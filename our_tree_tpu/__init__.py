@@ -1,0 +1,15 @@
+"""our_tree_tpu — a TPU-native parallel symmetric-cryptography framework.
+
+Built from scratch in JAX/XLA/Pallas toward the capabilities of the reference
+repo maleiwhat/Our-Tree (see SURVEY.md). Implemented so far: AES-128/192/256
+in ECB/CBC/CFB128/CTR modes with byte-granular streaming resume, and the ARC4
+stream cipher with its split keystream/XOR phases — all bit-exact against the
+reference's portable C implementation. In progress (SURVEY.md §7): multi-chip
+sharding (parallel/), native C++ CPU backend (runtime/), benchmark harness and
+CSV-results surface (harness/), and the bitsliced/Pallas TPU fast paths (ops/).
+"""
+
+__version__ = "0.1.0"
+
+from .models.aes import AES, AES_DECRYPT, AES_ENCRYPT  # noqa: F401
+from .models.arc4 import ARC4  # noqa: F401

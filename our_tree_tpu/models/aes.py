@@ -1,0 +1,302 @@
+"""AES cipher contexts and block modes (ECB / CBC / CFB128 / CTR).
+
+API parity target is the reference C interface (aes-modes/aes.h:62-161):
+key setup for both directions over an `aes_context`, bulk mode functions that
+carry resumable stream state (`iv`, `iv_off`, `nonce_counter`, `stream_block`,
+`nc_off`). Those resume offsets are the reference's miniature
+checkpoint/restore system (SURVEY.md §5) and are preserved here so chunked /
+streaming encryption produces byte-identical output to one-shot calls.
+
+Mode dataflow is chosen for the hardware, not transliterated
+(SURVEY.md §2 parallelism table):
+
+  * ECB — embarrassingly parallel: one batched call over all blocks
+    (reference: pthread chunks, aes-modes/test.c:33-35).
+  * CTR — keystream block k = E(counter0 + k); counters are materialised with
+    an iota and encrypted in one batch (reference: sequential per-block
+    increment, aes-modes/aes.c:869-901; the *semantics* — post-increment
+    big-endian 128-bit counter — are matched bit-for-bit).
+  * CBC encrypt / CFB128 encrypt — true recurrences, expressed as `lax.scan`
+    over blocks (reference: while-loops, aes.c:757-816, aes.c:822-863).
+  * CBC decrypt / CFB128 decrypt — the recurrence reads only *ciphertext*,
+    so decryption is fully parallel: batch-decrypt all blocks and XOR against
+    the shifted ciphertext stream.
+
+The compute engine is selectable: "jnp" (T-table gather core, ops/block.py)
+or "bitslice" (bit-plane engine, ops/bitslice.py — the TPU throughput path).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import block
+from ..ops.keyschedule import expand_key_dec, expand_key_enc
+from ..utils import packing
+
+AES_ENCRYPT = 1
+AES_DECRYPT = 0
+
+
+# ---------------------------------------------------------------------------
+# Jitted functional cores (word-level). Shapes: words (N, 4) uint32.
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def ecb_encrypt_words(words, rk, nr):
+    return block.encrypt_words(words, rk, nr)
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def ecb_decrypt_words(words, rk_dec, nr):
+    return block.decrypt_words(words, rk_dec, nr)
+
+
+def _add_counter_be(ctr_be: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """128-bit big-endian add: (4,) u32 BE words + (N,) u32 -> (N, 4).
+
+    Matches the reference's byte-ripple increment (aes-modes/aes.c:879-884)
+    vectorised: word 3 is least significant; carries ripple upward.
+    """
+    s3 = ctr_be[3] + idx
+    c3 = (s3 < idx).astype(jnp.uint32)
+    s2 = ctr_be[2] + c3
+    c2 = c3 & (s2 == 0).astype(jnp.uint32)
+    s1 = ctr_be[1] + c2
+    c1 = c2 & (s1 == 0).astype(jnp.uint32)
+    s0 = ctr_be[0] + c1
+    return jnp.stack([jnp.broadcast_to(s0, idx.shape), jnp.broadcast_to(s1, idx.shape),
+                      jnp.broadcast_to(s2, idx.shape), s3], axis=-1)
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def ctr_keystream_words(ctr_be_words, rk, nr, nblocks_idx):
+    """Keystream for blocks counter0+idx. ctr_be_words: (4,) u32 BE."""
+    ctr_blocks_be = _add_counter_be(ctr_be_words, nblocks_idx)
+    # The cipher consumes LE-packed words of the counter's byte stream; the
+    # counter bytes are the BE words' bytes, so each word is byteswapped.
+    ctr_le = packing.byteswap32(ctr_blocks_be)
+    return block.encrypt_words(ctr_le, rk, nr)
+
+
+@functools.partial(jax.jit, static_argnums=(3,))
+def ctr_crypt_words(words, ctr_be_words, rk, nr):
+    n = words.shape[0]
+    idx = jnp.arange(n, dtype=jnp.uint32)
+    ks = ctr_keystream_words(ctr_be_words, rk, nr, idx)
+    return words ^ ks
+
+
+@functools.partial(jax.jit, static_argnums=(3,))
+def cbc_encrypt_words(words, iv_words, rk, nr):
+    def step(iv, p):
+        c = block.encrypt_words(p ^ iv, rk, nr)
+        return c, c
+
+    iv_out, out = jax.lax.scan(step, iv_words, words)
+    return out, iv_out
+
+
+@functools.partial(jax.jit, static_argnums=(3,))
+def _cbc_decrypt_words_impl(words, iv_words, rk_dec, nr):
+    # Parallel: P_i = D(C_i) ^ C_{i-1} (C_{-1} = IV). Reference does this
+    # serially (aes.c:782-796); the dependency chain only involves ciphertext,
+    # so the TPU version is one batched decrypt + shifted XOR.
+    prev = jnp.concatenate([iv_words[None, :], words[:-1]], axis=0)
+    out = block.decrypt_words(words, rk_dec, nr) ^ prev
+    return out, words[-1]
+
+
+def cbc_decrypt_words(words, iv_words, rk_dec, nr):
+    if words.shape[0] == 0:  # length-0 is a no-op, as in the reference
+        return words, iv_words
+    return _cbc_decrypt_words_impl(words, iv_words, rk_dec, nr)
+
+
+@functools.partial(jax.jit, static_argnums=(3,))
+def cfb128_encrypt_words(words, iv_words, rk, nr):
+    def step(iv, p):
+        c = p ^ block.encrypt_words(iv, rk, nr)
+        return c, c
+
+    iv_out, out = jax.lax.scan(step, iv_words, words)
+    return out, iv_out
+
+
+@functools.partial(jax.jit, static_argnums=(3,))
+def cfb128_decrypt_words(words, iv_words, rk, nr):
+    # Keystream block i = E(C_{i-1}) — all known up front, so parallel.
+    prev = jnp.concatenate([iv_words[None, :], words[:-1]], axis=0)
+    out = words ^ block.encrypt_words(prev, rk, nr)
+    return out, words[-1]
+
+
+# ---------------------------------------------------------------------------
+# Host-facing context with byte-granular streaming (the aes.h API shape).
+# ---------------------------------------------------------------------------
+
+
+def _to_u8(data) -> np.ndarray:
+    if isinstance(data, (bytes, bytearray, memoryview)):
+        return np.frombuffer(bytes(data), dtype=np.uint8)
+    return np.asarray(data, dtype=np.uint8)
+
+
+def _words_np(b: np.ndarray) -> np.ndarray:
+    return packing.np_bytes_to_words(b).reshape(-1, 4)
+
+
+def _bytes_np(w) -> np.ndarray:
+    return packing.np_words_to_bytes(np.asarray(w, dtype=np.uint32).reshape(-1, 4)).reshape(-1)
+
+
+def _inc_counter_bytes(ctr: np.ndarray, k: int = 1) -> np.ndarray:
+    """Add k to a 16-byte big-endian counter (host-side bookkeeping)."""
+    val = int.from_bytes(ctr.tobytes(), "big")
+    val = (val + k) % (1 << 128)
+    return np.frombuffer(val.to_bytes(16, "big"), dtype=np.uint8).copy()
+
+
+@dataclass
+class AES:
+    """An AES key context, both directions, engine-selectable.
+
+    Equivalent of `aes_context` + `aes_setkey_enc`/`aes_setkey_dec`
+    (reference aes-modes/aes.h:41-84). Round keys are expanded on host and
+    staged to device once.
+    """
+
+    key: bytes
+    engine: str = "jnp"
+
+    def __post_init__(self):
+        if self.engine not in ("jnp",):  # "bitslice" lands with ops/bitslice.py
+            raise ValueError(f"unknown engine {self.engine!r}")
+        self.key = bytes(self.key)
+        self.nr, rk_enc = expand_key_enc(self.key)
+        _, rk_dec = expand_key_dec(self.key)
+        self.rk_enc = jnp.asarray(rk_enc)
+        self.rk_dec = jnp.asarray(rk_dec)
+
+    # -- ECB ---------------------------------------------------------------
+    def crypt_ecb(self, mode: int, data) -> np.ndarray:
+        """Bulk ECB over any multiple of 16 bytes (reference aes.c:650-752
+        handles one block; the batch dimension replaces the caller's loop)."""
+        b = _to_u8(data)
+        if b.size % 16:
+            raise ValueError("ECB data must be a multiple of 16 bytes")
+        w = _words_np(b)
+        if mode == AES_ENCRYPT:
+            out = ecb_encrypt_words(jnp.asarray(w), self.rk_enc, self.nr)
+        else:
+            out = ecb_decrypt_words(jnp.asarray(w), self.rk_dec, self.nr)
+        return _bytes_np(np.asarray(out))
+
+    # -- CBC ---------------------------------------------------------------
+    def crypt_cbc(self, mode: int, iv: np.ndarray, data) -> tuple[np.ndarray, np.ndarray]:
+        """CBC with explicit IV state; returns (output, new_iv). Semantics of
+        reference aes.c:757-816 (IV updated to last ciphertext block)."""
+        b = _to_u8(data)
+        if b.size % 16:
+            raise ValueError("CBC data must be a multiple of 16 bytes")
+        ivw = jnp.asarray(_words_np(_to_u8(iv))[0])
+        w = jnp.asarray(_words_np(b))
+        if mode == AES_ENCRYPT:
+            out, newiv = cbc_encrypt_words(w, ivw, self.rk_enc, self.nr)
+        else:
+            out, newiv = cbc_decrypt_words(w, ivw, self.rk_dec, self.nr)
+        return _bytes_np(np.asarray(out)), _bytes_np(np.asarray(newiv)[None, :])
+
+    # -- CFB128 ------------------------------------------------------------
+    def crypt_cfb128(self, mode: int, iv_off: int, iv: np.ndarray, data):
+        """Byte-granular CFB128 (reference aes.c:822-863): returns
+        (output, new_iv_off, new_iv). `iv` carries the feedback register,
+        partially overwritten with ciphertext when iv_off != 0."""
+        b = _to_u8(data)
+        iv = _to_u8(iv).copy()
+        return self._cfb_impl(mode, int(iv_off), iv, b)
+
+    def _ecb1(self, block16: np.ndarray) -> np.ndarray:
+        return self.crypt_ecb(AES_ENCRYPT, block16)
+
+    def _cfb_impl(self, mode, iv_off, iv, b):
+        out = np.empty_like(b)
+        pos = 0
+        n = int(iv_off)
+        # PolarSSL keeps the *current* keystream implicitly: when n != 0 the
+        # iv buffer holds ciphertext in positions [0, n) and not-yet-consumed
+        # keystream bytes E(prev_iv) in positions [n, 16). See aes.c:836-846.
+        while pos < b.size:
+            if n == 0 and b.size - pos >= 16:
+                # Aligned bulk: batched device kernels over all full blocks.
+                nfull = (b.size - pos) // 16
+                w = jnp.asarray(_words_np(b[pos : pos + nfull * 16]))
+                ivw = jnp.asarray(_words_np(iv)[0])
+                if mode == AES_ENCRYPT:
+                    o, newiv = cfb128_encrypt_words(w, ivw, self.rk_enc, self.nr)
+                else:
+                    o, newiv = cfb128_decrypt_words(w, ivw, self.rk_enc, self.nr)
+                out[pos : pos + nfull * 16] = _bytes_np(np.asarray(o))
+                iv = _bytes_np(np.asarray(newiv)[None, :]).copy()
+                pos += nfull * 16
+                continue
+            if n == 0:
+                iv = self._ecb1(iv).copy()
+            take = min(16 - n, b.size - pos)
+            chunk = b[pos : pos + take]
+            c = chunk ^ iv[n : n + take]
+            iv[n : n + take] = c if mode == AES_ENCRYPT else chunk
+            out[pos : pos + take] = c
+            pos += take
+            n = (n + take) & 0x0F
+        return out, n, iv
+
+    # -- CTR ---------------------------------------------------------------
+    def crypt_ctr(self, nc_off: int, nonce_counter: np.ndarray,
+                  stream_block: np.ndarray, data):
+        """Byte-granular CTR (reference aes.c:869-901): returns
+        (output, new_nc_off, new_nonce_counter, new_stream_block).
+
+        Parity-critical detail: the reference computes
+        ``stream_block = E(counter)`` and **then** post-increments the
+        counter (aes.c:876-884), so keystream block k is E(counter0 + k) and
+        after a call that ends mid-block the stored counter is one ahead of
+        the block being consumed.
+        """
+        b = _to_u8(data)
+        nonce_counter = _to_u8(nonce_counter).copy()
+        stream_block = _to_u8(stream_block).copy()
+        out = np.empty_like(b)
+        pos = 0
+        n = int(nc_off)
+
+        # Drain a partial stream block left over from a previous call.
+        if n != 0:
+            take = min(16 - n, b.size)
+            out[:take] = b[:take] ^ stream_block[n : n + take]
+            pos = take
+            n = (n + take) & 0x0F
+
+        nfull = (b.size - pos) // 16
+        if nfull:
+            w = jnp.asarray(_words_np(b[pos : pos + nfull * 16]))
+            ctr_be = jnp.asarray(packing.np_bytes_to_words(nonce_counter).byteswap())
+            o = ctr_crypt_words(w, ctr_be, self.rk_enc, self.nr)
+            out[pos : pos + nfull * 16] = _bytes_np(np.asarray(o))
+            pos += nfull * 16
+            nonce_counter = _inc_counter_bytes(nonce_counter, nfull)
+
+        if pos < b.size:
+            # Tail: generate one more keystream block, post-increment counter.
+            stream_block = self._ecb1(nonce_counter)
+            nonce_counter = _inc_counter_bytes(nonce_counter, 1)
+            take = b.size - pos
+            out[pos:] = b[pos:] ^ stream_block[:take]
+            n = take
+        return out, n, nonce_counter, stream_block

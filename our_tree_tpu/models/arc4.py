@@ -1,0 +1,115 @@
+"""ARC4 stream cipher with the reference's three-phase split.
+
+The reference's one original design idea (SURVEY.md §0) is splitting RC4 into
+a sequential keystream-generation phase and a data-parallel XOR phase
+(`arc4_prep` / `arc4_crypt`, reference arc4.c:72-112, vs the usual fused
+loop). That phase split *is* this framework's sequence-parallelism story, so
+the three-phase API is preserved exactly:
+
+  * `setup`   — key schedule, 256 sequential swaps (reference arc4.c:43-67).
+    Host-side numpy: tiny, inherently serial.
+  * `prep`    — keystream generation. An O(n) recurrence with 258 bytes of
+    state `{x, y, m[256]}`; expressed as a `lax.scan` whose carry is exactly
+    that state, so a stream can be generated in chunks and resumed — the
+    scan carry is the reference's cross-call resumability (arc4.c:93-94).
+    A numpy fallback exists for host-only use.
+  * `crypt`   — pure XOR of data against keystream (arc4.c:101-112);
+    embarrassingly parallel, batched on device, shardable across chips.
+
+State convention matches `arc4_context {x, y, m[256]}` (arc4.h:35-41).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def key_schedule(key: bytes | np.ndarray) -> np.ndarray:
+    """KSA: returns the initial 256-byte permutation (uint8)."""
+    key = np.frombuffer(bytes(key), dtype=np.uint8) if isinstance(key, (bytes, bytearray)) else np.asarray(key, np.uint8)
+    m = np.arange(256, dtype=np.int64)
+    j = 0
+    for i in range(256):
+        j = (j + int(m[i]) + int(key[i % len(key)])) & 0xFF
+        m[i], m[j] = m[j], m[i]
+    return m.astype(np.uint8)
+
+
+def keystream_np(state: tuple[int, int, np.ndarray], length: int):
+    """Host PRGA: returns (keystream, new_state). Oracle for the scan path."""
+    x, y, m = state
+    m = m.astype(np.int64).copy()
+    ks = np.empty(length, dtype=np.uint8)
+    for i in range(length):
+        x = (x + 1) & 0xFF
+        a = m[x]
+        y = (y + a) & 0xFF
+        b = m[y]
+        m[x] = b
+        m[y] = a
+        ks[i] = m[(a + b) & 0xFF]
+    return ks, (x, y, m.astype(np.uint8))
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def keystream_scan(state, length: int):
+    """PRGA as an XLA scan. state = (x, y, m) with x,y uint32 scalars and m
+    a (256,) uint32 permutation; returns ((x', y', m'), keystream uint8).
+
+    One byte per scan step with two dynamic scatter updates — the honest
+    sequential baseline, exactly as the reference's keygen loop is the
+    sequential baseline there (arc4.c:82-91 at 0.037 GB/s, results.myth.1:38).
+    """
+
+    def step(carry, _):
+        x, y, m = carry
+        x = (x + 1) & 0xFF
+        a = m[x]
+        y = (y + a) & 0xFF
+        b = m[y]
+        m = m.at[x].set(b).at[y].set(a)
+        out = m[(a + b) & 0xFF]
+        return (x, y, m), out.astype(jnp.uint8)
+
+    carry, ks = jax.lax.scan(step, state, None, length=length)
+    return carry, ks
+
+
+def crypt(data: jnp.ndarray, keystream: jnp.ndarray) -> jnp.ndarray:
+    """Phase 3: XOR (device, parallel)."""
+    return jnp.bitwise_xor(data, keystream)
+
+
+@dataclass
+class ARC4:
+    """arc4_context equivalent: holds {x, y, m} across calls."""
+
+    key: bytes
+
+    def __post_init__(self):
+        self.x = 0
+        self.y = 0
+        self.m = key_schedule(self.key)
+
+    def prep(self, length: int, backend: str = "jax") -> np.ndarray:
+        """Generate `length` keystream bytes, advancing internal state."""
+        if backend == "np":
+            ks, (self.x, self.y, self.m) = keystream_np((self.x, self.y, self.m), length)
+            return ks
+        state = (jnp.uint32(self.x), jnp.uint32(self.y), jnp.asarray(self.m, jnp.uint32))
+        (x, y, m), ks = keystream_scan(state, length)
+        self.x, self.y = int(x), int(y)
+        self.m = np.asarray(m, dtype=np.uint8)
+        return np.asarray(ks)
+
+    def crypt(self, data, keystream=None) -> np.ndarray:
+        """XOR data with keystream (generated here if not supplied)."""
+        d = np.frombuffer(bytes(data), dtype=np.uint8) if isinstance(data, (bytes, bytearray)) else np.asarray(data, np.uint8)
+        if keystream is None:
+            keystream = self.prep(d.size)
+        return np.asarray(crypt(jnp.asarray(d), jnp.asarray(keystream, dtype=jnp.uint8)))

@@ -1,0 +1,123 @@
+"""Reference AES block cipher in pure jnp (T-table formulation).
+
+This is the framework's *correctness core*: a direct, batched expression of
+the round structure used by the parity oracle (`AES_FROUND`/`AES_RROUND`,
+reference aes-modes/aes.c:601-645, and the round loops at aes.c:650-752). It
+is data-parallel over a leading block axis — one 16-byte block per row — so a
+single call encrypts N blocks with no Python-level looping over data
+(the reference's pthread chunking, aes-modes/test.c:33-35, becomes a batched
+array op).
+
+Table lookups use `jnp.take`, which XLA lowers to gathers. That is correct
+everywhere and reasonably fast on CPU; the TPU throughput path is the
+bitsliced engine in `ops/bitslice.py` — this module is the oracle the fast
+paths are tested against.
+
+State layout: four uint32 columns per block, little-endian packed
+(see utils/packing.py). All functions are jit-compatible; `nr` and table
+constants are static.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import tables
+
+
+def _tbl(t: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    # Indices are always masked to [0, 256), so promise in-bounds to skip
+    # XLA's clamping.
+    return t.at[idx.astype(jnp.int32)].get(mode="promise_in_bounds")
+
+
+def _bytes_of(x: jnp.ndarray) -> tuple[jnp.ndarray, ...]:
+    return x & 0xFF, (x >> 8) & 0xFF, (x >> 16) & 0xFF, x >> 24
+
+
+def encrypt_words(x: jnp.ndarray, rk: jnp.ndarray, nr: int) -> jnp.ndarray:
+    """Encrypt a batch of blocks.
+
+    Args:
+      x: (..., 4) uint32 — LE-packed state words, one block per row.
+      rk: (4*(nr+1),) uint32 round keys from `expand_key_enc`.
+      nr: static round count (10/12/14).
+
+    Returns:
+      (..., 4) uint32 ciphertext words.
+    """
+    ft0, ft1, ft2, ft3 = (jnp.asarray(t) for t in (tables.FT0, tables.FT1, tables.FT2, tables.FT3))
+    fsb = jnp.asarray(tables.SBOX)
+    rk = rk.astype(jnp.uint32)
+
+    x0 = x[..., 0] ^ rk[0]
+    x1 = x[..., 1] ^ rk[1]
+    x2 = x[..., 2] ^ rk[2]
+    x3 = x[..., 3] ^ rk[3]
+
+    def fround(r, a0, a1, a2, a3):
+        k = rk[4 * r : 4 * r + 4]
+        b = [_bytes_of(a) for a in (a0, a1, a2, a3)]
+        y0 = k[0] ^ _tbl(ft0, b[0][0]) ^ _tbl(ft1, b[1][1]) ^ _tbl(ft2, b[2][2]) ^ _tbl(ft3, b[3][3])
+        y1 = k[1] ^ _tbl(ft0, b[1][0]) ^ _tbl(ft1, b[2][1]) ^ _tbl(ft2, b[3][2]) ^ _tbl(ft3, b[0][3])
+        y2 = k[2] ^ _tbl(ft0, b[2][0]) ^ _tbl(ft1, b[3][1]) ^ _tbl(ft2, b[0][2]) ^ _tbl(ft3, b[1][3])
+        y3 = k[3] ^ _tbl(ft0, b[3][0]) ^ _tbl(ft1, b[0][1]) ^ _tbl(ft2, b[1][2]) ^ _tbl(ft3, b[2][3])
+        return y0, y1, y2, y3
+
+    for r in range(1, nr):
+        x0, x1, x2, x3 = fround(r, x0, x1, x2, x3)
+
+    # Final round: SubBytes + ShiftRows + AddRoundKey, no MixColumns.
+    k = rk[4 * nr : 4 * nr + 4]
+    b = [_bytes_of(a) for a in (x0, x1, x2, x3)]
+
+    def ffinal(j, kj):
+        return kj ^ (
+            _tbl(fsb, b[j % 4][0])
+            | (_tbl(fsb, b[(j + 1) % 4][1]) << 8)
+            | (_tbl(fsb, b[(j + 2) % 4][2]) << 16)
+            | (_tbl(fsb, b[(j + 3) % 4][3]) << 24)
+        )
+
+    out = [ffinal(j, k[j]) for j in range(4)]
+    return jnp.stack(out, axis=-1)
+
+
+def decrypt_words(x: jnp.ndarray, rk_dec: jnp.ndarray, nr: int) -> jnp.ndarray:
+    """Decrypt a batch of blocks with a decryption schedule from `expand_key_dec`."""
+    rt0, rt1, rt2, rt3 = (jnp.asarray(t) for t in (tables.RT0, tables.RT1, tables.RT2, tables.RT3))
+    rsb = jnp.asarray(tables.INV_SBOX)
+    rk = rk_dec.astype(jnp.uint32)
+
+    x0 = x[..., 0] ^ rk[0]
+    x1 = x[..., 1] ^ rk[1]
+    x2 = x[..., 2] ^ rk[2]
+    x3 = x[..., 3] ^ rk[3]
+
+    def rround(r, a0, a1, a2, a3):
+        k = rk[4 * r : 4 * r + 4]
+        b = [_bytes_of(a) for a in (a0, a1, a2, a3)]
+        # Inverse ShiftRows: row i sourced from column (j - i) mod 4.
+        y0 = k[0] ^ _tbl(rt0, b[0][0]) ^ _tbl(rt1, b[3][1]) ^ _tbl(rt2, b[2][2]) ^ _tbl(rt3, b[1][3])
+        y1 = k[1] ^ _tbl(rt0, b[1][0]) ^ _tbl(rt1, b[0][1]) ^ _tbl(rt2, b[3][2]) ^ _tbl(rt3, b[2][3])
+        y2 = k[2] ^ _tbl(rt0, b[2][0]) ^ _tbl(rt1, b[1][1]) ^ _tbl(rt2, b[0][2]) ^ _tbl(rt3, b[3][3])
+        y3 = k[3] ^ _tbl(rt0, b[3][0]) ^ _tbl(rt1, b[2][1]) ^ _tbl(rt2, b[1][2]) ^ _tbl(rt3, b[0][3])
+        return y0, y1, y2, y3
+
+    for r in range(1, nr):
+        x0, x1, x2, x3 = rround(r, x0, x1, x2, x3)
+
+    k = rk[4 * nr : 4 * nr + 4]
+    b = [_bytes_of(a) for a in (x0, x1, x2, x3)]
+
+    def rfinal(j, kj):
+        return kj ^ (
+            _tbl(rsb, b[j % 4][0])
+            | (_tbl(rsb, b[(j + 3) % 4][1]) << 8)
+            | (_tbl(rsb, b[(j + 2) % 4][2]) << 16)
+            | (_tbl(rsb, b[(j + 1) % 4][3]) << 24)
+        )
+
+    out = [rfinal(j, k[j]) for j in range(4)]
+    return jnp.stack(out, axis=-1)

@@ -1,0 +1,57 @@
+"""GF(2^8) arithmetic for AES (Rijndael field, modulus x^8+x^4+x^3+x+1).
+
+Host-side numpy, used only at import time to generate lookup tables and
+key schedules. This replaces the reference's runtime table generator
+(`aes_gen_tables`, reference aes-modes/aes.c:361-435) with a from-scratch
+implementation derived directly from FIPS-197; nothing here is traced by JAX.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+#: The AES field modulus x^8 + x^4 + x^3 + x + 1.
+POLY = 0x11B
+
+
+def xtime(a: int) -> int:
+    """Multiply by x (i.e. 2) in GF(2^8)."""
+    a <<= 1
+    if a & 0x100:
+        a ^= POLY
+    return a & 0xFF
+
+
+def gmul(a: int, b: int) -> int:
+    """Carry-less multiply of two field elements, reduced mod POLY."""
+    r = 0
+    while b:
+        if b & 1:
+            r ^= a
+        b >>= 1
+        a = xtime(a)
+    return r
+
+
+def gpow(a: int, e: int) -> int:
+    """a**e in GF(2^8) by square-and-multiply."""
+    r = 1
+    base = a
+    while e:
+        if e & 1:
+            r = gmul(r, base)
+        base = gmul(base, base)
+        e >>= 1
+    return r
+
+
+def ginv(a: int) -> int:
+    """Multiplicative inverse; AES convention maps 0 -> 0."""
+    if a == 0:
+        return 0
+    return gpow(a, 254)
+
+
+def gmul_table(c: int) -> np.ndarray:
+    """(256,) uint32 table of gmul(c, x) for all x — used for table generation."""
+    return np.array([gmul(c, x) for x in range(256)], dtype=np.uint32)

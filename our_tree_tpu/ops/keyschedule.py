@@ -1,0 +1,87 @@
+"""AES key expansion (host-side, numpy).
+
+The key schedule is tiny (<=60 words) and inherently sequential, so like the
+reference — which expands keys on the host CPU even for the GPU backend
+(ExpandKey at aes-gpu/Source/AES.cu:68-149, AES-NI variant at
+aes-modes/aesni.c:38-77) — it runs on host in numpy and the resulting round
+keys are staged to the device once per key.
+
+Word layout matches the parity oracle (`aes_setkey_enc`, reference
+aes-modes/aes.c:442-542): little-endian packed uint32 words, flat array of
+4*(nr+1) words. The decryption schedule reverses the round order and applies
+InvMixColumns to the interior round keys (`aes_setkey_dec`, aes.c:547-599),
+enabling the "equivalent inverse cipher" so decryption has the same dataflow
+shape as encryption.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .tables import RCON, SBOX, inv_mix_columns_word
+
+#: key bits -> number of rounds
+ROUNDS = {128: 10, 192: 12, 256: 14}
+
+
+def _sub_word(w: int) -> int:
+    return int(
+        SBOX[w & 0xFF]
+        | (SBOX[(w >> 8) & 0xFF] << 8)
+        | (SBOX[(w >> 16) & 0xFF] << 16)
+        | (SBOX[(w >> 24) & 0xFF] << 24)
+    )
+
+
+def _rot_word(w: int) -> int:
+    # Spec RotWord([a0,a1,a2,a3]) -> [a1,a2,a3,a0]; in LE packing that is a
+    # 32-bit rotate right by 8.
+    return ((w >> 8) | (w << 24)) & 0xFFFFFFFF
+
+
+def expand_key_enc(key: bytes) -> tuple[int, np.ndarray]:
+    """Expand an AES key for encryption.
+
+    Args:
+      key: 16, 24 or 32 raw key bytes.
+
+    Returns:
+      (nr, rk): the round count and a (4*(nr+1),) uint32 array of round-key
+      words, little-endian packed.
+    """
+    keybits = len(key) * 8
+    if keybits not in ROUNDS:
+        raise ValueError(f"AES key must be 128/192/256 bits, got {keybits}")
+    nr = ROUNDS[keybits]
+    nk = len(key) // 4
+    nwords = 4 * (nr + 1)
+
+    w = [0] * nwords
+    kb = [int(x) for x in key]
+    for i in range(nk):
+        w[i] = kb[4 * i] | (kb[4 * i + 1] << 8) | (kb[4 * i + 2] << 16) | (kb[4 * i + 3] << 24)
+
+    for i in range(nk, nwords):
+        t = w[i - 1]
+        if i % nk == 0:
+            t = _sub_word(_rot_word(t)) ^ int(RCON[i // nk - 1])
+        elif nk == 8 and i % nk == 4:
+            t = _sub_word(t)
+        w[i] = w[i - nk] ^ t
+
+    return nr, np.array(w, dtype=np.uint32)
+
+
+def expand_key_dec(key: bytes) -> tuple[int, np.ndarray]:
+    """Expand an AES key for decryption (equivalent inverse cipher schedule)."""
+    nr, enc = expand_key_enc(key)
+    dec = np.zeros_like(enc)
+    # Round 0 of decryption = last round key of encryption, untransformed.
+    dec[0:4] = enc[4 * nr : 4 * nr + 4]
+    # Interior rounds: reversed order with InvMixColumns applied.
+    for r in range(1, nr):
+        src = enc[4 * (nr - r) : 4 * (nr - r) + 4]
+        dec[4 * r : 4 * r + 4] = inv_mix_columns_word(src)
+    # Final: the original first round key.
+    dec[4 * nr : 4 * nr + 4] = enc[0:4]
+    return nr, dec

@@ -1,0 +1,83 @@
+"""AES lookup tables, generated programmatically at import time.
+
+One source of truth for the S-box, inverse S-box, the combined
+SubBytes+MixColumns "T-tables" and the round-constant schedule. The reference
+carries three separate copies of this data (runtime generator at
+aes-modes/aes.c:361-435, a 1,382-line static file aes-gpu/Source/AES.tab, and
+the hardware path needs none); here everything is derived from GF(2^8)
+arithmetic in ~40 lines of numpy.
+
+Byte-order convention: **little-endian 32-bit words**, matching the parity
+oracle (`GET_ULONG_LE`, reference aes-modes/aes.c:43-49). A state column with
+bytes (b0, b1, b2, b3) — b0 being row 0 — packs as
+``b0 | b1<<8 | b2<<16 | b3<<24``.  The reference's GPU path uses the opposite
+(big-endian, AES.cu:42); we deliberately do not.
+
+Table math (standard T-table construction):
+  FT0[x] = (2*S | S<<8 | S<<16 | 3*S<<24) where S = SBOX[x]; FTi = rotl(FT0, 8i)
+  RT0[x] = (14*I | 9*I<<8 | 13*I<<16 | 11*I<<24) where I = INV_SBOX[x];
+  RTi = rotl(RT0, 8i)
+These fold SubBytes+MixColumns (resp. InvSubBytes+InvMixColumns) into four
+256-entry uint32 lookups per state word.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import gf
+
+
+def _rotl8(b: np.ndarray, n: int) -> np.ndarray:
+    """8-bit rotate left of a uint array holding byte values."""
+    return ((b << n) | (b >> (8 - n))) & 0xFF
+
+
+def _rotl32(w: np.ndarray, n: int) -> np.ndarray:
+    w = w.astype(np.uint64)
+    return (((w << n) | (w >> (32 - n))) & 0xFFFFFFFF).astype(np.uint32)
+
+
+def _make_sbox() -> tuple[np.ndarray, np.ndarray]:
+    inv = np.array([gf.ginv(x) for x in range(256)], dtype=np.uint32)
+    s = inv ^ _rotl8(inv, 1) ^ _rotl8(inv, 2) ^ _rotl8(inv, 3) ^ _rotl8(inv, 4) ^ 0x63
+    sbox = s.astype(np.uint32)
+    inv_sbox = np.zeros(256, dtype=np.uint32)
+    inv_sbox[sbox] = np.arange(256, dtype=np.uint32)
+    return sbox, inv_sbox
+
+
+SBOX, INV_SBOX = _make_sbox()
+
+# Forward tables: SubBytes + MixColumns folded, little-endian packing.
+_m2, _m3 = gf.gmul_table(2), gf.gmul_table(3)
+_S = SBOX
+FT0 = (_m2[_S] | (_S << 8) | (_S << 16) | (_m3[_S] << 24)).astype(np.uint32)
+FT1 = _rotl32(FT0, 8)
+FT2 = _rotl32(FT0, 16)
+FT3 = _rotl32(FT0, 24)
+
+# Reverse tables: InvSubBytes + InvMixColumns folded.
+_m9, _m11, _m13, _m14 = (gf.gmul_table(c) for c in (9, 11, 13, 14))
+_I = INV_SBOX
+RT0 = (_m14[_I] | (_m9[_I] << 8) | (_m13[_I] << 16) | (_m11[_I] << 24)).astype(np.uint32)
+RT1 = _rotl32(RT0, 8)
+RT2 = _rotl32(RT0, 16)
+RT3 = _rotl32(RT0, 24)
+
+#: Round constants for the key schedule (low byte of the LE word).
+RCON = np.array(
+    [gf.gpow(2, i) for i in range(10)], dtype=np.uint32
+)
+
+#: InvMixColumns applied to a packed LE word, as a function — used by the
+#: decryption key schedule (reference aes-modes/aes.c:580-589 does this with
+#: table lookups; we do the field math directly).
+def inv_mix_columns_word(w: np.ndarray) -> np.ndarray:
+    w = np.asarray(w, dtype=np.uint32)
+    b0, b1, b2, b3 = (w >> 0) & 0xFF, (w >> 8) & 0xFF, (w >> 16) & 0xFF, (w >> 24) & 0xFF
+    s0 = _m14[b0] ^ _m11[b1] ^ _m13[b2] ^ _m9[b3]
+    s1 = _m9[b0] ^ _m14[b1] ^ _m11[b2] ^ _m13[b3]
+    s2 = _m13[b0] ^ _m9[b1] ^ _m14[b2] ^ _m11[b3]
+    s3 = _m11[b0] ^ _m13[b1] ^ _m9[b2] ^ _m14[b3]
+    return (s0 | (s1 << 8) | (s2 << 16) | (s3 << 24)).astype(np.uint32)

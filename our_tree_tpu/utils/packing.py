@@ -1,0 +1,63 @@
+"""Byte <-> word packing helpers.
+
+All of the framework's cipher cores operate on little-endian packed uint32
+words (the `GET_ULONG_LE`/`PUT_ULONG_LE` convention of the parity oracle,
+reference aes-modes/aes.c:43-60). The VPU is a >=32-bit machine, so bytes are
+packed 4-per-lane at the boundary and everything stays uint32 internally
+(SURVEY.md §7 hard part #2).
+
+numpy variants are host-side (zero-copy views where possible); jnp variants
+trace into XLA programs.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def np_bytes_to_words(b: np.ndarray) -> np.ndarray:
+    """uint8 array with length % 4 == 0 -> little-endian uint32 words."""
+    b = np.ascontiguousarray(b, dtype=np.uint8)
+    if b.size % 4:
+        raise ValueError("byte length must be a multiple of 4")
+    return b.view("<u4").reshape(b.shape[:-1] + (b.shape[-1] // 4,))
+
+
+def np_words_to_bytes(w: np.ndarray) -> np.ndarray:
+    """uint32 words -> little-endian uint8 bytes."""
+    w = np.ascontiguousarray(w)
+    return w.astype("<u4").view(np.uint8).reshape(w.shape[:-1] + (w.shape[-1] * 4,))
+
+
+def jnp_bytes_to_words(b: jnp.ndarray) -> jnp.ndarray:
+    """(..., 4k) uint8 -> (..., k) uint32, little-endian, on device."""
+    b = b.astype(jnp.uint32)
+    b = b.reshape(b.shape[:-1] + (b.shape[-1] // 4, 4))
+    return b[..., 0] | (b[..., 1] << 8) | (b[..., 2] << 16) | (b[..., 3] << 24)
+
+
+def jnp_words_to_bytes(w: jnp.ndarray) -> jnp.ndarray:
+    """(..., k) uint32 -> (..., 4k) uint8, little-endian, on device."""
+    parts = jnp.stack(
+        [w & 0xFF, (w >> 8) & 0xFF, (w >> 16) & 0xFF, (w >> 24) & 0xFF], axis=-1
+    )
+    return parts.reshape(w.shape[:-1] + (w.shape[-1] * 4,)).astype(jnp.uint8)
+
+
+def byteswap32(w: jnp.ndarray) -> jnp.ndarray:
+    """Reverse byte order within each uint32 lane (BE<->LE word view)."""
+    return (
+        ((w & 0xFF) << 24)
+        | ((w & 0xFF00) << 8)
+        | ((w >> 8) & 0xFF00)
+        | ((w >> 24) & 0xFF)
+    )
+
+
+def hex_to_bytes(s: str) -> np.ndarray:
+    return np.frombuffer(bytes.fromhex(s), dtype=np.uint8)
+
+
+def bytes_to_hex(b: np.ndarray) -> str:
+    return np.asarray(b, dtype=np.uint8).tobytes().hex()

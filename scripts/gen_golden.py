@@ -1,0 +1,220 @@
+"""Generate golden parity data from the reference C implementation.
+
+Compiles the reference's portable AES (`aes-modes/aes.c`) and ARC4
+(`arc4.c`) — the only trustworthy correctness oracles in the reference per
+SURVEY.md §2 ("known defects") — into a shared library, drives them through
+ctypes, and writes `tests/golden/golden.json`. The checked-in JSON makes the
+test suite self-contained: CI parity tests never need the reference repo.
+
+Run once (or whenever coverage is extended):
+    python scripts/gen_golden.py [--reference /root/reference]
+"""
+
+from __future__ import annotations
+
+import argparse
+import ctypes
+import json
+import pathlib
+import subprocess
+import tempfile
+
+import numpy as np
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+class AesContext(ctypes.Structure):
+    # aes_context at reference aes-modes/aes.h:41-47 (unsigned long on LP64).
+    _fields_ = [
+        ("nr", ctypes.c_int),
+        ("rk", ctypes.POINTER(ctypes.c_ulong)),
+        ("buf", ctypes.c_ulong * 68),
+    ]
+
+
+class Arc4Context(ctypes.Structure):
+    # arc4_context at reference arc4.h:35-41.
+    _fields_ = [
+        ("x", ctypes.c_int),
+        ("y", ctypes.c_int),
+        ("m", ctypes.c_ubyte * 256),
+    ]
+
+
+def build_oracle(reference: pathlib.Path) -> ctypes.CDLL:
+    tmp = pathlib.Path(tempfile.mkdtemp(prefix="cryptoracle_"))
+    so = tmp / "libref.so"
+    subprocess.run(
+        [
+            "gcc", "-shared", "-fPIC", "-O2", "-std=gnu99",
+            # The reference compiles CFB out and never enables the AES self
+            # test (aes.c:32-33); enable both for full oracle coverage.
+            "-DPOLARSSL_SELF_TEST", "-DPOLARSSL_CIPHER_MODE_CFB",
+            "-I", str(reference / "aes-modes"), "-I", str(reference),
+            str(reference / "aes-modes" / "aes.c"),
+            str(reference / "arc4.c"),
+            "-o", str(so),
+        ],
+        check=True,
+    )
+    return ctypes.CDLL(str(so))
+
+
+class Oracle:
+    """ctypes driver for the reference implementation."""
+
+    def __init__(self, lib: ctypes.CDLL):
+        self.lib = lib
+
+    # -- AES ---------------------------------------------------------------
+    def _ctx(self, key: bytes, enc: bool) -> AesContext:
+        ctx = AesContext()
+        fn = self.lib.aes_setkey_enc if enc else self.lib.aes_setkey_dec
+        rc = fn(ctypes.byref(ctx), key, len(key) * 8)
+        assert rc == 0
+        return ctx
+
+    def ecb(self, key: bytes, data: bytes, encrypt: bool) -> bytes:
+        ctx = self._ctx(key, encrypt)
+        out = bytearray(len(data))
+        buf = (ctypes.c_ubyte * 16)()
+        ob = (ctypes.c_ubyte * 16)()
+        for off in range(0, len(data), 16):
+            buf[:] = data[off : off + 16]
+            self.lib.aes_crypt_ecb(ctypes.byref(ctx), 1 if encrypt else 0, buf, ob)
+            out[off : off + 16] = bytes(ob)
+        return bytes(out)
+
+    def cbc(self, key: bytes, iv: bytes, data: bytes, encrypt: bool) -> tuple[bytes, bytes]:
+        ctx = self._ctx(key, encrypt)
+        ivb = (ctypes.c_ubyte * 16)(*iv)
+        out = (ctypes.c_ubyte * len(data))()
+        rc = self.lib.aes_crypt_cbc(
+            ctypes.byref(ctx), 1 if encrypt else 0, len(data), ivb, bytes(data), out
+        )
+        assert rc == 0
+        return bytes(out), bytes(ivb)
+
+    def cfb128(self, key: bytes, iv: bytes, chunks: list[bytes], encrypt: bool):
+        """Returns (outputs per chunk, final iv_off, final iv)."""
+        ctx = self._ctx(key, True)  # CFB always uses the encryption schedule
+        ivb = (ctypes.c_ubyte * 16)(*iv)
+        off = ctypes.c_int(0)
+        outs = []
+        for chunk in chunks:
+            out = (ctypes.c_ubyte * len(chunk))()
+            rc = self.lib.aes_crypt_cfb128(
+                ctypes.byref(ctx), 1 if encrypt else 0, len(chunk),
+                ctypes.byref(off), ivb, bytes(chunk), out,
+            )
+            assert rc == 0
+            outs.append(bytes(out))
+        return outs, off.value, bytes(ivb)
+
+    def ctr(self, key: bytes, nonce: bytes, chunks: list[bytes]):
+        """Returns (outputs per chunk, final nc_off, final counter, final stream_block)."""
+        ctx = self._ctx(key, True)
+        nc = (ctypes.c_ubyte * 16)(*nonce)
+        sb = (ctypes.c_ubyte * 16)()
+        off = ctypes.c_int(0)
+        outs = []
+        for chunk in chunks:
+            out = (ctypes.c_ubyte * len(chunk))()
+            rc = self.lib.aes_crypt_ctr(
+                ctypes.byref(ctx), len(chunk), ctypes.byref(off), nc, sb,
+                bytes(chunk), out,
+            )
+            assert rc == 0
+            outs.append(bytes(out))
+        return outs, off.value, bytes(nc), bytes(sb)
+
+    # -- ARC4 --------------------------------------------------------------
+    def arc4_keystream(self, key: bytes, chunks: list[int]):
+        ctx = Arc4Context()
+        self.lib.arc4_setup(ctypes.byref(ctx), key, len(key))
+        outs = []
+        for n in chunks:
+            ks = (ctypes.c_ubyte * n)()
+            self.lib.arc4_prep(ctypes.byref(ctx), n, ks)
+            outs.append(bytes(ks))
+        return outs, (ctx.x, ctx.y, bytes(ctx.m))
+
+    def self_tests(self) -> dict:
+        return {
+            "aes_self_test": int(self.lib.aes_self_test(0)),
+            "arc4_self_test": int(self.lib.arc4_self_test(0)),
+        }
+
+
+def h(b: bytes) -> str:
+    return b.hex()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--reference", default="/root/reference")
+    args = ap.parse_args()
+
+    oracle = Oracle(build_oracle(pathlib.Path(args.reference)))
+    rng = np.random.default_rng(1337)  # the reference's fixed seed (test.c:131)
+
+    golden: dict = {"self_tests": oracle.self_tests()}
+    assert golden["self_tests"] == {"aes_self_test": 0, "arc4_self_test": 0}, golden
+
+    aes_cases = []
+    for keybits in (128, 192, 256):
+        key = rng.integers(0, 256, keybits // 8, dtype=np.uint8).tobytes()
+        iv = rng.integers(0, 256, 16, dtype=np.uint8).tobytes()
+        pt = rng.integers(0, 256, 256, dtype=np.uint8).tobytes()
+        pt_odd = rng.integers(0, 256, 100, dtype=np.uint8).tobytes()
+        case = {"keybits": keybits, "key": h(key), "iv": h(iv), "pt": h(pt), "pt_odd": h(pt_odd)}
+
+        case["ecb_ct"] = h(oracle.ecb(key, pt, True))
+        case["ecb_dec_of_pt"] = h(oracle.ecb(key, pt, False))
+
+        ct, iv_out = oracle.cbc(key, iv, pt, True)
+        case["cbc_ct"], case["cbc_iv_out"] = h(ct), h(iv_out)
+        dpt, div_out = oracle.cbc(key, iv, pt, False)
+        case["cbc_dec"], case["cbc_dec_iv_out"] = h(dpt), h(div_out)
+
+        outs, off, ivf = oracle.cfb128(key, iv, [pt_odd], True)
+        case["cfb_ct"], case["cfb_iv_off"], case["cfb_iv_out"] = h(outs[0]), off, h(ivf)
+        chunks = [pt_odd[:7], pt_odd[7:52], pt_odd[52:]]
+        outs_c, off_c, ivf_c = oracle.cfb128(key, iv, chunks, True)
+        assert b"".join(outs_c) == outs[0] and off_c == off and ivf_c == ivf
+        douts, doff, divf = oracle.cfb128(key, iv, [bytes.fromhex(case["cfb_ct"])], False)
+        case["cfb_dec_roundtrip"] = h(douts[0])
+
+        # CTR: plain nonce and a carry-propagating nonce near 2^128.
+        for tag, nonce in (("ctr", iv), ("ctr_wrap", b"\xff" * 15 + b"\xfe")):
+            outs, off, nc, sb = oracle.ctr(key, nonce, [pt_odd])
+            case[f"{tag}_nonce"] = h(nonce)
+            case[f"{tag}_ct"] = h(outs[0])
+            case[f"{tag}_nc_off"] = off
+            case[f"{tag}_counter_out"] = h(nc)
+            case[f"{tag}_stream_block"] = h(sb)
+            outs_c, off_c, nc_c, sb_c = oracle.ctr(key, nonce, [pt_odd[:7], pt_odd[7:52], pt_odd[52:]])
+            assert b"".join(outs_c) == outs[0] and (off_c, nc_c, sb_c) == (off, nc, sb)
+
+        aes_cases.append(case)
+    golden["aes"] = aes_cases
+
+    arc4_cases = []
+    for klen in (5, 8, 16, 32):
+        key = rng.integers(0, 256, klen, dtype=np.uint8).tobytes()
+        outs, (x, y, m) = oracle.arc4_keystream(key, [300])
+        outs_c, (xc, yc, mc) = oracle.arc4_keystream(key, [100, 200])
+        assert b"".join(outs_c) == outs[0] and (xc, yc, mc) == (x, y, m)
+        arc4_cases.append(
+            {"key": h(key), "keystream": h(outs[0]), "x": x, "y": y, "m": h(m)}
+        )
+    golden["arc4"] = arc4_cases
+
+    out_path = REPO / "tests" / "golden" / "golden.json"
+    out_path.write_text(json.dumps(golden, indent=1))
+    print(f"wrote {out_path} ({out_path.stat().st_size} bytes)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,14 @@
+"""Test configuration: force CPU with 8 virtual devices.
+
+The 8 virtual devices let sharding tests (tests/test_parallel.py) validate
+multi-chip paths without a pod — a capability the reference had no equivalent
+of (SURVEY.md §4: multi-device was "tested" only by owning the hardware).
+Must run before jax is imported anywhere.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,53 @@
+"""Single-block cipher tests vs FIPS-197 appendix C (reference aes.c:650-752)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from our_tree_tpu.models.aes import AES, AES_DECRYPT, AES_ENCRYPT
+from our_tree_tpu.ops import block
+from our_tree_tpu.ops.keyschedule import expand_key_dec, expand_key_enc
+from our_tree_tpu.utils import packing
+
+PT = bytes.fromhex("00112233445566778899aabbccddeeff")
+VECTORS = [
+    ("000102030405060708090a0b0c0d0e0f", "69c4e0d86a7b0430d8cdb78070b4c55a"),
+    ("000102030405060708090a0b0c0d0e0f1011121314151617", "dda97ca4864cdfe06eaf70a0ec0d7191"),
+    (
+        "000102030405060708090a0b0c0d0e0f101112131415161718191a1b1c1d1e1f",
+        "8ea2b7ca516745bfeafc49904b496089",
+    ),
+]
+
+
+def test_fips197_all_key_sizes():
+    for keyhex, cthex in VECTORS:
+        a = AES(bytes.fromhex(keyhex))
+        ct = a.crypt_ecb(AES_ENCRYPT, PT)
+        assert ct.tobytes().hex() == cthex
+        assert a.crypt_ecb(AES_DECRYPT, ct).tobytes() == PT
+
+
+def test_batched_equals_blockwise():
+    """N-block batch must equal N independent single-block calls — the
+    invariance that would have caught reference defect #1 (SURVEY.md §2)."""
+    rng = np.random.default_rng(7)
+    key = rng.integers(0, 256, 16, dtype=np.uint8).tobytes()
+    data = rng.integers(0, 256, 64 * 16, dtype=np.uint8)
+    nr, rk = expand_key_enc(key)
+    w = jnp.asarray(packing.np_bytes_to_words(data).reshape(-1, 4))
+    batched = np.asarray(block.encrypt_words(w, jnp.asarray(rk), nr))
+    for i in range(0, 64, 17):
+        single = np.asarray(block.encrypt_words(w[i], jnp.asarray(rk), nr))
+        assert np.array_equal(batched[i], single)
+
+
+def test_decrypt_inverts_encrypt_random():
+    rng = np.random.default_rng(11)
+    for keylen in (16, 24, 32):
+        key = rng.integers(0, 256, keylen, dtype=np.uint8).tobytes()
+        nr, rk_e = expand_key_enc(key)
+        _, rk_d = expand_key_dec(key)
+        w = jnp.asarray(rng.integers(0, 1 << 32, (32, 4), dtype=np.uint32))
+        ct = block.encrypt_words(w, jnp.asarray(rk_e), nr)
+        back = block.decrypt_words(ct, jnp.asarray(rk_d), nr)
+        assert np.array_equal(np.asarray(back), np.asarray(w))

@@ -1,0 +1,43 @@
+"""Key-expansion tests vs FIPS-197 appendix A (reference aes.c:442-599)."""
+
+import numpy as np
+
+from our_tree_tpu.ops.keyschedule import expand_key_dec, expand_key_enc
+
+
+def le(hexword: str) -> int:
+    """Spec prints words big-endian; our packing is LE of the byte stream."""
+    return int.from_bytes(bytes.fromhex(hexword), "little")
+
+
+def test_aes128_expansion():
+    nr, rk = expand_key_enc(bytes.fromhex("2b7e151628aed2a6abf7158809cf4f3c"))
+    assert nr == 10 and rk.shape == (44,)
+    assert rk[4] == le("a0fafe17")
+    assert rk[5] == le("88542cb1")
+    assert rk[40] == le("d014f9a8")
+    assert rk[43] == le("b6630ca6")
+
+
+def test_aes192_expansion():
+    nr, rk = expand_key_enc(bytes.fromhex("8e73b0f7da0e6452c810f32b809079e562f8ead2522c6b7b"))
+    assert nr == 12 and rk.shape == (52,)
+    assert rk[6] == le("fe0c91f7")
+    assert rk[51] == le("01002202")
+
+
+def test_aes256_expansion():
+    nr, rk = expand_key_enc(
+        bytes.fromhex("603deb1015ca71be2b73aef0857d77811f352c073b6108d72d9810a30914dff4")
+    )
+    assert nr == 14 and rk.shape == (60,)
+    assert rk[8] == le("9ba35411")
+    assert rk[59] == le("706c631e")
+
+
+def test_dec_schedule_endpoints():
+    key = bytes(range(16))
+    nr, enc = expand_key_enc(key)
+    _, dec = expand_key_dec(key)
+    assert np.array_equal(dec[0:4], enc[4 * nr : 4 * nr + 4])
+    assert np.array_equal(dec[4 * nr :], enc[0:4])

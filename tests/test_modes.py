@@ -1,0 +1,159 @@
+"""Mode tests: SP800-38A known answers + streaming/resume semantics.
+
+Mode semantics under test are those of the reference (aes-modes/aes.c):
+CBC at aes.c:757-816, CFB128 at aes.c:822-863, CTR (post-increment BE
+counter) at aes.c:869-901. Bit-parity against the compiled reference itself
+is in test_parity.py; these are the public NIST vectors.
+"""
+
+import numpy as np
+import pytest
+
+from our_tree_tpu.models.aes import AES, AES_DECRYPT, AES_ENCRYPT
+
+KEY128 = bytes.fromhex("2b7e151628aed2a6abf7158809cf4f3c")
+KEY192 = bytes.fromhex("8e73b0f7da0e6452c810f32b809079e562f8ead2522c6b7b")
+KEY256 = bytes.fromhex("603deb1015ca71be2b73aef0857d77811f352c073b6108d72d9810a30914dff4")
+IV = bytes.fromhex("000102030405060708090a0b0c0d0e0f")
+CTR0 = bytes.fromhex("f0f1f2f3f4f5f6f7f8f9fafbfcfdfeff")
+PT4 = bytes.fromhex(
+    "6bc1bee22e409f96e93d7e117393172a"
+    "ae2d8a571e03ac9c9eb76fac45af8e51"
+    "30c81c46a35ce411e5fbc1191a0a52ef"
+    "f69f2445df4f9b17ad2b417be66c3710"
+)
+
+ECB_CT = {
+    128: "3ad77bb40d7a3660a89ecaf32466ef97f5d3d58503b9699de785895a96fdbaaf"
+    "43b1cd7f598ece23881b00e3ed0306887b0c785e27e8ad3f8223207104725dd4",
+    192: "bd334f1d6e45f25ff712a214571fa5cc974104846d0ad3ad7734ecb3ecee4eef"
+    "ef7afd2270e2e60adce0ba2face6444e9a4b41ba738d6c72fb16691603c18e0e",
+    256: "f3eed1bdb5d2a03c064b5a7e3db181f8591ccb10d410ed26dc5ba74a31362870"
+    "b6ed21b99ca6f4f9f153e7b1beafed1d23304b7a39f9f3ff067d8d8f9e24ecc7",
+}
+CBC_CT = {
+    128: "7649abac8119b246cee98e9b12e9197d5086cb9b507219ee95db113a917678b2"
+    "73bed6b8e3c1743b7116e69e222295163ff1caa1681fac09120eca307586e1a7",
+    192: "4f021db243bc633d7178183a9fa071e8b4d9ada9ad7dedf4e5e738763f69145a"
+    "571b242012fb7ae07fa9baac3df102e008b0e27988598881d920a9e64f5615cd",
+    256: "f58c4c04d6e5f1ba779eabfb5f7bfbd69cfc4e967edb808d679f777bc6702c7d"
+    "39f23369a9d9bacfa530e26304231461b2eb05e2c39be9fcda6c19078c6a9d1b",
+}
+CFB_CT = {
+    128: "3b3fd92eb72dad20333449f8e83cfb4ac8a64537a0b3a93fcde3cdad9f1ce58b"
+    "26751f67a3cbb140b1808cf187a4f4dfc04b05357c5d1c0eeac4c66f9ff7f2e6",
+    192: "cdc80d6fddf18cab34c25909c99a417467ce7f7f81173621961a2b70171d3d7a"
+    "2e1e8a1dd59b88b1c8e60fed1efac4c9c05f9f9ca9834fa042ae8fba584b09ff",
+    256: "dc7e84bfda79164b7ecd8486985d386039ffed143b28b1c832113c6331e5407b"
+    "df10132415e54b92a13ed0a8267ae2f975a385741ab9cef82031623d55b1e471",
+}
+CTR_CT = {
+    128: "874d6191b620e3261bef6864990db6ce9806f66b7970fdff8617187bb9fffdff"
+    "5ae4df3edbd5d35e5b4f09020db03eab1e031dda2fbe03d1792170a0f3009cee",
+    192: "1abc932417521ca24f2b0459fe7e6e0b090339ec0aa6faefd5ccc2c6f4ce8e94"
+    "1e36b26bd1ebc670d1bd1d665620abf74f78a7f6d29809585a97daec58c6b050",
+    256: "601ec313775789a5b7a7f504bbf3d228f443e3ca4d62b59aca84e990cacaf5c5"
+    "2b0930daa23de94ce87017ba2d84988ddfc9c58db67aada613c2dd08457941a6",
+}
+KEYS = {128: KEY128, 192: KEY192, 256: KEY256}
+
+
+@pytest.mark.parametrize("bits", [128, 192, 256])
+def test_sp800_38a_ecb(bits):
+    a = AES(KEYS[bits])
+    assert a.crypt_ecb(AES_ENCRYPT, PT4).tobytes().hex() == ECB_CT[bits]
+    assert a.crypt_ecb(AES_DECRYPT, bytes.fromhex(ECB_CT[bits])).tobytes() == PT4
+
+
+@pytest.mark.parametrize("bits", [128, 192, 256])
+def test_sp800_38a_cbc(bits):
+    a = AES(KEYS[bits])
+    ct, iv_out = a.crypt_cbc(AES_ENCRYPT, np.frombuffer(IV, np.uint8), PT4)
+    assert ct.tobytes().hex() == CBC_CT[bits]
+    assert iv_out.tobytes() == ct.tobytes()[-16:]
+    pt, div_out = a.crypt_cbc(AES_DECRYPT, np.frombuffer(IV, np.uint8), ct)
+    assert pt.tobytes() == PT4
+    assert div_out.tobytes() == ct.tobytes()[-16:]
+
+
+@pytest.mark.parametrize("bits", [128, 192, 256])
+def test_sp800_38a_cfb128(bits):
+    a = AES(KEYS[bits])
+    ct, off, iv_out = a.crypt_cfb128(AES_ENCRYPT, 0, np.frombuffer(IV, np.uint8), PT4)
+    assert ct.tobytes().hex() == CFB_CT[bits]
+    assert off == 0
+    pt, _, _ = a.crypt_cfb128(AES_DECRYPT, 0, np.frombuffer(IV, np.uint8), ct)
+    assert pt.tobytes() == PT4
+
+
+@pytest.mark.parametrize("bits", [128, 192, 256])
+def test_sp800_38a_ctr(bits):
+    a = AES(KEYS[bits])
+    sb = np.zeros(16, np.uint8)
+    ct, off, _, _ = a.crypt_ctr(0, np.frombuffer(CTR0, np.uint8), sb, PT4)
+    assert ct.tobytes().hex() == CTR_CT[bits]
+    assert off == 0
+    pt, _, _, _ = a.crypt_ctr(0, np.frombuffer(CTR0, np.uint8), sb, ct)
+    assert pt.tobytes() == PT4
+
+
+def test_ctr_chunked_equals_oneshot():
+    """Streaming resume: arbitrary chunking must be invisible in the output —
+    the reference's nc_off/stream_block contract (aes.c:869-901)."""
+    rng = np.random.default_rng(3)
+    a = AES(KEY128)
+    data = rng.integers(0, 256, 1000, dtype=np.uint8)
+    sb = np.zeros(16, np.uint8)
+    one, off1, nc1, sb1 = a.crypt_ctr(0, np.frombuffer(CTR0, np.uint8), sb, data)
+
+    out = []
+    off, nc, sbl = 0, np.frombuffer(CTR0, np.uint8), np.zeros(16, np.uint8)
+    for lo, hi in [(0, 3), (3, 20), (20, 21), (21, 500), (500, 1000)]:
+        o, off, nc, sbl = a.crypt_ctr(off, nc, sbl, data[lo:hi])
+        out.append(o)
+    assert np.concatenate(out).tobytes() == one.tobytes()
+    assert off == off1 and nc.tobytes() == nc1.tobytes() and sbl.tobytes() == sb1.tobytes()
+
+
+def test_cfb_chunked_equals_oneshot():
+    rng = np.random.default_rng(4)
+    a = AES(KEY256)
+    data = rng.integers(0, 256, 777, dtype=np.uint8)
+    one, off1, iv1 = a.crypt_cfb128(AES_ENCRYPT, 0, np.frombuffer(IV, np.uint8), data)
+    out = []
+    off, iv = 0, np.frombuffer(IV, np.uint8)
+    for lo, hi in [(0, 5), (5, 16), (16, 160), (160, 161), (161, 777)]:
+        o, off, iv = a.crypt_cfb128(AES_ENCRYPT, off, iv, data[lo:hi])
+        out.append(o)
+    assert np.concatenate(out).tobytes() == one.tobytes()
+    assert off == off1 and iv.tobytes() == iv1.tobytes()
+
+
+def test_ctr_counter_wraparound():
+    """Carry must ripple through all 16 counter bytes (aes.c:879-884)."""
+    a = AES(KEY128)
+    nonce = np.frombuffer(b"\xff" * 15 + b"\xfe", np.uint8)
+    data = np.zeros(16 * 5, np.uint8)
+    sb = np.zeros(16, np.uint8)
+    one, _, nc, _ = a.crypt_ctr(0, nonce, sb, data)
+    # block keystreams must be E(...fe), E(...ff), E(0), E(1), E(2)
+    ks = [a.crypt_ecb(AES_ENCRYPT, int(v).to_bytes(16, "big")) for v in
+          [(1 << 128) - 2, (1 << 128) - 1, 0, 1, 2]]
+    assert one.tobytes() == b"".join(k.tobytes() for k in ks)
+    assert nc.tobytes() == (3).to_bytes(16, "big")
+
+
+def test_cbc_chaining_vs_blockwise():
+    """CBC ciphertext block i depends on all prior blocks; verify scan
+    equals the sequential definition."""
+    rng = np.random.default_rng(5)
+    a = AES(KEY192)
+    data = rng.integers(0, 256, 16 * 9, dtype=np.uint8)
+    ct, _ = a.crypt_cbc(AES_ENCRYPT, np.frombuffer(IV, np.uint8), data)
+    iv = np.frombuffer(IV, np.uint8)
+    expect = []
+    for i in range(9):
+        blk = np.bitwise_xor(data[16 * i : 16 * i + 16], iv)
+        iv = a.crypt_ecb(AES_ENCRYPT, blk)
+        expect.append(iv)
+    assert ct.tobytes() == np.concatenate(expect).tobytes()

@@ -1,0 +1,63 @@
+"""Table-generation unit tests (reference aes_gen_tables, aes.c:361-435)."""
+
+import numpy as np
+
+from our_tree_tpu.ops import gf, tables
+
+
+def test_sbox_known_entries():
+    # FIPS-197 figure 7 spot checks.
+    assert tables.SBOX[0x00] == 0x63
+    assert tables.SBOX[0x01] == 0x7C
+    assert tables.SBOX[0x53] == 0xED
+    assert tables.SBOX[0xFF] == 0x16
+
+
+def test_sbox_is_bijection():
+    assert sorted(tables.SBOX.tolist()) == list(range(256))
+    assert np.array_equal(tables.INV_SBOX[tables.SBOX], np.arange(256))
+
+
+def test_gf_inverse():
+    for a in range(1, 256):
+        assert gf.gmul(a, gf.ginv(a)) == 1
+    assert gf.ginv(0) == 0
+
+
+def test_ft_tables_structure():
+    # FT0[x] packs (2S, S, S, 3S) little-endian; FTi are byte rotations.
+    for x in (0x00, 0x01, 0x7F, 0xFF):
+        s = int(tables.SBOX[x])
+        expect = gf.gmul(2, s) | (s << 8) | (s << 16) | (gf.gmul(3, s) << 24)
+        assert int(tables.FT0[x]) == expect
+    w = tables.FT0.astype(np.uint64)
+    assert np.array_equal(tables.FT1, (((w << 8) | (w >> 24)) & 0xFFFFFFFF).astype(np.uint32))
+
+
+def test_rt_tables_structure():
+    for x in (0x00, 0x01, 0x7F, 0xFF):
+        i = int(tables.INV_SBOX[x])
+        expect = (
+            gf.gmul(14, i)
+            | (gf.gmul(9, i) << 8)
+            | (gf.gmul(13, i) << 16)
+            | (gf.gmul(11, i) << 24)
+        )
+        assert int(tables.RT0[x]) == expect
+
+
+def test_inv_mix_columns_word_roundtrip():
+    # MixColumns then InvMixColumns is identity on random words.
+    rng = np.random.default_rng(0)
+    m2, m3 = gf.gmul_table(2), gf.gmul_table(3)
+
+    def mix(w):
+        b = [(w >> (8 * k)) & 0xFF for k in range(4)]
+        s0 = m2[b[0]] ^ m3[b[1]] ^ b[2] ^ b[3]
+        s1 = b[0] ^ m2[b[1]] ^ m3[b[2]] ^ b[3]
+        s2 = b[0] ^ b[1] ^ m2[b[2]] ^ m3[b[3]]
+        s3 = m3[b[0]] ^ b[1] ^ b[2] ^ m2[b[3]]
+        return (s0 | (s1 << 8) | (s2 << 16) | (s3 << 24)).astype(np.uint32)
+
+    w = rng.integers(0, 1 << 32, 64, dtype=np.uint32)
+    assert np.array_equal(tables.inv_mix_columns_word(mix(w)), w)
