@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""North-star benchmark: AES-128-CTR GB/s on one chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "GB/s", "vs_baseline": N}
+
+Baseline is the reference's best honest CPU number — AES-NI AES-256-CTR,
+1 GiB, 8 threads, ~0.520 GB/s (BASELINE.md, aes-modes/results.frankchn.aesni:32).
+`vs_baseline` is the speedup ratio (ours / theirs).
+
+Timing methodology: remote/async dispatch means `block_until_ready` can
+return before the work is done and a scalar readback carries a fixed
+round-trip cost, so K encrypt iterations are chained *inside* one jit (each
+iteration's input depends on the previous XOR-digest, preventing hoisting)
+and the reported time is the difference T(K) - T(1) — per-call overhead and
+the one-off reduction cancel exactly. The digest readback also forces real
+completion, which doubles as an end-of-run correctness guard against
+silently-skipped work (cf. the reference's unchecked CUDA launches,
+SURVEY.md §2 defect #4).
+
+Buffer size defaults per engine (16 MiB for the slow jnp-gather engine,
+256 MiB for the fast paths, capped at 64 MiB on CPU hosts) and is printed in
+the metric line; OT_BENCH_BYTES overrides. The 1 GiB reference message
+behaves identically — throughput is flat past ~64 MiB.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+BASELINE_GBPS = 0.520
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from our_tree_tpu.models import aes as aes_mod
+    from our_tree_tpu.models.aes import AES
+    from our_tree_tpu.utils import packing
+
+    platform = jax.devices()[0].platform
+    engine = aes_mod.resolve_engine(os.environ.get("OT_BENCH_ENGINE", "auto"))
+    default_bytes = 256 << 20 if engine != "jnp" else 16 << 20
+    if platform == "cpu":
+        default_bytes = min(default_bytes, 64 << 20)
+    nbytes = int(os.environ.get("OT_BENCH_BYTES", default_bytes))
+    nbytes -= nbytes % 16
+    iters = int(os.environ.get("OT_BENCH_ITERS", 5))
+
+    a = AES(bytes(range(16)))  # AES-128
+    rng = np.random.default_rng(1337)
+    host = rng.integers(0, 256, nbytes, dtype=np.uint8)
+    words = jax.device_put(jnp.asarray(packing.np_bytes_to_words(host).reshape(-1, 4)))
+    nonce = np.frombuffer(bytes.fromhex("f0f1f2f3f4f5f6f7f8f9fafbfcfdfeff"), np.uint8)
+    ctr_be = jax.device_put(jnp.asarray(packing.np_bytes_to_words(nonce).byteswap()))
+
+    ctr_fn = aes_mod.ctr_crypt_fn(a.nr, engine=engine)
+
+    @functools.partial(jax.jit, static_argnums=(3,))
+    def chained(words, ctr_be, rk, k):
+        def body(_, acc):
+            out = ctr_fn(words ^ acc, ctr_be, rk)
+            return jax.lax.reduce(out.ravel(), jnp.uint32(0), jax.lax.bitwise_xor, (0,))
+        return jax.lax.fori_loop(0, k, body, jnp.uint32(0))
+
+    def run(k):
+        t0 = time.perf_counter()
+        digest = int(chained(words, ctr_be, a.rk_enc, k))  # readback = real barrier
+        return time.perf_counter() - t0, digest
+
+    run(1)          # compile k=1
+    run(1 + iters)  # compile k=1+iters
+    t1 = min(run(1)[0] for _ in range(2))
+    tk, digest = run(1 + iters)
+    gbps = iters * nbytes / max(tk - t1, 1e-9) / 1e9
+
+    print(json.dumps({
+        "metric": f"AES-128-CTR throughput, {nbytes >> 20} MiB buffer, "
+                  f"1 {platform} device, engine={engine}, digest={digest:#010x}",
+        "value": round(gbps, 4),
+        "unit": "GB/s",
+        "vs_baseline": round(gbps / BASELINE_GBPS, 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

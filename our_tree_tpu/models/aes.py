@@ -138,6 +138,31 @@ def cfb128_decrypt_words(words, iv_words, rk, nr):
 
 
 # ---------------------------------------------------------------------------
+# Engine registry: pluggable compute cores behind one functional surface.
+# ---------------------------------------------------------------------------
+
+
+ENGINES = ("jnp",)  # "bitslice" / "pallas" register themselves as they land
+
+
+def resolve_engine(name: str | None = "auto") -> str:
+    """Map "auto" to the best available engine for the current backend."""
+    if name in (None, "auto"):
+        return "jnp"
+    if name not in ENGINES:
+        raise ValueError(f"unknown engine {name!r}; available: {ENGINES}")
+    return name
+
+
+def ctr_crypt_fn(nr: int, engine: str = "auto"):
+    """A jitted (words, ctr_be_words, rk) -> words CTR function."""
+    engine = resolve_engine(engine)
+    if engine == "jnp":
+        return lambda words, ctr_be, rk: ctr_crypt_words(words, ctr_be, rk, nr)
+    raise AssertionError(engine)
+
+
+# ---------------------------------------------------------------------------
 # Host-facing context with byte-granular streaming (the aes.h API shape).
 # ---------------------------------------------------------------------------
 
@@ -176,8 +201,7 @@ class AES:
     engine: str = "jnp"
 
     def __post_init__(self):
-        if self.engine not in ("jnp",):  # "bitslice" lands with ops/bitslice.py
-            raise ValueError(f"unknown engine {self.engine!r}")
+        self.engine = resolve_engine(self.engine)
         self.key = bytes(self.key)
         self.nr, rk_enc = expand_key_enc(self.key)
         _, rk_dec = expand_key_dec(self.key)

@@ -1,0 +1,10 @@
+"""Multi-chip distribution layer (mesh + shard_map kernels)."""
+
+from .dist import (  # noqa: F401
+    AXIS,
+    ctr_crypt_sharded,
+    ecb_crypt_sharded,
+    gather_for_verification,
+    make_mesh,
+    xor_sharded,
+)

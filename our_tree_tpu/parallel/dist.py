@@ -1,0 +1,175 @@
+"""Multi-chip distribution: 1-D device mesh + shard_map'd cipher kernels.
+
+The reference's only parallelism is shared-memory pthreads — a message split
+into `len/T` contiguous chunks, one thread each (aes-modes/test.c:33-35,
+test.c:50-55) — and there is NO distributed communication backend at all
+(SURVEY.md §2 "Distributed communication backend"). The workloads need no
+cross-worker reduction: chunks are independent (ECB, CTR, XOR), so the whole
+"collective" story is scatter (chunk assignment) + gather (disjoint writes).
+
+The TPU-native re-design of that scheme (SURVEY.md §7 layer 6):
+
+  * a 1-D `jax.sharding.Mesh` over however many chips exist (ICI within a
+    host, DCN across hosts — XLA picks the transport; the code is identical),
+  * inputs block-sharded over the mesh axis; the 240-byte round-key schedule
+    replicated (the only "broadcast" the workload has),
+  * `shard_map` kernels in which each shard derives its global position with
+    `jax.lax.axis_index` — the moral equivalent of the reference threads'
+    `offset = chunk_size * thread_id` pointer arithmetic (test.c:51-53),
+  * CTR counter offsets computed per shard from that index, so shard seams
+    produce bit-identical keystream to the single-chip path — the
+    shard-invariance property the reference never tested (and whose absence
+    let defect #1 in SURVEY.md §2 go unnoticed),
+  * no collectives in the hot path; an optional `all_gather` exists only for
+    verification, mirroring how the reference verified nothing.
+
+Everything here also runs unmodified on a single device (mesh of 1) and on
+CPU-simulated meshes (tests/conftest.py forces 8 virtual CPU devices).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.aes import _add_counter_be
+from ..ops import block
+from ..utils import packing
+
+AXIS = "shards"
+
+
+def make_mesh(n_devices: int | None = None, axis: str = AXIS) -> Mesh:
+    """1-D mesh over the first `n_devices` devices (all, if None).
+
+    The reference's analogue is the `num_threads` sweep parameter
+    (test.c:135-153); here a "worker" is a chip.
+    """
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.asarray(devs), (axis,))
+
+
+def _pad_blocks(words: jnp.ndarray, n_shards: int):
+    """Pad the block axis to a multiple of n_shards (zeros, sliced off after).
+
+    Padding sits at the END of the stream, so every real block keeps its
+    global index — counter/keystream indices stay parity-exact.
+    """
+    n = words.shape[0]
+    rem = (-n) % n_shards
+    if rem:
+        words = jnp.concatenate(
+            [words, jnp.zeros((rem,) + words.shape[1:], words.dtype)], axis=0
+        )
+    return words, n
+
+
+# ---------------------------------------------------------------------------
+# Sharded mode kernels
+# ---------------------------------------------------------------------------
+
+
+def _ctr_shard_body(words, ctr_be, rk, nr, axis):
+    """Per-shard CTR: global block index = axis_index * local_n + local iota.
+
+    Matches the 128-bit big-endian post-increment counter semantics of the
+    oracle (aes-modes/aes.c:869-901) across shard seams — the multi-chip
+    counter bookkeeping called out as hard part #6 in SURVEY.md §7.
+    """
+    n_local = words.shape[0]
+    base = jax.lax.axis_index(axis).astype(jnp.uint32) * jnp.uint32(n_local)
+    idx = base + jnp.arange(n_local, dtype=jnp.uint32)
+    ctr_blocks_be = _add_counter_be(ctr_be, idx)
+    ks = block.encrypt_words(packing.byteswap32(ctr_blocks_be), rk, nr)
+    return words ^ ks
+
+
+@functools.partial(jax.jit, static_argnames=("nr", "mesh", "axis"))
+def _ctr_sharded_jit(words, ctr_be, rk, *, nr, mesh, axis):
+    f = jax.shard_map(
+        functools.partial(_ctr_shard_body, nr=nr, axis=axis),
+        mesh=mesh,
+        in_specs=(P(axis), P(), P()),
+        out_specs=P(axis),
+    )
+    return f(words, ctr_be, rk)
+
+
+def ctr_crypt_sharded(words, ctr_be, rk, nr, mesh: Mesh, axis: str = AXIS):
+    """CTR en/decrypt (N, 4) u32 words sharded over `mesh`.
+
+    `ctr_be` is the initial 128-bit counter as (4,) big-endian u32 words;
+    round keys are replicated to every shard (the schedule is the only
+    broadcast this workload has, cf. cudaMemcpy of `ce_sched` AES.cu:222).
+    """
+    n_shards = mesh.devices.size
+    padded, n = _pad_blocks(words, n_shards)
+    out = _ctr_sharded_jit(padded, ctr_be, rk, nr=nr, mesh=mesh, axis=axis)
+    return out[:n]
+
+
+def _ecb_shard_body(words, rk, nr, encrypt):
+    fn = block.encrypt_words if encrypt else block.decrypt_words
+    return fn(words, rk, nr)
+
+
+@functools.partial(jax.jit, static_argnames=("nr", "encrypt", "mesh", "axis"))
+def _ecb_sharded_jit(words, rk, *, nr, encrypt, mesh, axis):
+    f = jax.shard_map(
+        functools.partial(_ecb_shard_body, nr=nr, encrypt=encrypt),
+        mesh=mesh,
+        in_specs=(P(axis), P()),
+        out_specs=P(axis),
+    )
+    return f(words, rk)
+
+
+def ecb_crypt_sharded(words, rk, nr, mesh: Mesh, encrypt: bool = True,
+                      axis: str = AXIS):
+    """ECB over a sharded block axis — the reference's headline parallel mode
+    (each pthread ran aes_crypt_ecb over its chunk, aes-modes/test.c:37-41)."""
+    n_shards = mesh.devices.size
+    padded, n = _pad_blocks(words, n_shards)
+    out = _ecb_sharded_jit(padded, rk, nr=nr, encrypt=encrypt, mesh=mesh, axis=axis)
+    return out[:n]
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "axis"))
+def _xor_sharded_jit(data, ks, *, mesh, axis):
+    f = jax.shard_map(
+        jnp.bitwise_xor, mesh=mesh, in_specs=(P(axis), P(axis)), out_specs=P(axis)
+    )
+    return f(data, ks)
+
+
+def xor_sharded(data, keystream, mesh: Mesh, axis: str = AXIS):
+    """ARC4 phase 3 — the data-parallel XOR (arc4.c:101-112) as a sharded
+    elementwise op. Works on any dtype/shape with leading axis divisible or
+    padded to the shard count."""
+    if data.shape != keystream.shape:
+        # A short keystream must be an error: XOR-against-padding would pass
+        # tail plaintext through unencrypted.
+        raise ValueError(
+            f"data/keystream shape mismatch: {data.shape} vs {keystream.shape}"
+        )
+    n_shards = mesh.devices.size
+    padded, n = _pad_blocks(data, n_shards)
+    ks_padded, _ = _pad_blocks(keystream, n_shards)
+    return _xor_sharded_jit(padded, ks_padded, mesh=mesh, axis=axis)[:n]
+
+
+def gather_for_verification(x, mesh: Mesh, axis: str = AXIS):
+    """Optional all_gather so a host can bit-compare the full output — the
+    lone collective, used only by tests (SURVEY.md §2: verification gather)."""
+    f = jax.shard_map(
+        lambda s: jax.lax.all_gather(s, axis, tiled=True),
+        mesh=mesh, in_specs=P(axis), out_specs=P(),
+        check_vma=False,  # all_gather output is replicated; not inferred
+    )
+    return f(x)

@@ -3,12 +3,21 @@
 The 8 virtual devices let sharding tests (tests/test_parallel.py) validate
 multi-chip paths without a pod — a capability the reference had no equivalent
 of (SURVEY.md §4: multi-device was "tested" only by owning the hardware).
-Must run before jax is imported anywhere.
+
+Env vars alone are not enough on hosts whose site hooks pre-register an
+accelerator backend at interpreter start, so the platform is also forced
+through `jax.config`. That update only takes effect while no backend has
+been *initialized* yet (it is a silent no-op afterwards) — which holds here
+because conftest imports before any test touches jax.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

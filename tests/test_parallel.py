@@ -1,0 +1,104 @@
+"""Multi-chip sharding tests on 8 virtual CPU devices (conftest.py).
+
+The key property under test is **shard invariance**: the same ciphertext for
+1 vs 2 vs 8 shards. This is exactly the determinism check whose absence let
+the reference ship a CTR benchmark that silently ran ECB work
+(SURVEY.md §2 defect #1) — the reference never compared T=1 vs T=8 output.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from our_tree_tpu.models.aes import AES, AES_ENCRYPT
+from our_tree_tpu.models import aes as aes_mod
+from our_tree_tpu.parallel import (
+    ctr_crypt_sharded,
+    ecb_crypt_sharded,
+    gather_for_verification,
+    make_mesh,
+    xor_sharded,
+)
+from our_tree_tpu.utils import packing
+
+KEY = bytes(range(32))
+RNG = np.random.default_rng(1337)
+
+
+def _words(nbytes):
+    return jnp.asarray(
+        packing.np_bytes_to_words(RNG.integers(0, 256, nbytes, np.uint8)).reshape(-1, 4)
+    )
+
+
+def test_mesh_has_8_virtual_devices():
+    assert len(jax.devices()) == 8
+
+
+@pytest.mark.parametrize("nshards", [1, 2, 8])
+def test_ecb_shard_invariance(nshards):
+    a = AES(KEY)
+    w = _words(16 * 64)
+    ref = aes_mod.ecb_encrypt_words(w, a.rk_enc, a.nr)
+    mesh = make_mesh(nshards)
+    out = ecb_crypt_sharded(w, a.rk_enc, a.nr, mesh)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+@pytest.mark.parametrize("nshards", [1, 2, 8])
+@pytest.mark.parametrize("nblocks", [64, 61])  # 61: padding path (not divisible)
+def test_ctr_shard_invariance(nshards, nblocks):
+    a = AES(KEY[:16])
+    w = _words(16 * nblocks)
+    ctr_be = jnp.asarray(
+        packing.np_bytes_to_words(np.frombuffer(bytes(range(240, 256)), np.uint8)).byteswap()
+    )
+    ref = aes_mod.ctr_crypt_words(w, ctr_be, a.rk_enc, a.nr)
+    mesh = make_mesh(nshards)
+    out = ctr_crypt_sharded(w, ctr_be, a.rk_enc, a.nr, mesh)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_ctr_shard_seam_counter_carry():
+    """Counter must ripple across shard seams exactly as the byte-ripple
+    increment of the oracle (aes.c:879-884): start the counter just below a
+    32-bit word boundary so the carry lands mid-stream inside shard > 0."""
+    a = AES(KEY[:16])
+    w = _words(16 * 64)
+    # counter0 = ...fffffff0 -> carry into word 2 after 16 blocks (shard 2 of 8)
+    ctr_bytes = np.frombuffer(
+        bytes.fromhex("00112233445566778899aabbfffffff0"), np.uint8
+    )
+    ctr_be = jnp.asarray(packing.np_bytes_to_words(ctr_bytes).byteswap())
+    ref = aes_mod.ctr_crypt_words(w, ctr_be, a.rk_enc, a.nr)
+    out = ctr_crypt_sharded(w, ctr_be, a.rk_enc, a.nr, make_mesh(8))
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_ctr_sharded_matches_context_api():
+    """Cross-check the sharded path against the byte-level streaming context
+    (models.aes.AES.crypt_ctr), i.e. against the parity-oracle semantics."""
+    a = AES(KEY)
+    data = RNG.integers(0, 256, 16 * 40, np.uint8)
+    nonce = np.frombuffer(bytes(range(16)), np.uint8)
+    ref, _, _, _ = a.crypt_ctr(0, nonce, np.zeros(16, np.uint8), data)
+    ctr_be = jnp.asarray(packing.np_bytes_to_words(nonce).byteswap())
+    w = jnp.asarray(packing.np_bytes_to_words(data).reshape(-1, 4))
+    out = ctr_crypt_sharded(w, ctr_be, a.rk_enc, a.nr, make_mesh(8))
+    assert packing.np_words_to_bytes(np.asarray(out)).tobytes() == ref.tobytes()
+
+
+@pytest.mark.parametrize("n", [4096, 4100])  # 4100: padding path
+def test_xor_sharded(n):
+    d = jnp.asarray(RNG.integers(0, 256, n, np.uint8))
+    k = jnp.asarray(RNG.integers(0, 256, n, np.uint8))
+    out = xor_sharded(d, k, make_mesh(8))
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(d) ^ np.asarray(k))
+
+
+def test_gather_for_verification():
+    w = _words(16 * 64)
+    mesh = make_mesh(8)
+    out = gather_for_verification(w, mesh)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(w))
