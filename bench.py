@@ -78,7 +78,8 @@ def main() -> None:
     run(1)          # compile k=1
     run(1 + iters)  # compile k=1+iters
     t1 = min(run(1)[0] for _ in range(2))
-    tk, digest = run(1 + iters)
+    (tk, digest), (tk2, _) = run(1 + iters), run(1 + iters)
+    tk = min(tk, tk2)  # a single hiccup in the long run would skew GB/s
     gbps = iters * nbytes / max(tk - t1, 1e-9) / 1e9
 
     print(json.dumps({

@@ -44,18 +44,48 @@ AES_DECRYPT = 0
 
 
 # ---------------------------------------------------------------------------
+# Engine registry: pluggable (words, rk, nr) -> words block cores behind one
+# functional surface. "jnp" is the T-table correctness core; throughput
+# engines ("bitslice", "pallas") register themselves at import (bottom of
+# this module) so every mode/ shard path picks them up by name.
+# ---------------------------------------------------------------------------
+
+CORES: dict[str, tuple] = {"jnp": (block.encrypt_words, block.decrypt_words)}
+
+
+def register_core(name: str, encrypt_fn, decrypt_fn) -> None:
+    CORES[name] = (encrypt_fn, decrypt_fn)
+
+
+def resolve_engine(name: str | None = "auto") -> str:
+    """Map "auto" to the best available engine for the current backend.
+
+    The gather-based T-table core is fine on CPU; on TPU the VPU has no cheap
+    256-way gather (SURVEY.md §7 hard part #1), so batch paths default to the
+    bitsliced circuit engine there.
+    """
+    if name in (None, "auto"):
+        if jax.default_backend() == "cpu":
+            return "jnp"
+        return "bitslice" if "bitslice" in CORES else "jnp"
+    if name not in CORES:
+        raise ValueError(f"unknown engine {name!r}; available: {sorted(CORES)}")
+    return name
+
+
+# ---------------------------------------------------------------------------
 # Jitted functional cores (word-level). Shapes: words (N, 4) uint32.
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.jit, static_argnums=(2,))
-def ecb_encrypt_words(words, rk, nr):
-    return block.encrypt_words(words, rk, nr)
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def ecb_encrypt_words(words, rk, nr, engine="jnp"):
+    return CORES[engine][0](words, rk, nr)
 
 
-@functools.partial(jax.jit, static_argnums=(2,))
-def ecb_decrypt_words(words, rk_dec, nr):
-    return block.decrypt_words(words, rk_dec, nr)
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def ecb_decrypt_words(words, rk_dec, nr, engine="jnp"):
+    return CORES[engine][1](words, rk_dec, nr)
 
 
 def _add_counter_be(ctr_be: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
@@ -75,21 +105,21 @@ def _add_counter_be(ctr_be: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
                       jnp.broadcast_to(s2, idx.shape), s3], axis=-1)
 
 
-@functools.partial(jax.jit, static_argnums=(2,))
-def ctr_keystream_words(ctr_be_words, rk, nr, nblocks_idx):
+@functools.partial(jax.jit, static_argnums=(2, 4))
+def ctr_keystream_words(ctr_be_words, rk, nr, nblocks_idx, engine="jnp"):
     """Keystream for blocks counter0+idx. ctr_be_words: (4,) u32 BE."""
     ctr_blocks_be = _add_counter_be(ctr_be_words, nblocks_idx)
     # The cipher consumes LE-packed words of the counter's byte stream; the
     # counter bytes are the BE words' bytes, so each word is byteswapped.
     ctr_le = packing.byteswap32(ctr_blocks_be)
-    return block.encrypt_words(ctr_le, rk, nr)
+    return CORES[engine][0](ctr_le, rk, nr)
 
 
-@functools.partial(jax.jit, static_argnums=(3,))
-def ctr_crypt_words(words, ctr_be_words, rk, nr):
+@functools.partial(jax.jit, static_argnums=(3, 4))
+def ctr_crypt_words(words, ctr_be_words, rk, nr, engine="jnp"):
     n = words.shape[0]
     idx = jnp.arange(n, dtype=jnp.uint32)
-    ks = ctr_keystream_words(ctr_be_words, rk, nr, idx)
+    ks = ctr_keystream_words(ctr_be_words, rk, nr, idx, engine)
     return words ^ ks
 
 
@@ -103,20 +133,20 @@ def cbc_encrypt_words(words, iv_words, rk, nr):
     return out, iv_out
 
 
-@functools.partial(jax.jit, static_argnums=(3,))
-def _cbc_decrypt_words_impl(words, iv_words, rk_dec, nr):
+@functools.partial(jax.jit, static_argnums=(3, 4))
+def _cbc_decrypt_words_impl(words, iv_words, rk_dec, nr, engine="jnp"):
     # Parallel: P_i = D(C_i) ^ C_{i-1} (C_{-1} = IV). Reference does this
     # serially (aes.c:782-796); the dependency chain only involves ciphertext,
     # so the TPU version is one batched decrypt + shifted XOR.
     prev = jnp.concatenate([iv_words[None, :], words[:-1]], axis=0)
-    out = block.decrypt_words(words, rk_dec, nr) ^ prev
+    out = CORES[engine][1](words, rk_dec, nr) ^ prev
     return out, words[-1]
 
 
-def cbc_decrypt_words(words, iv_words, rk_dec, nr):
+def cbc_decrypt_words(words, iv_words, rk_dec, nr, engine="jnp"):
     if words.shape[0] == 0:  # length-0 is a no-op, as in the reference
         return words, iv_words
-    return _cbc_decrypt_words_impl(words, iv_words, rk_dec, nr)
+    return _cbc_decrypt_words_impl(words, iv_words, rk_dec, nr, engine)
 
 
 @functools.partial(jax.jit, static_argnums=(3,))
@@ -129,37 +159,18 @@ def cfb128_encrypt_words(words, iv_words, rk, nr):
     return out, iv_out
 
 
-@functools.partial(jax.jit, static_argnums=(3,))
-def cfb128_decrypt_words(words, iv_words, rk, nr):
+@functools.partial(jax.jit, static_argnums=(3, 4))
+def cfb128_decrypt_words(words, iv_words, rk, nr, engine="jnp"):
     # Keystream block i = E(C_{i-1}) — all known up front, so parallel.
     prev = jnp.concatenate([iv_words[None, :], words[:-1]], axis=0)
-    out = words ^ block.encrypt_words(prev, rk, nr)
+    out = words ^ CORES[engine][0](prev, rk, nr)
     return out, words[-1]
-
-
-# ---------------------------------------------------------------------------
-# Engine registry: pluggable compute cores behind one functional surface.
-# ---------------------------------------------------------------------------
-
-
-ENGINES = ("jnp",)  # "bitslice" / "pallas" register themselves as they land
-
-
-def resolve_engine(name: str | None = "auto") -> str:
-    """Map "auto" to the best available engine for the current backend."""
-    if name in (None, "auto"):
-        return "jnp"
-    if name not in ENGINES:
-        raise ValueError(f"unknown engine {name!r}; available: {ENGINES}")
-    return name
 
 
 def ctr_crypt_fn(nr: int, engine: str = "auto"):
     """A jitted (words, ctr_be_words, rk) -> words CTR function."""
     engine = resolve_engine(engine)
-    if engine == "jnp":
-        return lambda words, ctr_be, rk: ctr_crypt_words(words, ctr_be, rk, nr)
-    raise AssertionError(engine)
+    return lambda words, ctr_be, rk: ctr_crypt_words(words, ctr_be, rk, nr, engine)
 
 
 # ---------------------------------------------------------------------------
@@ -198,10 +209,17 @@ class AES:
     """
 
     key: bytes
-    engine: str = "jnp"
+    engine: str = "auto"
 
     def __post_init__(self):
-        self.engine = resolve_engine(self.engine)
+        # Validate names eagerly but resolve "auto" lazily at call time:
+        # resolving needs jax.default_backend(), and initializing the backend
+        # as a construction side effect would defeat later platform switches
+        # (e.g. dryrun_multichip's jax.config.update to CPU).
+        if self.engine not in (None, "auto") and self.engine not in CORES:
+            raise ValueError(
+                f"unknown engine {self.engine!r}; available: {sorted(CORES)}"
+            )
         self.key = bytes(self.key)
         self.nr, rk_enc = expand_key_enc(self.key)
         _, rk_dec = expand_key_dec(self.key)
@@ -216,10 +234,11 @@ class AES:
         if b.size % 16:
             raise ValueError("ECB data must be a multiple of 16 bytes")
         w = _words_np(b)
+        engine = resolve_engine(self.engine)
         if mode == AES_ENCRYPT:
-            out = ecb_encrypt_words(jnp.asarray(w), self.rk_enc, self.nr)
+            out = ecb_encrypt_words(jnp.asarray(w), self.rk_enc, self.nr, engine)
         else:
-            out = ecb_decrypt_words(jnp.asarray(w), self.rk_dec, self.nr)
+            out = ecb_decrypt_words(jnp.asarray(w), self.rk_dec, self.nr, engine)
         return _bytes_np(np.asarray(out))
 
     # -- CBC ---------------------------------------------------------------
@@ -234,7 +253,9 @@ class AES:
         if mode == AES_ENCRYPT:
             out, newiv = cbc_encrypt_words(w, ivw, self.rk_enc, self.nr)
         else:
-            out, newiv = cbc_decrypt_words(w, ivw, self.rk_dec, self.nr)
+            out, newiv = cbc_decrypt_words(
+                w, ivw, self.rk_dec, self.nr, resolve_engine(self.engine)
+            )
         return _bytes_np(np.asarray(out)), _bytes_np(np.asarray(newiv)[None, :])
 
     # -- CFB128 ------------------------------------------------------------
@@ -247,7 +268,12 @@ class AES:
         return self._cfb_impl(mode, int(iv_off), iv, b)
 
     def _ecb1(self, block16: np.ndarray) -> np.ndarray:
-        return self.crypt_ecb(AES_ENCRYPT, block16)
+        # One block at a time (CFB feedback / CTR tail): always the T-table
+        # core — the bitsliced engine's 32-block lane packing is pure
+        # overhead at batch size 1.
+        w = jnp.asarray(_words_np(_to_u8(block16)))
+        out = ecb_encrypt_words(w, self.rk_enc, self.nr, "jnp")
+        return _bytes_np(np.asarray(out))
 
     def _cfb_impl(self, mode, iv_off, iv, b):
         out = np.empty_like(b)
@@ -265,7 +291,9 @@ class AES:
                 if mode == AES_ENCRYPT:
                     o, newiv = cfb128_encrypt_words(w, ivw, self.rk_enc, self.nr)
                 else:
-                    o, newiv = cfb128_decrypt_words(w, ivw, self.rk_enc, self.nr)
+                    o, newiv = cfb128_decrypt_words(
+                        w, ivw, self.rk_enc, self.nr, resolve_engine(self.engine)
+                    )
                 out[pos : pos + nfull * 16] = _bytes_np(np.asarray(o))
                 iv = _bytes_np(np.asarray(newiv)[None, :]).copy()
                 pos += nfull * 16
@@ -311,7 +339,9 @@ class AES:
         if nfull:
             w = jnp.asarray(_words_np(b[pos : pos + nfull * 16]))
             ctr_be = jnp.asarray(packing.np_bytes_to_words(nonce_counter).byteswap())
-            o = ctr_crypt_words(w, ctr_be, self.rk_enc, self.nr)
+            o = ctr_crypt_words(
+                w, ctr_be, self.rk_enc, self.nr, resolve_engine(self.engine)
+            )
             out[pos : pos + nfull * 16] = _bytes_np(np.asarray(o))
             pos += nfull * 16
             nonce_counter = _inc_counter_bytes(nonce_counter, nfull)
@@ -324,3 +354,20 @@ class AES:
             out[pos:] = b[pos:] ^ stream_block[:take]
             n = take
         return out, n, nonce_counter, stream_block
+
+
+# ---------------------------------------------------------------------------
+# Throughput-engine registration. Imported last: the modules below depend
+# only on ops/{tables,gf,...}, never on this module, so there is no cycle.
+# The chained modes (CBC/CFB encrypt scans) intentionally stay on the T-table
+# core regardless of engine: their scan steps see one block at a time, where
+# the bitsliced circuit's 32-block lane packing and transposes are pure
+# overhead — sequential modes are latency-bound, the honest "anti-parallel
+# baseline" of the reference (SURVEY.md §2 parallelism table).
+# ---------------------------------------------------------------------------
+
+from ..ops import bitslice as _bitslice  # noqa: E402
+from ..ops import pallas_aes as _pallas_aes  # noqa: E402
+
+register_core("bitslice", _bitslice.encrypt_words, _bitslice.decrypt_words)
+register_core("pallas", _pallas_aes.encrypt_words, _pallas_aes.decrypt_words)

@@ -1,0 +1,304 @@
+"""Bitsliced AES — the TPU-native throughput engine.
+
+The byte-indexed T-table formulation (ops/block.py, mirroring the oracle's
+`AES_FROUND`, reference aes-modes/aes.c:601-645) needs 16 table gathers per
+round per block. The VPU has no cheap 256-way gather (SURVEY.md §7 hard part
+#1), so this engine removes tables entirely: AES is computed as a boolean
+circuit over *bit-planes* — option (c) from the survey, the most
+TPU-idiomatic formulation, all XOR/AND/OR on uint32 lanes with zero memory
+indirection. XLA fuses the whole round chain into elementwise VPU code.
+
+Data layout
+-----------
+A batch of N blocks (padded to a multiple of 32) becomes a `(8, 16, W)`
+uint32 array, W = N/32: ``planes[bit, byte_pos, w]`` holds, in its 32 lanes'
+bit t, bit `bit` of state byte `byte_pos` of block ``32*w + t``. Byte order
+within a block follows the oracle's little-endian packing
+(`GET_ULONG_LE`, aes-modes/aes.c:43-49): byte_pos i lives in word i//4,
+lane byte i%4, and maps to AES state row i%4, column i//4 (FIPS-197 §3.4).
+
+SubBytes without a table
+------------------------
+S(x) = Aff(x^254) over GF(2^8). Inversion uses the Itoh-Tsujii-style
+addition chain 254 = 2 + 12 + 240  (x2=x², x3=x²·x, x12=x3⁴, x15=x12·x3,
+x240=x15¹⁶, x252=x240·x12, x254=x252·x2): 4 bitsliced multiplies — squaring
+is *linear* in characteristic 2, so all squarings are free XOR networks.
+Every linear layer (squaring, the affine map and its inverse, ×2 for
+MixColumns, ×9/×11/×13/×14 for InvMixColumns, modular reduction) is an 8×8
+or 15×8 GF(2) matrix **derived numerically at import time** from the field
+arithmetic in ops/gf.py — no transcribed circuit constants to get subtly
+wrong; tests/test_bitslice.py checks every derived map exhaustively.
+
+The round structure and key-schedule convention (decrypt uses the
+InvMixColumns-folded schedule, so rounds run InvShiftRows → InvSubBytes →
+InvMixColumns → AddRoundKey) match the T-table core exactly — both engines
+are drop-in `(words, rk, nr) -> words` cores behind `models.aes.CORES`.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import gf, tables
+
+# ---------------------------------------------------------------------------
+# GF(2) linear-map derivation (numpy, import time).
+# ---------------------------------------------------------------------------
+
+
+def _linmat(f) -> np.ndarray:
+    """8x8 GF(2) matrix of a linear byte function: column j = f(1<<j)."""
+    m = np.zeros((8, 8), dtype=np.uint8)
+    for j in range(8):
+        v = f(1 << j)
+        for i in range(8):
+            m[i, j] = (v >> i) & 1
+    return m
+
+
+def _gf2_inv(mat: np.ndarray) -> np.ndarray:
+    """Invert a GF(2) matrix by Gauss-Jordan elimination."""
+    n = mat.shape[0]
+    a = np.concatenate([mat.copy(), np.eye(n, dtype=np.uint8)], axis=1)
+    for col in range(n):
+        piv = next(r for r in range(col, n) if a[r, col])
+        a[[col, piv]] = a[[piv, col]]
+        for r in range(n):
+            if r != col and a[r, col]:
+                a[r] ^= a[col]
+    return a[:, n:]
+
+
+#: Squaring — linear because (a + b)² = a² + b² in characteristic 2.
+MAT_SQ = _linmat(lambda x: gf.gmul(x, x))
+
+#: The linear part L of the S-box affine layer: S(x) = L(x^-1) ^ 0x63.
+#: Derived from the S-box table itself: L(y) = S(y^-1) ^ S(0).
+MAT_AFF = _linmat(lambda y: int(tables.SBOX[gf.ginv(y)]) ^ 0x63)
+MAT_AFF_INV = _gf2_inv(MAT_AFF)
+AFF_CONST = 0x63
+
+#: Constant multipliers for MixColumns (×2) and InvMixColumns (×9/11/13/14).
+MAT_MUL = {c: _linmat(lambda x, c=c: gf.gmul(c, x)) for c in (2, 9, 11, 13, 14)}
+
+#: Modular reduction of a degree-14 product: REDUCE[k] = x^k mod POLY.
+REDUCE = np.array([gf.gpow(2, k) for k in range(15)], dtype=np.uint16)
+
+#: ShiftRows as a static permutation of the 16 byte positions.
+#: State byte i = row i%4, col i//4; row r rotates left by r (FIPS-197 §5.1.2)
+#: so new[4c+r] = old[4*((c+r)%4) + r]; inverse has (c-r).
+SR_PERM = np.array([4 * ((i // 4 + i % 4) % 4) + i % 4 for i in range(16)])
+ISR_PERM = np.array([4 * ((i // 4 - i % 4) % 4) + i % 4 for i in range(16)])
+
+
+# ---------------------------------------------------------------------------
+# Bit-plane circuit primitives. A "byte" is a list of 8 same-shaped uint32
+# arrays (LSB first); every op below is elementwise over those arrays, so the
+# same code runs inside jit, scan bodies, and Pallas kernels.
+# ---------------------------------------------------------------------------
+
+
+def apply_linear(mat: np.ndarray, p: list) -> list:
+    """y_i = XOR of p_j over j with mat[i, j] == 1 (static wiring, unrolled)."""
+    out = []
+    for i in range(8):
+        acc = None
+        for j in range(8):
+            if mat[i, j]:
+                acc = p[j] if acc is None else acc ^ p[j]
+        out.append(acc if acc is not None else jnp.zeros_like(p[0]))
+    return out
+
+
+def xor_const(p: list, c: int) -> list:
+    """XOR a constant byte into every lane: flip planes where c has a 1 bit."""
+    return [x ^ jnp.uint32(0xFFFFFFFF) if (c >> i) & 1 else x for i, x in enumerate(p)]
+
+
+def gf_mul_planes(a: list, b: list) -> list:
+    """Bitsliced GF(2^8) multiply: schoolbook partials + derived reduction."""
+    c = [None] * 15
+    for i in range(8):
+        for j in range(8):
+            t = a[i] & b[j]
+            k = i + j
+            c[k] = t if c[k] is None else c[k] ^ t
+    out = []
+    for i in range(8):
+        acc = None
+        for k in range(15):
+            if (int(REDUCE[k]) >> i) & 1:
+                acc = c[k] if acc is None else acc ^ c[k]
+        out.append(acc)
+    return out
+
+
+def gf_inv_planes(x: list) -> list:
+    """x^254 (= x^-1, with 0 -> 0) via the 4-multiply addition chain."""
+    sq = functools.partial(apply_linear, MAT_SQ)
+    x2 = sq(x)
+    x3 = gf_mul_planes(x2, x)
+    x12 = sq(sq(x3))
+    x15 = gf_mul_planes(x12, x3)
+    x240 = sq(sq(sq(sq(x15))))
+    x252 = gf_mul_planes(x240, x12)
+    return gf_mul_planes(x252, x2)
+
+
+def sbox_planes(p: list) -> list:
+    return xor_const(apply_linear(MAT_AFF, gf_inv_planes(p)), AFF_CONST)
+
+
+def inv_sbox_planes(p: list) -> list:
+    return gf_inv_planes(apply_linear(MAT_AFF_INV, xor_const(list(p), AFF_CONST)))
+
+
+def _cols(x: jnp.ndarray) -> jnp.ndarray:
+    """(16, ...) byte axis -> (4 cols, 4 rows, ...)."""
+    return x.reshape((4, 4) + x.shape[1:])
+
+
+def _flat(x: jnp.ndarray) -> jnp.ndarray:
+    return x.reshape((16,) + x.shape[2:])
+
+
+def mixcolumns_planes(p: list) -> list:
+    """out_r = 2·a_r + 3·a_(r+1) + a_(r+2) + a_(r+3) = xt(a_r ^ a_(r+1))
+    ^ (Σ_r a_r) ^ a_r, vectorised over the column axis."""
+    a = [_cols(x) for x in p]
+    b = [jnp.roll(x, -1, axis=1) for x in a]
+    t = [a[i] ^ b[i] for i in range(8)]
+    xt = apply_linear(MAT_MUL[2], t)
+    tot = [a[i] ^ b[i] ^ jnp.roll(a[i], -2, axis=1) ^ jnp.roll(a[i], -3, axis=1)
+           for i in range(8)]
+    return [_flat(xt[i] ^ tot[i] ^ a[i]) for i in range(8)]
+
+
+def inv_mixcolumns_planes(p: list) -> list:
+    """out_r = 14·a_r + 11·a_(r+1) + 13·a_(r+2) + 9·a_(r+3) (FIPS-197 §5.3.3)."""
+    a = [_cols(x) for x in p]
+    rolled = [a] + [[jnp.roll(x, -k, axis=1) for x in a] for k in (1, 2, 3)]
+    terms = [apply_linear(MAT_MUL[c], r) for c, r in zip((14, 11, 13, 9), rolled)]
+    return [_flat(terms[0][i] ^ terms[1][i] ^ terms[2][i] ^ terms[3][i])
+            for i in range(8)]
+
+
+# ---------------------------------------------------------------------------
+# Plane <-> word transposition and round-key planes.
+# ---------------------------------------------------------------------------
+
+
+def to_planes(words: jnp.ndarray) -> jnp.ndarray:
+    """(N, 4) u32 LE words, N % 32 == 0  ->  (8, 16, N/32) u32 planes."""
+    n = words.shape[0]
+    w = n // 32
+    shifts = (jnp.arange(4, dtype=jnp.uint32) * 8)[None, None, :]
+    by = ((words[:, :, None] >> shifts) & 0xFF).reshape(n, 16)
+    bits = (by[None, :, :] >> jnp.arange(8, dtype=jnp.uint32)[:, None, None]) & 1
+    bits = bits.reshape(8, w, 32, 16)
+    lane = jnp.arange(32, dtype=jnp.uint32)[None, None, :, None]
+    return jnp.sum(bits << lane, axis=2, dtype=jnp.uint32).transpose(0, 2, 1)
+
+
+def from_planes(planes: jnp.ndarray) -> jnp.ndarray:
+    """(8, 16, W) u32 planes -> (32*W, 4) u32 LE words."""
+    w = planes.shape[2]
+    lane = jnp.arange(32, dtype=jnp.uint32)[None, None, :, None]
+    bits = (planes.transpose(0, 2, 1)[:, :, None, :] >> lane) & 1
+    by = jnp.sum(bits << jnp.arange(8, dtype=jnp.uint32)[:, None, None, None],
+                 axis=0, dtype=jnp.uint32)          # (W, 32, 16)
+    by = by.reshape(w * 32, 4, 4)
+    sh = jnp.arange(4, dtype=jnp.uint32) * 8
+    return jnp.sum(by << sh[None, None, :], axis=2, dtype=jnp.uint32)
+
+
+def key_planes(rk: jnp.ndarray, nr: int) -> jnp.ndarray:
+    """(4*(nr+1),) u32 round keys -> (nr+1, 8, 16, 1) full-lane bit masks."""
+    w = rk.astype(jnp.uint32).reshape(nr + 1, 4)
+    sh = (jnp.arange(4, dtype=jnp.uint32) * 8)[None, None, :]
+    by = ((w[:, :, None] >> sh) & 0xFF).reshape(nr + 1, 16)
+    bits = (by[:, None, :] >> jnp.arange(8, dtype=jnp.uint32)[None, :, None]) & 1
+    return (bits * jnp.uint32(0xFFFFFFFF))[..., None]
+
+
+# ---------------------------------------------------------------------------
+# Rounds. Shared by the XLA path (scan over rounds) and the Pallas kernel
+# (unrolled/fori inside the tile body) — see ops/pallas_aes.py.
+# ---------------------------------------------------------------------------
+
+
+def _perm_take(x: jnp.ndarray, idx: np.ndarray) -> jnp.ndarray:
+    """Static byte-position permutation. Advanced indexing lowers to one
+    gather, which also acts as the fusion boundary that keeps XLA-CPU's
+    emitter from re-expanding the S-box circuit per consumer (see
+    decrypt_round); Pallas kernels substitute a stack-of-rows version
+    because Mosaic has no gather (ops/pallas_aes.py)."""
+    return x[idx]
+
+
+def encrypt_round(planes: jnp.ndarray, kp: jnp.ndarray, last: bool,
+                  perm=_perm_take) -> jnp.ndarray:
+    """One forward round on stacked planes; kp = (8, 16, 1) key masks."""
+    p = sbox_planes([planes[i] for i in range(8)])
+    p = [perm(x, SR_PERM) for x in p]
+    if not last:
+        p = mixcolumns_planes(p)
+    return jnp.stack([p[i] ^ kp[i] for i in range(8)])
+
+
+def decrypt_round(planes: jnp.ndarray, kp: jnp.ndarray, last: bool,
+                  perm=_perm_take) -> jnp.ndarray:
+    """One inverse round, matching the folded-schedule ordering of the
+    T-table core (AES_RROUND, reference aes-modes/aes.c:624-645):
+    InvShiftRows/InvSubBytes (they commute — permutation vs byte-wise map;
+    the substitution runs first so the round ends in a gather, which keeps
+    XLA-CPU from fusing the whole inversion circuit into a downstream
+    consumer and exploding compile time), then InvMixColumns, then rk_dec."""
+    p = inv_sbox_planes([planes[i] for i in range(8)])
+    p = [perm(x, ISR_PERM) for x in p]
+    if not last:
+        p = inv_mixcolumns_planes(p)
+    return jnp.stack([p[i] ^ kp[i] for i in range(8)])
+
+
+def _crypt_planes(planes: jnp.ndarray, kp: jnp.ndarray, nr: int,
+                  round_fn) -> jnp.ndarray:
+    planes = planes ^ kp[0]
+    if nr > 1:
+        planes, _ = jax.lax.scan(
+            lambda q, k: (round_fn(q, k, False), None), planes, kp[1:nr]
+        )
+    return round_fn(planes, kp[nr], True)
+
+
+# ---------------------------------------------------------------------------
+# Engine surface: drop-in (words, rk, nr) -> words cores.
+# ---------------------------------------------------------------------------
+
+
+def _pad32(words: jnp.ndarray):
+    n = words.shape[0]
+    pad = (-n) % 32
+    if pad:
+        words = jnp.concatenate(
+            [words, jnp.zeros((pad, 4), dtype=words.dtype)], axis=0
+        )
+    return words, n
+
+
+def encrypt_words(words: jnp.ndarray, rk: jnp.ndarray, nr: int) -> jnp.ndarray:
+    """Bitsliced batch encrypt; same contract as ops/block.py:encrypt_words."""
+    padded, n = _pad32(words)
+    out = _crypt_planes(to_planes(padded), key_planes(rk, nr), nr, encrypt_round)
+    return from_planes(out)[:n]
+
+
+def decrypt_words(words: jnp.ndarray, rk_dec: jnp.ndarray, nr: int) -> jnp.ndarray:
+    """Bitsliced batch decrypt with the InvMixColumns-folded schedule."""
+    padded, n = _pad32(words)
+    out = _crypt_planes(to_planes(padded), key_planes(rk_dec, nr), nr, decrypt_round)
+    return from_planes(out)[:n]

@@ -1,0 +1,110 @@
+"""Pallas TPU kernels for the AES round pipeline.
+
+This is the framework's answer to the reference's CUDA kernels
+(`AES_encrypt`/`AES_decrypt`, reference aes-gpu/Source/AES.cu:284-502): the
+whole round pipeline as one fused device kernel. Where the CUDA version maps
+one 16-byte block per thread and gathers from T-tables in shared memory, the
+TPU version keeps the bitsliced plane formulation (ops/bitslice.py) and tiles
+the *lane* axis: each grid step loads an (8, 16, TILE) u32 plane tile — TILE
+lanes = 32·TILE blocks — into VMEM, runs all `nr` rounds on it without ever
+touching HBM, and writes the ciphertext tile back. HBM traffic is exactly
+input + output; the XLA fallback path (scan over rounds) re-materialises the
+carry every round instead.
+
+Differences from the plain-XLA bitslice path, forced by Mosaic:
+
+  * ShiftRows: Mosaic has no vector gather, so the static byte-position
+    permutation is a stack of 16 row slices instead of advanced indexing.
+  * Rounds are a Python loop (nr is static) — fully unrolled straight-line
+    code, like the CUDA kernels' `FULL_UNROLL` (reference AES.cu:35,298-365),
+    but over 512-lane vectors instead of one block per thread.
+
+On non-TPU backends the kernel runs in interpreter mode (tests exercise it
+on CPU); `models.aes` registers it as the "pallas" engine either way.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from . import bitslice
+
+#: Lanes per grid step. (8, 16, 1024) u32 = 512 KiB per tile buffer; with
+#: input + output + circuit intermediates this sits comfortably inside the
+#: ~16 MiB of VMEM while keeping the lane dimension a multiple of 128.
+TILE = 1024
+
+
+def _perm_stack(x: jnp.ndarray, idx) -> jnp.ndarray:
+    """Static permutation of the leading (byte-position) axis as slices."""
+    return jnp.stack([x[int(j)] for j in idx], axis=0)
+
+
+def _aes_kernel(kp_ref, in_ref, out_ref, *, nr: int, decrypt: bool):
+    # ShiftRows is always the stack-of-slices permutation here: Mosaic has
+    # no vector gather, and a pallas kernel may not capture the gather
+    # form's constant index arrays.
+    perm = _perm_stack
+    planes = in_ref[...]
+    kp = kp_ref[...]
+    round_fn = bitslice.decrypt_round if decrypt else bitslice.encrypt_round
+    p = planes ^ kp[0]
+
+    # Middle rounds as a fori_loop rather than straight-line unrolling: the
+    # loop keeps the traced circuit at one round (~800 vector ops), which
+    # Mosaic compiles quickly and — in interpreter mode on CPU — avoids
+    # handing XLA a 10x-unrolled graph it compiles pathologically slowly.
+    def body(r, q):
+        k = jax.lax.dynamic_index_in_dim(kp, r, axis=0, keepdims=False)
+        return round_fn(q, k, False, perm=perm)
+
+    p = jax.lax.fori_loop(1, nr, body, p)
+    out_ref[...] = round_fn(p, kp[nr], True, perm=perm)
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+@functools.partial(jax.jit, static_argnames=("nr", "decrypt", "tile"))
+def _crypt_planes_pallas(planes, kp, *, nr, decrypt, tile):
+    w = planes.shape[2]
+    kernel = functools.partial(_aes_kernel, nr=nr, decrypt=decrypt)
+    return pl.pallas_call(
+        kernel,
+        grid=(w // tile,),
+        in_specs=[
+            pl.BlockSpec((nr + 1, 8, 16, 1), lambda i: (0, 0, 0, 0)),
+            pl.BlockSpec((8, 16, tile), lambda i: (0, 0, i)),
+        ],
+        out_specs=pl.BlockSpec((8, 16, tile), lambda i: (0, 0, i)),
+        out_shape=jax.ShapeDtypeStruct(planes.shape, planes.dtype),
+        interpret=_interpret(),
+    )(kp, planes)
+
+
+def _crypt_words(words, rk, nr, decrypt):
+    n = words.shape[0]
+    tile = TILE if n >= 32 * TILE else max(1, n // 32)
+    span = 32 * tile
+    pad = (-n) % span
+    if pad:
+        words = jnp.concatenate([words, jnp.zeros((pad, 4), words.dtype)], axis=0)
+    planes = bitslice.to_planes(words)
+    kp = bitslice.key_planes(rk, nr)
+    out = _crypt_planes_pallas(planes, kp, nr=nr, decrypt=decrypt, tile=tile)
+    return bitslice.from_planes(out)[:n]
+
+
+def encrypt_words(words: jnp.ndarray, rk: jnp.ndarray, nr: int) -> jnp.ndarray:
+    """Pallas-kernel batch encrypt; contract of ops/block.py:encrypt_words."""
+    return _crypt_words(words, rk, nr, decrypt=False)
+
+
+def decrypt_words(words: jnp.ndarray, rk_dec: jnp.ndarray, nr: int) -> jnp.ndarray:
+    """Pallas-kernel batch decrypt (InvMixColumns-folded schedule)."""
+    return _crypt_words(words, rk_dec, nr, decrypt=True)

@@ -34,10 +34,9 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
-from ..models.aes import _add_counter_be
-from ..ops import block
+from ..models.aes import CORES, _add_counter_be, resolve_engine
 from ..utils import packing
 
 AXIS = "shards"
@@ -51,6 +50,12 @@ def make_mesh(n_devices: int | None = None, axis: str = AXIS) -> Mesh:
     """
     devs = jax.devices()
     if n_devices is not None:
+        if len(devs) < n_devices:
+            raise ValueError(
+                f"requested a {n_devices}-device mesh but only {len(devs)} "
+                "devices exist — a silently smaller mesh would let shard-count "
+                "assumptions go unvalidated"
+            )
         devs = devs[:n_devices]
     return Mesh(np.asarray(devs), (axis,))
 
@@ -75,7 +80,7 @@ def _pad_blocks(words: jnp.ndarray, n_shards: int):
 # ---------------------------------------------------------------------------
 
 
-def _ctr_shard_body(words, ctr_be, rk, nr, axis):
+def _ctr_shard_body(words, ctr_be, rk, nr, axis, engine="jnp"):
     """Per-shard CTR: global block index = axis_index * local_n + local iota.
 
     Matches the 128-bit big-endian post-increment counter semantics of the
@@ -86,14 +91,14 @@ def _ctr_shard_body(words, ctr_be, rk, nr, axis):
     base = jax.lax.axis_index(axis).astype(jnp.uint32) * jnp.uint32(n_local)
     idx = base + jnp.arange(n_local, dtype=jnp.uint32)
     ctr_blocks_be = _add_counter_be(ctr_be, idx)
-    ks = block.encrypt_words(packing.byteswap32(ctr_blocks_be), rk, nr)
+    ks = CORES[engine][0](packing.byteswap32(ctr_blocks_be), rk, nr)
     return words ^ ks
 
 
-@functools.partial(jax.jit, static_argnames=("nr", "mesh", "axis"))
-def _ctr_sharded_jit(words, ctr_be, rk, *, nr, mesh, axis):
+@functools.partial(jax.jit, static_argnames=("nr", "mesh", "axis", "engine"))
+def _ctr_sharded_jit(words, ctr_be, rk, *, nr, mesh, axis, engine="jnp"):
     f = jax.shard_map(
-        functools.partial(_ctr_shard_body, nr=nr, axis=axis),
+        functools.partial(_ctr_shard_body, nr=nr, axis=axis, engine=engine),
         mesh=mesh,
         in_specs=(P(axis), P(), P()),
         out_specs=P(axis),
@@ -101,7 +106,8 @@ def _ctr_sharded_jit(words, ctr_be, rk, *, nr, mesh, axis):
     return f(words, ctr_be, rk)
 
 
-def ctr_crypt_sharded(words, ctr_be, rk, nr, mesh: Mesh, axis: str = AXIS):
+def ctr_crypt_sharded(words, ctr_be, rk, nr, mesh: Mesh, axis: str = AXIS,
+                      engine: str = "auto"):
     """CTR en/decrypt (N, 4) u32 words sharded over `mesh`.
 
     `ctr_be` is the initial 128-bit counter as (4,) big-endian u32 words;
@@ -110,19 +116,20 @@ def ctr_crypt_sharded(words, ctr_be, rk, nr, mesh: Mesh, axis: str = AXIS):
     """
     n_shards = mesh.devices.size
     padded, n = _pad_blocks(words, n_shards)
-    out = _ctr_sharded_jit(padded, ctr_be, rk, nr=nr, mesh=mesh, axis=axis)
+    out = _ctr_sharded_jit(padded, ctr_be, rk, nr=nr, mesh=mesh, axis=axis,
+                           engine=resolve_engine(engine))
     return out[:n]
 
 
-def _ecb_shard_body(words, rk, nr, encrypt):
-    fn = block.encrypt_words if encrypt else block.decrypt_words
+def _ecb_shard_body(words, rk, nr, encrypt, engine="jnp"):
+    fn = CORES[engine][0 if encrypt else 1]
     return fn(words, rk, nr)
 
 
-@functools.partial(jax.jit, static_argnames=("nr", "encrypt", "mesh", "axis"))
-def _ecb_sharded_jit(words, rk, *, nr, encrypt, mesh, axis):
+@functools.partial(jax.jit, static_argnames=("nr", "encrypt", "mesh", "axis", "engine"))
+def _ecb_sharded_jit(words, rk, *, nr, encrypt, mesh, axis, engine="jnp"):
     f = jax.shard_map(
-        functools.partial(_ecb_shard_body, nr=nr, encrypt=encrypt),
+        functools.partial(_ecb_shard_body, nr=nr, encrypt=encrypt, engine=engine),
         mesh=mesh,
         in_specs=(P(axis), P()),
         out_specs=P(axis),
@@ -131,12 +138,13 @@ def _ecb_sharded_jit(words, rk, *, nr, encrypt, mesh, axis):
 
 
 def ecb_crypt_sharded(words, rk, nr, mesh: Mesh, encrypt: bool = True,
-                      axis: str = AXIS):
+                      axis: str = AXIS, engine: str = "auto"):
     """ECB over a sharded block axis — the reference's headline parallel mode
     (each pthread ran aes_crypt_ecb over its chunk, aes-modes/test.c:37-41)."""
     n_shards = mesh.devices.size
     padded, n = _pad_blocks(words, n_shards)
-    out = _ecb_sharded_jit(padded, rk, nr=nr, encrypt=encrypt, mesh=mesh, axis=axis)
+    out = _ecb_sharded_jit(padded, rk, nr=nr, encrypt=encrypt, mesh=mesh,
+                           axis=axis, engine=resolve_engine(engine))
     return out[:n]
 
 
@@ -167,9 +175,10 @@ def xor_sharded(data, keystream, mesh: Mesh, axis: str = AXIS):
 def gather_for_verification(x, mesh: Mesh, axis: str = AXIS):
     """Optional all_gather so a host can bit-compare the full output — the
     lone collective, used only by tests (SURVEY.md §2: verification gather)."""
+    padded, n = _pad_blocks(x, mesh.devices.size)
     f = jax.shard_map(
         lambda s: jax.lax.all_gather(s, axis, tiled=True),
         mesh=mesh, in_specs=P(axis), out_specs=P(),
         check_vma=False,  # all_gather output is replicated; not inferred
     )
-    return f(x)
+    return f(padded)[:n]

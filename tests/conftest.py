@@ -12,10 +12,14 @@ because conftest imports before any test touches jax.
 """
 
 import os
+import re
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
+_m = re.search(r"--xla_force_host_platform_device_count=(\d+)", _flags)
+if _m is None or int(_m.group(1)) < 8:
+    if _m is not None:  # a smaller pre-set count would break every mesh test
+        _flags = _flags.replace(_m.group(0), "")
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402

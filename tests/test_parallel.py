@@ -11,7 +11,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from our_tree_tpu.models.aes import AES, AES_ENCRYPT
+from our_tree_tpu.models.aes import AES
 from our_tree_tpu.models import aes as aes_mod
 from our_tree_tpu.parallel import (
     ctr_crypt_sharded,
@@ -33,7 +33,7 @@ def _words(nbytes):
 
 
 def test_mesh_has_8_virtual_devices():
-    assert len(jax.devices()) == 8
+    assert len(jax.devices()) >= 8
 
 
 @pytest.mark.parametrize("nshards", [1, 2, 8])
