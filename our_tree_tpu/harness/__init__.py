@@ -1,0 +1,9 @@
+"""Benchmark/CLI layer — the reference's L2 orchestration layer, unified.
+
+- `bench` (python -m our_tree_tpu.harness.bench): size x workers sweep in
+  the reference CSV format, `--backend={tpu,c}`.
+- `decrypt` (python -m our_tree_tpu.harness.decrypt): hex in/out cipher CLI,
+  the aes_ecb_d equivalent.
+"""
+
+from .backends import make_backend  # noqa: F401

@@ -1,0 +1,129 @@
+"""Benchmark backends: one protocol, multiple execution engines.
+
+The reference repo had one harness per backend, each a standalone `main()`
+with copy-pasted sweep loops (test.c, aes-modes/test.c, main_ecb_e.cu —
+SURVEY.md §1 L2). Here a backend is an object with a tiny protocol
+(`ecb` / `ctr` / `cbc` / `cfb128` / `arc4_setup_prep` / `arc4_crypt`) and one
+sweep driver serves them all; `--backend={tpu,c}` replaces recompiling a
+different directory.
+
+  * "tpu"  — the JAX framework paths (any registered engine, any number of
+    mesh shards). Workers map to mesh shards: the moral successor of the
+    reference's pthread chunking (aes-modes/test.c:33-35), scatter/gather by
+    sharding instead of pointer arithmetic.
+  * "c"    — the framework's own native C runtime (runtime/, clean-room,
+    pthread-parallel like the reference harnesses), loaded via ctypes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class TpuBackend:
+    """JAX/TPU execution: batched kernels, optional multi-chip sharding."""
+
+    name = "tpu"
+
+    def __init__(self, engine: str = "auto"):
+        import jax
+
+        from ..models import aes as aes_mod
+        from ..models.arc4 import ARC4
+        from ..parallel import dist
+
+        self._jax = jax
+        self._aes_mod = aes_mod
+        self._ARC4 = ARC4
+        self._dist = dist
+        self.engine = aes_mod.resolve_engine(engine)
+        self.max_workers = len(jax.devices())
+        self._meshes: dict[int, object] = {}
+
+    # -- helpers -----------------------------------------------------------
+    def _mesh(self, workers: int):
+        if workers not in self._meshes:
+            self._meshes[workers] = self._dist.make_mesh(workers)
+        return self._meshes[workers]
+
+    def stage_words(self, data: np.ndarray):
+        """Byte buffer -> device (N, 4) u32 LE words (the H2D staging step,
+        cf. cudaMemcpy in reference AES.cu:236)."""
+        from ..utils import packing
+
+        return self._jax.device_put(
+            packing.np_bytes_to_words(np.ascontiguousarray(data)).reshape(-1, 4)
+        )
+
+    def block_until_ready(self, x):
+        return self._jax.block_until_ready(x)
+
+    # -- AES ---------------------------------------------------------------
+    def make_key(self, key: bytes):
+        return self._aes_mod.AES(key, engine=self.engine)
+
+    def ecb(self, ctx, words, workers: int):
+        if workers == 1:
+            return self._aes_mod.ecb_encrypt_words(
+                words, ctx.rk_enc, ctx.nr, self.engine
+            )
+        return self._dist.ecb_crypt_sharded(
+            words, ctx.rk_enc, ctx.nr, self._mesh(workers), engine=self.engine
+        )
+
+    def ctr(self, ctx, words, ctr_be, workers: int):
+        if workers == 1:
+            return self._aes_mod.ctr_crypt_words(
+                words, ctr_be, ctx.rk_enc, ctx.nr, self.engine
+            )
+        return self._dist.ctr_crypt_sharded(
+            words, ctr_be, ctx.rk_enc, ctx.nr, self._mesh(workers),
+            engine=self.engine,
+        )
+
+    def cbc(self, ctx, words, iv_words, workers: int):
+        out, _ = self._aes_mod.cbc_encrypt_words(words, iv_words, ctx.rk_enc, ctx.nr)
+        return out
+
+    def cfb128(self, ctx, words, iv_words, workers: int):
+        out, _ = self._aes_mod.cfb128_encrypt_words(words, iv_words, ctx.rk_enc, ctx.nr)
+        return out
+
+    def ctr_be_words(self, nonce: np.ndarray):
+        import jax.numpy as jnp
+
+        from ..utils import packing
+
+        return jnp.asarray(packing.np_bytes_to_words(nonce).byteswap())
+
+    def iv_words(self, iv: np.ndarray):
+        import jax.numpy as jnp
+
+        from ..utils import packing
+
+        return jnp.asarray(packing.np_bytes_to_words(iv))
+
+    # -- ARC4 --------------------------------------------------------------
+    def arc4_setup_prep(self, key: bytes, length: int):
+        rc = self._ARC4(key)
+        return rc.prep(length)
+
+    def arc4_crypt(self, data_dev, ks_dev, workers: int):
+        if workers == 1:
+            from ..models.arc4 import crypt
+
+            return crypt(data_dev, ks_dev)
+        return self._dist.xor_sharded(data_dev, ks_dev, self._mesh(workers))
+
+    def to_device(self, arr: np.ndarray):
+        return self._jax.device_put(arr)
+
+
+def make_backend(name: str, engine: str = "auto"):
+    if name == "tpu":
+        return TpuBackend(engine)
+    if name == "c":
+        from ..runtime.native import CBackend
+
+        return CBackend()
+    raise ValueError(f"unknown backend {name!r} (expected 'tpu' or 'c')")

@@ -1,0 +1,260 @@
+"""Benchmark sweep CLI — the TPU successor of the reference harnesses.
+
+Replicates the reference sweep shape (sizes x workers x iterations with a
+fixed seed, reference test.c:129-157 and aes-modes/test.c:353-446) and its
+CSV result format exactly:
+
+    <name>, <msg_bytes>, <workers>, t1, t2, ..., tN,
+
+with RC4 additionally printing the separately-timed keystream-generation
+line ("Generated a new key in <us>,", reference test.c:84-91) and the run
+ending with the ARC4 known-answer self-test, mirroring test.c:156. Output
+goes to stdout and (with --out) to a `results.<host>.tpu` file — the L3
+results corpus of SURVEY.md §1, new backend column.
+
+Differences from the reference, on purpose:
+  * correctness is checked, not assumed: after the sweeps, one message is
+    run through every worker count and bit-compared (the shard-invariance
+    check whose absence let reference defect #1 go unnoticed), the RC4 XOR
+    phase is verified against numpy, and the run ends with known-answer
+    self-tests. (The timed iterations themselves are not re-verified.)
+  * `--timing device` excludes host<->device staging (reports pure kernel
+    time); default `e2e` includes staging like the reference GPU harness
+    (main_ecb_e.cu:37-44).
+  * sweeps are flags, not recompiles: --sizes-mb, --workers, --iters,
+    --keybits, --modes, --backend, --engine.
+"""
+
+from __future__ import annotations
+
+import argparse
+import socket
+import sys
+import time
+
+import numpy as np
+
+from .backends import make_backend
+
+MIB = 1 << 20
+
+#: Fixed nonce/IV, in the spirit of the reference's hardcoded constants
+#: (aes-modes/test.c:305-308).
+NONCE = np.frombuffer(bytes.fromhex("f0f1f2f3f4f5f6f7f8f9fafbfcfdfeff"), np.uint8)
+IV = np.frombuffer(bytes.fromhex("000102030405060708090a0b0c0d0e0f"), np.uint8)
+
+
+class Emitter:
+    def __init__(self, path: str | None):
+        self.f = open(path, "w") if path else None
+
+    def line(self, text: str):
+        print(text, flush=True)
+        if self.f:
+            self.f.write(text + "\n")
+            self.f.flush()
+
+    def close(self):
+        if self.f:
+            self.f.close()
+
+
+def _csv(times_us: list[int]) -> str:
+    return "".join(f"{t}, " for t in times_us).rstrip()
+
+
+def _time_us(fn) -> tuple[int, object]:
+    t0 = time.perf_counter_ns()
+    out = fn()
+    return (time.perf_counter_ns() - t0) // 1000, out
+
+
+def run_aes_mode(em, backend, mode, size, workers_list, iters, keybits, rng,
+                 timing):
+    msg = rng.integers(0, 256, size, dtype=np.uint8)
+    for workers in workers_list:
+        times = []
+        warmed = False
+        for it in range(iters):
+            key = rng.integers(0, 256, keybits // 8, dtype=np.uint8).tobytes()
+            ctx = backend.make_key(key)  # untimed, like the reference
+            if mode == "ctr":
+                ctr_be = backend.ctr_be_words(NONCE)
+                run = lambda w: backend.ctr(ctx, w, ctr_be, workers)
+            elif mode == "ecb":
+                run = lambda w: backend.ecb(ctx, w, workers)
+            elif mode == "cbc":
+                ivw = backend.iv_words(IV)
+                run = lambda w: backend.cbc(ctx, w, ivw, workers)
+            elif mode == "cfb128":
+                ivw = backend.iv_words(IV)
+                run = lambda w: backend.cfb128(ctx, w, ivw, workers)
+            else:
+                raise ValueError(mode)
+
+            if not warmed:
+                # One untimed call absorbs JIT compilation — the analogue of
+                # the reference's numbers never containing a compiler in the
+                # timed region. Rekeying later iterations does NOT recompile
+                # (keys are data, not trace constants).
+                backend.block_until_ready(run(backend.stage_words(msg)))
+                warmed = True
+            if timing == "device":
+                words = backend.stage_words(msg)
+                backend.block_until_ready(words)
+                us, out = _time_us(
+                    lambda: backend.block_until_ready(run(words))
+                )
+            else:
+                us, out = _time_us(
+                    lambda: backend.block_until_ready(run(backend.stage_words(msg)))
+                )
+            times.append(us)
+        label = backend.name.upper()
+        em.line(f"{label} AES-{keybits} {mode.upper()}, {size}, {workers}, {_csv(times)}")
+
+
+def check_shard_invariance(em, backend, size, workers_list, keybits, rng):
+    """Same key + data through every worker count -> identical ciphertext.
+
+    This is the determinism check the reference never ran (SURVEY.md §5
+    "race detection"): its defect #1 (CTR sweeps silently running ECB) would
+    have been caught by exactly this comparison.
+    """
+    msg = rng.integers(0, 256, size, dtype=np.uint8)
+    key = rng.integers(0, 256, keybits // 8, dtype=np.uint8).tobytes()
+    ctx = backend.make_key(key)
+    words = backend.stage_words(msg)
+    ctr_be = backend.ctr_be_words(NONCE)
+    ref_ecb = ref_ctr = None
+    for workers in workers_list:
+        e = np.asarray(backend.block_until_ready(backend.ecb(ctx, words, workers)))
+        c = np.asarray(backend.block_until_ready(backend.ctr(ctx, words, ctr_be, workers)))
+        if ref_ecb is None:
+            ref_ecb, ref_ctr = e, c
+        else:
+            if not (np.array_equal(e, ref_ecb) and np.array_equal(c, ref_ctr)):
+                em.line(f"SHARD-INVARIANCE FAILED at workers={workers}")
+                raise SystemExit(2)
+    em.line(f"Shard invariance {workers_list}: passed")
+
+
+def run_rc4(em, backend, size, workers_list, iters, rng):
+    msg = rng.integers(0, 256, size, dtype=np.uint8)
+    for workers in workers_list:
+        em.line(f"RC4, {size}, {workers}, ")
+        key = rng.integers(0, 256, 16, dtype=np.uint8).tobytes()
+        # Phase 1+2 (key schedule + keystream gen): sequential, timed once
+        # per (size, workers) row, like the reference (test.c:84-91).
+        us, ks = _time_us(lambda: backend.arc4_setup_prep(key, size))
+        em.line(f"Generated a new key in {us}, ")
+        ks_dev = backend.to_device(np.asarray(ks))
+        data_dev = backend.to_device(msg)
+        backend.block_until_ready(
+            backend.arc4_crypt(data_dev, ks_dev, workers)  # untimed compile
+        )
+        times = []
+        out = None
+        for _ in range(iters):
+            us, out = _time_us(
+                lambda: backend.block_until_ready(
+                    backend.arc4_crypt(data_dev, ks_dev, workers)
+                )
+            )
+            times.append(us)
+        em.line(f"{_csv(times)}")
+        # XOR phase correctness (the reference checked nothing here).
+        if out is not None and not np.array_equal(np.asarray(out), msg ^ np.asarray(ks)):
+            em.line(f"RC4 XOR MISMATCH at workers={workers}")
+            raise SystemExit(2)
+
+
+def arc4_self_test(em):
+    """Rescorla-1994 vectors through setup->prep->crypt, like arc4_self_test
+    (reference arc4.c:124-183), printed in the reference's format."""
+    from ..models.arc4 import ARC4
+
+    vectors = [
+        ("0123456789abcdef", "0123456789abcdef", "75b7878099e0c596"),
+        ("0123456789abcdef", "0000000000000000", "7494c2e7104b0879"),
+        ("0000000000000000", "0000000000000000", "de188941a3375d3a"),
+    ]
+    for i, (key, pt, ct) in enumerate(vectors, 1):
+        rc = ARC4(bytes.fromhex(key))
+        ks = rc.prep(8)
+        out = rc.crypt(np.frombuffer(bytes.fromhex(pt), np.uint8), ks)
+        ok = out.tobytes().hex() == ct
+        em.line(f"ARC4 test #{i}: {'passed' if ok else 'FAILED'}")
+        if not ok:
+            raise SystemExit(2)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="our-tree-tpu benchmark sweep (reference CSV format)"
+    )
+    ap.add_argument("--backend", default="tpu", choices=("tpu", "c"))
+    ap.add_argument("--engine", default="auto",
+                    help="tpu backend compute engine (auto/jnp/bitslice/pallas)")
+    ap.add_argument("--sizes-mb", default="1,10,100,1000",
+                    help="comma list of message sizes in MiB")
+    ap.add_argument("--workers", default="",
+                    help="comma list of worker counts (default: 1,2,4,8 capped "
+                         "at the device count)")
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--keybits", type=int, default=256, choices=(128, 192, 256))
+    ap.add_argument("--modes", default="ecb,ctr,rc4",
+                    help="comma list from ecb,ctr,cbc,cfb128,rc4")
+    ap.add_argument("--seed", type=int, default=1337)
+    ap.add_argument("--timing", default="e2e", choices=("e2e", "device"),
+                    help="e2e includes host<->device staging (reference GPU "
+                         "harness convention); device excludes it")
+    ap.add_argument("--out", default=None,
+                    help="also write results to this file "
+                         "(e.g. results.$(hostname).tpu)")
+    ap.add_argument("--default-out", action="store_true",
+                    help="write to results.<host>.<backend>")
+    args = ap.parse_args(argv)
+
+    backend = make_backend(args.backend, args.engine)
+    sizes = []
+    for tok in args.sizes_mb.split(","):
+        if not tok:
+            continue
+        nbytes = int(float(tok) * MIB) // 16 * 16  # whole AES blocks only
+        if nbytes <= 0:
+            ap.error(f"--sizes-mb entry {tok!r} is below one 16-byte block")
+        sizes.append(nbytes)
+    if args.workers:
+        workers_list = [int(w) for w in args.workers.split(",") if w]
+    else:
+        cap = getattr(backend, "max_workers", 8)
+        workers_list = [w for w in (1, 2, 4, 8) if w <= cap] or [1]
+
+    out_path = args.out
+    if args.default_out and not out_path:
+        out_path = f"results.{socket.gethostname().split('.')[0]}.{args.backend}"
+    em = Emitter(out_path)
+    modes = [m.strip() for m in args.modes.split(",") if m.strip()]
+    rng = np.random.default_rng(args.seed)  # srand(1337) of the reference
+
+    try:
+        for mode in modes:
+            for size in sizes:
+                if mode == "rc4":
+                    run_rc4(em, backend, size, workers_list, args.iters, rng)
+                else:
+                    run_aes_mode(em, backend, mode, size, workers_list,
+                                 args.iters, args.keybits, rng, args.timing)
+        if len(workers_list) > 1 and {"ecb", "ctr"} & set(modes):
+            check_shard_invariance(em, backend, min(sizes), workers_list,
+                                   args.keybits, rng)
+        if "rc4" in modes:
+            arc4_self_test(em)
+    finally:
+        em.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

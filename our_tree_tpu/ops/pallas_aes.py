@@ -89,9 +89,13 @@ def _crypt_planes_pallas(planes, kp, *, nr, decrypt, tile):
 
 def _crypt_words(words, rk, nr, decrypt):
     n = words.shape[0]
-    tile = TILE if n >= 32 * TILE else max(1, n // 32)
-    span = 32 * tile
-    pad = (-n) % span
+    # Pad to whole 32-block lanes first, THEN pick the tile: choosing the
+    # tile from the unpadded count can double the padded work for sizes
+    # just under the tile span. This way padding never exceeds 31 blocks
+    # plus tile alignment on the lane axis.
+    w_lanes = (n + 31) // 32
+    tile = min(TILE, w_lanes)
+    pad = 32 * ((w_lanes + tile - 1) // tile * tile) - n
     if pad:
         words = jnp.concatenate([words, jnp.zeros((pad, 4), words.dtype)], axis=0)
     planes = bitslice.to_planes(words)

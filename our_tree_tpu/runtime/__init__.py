@@ -1,0 +1,6 @@
+"""Native runtime layer: clean-room C cipher cores + pthread parallel bulk
+ops (csrc/), ctypes bindings and the `--backend=c` harness backend
+(native.py). The role of the reference's C/C++ layer (SURVEY.md §1 L0-L1),
+rebuilt from the specifications."""
+
+from .native import CBackend, NativeAES, NativeARC4, load  # noqa: F401

@@ -1,0 +1,232 @@
+"""ctypes bindings for the native C runtime + the `--backend=c` harness backend.
+
+The shared library (runtime/csrc/libotcrypt.so) is built on first use with
+the in-tree Makefile — the build is a single `make` of three C files, cheap
+enough to run lazily and cached by mtime. Bindings use ctypes (no pybind11
+in this image); buffers cross the boundary as numpy arrays, zero-copy.
+
+This layer plays the role of the reference's portable-C path *and* its
+pthread harness (aes-modes/test.c): same contiguous-chunk work split, same
+cipher semantics, our own implementation.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import pathlib
+import subprocess
+
+import numpy as np
+
+_CSRC = pathlib.Path(__file__).parent / "csrc"
+_LIB_PATH = _CSRC / "libotcrypt.so"
+_lib = None
+
+
+class AesCtx(ctypes.Structure):
+    _fields_ = [("nr", ctypes.c_int), ("rk", ctypes.c_uint8 * (15 * 16))]
+
+
+class Arc4Ctx(ctypes.Structure):
+    _fields_ = [("x", ctypes.c_int), ("y", ctypes.c_int),
+                ("m", ctypes.c_uint8 * 256)]
+
+
+def _build() -> None:
+    srcs = list(_CSRC.glob("*.c")) + [_CSRC / "ot_crypt.h", _CSRC / "Makefile"]
+    if _LIB_PATH.exists() and all(
+        _LIB_PATH.stat().st_mtime >= s.stat().st_mtime for s in srcs
+    ):
+        return
+    proc = subprocess.run(
+        ["make", "-C", str(_CSRC)], capture_output=True, text=True
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"native runtime build failed:\n{proc.stdout}\n{proc.stderr}"
+        )
+
+
+_u8p = np.ctypeslib.ndpointer(dtype=np.uint8, flags="C_CONTIGUOUS")
+
+
+def load():
+    """Build (if stale) and load the native library, with typed signatures."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    _build()
+    lib = ctypes.CDLL(str(_LIB_PATH))
+    lib.ot_aes_setkey.argtypes = [ctypes.POINTER(AesCtx), _u8p, ctypes.c_int]
+    lib.ot_aes_setkey.restype = ctypes.c_int
+    lib.ot_aes_ecb.argtypes = [ctypes.POINTER(AesCtx), ctypes.c_int, _u8p,
+                               _u8p, ctypes.c_size_t, ctypes.c_int]
+    lib.ot_aes_ctr.argtypes = [ctypes.POINTER(AesCtx), _u8p, _u8p, _u8p,
+                               ctypes.c_size_t, ctypes.c_int]
+    lib.ot_aes_cbc_encrypt.argtypes = [ctypes.POINTER(AesCtx), _u8p, _u8p,
+                                       _u8p, ctypes.c_size_t]
+    lib.ot_aes_cbc_decrypt.argtypes = [ctypes.POINTER(AesCtx), _u8p, _u8p,
+                                       _u8p, ctypes.c_size_t, ctypes.c_int]
+    lib.ot_aes_cfb128.argtypes = [ctypes.POINTER(AesCtx), ctypes.c_int,
+                                  ctypes.POINTER(ctypes.c_int), _u8p, _u8p,
+                                  _u8p, ctypes.c_size_t]
+    lib.ot_arc4_setup.argtypes = [ctypes.POINTER(Arc4Ctx), _u8p,
+                                  ctypes.c_size_t]
+    lib.ot_arc4_prep.argtypes = [ctypes.POINTER(Arc4Ctx), _u8p,
+                                 ctypes.c_size_t]
+    lib.ot_xor.argtypes = [_u8p, _u8p, _u8p, ctypes.c_size_t, ctypes.c_int]
+    _lib = lib
+    return lib
+
+
+# ---------------------------------------------------------------------------
+# Pythonic wrappers (mirror the TPU-side API shapes).
+# ---------------------------------------------------------------------------
+
+
+class NativeAES:
+    """C-runtime AES context; same surface idea as models.aes.AES."""
+
+    def __init__(self, key: bytes):
+        self._lib = load()
+        self.key = bytes(key)
+        self.ctx = AesCtx()
+        kb = np.frombuffer(self.key, dtype=np.uint8)
+        if self._lib.ot_aes_setkey(ctypes.byref(self.ctx), kb, len(key) * 8):
+            raise ValueError(f"invalid AES key size {len(key)}")
+        self.nr = self.ctx.nr
+
+    def ecb(self, data: np.ndarray, encrypt: bool = True,
+            nthreads: int = 1) -> np.ndarray:
+        data = np.ascontiguousarray(data, dtype=np.uint8)
+        if data.size % 16:
+            raise ValueError("ECB data must be a multiple of 16 bytes")
+        out = np.empty_like(data)
+        self._lib.ot_aes_ecb(ctypes.byref(self.ctx), int(encrypt), data, out,
+                             data.size // 16, nthreads)
+        return out
+
+    def ctr(self, nonce: np.ndarray, data: np.ndarray,
+            nthreads: int = 1) -> tuple[np.ndarray, np.ndarray]:
+        data = np.ascontiguousarray(data, dtype=np.uint8)
+        nonce = np.ascontiguousarray(nonce, dtype=np.uint8).copy()
+        out = np.empty_like(data)
+        self._lib.ot_aes_ctr(ctypes.byref(self.ctx), nonce, data, out,
+                             data.size, nthreads)
+        return out, nonce
+
+    def cbc(self, iv: np.ndarray, data: np.ndarray, encrypt: bool = True,
+            nthreads: int = 1) -> tuple[np.ndarray, np.ndarray]:
+        data = np.ascontiguousarray(data, dtype=np.uint8)
+        if data.size % 16:
+            raise ValueError("CBC data must be a multiple of 16 bytes")
+        iv = np.ascontiguousarray(iv, dtype=np.uint8).copy()
+        out = np.empty_like(data)
+        if encrypt:
+            self._lib.ot_aes_cbc_encrypt(ctypes.byref(self.ctx), iv, data,
+                                         out, data.size // 16)
+        else:
+            self._lib.ot_aes_cbc_decrypt(ctypes.byref(self.ctx), iv, data,
+                                         out, data.size // 16, nthreads)
+        return out, iv
+
+    def cfb128(self, iv_off: int, iv: np.ndarray, data: np.ndarray,
+               encrypt: bool = True) -> tuple[np.ndarray, int, np.ndarray]:
+        data = np.ascontiguousarray(data, dtype=np.uint8)
+        iv = np.ascontiguousarray(iv, dtype=np.uint8).copy()
+        out = np.empty_like(data)
+        off = ctypes.c_int(iv_off)
+        self._lib.ot_aes_cfb128(ctypes.byref(self.ctx), int(encrypt),
+                                ctypes.byref(off), iv, data, out, data.size)
+        return out, off.value, iv
+
+
+class NativeARC4:
+    def __init__(self, key: bytes):
+        if len(key) == 0:
+            raise ValueError("ARC4 key must be non-empty")
+        self._lib = load()
+        self.ctx = Arc4Ctx()
+        kb = np.frombuffer(bytes(key), dtype=np.uint8)
+        self._lib.ot_arc4_setup(ctypes.byref(self.ctx), kb, len(key))
+
+    def prep(self, length: int) -> np.ndarray:
+        ks = np.empty(length, dtype=np.uint8)
+        self._lib.ot_arc4_prep(ctypes.byref(self.ctx), ks, length)
+        return ks
+
+    def crypt(self, data: np.ndarray, keystream: np.ndarray,
+              nthreads: int = 1) -> np.ndarray:
+        data = np.ascontiguousarray(data, dtype=np.uint8)
+        keystream = np.ascontiguousarray(keystream, dtype=np.uint8)
+        if data.shape != keystream.shape:
+            raise ValueError("data/keystream length mismatch")
+        out = np.empty_like(data)
+        self._lib.ot_xor(data, keystream, out, data.size, nthreads)
+        return out
+
+
+class CBackend:
+    """Harness backend protocol over the native runtime (--backend=c).
+
+    'Workers' are pthreads, exactly the reference's sweep axis
+    (test.c:135-153). Device staging is a no-op; block_until_ready is
+    identity (C calls are synchronous).
+    """
+
+    name = "c"
+
+    def __init__(self):
+        load()
+        self.max_workers = os.cpu_count() or 8
+
+    # -- protocol ----------------------------------------------------------
+    def stage_words(self, data: np.ndarray):
+        return np.ascontiguousarray(data, dtype=np.uint8)
+
+    def to_device(self, arr: np.ndarray):
+        return np.ascontiguousarray(arr)
+
+    def block_until_ready(self, x):
+        return x
+
+    def make_key(self, key: bytes):
+        return NativeAES(key)
+
+    def ecb(self, ctx: NativeAES, data, workers: int):
+        return ctx.ecb(data, encrypt=True, nthreads=workers)
+
+    def ctr(self, ctx: NativeAES, data, nonce, workers: int):
+        out, _ = ctx.ctr(nonce, data, nthreads=workers)
+        return out
+
+    def cbc(self, ctx: NativeAES, data, iv, workers: int):
+        out, _ = ctx.cbc(iv, data, encrypt=True)
+        return out
+
+    def cfb128(self, ctx: NativeAES, data, iv, workers: int):
+        out, _, _ = ctx.cfb128(0, iv, data, encrypt=True)
+        return out
+
+    def ctr_be_words(self, nonce: np.ndarray):
+        return np.ascontiguousarray(nonce, dtype=np.uint8)
+
+    def iv_words(self, iv: np.ndarray):
+        return np.ascontiguousarray(iv, dtype=np.uint8)
+
+    def arc4_setup_prep(self, key: bytes, length: int):
+        return NativeARC4(key).prep(length)
+
+    def arc4_crypt(self, data, ks, workers: int):
+        data = np.ascontiguousarray(data, dtype=np.uint8)
+        ks = np.ascontiguousarray(ks, dtype=np.uint8)
+        if data.shape != ks.shape:
+            # A short keystream would read out of bounds in C (and XOR
+            # against padding would pass tail plaintext through — see
+            # dist.xor_sharded's identical guard).
+            raise ValueError(f"data/keystream shape mismatch: "
+                             f"{data.shape} vs {ks.shape}")
+        out = np.empty_like(data)
+        load().ot_xor(data, ks, out, data.size, workers)
+        return out

@@ -1,0 +1,70 @@
+"""Harness CLIs: sweep CSV format, shard invariance, decrypt round-trip."""
+
+import numpy as np
+import pytest
+
+from our_tree_tpu.harness import bench as bench_mod
+from our_tree_tpu.harness import decrypt as decrypt_mod
+
+
+def test_bench_sweep_csv(tmp_path, capsys):
+    out = tmp_path / "results.test.tpu"
+    rc = bench_mod.main([
+        "--sizes-mb", "0.0625", "--workers", "1,2", "--iters", "2",
+        "--modes", "ecb,ctr,rc4", "--out", str(out),
+    ])
+    assert rc == 0
+    lines = out.read_text().splitlines()
+    # Reference row shape: "<name>, <bytes>, <workers>, t1, t2," — and the
+    # run must end with the ARC4 self-test like reference test.c:156.
+    ecb_rows = [l for l in lines if l.startswith("TPU AES-256 ECB")]
+    assert len(ecb_rows) == 2
+    for row in ecb_rows:
+        fields = [f for f in row.split(",") if f.strip()]
+        assert fields[1].strip() == "65536"
+        assert int(fields[2]) in (1, 2)
+        assert len(fields) == 3 + 2  # name, bytes, workers, two timings
+        assert all(int(f) >= 0 for f in fields[3:])
+    assert "Shard invariance [1, 2]: passed" in lines
+    assert "ARC4 test #3: passed" in lines
+
+
+def test_bench_rejects_unknown_mode():
+    with pytest.raises(ValueError):
+        bench_mod.main(["--sizes-mb", "0.001", "--modes", "rot13", "--iters", "1"])
+
+
+def test_decrypt_cli_nist_roundtrip(capsys):
+    key = "000102030405060708090a0b0c0d0e0f"
+    assert decrypt_mod.main([key, "00112233445566778899aabbccddeeff",
+                             "--encrypt"]) == 0
+    assert capsys.readouterr().out.strip() == "69c4e0d86a7b0430d8cdb78070b4c55a"
+    assert decrypt_mod.main([key, "69c4e0d86a7b0430d8cdb78070b4c55a"]) == 0
+    assert capsys.readouterr().out.strip() == "00112233445566778899aabbccddeeff"
+
+
+def test_decrypt_cli_cbc_ctr_match_context(capsys):
+    rng = np.random.default_rng(5)
+    key = rng.integers(0, 256, 16, np.uint8)
+    iv = rng.integers(0, 256, 16, np.uint8)
+    data = rng.integers(0, 256, 48, np.uint8)
+    from our_tree_tpu.models.aes import AES, AES_ENCRYPT
+
+    a = AES(key.tobytes())
+    for mode in ("cbc", "ctr"):
+        assert decrypt_mod.main([
+            key.tobytes().hex(), data.tobytes().hex(),
+            "--encrypt", "--mode", mode, "--iv", iv.tobytes().hex(),
+        ]) == 0
+        got = capsys.readouterr().out.strip()
+        if mode == "cbc":
+            expect, _ = a.crypt_cbc(AES_ENCRYPT, iv, data)
+        else:
+            expect, *_ = a.crypt_ctr(0, iv.copy(), np.zeros(16, np.uint8), data)
+        assert got == expect.tobytes().hex()
+
+
+def test_decrypt_cli_rejects_bad_input(capsys):
+    assert decrypt_mod.main(["zz", "00" * 16]) == 1
+    assert decrypt_mod.main(["00" * 5, "00" * 16]) == 1
+    assert decrypt_mod.main(["00" * 16, "00" * 15]) == 1

@@ -1,0 +1,100 @@
+"""Native C runtime: cross-backend parity with the JAX paths + thread
+invariance.
+
+The JAX "jnp" engine is pinned bit-exactly to the reference C oracle by
+tests/test_parity.py; comparing the native runtime against it closes the
+triangle (C backend == JAX backend == reference oracle) without needing the
+reference repo at test time — the automated version of the reference's
+manual hex-CLI cross-check (SURVEY.md §4 tier 2).
+"""
+
+import numpy as np
+import pytest
+
+from our_tree_tpu.models.aes import AES, AES_DECRYPT, AES_ENCRYPT
+from our_tree_tpu.models.arc4 import ARC4
+from our_tree_tpu.runtime.native import CBackend, NativeAES, NativeARC4
+
+RNG = np.random.default_rng(11)
+KEY = {bits: RNG.integers(0, 256, bits // 8, np.uint8).tobytes()
+       for bits in (128, 192, 256)}
+MSG = RNG.integers(0, 256, 16 * 129, np.uint8)
+ODD = RNG.integers(0, 256, 10_007, np.uint8)
+IV = RNG.integers(0, 256, 16, np.uint8)
+
+
+@pytest.mark.parametrize("bits", [128, 192, 256])
+def test_native_ecb_matches_jax(bits):
+    nat, jx = NativeAES(KEY[bits]), AES(KEY[bits], engine="jnp")
+    ct = nat.ecb(MSG, encrypt=True, nthreads=3)
+    np.testing.assert_array_equal(ct, jx.crypt_ecb(AES_ENCRYPT, MSG))
+    np.testing.assert_array_equal(
+        nat.ecb(ct, encrypt=False, nthreads=2), jx.crypt_ecb(AES_DECRYPT, ct)
+    )
+
+
+@pytest.mark.parametrize("bits", [128, 256])
+def test_native_ctr_matches_jax_and_threads(bits):
+    nat, jx = NativeAES(KEY[bits]), AES(KEY[bits], engine="jnp")
+    expect, *_ = jx.crypt_ctr(0, IV.copy(), np.zeros(16, np.uint8), ODD)
+    outs = [nat.ctr(IV, ODD, nthreads=t)[0] for t in (1, 2, 7)]
+    for out in outs:
+        np.testing.assert_array_equal(out, expect)  # thread invariance too
+
+
+def test_native_ctr_advances_nonce_like_jax():
+    nat, jx = NativeAES(KEY[128]), AES(KEY[128], engine="jnp")
+    _, _, nc_jax, _ = jx.crypt_ctr(0, IV.copy(), np.zeros(16, np.uint8), ODD)
+    _, nc_nat = nat.ctr(IV, ODD, nthreads=2)
+    np.testing.assert_array_equal(nc_nat, nc_jax)
+
+
+def test_native_cbc_both_directions():
+    nat, jx = NativeAES(KEY[256]), AES(KEY[256], engine="jnp")
+    ct, iv_after = nat.cbc(IV, MSG, encrypt=True)
+    expect, iv_jax = jx.crypt_cbc(AES_ENCRYPT, IV, MSG)
+    np.testing.assert_array_equal(ct, expect)
+    np.testing.assert_array_equal(iv_after, iv_jax)
+    pt, _ = nat.cbc(IV, ct, encrypt=False, nthreads=4)
+    np.testing.assert_array_equal(pt, MSG)
+
+
+def test_native_cfb128_streaming_resume():
+    nat = NativeAES(KEY[128])
+    jx = AES(KEY[128], engine="jnp")
+    expect, _, _ = jx.crypt_cfb128(AES_ENCRYPT, 0, IV, ODD[:1000])
+    one, off, ivf = nat.cfb128(0, IV, ODD[:1000], encrypt=True)
+    np.testing.assert_array_equal(one, expect)
+    # chunked across a block seam == one-shot
+    p1, off1, iv1 = nat.cfb128(0, IV, ODD[:7], encrypt=True)
+    p2, _, _ = nat.cfb128(off1, iv1, ODD[7:1000], encrypt=True)
+    np.testing.assert_array_equal(np.concatenate([p1, p2]), expect)
+
+
+def test_native_arc4_matches_jax():
+    ks_nat = NativeARC4(b"parity-key").prep(4096)
+    ks_jax = ARC4(b"parity-key").prep(4096)
+    np.testing.assert_array_equal(ks_nat, ks_jax)
+
+
+def test_native_arc4_rescorla_vector():
+    rc = NativeARC4(bytes.fromhex("0123456789abcdef"))
+    out = rc.crypt(np.frombuffer(bytes.fromhex("0123456789abcdef"), np.uint8),
+                   rc.prep(8), nthreads=2)
+    assert out.tobytes().hex() == "75b7878099e0c596"
+
+
+def test_native_rejects_bad_key():
+    with pytest.raises(ValueError):
+        NativeAES(b"short")
+
+
+def test_c_backend_protocol_end_to_end():
+    b = CBackend()
+    ctx = b.make_key(KEY[128])
+    data = b.stage_words(MSG)
+    out1 = b.ecb(ctx, data, 1)
+    out4 = b.ecb(ctx, data, 4)
+    np.testing.assert_array_equal(out1, out4)
+    jx = AES(KEY[128], engine="jnp")
+    np.testing.assert_array_equal(out1, jx.crypt_ecb(AES_ENCRYPT, MSG))
