@@ -1,15 +1,31 @@
 """our_tree_tpu — a TPU-native parallel symmetric-cryptography framework.
 
-Built from scratch in JAX/XLA/Pallas toward the capabilities of the reference
-repo maleiwhat/Our-Tree (see SURVEY.md). Implemented so far: AES-128/192/256
-in ECB/CBC/CFB128/CTR modes with byte-granular streaming resume, and the ARC4
-stream cipher with its split keystream/XOR phases — all bit-exact against the
-reference's portable C implementation. In progress (SURVEY.md §7): multi-chip
-sharding (parallel/), native C++ CPU backend (runtime/), benchmark harness and
-CSV-results surface (harness/), and the bitsliced/Pallas TPU fast paths (ops/).
+Built from scratch in JAX/XLA/Pallas with the capabilities of the reference
+repo maleiwhat/Our-Tree (see SURVEY.md for the full component map):
+
+- AES-128/192/256 in ECB/CBC/CFB128/CTR with byte-granular streaming resume
+  (models/aes.py), bit-exact against the reference's portable C oracle.
+- Three compute engines behind one registry: "jnp" T-table gathers
+  (correctness core), "bitslice" bit-plane boolean circuit, and "pallas"
+  VMEM-tiled TPU kernels (ops/).
+- ARC4 with the reference's split keystream/XOR phases (models/arc4.py) and
+  the fused single-pass variant (models/rc4.py).
+- Multi-chip sharding over a 1-D mesh with per-shard CTR counter offsets
+  (parallel/).
+- A native C runtime with pthread-parallel bulk ops and ctypes bindings
+  (runtime/), and a unified benchmark harness + hex CLI emitting the
+  reference's CSV results format (harness/).
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
-from .models.aes import AES, AES_DECRYPT, AES_ENCRYPT  # noqa: F401
+from .models.aes import (  # noqa: F401
+    AES,
+    AES_DECRYPT,
+    AES_ENCRYPT,
+    CORES,
+    register_core,
+    resolve_engine,
+)
 from .models.arc4 import ARC4  # noqa: F401
+from .models.rc4 import RC4  # noqa: F401

@@ -1,0 +1,6 @@
+"""Cipher models: AES contexts/modes, phase-split ARC4, fused RC4."""
+
+from .aes import AES, AES_DECRYPT, AES_ENCRYPT  # noqa: F401
+from .base import DIR_BOTH, DIR_DECRYPT, DIR_ENCRYPT, AESCipher, BlockCipher  # noqa: F401
+from .arc4 import ARC4  # noqa: F401
+from .rc4 import RC4  # noqa: F401

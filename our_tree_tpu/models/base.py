@@ -1,0 +1,109 @@
+"""Abstract block-cipher interface — parity with the reference's C++ base
+class (BlockCipher.h:48-107).
+
+The reference's GPU backend defines an abstract `BlockCipher` (pure virtuals
+blockBits/blockSize/keyBits/keySize/makeKey/encrypt/decrypt, direction flags
+DIR_ENCRYPT/DIR_DECRYPT/DIR_BOTH at BlockCipher.h:31-46) with `AES` as its
+one subclass. This module is that interface's Python form, implemented by
+`AESCipher` over the framework's engine-selectable contexts; a second cipher
+family would subclass `BlockCipher` the same way the reference intended.
+
+The reference's byte2int/int2byte conversion virtuals are replaced by the
+framework-wide packing convention (utils/packing.py) rather than per-cipher
+methods — one byte-order decision for the whole framework (SURVEY.md §7
+layer 1) instead of one per backend, which is exactly how the reference's
+two backends ended up with conflicting endianness (aes.c LE vs AES.cu BE).
+"""
+
+from __future__ import annotations
+
+import abc
+
+import numpy as np
+
+#: Direction flags, values as in the reference (BlockCipher.h:31-46).
+DIR_ENCRYPT = 1
+DIR_DECRYPT = 2
+DIR_BOTH = DIR_ENCRYPT | DIR_DECRYPT
+
+
+class BlockCipher(abc.ABC):
+    """A keyed block cipher over n-block byte buffers."""
+
+    @property
+    @abc.abstractmethod
+    def block_bits(self) -> int: ...
+
+    @property
+    def block_size(self) -> int:
+        return self.block_bits // 8
+
+    @property
+    @abc.abstractmethod
+    def key_bits(self) -> int: ...
+
+    @property
+    def key_size(self) -> int:
+        return self.key_bits // 8
+
+    @abc.abstractmethod
+    def make_key(self, key: bytes, direction: int = DIR_BOTH) -> None:
+        """Install a key for the given direction(s) (makeKey,
+        BlockCipher.h:74-83)."""
+
+    @abc.abstractmethod
+    def encrypt(self, data) -> np.ndarray:
+        """Bulk-encrypt a multiple of block_size bytes."""
+
+    @abc.abstractmethod
+    def decrypt(self, data) -> np.ndarray:
+        """Bulk-decrypt a multiple of block_size bytes."""
+
+
+class AESCipher(BlockCipher):
+    """The framework's AES behind the BlockCipher interface.
+
+    `engine` selects the compute core ("auto"/"jnp"/"bitslice"/"pallas");
+    the reference's analogue of this choice was picking a build directory.
+    """
+
+    def __init__(self, key: bytes | None = None, engine: str = "auto"):
+        self._engine = engine
+        self._ctx = None
+        self._direction = 0
+        if key is not None:
+            self.make_key(key)
+
+    @property
+    def block_bits(self) -> int:
+        return 128
+
+    @property
+    def key_bits(self) -> int:
+        if self._ctx is None:
+            raise ValueError("no key installed")
+        return len(self._ctx.key) * 8
+
+    def make_key(self, key: bytes, direction: int = DIR_BOTH) -> None:
+        from .aes import AES
+
+        self._ctx = AES(bytes(key), engine=self._engine)
+        self._direction = direction
+
+    def _require(self, direction: int):
+        if self._ctx is None:
+            raise ValueError("no key installed")
+        if not (self._direction & direction):
+            raise ValueError("key not installed for this direction")
+
+    def encrypt(self, data) -> np.ndarray:
+        from .aes import AES_ENCRYPT
+
+        self._require(DIR_ENCRYPT)
+        return self._ctx.crypt_ecb(AES_ENCRYPT, data)
+
+    def decrypt(self, data) -> np.ndarray:
+        from .aes import AES_DECRYPT
+
+        self._require(DIR_DECRYPT)
+        return self._ctx.crypt_ecb(AES_DECRYPT, data)

@@ -1,0 +1,74 @@
+"""Fused RC4 — the single-pass keystream+XOR variant.
+
+The reference carries a second RC4 implementation (FreeBSD-derived rc4.c/
+rc4.h) that is *dead code*: no Makefile builds it and its only call site is
+commented out (reference Makefile:25, test.c:158-171 — SURVEY.md §2 #7). It
+differs from arc4.c only in fusing keystream generation with the XOR, i.e.
+the classic `rc4_crypt(buf)` API.
+
+The framework keeps that API alive (completeness: component #7 of the
+inventory), expressed the TPU way: one `lax.scan` whose step emits the
+XORed byte directly, state carried exactly like the phase-split path. For
+throughput-critical use prefer models/arc4.py — its phase split is what
+makes the XOR phase data-parallel/shardable; this fused form is inherently
+one long scan.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .arc4 import key_schedule
+
+
+@functools.partial(jax.jit, static_argnums=())
+def _fused_scan(state, data_u32):
+    """state = (x, y, m) as uint32; data (N,) u32 in [0,256) -> XORed out."""
+
+    def step(carry, d):
+        x, y, m = carry
+        x = (x + 1) & 0xFF
+        a = m[x]
+        y = (y + a) & 0xFF
+        b = m[y]
+        m = m.at[x].set(b).at[y].set(a)
+        ks = m[(a + b) & 0xFF]
+        return (x, y, m), (d ^ ks).astype(jnp.uint8)
+
+    return jax.lax.scan(step, state, data_u32)
+
+
+@dataclass
+class RC4:
+    """Fused-API RC4 context: `crypt` consumes data and advances state."""
+
+    key: bytes
+
+    def __post_init__(self):
+        if len(self.key) == 0:
+            raise ValueError("RC4 key must be non-empty")
+        self.x = 0
+        self.y = 0
+        self.m = key_schedule(self.key)
+
+    def crypt(self, data) -> np.ndarray:
+        """Encrypt/decrypt `data` in one fused pass (rc4.c's API shape)."""
+        d = (
+            np.frombuffer(bytes(data), dtype=np.uint8)
+            if isinstance(data, (bytes, bytearray))
+            else np.asarray(data, dtype=np.uint8)
+        )
+        state = (
+            jnp.uint32(self.x),
+            jnp.uint32(self.y),
+            jnp.asarray(self.m, jnp.uint32),
+        )
+        (x, y, m), out = _fused_scan(state, jnp.asarray(d, jnp.uint32))
+        self.x, self.y = int(x), int(y)
+        self.m = np.asarray(m, dtype=np.uint8)
+        return np.asarray(out)

@@ -1,0 +1,2 @@
+"""Compute cores: GF(2^8) math, tables, key schedules, and the three block
+engines (T-table gather, bitsliced circuit, Pallas kernels)."""

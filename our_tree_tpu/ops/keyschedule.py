@@ -85,3 +85,94 @@ def expand_key_dec(key: bytes) -> tuple[int, np.ndarray]:
     # Final: the original first round key.
     dec[4 * nr : 4 * nr + 4] = enc[0:4]
     return nr, dec
+
+
+# ---------------------------------------------------------------------------
+# On-device expansion. The host numpy path above is the default (like the
+# reference, which expands keys on the host even for the GPU backend); this
+# scan exists for workloads that rekey on device — e.g. per-iteration rekey
+# sweeps — and to keep the whole pipeline traceable under jit.
+# ---------------------------------------------------------------------------
+
+
+def _device_schedule_consts(keybits: int):
+    """Static per-step wiring for the expansion scan (host, cached)."""
+    import numpy as _np
+
+    nr = ROUNDS[keybits]
+    nk = keybits // 32
+    nwords = 4 * (nr + 1)
+    steps = nwords - nk
+    is_rot = _np.zeros(steps, dtype=_np.uint32)
+    is_sub = _np.zeros(steps, dtype=_np.uint32)
+    rcon = _np.zeros(steps, dtype=_np.uint32)
+    for s in range(steps):
+        i = nk + s
+        if i % nk == 0:
+            is_rot[s] = 1
+            rcon[s] = RCON[i // nk - 1]
+        elif nk == 8 and i % nk == 4:
+            is_sub[s] = 1
+    return nr, nk, is_rot, is_sub, rcon
+
+
+def expand_key_enc_device(key_words, keybits: int):
+    """jit-traceable key expansion: (keybits/32,) u32 LE words -> (nr, rk).
+
+    Same recurrence as `expand_key_enc`, expressed as a `lax.scan` whose
+    carry is the last nk words (the whole sequential dependency).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    nr, nk, is_rot, is_sub, rcon = _device_schedule_consts(keybits)
+    sbox = jnp.asarray(SBOX.astype(np.uint32))
+
+    def sub_word(w):
+        return (
+            sbox[w & 0xFF]
+            | (sbox[(w >> 8) & 0xFF] << 8)
+            | (sbox[(w >> 16) & 0xFF] << 16)
+            | (sbox[w >> 24] << 24)
+        )
+
+    def step(carry, x):
+        rot_f, sub_f, rc = x
+        t = carry[-1]
+        rotated = (t >> 8) | (t << 24)
+        t = jnp.where(
+            rot_f, sub_word(rotated) ^ rc, jnp.where(sub_f, sub_word(t), t)
+        )
+        new = carry[0] ^ t
+        return jnp.concatenate([carry[1:], new[None]]), new
+
+    xs = (jnp.asarray(is_rot), jnp.asarray(is_sub), jnp.asarray(rcon))
+    carry0 = jnp.asarray(key_words, dtype=jnp.uint32)
+    _, tail = jax.lax.scan(step, carry0, xs)
+    return nr, jnp.concatenate([carry0, tail])
+
+
+def expand_key_dec_device(key_words, keybits: int):
+    """Device decryption schedule: reverse rounds + InvMixColumns interior."""
+    import jax.numpy as jnp
+
+    from . import gf as _gf
+
+    nr, enc = expand_key_enc_device(key_words, keybits)
+    m9, m11, m13, m14 = (
+        jnp.asarray(_gf.gmul_table(c)) for c in (9, 11, 13, 14)
+    )
+
+    def inv_mix(w):
+        b0, b1, b2, b3 = w & 0xFF, (w >> 8) & 0xFF, (w >> 16) & 0xFF, w >> 24
+        return (
+            (m14[b0] ^ m11[b1] ^ m13[b2] ^ m9[b3])
+            | ((m9[b0] ^ m14[b1] ^ m11[b2] ^ m13[b3]) << 8)
+            | ((m13[b0] ^ m9[b1] ^ m14[b2] ^ m11[b3]) << 16)
+            | ((m11[b0] ^ m13[b1] ^ m9[b2] ^ m14[b3]) << 24)
+        )
+
+    rounds = enc.reshape(nr + 1, 4)[::-1]  # reversed round order
+    interior = inv_mix(rounds[1:nr])
+    dec = jnp.concatenate([rounds[:1], interior, rounds[nr:]], axis=0)
+    return nr, dec.reshape(-1)

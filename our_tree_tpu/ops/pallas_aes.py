@@ -89,6 +89,8 @@ def _crypt_planes_pallas(planes, kp, *, nr, decrypt, tile):
 
 def _crypt_words(words, rk, nr, decrypt):
     n = words.shape[0]
+    if n == 0:
+        return words
     # Pad to whole 32-block lanes first, THEN pick the tile: choosing the
     # tile from the unpadded count can double the padded work for sizes
     # just under the tile span. This way padding never exceeds 31 blocks

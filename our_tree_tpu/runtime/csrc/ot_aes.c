@@ -11,6 +11,7 @@
  */
 #include "ot_crypt.h"
 
+#include <pthread.h>
 #include <string.h>
 
 /* ---------------------------------------------------------------- GF(2^8) */
@@ -29,9 +30,10 @@ static uint8_t gf_mul(uint8_t a, uint8_t b) {
     return r;
 }
 
-/* S-boxes generated once: S(x) = affine(x^254). */
+/* S-boxes generated once: S(x) = affine(x^254). pthread_once because
+ * ctypes callers drop the GIL, so two threads may race the first setkey. */
 static uint8_t SBOX[256], ISBOX[256];
-static int tables_ready = 0;
+static pthread_once_t tables_once = PTHREAD_ONCE_INIT;
 
 static void gen_tables(void) {
     for (int x = 0; x < 256; x++) {
@@ -52,13 +54,12 @@ static void gen_tables(void) {
         SBOX[x] = s;
         ISBOX[s] = (uint8_t)x;
     }
-    tables_ready = 1;
 }
 
 /* ------------------------------------------------------------ key schedule */
 
 int ot_aes_setkey(ot_aes_ctx *ctx, const uint8_t *key, int keybits) {
-    if (!tables_ready) gen_tables();
+    pthread_once(&tables_once, gen_tables);
     int nk;
     switch (keybits) {
         case 128: nk = 4;  ctx->nr = 10; break;

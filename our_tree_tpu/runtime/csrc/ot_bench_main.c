@@ -1,0 +1,176 @@
+/* ot_bench — the reference harness shape (test.c / aes-modes/test.c), one
+ * executable, two dispatch targets:
+ *
+ *   --backend=c    sweep the native runtime in-process (pthread workers,
+ *                  gettimeofday-style timing, CSV rows on stdout — the
+ *                  modern form of reference aes-modes/test.c:353-446).
+ *   --backend=tpu  embed CPython and hand the identical sweep arguments to
+ *                  our_tree_tpu.harness.bench — the "thin shim" by which
+ *                  the C harness calls the TPU path (BASELINE.json north
+ *                  star; the reference's GPU analogue was a separate nvcc
+ *                  binary, main_ecb_e.cu).
+ *
+ * CSV format matches the reference results corpus:
+ *   <name>, <bytes>, <threads>, t1, ..., tN,
+ *
+ * Build: make ot_bench (links libpython for the tpu dispatch).
+ */
+#include "ot_crypt.h"
+
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/time.h>
+
+#define MAX_LIST 16
+
+static long long now_us(void) {
+    struct timeval tv;
+    gettimeofday(&tv, NULL);
+    return (long long)tv.tv_sec * 1000000 + tv.tv_usec;
+}
+
+static int parse_list(const char *s, long long *out, int cap) {
+    int n = 0;
+    while (*s && n < cap) {
+        out[n++] = atoll(s);
+        const char *c = strchr(s, ',');
+        if (!c) break;
+        s = c + 1;
+    }
+    return n;
+}
+
+/* xorshift PRNG, seeded 1337 like the reference (test.c:131). */
+static unsigned long long rng_state = 1337;
+static unsigned char rng_byte(void) {
+    rng_state ^= rng_state << 13;
+    rng_state ^= rng_state >> 7;
+    rng_state ^= rng_state << 17;
+    return (unsigned char)rng_state;
+}
+
+static void fill_random(unsigned char *p, size_t n) {
+    for (size_t i = 0; i < n; i++) p[i] = rng_byte();
+}
+
+static void sweep_aes(const char *mode, size_t size, const long long *threads,
+                      int nthreads_cnt, int iters, int keybits) {
+    unsigned char *msg = malloc(size), *out = malloc(size);
+    unsigned char key[32], nonce[16];
+    if (!msg || !out) { fprintf(stderr, "alloc failed\n"); exit(1); }
+    fill_random(msg, size);
+    for (int t = 0; t < nthreads_cnt; t++) {
+        int nt = (int)threads[t];
+        printf("C AES-%d %s, %zu, %d, ", keybits, mode, size, nt);
+        for (int it = 0; it < iters; it++) {
+            fill_random(key, sizeof key);       /* per-iter rekey, test.c:301 */
+            ot_aes_ctx ctx;
+            if (ot_aes_setkey(&ctx, key, keybits) != 0) {
+                fprintf(stderr, "invalid --keybits=%d\n", keybits);
+                exit(1);
+            }
+            memset(nonce, 0xA5, sizeof nonce);
+            long long t0 = now_us();
+            if (strcmp(mode, "ECB") == 0)
+                ot_aes_ecb(&ctx, 1, msg, out, size / 16, nt);
+            else
+                ot_aes_ctr(&ctx, nonce, msg, out, size, nt);
+            printf("%lld, ", now_us() - t0);
+        }
+        printf("\n");
+    }
+    free(msg);
+    free(out);
+}
+
+static void sweep_rc4(size_t size, const long long *threads, int nthreads_cnt,
+                      int iters) {
+    unsigned char *msg = malloc(size), *out = malloc(size);
+    unsigned char *ks = malloc(size);
+    unsigned char key[16];
+    if (!msg || !out || !ks) { fprintf(stderr, "alloc failed\n"); exit(1); }
+    fill_random(msg, size);
+    for (int t = 0; t < nthreads_cnt; t++) {
+        int nt = (int)threads[t];
+        printf("RC4, %zu, %d, \n", size, nt);
+        fill_random(key, sizeof key);
+        ot_arc4_ctx ctx;
+        long long t0 = now_us();
+        ot_arc4_setup(&ctx, key, sizeof key);
+        ot_arc4_prep(&ctx, ks, size);           /* sequential phase, timed */
+        printf("Generated a new key in %lld, \n", now_us() - t0);
+        for (int it = 0; it < iters; it++) {
+            t0 = now_us();
+            ot_xor(msg, ks, out, size, nt);      /* parallel phase */
+            printf("%lld, ", now_us() - t0);
+        }
+        printf("\n");
+    }
+    free(msg); free(out); free(ks);
+}
+
+#ifdef OT_WITH_PYTHON
+#include <Python.h>
+
+static int dispatch_tpu(const char *sizes, const char *threads, int iters,
+                        int keybits, const char *modes) {
+    /* The thin shim: same sweep arguments, TPU execution. */
+    char code[1024];
+    snprintf(code, sizeof code,
+             "import sys\n"
+             "from our_tree_tpu.harness.bench import main\n"
+             "sys.exit(main(['--sizes-mb','%s','--workers','%s',"
+             "'--iters','%d','--keybits','%d','--modes','%s']))\n",
+             sizes, threads, iters, keybits, modes);
+    Py_Initialize();
+    int rc = PyRun_SimpleString(code);
+    if (Py_FinalizeEx() < 0) rc = 1;
+    return rc == 0 ? 0 : 1;
+}
+#endif
+
+int main(int argc, char **argv) {
+    const char *backend = "c", *sizes_s = "1,10,100,1000";
+    const char *threads_s = "1,2,4,8", *modes = "ecb,ctr,rc4";
+    int iters = 10, keybits = 256;
+    for (int i = 1; i < argc; i++) {
+        if (strncmp(argv[i], "--backend=", 10) == 0) backend = argv[i] + 10;
+        else if (strncmp(argv[i], "--sizes=", 8) == 0) sizes_s = argv[i] + 8;
+        else if (strncmp(argv[i], "--threads=", 10) == 0) threads_s = argv[i] + 10;
+        else if (strncmp(argv[i], "--iters=", 8) == 0) iters = atoi(argv[i] + 8);
+        else if (strncmp(argv[i], "--keybits=", 10) == 0) keybits = atoi(argv[i] + 10);
+        else if (strncmp(argv[i], "--modes=", 8) == 0) modes = argv[i] + 8;
+        else {
+            fprintf(stderr,
+                    "usage: ot_bench [--backend=c|tpu] [--sizes=MB,..]\n"
+                    "                [--threads=N,..] [--iters=N]\n"
+                    "                [--keybits=128|192|256] [--modes=ecb,ctr,rc4]\n");
+            return 1;
+        }
+    }
+
+    if (strcmp(backend, "tpu") == 0) {
+#ifdef OT_WITH_PYTHON
+        return dispatch_tpu(sizes_s, threads_s, iters, keybits, modes);
+#else
+        fprintf(stderr, "ot_bench built without python embedding; "
+                        "rebuild with `make ot_bench`\n");
+        return 1;
+#endif
+    }
+
+    long long sizes[MAX_LIST], threads[MAX_LIST];
+    int ns = parse_list(sizes_s, sizes, MAX_LIST);
+    int nt = parse_list(threads_s, threads, MAX_LIST);
+    int do_ecb = strstr(modes, "ecb") != NULL;
+    int do_ctr = strstr(modes, "ctr") != NULL;
+    int do_rc4 = strstr(modes, "rc4") != NULL;
+    for (int s = 0; s < ns; s++) {
+        size_t bytes = (size_t)sizes[s] << 20;
+        if (do_ecb) sweep_aes("ECB", bytes, threads, nt, iters, keybits);
+        if (do_ctr) sweep_aes("CTR", bytes, threads, nt, iters, keybits);
+        if (do_rc4) sweep_rc4(bytes, threads, nt, iters);
+    }
+    return 0;
+}

@@ -34,13 +34,15 @@ class Arc4Ctx(ctypes.Structure):
 
 
 def _build() -> None:
-    srcs = list(_CSRC.glob("*.c")) + [_CSRC / "ot_crypt.h", _CSRC / "Makefile"]
+    srcs = [_CSRC / n for n in ("ot_aes.c", "ot_arc4.c", "ot_parallel.c",
+                                 "ot_crypt.h", "Makefile")]
     if _LIB_PATH.exists() and all(
         _LIB_PATH.stat().st_mtime >= s.stat().st_mtime for s in srcs
     ):
         return
     proc = subprocess.run(
-        ["make", "-C", str(_CSRC)], capture_output=True, text=True
+        ["make", "-C", str(_CSRC), "libotcrypt.so"],  # bindings need only
+        capture_output=True, text=True,               # the lib, not ot_bench
     )
     if proc.returncode != 0:
         raise RuntimeError(
@@ -142,6 +144,22 @@ class NativeAES:
         return out, off.value, iv
 
 
+def xor_parallel(data: np.ndarray, keystream: np.ndarray,
+                 nthreads: int = 1) -> np.ndarray:
+    """Thread-parallel XOR with the shape guard both ARC4 surfaces need: a
+    short keystream would read out of bounds in C (and XOR against padding
+    would pass tail plaintext through — see dist.xor_sharded)."""
+    data = np.ascontiguousarray(data, dtype=np.uint8)
+    keystream = np.ascontiguousarray(keystream, dtype=np.uint8)
+    if data.shape != keystream.shape:
+        raise ValueError(
+            f"data/keystream shape mismatch: {data.shape} vs {keystream.shape}"
+        )
+    out = np.empty_like(data)
+    load().ot_xor(data, keystream, out, data.size, nthreads)
+    return out
+
+
 class NativeARC4:
     def __init__(self, key: bytes):
         if len(key) == 0:
@@ -158,13 +176,7 @@ class NativeARC4:
 
     def crypt(self, data: np.ndarray, keystream: np.ndarray,
               nthreads: int = 1) -> np.ndarray:
-        data = np.ascontiguousarray(data, dtype=np.uint8)
-        keystream = np.ascontiguousarray(keystream, dtype=np.uint8)
-        if data.shape != keystream.shape:
-            raise ValueError("data/keystream length mismatch")
-        out = np.empty_like(data)
-        self._lib.ot_xor(data, keystream, out, data.size, nthreads)
-        return out
+        return xor_parallel(data, keystream, nthreads)
 
 
 class CBackend:
@@ -219,14 +231,4 @@ class CBackend:
         return NativeARC4(key).prep(length)
 
     def arc4_crypt(self, data, ks, workers: int):
-        data = np.ascontiguousarray(data, dtype=np.uint8)
-        ks = np.ascontiguousarray(ks, dtype=np.uint8)
-        if data.shape != ks.shape:
-            # A short keystream would read out of bounds in C (and XOR
-            # against padding would pass tail plaintext through — see
-            # dist.xor_sharded's identical guard).
-            raise ValueError(f"data/keystream shape mismatch: "
-                             f"{data.shape} vs {ks.shape}")
-        out = np.empty_like(data)
-        load().ot_xor(data, ks, out, data.size, workers)
-        return out
+        return xor_parallel(data, ks, workers)

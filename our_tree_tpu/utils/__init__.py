@@ -1,0 +1,11 @@
+"""Byte/word packing helpers shared by every layer."""
+
+from .packing import (  # noqa: F401
+    bytes_to_hex,
+    byteswap32,
+    hex_to_bytes,
+    jnp_bytes_to_words,
+    jnp_words_to_bytes,
+    np_bytes_to_words,
+    np_words_to_bytes,
+)
