@@ -10,13 +10,15 @@ Baseline is the reference's best honest CPU number — AES-NI AES-256-CTR,
 
 Timing methodology: remote/async dispatch means `block_until_ready` can
 return before the work is done and a scalar readback carries a fixed
-round-trip cost, so K encrypt iterations are chained *inside* one jit (each
-iteration's input depends on the previous XOR-digest, preventing hoisting)
-and the reported time is the difference T(K) - T(1) — per-call overhead and
-the one-off reduction cancel exactly. The digest readback also forces real
-completion, which doubles as an end-of-run correctness guard against
-silently-skipped work (cf. the reference's unchecked CUDA launches,
-SURVEY.md §2 defect #4).
+round-trip cost, so K encrypt iterations are chained *inside* one jit and
+the reported time is the difference T(K) - T(1) — per-call overhead and
+the one-off reduction cancel exactly. Two subtleties make the chain real
+(see `chained` below): the carry perturbs the counter (a data-only carry
+lets XLA hoist the keystream — all the AES work — out of the loop) and
+the digest is a sum (an XOR-reduce over an even element count cancels the
+carry, leaving identical CSE-able iterations). The digest readback also
+forces real completion, an end-of-run guard against silently-skipped work
+(cf. the reference's unchecked CUDA launches, SURVEY.md §2 defect #4).
 
 Buffer size defaults per engine (16 MiB for the slow jnp-gather engine,
 256 MiB for the fast paths, capped at 64 MiB on CPU hosts) and is printed in
@@ -46,41 +48,75 @@ def main() -> None:
     from our_tree_tpu.utils import packing
 
     platform = jax.devices()[0].platform
-    engine = aes_mod.resolve_engine(os.environ.get("OT_BENCH_ENGINE", "auto"))
+    requested = os.environ.get("OT_BENCH_ENGINE", "probe")
+    iters = int(os.environ.get("OT_BENCH_ITERS", 5))
+
+    a = AES(bytes(range(16)))  # AES-128
+    nonce = np.frombuffer(bytes.fromhex("f0f1f2f3f4f5f6f7f8f9fafbfcfdfeff"), np.uint8)
+    ctr_be = jax.device_put(jnp.asarray(packing.np_bytes_to_words(nonce).byteswap()))
+
+    def measure(engine, nbytes, iters):
+        # Fresh rng per measurement: the digest is only a cross-run
+        # correctness guard if identical (engine, size) configs see
+        # identical buffers, regardless of how many probes ran before.
+        host = np.random.default_rng(1337).integers(0, 256, nbytes, dtype=np.uint8)
+        words = jax.device_put(
+            jnp.asarray(packing.np_bytes_to_words(host).reshape(-1, 4))
+        )
+        ctr_fn = aes_mod.ctr_crypt_fn(a.nr, engine=engine)
+
+        @functools.partial(jax.jit, static_argnums=(3,))
+        def chained(words, ctr_be, rk, k):
+            def body(_, acc):
+                # The carry must perturb the COUNTER, not the data: in CTR
+                # the expensive work (the keystream) depends only on the
+                # counter, so a data-only dependency lets XLA hoist the
+                # whole AES computation out of the loop. A SUM digest (not
+                # XOR) keeps the carry alive through the reduction — an
+                # XOR-reduce over an even element count cancels it, leaving
+                # identical CSE-able iterations.
+                out = ctr_fn(words, ctr_be ^ acc, rk)
+                return jnp.sum(out, dtype=jnp.uint32)
+            return jax.lax.fori_loop(0, k, body, jnp.uint32(0))
+
+        def run(k):
+            t0 = time.perf_counter()
+            digest = int(chained(words, ctr_be, a.rk_enc, k))  # readback = barrier
+            return time.perf_counter() - t0, digest
+
+        run(1)          # compile k=1
+        run(1 + iters)  # compile k=1+iters
+        t1 = min(run(1)[0] for _ in range(2))
+        (tk, digest), (tk2, _) = run(1 + iters), run(1 + iters)
+        tk = min(tk, tk2)  # a single hiccup in the long run would skew GB/s
+        return iters * nbytes / max(tk - t1, 1e-9) / 1e9, digest
+
+    # Engine choice: explicit via OT_BENCH_ENGINE, else probe the registered
+    # throughput engines on a small buffer and run the headline measurement
+    # on the fastest — self-tuning beats guessing which formulation a given
+    # generation's VPU/Mosaic compiler prefers.
+    if requested == "probe" and platform != "cpu":
+        probes = {}
+        for eng in sorted(aes_mod.CORES, key=lambda e: e != "jnp"):
+            try:
+                probes[eng], _ = measure(eng, 4 << 20, 2)
+            except Exception as e:  # an engine failing to compile is data
+                print(f"# probe {eng}: failed ({type(e).__name__})",
+                      file=sys.stderr)
+        engine = max(probes, key=probes.get) if probes else "jnp"
+        print(f"# probe GB/s: " + ", ".join(
+            f"{k}={v:.2f}" for k, v in sorted(probes.items())), file=sys.stderr)
+    else:
+        engine = aes_mod.resolve_engine(
+            "auto" if requested == "probe" else requested
+        )
+
     default_bytes = 256 << 20 if engine != "jnp" else 16 << 20
     if platform == "cpu":
         default_bytes = min(default_bytes, 64 << 20)
     nbytes = int(os.environ.get("OT_BENCH_BYTES", default_bytes))
     nbytes -= nbytes % 16
-    iters = int(os.environ.get("OT_BENCH_ITERS", 5))
-
-    a = AES(bytes(range(16)))  # AES-128
-    rng = np.random.default_rng(1337)
-    host = rng.integers(0, 256, nbytes, dtype=np.uint8)
-    words = jax.device_put(jnp.asarray(packing.np_bytes_to_words(host).reshape(-1, 4)))
-    nonce = np.frombuffer(bytes.fromhex("f0f1f2f3f4f5f6f7f8f9fafbfcfdfeff"), np.uint8)
-    ctr_be = jax.device_put(jnp.asarray(packing.np_bytes_to_words(nonce).byteswap()))
-
-    ctr_fn = aes_mod.ctr_crypt_fn(a.nr, engine=engine)
-
-    @functools.partial(jax.jit, static_argnums=(3,))
-    def chained(words, ctr_be, rk, k):
-        def body(_, acc):
-            out = ctr_fn(words ^ acc, ctr_be, rk)
-            return jax.lax.reduce(out.ravel(), jnp.uint32(0), jax.lax.bitwise_xor, (0,))
-        return jax.lax.fori_loop(0, k, body, jnp.uint32(0))
-
-    def run(k):
-        t0 = time.perf_counter()
-        digest = int(chained(words, ctr_be, a.rk_enc, k))  # readback = real barrier
-        return time.perf_counter() - t0, digest
-
-    run(1)          # compile k=1
-    run(1 + iters)  # compile k=1+iters
-    t1 = min(run(1)[0] for _ in range(2))
-    (tk, digest), (tk2, _) = run(1 + iters), run(1 + iters)
-    tk = min(tk, tk2)  # a single hiccup in the long run would skew GB/s
-    gbps = iters * nbytes / max(tk - t1, 1e-9) / 1e9
+    gbps, digest = measure(engine, nbytes, iters)
 
     print(json.dumps({
         "metric": f"AES-128-CTR throughput, {nbytes >> 20} MiB buffer, "

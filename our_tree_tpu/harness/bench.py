@@ -209,6 +209,9 @@ def main(argv=None) -> int:
     ap.add_argument("--timing", default="e2e", choices=("e2e", "device"),
                     help="e2e includes host<->device staging (reference GPU "
                          "harness convention); device excludes it")
+    ap.add_argument("--profile", default=None, metavar="DIR",
+                    help="capture a jax.profiler trace of the sweep into DIR "
+                         "(tpu backend only)")
     ap.add_argument("--out", default=None,
                     help="also write results to this file "
                          "(e.g. results.$(hostname).tpu)")
@@ -238,6 +241,14 @@ def main(argv=None) -> int:
     modes = [m.strip() for m in args.modes.split(",") if m.strip()]
     rng = np.random.default_rng(args.seed)  # srand(1337) of the reference
 
+    profiler_cm = None
+    if args.profile and args.backend == "tpu":
+        import contextlib
+
+        import jax
+
+        profiler_cm = contextlib.ExitStack()
+        profiler_cm.enter_context(jax.profiler.trace(args.profile))
     try:
         for mode in modes:
             for size in sizes:
@@ -252,6 +263,8 @@ def main(argv=None) -> int:
         if "rc4" in modes:
             arc4_self_test(em)
     finally:
+        if profiler_cm is not None:
+            profiler_cm.close()
         em.close()
     return 0
 

@@ -56,14 +56,16 @@ def keystream_np(state: tuple[int, int, np.ndarray], length: int):
     return ks, (x, y, m.astype(np.uint8))
 
 
-@functools.partial(jax.jit, static_argnums=(1,))
-def keystream_scan(state, length: int):
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def keystream_scan(state, length: int, unroll: int = 8):
     """PRGA as an XLA scan. state = (x, y, m) with x,y uint32 scalars and m
     a (256,) uint32 permutation; returns ((x', y', m'), keystream uint8).
 
     One byte per scan step with two dynamic scatter updates — the honest
     sequential baseline, exactly as the reference's keygen loop is the
     sequential baseline there (arc4.c:82-91 at 0.037 GB/s, results.myth.1:38).
+    `unroll` inlines that many steps per scan iteration (SURVEY.md §7 hard
+    part #3's mitigation: amortise loop overhead over the recurrence).
     """
 
     def step(carry, _):
@@ -76,8 +78,20 @@ def keystream_scan(state, length: int):
         out = m[(a + b) & 0xFF]
         return (x, y, m), out.astype(jnp.uint8)
 
-    carry, ks = jax.lax.scan(step, state, None, length=length)
+    carry, ks = jax.lax.scan(step, state, None, length=length, unroll=unroll)
     return carry, ks
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def keystream_scan_batch(states, length: int, unroll: int = 8):
+    """Many independent keystreams at once: vmap over the stream axis.
+
+    The scan is inherently sequential *within* a stream; across streams it
+    is embarrassingly parallel — the batch axis fills the VPU lanes the way
+    CTR's counter axis does. states = (x, y, m) with shapes ((S,), (S,),
+    (S, 256)); returns ((x', y', m'), keystream (S, length) uint8).
+    """
+    return jax.vmap(lambda st: keystream_scan(st, length, unroll))(states)
 
 
 def crypt(data: jnp.ndarray, keystream: jnp.ndarray) -> jnp.ndarray:
@@ -105,6 +119,24 @@ class ARC4:
         (x, y, m), ks = keystream_scan(state, length)
         self.x, self.y = int(x), int(y)
         self.m = np.asarray(m, dtype=np.uint8)
+        return np.asarray(ks)
+
+    @staticmethod
+    def prep_batch(keys: list[bytes], length: int) -> np.ndarray:
+        """Keystreams for many independent keys in one device call.
+
+        Multi-stream parallelism: sequence-level work that cannot be
+        parallelised within a stream scales across streams instead (the
+        batch axis is the parallel axis, like CTR's counter axis). Returns
+        (len(keys), length) uint8.
+        """
+        ms = np.stack([key_schedule(k) for k in keys]).astype(np.uint32)
+        states = (
+            jnp.zeros(len(keys), jnp.uint32),
+            jnp.zeros(len(keys), jnp.uint32),
+            jnp.asarray(ms),
+        )
+        _, ks = keystream_scan_batch(states, length)
         return np.asarray(ks)
 
     def crypt(self, data, keystream=None) -> np.ndarray:

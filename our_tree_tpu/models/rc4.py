@@ -40,7 +40,7 @@ def _fused_scan(state, data_u32):
         ks = m[(a + b) & 0xFF]
         return (x, y, m), (d ^ ks).astype(jnp.uint8)
 
-    return jax.lax.scan(step, state, data_u32)
+    return jax.lax.scan(step, state, data_u32, unroll=8)
 
 
 @dataclass

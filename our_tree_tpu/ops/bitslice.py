@@ -192,28 +192,50 @@ def inv_mixcolumns_planes(p: list) -> list:
 # ---------------------------------------------------------------------------
 
 
+def _transpose32(a: jnp.ndarray) -> jnp.ndarray:
+    """Transpose the 32x32 bit matrix held in axis -2 (length 32, u32 rows).
+
+    Log-time SWAR ladder (the classic masked-swap network): 5 stages of
+    half-word exchanges instead of materialising 8x-larger per-bit tensors.
+    LSB-first convention: out[i] bit t == in[t] bit i. Involution — applying
+    it twice is the identity — so the same function packs and unpacks.
+    """
+    j = 16
+    m = jnp.uint32(0x0000FFFF)
+    while j:
+        sh = a.shape
+        b = a.reshape(sh[:-2] + (32 // (2 * j), 2, j) + sh[-1:])
+        lo, hi = b[..., 0, :, :], b[..., 1, :, :]
+        t = (lo >> j ^ hi) & m
+        a = jnp.stack([lo ^ (t << j), hi ^ t], axis=-3).reshape(sh)
+        j >>= 1
+        m = m ^ (m << j)
+    return a
+
+
 def to_planes(words: jnp.ndarray) -> jnp.ndarray:
     """(N, 4) u32 LE words, N % 32 == 0  ->  (8, 16, N/32) u32 planes."""
     n = words.shape[0]
     w = n // 32
-    shifts = (jnp.arange(4, dtype=jnp.uint32) * 8)[None, None, :]
-    by = ((words[:, :, None] >> shifts) & 0xFF).reshape(n, 16)
-    bits = (by[None, :, :] >> jnp.arange(8, dtype=jnp.uint32)[:, None, None]) & 1
-    bits = bits.reshape(8, w, 32, 16)
-    lane = jnp.arange(32, dtype=jnp.uint32)[None, None, :, None]
-    return jnp.sum(bits << lane, axis=2, dtype=jnp.uint32).transpose(0, 2, 1)
+    # Column c of a 32-block group is a 32x32 bit matrix: row t = word c of
+    # block t, whose bit 8a+b is bit b of state byte 4c+a. Transposing gives
+    # row 8a+b = plane(byte 4c+a, bit b) with lane t = block t.
+    grouped = words.reshape(w, 32, 4)
+    tr = _transpose32(grouped)                       # (W, 32, 4)
+    planes = tr.transpose(1, 2, 0).reshape(4, 8, 4, w)   # (a, b, c, W)
+    return planes.transpose(1, 2, 0, 3).reshape(8, 16, w)
 
 
 def from_planes(planes: jnp.ndarray) -> jnp.ndarray:
     """(8, 16, W) u32 planes -> (32*W, 4) u32 LE words."""
     w = planes.shape[2]
-    lane = jnp.arange(32, dtype=jnp.uint32)[None, None, :, None]
-    bits = (planes.transpose(0, 2, 1)[:, :, None, :] >> lane) & 1
-    by = jnp.sum(bits << jnp.arange(8, dtype=jnp.uint32)[:, None, None, None],
-                 axis=0, dtype=jnp.uint32)          # (W, 32, 16)
-    by = by.reshape(w * 32, 4, 4)
-    sh = jnp.arange(4, dtype=jnp.uint32) * 8
-    return jnp.sum(by << sh[None, None, :], axis=2, dtype=jnp.uint32)
+    tr = (
+        planes.reshape(8, 4, 4, w)                   # (b, c, a, W)
+        .transpose(2, 0, 1, 3)                       # (a, b, c, W)
+        .reshape(32, 4, w)
+        .transpose(2, 0, 1)                          # (W, 32, 4)
+    )
+    return _transpose32(tr).reshape(32 * w, 4)
 
 
 def key_planes(rk: jnp.ndarray, nr: int) -> jnp.ndarray:

@@ -60,3 +60,13 @@ def test_crypt_roundtrip():
 def test_key_schedule_identity_permutation_property():
     m = key_schedule(b"\x00")
     assert sorted(m.tolist()) == list(range(256))
+
+
+def test_prep_batch_matches_single_streams():
+    from our_tree_tpu.models.arc4 import ARC4
+
+    keys = [b"stream-a", b"stream-b", b"stream-c-longer"]
+    batch = ARC4.prep_batch(keys, 512)
+    assert batch.shape == (3, 512)
+    for i, k in enumerate(keys):
+        np.testing.assert_array_equal(batch[i], ARC4(k).prep(512))
