@@ -33,10 +33,13 @@ from jax.experimental import pallas as pl
 
 from . import bitslice
 
+import os
+
 #: Lanes per grid step. (8, 16, 1024) u32 = 512 KiB per tile buffer; with
 #: input + output + circuit intermediates this sits comfortably inside the
 #: ~16 MiB of VMEM while keeping the lane dimension a multiple of 128.
-TILE = 1024
+#: OT_PALLAS_TILE overrides for on-hardware tuning without a code change.
+TILE = int(os.environ.get("OT_PALLAS_TILE", 1024))
 
 
 def _perm_stack(x: jnp.ndarray, idx) -> jnp.ndarray:
