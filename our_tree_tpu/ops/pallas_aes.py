@@ -40,6 +40,10 @@ import os
 #: ~16 MiB of VMEM while keeping the lane dimension a multiple of 128.
 #: OT_PALLAS_TILE overrides for on-hardware tuning without a code change.
 TILE = int(os.environ.get("OT_PALLAS_TILE", 1024))
+if TILE <= 0 or TILE % 128:
+    raise ValueError(
+        f"OT_PALLAS_TILE must be a positive multiple of 128, got {TILE}"
+    )
 
 
 def _perm_stack(x: jnp.ndarray, idx) -> jnp.ndarray:

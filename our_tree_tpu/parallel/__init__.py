@@ -2,6 +2,8 @@
 
 from .dist import (  # noqa: F401
     AXIS,
+    cbc_decrypt_sharded,
+    cfb128_decrypt_sharded,
     ctr_crypt_sharded,
     ecb_crypt_sharded,
     gather_for_verification,

@@ -182,3 +182,91 @@ def gather_for_verification(x, mesh: Mesh, axis: str = AXIS):
         check_vma=False,  # all_gather output is replicated; not inferred
     )
     return f(padded)[:n]
+
+
+# ---------------------------------------------------------------------------
+# Sequence-parallel chained modes: boundary exchange over ICI.
+#
+# CBC/CFB decryption recurrences read only *ciphertext*: plaintext block i
+# needs ciphertext blocks i and i-1. Sharded over blocks, each shard needs
+# exactly one block from its left neighbour — a halo exchange, the same
+# communication pattern ring-attention uses for KV blocks, here one
+# `ppermute` hop of 16 bytes per shard. This is the framework's one genuinely
+# collective-dependent kernel (everything else is embarrassingly parallel;
+# SURVEY.md §2 "Distributed communication backend").
+# ---------------------------------------------------------------------------
+
+
+def _shift_right_one(x, axis, mesh_size):
+    """Each shard receives its left neighbour's value; shard 0 gets zeros."""
+    perm = [(i, i + 1) for i in range(mesh_size - 1)]
+    return jax.lax.ppermute(x, axis, perm)
+
+
+def _halo_prev_stream(words, iv, axis, n_shards):
+    """The prev-ciphertext stream for a chained-mode shard: local shift,
+    seam block from the left neighbour via one ppermute hop, IV on shard 0."""
+    seam = _shift_right_one(words[-1], axis, n_shards)
+    first_prev = jnp.where(jax.lax.axis_index(axis) == 0, iv, seam)
+    return jnp.concatenate([first_prev[None], words[:-1]], axis=0)
+
+
+def _cbc_combine(words, prev, rk_dec, nr, engine):
+    return CORES[engine][1](words, rk_dec, nr) ^ prev
+
+
+def _cfb_combine(words, prev, rk_enc, nr, engine):
+    return words ^ CORES[engine][0](prev, rk_enc, nr)
+
+
+_CHAIN_COMBINE = {"cbc": _cbc_combine, "cfb128": _cfb_combine}
+
+
+@functools.partial(jax.jit, static_argnames=("nr", "mesh", "axis", "engine", "mode"))
+def _chained_dec_sharded_jit(words, iv, rk, *, nr, mesh, axis, engine, mode):
+    combine = _CHAIN_COMBINE[mode]
+
+    def body(words, iv, rk):
+        prev = _halo_prev_stream(words, iv, axis, mesh.devices.size)
+        return combine(words, prev, rk, nr, engine)
+
+    f = jax.shard_map(
+        body, mesh=mesh, in_specs=(P(axis), P(), P()), out_specs=P(axis)
+    )
+    return f(words, iv, rk)
+
+
+def _chained_dec_sharded(words, iv_words, rk, nr, mesh, axis, engine, mode):
+    n = words.shape[0]
+    n_shards = mesh.devices.size
+    if n == 0 or n % n_shards:
+        raise ValueError(
+            f"{mode.upper()} block count {n} must be nonzero and divide "
+            f"evenly over {n_shards} shards (chained modes cannot be "
+            "zero-padded)"
+        )
+    return _chained_dec_sharded_jit(
+        words, iv_words, rk, nr=nr, mesh=mesh, axis=axis,
+        engine=resolve_engine(engine), mode=mode,
+    )
+
+
+def cbc_decrypt_sharded(words, iv_words, rk_dec, nr, mesh: Mesh,
+                        axis: str = AXIS, engine: str = "auto"):
+    """CBC decrypt sharded over blocks with a one-block halo exchange.
+
+    Bit-identical to the single-chip cbc_decrypt_words for every shard
+    count. The block count must be nonzero and divide over the shards
+    (padding a chained mode would corrupt the recurrence, so short inputs
+    are rejected rather than padded).
+    """
+    return _chained_dec_sharded(words, iv_words, rk_dec, nr, mesh, axis,
+                                engine, "cbc")
+
+
+def cfb128_decrypt_sharded(words, iv_words, rk_enc, nr, mesh: Mesh,
+                           axis: str = AXIS, engine: str = "auto"):
+    """CFB128 decrypt sharded over blocks (keystream_i = E(C_{i-1}), so the
+    same one-block halo exchange makes decryption fully parallel)."""
+    return _chained_dec_sharded(words, iv_words, rk_enc, nr, mesh, axis,
+                                engine, "cfb128")

@@ -1,0 +1,92 @@
+"""Multi-host initialization: the DCN side of the distributed backend.
+
+The reference has no distributed communication layer at all (SURVEY.md §2
+— pthreads and PCIe only). This framework's scaling axis is the device
+mesh, and the same `shard_map` kernels in dist.py run unchanged whether
+the mesh spans one chip, one host's chips (ICI), or many hosts (DCN): XLA
+picks the transport per edge. The only multi-host-specific work is process
+bootstrap, which this module wraps.
+
+Usage, one call per process (all processes run the same program — SPMD):
+
+    from our_tree_tpu.parallel import multihost
+    multihost.initialize(coordinator="host0:8476",
+                         num_processes=N, process_id=i)
+    mesh = multihost.global_mesh()      # 1-D mesh over every chip anywhere
+    out  = dist.ctr_crypt_sharded(words, ctr_be, rk, nr, mesh)
+
+For CPU-only rehearsal without TPUs (the reference had no equivalent of
+testing multi-device without owning the hardware, SURVEY.md §4):
+
+    multihost.initialize(..., cpu_devices_per_process=4)
+
+spawns each process with 4 virtual CPU devices; an N-process run then
+exposes a 4N-device global mesh. tests/test_multihost.py drives a real
+2-process x 2-device rehearsal through `ctr_crypt_sharded` and checks
+bit-parity against the single-process result.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+import numpy as np
+
+
+def initialize(coordinator: str, num_processes: int, process_id: int,
+               cpu_devices_per_process: int | None = None) -> None:
+    """Join the distributed system. Call before any other jax use.
+
+    Args:
+      coordinator: "host:port" of process 0's coordination service.
+      num_processes: total process count (one per host, typically).
+      process_id: this process's rank in [0, num_processes).
+      cpu_devices_per_process: if set, force the CPU platform with this many
+        virtual devices per process — the no-hardware rehearsal mode.
+    """
+    import jax
+
+    if cpu_devices_per_process is not None:
+        # Replace (not merely default) any inherited device-count flag: the
+        # caller is describing the rehearsal topology, and a stale count
+        # from e.g. a test runner would silently change the global mesh.
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+",
+            "",
+            os.environ.get("XLA_FLAGS", ""),
+        )
+        os.environ["XLA_FLAGS"] = (
+            flags
+            + f" --xla_force_host_platform_device_count={cpu_devices_per_process}"
+        ).strip()
+        jax.config.update("jax_platforms", "cpu")
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def global_mesh(axis: str = "shards"):
+    """A 1-D mesh over every device in the system (all hosts)."""
+    import jax
+    from jax.sharding import Mesh
+
+    return Mesh(np.asarray(jax.devices()), (axis,))
+
+
+def host_local_to_global(arr, mesh, axis: str = "shards"):
+    """Assemble a globally-sharded array from per-host local shards.
+
+    Each process passes its own contiguous chunk (equal sizes); the result
+    is one global jax.Array block-sharded over `mesh` — the multi-host
+    version of the scatter the reference did with pointer arithmetic
+    (test.c:51-53).
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharding = NamedSharding(mesh, P(axis))
+    return jax.make_array_from_process_local_data(sharding, np.asarray(arr))

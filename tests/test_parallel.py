@@ -102,3 +102,43 @@ def test_gather_for_verification():
     mesh = make_mesh(8)
     out = gather_for_verification(w, mesh)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(w))
+
+
+def test_cbc_decrypt_sharded_halo_parity():
+    """Sharded CBC decrypt (one-block ppermute halo) == single-chip path."""
+    from our_tree_tpu.models import aes as aes_mod
+    from our_tree_tpu.parallel import cbc_decrypt_sharded, make_mesh
+
+    rng = np.random.default_rng(31)
+    a = AES(rng.integers(0, 256, 32, np.uint8).tobytes(), engine="jnp")
+    words = jnp.asarray(rng.integers(0, 2**32, (64, 4)).astype(np.uint32))
+    iv = jnp.asarray(rng.integers(0, 2**32, 4).astype(np.uint32))
+    ref, _ = aes_mod.cbc_decrypt_words(words, iv, a.rk_dec, a.nr)
+    for n_dev in (2, 8):
+        mesh = make_mesh(n_dev)
+        out = cbc_decrypt_sharded(words, iv, a.rk_dec, a.nr, mesh)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_cfb_decrypt_sharded_halo_parity():
+    from our_tree_tpu.models import aes as aes_mod
+    from our_tree_tpu.parallel import cfb128_decrypt_sharded, make_mesh
+
+    rng = np.random.default_rng(32)
+    a = AES(rng.integers(0, 256, 16, np.uint8).tobytes(), engine="jnp")
+    words = jnp.asarray(rng.integers(0, 2**32, (40, 4)).astype(np.uint32))
+    iv = jnp.asarray(rng.integers(0, 2**32, 4).astype(np.uint32))
+    ref, _ = aes_mod.cfb128_decrypt_words(words, iv, a.rk_enc, a.nr)
+    mesh = make_mesh(8)
+    out = cfb128_decrypt_sharded(words, iv, a.rk_enc, a.nr, mesh)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_chained_sharded_rejects_indivisible():
+    from our_tree_tpu.parallel import cbc_decrypt_sharded, make_mesh
+
+    a = AES(bytes(range(16)), engine="jnp")
+    words = jnp.zeros((13, 4), jnp.uint32)
+    iv = jnp.zeros(4, jnp.uint32)
+    with pytest.raises(ValueError, match="divide evenly"):
+        cbc_decrypt_sharded(words, iv, a.rk_dec, a.nr, make_mesh(8))
