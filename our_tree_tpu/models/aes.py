@@ -129,7 +129,9 @@ def cbc_encrypt_words(words, iv_words, rk, nr):
         c = block.encrypt_words(p ^ iv, rk, nr)
         return c, c
 
-    iv_out, out = jax.lax.scan(step, iv_words, words)
+    # unroll amortises per-step scan overhead over the unavoidable
+    # block-to-block dependency (SURVEY.md §7 hard part #3).
+    iv_out, out = jax.lax.scan(step, iv_words, words, unroll=4)
     return out, iv_out
 
 
@@ -155,7 +157,7 @@ def cfb128_encrypt_words(words, iv_words, rk, nr):
         c = p ^ block.encrypt_words(iv, rk, nr)
         return c, c
 
-    iv_out, out = jax.lax.scan(step, iv_words, words)
+    iv_out, out = jax.lax.scan(step, iv_words, words, unroll=4)
     return out, iv_out
 
 

@@ -38,12 +38,26 @@ are drop-in `(words, rk, nr) -> words` cores behind `models.aes.CORES`.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from . import gf, tables
+
+#: Rounds inlined per scan step in the XLA path. >1 halves the scan-carry
+#: HBM round-trips at the cost of a larger compiled body; tune on hardware
+#: via env without a code change (the Pallas engine keeps all rounds in
+#: VMEM and doesn't use this).
+try:
+    ROUND_UNROLL = int(os.environ.get("OT_BITSLICE_UNROLL", 1))
+except ValueError as e:
+    raise ValueError(f"OT_BITSLICE_UNROLL must be an integer: {e}") from None
+if ROUND_UNROLL < 1:
+    raise ValueError(
+        f"OT_BITSLICE_UNROLL must be a positive integer, got {ROUND_UNROLL}"
+    )
 
 # ---------------------------------------------------------------------------
 # GF(2) linear-map derivation (numpy, import time).
@@ -292,7 +306,8 @@ def _crypt_planes(planes: jnp.ndarray, kp: jnp.ndarray, nr: int,
     planes = planes ^ kp[0]
     if nr > 1:
         planes, _ = jax.lax.scan(
-            lambda q, k: (round_fn(q, k, False), None), planes, kp[1:nr]
+            lambda q, k: (round_fn(q, k, False), None), planes, kp[1:nr],
+            unroll=ROUND_UNROLL,
         )
     return round_fn(planes, kp[nr], True)
 

@@ -203,10 +203,10 @@ def _shift_right_one(x, axis, mesh_size):
     return jax.lax.ppermute(x, axis, perm)
 
 
-def _halo_prev_stream(words, iv, axis, n_shards):
+def _halo_prev_stream(words, iv, axis, axis_size):
     """The prev-ciphertext stream for a chained-mode shard: local shift,
     seam block from the left neighbour via one ppermute hop, IV on shard 0."""
-    seam = _shift_right_one(words[-1], axis, n_shards)
+    seam = _shift_right_one(words[-1], axis, axis_size)
     first_prev = jnp.where(jax.lax.axis_index(axis) == 0, iv, seam)
     return jnp.concatenate([first_prev[None], words[:-1]], axis=0)
 
@@ -227,7 +227,7 @@ def _chained_dec_sharded_jit(words, iv, rk, *, nr, mesh, axis, engine, mode):
     combine = _CHAIN_COMBINE[mode]
 
     def body(words, iv, rk):
-        prev = _halo_prev_stream(words, iv, axis, mesh.devices.size)
+        prev = _halo_prev_stream(words, iv, axis, mesh.shape[axis])
         return combine(words, prev, rk, nr, engine)
 
     f = jax.shard_map(
@@ -238,12 +238,13 @@ def _chained_dec_sharded_jit(words, iv, rk, *, nr, mesh, axis, engine, mode):
 
 def _chained_dec_sharded(words, iv_words, rk, nr, mesh, axis, engine, mode):
     n = words.shape[0]
-    n_shards = mesh.devices.size
-    if n == 0 or n % n_shards:
+    if n == 0:  # no-op, matching the single-chip path (models/aes.py)
+        return words
+    n_shards = mesh.shape[axis]
+    if n % n_shards:
         raise ValueError(
-            f"{mode.upper()} block count {n} must be nonzero and divide "
-            f"evenly over {n_shards} shards (chained modes cannot be "
-            "zero-padded)"
+            f"{mode.upper()} block count {n} must divide evenly over "
+            f"{n_shards} shards (chained modes cannot be zero-padded)"
         )
     return _chained_dec_sharded_jit(
         words, iv_words, rk, nr=nr, mesh=mesh, axis=axis,

@@ -68,3 +68,17 @@ def test_decrypt_cli_rejects_bad_input(capsys):
     assert decrypt_mod.main(["zz", "00" * 16]) == 1
     assert decrypt_mod.main(["00" * 5, "00" * 16]) == 1
     assert decrypt_mod.main(["00" * 16, "00" * 15]) == 1
+
+
+def test_bench_c_backend_cli(tmp_path):
+    """The full sweep through the native C backend (--backend c)."""
+    out = tmp_path / "results.test.c"
+    rc = bench_mod.main([
+        "--backend", "c", "--sizes-mb", "0.0625", "--workers", "1,2",
+        "--iters", "2", "--modes", "ecb,ctr,rc4", "--out", str(out),
+    ])
+    assert rc == 0
+    lines = out.read_text().splitlines()
+    assert any(l.startswith("C AES-256 ECB, 65536, 2") for l in lines)
+    assert "Shard invariance [1, 2]: passed" in lines
+    assert "ARC4 test #3: passed" in lines

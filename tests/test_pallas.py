@@ -31,3 +31,19 @@ def test_pallas_matches_ttable(bits):
         np.asarray(aes_mod.ecb_decrypt_words(w, rkd, nr, "pallas")),
         np.asarray(aes_mod.ecb_decrypt_words(w, rkd, nr, "jnp")),
     )
+
+
+def test_pallas_engine_ctr_context():
+    """The pallas core through the CTR mode path and the AES context."""
+    import numpy as np
+
+    from our_tree_tpu.models.aes import AES
+
+    data = np.random.default_rng(9).integers(0, 256, 16 * 40 + 7, np.uint8)
+    nonce = np.arange(16, dtype=np.uint8)
+    outs = {}
+    for engine in ("jnp", "pallas"):
+        a = AES(bytes(range(16)), engine=engine)
+        outs[engine], *_ = a.crypt_ctr(0, nonce.copy(),
+                                       np.zeros(16, np.uint8), data)
+    np.testing.assert_array_equal(outs["jnp"], outs["pallas"])
