@@ -19,11 +19,14 @@ the digest is a sum (an XOR-reduce over an even element count cancels the
 carry, leaving identical CSE-able iterations). The digest readback also
 forces real completion, an end-of-run guard against silently-skipped work
 (cf. the reference's unchecked CUDA launches, SURVEY.md §2 defect #4).
+The iteration count K is a *traced* scalar, so each (engine, size) pair
+costs exactly one compile.
 
-Buffer size defaults per engine (16 MiB for the slow jnp-gather engine,
-256 MiB for the fast paths, capped at 64 MiB on CPU hosts) and is printed in
-the metric line; OT_BENCH_BYTES overrides. The 1 GiB reference message
-behaves identically — throughput is flat past ~64 MiB.
+Wall-clock is bounded: OT_BENCH_DEADLINE (default 1200 s) is checked
+before every compile-bearing stage; when the budget runs short the probe
+stage is cut and the best number measured so far is reported — the JSON
+line is always printed. OT_BENCH_BYTES / OT_BENCH_ENGINE / OT_BENCH_ITERS
+override the defaults.
 """
 
 from __future__ import annotations
@@ -31,15 +34,55 @@ from __future__ import annotations
 import functools
 import json
 import os
+import subprocess
 import sys
 import time
 
 import numpy as np
 
 BASELINE_GBPS = 0.520
+DEADLINE_S = float(os.environ.get("OT_BENCH_DEADLINE", 1200))
+INIT_TIMEOUT_S = float(os.environ.get("OT_BENCH_INIT_TIMEOUT", 240))
+_T0 = time.perf_counter()
+
+
+def _left() -> float:
+    return DEADLINE_S - (time.perf_counter() - _T0)
+
+
+def _ensure_live_backend() -> None:
+    """Probe accelerator-backend init in a THROWAWAY subprocess first.
+
+    A wedged device tunnel hangs inside PJRT client init — in-process
+    watchdog threads can't recover from that (the second jax.devices()
+    would block on the same backend lock), so the probe runs in a child
+    process. On timeout/failure the parent — which has not touched any
+    backend yet — switches to CPU so the benchmark still reports a line.
+    Skipped when CPU is already pinned: no tunnel is involved there, and
+    the probe would just double the startup cost.
+    """
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        return
+    try:
+        subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=INIT_TIMEOUT_S,
+            check=True,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+    except (subprocess.TimeoutExpired, subprocess.CalledProcessError) as e:
+        print(f"# accelerator init probe failed ({type(e).__name__}); "
+              "falling back to CPU", file=sys.stderr)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
 
 
 def main() -> None:
+    _ensure_live_backend()
+
     import jax
     import jax.numpy as jnp
 
@@ -65,7 +108,7 @@ def main() -> None:
         )
         ctr_fn = aes_mod.ctr_crypt_fn(a.nr, engine=engine)
 
-        @functools.partial(jax.jit, static_argnums=(3,))
+        @jax.jit
         def chained(words, ctr_be, rk, k):
             def body(_, acc):
                 # The carry must perturb the COUNTER, not the data: in CTR
@@ -74,18 +117,18 @@ def main() -> None:
                 # whole AES computation out of the loop. A SUM digest (not
                 # XOR) keeps the carry alive through the reduction — an
                 # XOR-reduce over an even element count cancels it, leaving
-                # identical CSE-able iterations.
+                # identical CSE-able iterations. k is traced: one compile
+                # serves every chain length.
                 out = ctr_fn(words, ctr_be ^ acc, rk)
                 return jnp.sum(out, dtype=jnp.uint32)
-            return jax.lax.fori_loop(0, k, body, jnp.uint32(0))
+            return jax.lax.fori_loop(jnp.uint32(0), k, body, jnp.uint32(0))
 
         def run(k):
             t0 = time.perf_counter()
-            digest = int(chained(words, ctr_be, a.rk_enc, k))  # readback = barrier
+            digest = int(chained(words, ctr_be, a.rk_enc, jnp.uint32(k)))
             return time.perf_counter() - t0, digest
 
-        run(1)          # compile k=1
-        run(1 + iters)  # compile k=1+iters
+        run(1)  # compile + warm-up (single executable for every k)
         t1 = min(run(1)[0] for _ in range(2))
         (tk, digest), (tk2, _) = run(1 + iters), run(1 + iters)
         tk = min(tk, tk2)  # a single hiccup in the long run would skew GB/s
@@ -94,32 +137,49 @@ def main() -> None:
     # Engine choice: explicit via OT_BENCH_ENGINE, else probe the registered
     # throughput engines on a small buffer and run the headline measurement
     # on the fastest — self-tuning beats guessing which formulation a given
-    # generation's VPU/Mosaic compiler prefers.
+    # generation's VPU/Mosaic compiler prefers. Probes stop early if the
+    # deadline budget runs short.
+    probes, probe_digests = {}, {}
     if requested == "probe" and platform != "cpu":
-        probes = {}
-        for eng in sorted(aes_mod.CORES, key=lambda e: e != "jnp"):
+        for eng in sorted(aes_mod.CORES, key=lambda e: e == "jnp"):
+            if _left() < 0.35 * DEADLINE_S:
+                print(f"# probe budget exhausted before {eng}", file=sys.stderr)
+                break
             try:
-                probes[eng], _ = measure(eng, 4 << 20, 2)
+                probes[eng], probe_digests[eng] = measure(eng, 4 << 20, 2)
             except Exception as e:  # an engine failing to compile is data
-                print(f"# probe {eng}: failed ({type(e).__name__})",
+                print(f"# probe {eng}: failed ({type(e).__name__}: {e})"[:500],
                       file=sys.stderr)
         engine = max(probes, key=probes.get) if probes else "jnp"
-        print(f"# probe GB/s: " + ", ".join(
+        print("# probe GB/s: " + ", ".join(
             f"{k}={v:.2f}" for k, v in sorted(probes.items())), file=sys.stderr)
     else:
         engine = aes_mod.resolve_engine(
             "auto" if requested == "probe" else requested
         )
 
-    default_bytes = 256 << 20 if engine != "jnp" else 16 << 20
+    default_bytes = 128 << 20 if engine not in ("jnp",) else 16 << 20
     if platform == "cpu":
         default_bytes = min(default_bytes, 64 << 20)
     nbytes = int(os.environ.get("OT_BENCH_BYTES", default_bytes))
     nbytes -= nbytes % 16
-    gbps, digest = measure(engine, nbytes, iters)
+
+    # Degraded fallback = the probe's own measurement, digest included (the
+    # digest is the guard against silently-skipped work; 0 would defeat it).
+    gbps, digest = probes.get(engine, 0.0), probe_digests.get(engine, 0)
+    measured_bytes = 4 << 20
+    if _left() > 0.25 * DEADLINE_S or not probes:
+        try:
+            gbps, digest = measure(engine, nbytes, iters)
+            measured_bytes = nbytes
+        except Exception as e:
+            print(f"# headline failed ({type(e).__name__}); "
+                  "reporting probe-size result", file=sys.stderr)
+            if not probes:
+                raise
 
     print(json.dumps({
-        "metric": f"AES-128-CTR throughput, {nbytes >> 20} MiB buffer, "
+        "metric": f"AES-128-CTR throughput, {measured_bytes >> 20} MiB buffer, "
                   f"1 {platform} device, engine={engine}, digest={digest:#010x}",
         "value": round(gbps, 4),
         "unit": "GB/s",

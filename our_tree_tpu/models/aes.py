@@ -52,9 +52,18 @@ AES_DECRYPT = 0
 
 CORES: dict[str, tuple] = {"jnp": (block.encrypt_words, block.decrypt_words)}
 
+#: Optional fused-CTR fast paths: (words, ctr_le_words, rk, nr) -> words,
+#: keeping the keystream on-chip instead of materialising it in HBM. Engines
+#: without an entry fall back to the layered keystream-then-XOR path. Both
+#: the single-device dispatcher (ctr_crypt_words) and the sharded one
+#: (parallel/dist.py:_ctr_shard_body) consult this registry.
+CTR_FUSED: dict[str, object] = {}
 
-def register_core(name: str, encrypt_fn, decrypt_fn) -> None:
+
+def register_core(name: str, encrypt_fn, decrypt_fn, ctr_fused_fn=None) -> None:
     CORES[name] = (encrypt_fn, decrypt_fn)
+    if ctr_fused_fn is not None:
+        CTR_FUSED[name] = ctr_fused_fn
 
 
 def resolve_engine(name: str | None = "auto") -> str:
@@ -119,6 +128,14 @@ def ctr_keystream_words(ctr_be_words, rk, nr, nblocks_idx, engine="jnp"):
 def ctr_crypt_words(words, ctr_be_words, rk, nr, engine="jnp"):
     n = words.shape[0]
     idx = jnp.arange(n, dtype=jnp.uint32)
+    fused = CTR_FUSED.get(engine)
+    if fused is not None:
+        # Fused kernel: the keystream never round-trips through HBM
+        # (e.g. ops/pallas_aes.py:ctr_crypt_words); counters are still
+        # materialised here so the 128-bit BE seam arithmetic stays in
+        # one place.
+        ctr_le = packing.byteswap32(_add_counter_be(ctr_be_words, idx))
+        return fused(words, ctr_le, rk, nr)
     ks = ctr_keystream_words(ctr_be_words, rk, nr, idx, engine)
     return words ^ ks
 
@@ -372,4 +389,5 @@ from ..ops import bitslice as _bitslice  # noqa: E402
 from ..ops import pallas_aes as _pallas_aes  # noqa: E402
 
 register_core("bitslice", _bitslice.encrypt_words, _bitslice.decrypt_words)
-register_core("pallas", _pallas_aes.encrypt_words, _pallas_aes.decrypt_words)
+register_core("pallas", _pallas_aes.encrypt_words, _pallas_aes.decrypt_words,
+              ctr_fused_fn=_pallas_aes.ctr_crypt_words)

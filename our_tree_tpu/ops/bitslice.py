@@ -108,6 +108,13 @@ REDUCE = np.array([gf.gpow(2, k) for k in range(15)], dtype=np.uint16)
 SR_PERM = np.array([4 * ((i // 4 + i % 4) % 4) + i % 4 for i in range(16)])
 ISR_PERM = np.array([4 * ((i // 4 - i % 4) % 4) + i % 4 for i in range(16)])
 
+#: MixColumns' row rotations as 16-byte-position permutations: ROT_PERM[k][i]
+#: = the byte position holding a_(r+k) of byte i's column, i.e. 4c + (r+k)%4.
+#: Lets a kernel express the column mix with the same leading-axis
+#: permutation primitive as ShiftRows — no reshape/roll inside Pallas.
+ROT_PERM = [np.array([4 * (i // 4) + (i % 4 + k) % 4 for i in range(16)])
+            for k in range(4)]
+
 
 # ---------------------------------------------------------------------------
 # Bit-plane circuit primitives. A "byte" is a list of 8 same-shaped uint32
@@ -180,9 +187,21 @@ def _flat(x: jnp.ndarray) -> jnp.ndarray:
     return x.reshape((16,) + x.shape[2:])
 
 
-def mixcolumns_planes(p: list) -> list:
+def mixcolumns_planes(p: list, perm=None) -> list:
     """out_r = 2·a_r + 3·a_(r+1) + a_(r+2) + a_(r+3) = xt(a_r ^ a_(r+1))
-    ^ (Σ_r a_r) ^ a_r, vectorised over the column axis."""
+    ^ (Σ_r a_r) ^ a_r, vectorised over the column axis.
+
+    With ``perm=None`` the rotations use reshape+roll (the cheap XLA
+    lowering); a kernel-safe ``perm(x, idx16)`` callable switches them to
+    leading-axis permutations (ROT_PERM) so Pallas/Mosaic sees only slices."""
+    if perm is not None:
+        a = p
+        b = [perm(x, ROT_PERM[1]) for x in p]
+        t = [a[i] ^ b[i] for i in range(8)]
+        xt = apply_linear(MAT_MUL[2], t)
+        tot = [a[i] ^ b[i] ^ perm(a[i], ROT_PERM[2]) ^ perm(a[i], ROT_PERM[3])
+               for i in range(8)]
+        return [xt[i] ^ tot[i] ^ a[i] for i in range(8)]
     a = [_cols(x) for x in p]
     b = [jnp.roll(x, -1, axis=1) for x in a]
     t = [a[i] ^ b[i] for i in range(8)]
@@ -192,8 +211,14 @@ def mixcolumns_planes(p: list) -> list:
     return [_flat(xt[i] ^ tot[i] ^ a[i]) for i in range(8)]
 
 
-def inv_mixcolumns_planes(p: list) -> list:
+def inv_mixcolumns_planes(p: list, perm=None) -> list:
     """out_r = 14·a_r + 11·a_(r+1) + 13·a_(r+2) + 9·a_(r+3) (FIPS-197 §5.3.3)."""
+    if perm is not None:
+        rolled = [p] + [[perm(x, ROT_PERM[k]) for x in p] for k in (1, 2, 3)]
+        terms = [apply_linear(MAT_MUL[c], r)
+                 for c, r in zip((14, 11, 13, 9), rolled)]
+        return [terms[0][i] ^ terms[1][i] ^ terms[2][i] ^ terms[3][i]
+                for i in range(8)]
     a = [_cols(x) for x in p]
     rolled = [a] + [[jnp.roll(x, -k, axis=1) for x in a] for k in (1, 2, 3)]
     terms = [apply_linear(MAT_MUL[c], r) for c, r in zip((14, 11, 13, 9), rolled)]
@@ -279,10 +304,11 @@ def _perm_take(x: jnp.ndarray, idx: np.ndarray) -> jnp.ndarray:
 def encrypt_round(planes: jnp.ndarray, kp: jnp.ndarray, last: bool,
                   perm=_perm_take) -> jnp.ndarray:
     """One forward round on stacked planes; kp = (8, 16, 1) key masks."""
+    mc_perm = None if perm is _perm_take else perm
     p = sbox_planes([planes[i] for i in range(8)])
     p = [perm(x, SR_PERM) for x in p]
     if not last:
-        p = mixcolumns_planes(p)
+        p = mixcolumns_planes(p, perm=mc_perm)
     return jnp.stack([p[i] ^ kp[i] for i in range(8)])
 
 
@@ -294,10 +320,11 @@ def decrypt_round(planes: jnp.ndarray, kp: jnp.ndarray, last: bool,
     the substitution runs first so the round ends in a gather, which keeps
     XLA-CPU from fusing the whole inversion circuit into a downstream
     consumer and exploding compile time), then InvMixColumns, then rk_dec."""
+    mc_perm = None if perm is _perm_take else perm
     p = inv_sbox_planes([planes[i] for i in range(8)])
     p = [perm(x, ISR_PERM) for x in p]
     if not last:
-        p = inv_mixcolumns_planes(p)
+        p = inv_mixcolumns_planes(p, perm=mc_perm)
     return jnp.stack([p[i] ^ kp[i] for i in range(8)])
 
 

@@ -51,36 +51,95 @@ def _perm_stack(x: jnp.ndarray, idx) -> jnp.ndarray:
     return jnp.stack([x[int(j)] for j in idx], axis=0)
 
 
-def _aes_kernel(kp_ref, in_ref, out_ref, *, nr: int, decrypt: bool):
-    # ShiftRows is always the stack-of-slices permutation here: Mosaic has
-    # no vector gather, and a pallas kernel may not capture the gather
-    # form's constant index arrays.
-    perm = _perm_stack
-    planes = in_ref[...]
+def _run_rounds(p, kp, nr: int, round_fn, interpret: bool):
+    """Whitened state -> state after the nr-1 middle rounds.
+
+    ShiftRows / MixColumns rotations inside kernels are always the
+    stack-of-slices permutation (_perm_stack): Mosaic has no vector gather,
+    and a pallas kernel may not capture the gather form's constant index
+    arrays — the traced body is only leading-axis slices, stacks, and u32
+    bit ops, the most conservative Mosaic feature set. Shared by the ECB
+    and fused-CTR kernels so the loop strategy cannot diverge between them.
+    """
+    if interpret:
+        # Interpreter mode (CPU tests): a fori_loop keeps the traced circuit
+        # at one round (~800 vector ops) — XLA-CPU compiles a 10x-unrolled
+        # graph pathologically slowly.
+        def body(r, q):
+            k = jax.lax.dynamic_index_in_dim(kp, r, axis=0, keepdims=False)
+            return round_fn(q, k, False, perm=_perm_stack)
+
+        return jax.lax.fori_loop(1, nr, body, p)
+    # Compiled: fully unrolled straight-line rounds with *static* key
+    # indexing, like the CUDA kernels' FULL_UNROLL (reference
+    # aes-gpu/Source/AES.cu:35,298-365) — no dynamic slicing for Mosaic
+    # to trip on, and the round keys fold into the instruction stream.
+    for r in range(1, nr):
+        p = round_fn(p, kp[r], False, perm=_perm_stack)
+    return p
+
+
+def _aes_kernel(kp_ref, in_ref, out_ref, *, nr: int, decrypt: bool,
+                interpret: bool):
     kp = kp_ref[...]
     round_fn = bitslice.decrypt_round if decrypt else bitslice.encrypt_round
-    p = planes ^ kp[0]
+    p = _run_rounds(in_ref[...] ^ kp[0], kp, nr, round_fn, interpret)
+    out_ref[...] = round_fn(p, kp[nr], True, perm=_perm_stack)
 
-    # Middle rounds as a fori_loop rather than straight-line unrolling: the
-    # loop keeps the traced circuit at one round (~800 vector ops), which
-    # Mosaic compiles quickly and — in interpreter mode on CPU — avoids
-    # handing XLA a 10x-unrolled graph it compiles pathologically slowly.
-    def body(r, q):
-        k = jax.lax.dynamic_index_in_dim(kp, r, axis=0, keepdims=False)
-        return round_fn(q, k, False, perm=perm)
 
-    p = jax.lax.fori_loop(1, nr, body, p)
-    out_ref[...] = round_fn(p, kp[nr], True, perm=perm)
+def _match_vma(x: jnp.ndarray, like: jnp.ndarray) -> jnp.ndarray:
+    """Promote x (e.g. replicated round keys) to `like`'s varying mesh axes.
+
+    Under `jax.shard_map(..., check_vma=True)` mixing a replicated value
+    into a shard-varying computation needs an explicit `pvary`; outside
+    shard_map both vma sets are empty and this is a no-op."""
+    try:
+        missing = jax.typeof(like).vma - jax.typeof(x).vma
+    except Exception:
+        return x
+    return jax.lax.pvary(x, tuple(missing)) if missing else x
+
+
+def _out_struct(x: jnp.ndarray) -> jax.ShapeDtypeStruct:
+    """Output spec matching x, carrying its varying-mesh-axes set.
+
+    Inside `jax.shard_map(..., check_vma=True)` a pallas_call must declare
+    which mesh axes its output varies over; mirroring the input's vma makes
+    the kernels usable both standalone and as shard_map bodies
+    (parallel/dist.py)."""
+    try:
+        vma = jax.typeof(x).vma
+    except Exception:
+        vma = None
+    return jax.ShapeDtypeStruct(x.shape, x.dtype, vma=vma)
 
 
 def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
+    """Interpreter mode unless a real TPU device is attached.
+
+    Checked against the *devices*, not `jax.default_backend()`: tunnelled
+    TPU platforms can register under a different backend name while the
+    device platform is still "tpu". OT_PALLAS_INTERPRET=0/1 overrides.
+    """
+    env = os.environ.get("OT_PALLAS_INTERPRET")
+    if env is not None:
+        return env not in ("0", "false", "")
+    try:
+        return not any(
+            d.platform == "tpu" or "TPU" in (d.device_kind or "")
+            for d in jax.devices()
+        )
+    except Exception:
+        return True
 
 
 @functools.partial(jax.jit, static_argnames=("nr", "decrypt", "tile"))
 def _crypt_planes_pallas(planes, kp, *, nr, decrypt, tile):
     w = planes.shape[2]
-    kernel = functools.partial(_aes_kernel, nr=nr, decrypt=decrypt)
+    interpret = _interpret()
+    kernel = functools.partial(
+        _aes_kernel, nr=nr, decrypt=decrypt, interpret=interpret
+    )
     return pl.pallas_call(
         kernel,
         grid=(w // tile,),
@@ -89,26 +148,35 @@ def _crypt_planes_pallas(planes, kp, *, nr, decrypt, tile):
             pl.BlockSpec((8, 16, tile), lambda i: (0, 0, i)),
         ],
         out_specs=pl.BlockSpec((8, 16, tile), lambda i: (0, 0, i)),
-        out_shape=jax.ShapeDtypeStruct(planes.shape, planes.dtype),
-        interpret=_interpret(),
+        out_shape=_out_struct(planes),
+        interpret=interpret,
     )(kp, planes)
+
+
+def _lane_pad_and_tile(n: int) -> tuple[int, int]:
+    """(pad_blocks, tile) for an n-block batch.
+
+    Pad to whole 32-block lanes first, THEN pick the tile: choosing the
+    tile from the unpadded count can double the padded work for sizes
+    just under the tile span. This way padding never exceeds 31 blocks
+    plus tile alignment on the lane axis. Shared by every pallas entry
+    point so the padding invariant cannot drift between them.
+    """
+    w_lanes = (n + 31) // 32
+    tile = min(TILE, w_lanes)
+    pad = 32 * ((w_lanes + tile - 1) // tile * tile) - n
+    return pad, tile
 
 
 def _crypt_words(words, rk, nr, decrypt):
     n = words.shape[0]
     if n == 0:
         return words
-    # Pad to whole 32-block lanes first, THEN pick the tile: choosing the
-    # tile from the unpadded count can double the padded work for sizes
-    # just under the tile span. This way padding never exceeds 31 blocks
-    # plus tile alignment on the lane axis.
-    w_lanes = (n + 31) // 32
-    tile = min(TILE, w_lanes)
-    pad = 32 * ((w_lanes + tile - 1) // tile * tile) - n
+    pad, tile = _lane_pad_and_tile(n)
     if pad:
         words = jnp.concatenate([words, jnp.zeros((pad, 4), words.dtype)], axis=0)
     planes = bitslice.to_planes(words)
-    kp = bitslice.key_planes(rk, nr)
+    kp = _match_vma(bitslice.key_planes(rk, nr), planes)
     out = _crypt_planes_pallas(planes, kp, nr=nr, decrypt=decrypt, tile=tile)
     return bitslice.from_planes(out)[:n]
 
@@ -121,3 +189,75 @@ def encrypt_words(words: jnp.ndarray, rk: jnp.ndarray, nr: int) -> jnp.ndarray:
 def decrypt_words(words: jnp.ndarray, rk_dec: jnp.ndarray, nr: int) -> jnp.ndarray:
     """Pallas-kernel batch decrypt (InvMixColumns-folded schedule)."""
     return _crypt_words(words, rk_dec, nr, decrypt=True)
+
+
+# ---------------------------------------------------------------------------
+# Fused CTR: encrypt the counter tile AND xor the data tile in one kernel.
+#
+# The layered CTR path (models/aes.py: keystream = engine_encrypt(counters);
+# out = data ^ keystream) writes the keystream to HBM, reads it back for the
+# XOR, and writes the output — three full-buffer HBM passes beyond the
+# unavoidable data read/out write. Keystream blocks never need to exist in
+# HBM at all: this kernel takes the counter planes and the data planes as
+# two inputs, runs the round pipeline on the counters in VMEM, xors the data
+# tile, and writes only the ciphertext tile (semantics per the reference's
+# CTR definition, aes-modes/aes.c:869-901: C = P ^ E(counter)).
+# ---------------------------------------------------------------------------
+
+
+def _ctr_kernel(kp_ref, ctr_ref, data_ref, out_ref, *, nr: int,
+                interpret: bool):
+    kp = kp_ref[...]
+    p = _run_rounds(ctr_ref[...] ^ kp[0], kp, nr, bitslice.encrypt_round,
+                    interpret)
+    ks = bitslice.encrypt_round(p, kp[nr], True, perm=_perm_stack)
+    out_ref[...] = data_ref[...] ^ ks
+
+
+@functools.partial(jax.jit, static_argnames=("nr", "tile"))
+def _ctr_planes_pallas(ctr_planes, data_planes, kp, *, nr, tile):
+    w = ctr_planes.shape[2]
+    interpret = _interpret()
+    kernel = functools.partial(_ctr_kernel, nr=nr, interpret=interpret)
+    spec = pl.BlockSpec((8, 16, tile), lambda i: (0, 0, i))
+    return pl.pallas_call(
+        kernel,
+        grid=(w // tile,),
+        in_specs=[
+            pl.BlockSpec((nr + 1, 8, 16, 1), lambda i: (0, 0, 0, 0)),
+            spec,
+            spec,
+        ],
+        out_specs=spec,
+        out_shape=_out_struct(ctr_planes),
+        interpret=interpret,
+    )(kp, ctr_planes, data_planes)
+
+
+def ctr_crypt_words(words: jnp.ndarray, ctr_le: jnp.ndarray, rk: jnp.ndarray,
+                    nr: int) -> jnp.ndarray:
+    """Fused CTR en/decrypt: words ^ E(counter blocks), keystream VMEM-only.
+
+    ``ctr_le`` is the (N, 4) u32 LE-word counter block stream (already
+    offset/byteswapped by the caller — models/aes.py owns the 128-bit BE
+    counter arithmetic). Symmetric, so it serves both directions.
+    """
+    n = words.shape[0]
+    if n == 0:
+        return words
+    pad, tile = _lane_pad_and_tile(n)
+    if pad:
+        zeros = jnp.zeros((pad, 4), words.dtype)
+        words = jnp.concatenate([words, zeros], axis=0)
+        ctr_le = jnp.concatenate([ctr_le, zeros], axis=0)
+    ctr_planes = bitslice.to_planes(ctr_le)
+    data_planes = _match_vma(bitslice.to_planes(words), ctr_planes)
+    ctr_planes = _match_vma(ctr_planes, data_planes)
+    out = _ctr_planes_pallas(
+        ctr_planes,
+        data_planes,
+        _match_vma(bitslice.key_planes(rk, nr), data_planes),
+        nr=nr,
+        tile=tile,
+    )
+    return bitslice.from_planes(out)[:n]

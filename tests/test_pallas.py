@@ -33,6 +33,28 @@ def test_pallas_matches_ttable(bits):
     )
 
 
+def test_pallas_fused_ctr_counter_carry():
+    """Fused CTR kernel (ops/pallas_aes.py:ctr_crypt_words) across a 32-bit
+    counter-word overflow: the low BE word wraps mid-batch, so the carry
+    ripple (reference aes-modes/aes.c:879-884 semantics) must agree with the
+    layered keystream path bit-for-bit."""
+    from our_tree_tpu.utils import packing
+
+    rng = np.random.default_rng(3)
+    nr, rk = expand_key_enc(bytes(range(16)))
+    rk = jnp.asarray(rk)
+    # Low word = 2^32 - 5: wraps after 5 of the 40 blocks.
+    nonce = np.frombuffer(
+        bytes(range(12)) + (2**32 - 5).to_bytes(4, "big"), dtype=np.uint8
+    )
+    ctr_be = jnp.asarray(packing.np_bytes_to_words(nonce).byteswap())
+    w = jnp.asarray(rng.integers(0, 2**32, (40, 4)).astype(np.uint32))
+    np.testing.assert_array_equal(
+        np.asarray(aes_mod.ctr_crypt_words(w, ctr_be, rk, nr, "pallas")),
+        np.asarray(aes_mod.ctr_crypt_words(w, ctr_be, rk, nr, "jnp")),
+    )
+
+
 def test_pallas_engine_ctr_context():
     """The pallas core through the CTR mode path and the AES context."""
     import numpy as np

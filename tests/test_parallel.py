@@ -60,6 +60,21 @@ def test_ctr_shard_invariance(nshards, nblocks):
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
 
 
+def test_ctr_sharded_fused_pallas_engine():
+    """engine="pallas" inside shard_map takes the fused-CTR kernel path
+    (CTR_FUSED registry) — regression for the vma/check_vma interaction of
+    pallas-interpret round loops under shard_map (parallel/dist.py)."""
+    a = AES(KEY[:16])
+    w = _words(16 * (32 * 8 + 3))  # uneven: exercises pad + per-shard tiles
+    ctr_be = jnp.asarray(
+        packing.np_bytes_to_words(np.frombuffer(bytes(range(16)), np.uint8)).byteswap()
+    )
+    ref = aes_mod.ctr_crypt_words(w, ctr_be, a.rk_enc, a.nr)
+    out = ctr_crypt_sharded(w, ctr_be, a.rk_enc, a.nr, make_mesh(8),
+                            engine="pallas")
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
 def test_ctr_shard_seam_counter_carry():
     """Counter must ripple across shard seams exactly as the byte-ripple
     increment of the oracle (aes.c:879-884): start the counter just below a
