@@ -114,14 +114,22 @@ def _add_counter_be(ctr_be: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
                       jnp.broadcast_to(s2, idx.shape), s3], axis=-1)
 
 
+def ctr_le_blocks(ctr_be_words, idx):
+    """Counter blocks counter0+idx as the (N, 4) u32 LE words the cipher
+    consumes. The ONE place the 128-bit BE seam arithmetic + byte-order
+    conversion lives — the fused and layered CTR paths and the sharded
+    dispatcher (parallel/dist.py) all call this, so they cannot drift.
+
+    The cipher consumes LE-packed words of the counter's byte stream; the
+    counter bytes are the BE words' bytes, so each word is byteswapped.
+    """
+    return packing.byteswap32(_add_counter_be(ctr_be_words, idx))
+
+
 @functools.partial(jax.jit, static_argnums=(2, 4))
 def ctr_keystream_words(ctr_be_words, rk, nr, nblocks_idx, engine="jnp"):
     """Keystream for blocks counter0+idx. ctr_be_words: (4,) u32 BE."""
-    ctr_blocks_be = _add_counter_be(ctr_be_words, nblocks_idx)
-    # The cipher consumes LE-packed words of the counter's byte stream; the
-    # counter bytes are the BE words' bytes, so each word is byteswapped.
-    ctr_le = packing.byteswap32(ctr_blocks_be)
-    return CORES[engine][0](ctr_le, rk, nr)
+    return CORES[engine][0](ctr_le_blocks(ctr_be_words, nblocks_idx), rk, nr)
 
 
 @functools.partial(jax.jit, static_argnums=(3, 4))
@@ -131,11 +139,8 @@ def ctr_crypt_words(words, ctr_be_words, rk, nr, engine="jnp"):
     fused = CTR_FUSED.get(engine)
     if fused is not None:
         # Fused kernel: the keystream never round-trips through HBM
-        # (e.g. ops/pallas_aes.py:ctr_crypt_words); counters are still
-        # materialised here so the 128-bit BE seam arithmetic stays in
-        # one place.
-        ctr_le = packing.byteswap32(_add_counter_be(ctr_be_words, idx))
-        return fused(words, ctr_le, rk, nr)
+        # (e.g. ops/pallas_aes.py:ctr_crypt_words).
+        return fused(words, ctr_le_blocks(ctr_be_words, idx), rk, nr)
     ks = ctr_keystream_words(ctr_be_words, rk, nr, idx, engine)
     return words ^ ks
 

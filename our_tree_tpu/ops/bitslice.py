@@ -64,12 +64,13 @@ if ROUND_UNROLL < 1:
 # ---------------------------------------------------------------------------
 
 
-def _linmat(f) -> np.ndarray:
-    """8x8 GF(2) matrix of a linear byte function: column j = f(1<<j)."""
-    m = np.zeros((8, 8), dtype=np.uint8)
-    for j in range(8):
+def _linmat(f, n: int = 8) -> np.ndarray:
+    """n x n GF(2) matrix of a linear function on n-bit values:
+    column j = f(1<<j). n=8 for byte maps, n=4 for the tower's nibble maps."""
+    m = np.zeros((n, n), dtype=np.uint8)
+    for j in range(n):
         v = f(1 << j)
-        for i in range(8):
+        for i in range(n):
             m[i, j] = (v >> i) & 1
     return m
 
@@ -117,6 +118,116 @@ ROT_PERM = [np.array([4 * (i // 4) + (i % 4 + k) % 4 for i in range(16)])
 
 
 # ---------------------------------------------------------------------------
+# Composite-field ("tower") S-box derivation. The straightforward inversion
+# x^254 costs 4 full GF(2^8) bitsliced multiplies (~64 ANDs + ~70 XORs each);
+# re-expressing GF(2^8) as GF(2^4)[x]/(x^2 + x + λ) turns inversion into a
+# handful of 4-bit field ops — (ax+b)^-1 = aΔ^-1·x + (a+b)Δ^-1 with
+# Δ = λa² + ab + b² — roughly a third of the vector-op count. This is the
+# hardware-S-box construction (Satoh/Canright lineage); everything below —
+# λ, the field isomorphism, every 4-bit linear map — is searched/derived
+# numerically from the field arithmetic at import time and pinned by the
+# exhaustive circuit tests, so no transcribed constants can be subtly wrong.
+# ---------------------------------------------------------------------------
+
+GF16_POLY = 0b10011  # w^4 + w + 1, irreducible over GF(2)
+
+
+def _gf16_mul(a: int, b: int) -> int:
+    r = 0
+    while b:
+        if b & 1:
+            r ^= a
+        b >>= 1
+        a <<= 1
+        if a & 0x10:
+            a ^= GF16_POLY
+    return r & 0xF
+
+
+def _pick_lambda() -> int:
+    """Smallest λ making x^2 + x + λ irreducible over GF(2^4) (no root)."""
+    for lam in range(1, 16):
+        if all(_gf16_mul(r, r) ^ r ^ lam for r in range(16)):
+            return lam
+    raise AssertionError("no irreducible x^2+x+λ over GF(2^4)")
+
+
+TOWER_LAMBDA = _pick_lambda()
+
+
+def _tower_mul(u: int, v: int) -> int:
+    """Multiply in GF(2^4)[x]/(x^2+x+λ); byte = (a<<4)|b for a·x+b."""
+    a, b, c, d = u >> 4, u & 0xF, v >> 4, v & 0xF
+    ac = _gf16_mul(a, c)
+    hi = _gf16_mul(a, d) ^ _gf16_mul(b, c) ^ ac          # x^2 -> +x
+    lo = _gf16_mul(b, d) ^ _gf16_mul(ac, TOWER_LAMBDA)   # x^2 -> +λ
+    return (hi << 4) | lo
+
+
+def _find_tower_iso() -> np.ndarray:
+    """8x8 GF(2) matrix φ with φ(uv) = φ(u)φ(v) into the tower field.
+
+    Built from discrete logs: g = 0x03 generates the AES field; for each
+    tower element h of order 255, the candidate φ(g^k) = h^k is linear iff
+    the matrix assembled from φ on the bit basis reproduces φ everywhere.
+    """
+    log = {}
+    v = 1
+    for k in range(255):
+        log[v] = k
+        v = gf.gmul(v, 0x03)
+    for h in range(2, 256):
+        powers = [1]
+        for _ in range(254):
+            powers.append(_tower_mul(powers[-1], h))
+        if len(set(powers)) != 255:
+            continue  # not a generator
+        phi = [0] * 256
+        for val, k in log.items():
+            phi[val] = powers[k]
+        m = np.zeros((8, 8), dtype=np.uint8)
+        for j in range(8):
+            img = phi[1 << j]
+            for i in range(8):
+                m[i, j] = (img >> i) & 1
+        ok = True
+        for x in range(256):
+            bits = np.array([(x >> j) & 1 for j in range(8)], dtype=np.uint8)
+            img_bits = (m @ bits) % 2
+            img = int(sum(int(img_bits[i]) << i for i in range(8)))
+            if img != phi[x]:
+                ok = False
+                break
+        if ok:
+            return m
+    raise AssertionError("no field isomorphism found")
+
+
+TOWER_ISO = _find_tower_iso()
+TOWER_ISO_INV = _gf2_inv(TOWER_ISO)
+
+#: Merged boundary maps: forward S-box = Aff∘inv_tower∘φ (+0x63 after);
+#: inverse S-box = φ⁻¹∘inv_tower∘φ∘Aff⁻¹ (0x63 xored before).
+M_SBOX_IN = TOWER_ISO
+M_SBOX_OUT = (MAT_AFF @ TOWER_ISO_INV) % 2
+M_ISBOX_IN = (TOWER_ISO @ MAT_AFF_INV) % 2
+M_ISBOX_OUT = TOWER_ISO_INV
+
+
+MAT_SQ4 = _linmat(lambda x: _gf16_mul(x, x), 4)
+MAT_LAMSQ4 = _linmat(lambda x: _gf16_mul(TOWER_LAMBDA, _gf16_mul(x, x)), 4)
+
+#: x^k mod (w^4+w+1) for the 4-bit schoolbook product's degree-6 terms.
+GF16_REDUCE = []
+for _k in range(7):
+    _v = 1
+    for _ in range(_k):
+        _v = _gf16_mul(_v, 2)
+    GF16_REDUCE.append(_v)
+GF16_REDUCE = np.array(GF16_REDUCE, dtype=np.uint8)
+
+
+# ---------------------------------------------------------------------------
 # Bit-plane circuit primitives. A "byte" is a list of 8 same-shaped uint32
 # arrays (LSB first); every op below is elementwise over those arrays, so the
 # same code runs inside jit, scan bodies, and Pallas kernels.
@@ -124,11 +235,15 @@ ROT_PERM = [np.array([4 * (i // 4) + (i % 4 + k) % 4 for i in range(16)])
 
 
 def apply_linear(mat: np.ndarray, p: list) -> list:
-    """y_i = XOR of p_j over j with mat[i, j] == 1 (static wiring, unrolled)."""
+    """y_i = XOR of p_j over j with mat[i, j] == 1 (static wiring, unrolled).
+
+    Works for any GF(2) matrix shape — 8×8 byte maps and the tower field's
+    4×4 nibble maps alike."""
+    rows, cols = mat.shape
     out = []
-    for i in range(8):
+    for i in range(rows):
         acc = None
-        for j in range(8):
+        for j in range(cols):
             if mat[i, j]:
                 acc = p[j] if acc is None else acc ^ p[j]
         out.append(acc if acc is not None else jnp.zeros_like(p[0]))
@@ -170,11 +285,64 @@ def gf_inv_planes(x: list) -> list:
     return gf_mul_planes(x252, x2)
 
 
+def gf16_mul_planes(a: list, b: list) -> list:
+    """Bitsliced GF(2^4) multiply: 16 ANDs + the derived 7-term reduction."""
+    c = [None] * 7
+    for i in range(4):
+        for j in range(4):
+            t = a[i] & b[j]
+            k = i + j
+            c[k] = t if c[k] is None else c[k] ^ t
+    out = []
+    for i in range(4):
+        acc = None
+        for k in range(7):
+            if (int(GF16_REDUCE[k]) >> i) & 1:
+                acc = c[k] if acc is None else acc ^ c[k]
+        out.append(acc)
+    return out
+
+
+def tower_inv_planes(p: list) -> list:
+    """GF(2^8) inversion in the tower basis: p = [b0..b3, a0..a3] for a·x+b.
+
+    (a·x + b)^-1 = aΔ^-1·x + (a+b)Δ^-1 with Δ = λa² + ab + b²; the 4-bit
+    inverse Δ^-1 = Δ^14 costs two gf16 multiplies (squarings are linear).
+    Total: 5 gf16 multiplies ≈ a third of the x^254 chain's vector ops.
+    """
+    b, a = p[:4], p[4:]
+    ab = gf16_mul_planes(a, b)
+    lam_a2 = apply_linear(MAT_LAMSQ4, a)
+    b2 = apply_linear(MAT_SQ4, b)
+    delta = [lam_a2[i] ^ ab[i] ^ b2[i] for i in range(4)]
+    d2 = apply_linear(MAT_SQ4, delta)
+    d4 = apply_linear(MAT_SQ4, d2)
+    d8 = apply_linear(MAT_SQ4, d4)
+    dinv = gf16_mul_planes(gf16_mul_planes(d8, d4), d2)
+    a_out = gf16_mul_planes(a, dinv)
+    b_out = gf16_mul_planes([a[i] ^ b[i] for i in range(4)], dinv)
+    return b_out + a_out
+
+
+#: S-box implementation: "tower" (composite field, default — fewest vector
+#: ops) or "chain" (the x^254 addition chain, kept as an independent
+#: formulation for cross-checking and benchmarking). OT_SBOX overrides.
+SBOX_IMPL = os.environ.get("OT_SBOX", "tower")
+if SBOX_IMPL not in ("tower", "chain"):
+    raise ValueError(f"OT_SBOX must be 'tower' or 'chain', got {SBOX_IMPL!r}")
+
+
 def sbox_planes(p: list) -> list:
+    if SBOX_IMPL == "tower":
+        t = tower_inv_planes(apply_linear(M_SBOX_IN, p))
+        return xor_const(apply_linear(M_SBOX_OUT, t), AFF_CONST)
     return xor_const(apply_linear(MAT_AFF, gf_inv_planes(p)), AFF_CONST)
 
 
 def inv_sbox_planes(p: list) -> list:
+    if SBOX_IMPL == "tower":
+        t = apply_linear(M_ISBOX_IN, xor_const(list(p), AFF_CONST))
+        return apply_linear(M_ISBOX_OUT, tower_inv_planes(t))
     return gf_inv_planes(apply_linear(MAT_AFF_INV, xor_const(list(p), AFF_CONST)))
 
 
@@ -193,22 +361,22 @@ def mixcolumns_planes(p: list, perm=None) -> list:
 
     With ``perm=None`` the rotations use reshape+roll (the cheap XLA
     lowering); a kernel-safe ``perm(x, idx16)`` callable switches them to
-    leading-axis permutations (ROT_PERM) so Pallas/Mosaic sees only slices."""
+    leading-axis permutations (ROT_PERM) so Pallas/Mosaic sees only slices.
+
+    Rotation count is minimised via t = a ^ rot1(a): the four-rotation sum
+    a ^ rot1(a) ^ rot2(a) ^ rot3(a) equals t ^ rot2(t), so one rot1 and one
+    rot2 suffice (out = xt(t) ^ t ^ rot2(t) ^ a)."""
     if perm is not None:
         a = p
-        b = [perm(x, ROT_PERM[1]) for x in p]
-        t = [a[i] ^ b[i] for i in range(8)]
+        t = [x ^ perm(x, ROT_PERM[1]) for x in p]
         xt = apply_linear(MAT_MUL[2], t)
-        tot = [a[i] ^ b[i] ^ perm(a[i], ROT_PERM[2]) ^ perm(a[i], ROT_PERM[3])
-               for i in range(8)]
-        return [xt[i] ^ tot[i] ^ a[i] for i in range(8)]
+        return [xt[i] ^ t[i] ^ perm(t[i], ROT_PERM[2]) ^ a[i]
+                for i in range(8)]
     a = [_cols(x) for x in p]
-    b = [jnp.roll(x, -1, axis=1) for x in a]
-    t = [a[i] ^ b[i] for i in range(8)]
+    t = [x ^ jnp.roll(x, -1, axis=1) for x in a]
     xt = apply_linear(MAT_MUL[2], t)
-    tot = [a[i] ^ b[i] ^ jnp.roll(a[i], -2, axis=1) ^ jnp.roll(a[i], -3, axis=1)
-           for i in range(8)]
-    return [_flat(xt[i] ^ tot[i] ^ a[i]) for i in range(8)]
+    return [_flat(xt[i] ^ t[i] ^ jnp.roll(t[i], -2, axis=1) ^ a[i])
+            for i in range(8)]
 
 
 def inv_mixcolumns_planes(p: list, perm=None) -> list:

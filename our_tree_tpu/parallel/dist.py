@@ -36,8 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..models.aes import CORES, CTR_FUSED, _add_counter_be, resolve_engine
-from ..utils import packing
+from ..models.aes import CORES, CTR_FUSED, ctr_le_blocks, resolve_engine
 
 AXIS = "shards"
 
@@ -90,7 +89,7 @@ def _ctr_shard_body(words, ctr_be, rk, nr, axis, engine="jnp"):
     n_local = words.shape[0]
     base = jax.lax.axis_index(axis).astype(jnp.uint32) * jnp.uint32(n_local)
     idx = base + jnp.arange(n_local, dtype=jnp.uint32)
-    ctr_le = packing.byteswap32(_add_counter_be(ctr_be, idx))
+    ctr_le = ctr_le_blocks(ctr_be, idx)
     fused = CTR_FUSED.get(engine)
     if fused is not None:  # keystream stays on-chip per shard
         return fused(words, ctr_le, rk, nr)
@@ -104,14 +103,15 @@ def _ctr_sharded_jit(words, ctr_be, rk, *, nr, mesh, axis, engine="jnp"):
         mesh=mesh,
         in_specs=(P(axis), P(), P()),
         out_specs=P(axis),
-        # Needed for engine="pallas" in interpret mode (CPU meshes): jax
+        # Disabled only where the engine routes into a pallas kernel: jax
         # 0.9.0's pallas interpreter drops vma tags across its internal
         # scan, so the kernel's round fori_loop fails shard_map's carry
         # check ("Scan carry input and output got mismatched varying manual
         # axes") even though values are correct — reproduced by
         # ctr_crypt_sharded(engine="pallas") on an 8-virtual-device CPU
-        # mesh. Shard parity is covered by test_parallel's invariance tests.
-        check_vma=False,
+        # mesh. Other engines keep the full vma safety check; pallas shard
+        # parity is covered by test_parallel instead.
+        check_vma=engine not in CTR_FUSED and engine != "pallas",
     )
     return f(words, ctr_be, rk)
 
@@ -143,7 +143,8 @@ def _ecb_sharded_jit(words, rk, *, nr, encrypt, mesh, axis, engine="jnp"):
         mesh=mesh,
         in_specs=(P(axis), P()),
         out_specs=P(axis),
-        check_vma=False,  # same pallas-interpreter vma drop; see _ctr_sharded_jit
+        # same pallas-interpreter vma drop; see _ctr_sharded_jit
+        check_vma=engine != "pallas",
     )
     return f(words, rk)
 

@@ -45,6 +45,36 @@ def test_inv_sbox_circuit_exhaustive():
     np.testing.assert_array_equal(out, np.asarray(tables.INV_SBOX, dtype=np.uint8))
 
 
+@pytest.mark.parametrize("impl", ["tower", "chain"])
+def test_sbox_impls_exhaustive(impl, monkeypatch):
+    """Both S-box formulations — the composite-field tower (default) and the
+    x^254 addition chain — must match the table for every byte, in both
+    directions. Two independent derivations cross-checking each other."""
+    monkeypatch.setattr(bitslice, "SBOX_IMPL", impl)
+    pl = _all_bytes_planes()
+    out = _planes_to_first_byte(bitslice.sbox_planes([pl[i] for i in range(8)]))
+    np.testing.assert_array_equal(out, np.asarray(tables.SBOX, dtype=np.uint8))
+    out = _planes_to_first_byte(bitslice.inv_sbox_planes([pl[i] for i in range(8)]))
+    np.testing.assert_array_equal(out, np.asarray(tables.INV_SBOX, dtype=np.uint8))
+
+
+def test_gf16_mul_planes_matches_field():
+    """Bitsliced GF(2^4) multiply vs the scalar field op, all 256 pairs."""
+    import jax.numpy as jnp
+
+    a_vals = np.repeat(np.arange(16, dtype=np.uint32), 16)   # 256 lanes
+    b_vals = np.tile(np.arange(16, dtype=np.uint32), 16)
+    a_planes = [jnp.asarray((a_vals >> i) & 1, jnp.uint32) * jnp.uint32(0xFFFFFFFF)
+                for i in range(4)]
+    b_planes = [jnp.asarray((b_vals >> i) & 1, jnp.uint32) * jnp.uint32(0xFFFFFFFF)
+                for i in range(4)]
+    out = bitslice.gf16_mul_planes(a_planes, b_planes)
+    got = sum((np.asarray(out[i]) & 1) << i for i in range(4))
+    want = np.array([bitslice._gf16_mul(int(a), int(b))
+                     for a, b in zip(a_vals, b_vals)])
+    np.testing.assert_array_equal(got, want)
+
+
 def test_gf_mul_planes_matches_field():
     from our_tree_tpu.ops import gf
 
