@@ -470,9 +470,15 @@ def _perm_take(x: jnp.ndarray, idx: np.ndarray) -> jnp.ndarray:
 
 
 def encrypt_round(planes: jnp.ndarray, kp: jnp.ndarray, last: bool,
-                  perm=_perm_take) -> jnp.ndarray:
-    """One forward round on stacked planes; kp = (8, 16, 1) key masks."""
-    mc_perm = None if perm is _perm_take else perm
+                  perm=_perm_take, mc="auto") -> jnp.ndarray:
+    """One forward round on stacked planes; kp = (8, 16, 1) key masks.
+
+    ``mc`` picks the MixColumns rotation lowering: "auto" follows ``perm``
+    (gather form -> reshape+roll, kernel form -> leading-axis perms);
+    "roll"/"perm" force one — a tuning knob for Mosaic, where the relative
+    cost of sublane rolls vs slice-stacks is hardware-generation-dependent.
+    """
+    mc_perm = _resolve_mc(perm, mc)
     p = sbox_planes([planes[i] for i in range(8)])
     p = [perm(x, SR_PERM) for x in p]
     if not last:
@@ -480,15 +486,23 @@ def encrypt_round(planes: jnp.ndarray, kp: jnp.ndarray, last: bool,
     return jnp.stack([p[i] ^ kp[i] for i in range(8)])
 
 
+def _resolve_mc(perm, mc):
+    if mc == "roll":
+        return None
+    if mc == "perm":
+        return perm
+    return None if perm is _perm_take else perm
+
+
 def decrypt_round(planes: jnp.ndarray, kp: jnp.ndarray, last: bool,
-                  perm=_perm_take) -> jnp.ndarray:
+                  perm=_perm_take, mc="auto") -> jnp.ndarray:
     """One inverse round, matching the folded-schedule ordering of the
     T-table core (AES_RROUND, reference aes-modes/aes.c:624-645):
     InvShiftRows/InvSubBytes (they commute — permutation vs byte-wise map;
     the substitution runs first so the round ends in a gather, which keeps
     XLA-CPU from fusing the whole inversion circuit into a downstream
     consumer and exploding compile time), then InvMixColumns, then rk_dec."""
-    mc_perm = None if perm is _perm_take else perm
+    mc_perm = _resolve_mc(perm, mc)
     p = inv_sbox_planes([planes[i] for i in range(8)])
     p = [perm(x, ISR_PERM) for x in p]
     if not last:

@@ -45,6 +45,16 @@ if TILE <= 0 or TILE % 128:
         f"OT_PALLAS_TILE must be a positive multiple of 128, got {TILE}"
     )
 
+#: MixColumns rotation lowering inside kernels: "perm" (leading-axis
+#: slice-stacks, the conservative Mosaic form) or "roll" (reshape + sublane
+#: roll — fewer data movements if the generation's Mosaic supports it).
+#: A hardware tuning knob, like OT_PALLAS_TILE.
+MC_LOWERING = os.environ.get("OT_PALLAS_MC", "perm")
+if MC_LOWERING not in ("perm", "roll"):
+    raise ValueError(
+        f"OT_PALLAS_MC must be 'perm' or 'roll', got {MC_LOWERING!r}"
+    )
+
 
 def _perm_stack(x: jnp.ndarray, idx) -> jnp.ndarray:
     """Static permutation of the leading (byte-position) axis as slices."""
@@ -67,7 +77,7 @@ def _run_rounds(p, kp, nr: int, round_fn, interpret: bool):
         # graph pathologically slowly.
         def body(r, q):
             k = jax.lax.dynamic_index_in_dim(kp, r, axis=0, keepdims=False)
-            return round_fn(q, k, False, perm=_perm_stack)
+            return round_fn(q, k, False, perm=_perm_stack, mc=MC_LOWERING)
 
         return jax.lax.fori_loop(1, nr, body, p)
     # Compiled: fully unrolled straight-line rounds with *static* key
@@ -75,7 +85,7 @@ def _run_rounds(p, kp, nr: int, round_fn, interpret: bool):
     # aes-gpu/Source/AES.cu:35,298-365) — no dynamic slicing for Mosaic
     # to trip on, and the round keys fold into the instruction stream.
     for r in range(1, nr):
-        p = round_fn(p, kp[r], False, perm=_perm_stack)
+        p = round_fn(p, kp[r], False, perm=_perm_stack, mc=MC_LOWERING)
     return p
 
 

@@ -61,6 +61,20 @@ void ot_aes_cfb128(const ot_aes_ctx *ctx, int encrypt, int *iv_off,
                    uint8_t iv[16], const uint8_t *in, uint8_t *out,
                    size_t len);
 
+/* Hardware AES (AES-NI) chunk workers — the framework's SIMD backend
+ * (reference component #2 role). Runtime-gated: callers must check
+ * ot_aesni_available(); the bulk dispatchers in ot_parallel.c do this and
+ * fall back to the portable core (OT_C_FORCE_PORTABLE env pins portable
+ * for parity testing). Chunk functions mirror the per-worker loops. */
+int ot_aesni_available(void);
+void ot_aesni_ecb_chunk(const ot_aes_ctx *ctx, int encrypt, const uint8_t *in,
+                        uint8_t *out, size_t nblocks);
+void ot_aesni_ctr_chunk(const ot_aes_ctx *ctx, uint8_t ctr[16],
+                        const uint8_t *in, uint8_t *out, size_t nblocks,
+                        size_t tail);
+void ot_aesni_cbc_dec_chunk(const ot_aes_ctx *ctx, const uint8_t prev0[16],
+                            const uint8_t *in, uint8_t *out, size_t nblocks);
+
 /* ARC4 in the reference's three phases (its one original design idea,
  * SURVEY.md §0): setup (KSA), prep (sequential PRGA -> keystream buffer),
  * crypt (parallel XOR). State persists across prep calls. */

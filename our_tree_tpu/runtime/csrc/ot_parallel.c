@@ -10,9 +10,27 @@
 #include "ot_crypt.h"
 
 #include <pthread.h>
+#include <stdlib.h>
 #include <string.h>
 
 #define OT_MAX_THREADS 64
+
+/* Hardware path selection, decided once: AES-NI when the CPU has it and
+ * OT_C_FORCE_PORTABLE is unset (the knob parity tests use to compare the
+ * two implementations on the same machine). pthread_once, not a bare
+ * static: the first callers are the worker threads themselves, which
+ * would otherwise race the write (C11 UB, TSan-visible). */
+static int aesni_on;
+static pthread_once_t aesni_once = PTHREAD_ONCE_INIT;
+
+static void decide_aesni(void) {
+    aesni_on = ot_aesni_available() && !getenv("OT_C_FORCE_PORTABLE");
+}
+
+static int use_aesni(void) {
+    pthread_once(&aesni_once, decide_aesni);
+    return aesni_on;
+}
 
 /* 128-bit big-endian add: ctr += k. */
 static void ctr_add(uint8_t ctr[16], uint64_t k) {
@@ -35,6 +53,10 @@ typedef struct {
 
 static void *ecb_worker(void *arg) {
     job_t *j = (job_t *)arg;
+    if (use_aesni()) {
+        ot_aesni_ecb_chunk(j->ctx, j->encrypt, j->in, j->out, j->nblocks);
+        return NULL;
+    }
     for (size_t b = 0; b < j->nblocks; b++) {
         if (j->encrypt)
             ot_aes_encrypt_block(j->ctx, j->in + 16 * b, j->out + 16 * b);
@@ -47,6 +69,10 @@ static void *ecb_worker(void *arg) {
 static void *ctr_worker(void *arg) {
     job_t *j = (job_t *)arg;
     uint8_t ks[16];
+    if (use_aesni()) {
+        ot_aesni_ctr_chunk(j->ctx, j->ctr, j->in, j->out, j->nblocks, j->tail);
+        return NULL;
+    }
     for (size_t b = 0; b < j->nblocks; b++) {
         ot_aes_encrypt_block(j->ctx, j->ctr, ks);
         ctr_add(j->ctr, 1);
@@ -69,6 +95,10 @@ static void *cbc_dec_worker(void *arg) {
      * the same asymmetry the TPU path exploits (models/aes.py). */
     job_t *j = (job_t *)arg;
     uint8_t prev[16], cur[16];
+    if (use_aesni()) {
+        ot_aesni_cbc_dec_chunk(j->ctx, j->ctr, j->in, j->out, j->nblocks);
+        return NULL;
+    }
     memcpy(prev, j->ctr, 16);
     for (size_t b = 0; b < j->nblocks; b++) {
         memcpy(cur, j->in + 16 * b, 16);

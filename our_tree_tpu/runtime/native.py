@@ -1,9 +1,10 @@
 """ctypes bindings for the native C runtime + the `--backend=c` harness backend.
 
 The shared library (runtime/csrc/libotcrypt.so) is built on first use with
-the in-tree Makefile — the build is a single `make` of three C files, cheap
-enough to run lazily and cached by mtime. Bindings use ctypes (no pybind11
-in this image); buffers cross the boundary as numpy arrays, zero-copy.
+the in-tree Makefile — a single `make`, cheap enough to run lazily and
+cached by mtime against every source in csrc/ (globbed, so new files can't
+silently go stale). Bindings use ctypes (no pybind11 in this image);
+buffers cross the boundary as numpy arrays, zero-copy.
 
 This layer plays the role of the reference's portable-C path *and* its
 pthread harness (aes-modes/test.c): same contiguous-chunk work split, same
@@ -34,8 +35,9 @@ class Arc4Ctx(ctypes.Structure):
 
 
 def _build() -> None:
-    srcs = [_CSRC / n for n in ("ot_aes.c", "ot_arc4.c", "ot_parallel.c",
-                                 "ot_crypt.h", "Makefile")]
+    srcs = sorted(_CSRC.glob("*.c")) + sorted(_CSRC.glob("*.h")) + [
+        _CSRC / "Makefile"
+    ]
     if _LIB_PATH.exists() and all(
         _LIB_PATH.stat().st_mtime >= s.stat().st_mtime for s in srcs
     ):
@@ -78,8 +80,18 @@ def load():
     lib.ot_arc4_prep.argtypes = [ctypes.POINTER(Arc4Ctx), _u8p,
                                  ctypes.c_size_t]
     lib.ot_xor.argtypes = [_u8p, _u8p, _u8p, ctypes.c_size_t, ctypes.c_int]
+    lib.ot_aesni_available.argtypes = []
+    lib.ot_aesni_available.restype = ctypes.c_int
     _lib = lib
     return lib
+
+
+def aesni_available() -> bool:
+    """True when the CPU's hardware AES path (ot_aesni.c) is usable.
+
+    Note the runtime also honors OT_C_FORCE_PORTABLE (checked once per
+    process in ot_parallel.c) — this only reports the cpuid capability."""
+    return bool(load().ot_aesni_available())
 
 
 # ---------------------------------------------------------------------------

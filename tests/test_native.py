@@ -98,3 +98,46 @@ def test_c_backend_protocol_end_to_end():
     np.testing.assert_array_equal(out1, out4)
     jx = AES(KEY[128], engine="jnp")
     np.testing.assert_array_equal(out1, jx.crypt_ecb(AES_ENCRYPT, MSG))
+
+
+def test_native_portable_vs_hardware_parity():
+    """The runtime picks AES-NI when the CPU has it (ot_parallel.c:use_aesni);
+    the portable byte-matrix core must produce identical bytes. The choice is
+    cached per process, so the portable run happens in a subprocess with
+    OT_C_FORCE_PORTABLE=1 — same mechanism a parity-minded operator would use.
+    """
+    import json
+    import os
+    import subprocess
+    import sys
+
+    from our_tree_tpu.runtime.native import aesni_available
+
+    if not aesni_available():
+        pytest.skip("no hardware AES path on this CPU — nothing to compare")
+
+    prog = r"""
+import json, sys
+import numpy as np
+from our_tree_tpu.runtime.native import NativeAES
+rng = np.random.default_rng(77)
+key = rng.integers(0, 256, 32, np.uint8).tobytes()
+msg = rng.integers(0, 256, 16 * 65 + 9, np.uint8)
+nonce = rng.integers(0, 256, 16, np.uint8)
+nat = NativeAES(key)
+ct_ecb = nat.ecb(msg[: 16 * 65], encrypt=True, nthreads=2)
+out_ctr, _ = nat.ctr(nonce.copy(), msg, nthreads=3)
+print(json.dumps({"ecb": ct_ecb.tobytes().hex(), "ctr": out_ctr.tobytes().hex()}))
+"""
+    outs = {}
+    for label, force in (("hw", None), ("portable", "1")):
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        # An inherited OT_C_FORCE_PORTABLE would make the "hw" run portable
+        # too and the comparison vacuous — strip it, set it only as asked.
+        env.pop("OT_C_FORCE_PORTABLE", None)
+        if force is not None:
+            env["OT_C_FORCE_PORTABLE"] = force
+        r = subprocess.run([sys.executable, "-c", prog], capture_output=True,
+                           text=True, env=env, check=True)
+        outs[label] = json.loads(r.stdout.strip().splitlines()[-1])
+    assert outs["hw"] == outs["portable"]
