@@ -81,6 +81,42 @@ class TpuBackend:
             engine=self.engine,
         )
 
+    def ctr_stream(self, ctx, msg: np.ndarray, nonce: np.ndarray,
+                   chunk_bytes: int, workers: int) -> np.ndarray:
+        """CTR over a message larger than device memory: stage, encrypt, and
+        read back chunk-by-chunk, carrying the 128-bit counter across chunk
+        seams (host-side, via the same byte-ripple semantics as the cipher).
+
+        This is how the framework runs the reference's biggest configs (a
+        16 GiB message does not fit a single chip's HBM): the resume-state
+        API (models/aes.py) is the per-chunk seam, exactly as the
+        reference's `nc_off`/counter carry lets its CTR resume mid-stream
+        (aes-modes/aes.c:869-901). Output assembles on host.
+        """
+        from ..models.aes import _inc_counter_bytes
+        from ..utils import packing
+
+        chunk_bytes -= chunk_bytes % 16
+        if chunk_bytes <= 0:
+            raise ValueError("chunk_bytes must be at least one 16-byte block")
+        out = np.empty_like(msg)
+        nonce = np.array(nonce, dtype=np.uint8, copy=True)
+        for off in range(0, msg.size, chunk_bytes):
+            part = msg[off : off + chunk_bytes]
+            nfull = part.size // 16
+            words = self.stage_words(part[: nfull * 16])
+            o = self.ctr(ctx, words, self.ctr_be_words(nonce), workers)
+            out[off : off + nfull * 16] = packing.np_words_to_bytes(
+                np.asarray(o, dtype=np.uint32)
+            ).reshape(-1)
+            nonce = _inc_counter_bytes(nonce, nfull)
+            if part.size % 16:  # trailing partial block (last chunk only)
+                tail_out, _, nonce, _ = ctx.crypt_ctr(
+                    0, nonce, np.zeros(16, np.uint8), part[nfull * 16 :]
+                )
+                out[off + nfull * 16 : off + part.size] = tail_out
+        return out
+
     def cbc(self, ctx, words, iv_words, workers: int):
         out, _ = self._aes_mod.cbc_encrypt_words(words, iv_words, ctx.rk_enc, ctx.nr)
         return out
