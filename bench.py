@@ -59,9 +59,19 @@ def _ensure_live_backend() -> None:
     process. On timeout/failure the parent — which has not touched any
     backend yet — switches to CPU so the benchmark still reports a line.
     Skipped when CPU is already pinned: no tunnel is involved there, and
-    the probe would just double the startup cost.
+    the probe would just double the startup cost. The pin is re-asserted
+    through jax.config, not just trusted from the env: site hooks that
+    pre-register an accelerator plugin can clobber JAX_PLATFORMS at
+    interpreter start (see tests/conftest.py), and the env var alone would
+    leave this process initializing the very tunnel the caller opted out of.
     """
     if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
         return
     try:
         subprocess.run(

@@ -26,6 +26,9 @@ class TpuBackend:
     name = "tpu"
 
     def __init__(self, engine: str = "auto"):
+        import os
+        import sys
+
         import jax
 
         from ..models import aes as aes_mod
@@ -39,6 +42,32 @@ class TpuBackend:
         self.engine = aes_mod.resolve_engine(engine)
         self.max_workers = len(jax.devices())
         self._meshes: dict[int, object] = {}
+
+        # ARC4 keystream implementation, resolved ONCE at construction so
+        # the lazy native build (a `make` subprocess) can never land inside
+        # a timed region, and so a fallback is visible rather than silent:
+        #   auto   — native C core when buildable, else the lax.scan (noted
+        #            on stderr: the two differ by orders of magnitude);
+        #   native — require the C core, fail loudly if it can't build;
+        #   jax    — pin the on-device scan (parity tests use this).
+        mode = os.environ.get("OT_ARC4_PREP", "auto")
+        if mode not in ("auto", "native", "jax"):
+            raise ValueError(
+                f"OT_ARC4_PREP must be auto|native|jax, got {mode!r}"
+            )
+        self._arc4_native = None
+        if mode != "jax":
+            try:
+                from ..runtime import native
+
+                native.load()  # builds now, outside any timed region
+                self._arc4_native = native.NativeARC4
+            except Exception as e:
+                if mode == "native":
+                    raise
+                print(f"# arc4 prep: native runtime unavailable "
+                      f"({type(e).__name__}); keygen rows will time the "
+                      "lax.scan path", file=sys.stderr)
 
     # -- helpers -----------------------------------------------------------
     def _mesh(self, workers: int):
@@ -141,8 +170,20 @@ class TpuBackend:
 
     # -- ARC4 --------------------------------------------------------------
     def arc4_setup_prep(self, key: bytes, length: int):
-        rc = self._ARC4(key)
-        return rc.prep(length)
+        """Phase 1+2: key schedule + sequential keystream generation.
+
+        The keystream recurrence is inherently serial — there is nothing
+        for an accelerator to parallelise, and a per-byte `lax.scan` pays
+        device-step latency on every byte. The phase split (the reference's
+        design, SURVEY.md §0) means the sequential phase runs on the best
+        *serial* processor available — the host CPU via the native C core —
+        while the parallel XOR phase scales on the device mesh. The
+        implementation was resolved at construction (OT_ARC4_PREP; see
+        __init__); bit-equality of the two is pinned by test_native.
+        """
+        if self._arc4_native is not None:
+            return self._arc4_native(key).prep(length)
+        return self._ARC4(key).prep(length)
 
     def arc4_crypt(self, data_dev, ks_dev, workers: int):
         if workers == 1:
