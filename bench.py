@@ -68,10 +68,7 @@ def _ensure_live_backend() -> None:
     if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
         import jax
 
-        try:
-            jax.config.update("jax_platforms", "cpu")
-        except Exception:
-            pass
+        jax.config.update("jax_platforms", "cpu")
         return
     try:
         subprocess.run(

@@ -24,10 +24,12 @@ addition chain 254 = 2 + 12 + 240  (x2=x², x3=x²·x, x12=x3⁴, x15=x12·x3,
 x240=x15¹⁶, x252=x240·x12, x254=x252·x2): 4 bitsliced multiplies — squaring
 is *linear* in characteristic 2, so all squarings are free XOR networks.
 Every linear layer (squaring, the affine map and its inverse, ×2 for
-MixColumns, ×9/×11/×13/×14 for InvMixColumns, modular reduction) is an 8×8
-or 15×8 GF(2) matrix **derived numerically at import time** from the field
-arithmetic in ops/gf.py — no transcribed circuit constants to get subtly
-wrong; tests/test_bitslice.py checks every derived map exhaustively.
+MixColumns, ×4 for the InvMixColumns pre-transform — the inverse mix
+routes through the forward one, see inv_mixcolumns_planes — the tower
+field's nibble maps, modular reduction) is a GF(2) matrix **derived
+numerically at import time** from the field arithmetic in ops/gf.py — no
+transcribed circuit constants to get subtly wrong; tests/test_bitslice.py
+pins the circuits exhaustively against the S-box/field tables.
 
 The round structure and key-schedule convention (decrypt uses the
 InvMixColumns-folded schedule, so rounds run InvShiftRows → InvSubBytes →
@@ -97,8 +99,9 @@ MAT_AFF = _linmat(lambda y: int(tables.SBOX[gf.ginv(y)]) ^ 0x63)
 MAT_AFF_INV = _gf2_inv(MAT_AFF)
 AFF_CONST = 0x63
 
-#: Constant multipliers for MixColumns (×2) and InvMixColumns (×9/11/13/14).
-MAT_MUL = {c: _linmat(lambda x, c=c: gf.gmul(c, x)) for c in (2, 9, 11, 13, 14)}
+#: Constant multipliers: ×2 for MixColumns, ×4 for the InvMixColumns
+#: pre-transform (inv_mixcolumns_planes routes through the forward mix).
+MAT_MUL = {c: _linmat(lambda x, c=c: gf.gmul(c, x)) for c in (2, 4)}
 
 #: Modular reduction of a degree-14 product: REDUCE[k] = x^k mod POLY.
 REDUCE = np.array([gf.gpow(2, k) for k in range(15)], dtype=np.uint16)
@@ -380,18 +383,24 @@ def mixcolumns_planes(p: list, perm=None) -> list:
 
 
 def inv_mixcolumns_planes(p: list, perm=None) -> list:
-    """out_r = 14·a_r + 11·a_(r+1) + 13·a_(r+2) + 9·a_(r+3) (FIPS-197 §5.3.3)."""
+    """out_r = 14·a_r + 11·a_(r+1) + 13·a_(r+2) + 9·a_(r+3) (FIPS-197 §5.3.3).
+
+    Computed as MixColumns of a cheap pre-transform rather than four dense
+    coefficient matrices: with d_r = a_r ^ 4·(a_r ^ a_(r+2)),
+    MC([2,3,1,1])(d) expands to exactly [14,11,13,9](a) — check the
+    coefficient algebra: 2(5a_r+4a_(r+2)) + 3(5a_(r+1)+4a_(r+3)) +
+    (5a_(r+2)+4a_r) + (5a_(r+3)+4a_(r+1)) = 14,11,13,9. One sparse ×4 map
+    and one rotation replace four dense 8×8 GF(2) matrices."""
     if perm is not None:
-        rolled = [p] + [[perm(x, ROT_PERM[k]) for x in p] for k in (1, 2, 3)]
-        terms = [apply_linear(MAT_MUL[c], r)
-                 for c, r in zip((14, 11, 13, 9), rolled)]
-        return [terms[0][i] ^ terms[1][i] ^ terms[2][i] ^ terms[3][i]
-                for i in range(8)]
+        t = [x ^ perm(x, ROT_PERM[2]) for x in p]
+        four = apply_linear(MAT_MUL[4], t)
+        d = [p[i] ^ four[i] for i in range(8)]
+        return mixcolumns_planes(d, perm=perm)
     a = [_cols(x) for x in p]
-    rolled = [a] + [[jnp.roll(x, -k, axis=1) for x in a] for k in (1, 2, 3)]
-    terms = [apply_linear(MAT_MUL[c], r) for c, r in zip((14, 11, 13, 9), rolled)]
-    return [_flat(terms[0][i] ^ terms[1][i] ^ terms[2][i] ^ terms[3][i])
-            for i in range(8)]
+    t = [x ^ jnp.roll(x, -2, axis=1) for x in a]
+    four = apply_linear(MAT_MUL[4], t)
+    d = [_flat(a[i] ^ four[i]) for i in range(8)]
+    return mixcolumns_planes(d)
 
 
 # ---------------------------------------------------------------------------
