@@ -52,11 +52,14 @@ AES_DECRYPT = 0
 
 CORES: dict[str, tuple] = {"jnp": (block.encrypt_words, block.decrypt_words)}
 
-#: Optional fused-CTR fast paths: (words, ctr_le_words, rk, nr) -> words,
-#: keeping the keystream on-chip instead of materialising it in HBM. Engines
-#: without an entry fall back to the layered keystream-then-XOR path. Both
-#: the single-device dispatcher (ctr_crypt_words) and the sharded one
-#: (parallel/dist.py:_ctr_shard_body) consult this registry.
+#: Optional fused-CTR fast paths: (words, ctr_be_words, rk, nr) -> words
+#: where the counter for block i is ctr_be + i (128-bit BE semantics),
+#: keeping the keystream — and, for counter-synthesising kernels, the
+#: counter stream itself — on-chip instead of materialising it in HBM.
+#: Engines without an entry fall back to the layered keystream-then-XOR
+#: path. Both the single-device dispatcher (ctr_crypt_words) and the
+#: sharded one (parallel/dist.py:_ctr_shard_body, which pre-offsets
+#: ctr_be to the shard's first block) consult this registry.
 CTR_FUSED: dict[str, object] = {}
 
 
@@ -116,9 +119,13 @@ def _add_counter_be(ctr_be: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
 
 def ctr_le_blocks(ctr_be_words, idx):
     """Counter blocks counter0+idx as the (N, 4) u32 LE words the cipher
-    consumes. The ONE place the 128-bit BE seam arithmetic + byte-order
-    conversion lives — the fused and layered CTR paths and the sharded
-    dispatcher (parallel/dist.py) all call this, so they cannot drift.
+    consumes. Owns the BE-add + byte-order conversion for every path that
+    *materialises* counter words (layered keystream, non-fused shards).
+    Counter-synthesising fused kernels don't materialise words at all —
+    they share `_add_counter_be` for seam offsets and re-derive the same
+    byte-plane mapping bitwise (ops/pallas_aes.py:_ctr_planes_from_base);
+    tests/test_pallas.py pins the two formulations against each other
+    across multi-word carries.
 
     The cipher consumes LE-packed words of the counter's byte stream; the
     counter bytes are the BE words' bytes, so each word is byteswapped.
@@ -138,9 +145,10 @@ def ctr_crypt_words(words, ctr_be_words, rk, nr, engine="jnp"):
     idx = jnp.arange(n, dtype=jnp.uint32)
     fused = CTR_FUSED.get(engine)
     if fused is not None:
-        # Fused kernel: the keystream never round-trips through HBM
-        # (e.g. ops/pallas_aes.py:ctr_crypt_words).
-        return fused(words, ctr_le_blocks(ctr_be_words, idx), rk, nr)
+        # Fused kernel: neither the keystream nor (for counter-synthesising
+        # kernels) the counter stream round-trips through HBM
+        # (ops/pallas_aes.py:ctr_crypt_words_gen).
+        return fused(words, ctr_be_words, rk, nr)
     ks = ctr_keystream_words(ctr_be_words, rk, nr, idx, engine)
     return words ^ ks
 
@@ -395,4 +403,4 @@ from ..ops import pallas_aes as _pallas_aes  # noqa: E402
 
 register_core("bitslice", _bitslice.encrypt_words, _bitslice.decrypt_words)
 register_core("pallas", _pallas_aes.encrypt_words, _pallas_aes.decrypt_words,
-              ctr_fused_fn=_pallas_aes.ctr_crypt_words)
+              ctr_fused_fn=_pallas_aes.ctr_crypt_words_gen)

@@ -220,6 +220,130 @@ M_ISBOX_OUT = TOWER_ISO_INV
 MAT_SQ4 = _linmat(lambda x: _gf16_mul(x, x), 4)
 MAT_LAMSQ4 = _linmat(lambda x: _gf16_mul(TOWER_LAMBDA, _gf16_mul(x, x)), 4)
 
+# ---------------------------------------------------------------------------
+# Second tower level: GF(2^4) = GF(2^2)[u]/(u^2 + u + Λ), GF(2^2) =
+# GF(2)[w]/(w^2 + w + 1). Purpose: the 4-bit inverse Δ^-1 inside the S-box.
+# The flat form costs Δ^14 = two GF(2^4) multiplies + squarings; in the
+# sub-tower, (a·u + b)^-1 = a·δ^-1·u + (a+b)·δ^-1 with δ = Λa² + ab + b²
+# ∈ GF(2^2), where δ^-1 = δ² is LINEAR (x³ = 1 for x ≠ 0 in GF(4)) — the
+# inversion bottoms out in free squarings (Satoh/Canright, one level down).
+# The basis isomorphism ψ: GF(16)[w-basis] -> pair basis is derived like
+# TOWER_ISO and costs nothing at runtime: it is folded into the multiply
+# reduction matrices on entry (ψ∘reduce) and into a mixed-basis bilinear
+# multiply on exit (see _mixed_mul_reduction), so no standalone basis
+# conversion ops exist in the circuit.
+# ---------------------------------------------------------------------------
+
+
+def _gf4_mul(a: int, b: int) -> int:
+    """GF(2^2) multiply, poly w^2 + w + 1."""
+    r = 0
+    while b:
+        if b & 1:
+            r ^= a
+        b >>= 1
+        a <<= 1
+        if a & 4:
+            a ^= 0b111
+    return r & 3
+
+
+def _pick_lambda4() -> int:
+    """Λ ∈ GF(2^2) making u^2 + u + Λ irreducible over GF(2^2)."""
+    for lam in range(1, 4):
+        if all(_gf4_mul(r, r) ^ r ^ lam for r in range(4)):
+            return lam
+    raise AssertionError("no irreducible u^2+u+Λ over GF(2^2)")
+
+
+SUB_LAMBDA = _pick_lambda4()
+
+
+def _pair_mul(u: int, v: int) -> int:
+    """Multiply in GF(2^2)[u]/(u^2+u+Λ); nibble = (a<<2)|b for a·u+b."""
+    a, b, c, d = u >> 2, u & 3, v >> 2, v & 3
+    ac = _gf4_mul(a, c)
+    hi = _gf4_mul(a, d) ^ _gf4_mul(b, c) ^ ac
+    lo = _gf4_mul(b, d) ^ _gf4_mul(ac, SUB_LAMBDA)
+    return (hi << 2) | lo
+
+
+def _find_sub_iso() -> np.ndarray:
+    """4x4 GF(2) matrix ψ with ψ(uv) = ψ(u)ψ(v), GF(16) w-basis -> pair."""
+    gen = next(g for g in range(2, 16)
+               if len({functools.reduce(lambda x, _: _gf16_mul(x, g),
+                                        range(k), 1) for k in range(15)}) == 15)
+    log = {}
+    v = 1
+    for k in range(15):
+        log[v] = k
+        v = _gf16_mul(v, gen)
+    for h in range(2, 16):
+        powers = [1]
+        for _ in range(14):
+            powers.append(_pair_mul(powers[-1], h))
+        if len(set(powers)) != 15:
+            continue
+        psi = [0] * 16
+        for val, k in log.items():
+            psi[val] = powers[k]
+        m = np.zeros((4, 4), dtype=np.uint8)
+        for j in range(4):
+            for i in range(4):
+                m[i, j] = (psi[1 << j] >> i) & 1
+        if all(
+            int(sum(int(x) << i for i, x in enumerate(
+                (m @ [(x >> j) & 1 for j in range(4)]) % 2))) == psi[x]
+            for x in range(16)
+        ):
+            return m
+    raise AssertionError("no GF(16) sub-tower isomorphism found")
+
+
+SUB_ISO = _find_sub_iso()
+SUB_ISO_INV = _gf2_inv(SUB_ISO)
+
+#: δ^-1 = δ² and the Λ'·x² map of the pair-basis inversion, as GF(2) maps
+#: over the 2-bit planes.
+MAT_SQ2 = _linmat(lambda x: _gf4_mul(x, x), 2)
+MAT_LAMSQ2 = _linmat(lambda x: _gf4_mul(SUB_LAMBDA, _gf4_mul(x, x)), 2)
+
+
+def _bilinear_reduction(out_map) -> np.ndarray:
+    """(4, 16) GF(2) matrix R with out_k = XOR_{i,j: R[k, 4i+j]} a_i & b_j
+    for the GF(16) product under ``out_map``: R[k, 4i+j] = bit k of
+    out_map(e_i · e_j). Lets any post-multiply linear map (ψ, ψ⁻¹, identity)
+    fold into the multiply for free."""
+    m = np.zeros((4, 16), dtype=np.uint8)
+    for i in range(4):
+        for j in range(4):
+            prod = out_map(i, j)
+            for k in range(4):
+                m[k, 4 * i + j] = (prod >> k) & 1
+    return m
+
+
+def _psi_apply(x: int) -> int:
+    return int(sum(int(v) << i for i, v in enumerate(
+        (SUB_ISO @ [(x >> j) & 1 for j in range(4)]) % 2)))
+
+
+def _psi_inv_apply(x: int) -> int:
+    return int(sum(int(v) << i for i, v in enumerate(
+        (SUB_ISO_INV @ [(x >> j) & 1 for j in range(4)]) % 2)))
+
+
+#: w-basis × w-basis -> pair-basis product (ψ folded into the reduction).
+_MUL_W_W_TO_PAIR = _bilinear_reduction(
+    lambda i, j: _psi_apply(_gf16_mul(1 << i, 1 << j)))
+#: w-basis × pair-basis -> w-basis product (ψ⁻¹ folded in).
+_MUL_W_PAIR_TO_W = _bilinear_reduction(
+    lambda i, j: _gf16_mul(1 << i, _psi_inv_apply(1 << j)))
+
+#: ψ∘(λ·x²) and ψ∘x² — the Δ-term maps emitting directly into pair basis.
+MAT_LAMSQ4_PAIR = (SUB_ISO @ MAT_LAMSQ4) % 2
+MAT_SQ4_PAIR = (SUB_ISO @ MAT_SQ4) % 2
+
 #: x^k mod (w^4+w+1) for the 4-bit schoolbook product's degree-6 terms.
 GF16_REDUCE = []
 for _k in range(7):
@@ -237,18 +361,72 @@ GF16_REDUCE = np.array(GF16_REDUCE, dtype=np.uint8)
 # ---------------------------------------------------------------------------
 
 
+_CSE_CACHE: dict = {}
+
+
+def _xor_cse_schedule(mat: np.ndarray):
+    """Greedy XOR common-subexpression factoring of a GF(2) matrix (Paar).
+
+    Repeatedly extracts the input pair that co-occurs in the most output
+    rows into a fresh intermediate variable. Machine-derived like the
+    matrices themselves; cuts the XOR count of a dense 8×8 map roughly in
+    half versus emitting each row as an independent chain (XLA/Mosaic CSE
+    only merges syntactically identical trees, which left-associated
+    per-row chains almost never are). Deterministic tie-breaking keeps the
+    schedule stable across runs.
+
+    Returns (pair_ops, out_rows): pair_ops = [(j, k), ...] — each defines
+    new variable len(inputs)+idx = v_j ^ v_k; out_rows[i] = sorted variable
+    indices whose XOR is output row i.
+    """
+    rows, cols = mat.shape
+    terms = [{j for j in range(cols) if mat[i, j]} for i in range(rows)]
+    nvars = cols
+    pair_ops = []
+    while True:
+        counts: dict = {}
+        for r in terms:
+            rs = sorted(r)
+            for x in range(len(rs)):
+                for y in range(x + 1, len(rs)):
+                    pr = (rs[x], rs[y])
+                    counts[pr] = counts.get(pr, 0) + 1
+        if not counts:
+            break
+        (j, k), c = max(counts.items(), key=lambda kv: (kv[1], kv[0]))
+        if c < 2:
+            break
+        new = nvars
+        nvars += 1
+        pair_ops.append((j, k))
+        for r in terms:
+            if j in r and k in r:
+                r.discard(j)
+                r.discard(k)
+                r.add(new)
+    return pair_ops, [sorted(r) for r in terms]
+
+
 def apply_linear(mat: np.ndarray, p: list) -> list:
     """y_i = XOR of p_j over j with mat[i, j] == 1 (static wiring, unrolled).
 
     Works for any GF(2) matrix shape — 8×8 byte maps and the tower field's
-    4×4 nibble maps alike."""
+    4×4 nibble maps alike. The XOR network is emitted from a greedily
+    CSE-factored schedule (see _xor_cse_schedule), cached per matrix."""
     rows, cols = mat.shape
+    key = (rows, cols, mat.tobytes())
+    sched = _CSE_CACHE.get(key)
+    if sched is None:
+        sched = _CSE_CACHE[key] = _xor_cse_schedule(mat)
+    pair_ops, out_rows = sched
+    v = list(p)
+    for j, k in pair_ops:
+        v.append(v[j] ^ v[k])
     out = []
-    for i in range(rows):
+    for r in out_rows:
         acc = None
-        for j in range(cols):
-            if mat[i, j]:
-                acc = p[j] if acc is None else acc ^ p[j]
+        for j in r:
+            acc = v[j] if acc is None else acc ^ v[j]
         out.append(acc if acc is not None else jnp.zeros_like(p[0]))
     return out
 
@@ -256,6 +434,12 @@ def apply_linear(mat: np.ndarray, p: list) -> list:
 def xor_const(p: list, c: int) -> list:
     """XOR a constant byte into every lane: flip planes where c has a 1 bit."""
     return [x ^ jnp.uint32(0xFFFFFFFF) if (c >> i) & 1 else x for i, x in enumerate(p)]
+
+
+#: Reduction of schoolbook partials as GF(2) matrices (degree-k term -> output
+#: bits), so the XOR trees go through the CSE-factored apply_linear path.
+_RED8 = np.array([[(int(REDUCE[k]) >> i) & 1 for k in range(15)]
+                  for i in range(8)], dtype=np.uint8)
 
 
 def gf_mul_planes(a: list, b: list) -> list:
@@ -266,14 +450,7 @@ def gf_mul_planes(a: list, b: list) -> list:
             t = a[i] & b[j]
             k = i + j
             c[k] = t if c[k] is None else c[k] ^ t
-    out = []
-    for i in range(8):
-        acc = None
-        for k in range(15):
-            if (int(REDUCE[k]) >> i) & 1:
-                acc = c[k] if acc is None else acc ^ c[k]
-        out.append(acc)
-    return out
+    return apply_linear(_RED8, c)
 
 
 def gf_inv_planes(x: list) -> list:
@@ -288,6 +465,10 @@ def gf_inv_planes(x: list) -> list:
     return gf_mul_planes(x252, x2)
 
 
+_RED4 = np.array([[(int(GF16_REDUCE[k]) >> i) & 1 for k in range(7)]
+                  for i in range(4)], dtype=np.uint8)
+
+
 def gf16_mul_planes(a: list, b: list) -> list:
     """Bitsliced GF(2^4) multiply: 16 ANDs + the derived 7-term reduction."""
     c = [None] * 7
@@ -296,34 +477,57 @@ def gf16_mul_planes(a: list, b: list) -> list:
             t = a[i] & b[j]
             k = i + j
             c[k] = t if c[k] is None else c[k] ^ t
-    out = []
-    for i in range(4):
-        acc = None
-        for k in range(7):
-            if (int(GF16_REDUCE[k]) >> i) & 1:
-                acc = c[k] if acc is None else acc ^ c[k]
-        out.append(acc)
-    return out
+    return apply_linear(_RED4, c)
+
+
+#: GF(2^2) product as a bilinear reduction: c[2i+j] = a_i & b_j, out rows
+#: from the field table (w² = w + 1).
+_MUL_GF4 = np.array(
+    [[( _gf4_mul(1 << i, 1 << j) >> k) & 1 for i in range(2) for j in range(2)]
+     for k in range(2)], dtype=np.uint8)
+
+
+def gf4_mul_planes(a: list, b: list) -> list:
+    """Bitsliced GF(2^2) multiply: 4 ANDs + the derived reduction."""
+    c = [a[i] & b[j] for i in range(2) for j in range(2)]
+    return apply_linear(_MUL_GF4, c)
+
+
+def _mul16_planes(a: list, b: list, red: np.ndarray) -> list:
+    """GF(16) bitsliced multiply through a folded bilinear reduction matrix
+    (16 ANDs + one CSE-factored XOR network); ``red`` selects the operand /
+    output bases (see _bilinear_reduction)."""
+    c = [a[i] & b[j] for i in range(4) for j in range(4)]
+    return apply_linear(red, c)
 
 
 def tower_inv_planes(p: list) -> list:
     """GF(2^8) inversion in the tower basis: p = [b0..b3, a0..a3] for a·x+b.
 
-    (a·x + b)^-1 = aΔ^-1·x + (a+b)Δ^-1 with Δ = λa² + ab + b²; the 4-bit
-    inverse Δ^-1 = Δ^14 costs two gf16 multiplies (squarings are linear).
-    Total: 5 gf16 multiplies ≈ a third of the x^254 chain's vector ops.
+    (a·x + b)^-1 = aΔ^-1·x + (a+b)Δ^-1 with Δ = λa² + ab + b². The 4-bit
+    inverse Δ^-1 descends a second tower level (GF(2^2) pairs, basis change
+    ψ folded into the surrounding multiplies): δ = Λ'h² + hl + l² over
+    GF(2^2), δ^-1 = δ² — a linear map, so the recursion bottoms out in
+    free squarings instead of the two extra GF(16) multiplies Δ^14 costs.
+    Net: 3 GF(16) multiplies + 3 GF(4) multiplies for the whole inversion.
     """
     b, a = p[:4], p[4:]
-    ab = gf16_mul_planes(a, b)
-    lam_a2 = apply_linear(MAT_LAMSQ4, a)
-    b2 = apply_linear(MAT_SQ4, b)
-    delta = [lam_a2[i] ^ ab[i] ^ b2[i] for i in range(4)]
-    d2 = apply_linear(MAT_SQ4, delta)
-    d4 = apply_linear(MAT_SQ4, d2)
-    d8 = apply_linear(MAT_SQ4, d4)
-    dinv = gf16_mul_planes(gf16_mul_planes(d8, d4), d2)
-    a_out = gf16_mul_planes(a, dinv)
-    b_out = gf16_mul_planes([a[i] ^ b[i] for i in range(4)], dinv)
+    ab = _mul16_planes(a, b, _MUL_W_W_TO_PAIR)            # pair basis out
+    lam_a2 = apply_linear(MAT_LAMSQ4_PAIR, a)
+    b2 = apply_linear(MAT_SQ4_PAIR, b)
+    delta = [lam_a2[i] ^ ab[i] ^ b2[i] for i in range(4)]  # ψ(Δ)
+    lo, hi = delta[:2], delta[2:]                          # Δ = hi·u + lo
+    hl = gf4_mul_planes(hi, lo)
+    lam_h2 = apply_linear(MAT_LAMSQ2, hi)
+    l2 = apply_linear(MAT_SQ2, lo)
+    d = [lam_h2[i] ^ hl[i] ^ l2[i] for i in range(2)]      # δ ∈ GF(2^2)
+    dinv = apply_linear(MAT_SQ2, d)                        # δ^-1 = δ²
+    hi_out = gf4_mul_planes(hi, dinv)
+    lo_out = gf4_mul_planes([hi[i] ^ lo[i] for i in range(2)], dinv)
+    dinv4 = lo_out + hi_out                                # ψ(Δ^-1)
+    a_out = _mul16_planes(a, dinv4, _MUL_W_PAIR_TO_W)      # ψ⁻¹ folded in
+    b_out = _mul16_planes([a[i] ^ b[i] for i in range(4)], dinv4,
+                          _MUL_W_PAIR_TO_W)
     return b_out + a_out
 
 

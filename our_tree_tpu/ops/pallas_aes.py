@@ -271,3 +271,131 @@ def ctr_crypt_words(words: jnp.ndarray, ctr_le: jnp.ndarray, rk: jnp.ndarray,
         tile=tile,
     )
     return bitslice.from_planes(out)[:n]
+
+
+# ---------------------------------------------------------------------------
+# Counter-generating fused CTR: the counter *bit-planes* are synthesised
+# inside the kernel from the 128-bit base counter, so the counter stream
+# never exists anywhere — not in HBM, not even as words. What the layered
+# path spends per block on iota + 128-bit add + byteswap + SWAR transposition
+# (plus two full HBM streams: write counters, read them back) collapses to a
+# bitsliced ripple-carry adder on (1, TILE) lane vectors: block j of lane l
+# in grid step g has index j = 32*(g*TILE + l) + t (t = bit position), so
+# bits 0..4 of j are compile-time lane masks, bits 5+ are broadcast bits of
+# the lane iota, and counter_bit_q(j) = bit q of (base + j) comes from a
+# 128-step ripple add whose operands are bit-masks — ~5 tiny vector ops per
+# counter bit, amortised over 32*TILE blocks.
+# ---------------------------------------------------------------------------
+
+#: Lane-constant bit masks of t = block position within a u32 lane:
+#: bit t of _IOTA32_MASKS[q] == (t >> q) & 1.
+_IOTA32_MASKS = tuple(
+    sum(((t >> q) & 1) << t for t in range(32)) for q in range(5)
+)
+
+
+def _ctr_planes_from_base(base, g, tile: int):
+    """(8, 16, tile) counter planes for blocks j = 32*(g*tile + lane) + t.
+
+    ``base`` is a (128, 1) u32 array of full-lane masks: row q = bit q of
+    the 128-bit big-endian base counter, replicated (0 or 0xFFFFFFFF).
+    Byte order matches models/aes.py:ctr_le_blocks — plane[b, p] holds bit
+    b of counter-stream byte p, and stream byte p is bits 8*(15-p)..+7 of
+    the big-endian counter value (reference semantics aes-modes/aes.c:879-884).
+    """
+    one = jnp.uint32(1)
+    lane = jax.lax.broadcasted_iota(jnp.uint32, (1, tile), 1)
+    G = jnp.uint32(g) * jnp.uint32(tile) + lane
+    jbits: list = []
+    for q in range(128):
+        if q < 5:
+            jbits.append(jnp.full((1, tile), _IOTA32_MASKS[q], jnp.uint32))
+        elif q - 5 < 32:
+            # broadcast bit (q-5) of the lane index to all 32 block slots
+            jbits.append(jnp.uint32(0) - ((G >> jnp.uint32(q - 5)) & one))
+        else:
+            jbits.append(None)  # j < 2^37 always (32·lane count)
+    s = []
+    carry = None
+    for q in range(128):
+        bq = base[q]  # (1,) -> broadcasts over (1, tile)
+        jq = jbits[q]
+        if jq is None:  # high bits: j contributes 0, only the carry ripples
+            s.append(bq ^ carry)
+            carry = bq & carry
+            continue
+        if carry is None:
+            s.append(bq ^ jq)
+            carry = bq & jq
+        else:
+            t = bq ^ jq
+            s.append(t ^ carry)
+            carry = (bq & jq) | (carry & t)
+    planes = []
+    for b in range(8):
+        rows = [s[8 * (15 - p) + b] for p in range(16)]
+        planes.append(jnp.concatenate(rows, axis=0))  # (16, tile)
+    return jnp.stack(planes)
+
+
+def _ctr_gen_kernel(kp_ref, base_ref, data_ref, out_ref, *, nr: int,
+                    tile: int, interpret: bool):
+    kp = kp_ref[...]
+    ctr = _ctr_planes_from_base(base_ref[...], pl.program_id(0), tile)
+    p = _run_rounds(ctr ^ kp[0], kp, nr, bitslice.encrypt_round, interpret)
+    ks = bitslice.encrypt_round(p, kp[nr], True, perm=_perm_stack)
+    out_ref[...] = data_ref[...] ^ ks
+
+
+@functools.partial(jax.jit, static_argnames=("nr", "tile"))
+def _ctr_gen_planes_pallas(data_planes, base_masks, kp, *, nr, tile):
+    w = data_planes.shape[2]
+    interpret = _interpret()
+    kernel = functools.partial(_ctr_gen_kernel, nr=nr, tile=tile,
+                               interpret=interpret)
+    spec = pl.BlockSpec((8, 16, tile), lambda i: (0, 0, i))
+    return pl.pallas_call(
+        kernel,
+        grid=(w // tile,),
+        in_specs=[
+            pl.BlockSpec((nr + 1, 8, 16, 1), lambda i: (0, 0, 0, 0)),
+            pl.BlockSpec((128, 1), lambda i: (0, 0)),
+            spec,
+        ],
+        out_specs=spec,
+        out_shape=_out_struct(data_planes),
+        interpret=interpret,
+    )(kp, base_masks, data_planes)
+
+
+def _base_bit_masks(ctr_be_words: jnp.ndarray) -> jnp.ndarray:
+    """(4,) u32 BE counter words -> (128, 1) full-lane masks, row q = bit q
+    of the 128-bit value (q = 0 least significant, i.e. word 3 bit 0)."""
+    q = jnp.arange(128, dtype=jnp.uint32)
+    word = ctr_be_words.astype(jnp.uint32)[3 - (q // 32)]
+    bits = (word >> (q % 32)) & jnp.uint32(1)
+    return (jnp.uint32(0) - bits).reshape(128, 1)
+
+
+def ctr_crypt_words_gen(words: jnp.ndarray, ctr_be_words: jnp.ndarray,
+                        rk: jnp.ndarray, nr: int) -> jnp.ndarray:
+    """Fused CTR with in-kernel counter synthesis (counter for block i =
+    base + i, 128-bit big-endian semantics per aes-modes/aes.c:869-901).
+
+    Registered as the "pallas" engine's CTR_FUSED entry: relative to
+    ctr_crypt_words it deletes the counter materialisation, its SWAR
+    transposition, and one full-buffer HBM input stream. Symmetric, so it
+    serves both directions; sharded callers pre-offset ``ctr_be_words`` to
+    their shard's first block (parallel/dist.py)."""
+    n = words.shape[0]
+    if n == 0:
+        return words
+    pad, tile = _lane_pad_and_tile(n)
+    if pad:
+        words = jnp.concatenate([words, jnp.zeros((pad, 4), words.dtype)],
+                                axis=0)
+    data_planes = bitslice.to_planes(words)
+    base = _match_vma(_base_bit_masks(ctr_be_words), data_planes)
+    kp = _match_vma(bitslice.key_planes(rk, nr), data_planes)
+    out = _ctr_gen_planes_pallas(data_planes, base, kp, nr=nr, tile=tile)
+    return bitslice.from_planes(out)[:n]

@@ -36,7 +36,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..models.aes import CORES, CTR_FUSED, ctr_le_blocks, resolve_engine
+from ..models.aes import (CORES, CTR_FUSED, _add_counter_be, ctr_le_blocks,
+                          resolve_engine)
 
 AXIS = "shards"
 
@@ -88,11 +89,12 @@ def _ctr_shard_body(words, ctr_be, rk, nr, axis, engine="jnp"):
     """
     n_local = words.shape[0]
     base = jax.lax.axis_index(axis).astype(jnp.uint32) * jnp.uint32(n_local)
+    fused = CTR_FUSED.get(engine)
+    if fused is not None:  # counter + keystream stay on-chip per shard
+        shard_ctr = _add_counter_be(ctr_be, base)
+        return fused(words, shard_ctr, rk, nr)
     idx = base + jnp.arange(n_local, dtype=jnp.uint32)
     ctr_le = ctr_le_blocks(ctr_be, idx)
-    fused = CTR_FUSED.get(engine)
-    if fused is not None:  # keystream stays on-chip per shard
-        return fused(words, ctr_le, rk, nr)
     return words ^ CORES[engine][0](ctr_le, rk, nr)
 
 

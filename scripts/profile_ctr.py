@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Component timing for the north-star CTR path (run on TPU).
+
+Separates: full models/aes.py CTR path, the fused Pallas kernel alone
+(planes pre-made), plane transposition, counter materialisation — so
+optimization effort goes where the time is.
+
+Timing uses bench.py's chained methodology: K iterations chained inside
+one jit via a carry that perturbs the input (so XLA cannot hoist/CSE the
+work) and a scalar sum-digest readback (so completion is real even on
+async/tunnelled platforms where block_until_ready returns early); the
+reported time is T(1+K) - T(1), cancelling per-call overhead.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from our_tree_tpu.models import aes as aes_mod
+from our_tree_tpu.models.aes import AES
+from our_tree_tpu.ops import bitslice, pallas_aes
+from our_tree_tpu.utils import packing
+
+NBYTES = int(os.environ.get("OT_PROF_BYTES", 128 << 20))
+ITERS = int(os.environ.get("OT_PROF_ITERS", 5))
+
+
+def chained_time(fn, x, *rest, iters=ITERS):
+    """T(1+iters) - T(1) for out = fn(x ^ acc, *rest), acc = sum(out)."""
+
+    @jax.jit
+    def chain(x, k, *rest):
+        def body(_, acc):
+            out = fn(x ^ acc, *rest)
+            return jnp.sum(out, dtype=jnp.uint32)
+
+        return jax.lax.fori_loop(jnp.uint32(0), k, body, jnp.uint32(0))
+
+    def run(k):
+        t0 = time.perf_counter()
+        int(chain(x, jnp.uint32(k), *rest))
+        return time.perf_counter() - t0
+
+    run(1)
+    t1 = min(run(1) for _ in range(2))
+    tk = min(run(1 + iters) for _ in range(2))
+    return max(tk - t1, 1e-9) / iters
+
+
+def report(name, t, gb=None):
+    rate = f"  {gb/t:7.2f} GB/s" if gb else ""
+    print(f"{name:28s}: {t*1e3:8.2f} ms{rate}")
+
+
+def main():
+    a = AES(bytes(range(16)))
+    host = np.random.default_rng(1337).integers(0, 256, NBYTES, dtype=np.uint8)
+    words = jax.device_put(jnp.asarray(packing.np_bytes_to_words(host).reshape(-1, 4)))
+    nonce = np.frombuffer(bytes(range(16)), np.uint8)
+    ctr_be = jax.device_put(jnp.asarray(packing.np_bytes_to_words(nonce).byteswap()))
+    n = words.shape[0]
+    gb = NBYTES / 1e9
+    tile = min(pallas_aes.TILE, n // 32)
+    print(f"# {NBYTES >> 20} MiB, {n} blocks, tile={tile}, "
+          f"device={jax.devices()[0].platform}")
+
+    t = chained_time(
+        lambda c, w, rk: aes_mod.ctr_crypt_words(w, c, rk, 10), ctr_be, words,
+        a.rk_enc)
+    report("full ctr_crypt_words", t, gb)
+
+    idx = jnp.arange(n, dtype=jnp.uint32)
+    t = chained_time(lambda c: aes_mod.ctr_le_blocks(c, idx), ctr_be)
+    report("counter materialisation", t)
+
+    t = chained_time(bitslice.to_planes, words)
+    report("to_planes (one stream)", t)
+
+    planes = jax.jit(bitslice.to_planes)(words)
+    t = chained_time(bitslice.from_planes, planes)
+    report("from_planes", t)
+
+    ctr_le = jax.jit(lambda c: aes_mod.ctr_le_blocks(c, idx))(ctr_be)
+    ctr_planes = jax.jit(bitslice.to_planes)(ctr_le)
+    kp = jax.jit(lambda rk: bitslice.key_planes(rk, 10))(a.rk_enc)
+    t = chained_time(
+        lambda cp, dp, kp: pallas_aes._ctr_planes_pallas(cp, dp, kp, nr=10,
+                                                         tile=tile),
+        ctr_planes, planes, kp)
+    report("fused CTR kernel alone", t, gb)
+
+    t = chained_time(
+        lambda dp, kp: pallas_aes._crypt_planes_pallas(dp, kp, nr=10,
+                                                       decrypt=False,
+                                                       tile=tile),
+        planes, kp)
+    report("ecb kernel alone", t, gb)
+
+    t = chained_time(
+        lambda dp, kp: pallas_aes._crypt_planes_pallas(dp, kp, nr=10,
+                                                       decrypt=True,
+                                                       tile=tile),
+        planes, kp)
+    report("ecb decrypt kernel alone", t, gb)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -55,6 +55,35 @@ def test_pallas_fused_ctr_counter_carry():
     )
 
 
+def test_pallas_ctr_gen_matches_materialised():
+    """The counter-synthesising kernel (ctr_crypt_words_gen — in-kernel
+    bitsliced 128-bit ripple add) vs the counter-materialising fused kernel
+    (ctr_crypt_words) vs the layered path, across a multi-word carry: the
+    low TWO BE words are at all-ones, so the mid-batch wrap ripples through
+    64 bits — every adder lane of the in-kernel generator past word 3 is
+    exercised."""
+    from our_tree_tpu.models.aes import ctr_le_blocks
+    from our_tree_tpu.ops import pallas_aes
+    from our_tree_tpu.utils import packing
+
+    rng = np.random.default_rng(11)
+    nr, rk = expand_key_enc(bytes(range(23, 39)))
+    rk = jnp.asarray(rk)
+    nonce = np.frombuffer(
+        bytes(range(8)) + b"\xff" * 7 + b"\xf9", dtype=np.uint8
+    )  # wraps 64 bits after 7 of the 40 blocks
+    ctr_be = jnp.asarray(packing.np_bytes_to_words(nonce).byteswap())
+    w = jnp.asarray(rng.integers(0, 2**32, (40, 4)).astype(np.uint32))
+    want = np.asarray(aes_mod.ctr_crypt_words(w, ctr_be, rk, nr, "jnp"))
+    got_gen = np.asarray(pallas_aes.ctr_crypt_words_gen(w, ctr_be, rk, nr))
+    idx = jnp.arange(40, dtype=jnp.uint32)
+    got_mat = np.asarray(
+        pallas_aes.ctr_crypt_words(w, ctr_le_blocks(ctr_be, idx), rk, nr)
+    )
+    np.testing.assert_array_equal(got_gen, want)
+    np.testing.assert_array_equal(got_mat, want)
+
+
 def test_pallas_engine_ctr_context():
     """The pallas core through the CTR mode path and the AES context."""
     import numpy as np
