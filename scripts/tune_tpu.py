@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""On-hardware tuning sweep for the Pallas AES engines.
+
+Sweeps OT_PALLAS_TILE x OT_PALLAS_MC x S-box form on the live chip and
+prints a GB/s table for the north-star CTR path, using bench.py's chained
+timing (fori_loop chain + digest readback — the only honest method on
+async/tunnelled platforms). Each configuration runs in a SUBPROCESS because
+tile/MC/S-box are import-time constants; run this alone (one jax process at
+a time on tunnelled hosts).
+
+Usage: python scripts/tune_tpu.py [--bytes BYTES] [--iters K]
+Writes the winning env to stdout; docs/TUNING.md documents the knobs.
+"""
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CHILD = r"""
+import json, os, sys, time
+import numpy as np
+import jax, jax.numpy as jnp
+sys.path.insert(0, %(repo)r)
+from our_tree_tpu.models import aes as aes_mod
+from our_tree_tpu.models.aes import AES
+from our_tree_tpu.utils import packing
+
+nbytes, iters, engine = %(nbytes)d, %(iters)d, %(engine)r
+a = AES(bytes(range(16)))
+host = np.random.default_rng(1337).integers(0, 256, nbytes, dtype=np.uint8)
+words = jax.device_put(jnp.asarray(packing.np_bytes_to_words(host).reshape(-1, 4)))
+nonce = np.frombuffer(bytes(range(16)), np.uint8)
+ctr_be = jax.device_put(jnp.asarray(packing.np_bytes_to_words(nonce).byteswap()))
+ctr_fn = aes_mod.ctr_crypt_fn(a.nr, engine=engine)
+
+@jax.jit
+def chained(words, ctr_be, rk, k):
+    def body(_, acc):
+        out = ctr_fn(words, ctr_be ^ acc, rk)
+        return jnp.sum(out, dtype=jnp.uint32)
+    return jax.lax.fori_loop(jnp.uint32(0), k, body, jnp.uint32(0))
+
+def run(k):
+    t0 = time.perf_counter()
+    d = int(chained(words, ctr_be, a.rk_enc, jnp.uint32(k)))
+    return time.perf_counter() - t0, d
+
+run(1)
+t1 = min(run(1)[0] for _ in range(2))
+(tk, dig) = min((run(1 + iters) for _ in range(2)), key=lambda r: r[0])
+gbps = iters * nbytes / max(tk - t1, 1e-9) / 1e9
+print(json.dumps({"gbps": round(gbps, 3), "digest": dig}))
+"""
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--bytes", type=int, default=128 << 20)
+    ap.add_argument("--iters", type=int, default=4)
+    ap.add_argument("--timeout", type=float, default=900.0)
+    ap.add_argument("--tiles", default="512,1024,2048")
+    ap.add_argument("--mc", default="perm,roll")
+    ap.add_argument("--sbox", default="tower")
+    ap.add_argument("--engines", default="pallas")
+    args = ap.parse_args()
+
+    grid = list(itertools.product(
+        [int(t) for t in args.tiles.split(",")],
+        args.mc.split(","),
+        args.sbox.split(","),
+        args.engines.split(","),
+    ))
+    results = []
+    digests = set()
+    for tile, mc, sbox, engine in grid:
+        env = dict(os.environ, OT_PALLAS_TILE=str(tile), OT_PALLAS_MC=mc,
+                   OT_SBOX=sbox)
+        code = CHILD % {"repo": REPO, "nbytes": args.bytes,
+                        "iters": args.iters, "engine": engine}
+        tag = f"tile={tile:<5} mc={mc:<4} sbox={sbox:<5} engine={engine}"
+        try:
+            out = subprocess.run(
+                [sys.executable, "-u", "-c", code], env=env, timeout=args.timeout,
+                capture_output=True, text=True, check=True,
+            )
+            r = json.loads(out.stdout.strip().splitlines()[-1])
+            results.append((r["gbps"], tag))
+            digests.add(r["digest"])
+            print(f"{tag}  ->  {r['gbps']:7.3f} GB/s  digest={r['digest']:#010x}",
+                  flush=True)
+        except subprocess.TimeoutExpired:
+            print(f"{tag}  ->  TIMEOUT", flush=True)
+        except subprocess.CalledProcessError as e:
+            msg = (e.stderr or "").strip().splitlines()
+            print(f"{tag}  ->  FAILED ({msg[-1] if msg else 'no stderr'})",
+                  flush=True)
+    if len(digests) > 1:
+        print("WARNING: digests disagree across configs — a config computed "
+              "different ciphertext; do not trust this sweep", file=sys.stderr)
+        return 1
+    if results:
+        best = max(results)
+        print(f"\nBEST: {best[1]}  {best[0]:.3f} GB/s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
