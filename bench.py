@@ -105,13 +105,21 @@ def main() -> None:
     nonce = np.frombuffer(bytes.fromhex("f0f1f2f3f4f5f6f7f8f9fafbfcfdfeff"), np.uint8)
     ctr_be = jax.device_put(jnp.asarray(packing.np_bytes_to_words(nonce).byteswap()))
 
+    # Words cross the jit boundary as a FLAT u32 stream by default: a (N, 4)
+    # boundary array gets its 4-wide minor dim padded to the 128-lane tile on
+    # TPU (~32x HBM footprint/bandwidth); flat lays out densely and the
+    # cipher reshapes internally where the compiler can fuse it.
+    # OT_BENCH_FLAT=0 reverts for A/B measurement of exactly that effect.
+    flat = os.environ.get("OT_BENCH_FLAT", "1") not in ("0", "false")
+
     def measure(engine, nbytes, iters):
         # Fresh rng per measurement: the digest is only a cross-run
         # correctness guard if identical (engine, size) configs see
         # identical buffers, regardless of how many probes ran before.
         host = np.random.default_rng(1337).integers(0, 256, nbytes, dtype=np.uint8)
+        host_words = packing.np_bytes_to_words(host)
         words = jax.device_put(
-            jnp.asarray(packing.np_bytes_to_words(host).reshape(-1, 4))
+            jnp.asarray(host_words if flat else host_words.reshape(-1, 4))
         )
         ctr_fn = aes_mod.ctr_crypt_fn(a.nr, engine=engine)
 

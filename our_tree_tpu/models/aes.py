@@ -141,16 +141,28 @@ def ctr_keystream_words(ctr_be_words, rk, nr, nblocks_idx, engine="jnp"):
 
 @functools.partial(jax.jit, static_argnums=(3, 4))
 def ctr_crypt_words(words, ctr_be_words, rk, nr, engine="jnp"):
-    n = words.shape[0]
-    idx = jnp.arange(n, dtype=jnp.uint32)
+    """CTR over (N, 4) u32 block words — or a flat (4N,) u32 stream.
+
+    Flat inputs exist for the jit *boundary*: a (N, 4) boundary array gets
+    the default TPU layout with its 4-wide minor dim padded to the 128-lane
+    tile (~32x HBM footprint and bandwidth on staging and readback); a flat
+    stream lays out densely, and the (N, 4) view below is internal, where
+    the compiler fuses the reshape instead of materialising the padded
+    form. Same byte semantics either way.
+    """
+    flat = words.ndim == 1
+    w2 = words.reshape(-1, 4) if flat else words
+    n = w2.shape[0]
     fused = CTR_FUSED.get(engine)
     if fused is not None:
         # Fused kernel: neither the keystream nor (for counter-synthesising
         # kernels) the counter stream round-trips through HBM
         # (ops/pallas_aes.py:ctr_crypt_words_gen).
-        return fused(words, ctr_be_words, rk, nr)
-    ks = ctr_keystream_words(ctr_be_words, rk, nr, idx, engine)
-    return words ^ ks
+        out = fused(w2, ctr_be_words, rk, nr)
+    else:
+        idx = jnp.arange(n, dtype=jnp.uint32)
+        out = w2 ^ ctr_keystream_words(ctr_be_words, rk, nr, idx, engine)
+    return out.reshape(words.shape) if flat else out
 
 
 @functools.partial(jax.jit, static_argnums=(3,))
@@ -369,7 +381,10 @@ class AES:
 
         nfull = (b.size - pos) // 16
         if nfull:
-            w = jnp.asarray(_words_np(b[pos : pos + nfull * 16]))
+            # Flat u32 staging: dense boundary layout on TPU (see
+            # ctr_crypt_words — a (N, 4) boundary array pads its minor dim
+            # to the 128-lane tile).
+            w = jnp.asarray(packing.np_bytes_to_words(b[pos : pos + nfull * 16]))
             ctr_be = jnp.asarray(packing.np_bytes_to_words(nonce_counter).byteswap())
             o = ctr_crypt_words(
                 w, ctr_be, self.rk_enc, self.nr, resolve_engine(self.engine)

@@ -34,7 +34,9 @@ from our_tree_tpu.utils import packing
 nbytes, iters, engine = %(nbytes)d, %(iters)d, %(engine)r
 a = AES(bytes(range(16)))
 host = np.random.default_rng(1337).integers(0, 256, nbytes, dtype=np.uint8)
-words = jax.device_put(jnp.asarray(packing.np_bytes_to_words(host).reshape(-1, 4)))
+# Flat u32 boundary staging, matching bench.py's default (a (N, 4)
+# boundary array pads its minor dim to the 128-lane tile on TPU).
+words = jax.device_put(jnp.asarray(packing.np_bytes_to_words(host)))
 nonce = np.frombuffer(bytes(range(16)), np.uint8)
 ctr_be = jax.device_put(jnp.asarray(packing.np_bytes_to_words(nonce).byteswap()))
 ctr_fn = aes_mod.ctr_crypt_fn(a.nr, engine=engine)

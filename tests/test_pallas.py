@@ -84,6 +84,49 @@ def test_pallas_ctr_gen_matches_materialised():
     np.testing.assert_array_equal(got_mat, want)
 
 
+def test_pallas_ctr_gen_multi_grid_step(monkeypatch):
+    """Counter synthesis across grid steps: with a 128-lane tile, 12288
+    blocks give a 3-step grid, so the in-kernel block index j = 32*(g*tile
+    + lane) + t must mix the program_id into the adder correctly for g > 0
+    (a bug there is invisible to single-tile tests)."""
+    from our_tree_tpu.ops import pallas_aes
+
+    monkeypatch.setattr(pallas_aes, "TILE", 128)
+    rng = np.random.default_rng(5)
+    nr, rk = expand_key_enc(bytes(range(16)))
+    rk = jnp.asarray(rk)
+    from our_tree_tpu.utils import packing
+
+    nonce = np.frombuffer(bytes(range(100, 116)), dtype=np.uint8)
+    ctr_be = jnp.asarray(packing.np_bytes_to_words(nonce).byteswap())
+    w = jnp.asarray(rng.integers(0, 2**32, (32 * 384, 4)).astype(np.uint32))
+    got = np.asarray(pallas_aes.ctr_crypt_words_gen(w, ctr_be, rk, nr))
+    want = np.asarray(aes_mod.ctr_crypt_words(w, ctr_be, rk, nr, "jnp"))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_ctr_flat_stream_equals_block_words():
+    """ctr_crypt_words accepts a flat (4N,) u32 stream (the dense TPU
+    boundary layout — a (N, 4) boundary array pads its minor dim to the
+    128-lane tile) and must produce byte-identical output to the (N, 4)
+    form on every engine."""
+    from our_tree_tpu.utils import packing
+
+    rng = np.random.default_rng(17)
+    nr, rk = expand_key_enc(bytes(range(16)))
+    rk = jnp.asarray(rk)
+    nonce = np.frombuffer(bytes(range(50, 66)), dtype=np.uint8)
+    ctr_be = jnp.asarray(packing.np_bytes_to_words(nonce).byteswap())
+    data = rng.integers(0, 256, 16 * 77, np.uint8)
+    w2 = jnp.asarray(packing.np_bytes_to_words(data).reshape(-1, 4))
+    wf = jnp.asarray(packing.np_bytes_to_words(data))
+    for engine in ("jnp", "bitslice", "pallas"):
+        o2 = np.asarray(aes_mod.ctr_crypt_words(w2, ctr_be, rk, nr, engine))
+        of = np.asarray(aes_mod.ctr_crypt_words(wf, ctr_be, rk, nr, engine))
+        assert of.shape == (4 * 77,)
+        np.testing.assert_array_equal(of.reshape(-1, 4), o2, err_msg=engine)
+
+
 def test_pallas_engine_ctr_context():
     """The pallas core through the CTR mode path and the AES context."""
     import numpy as np
