@@ -80,8 +80,12 @@ class TpuBackend:
         cf. cudaMemcpy in reference AES.cu:236)."""
         from ..utils import packing
 
+        # Flat u32 staging: a (N, 4) boundary array would pad its 4-wide
+        # minor dim to the TPU's 128-lane tile (~32x HBM footprint and
+        # staging bandwidth); every cipher entry point accepts the flat
+        # stream (models/aes.py:ctr_crypt_words).
         return self._jax.device_put(
-            packing.np_bytes_to_words(np.ascontiguousarray(data)).reshape(-1, 4)
+            packing.np_bytes_to_words(np.ascontiguousarray(data))
         )
 
     def block_until_ready(self, x):

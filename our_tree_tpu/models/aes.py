@@ -90,14 +90,27 @@ def resolve_engine(name: str | None = "auto") -> str:
 # ---------------------------------------------------------------------------
 
 
+def _as_block_words(words):
+    """(N, 4) block view of a words argument that may be a flat (4N,) u32
+    stream. Flat is the dense TPU *boundary* layout: a (N, 4) array at a jit
+    boundary pads its 4-wide minor dim to the 128-lane tile (~32x HBM
+    footprint/bandwidth); internally the compiler fuses this reshape. Every
+    words-taking entry point goes through this ONE helper and restores the
+    caller's shape on output, so the boundary-layout decision cannot be
+    half-applied across modes."""
+    return words.reshape(-1, 4) if words.ndim == 1 else words
+
+
 @functools.partial(jax.jit, static_argnums=(2, 3))
 def ecb_encrypt_words(words, rk, nr, engine="jnp"):
-    return CORES[engine][0](words, rk, nr)
+    """Batch ECB encrypt over (N, 4) block words or a flat (4N,) stream."""
+    return CORES[engine][0](_as_block_words(words), rk, nr).reshape(words.shape)
 
 
 @functools.partial(jax.jit, static_argnums=(2, 3))
 def ecb_decrypt_words(words, rk_dec, nr, engine="jnp"):
-    return CORES[engine][1](words, rk_dec, nr)
+    """Batch ECB decrypt; flat-stream contract of ecb_encrypt_words."""
+    return CORES[engine][1](_as_block_words(words), rk_dec, nr).reshape(words.shape)
 
 
 def _add_counter_be(ctr_be: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
@@ -150,9 +163,7 @@ def ctr_crypt_words(words, ctr_be_words, rk, nr, engine="jnp"):
     the compiler fuses the reshape instead of materialising the padded
     form. Same byte semantics either way.
     """
-    flat = words.ndim == 1
-    w2 = words.reshape(-1, 4) if flat else words
-    n = w2.shape[0]
+    w2 = _as_block_words(words)
     fused = CTR_FUSED.get(engine)
     if fused is not None:
         # Fused kernel: neither the keystream nor (for counter-synthesising
@@ -160,21 +171,23 @@ def ctr_crypt_words(words, ctr_be_words, rk, nr, engine="jnp"):
         # (ops/pallas_aes.py:ctr_crypt_words_gen).
         out = fused(w2, ctr_be_words, rk, nr)
     else:
-        idx = jnp.arange(n, dtype=jnp.uint32)
+        idx = jnp.arange(w2.shape[0], dtype=jnp.uint32)
         out = w2 ^ ctr_keystream_words(ctr_be_words, rk, nr, idx, engine)
-    return out.reshape(words.shape) if flat else out
+    return out.reshape(words.shape)
 
 
 @functools.partial(jax.jit, static_argnums=(3,))
 def cbc_encrypt_words(words, iv_words, rk, nr):
+    w2 = _as_block_words(words)
+
     def step(iv, p):
         c = block.encrypt_words(p ^ iv, rk, nr)
         return c, c
 
     # unroll amortises per-step scan overhead over the unavoidable
     # block-to-block dependency (SURVEY.md §7 hard part #3).
-    iv_out, out = jax.lax.scan(step, iv_words, words, unroll=4)
-    return out, iv_out
+    iv_out, out = jax.lax.scan(step, iv_words, w2, unroll=4)
+    return out.reshape(words.shape), iv_out
 
 
 @functools.partial(jax.jit, static_argnums=(3, 4))
@@ -182,9 +195,10 @@ def _cbc_decrypt_words_impl(words, iv_words, rk_dec, nr, engine="jnp"):
     # Parallel: P_i = D(C_i) ^ C_{i-1} (C_{-1} = IV). Reference does this
     # serially (aes.c:782-796); the dependency chain only involves ciphertext,
     # so the TPU version is one batched decrypt + shifted XOR.
-    prev = jnp.concatenate([iv_words[None, :], words[:-1]], axis=0)
-    out = CORES[engine][1](words, rk_dec, nr) ^ prev
-    return out, words[-1]
+    w2 = _as_block_words(words)
+    prev = jnp.concatenate([iv_words[None, :], w2[:-1]], axis=0)
+    out = CORES[engine][1](w2, rk_dec, nr) ^ prev
+    return out.reshape(words.shape), w2[-1]
 
 
 def cbc_decrypt_words(words, iv_words, rk_dec, nr, engine="jnp"):
@@ -195,20 +209,23 @@ def cbc_decrypt_words(words, iv_words, rk_dec, nr, engine="jnp"):
 
 @functools.partial(jax.jit, static_argnums=(3,))
 def cfb128_encrypt_words(words, iv_words, rk, nr):
+    w2 = _as_block_words(words)
+
     def step(iv, p):
         c = p ^ block.encrypt_words(iv, rk, nr)
         return c, c
 
-    iv_out, out = jax.lax.scan(step, iv_words, words, unroll=4)
-    return out, iv_out
+    iv_out, out = jax.lax.scan(step, iv_words, w2, unroll=4)
+    return out.reshape(words.shape), iv_out
 
 
 @functools.partial(jax.jit, static_argnums=(3, 4))
 def cfb128_decrypt_words(words, iv_words, rk, nr, engine="jnp"):
     # Keystream block i = E(C_{i-1}) — all known up front, so parallel.
-    prev = jnp.concatenate([iv_words[None, :], words[:-1]], axis=0)
-    out = words ^ CORES[engine][0](prev, rk, nr)
-    return out, words[-1]
+    w2 = _as_block_words(words)
+    prev = jnp.concatenate([iv_words[None, :], w2[:-1]], axis=0)
+    out = w2 ^ CORES[engine][0](prev, rk, nr)
+    return out.reshape(words.shape), w2[-1]
 
 
 def ctr_crypt_fn(nr: int, engine: str = "auto"):
@@ -277,7 +294,8 @@ class AES:
         b = _to_u8(data)
         if b.size % 16:
             raise ValueError("ECB data must be a multiple of 16 bytes")
-        w = _words_np(b)
+        # Flat u32 staging: dense jit-boundary layout (_as_block_words).
+        w = packing.np_bytes_to_words(b)
         engine = resolve_engine(self.engine)
         if mode == AES_ENCRYPT:
             out = ecb_encrypt_words(jnp.asarray(w), self.rk_enc, self.nr, engine)
@@ -293,7 +311,7 @@ class AES:
         if b.size % 16:
             raise ValueError("CBC data must be a multiple of 16 bytes")
         ivw = jnp.asarray(_words_np(_to_u8(iv))[0])
-        w = jnp.asarray(_words_np(b))
+        w = jnp.asarray(packing.np_bytes_to_words(b))  # flat boundary staging
         if mode == AES_ENCRYPT:
             out, newiv = cbc_encrypt_words(w, ivw, self.rk_enc, self.nr)
         else:
@@ -330,7 +348,8 @@ class AES:
             if n == 0 and b.size - pos >= 16:
                 # Aligned bulk: batched device kernels over all full blocks.
                 nfull = (b.size - pos) // 16
-                w = jnp.asarray(_words_np(b[pos : pos + nfull * 16]))
+                w = jnp.asarray(  # flat boundary staging (_as_block_words)
+                    packing.np_bytes_to_words(b[pos : pos + nfull * 16]))
                 ivw = jnp.asarray(_words_np(iv)[0])
                 if mode == AES_ENCRYPT:
                     o, newiv = cfb128_encrypt_words(w, ivw, self.rk_enc, self.nr)

@@ -36,8 +36,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..models.aes import (CORES, CTR_FUSED, _add_counter_be, ctr_le_blocks,
-                          resolve_engine)
+from ..models.aes import (CORES, CTR_FUSED, _add_counter_be, _as_block_words,
+                          ctr_le_blocks, resolve_engine)
 
 AXIS = "shards"
 
@@ -64,7 +64,10 @@ def _pad_blocks(words: jnp.ndarray, n_shards: int):
     """Pad the block axis to a multiple of n_shards (zeros, sliced off after).
 
     Padding sits at the END of the stream, so every real block keeps its
-    global index — counter/keystream indices stay parity-exact.
+    global index — counter/keystream indices stay parity-exact. Generic
+    over dtype/shape (xor_sharded pads byte-granular ARC4 data with this
+    too); AES word-stream wrappers use _pad_word_stream for flat streams,
+    where padding must stay on whole 16-byte blocks.
     """
     n = words.shape[0]
     rem = (-n) % n_shards
@@ -72,6 +75,18 @@ def _pad_blocks(words: jnp.ndarray, n_shards: int):
         words = jnp.concatenate(
             [words, jnp.zeros((rem,) + words.shape[1:], words.dtype)], axis=0
         )
+    return words, n
+
+
+def _pad_word_stream(words: jnp.ndarray, n_shards: int):
+    """_pad_blocks for a flat (4N,) u32 block stream (dense TPU boundary
+    layout, models/aes.py:_as_block_words): pads by WHOLE 16-byte blocks to
+    a block count divisible by n_shards, so shard seams fall on block
+    boundaries and per-shard counter offsets stay exact."""
+    n = words.shape[0]
+    rem = 4 * ((-(n // 4)) % n_shards)
+    if rem:
+        words = jnp.concatenate([words, jnp.zeros(rem, words.dtype)], axis=0)
     return words, n
 
 
@@ -87,15 +102,17 @@ def _ctr_shard_body(words, ctr_be, rk, nr, axis, engine="jnp"):
     oracle (aes-modes/aes.c:869-901) across shard seams — the multi-chip
     counter bookkeeping called out as hard part #6 in SURVEY.md §7.
     """
-    n_local = words.shape[0]
+    w2 = _as_block_words(words)
+    n_local = w2.shape[0]
     base = jax.lax.axis_index(axis).astype(jnp.uint32) * jnp.uint32(n_local)
     fused = CTR_FUSED.get(engine)
     if fused is not None:  # counter + keystream stay on-chip per shard
         shard_ctr = _add_counter_be(ctr_be, base)
-        return fused(words, shard_ctr, rk, nr)
-    idx = base + jnp.arange(n_local, dtype=jnp.uint32)
-    ctr_le = ctr_le_blocks(ctr_be, idx)
-    return words ^ CORES[engine][0](ctr_le, rk, nr)
+        out = fused(w2, shard_ctr, rk, nr)
+    else:
+        idx = base + jnp.arange(n_local, dtype=jnp.uint32)
+        out = w2 ^ CORES[engine][0](ctr_le_blocks(ctr_be, idx), rk, nr)
+    return out.reshape(words.shape)
 
 
 @functools.partial(jax.jit, static_argnames=("nr", "mesh", "axis", "engine"))
@@ -120,14 +137,17 @@ def _ctr_sharded_jit(words, ctr_be, rk, *, nr, mesh, axis, engine="jnp"):
 
 def ctr_crypt_sharded(words, ctr_be, rk, nr, mesh: Mesh, axis: str = AXIS,
                       engine: str = "auto"):
-    """CTR en/decrypt (N, 4) u32 words sharded over `mesh`.
+    """CTR en/decrypt words sharded over `mesh` — (N, 4) block words or a
+    flat (4N,) u32 stream (dense TPU boundary layout; shard seams stay on
+    block boundaries either way).
 
     `ctr_be` is the initial 128-bit counter as (4,) big-endian u32 words;
     round keys are replicated to every shard (the schedule is the only
     broadcast this workload has, cf. cudaMemcpy of `ce_sched` AES.cu:222).
     """
     n_shards = mesh.devices.size
-    padded, n = _pad_blocks(words, n_shards)
+    pad = _pad_word_stream if words.ndim == 1 else _pad_blocks
+    padded, n = pad(words, n_shards)
     out = _ctr_sharded_jit(padded, ctr_be, rk, nr=nr, mesh=mesh, axis=axis,
                            engine=resolve_engine(engine))
     return out[:n]
@@ -135,7 +155,7 @@ def ctr_crypt_sharded(words, ctr_be, rk, nr, mesh: Mesh, axis: str = AXIS,
 
 def _ecb_shard_body(words, rk, nr, encrypt, engine="jnp"):
     fn = CORES[engine][0 if encrypt else 1]
-    return fn(words, rk, nr)
+    return fn(_as_block_words(words), rk, nr).reshape(words.shape)
 
 
 @functools.partial(jax.jit, static_argnames=("nr", "encrypt", "mesh", "axis", "engine"))
@@ -156,7 +176,8 @@ def ecb_crypt_sharded(words, rk, nr, mesh: Mesh, encrypt: bool = True,
     """ECB over a sharded block axis — the reference's headline parallel mode
     (each pthread ran aes_crypt_ecb over its chunk, aes-modes/test.c:37-41)."""
     n_shards = mesh.devices.size
-    padded, n = _pad_blocks(words, n_shards)
+    pad = _pad_word_stream if words.ndim == 1 else _pad_blocks
+    padded, n = pad(words, n_shards)
     out = _ecb_sharded_jit(padded, rk, nr=nr, encrypt=encrypt, mesh=mesh,
                            axis=axis, engine=resolve_engine(engine))
     return out[:n]
@@ -251,7 +272,8 @@ def _chained_dec_sharded_jit(words, iv, rk, *, nr, mesh, axis, engine, mode):
 
 
 def _chained_dec_sharded(words, iv_words, rk, nr, mesh, axis, engine, mode):
-    n = words.shape[0]
+    w2 = _as_block_words(words)
+    n = w2.shape[0]
     if n == 0:  # no-op, matching the single-chip path (models/aes.py)
         return words
     n_shards = mesh.shape[axis]
@@ -260,10 +282,11 @@ def _chained_dec_sharded(words, iv_words, rk, nr, mesh, axis, engine, mode):
             f"{mode.upper()} block count {n} must divide evenly over "
             f"{n_shards} shards (chained modes cannot be zero-padded)"
         )
-    return _chained_dec_sharded_jit(
-        words, iv_words, rk, nr=nr, mesh=mesh, axis=axis,
+    out = _chained_dec_sharded_jit(
+        w2, iv_words, rk, nr=nr, mesh=mesh, axis=axis,
         engine=resolve_engine(engine), mode=mode,
     )
+    return out.reshape(words.shape)
 
 
 def cbc_decrypt_sharded(words, iv_words, rk_dec, nr, mesh: Mesh,

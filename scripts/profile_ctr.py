@@ -61,7 +61,9 @@ def report(name, t, gb=None):
 def main():
     a = AES(bytes(range(16)))
     host = np.random.default_rng(1337).integers(0, 256, NBYTES, dtype=np.uint8)
-    words = jax.device_put(jnp.asarray(packing.np_bytes_to_words(host).reshape(-1, 4)))
+    host_words = packing.np_bytes_to_words(host)
+    flat = jax.device_put(jnp.asarray(host_words))          # dense layout
+    words = jax.device_put(jnp.asarray(host_words.reshape(-1, 4)))  # padded
     nonce = np.frombuffer(bytes(range(16)), np.uint8)
     ctr_be = jax.device_put(jnp.asarray(packing.np_bytes_to_words(nonce).byteswap()))
     n = words.shape[0]
@@ -71,9 +73,14 @@ def main():
           f"device={jax.devices()[0].platform}")
 
     t = chained_time(
+        lambda c, w, rk: aes_mod.ctr_crypt_words(w, c, rk, 10), ctr_be, flat,
+        a.rk_enc)
+    report("full ctr (flat boundary)", t, gb)
+
+    t = chained_time(
         lambda c, w, rk: aes_mod.ctr_crypt_words(w, c, rk, 10), ctr_be, words,
         a.rk_enc)
-    report("full ctr_crypt_words", t, gb)
+    report("full ctr ((N,4) boundary)", t, gb)
 
     idx = jnp.arange(n, dtype=jnp.uint32)
     t = chained_time(lambda c: aes_mod.ctr_le_blocks(c, idx), ctr_be)

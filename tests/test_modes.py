@@ -157,3 +157,49 @@ def test_cbc_chaining_vs_blockwise():
         iv = a.crypt_ecb(AES_ENCRYPT, blk)
         expect.append(iv)
     assert ct.tobytes() == np.concatenate(expect).tobytes()
+
+
+def test_mode_words_flat_stream_parity():
+    """Every words-level mode entry point accepts a flat (4N,) u32 stream
+    (the dense TPU boundary layout, models/aes.py:_as_block_words) and must
+    match the (N, 4) form — including CBC/CFB, which the benchmark harness
+    feeds flat-staged words (harness/backends.py:stage_words)."""
+    import jax.numpy as jnp
+
+    from our_tree_tpu.models import aes as aes_mod
+    from our_tree_tpu.ops.keyschedule import expand_key_dec, expand_key_enc
+    from our_tree_tpu.utils import packing
+
+    rng = np.random.default_rng(23)
+    key = bytes(range(16))
+    nr, rk = expand_key_enc(key)
+    _, rkd = expand_key_dec(key)
+    rk, rkd = jnp.asarray(rk), jnp.asarray(rkd)
+    iv = jnp.asarray(packing.np_bytes_to_words(
+        np.frombuffer(bytes(range(16, 32)), np.uint8)))
+    data = rng.integers(0, 256, 16 * 19, np.uint8)
+    w2 = jnp.asarray(packing.np_bytes_to_words(data).reshape(-1, 4))
+    wf = w2.reshape(-1)
+
+    o2, iv2 = aes_mod.cbc_encrypt_words(w2, iv, rk, nr)
+    of, ivf = aes_mod.cbc_encrypt_words(wf, iv, rk, nr)
+    np.testing.assert_array_equal(np.asarray(of).reshape(-1, 4), np.asarray(o2))
+    np.testing.assert_array_equal(np.asarray(ivf), np.asarray(iv2))
+
+    d2, l2 = aes_mod.cbc_decrypt_words(o2, iv, rkd, nr)
+    df, lf = aes_mod.cbc_decrypt_words(of, iv, rkd, nr)
+    np.testing.assert_array_equal(np.asarray(df).reshape(-1, 4), np.asarray(d2))
+    np.testing.assert_array_equal(np.asarray(lf), np.asarray(l2))
+
+    o2, iv2 = aes_mod.cfb128_encrypt_words(w2, iv, rk, nr)
+    of, ivf = aes_mod.cfb128_encrypt_words(wf, iv, rk, nr)
+    np.testing.assert_array_equal(np.asarray(of).reshape(-1, 4), np.asarray(o2))
+
+    d2, l2 = aes_mod.cfb128_decrypt_words(o2, iv, rk, nr)
+    df, lf = aes_mod.cfb128_decrypt_words(of, iv, rk, nr)
+    np.testing.assert_array_equal(np.asarray(df).reshape(-1, 4), np.asarray(d2))
+    np.testing.assert_array_equal(np.asarray(lf), np.asarray(l2))
+
+    e2 = aes_mod.ecb_encrypt_words(w2, rk, nr)
+    ef = aes_mod.ecb_encrypt_words(wf, rk, nr)
+    np.testing.assert_array_equal(np.asarray(ef).reshape(-1, 4), np.asarray(e2))

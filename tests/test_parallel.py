@@ -75,6 +75,27 @@ def test_ctr_sharded_fused_pallas_engine():
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
 
 
+@pytest.mark.parametrize("nshards", [1, 2, 8])
+def test_sharded_flat_stream_parity(nshards):
+    """Sharded ECB/CTR over a flat (4N,) u32 stream (the dense TPU boundary
+    layout) must equal the (N, 4) block-words form, including the
+    pad-to-shards path (77 blocks) where flat padding must stay on whole
+    16-byte blocks so shard seams keep exact counter indices."""
+    a = AES(KEY[:16])
+    w2 = _words(16 * 77)
+    wf = w2.reshape(-1)
+    mesh = make_mesh(nshards)
+    ctr_be = jnp.asarray(
+        packing.np_bytes_to_words(np.frombuffer(bytes(range(16, 32)), np.uint8)).byteswap()
+    )
+    ref_ctr = np.asarray(ctr_crypt_sharded(w2, ctr_be, a.rk_enc, a.nr, mesh))
+    out_ctr = np.asarray(ctr_crypt_sharded(wf, ctr_be, a.rk_enc, a.nr, mesh))
+    np.testing.assert_array_equal(out_ctr.reshape(-1, 4), ref_ctr)
+    ref_ecb = np.asarray(ecb_crypt_sharded(w2, a.rk_enc, a.nr, mesh))
+    out_ecb = np.asarray(ecb_crypt_sharded(wf, a.rk_enc, a.nr, mesh))
+    np.testing.assert_array_equal(out_ecb.reshape(-1, 4), ref_ecb)
+
+
 def test_ctr_shard_seam_counter_carry():
     """Counter must ripple across shard seams exactly as the byte-ripple
     increment of the oracle (aes.c:879-884): start the counter just below a
@@ -133,6 +154,29 @@ def test_cbc_decrypt_sharded_halo_parity():
         mesh = make_mesh(n_dev)
         out = cbc_decrypt_sharded(words, iv, a.rk_dec, a.nr, mesh)
         np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_cbc_decrypt_sharded_flat_stream():
+    """Halo-exchange CBC decrypt over a flat (4N,) stream: same bytes as the
+    (N, 4) form, and the block-count divisibility guard counts BLOCKS (a
+    flat word count divisible by shards is not enough)."""
+    from our_tree_tpu.models import aes as aes_mod
+    from our_tree_tpu.parallel import cbc_decrypt_sharded, make_mesh
+
+    rng = np.random.default_rng(33)
+    a = AES(rng.integers(0, 256, 32, np.uint8).tobytes(), engine="jnp")
+    words = jnp.asarray(rng.integers(0, 2**32, (64, 4)).astype(np.uint32))
+    iv = jnp.asarray(rng.integers(0, 2**32, 4).astype(np.uint32))
+    ref, _ = aes_mod.cbc_decrypt_words(words, iv, a.rk_dec, a.nr)
+    mesh = make_mesh(4)
+    out = cbc_decrypt_sharded(words.reshape(-1), iv, a.rk_dec, a.nr, mesh)
+    assert out.shape == (64 * 4,)
+    np.testing.assert_array_equal(np.asarray(out).reshape(-1, 4), np.asarray(ref))
+    # 77 blocks: 308 words divide over 4 shards but 77 blocks do not — the
+    # guard must reject on block count.
+    bad = jnp.asarray(rng.integers(0, 2**32, 77 * 4).astype(np.uint32))
+    with pytest.raises(ValueError, match="divide evenly"):
+        cbc_decrypt_sharded(bad, iv, a.rk_dec, a.nr, mesh)
 
 
 def test_cfb_decrypt_sharded_halo_parity():
