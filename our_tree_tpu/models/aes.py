@@ -190,6 +190,23 @@ def cbc_encrypt_words(words, iv_words, rk, nr):
     return out.reshape(words.shape), iv_out
 
 
+@functools.partial(jax.jit, static_argnums=(3,))
+def cbc_encrypt_words_batch(words, iv_words, rk, nr):
+    """Many independent CBC streams at once: vmap over the stream axis.
+
+    CBC encryption is a true per-stream recurrence (reference
+    aes.c:799-813, necessarily serial there). The sequence-parallel answer
+    is the same as ARC4's prep_batch (models/arc4.py): work that cannot
+    parallelise *within* a stream scales *across* streams — the batch axis
+    fills the VPU lanes, and parallel/dist.py shards it over chips.
+    words: (S, N, 4) block words or (S, 4N) flat streams; iv_words: (S, 4).
+    Returns (outputs, final ivs) just like cbc_encrypt_words, per stream.
+    """
+    return jax.vmap(lambda w, iv: cbc_encrypt_words(w, iv, rk, nr))(
+        words, iv_words
+    )
+
+
 @functools.partial(jax.jit, static_argnums=(3, 4))
 def _cbc_decrypt_words_impl(words, iv_words, rk_dec, nr, engine="jnp"):
     # Parallel: P_i = D(C_i) ^ C_{i-1} (C_{-1} = IV). Reference does this

@@ -304,9 +304,11 @@ SUB_ISO = _find_sub_iso()
 SUB_ISO_INV = _gf2_inv(SUB_ISO)
 
 #: δ^-1 = δ² and the Λ'·x² map of the pair-basis inversion, as GF(2) maps
-#: over the 2-bit planes.
+#: over the 2-bit planes; MAT_DELTA4 merges the two δ-terms over [hi; lo]
+#: (δ_lin = Λ'hi² + lo²) for one CSE-factored network.
 MAT_SQ2 = _linmat(lambda x: _gf4_mul(x, x), 2)
 MAT_LAMSQ2 = _linmat(lambda x: _gf4_mul(SUB_LAMBDA, _gf4_mul(x, x)), 2)
+MAT_DELTA4 = np.concatenate([MAT_LAMSQ2, MAT_SQ2], axis=1)
 
 
 def _bilinear_reduction(out_map) -> np.ndarray:
@@ -340,9 +342,13 @@ _MUL_W_W_TO_PAIR = _bilinear_reduction(
 _MUL_W_PAIR_TO_W = _bilinear_reduction(
     lambda i, j: _gf16_mul(1 << i, _psi_inv_apply(1 << j)))
 
-#: ψ∘(λ·x²) and ψ∘x² — the Δ-term maps emitting directly into pair basis.
+#: ψ∘(λ·x²) and ψ∘x² — the Δ-term maps emitting directly into pair basis,
+#: concatenated into ONE map over the stacked [a; b] planes so the CSE
+#: factoring sees (and the XOR network merges) both terms at once:
+#: Δ_lin = [ψλ(·)² | ψ(·)²] @ [a; b] = ψ(λa² + b²).
 MAT_LAMSQ4_PAIR = (SUB_ISO @ MAT_LAMSQ4) % 2
 MAT_SQ4_PAIR = (SUB_ISO @ MAT_SQ4) % 2
+MAT_DELTA8 = np.concatenate([MAT_LAMSQ4_PAIR, MAT_SQ4_PAIR], axis=1)
 
 #: x^k mod (w^4+w+1) for the 4-bit schoolbook product's degree-6 terms.
 GF16_REDUCE = []
@@ -513,14 +519,12 @@ def tower_inv_planes(p: list) -> list:
     """
     b, a = p[:4], p[4:]
     ab = _mul16_planes(a, b, _MUL_W_W_TO_PAIR)            # pair basis out
-    lam_a2 = apply_linear(MAT_LAMSQ4_PAIR, a)
-    b2 = apply_linear(MAT_SQ4_PAIR, b)
-    delta = [lam_a2[i] ^ ab[i] ^ b2[i] for i in range(4)]  # ψ(Δ)
+    dlin = apply_linear(MAT_DELTA8, a + b)                 # ψ(λa² + b²)
+    delta = [dlin[i] ^ ab[i] for i in range(4)]            # ψ(Δ)
     lo, hi = delta[:2], delta[2:]                          # Δ = hi·u + lo
     hl = gf4_mul_planes(hi, lo)
-    lam_h2 = apply_linear(MAT_LAMSQ2, hi)
-    l2 = apply_linear(MAT_SQ2, lo)
-    d = [lam_h2[i] ^ hl[i] ^ l2[i] for i in range(2)]      # δ ∈ GF(2^2)
+    dlin2 = apply_linear(MAT_DELTA4, hi + lo)              # Λ'hi² + lo²
+    d = [dlin2[i] ^ hl[i] for i in range(2)]               # δ ∈ GF(2^2)
     dinv = apply_linear(MAT_SQ2, d)                        # δ^-1 = δ²
     hi_out = gf4_mul_planes(hi, dinv)
     lo_out = gf4_mul_planes([hi[i] ^ lo[i] for i in range(2)], dinv)

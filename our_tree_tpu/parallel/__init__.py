@@ -3,6 +3,7 @@
 from .dist import (  # noqa: F401
     AXIS,
     cbc_decrypt_sharded,
+    cbc_encrypt_batch_sharded,
     cfb128_decrypt_sharded,
     ctr_crypt_sharded,
     ecb_crypt_sharded,

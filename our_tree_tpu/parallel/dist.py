@@ -37,7 +37,8 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..models.aes import (CORES, CTR_FUSED, _add_counter_be, _as_block_words,
-                          ctr_le_blocks, resolve_engine)
+                          cbc_encrypt_words_batch, ctr_le_blocks,
+                          resolve_engine)
 
 AXIS = "shards"
 
@@ -287,6 +288,36 @@ def _chained_dec_sharded(words, iv_words, rk, nr, mesh, axis, engine, mode):
         engine=resolve_engine(engine), mode=mode,
     )
     return out.reshape(words.shape)
+
+
+@functools.partial(jax.jit, static_argnames=("nr", "mesh", "axis"))
+def _cbc_batch_sharded_jit(words, ivs, rk, *, nr, mesh, axis):
+    f = jax.shard_map(
+        lambda w, iv, k: cbc_encrypt_words_batch(w, iv, k, nr),
+        mesh=mesh, in_specs=(P(axis), P(axis), P()),
+        out_specs=(P(axis), P(axis)),
+    )
+    return f(words, ivs, rk)
+
+
+def cbc_encrypt_batch_sharded(words, ivs, rk, nr, mesh: Mesh,
+                              axis: str = AXIS):
+    """Independent CBC streams sharded over chips — pipeline-style sequence
+    parallelism for the chained mode: each chip runs its own streams'
+    recurrences concurrently; streams are independent so there is no
+    cross-chip communication (cf. the reference, where the chained modes
+    simply could not use its pthread chunking at all).
+
+    words: (S, N, 4) or (S, 4N); ivs: (S, 4). The stream axis is zero-
+    padded to the shard count (padding streams are independent, so real
+    streams are unaffected) and sliced back.
+    """
+    n_shards = mesh.devices.size
+    padded_w, s = _pad_blocks(words, n_shards)
+    padded_iv, _ = _pad_blocks(ivs, n_shards)
+    out, iv_out = _cbc_batch_sharded_jit(padded_w, padded_iv, rk, nr=nr,
+                                         mesh=mesh, axis=axis)
+    return out[:s], iv_out[:s]
 
 
 def cbc_decrypt_sharded(words, iv_words, rk_dec, nr, mesh: Mesh,

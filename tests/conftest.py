@@ -25,3 +25,20 @@ if _m is None or int(_m.group(1)) < 8:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+    """Drop compiled executables after each test module.
+
+    The full suite compiles hundreds of XLA CPU programs in one process;
+    past ~130 tests the accumulated compiler state reproducibly segfaulted
+    XLA's CPU backend_compile on this class of host (single-core container,
+    jaxlib 0.9.x) — always at the same downstream compile. Each module's
+    compilations are independent, so clearing between modules keeps the
+    per-process compiler footprint bounded without affecting coverage.
+    """
+    yield
+    jax.clear_caches()

@@ -201,3 +201,30 @@ def test_chained_sharded_rejects_indivisible():
     iv = jnp.zeros(4, jnp.uint32)
     with pytest.raises(ValueError, match="divide evenly"):
         cbc_decrypt_sharded(words, iv, a.rk_dec, a.nr, make_mesh(8))
+
+
+def test_cbc_encrypt_batch_sharded_streams():
+    """Multi-stream CBC: vmapped recurrences, sharded over the stream axis
+    (the chained-mode sequence-parallelism story, like ARC4 prep_batch).
+    Must equal per-stream single-chip encryption, including with a stream
+    count that does not divide the mesh (zero-stream padding)."""
+    from our_tree_tpu.parallel import cbc_encrypt_batch_sharded, make_mesh
+
+    rng = np.random.default_rng(41)
+    a = AES(KEY, engine="jnp")
+    S, N = 6, 9  # 6 streams over 4 shards: pad path
+    words = jnp.asarray(rng.integers(0, 2**32, (S, N, 4)).astype(np.uint32))
+    ivs = jnp.asarray(rng.integers(0, 2**32, (S, 4)).astype(np.uint32))
+    mesh = make_mesh(4)
+    out, iv_out = cbc_encrypt_batch_sharded(words, ivs, a.rk_enc, a.nr, mesh)
+    for s in range(S):
+        ref, ref_iv = aes_mod.cbc_encrypt_words(words[s], ivs[s], a.rk_enc, a.nr)
+        np.testing.assert_array_equal(np.asarray(out)[s], np.asarray(ref))
+        np.testing.assert_array_equal(np.asarray(iv_out)[s], np.asarray(ref_iv))
+    # flat per-stream layout (S, 4N)
+    flat = words.reshape(S, -1)
+    outf, ivf = cbc_encrypt_batch_sharded(flat, ivs, a.rk_enc, a.nr, mesh)
+    np.testing.assert_array_equal(
+        np.asarray(outf).reshape(S, N, 4), np.asarray(out)
+    )
+    np.testing.assert_array_equal(np.asarray(ivf), np.asarray(iv_out))
