@@ -70,21 +70,34 @@ def _ensure_live_backend() -> None:
 
         jax.config.update("jax_platforms", "cpu")
         return
-    try:
-        subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=INIT_TIMEOUT_S,
-            check=True,
-            stdout=subprocess.DEVNULL,
-            stderr=subprocess.DEVNULL,
-        )
-    except (subprocess.TimeoutExpired, subprocess.CalledProcessError) as e:
-        print(f"# accelerator init probe failed ({type(e).__name__}); "
-              "falling back to CPU", file=sys.stderr)
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        import jax
+    # Up to 3 probe attempts: a tunnelled device backend can be wedged
+    # transiently (observed: PJRT client init hanging for minutes after a
+    # remote-pool hiccup, then recovering), and one failed probe would
+    # otherwise demote a healthy accelerator run to CPU numbers. Attempts
+    # stop early when the overall deadline budget runs short.
+    last = None
+    for attempt in range(3):
+        if attempt and _left() < 0.6 * DEADLINE_S:
+            break
+        try:
+            subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                timeout=INIT_TIMEOUT_S,
+                check=True,
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+            )
+            return
+        except (subprocess.TimeoutExpired, subprocess.CalledProcessError) as e:
+            last = e
+            print(f"# accelerator init probe attempt {attempt + 1} failed "
+                  f"({type(e).__name__})", file=sys.stderr)
+    print(f"# accelerator init unavailable ({type(last).__name__}); "
+          "falling back to CPU", file=sys.stderr)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
 
-        jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_platforms", "cpu")
 
 
 def main() -> None:
