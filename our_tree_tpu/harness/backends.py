@@ -89,7 +89,24 @@ class TpuBackend:
         )
 
     def block_until_ready(self, x):
-        return self._jax.block_until_ready(x)
+        """Completion barrier for timed regions.
+
+        `jax.block_until_ready` alone is not a reliable barrier on
+        remote/tunnelled device transports, where it can return before the
+        work is done (the same platform property bench.py's chained-digest
+        methodology exists for) — timing around it would under-report. A
+        scalar host readback of a REDUCTION over each result leaf forces
+        real completion on every shard (a single-element probe would only
+        force the one device owning it); the round-trip and the one
+        HBM-read reduce it adds are honest e2e cost (the reference's GPU
+        timings likewise include their sync, main_ecb_e.cu:37-44).
+        """
+        self._jax.block_until_ready(x)
+        jnp = self._jax.numpy
+        for leaf in self._jax.tree_util.tree_leaves(x):
+            if getattr(leaf, "size", 0):
+                np.asarray(jnp.max(leaf.ravel()))
+        return x
 
     # -- AES ---------------------------------------------------------------
     def make_key(self, key: bytes):
