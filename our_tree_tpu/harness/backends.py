@@ -94,18 +94,24 @@ class TpuBackend:
         `jax.block_until_ready` alone is not a reliable barrier on
         remote/tunnelled device transports, where it can return before the
         work is done (the same platform property bench.py's chained-digest
-        methodology exists for) — timing around it would under-report. A
-        scalar host readback of a REDUCTION over each result leaf forces
-        real completion on every shard (a single-element probe would only
-        force the one device owning it); the round-trip and the one
-        HBM-read reduce it adds are honest e2e cost (the reference's GPU
-        timings likewise include their sync, main_ecb_e.cu:37-44).
+        methodology exists for) — timing around it would under-report. One
+        scalar host readback PER ADDRESSABLE SHARD forces real completion
+        on every device stream at O(1) data cost each (a whole-leaf probe
+        would only force the shard owning it; a full reduction would add an
+        O(N) pass to the timed region); the fixed round-trips are honest
+        sync cost (the reference's GPU timings likewise include their sync,
+        main_ecb_e.cu:37-44).
         """
         self._jax.block_until_ready(x)
-        jnp = self._jax.numpy
         for leaf in self._jax.tree_util.tree_leaves(x):
-            if getattr(leaf, "size", 0):
-                np.asarray(jnp.max(leaf.ravel()))
+            if not getattr(leaf, "size", 0):
+                continue
+            shards = getattr(leaf, "addressable_shards", None)
+            if shards:
+                for s in shards:
+                    np.asarray(s.data.ravel()[-1:])
+            else:
+                np.asarray(leaf.ravel()[-1:])
         return x
 
     # -- AES ---------------------------------------------------------------

@@ -18,8 +18,10 @@ Differences from the reference, on purpose:
     check whose absence let reference defect #1 go unnoticed), the RC4 XOR
     phase is verified against numpy, and the run ends with known-answer
     self-tests. (The timed iterations themselves are not re-verified.)
-  * `--timing device` excludes host<->device staging (reports pure kernel
-    time); default `e2e` includes staging like the reference GPU harness
+  * `--timing device` excludes host<->device staging (reports kernel time
+    plus the O(1)-per-shard sync readback a remote transport needs for a
+    true completion barrier — backends.TpuBackend.block_until_ready);
+    default `e2e` includes staging like the reference GPU harness
     (main_ecb_e.cu:37-44).
   * sweeps are flags, not recompiles: --sizes-mb, --workers, --iters,
     --keybits, --modes, --backend, --engine.

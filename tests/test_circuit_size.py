@@ -1,0 +1,121 @@
+"""Circuit-level regression net: exhaustive S-box checks + vector-op budgets.
+
+Runs the bitsliced circuits on 256-bit Python ints (one bit per test case —
+ints support ^/&, which is all the circuit primitives use), so the whole
+exhaustive check costs milliseconds instead of the minutes the jax version
+takes, and every vector op can be *counted*. The op-count assertions guard
+the throughput engines' arithmetic budget: on TPU the bitsliced round is
+issue-limited, so a silent +20% in ops is a silent -20% in GB/s
+(docs/ENGINES.md records the measured sizes these bounds protect).
+"""
+
+import types
+
+import numpy as np
+import pytest
+
+from our_tree_tpu.ops import bitslice, tables
+
+MASK = (1 << 256) - 1
+
+
+class OpInt(int):
+    """int wrapper counting XOR/AND ops globally."""
+
+    counts = {"xor": 0, "and": 0, "or": 0}
+
+    def __xor__(self, o):
+        OpInt.counts["xor"] += 1
+        return OpInt(int(self) ^ int(o))
+
+    __rxor__ = __xor__
+
+    def __and__(self, o):
+        OpInt.counts["and"] += 1
+        return OpInt(int(self) & int(o))
+
+    __rand__ = __and__
+
+    def __or__(self, o):
+        OpInt.counts["or"] += 1
+        return OpInt(int(self) | int(o))
+
+    __ror__ = __or__
+
+
+def _reset():
+    OpInt.counts = {"xor": 0, "and": 0, "or": 0}
+
+
+def _total():
+    return sum(OpInt.counts.values())
+
+
+def _planes_all_bytes():
+    # plane[i] = int whose bit v (v in 0..255) is bit i of byte value v.
+    return [OpInt(sum(((v >> i) & 1) << v for v in range(256)))
+            for i in range(8)]
+
+
+def _extract(planes) -> np.ndarray:
+    return np.array([
+        sum(((int(planes[i]) >> v) & 1) << i for i in range(len(planes)))
+        for v in range(256)
+    ])
+
+
+@pytest.fixture
+def int_circuit(monkeypatch):
+    """Route the circuit's few jnp touchpoints to int-compatible stubs."""
+    stub = types.SimpleNamespace(
+        uint32=lambda v=0: OpInt(v),
+        zeros_like=lambda x: OpInt(0),
+        full_like=lambda x, v: OpInt(MASK if v else 0),
+        stack=lambda xs, axis=0: list(xs),
+    )
+    monkeypatch.setattr(bitslice, "jnp", stub)
+    monkeypatch.setattr(
+        bitslice, "xor_const",
+        lambda p, c: [x ^ OpInt(MASK) if (c >> i) & 1 else x
+                      for i, x in enumerate(p)],
+    )
+    _reset()
+
+
+def test_sbox_exhaustive_and_budget(int_circuit):
+    out = _extract(bitslice.sbox_planes(_planes_all_bytes()))
+    np.testing.assert_array_equal(out, np.asarray(tables.SBOX))
+    assert _total() <= 180, f"forward S-box grew to {_total()} vector ops"
+
+
+def test_inv_sbox_exhaustive_and_budget(int_circuit):
+    out = _extract(bitslice.inv_sbox_planes(_planes_all_bytes()))
+    np.testing.assert_array_equal(out, np.asarray(tables.INV_SBOX))
+    assert _total() <= 185, f"inverse S-box grew to {_total()} vector ops"
+
+
+def test_sbox_chain_formulation_exhaustive(int_circuit, monkeypatch):
+    monkeypatch.setattr(bitslice, "SBOX_IMPL", "chain")
+    out = _extract(bitslice.sbox_planes(_planes_all_bytes()))
+    np.testing.assert_array_equal(out, np.asarray(tables.SBOX))
+
+
+def test_round_budget(int_circuit):
+    """Full rounds on (8, 16) object planes; budget in (16, W)-op units."""
+    def mk(seed):
+        arr = np.empty((8, 16), dtype=object)
+        for b in range(8):
+            for pos in range(16):
+                arr[b, pos] = OpInt((seed + b * 16 + pos)
+                                    * 0x9E3779B97F4A7C15 & MASK)
+        return arr
+
+    def perm_stack(x, idx):
+        return np.array([x[int(j)] for j in idx], dtype=object)
+
+    for fn, budget in ((bitslice.encrypt_round, 230),
+                       (bitslice.decrypt_round, 250)):
+        _reset()
+        fn(mk(3), mk(5), False, perm=perm_stack, mc="perm")
+        per16 = _total() / 16
+        assert per16 <= budget, f"{fn.__name__} grew to {per16:.0f} ops"
