@@ -2,6 +2,7 @@
 
 from .dist import (  # noqa: F401
     AXIS,
+    block_cyclic_to_contiguous,
     cbc_decrypt_sharded,
     cbc_encrypt_batch_sharded,
     cfb128_decrypt_sharded,

@@ -20,8 +20,10 @@ The TPU-native re-design of that scheme (SURVEY.md §7 layer 6):
     produce bit-identical keystream to the single-chip path — the
     shard-invariance property the reference never tested (and whose absence
     let defect #1 in SURVEY.md §2 go unnoticed),
-  * no collectives in the hot path; an optional `all_gather` exists only for
-    verification, mirroring how the reference verified nothing.
+  * no collectives in the cipher hot path; the collectives that do exist
+    each earn their place — the chained-mode halo `ppermute`
+    (cbc/cfb128_decrypt_sharded), the ingest re-layout `all_to_all`
+    (block_cyclic_to_contiguous), and a verification-only `all_gather`.
 
 Everything here also runs unmodified on a single device (mesh of 1) and on
 CPU-simulated meshes (tests/conftest.py forces 8 virtual CPU devices).
@@ -218,6 +220,51 @@ def gather_for_verification(x, mesh: Mesh, axis: str = AXIS):
         check_vma=False,  # all_gather output is replicated; not inferred
     )
     return f(padded)[:n]
+
+
+def block_cyclic_to_contiguous(x, mesh: Mesh, axis: str = AXIS):
+    """All-to-all layout exchange: round-robin-sharded rows -> the
+    contiguous-range sharding every cipher kernel here assumes.
+
+    A producer that deals rows out round-robin (shard s holds global rows
+    s, s+S, s+2S, ...) cannot feed the CTR/ECB kernels directly — their
+    per-shard counter/offset math needs each chip to own one contiguous
+    range (the reference's chunk split, test.c:51-53). This converts
+    layouts entirely on-device with ONE `lax.all_to_all` over ICI: shard s
+    slices its local rows into S groups by destination and receives its
+    contiguous range's elements from everyone — no host gather, no
+    full-array replication. Leading-axis length must divide evenly
+    (cyclic layouts have no natural padding rows).
+
+    With ppermute (halo exchange), all_gather (verification), and this
+    all-to-all, the framework exercises each collective class the
+    mesh/ICI design calls for.
+    """
+    S = mesh.devices.size
+    n = x.shape[0]
+    if n % (S * S):
+        # Each shard must slice its n/S local rows into S equal groups.
+        raise ValueError(
+            f"row count {n} must be divisible by shards^2 ({S * S}) for an "
+            "even all-to-all exchange"
+        )
+
+    def body(local):
+        # local rows of shard s: global rows s + k*S (k = 0..n/S-1), i.e.
+        # destination shard of local row k is k // (n/S/S). all_to_all
+        # sends slice j of the split axis to shard j and concatenates what
+        # arrives; interleaving each received group back by stride-S order
+        # restores global order within the contiguous range.
+        g = local.reshape((S, n // S // S) + local.shape[1:])
+        recv = jax.lax.all_to_all(g, axis, split_axis=0, concat_axis=0,
+                                  tiled=False)
+        # recv[src, k] = global row (s * n//S) + k*S + src of this shard's
+        # contiguous range -> transpose the (k, src) order.
+        out = jnp.swapaxes(recv, 0, 1).reshape((n // S,) + local.shape[1:])
+        return out
+
+    f = jax.shard_map(body, mesh=mesh, in_specs=P(axis), out_specs=P(axis))
+    return f(x)
 
 
 # ---------------------------------------------------------------------------
