@@ -2,6 +2,7 @@
 
 from .dist import (  # noqa: F401
     AXIS,
+    arc4_prep_batch_sharded,
     block_cyclic_to_contiguous,
     cbc_decrypt_sharded,
     cbc_encrypt_batch_sharded,

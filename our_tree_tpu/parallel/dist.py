@@ -41,6 +41,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..models.aes import (CORES, CTR_FUSED, _add_counter_be, _as_block_words,
                           cbc_encrypt_words_batch, ctr_le_blocks,
                           resolve_engine)
+from ..models.arc4 import keystream_scan_batch
 
 AXIS = "shards"
 
@@ -365,6 +366,42 @@ def cbc_encrypt_batch_sharded(words, ivs, rk, nr, mesh: Mesh,
     out, iv_out = _cbc_batch_sharded_jit(padded_w, padded_iv, rk, nr=nr,
                                          mesh=mesh, axis=axis)
     return out[:s], iv_out[:s]
+
+
+@functools.partial(jax.jit, static_argnames=("length", "mesh", "axis"))
+def _arc4_batch_sharded_jit(xs, ys, ms, *, length, mesh, axis):
+    def body(x, y, m):
+        (nx, ny, nm), ks = keystream_scan_batch((x, y, m), length)
+        return nx, ny, nm, ks
+
+    f = jax.shard_map(
+        body, mesh=mesh, in_specs=(P(axis), P(axis), P(axis)),
+        out_specs=(P(axis), P(axis), P(axis), P(axis)),
+    )
+    return f(xs, ys, ms)
+
+
+def arc4_prep_batch_sharded(states, length: int, mesh: Mesh,
+                            axis: str = AXIS):
+    """Keystreams for many independent ARC4 streams, sharded over chips.
+
+    The keygen recurrence is the reference's sequential phase
+    (arc4.c:72-97); like cbc_encrypt_batch_sharded, what cannot
+    parallelise within a stream scales across streams — each chip scans
+    its own streams concurrently, no cross-chip communication.
+    ``states`` = (x, y, m) with shapes ((S,), (S,), (S, 256)) uint32;
+    returns ((x', y', m'), keystream (S, length) uint8), stream count
+    zero-padded to the shard count and sliced back.
+    """
+    xs, ys, ms = states
+    s = xs.shape[0]
+    n_shards = mesh.devices.size
+    xs, _ = _pad_blocks(xs, n_shards)
+    ys, _ = _pad_blocks(ys, n_shards)
+    ms, _ = _pad_blocks(ms, n_shards)
+    nx, ny, nm, ks = _arc4_batch_sharded_jit(xs, ys, ms, length=length,
+                                             mesh=mesh, axis=axis)
+    return (nx[:s], ny[:s], nm[:s]), ks[:s]
 
 
 def cbc_decrypt_sharded(words, iv_words, rk_dec, nr, mesh: Mesh,

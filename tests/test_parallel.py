@@ -261,3 +261,29 @@ def test_block_cyclic_to_contiguous_all_to_all(nshards):
 
     with pytest.raises(ValueError, match="divisible"):
         block_cyclic_to_contiguous(jnp.asarray(G[: S * S + 1]), mesh)
+
+
+def test_arc4_prep_batch_sharded_streams():
+    """Multi-stream ARC4 keystream generation sharded over chips: each
+    chip scans its own streams (the sequential phase scales across
+    streams, like cbc_encrypt_batch_sharded). Matches the host PRGA per
+    stream, including the resumable (x, y, m) state, with a stream count
+    that does not divide the mesh."""
+    from our_tree_tpu.models.arc4 import key_schedule, keystream_np
+    from our_tree_tpu.parallel import arc4_prep_batch_sharded, make_mesh
+
+    keys = [bytes([i]) * (i + 3) for i in range(5)]  # 5 streams, 4 shards
+    length = 96
+    ms = np.stack([key_schedule(k) for k in keys]).astype(np.uint32)
+    states = (
+        jnp.zeros(len(keys), jnp.uint32),
+        jnp.zeros(len(keys), jnp.uint32),
+        jnp.asarray(ms),
+    )
+    (nx, ny, nm), ks = arc4_prep_batch_sharded(states, length, make_mesh(4))
+    for i, k in enumerate(keys):
+        want, (wx, wy, wm) = keystream_np((0, 0, key_schedule(k)), length)
+        np.testing.assert_array_equal(np.asarray(ks)[i], want)
+        assert (int(np.asarray(nx)[i]), int(np.asarray(ny)[i])) == (wx, wy)
+        np.testing.assert_array_equal(
+            np.asarray(nm)[i].astype(np.uint8), wm)
