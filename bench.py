@@ -186,7 +186,15 @@ def main() -> None:
             "auto" if requested == "probe" else requested
         )
 
-    default_bytes = 128 << 20 if engine not in ("jnp",) else 16 << 20
+    # 256 MiB headline for the throughput engines (flat staging keeps the
+    # HBM footprint at buffer size; BASELINE.json's metric is a 1 GiB
+    # buffer — OT_BENCH_BYTES=1073741824 runs exactly that when the
+    # staging/deadline budget allows).
+    default_bytes = 256 << 20 if engine not in ("jnp",) else 16 << 20
+    if not flat:
+        # The (N, 4) A/B layout occupies ~32x the buffer in HBM (minor-dim
+        # padding); 256 MiB x 32 x (in + out) would exceed a v5e's 16 GB.
+        default_bytes = min(default_bytes, 128 << 20)
     if platform == "cpu":
         default_bytes = min(default_bytes, 64 << 20)
     nbytes = int(os.environ.get("OT_BENCH_BYTES", default_bytes))
