@@ -88,6 +88,12 @@ def _pad_word_stream(words: jnp.ndarray, n_shards: int):
     a block count divisible by n_shards, so shard seams fall on block
     boundaries and per-shard counter offsets stay exact."""
     n = words.shape[0]
+    if n % 4:
+        raise ValueError(
+            f"flat word stream length must be a multiple of 4 u32 words "
+            f"(one 16-byte block), got {n} words — pad the byte stream to "
+            "16-byte blocks before sharding"
+        )
     rem = 4 * ((-(n // 4)) % n_shards)
     if rem:
         words = jnp.concatenate([words, jnp.zeros(rem, words.dtype)], axis=0)

@@ -68,7 +68,16 @@ def main():
     ctr_be = jax.device_put(jnp.asarray(packing.np_bytes_to_words(nonce).byteswap()))
     n = words.shape[0]
     gb = NBYTES / 1e9
-    tile = min(pallas_aes.TILE, n // 32)
+    # The raw _*_planes_pallas helpers are called below with pre-made plane
+    # tiles and no padding of their own, so pad the block batch exactly the
+    # way every production entry point does (_lane_pad_and_tile) — the
+    # kernel-alone timings then run at the production tile choice instead
+    # of a shrunken ad-hoc one, and any OT_PROF_BYTES value is legal.
+    pad, tile = pallas_aes._lane_pad_and_tile(n)
+    kwords = words
+    if pad:
+        kwords = jnp.concatenate(
+            [words, jnp.zeros((pad, 4), words.dtype)], axis=0)
     print(f"# {NBYTES >> 20} MiB, {n} blocks, tile={tile}, "
           f"device={jax.devices()[0].platform}")
 
@@ -82,14 +91,16 @@ def main():
         a.rk_enc)
     report("full ctr ((N,4) boundary)", t, gb)
 
-    idx = jnp.arange(n, dtype=jnp.uint32)
+    # Kernel-alone components run on the padded batch (kwords), matching the
+    # block count and tile the production entry points hand the kernels.
+    idx = jnp.arange(n + pad, dtype=jnp.uint32)
     t = chained_time(lambda c: aes_mod.ctr_le_blocks(c, idx), ctr_be)
     report("counter materialisation", t)
 
-    t = chained_time(bitslice.to_planes, words)
+    t = chained_time(bitslice.to_planes, kwords)
     report("to_planes (one stream)", t)
 
-    planes = jax.jit(bitslice.to_planes)(words)
+    planes = jax.jit(bitslice.to_planes)(kwords)
     t = chained_time(bitslice.from_planes, planes)
     report("from_planes", t)
 
