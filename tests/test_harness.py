@@ -34,6 +34,70 @@ def test_bench_rejects_unknown_mode():
         bench_mod.main(["--sizes-mb", "0.001", "--modes", "rot13", "--iters", "1"])
 
 
+def test_bench_batch_modes(tmp_path):
+    """cbc-batch / rc4-batch sweep rows: multi-stream sequence parallelism
+    driven from the CLI, with worker-count invariance checked in-run."""
+    out = tmp_path / "results.test.tpu"
+    rc = bench_mod.main([
+        "--sizes-mb", "0.0625", "--workers", "1,2", "--iters", "2",
+        "--modes", "cbc-batch,rc4-batch", "--streams", "4",
+        "--out", str(out),
+    ])
+    assert rc == 0
+    lines = out.read_text().splitlines()
+    cbc_rows = [l for l in lines if l.startswith("TPU AES-256 CBC-BATCHx4")]
+    assert len(cbc_rows) == 2
+    for row in cbc_rows:
+        fields = [f for f in row.split(",") if f.strip()]
+        assert fields[1].strip() == "65536"
+        assert int(fields[2]) in (1, 2)
+        assert len(fields) == 3 + 2
+    assert any(l.startswith("RC4-KEYGEN-BATCHx4, 65536, 2") for l in lines)
+    assert any(l.startswith("Generated 4 key schedules in") for l in lines)
+    assert "CBC-batch shard invariance [1, 2]: passed" in lines
+    assert "RC4-batch shard invariance [1, 2]: passed" in lines
+
+
+def test_backend_chained_modes_reject_workers():
+    """Both backends' cbc/cfb128 must reject workers > 1 loudly, not
+    silently ignore them (a silently-ignored knob is how the reference's
+    defect #1 class of bug survives)."""
+    import jax.numpy as jnp
+
+    from our_tree_tpu.harness.backends import make_backend
+
+    backend = make_backend("tpu")
+    ctx = backend.make_key(bytes(32))
+    words = jnp.zeros(16, jnp.uint32)
+    ivw = jnp.zeros(4, jnp.uint32)
+    for fn in (backend.cbc, backend.cfb128):
+        with pytest.raises(ValueError, match="sequential"):
+            fn(ctx, words, ivw, 2)
+
+    cback = make_backend("c")
+    cctx = cback.make_key(bytes(32))
+    data = np.zeros(32, np.uint8)
+    iv = np.zeros(16, np.uint8)
+    for fn in (cback.cbc, cback.cfb128):
+        with pytest.raises(ValueError, match="sequential"):
+            fn(cctx, data, iv, 2)
+
+
+def test_bench_cbc_pins_workers(tmp_path):
+    """A cbc sweep with a multi-worker list pins to workers=1 and announces
+    it in the results, instead of dying or silently ignoring the flag."""
+    out = tmp_path / "results.test.tpu"
+    rc = bench_mod.main([
+        "--sizes-mb", "0.0625", "--workers", "1,2", "--iters", "1",
+        "--modes", "cbc", "--out", str(out),
+    ])
+    assert rc == 0
+    lines = out.read_text().splitlines()
+    assert any("sweeping workers=1 only" in l for l in lines)
+    rows = [l for l in lines if l.startswith("TPU AES-256 CBC,")]
+    assert len(rows) == 1 and rows[0].split(",")[2].strip() == "1"
+
+
 def test_decrypt_cli_nist_roundtrip(capsys):
     key = "000102030405060708090a0b0c0d0e0f"
     assert decrypt_mod.main([key, "00112233445566778899aabbccddeeff",
@@ -64,10 +128,51 @@ def test_decrypt_cli_cbc_ctr_match_context(capsys):
         assert got == expect.tobytes().hex()
 
 
+def test_decrypt_cli_cfb128_roundtrip_and_resume(capsys):
+    """--mode cfb128: odd lengths are legal (byte-granular), decrypt inverts
+    encrypt, and --iv-off resumes mid-block exactly like the context API's
+    iv_off carry (reference aes.c:822-863)."""
+    rng = np.random.default_rng(11)
+    key = rng.integers(0, 256, 32, np.uint8)
+    iv = rng.integers(0, 256, 16, np.uint8)
+    data = rng.integers(0, 256, 53, np.uint8)  # odd, > 3 blocks
+    from our_tree_tpu.models.aes import AES, AES_DECRYPT, AES_ENCRYPT
+
+    a = AES(key.tobytes())
+    expect, _, _ = a.crypt_cfb128(AES_ENCRYPT, 0, iv, data)
+    assert decrypt_mod.main([
+        key.tobytes().hex(), data.tobytes().hex(),
+        "--encrypt", "--mode", "cfb128", "--iv", iv.tobytes().hex(),
+    ]) == 0
+    assert capsys.readouterr().out.strip() == expect.tobytes().hex()
+
+    assert decrypt_mod.main([
+        key.tobytes().hex(), expect.tobytes().hex(),
+        "--mode", "cfb128", "--iv", iv.tobytes().hex(),
+    ]) == 0
+    assert capsys.readouterr().out.strip() == data.tobytes().hex()
+
+    # Resume: crypt the first 5 bytes through the context API, then hand the
+    # carried (iv_off, iv register) to the CLI for the tail.
+    head, off, reg = a.crypt_cfb128(AES_DECRYPT, 0, iv, expect[:5])
+    assert off == 5
+    assert decrypt_mod.main([
+        key.tobytes().hex(), expect[5:].tobytes().hex(),
+        "--mode", "cfb128", "--iv", reg.tobytes().hex(),
+        "--iv-off", str(off),
+    ]) == 0
+    tail = capsys.readouterr().out.strip()
+    assert head.tobytes().hex() + tail == data.tobytes().hex()
+
+
 def test_decrypt_cli_rejects_bad_input(capsys):
     assert decrypt_mod.main(["zz", "00" * 16]) == 1
     assert decrypt_mod.main(["00" * 5, "00" * 16]) == 1
     assert decrypt_mod.main(["00" * 16, "00" * 15]) == 1
+    assert decrypt_mod.main(["00" * 16, "00" * 16, "--mode", "cfb128",
+                             "--iv-off", "16"]) == 1
+    assert decrypt_mod.main(["00" * 16, "00" * 16, "--mode", "ctr",
+                             "--iv-off", "5"]) == 1
 
 
 def test_bench_c_backend_cli(tmp_path):
