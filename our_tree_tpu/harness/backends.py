@@ -174,12 +174,53 @@ class TpuBackend:
         return out
 
     def cbc(self, ctx, words, iv_words, workers: int):
+        if workers != 1:
+            raise ValueError(
+                "single-stream CBC encrypt is a sequential recurrence and "
+                "cannot shard over workers; use cbc-batch (independent "
+                "streams sharded over chips) for multi-worker scaling"
+            )
         out, _ = self._aes_mod.cbc_encrypt_words(words, iv_words, ctx.rk_enc, ctx.nr)
         return out
 
     def cfb128(self, ctx, words, iv_words, workers: int):
+        if workers != 1:
+            raise ValueError(
+                "single-stream CFB128 encrypt is a sequential recurrence and "
+                "cannot shard over workers; batch independent streams instead"
+            )
         out, _ = self._aes_mod.cfb128_encrypt_words(words, iv_words, ctx.rk_enc, ctx.nr)
         return out
+
+    # -- batch sequence parallelism (independent streams over chips) -------
+    def stage_batch_words(self, data2d: np.ndarray):
+        """(S, bytes_per_stream) byte matrix -> device (S, 4N) u32 words."""
+        from ..utils import packing
+
+        w = packing.np_bytes_to_words(np.ascontiguousarray(data2d).reshape(-1))
+        return self._jax.device_put(w.reshape(data2d.shape[0], -1))
+
+    def cbc_batch(self, ctx, words_2d, ivs_2d, workers: int):
+        """S independent CBC-encrypt streams sharded over `workers` chips —
+        what cannot parallelise within a chained stream scales across
+        streams (parallel/dist.py:cbc_encrypt_batch_sharded)."""
+        out, _ = self._dist.cbc_encrypt_batch_sharded(
+            words_2d, ivs_2d, ctx.rk_enc, ctx.nr, self._mesh(workers)
+        )
+        return out
+
+    def arc4_batch_states(self, keys: list[bytes]):
+        """Host-side KSA for S streams (the reference's sequential `setup`
+        phase, arc4.c:43-67) -> (x, y, m) state stacks for the batch scan."""
+        return self._ARC4.batch_states(keys)
+
+    def arc4_prep_batch(self, states, length: int, workers: int):
+        """S independent keystream scans sharded over `workers` chips;
+        returns the (S, length) uint8 keystream batch (device)."""
+        _, ks = self._dist.arc4_prep_batch_sharded(
+            states, length, self._mesh(workers)
+        )
+        return ks
 
     def ctr_be_words(self, nonce: np.ndarray):
         import jax.numpy as jnp

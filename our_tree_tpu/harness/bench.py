@@ -74,6 +74,17 @@ def _time_us(fn) -> tuple[int, object]:
 def run_aes_mode(em, backend, mode, size, workers_list, iters, keybits, rng,
                  timing, stream_chunk=0):
     msg = rng.integers(0, 256, size, dtype=np.uint8)
+    if mode in ("cbc", "cfb128") and workers_list != [1]:
+        # Single-stream chained encrypt is a sequential recurrence — the
+        # backend rejects workers > 1 rather than silently ignoring them, so
+        # the sweep pins the row to one worker and says so in the results
+        # (scaling chained modes means batching independent streams; the
+        # sweep surface for that is cbc-batch).
+        hint = ("use cbc-batch for multi-worker scaling" if mode == "cbc"
+                else "chained modes scale by batching independent streams")
+        em.line(f"{mode.upper()} single-stream is sequential; sweeping "
+                f"workers=1 only ({hint}),")
+        workers_list = [1]
     streaming = (
         stream_chunk and mode == "ctr" and size > stream_chunk
         and hasattr(backend, "ctr_stream")
@@ -137,6 +148,111 @@ def run_aes_mode(em, backend, mode, size, workers_list, iters, keybits, rng,
             times.append(us)
         label = backend.name.upper()
         em.line(f"{label} AES-{keybits} {mode.upper()}, {size}, {workers}, {_csv(times)}")
+
+
+def run_cbc_batch(em, backend, size, workers_list, iters, keybits, rng,
+                  timing, streams):
+    """S independent CBC-encrypt streams, sharded over chips — the sweep
+    surface for dist.cbc_encrypt_batch_sharded (sequence parallelism for
+    chained modes: scale across streams, not within one)."""
+    if not hasattr(backend, "cbc_batch"):
+        raise ValueError("cbc-batch requires the tpu backend")
+    streams = max(1, min(streams, size // 16))
+    per = (size // streams) // 16 * 16
+    used = per * streams
+    em.line(f"Batch of {streams} independent CBC streams, {per} bytes each,")
+    msg = rng.integers(0, 256, (streams, per), dtype=np.uint8)
+    inv_key = rng.integers(0, 256, keybits // 8, dtype=np.uint8).tobytes()
+    inv_ivs = rng.integers(0, 256, (streams, 16), dtype=np.uint8)
+    inv_ref = None
+    for workers in workers_list:
+        times = []
+        warmed = False
+        for _ in range(iters):
+            key = rng.integers(0, 256, keybits // 8, dtype=np.uint8).tobytes()
+            ctx = backend.make_key(key)
+            ivs = rng.integers(0, 256, (streams, 16), dtype=np.uint8)
+            ivw = backend.stage_batch_words(ivs)
+            run = lambda w: backend.cbc_batch(ctx, w, ivw, workers)
+            if not warmed:
+                backend.block_until_ready(run(backend.stage_batch_words(msg)))
+                warmed = True
+            if timing == "device":
+                words = backend.stage_batch_words(msg)
+                backend.block_until_ready(words)
+                us, _ = _time_us(
+                    lambda: backend.block_until_ready(run(words)))
+            else:
+                us, _ = _time_us(
+                    lambda: backend.block_until_ready(
+                        run(backend.stage_batch_words(msg))))
+            times.append(us)
+        em.line(f"{backend.name.upper()} AES-{keybits} CBC-BATCHx{streams}, "
+                f"{used}, {workers}, {_csv(times)}")
+        # Worker-count invariance on a fixed key/IV set (the same determinism
+        # check the block-mode sweeps run); compare-and-discard so peak host
+        # memory stays at one extra output regardless of the worker list.
+        ctx = backend.make_key(inv_key)
+        got = np.asarray(backend.block_until_ready(
+            backend.cbc_batch(ctx, backend.stage_batch_words(msg),
+                              backend.stage_batch_words(inv_ivs), workers)))
+        if inv_ref is None:
+            inv_ref = got
+        elif not np.array_equal(got, inv_ref):
+            em.line(f"CBC-BATCH SHARD-INVARIANCE FAILED at workers={workers}")
+            raise SystemExit(2)
+    if len(workers_list) > 1:  # one worker count = nothing was compared
+        em.line(f"CBC-batch shard invariance {workers_list}: passed")
+
+
+def run_rc4_batch(em, backend, size, workers_list, iters, rng, streams):
+    """S independent RC4 keystream scans sharded over chips — the sweep
+    surface for dist.arc4_prep_batch_sharded (the sequential keygen phase
+    scaled across streams). Rows are device-timed by construction: the
+    keystream is generated on device and stays there for the XOR phase, so
+    there is no staging to include (announced in the output)."""
+    if not hasattr(backend, "arc4_prep_batch"):
+        raise ValueError("rc4-batch requires the tpu backend")
+    streams = max(1, min(streams, size))
+    per = size // streams
+    used = per * streams
+    em.line(f"Batch of {streams} independent RC4 keystreams, {per} bytes "
+            "each (device timing: keystreams are born and stay on device),")
+    keys = [rng.integers(0, 256, 16, dtype=np.uint8).tobytes()
+            for _ in range(streams)]
+    # The KSA phase is timed separately, mirroring the reference's separate
+    # "Generated a new key in" keygen line (test.c:84-91).
+    us, states = _time_us(lambda: backend.arc4_batch_states(keys))
+    em.line(f"Generated {streams} key schedules in {us}, ")
+    inv_ref = None
+    for workers in workers_list:
+        backend.block_until_ready(
+            backend.arc4_prep_batch(states, per, workers))  # untimed compile
+        times = []
+        out = None
+        for _ in range(iters):
+            us, out = _time_us(
+                lambda: backend.block_until_ready(
+                    backend.arc4_prep_batch(states, per, workers))
+            )
+            times.append(us)
+        em.line(f"RC4-KEYGEN-BATCHx{streams}, {used}, {workers}, {_csv(times)}")
+        got = np.asarray(out)
+        if inv_ref is None:
+            inv_ref = got
+        elif not np.array_equal(got, inv_ref):
+            em.line(f"RC4-BATCH SHARD-INVARIANCE FAILED at workers={workers}")
+            raise SystemExit(2)
+    # Stream 0 against the single-stream scan: the batch path must produce
+    # the same keystream bytes the resumable single-stream API does.
+    from ..models.arc4 import ARC4
+
+    if not np.array_equal(inv_ref[0], ARC4(keys[0]).prep(per)):
+        em.line("RC4-BATCH PARITY FAILED vs single-stream prep")
+        raise SystemExit(2)
+    em.line("RC4-batch parity vs single-stream: passed")
+    if len(workers_list) > 1:  # one worker count = nothing was compared
+        em.line(f"RC4-batch shard invariance {workers_list}: passed")
 
 
 def check_shard_invariance(em, backend, size, workers_list, keybits, rng):
@@ -229,7 +345,12 @@ def main(argv=None) -> int:
     ap.add_argument("--iters", type=int, default=10)
     ap.add_argument("--keybits", type=int, default=256, choices=(128, 192, 256))
     ap.add_argument("--modes", default="ecb,ctr,rc4",
-                    help="comma list from ecb,ctr,cbc,cfb128,rc4")
+                    help="comma list from ecb,ctr,cbc,cfb128,rc4,"
+                         "cbc-batch,rc4-batch")
+    ap.add_argument("--streams", type=int, default=32,
+                    help="independent streams for the batch modes "
+                         "(cbc-batch/rc4-batch): the stream axis is the "
+                         "parallel axis that shards over chips")
     ap.add_argument("--seed", type=int, default=1337)
     ap.add_argument("--timing", default="e2e", choices=("e2e", "device"),
                     help="e2e includes host<->device staging (reference GPU "
@@ -286,6 +407,12 @@ def main(argv=None) -> int:
             for size in sizes:
                 if mode == "rc4":
                     run_rc4(em, backend, size, workers_list, args.iters, rng)
+                elif mode == "cbc-batch":
+                    run_cbc_batch(em, backend, size, workers_list, args.iters,
+                                  args.keybits, rng, args.timing, args.streams)
+                elif mode == "rc4-batch":
+                    run_rc4_batch(em, backend, size, workers_list, args.iters,
+                                  rng, args.streams)
                 else:
                     run_aes_mode(em, backend, mode, size, workers_list,
                                  args.iters, args.keybits, rng, args.timing,

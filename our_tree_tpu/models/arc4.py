@@ -122,6 +122,18 @@ class ARC4:
         return np.asarray(ks)
 
     @staticmethod
+    def batch_states(keys: list[bytes]):
+        """KSA for many keys -> the (x, y, m) state stacks the batch scan
+        takes: ((S,), (S,), (S, 256)) uint32. The one construction shared
+        by prep_batch and the sharded bench path (backends.py)."""
+        ms = np.stack([key_schedule(k) for k in keys]).astype(np.uint32)
+        return (
+            jnp.zeros(len(keys), jnp.uint32),
+            jnp.zeros(len(keys), jnp.uint32),
+            jnp.asarray(ms),
+        )
+
+    @staticmethod
     def prep_batch(keys: list[bytes], length: int) -> np.ndarray:
         """Keystreams for many independent keys in one device call.
 
@@ -130,13 +142,7 @@ class ARC4:
         batch axis is the parallel axis, like CTR's counter axis). Returns
         (len(keys), length) uint8.
         """
-        ms = np.stack([key_schedule(k) for k in keys]).astype(np.uint32)
-        states = (
-            jnp.zeros(len(keys), jnp.uint32),
-            jnp.zeros(len(keys), jnp.uint32),
-            jnp.asarray(ms),
-        )
-        _, ks = keystream_scan_batch(states, length)
+        _, ks = keystream_scan_batch(ARC4.batch_states(keys), length)
         return np.asarray(ks)
 
     def crypt(self, data, keystream=None) -> np.ndarray:

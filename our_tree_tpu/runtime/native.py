@@ -226,10 +226,20 @@ class CBackend:
         return out
 
     def cbc(self, ctx: NativeAES, data, iv, workers: int):
+        if workers != 1:
+            raise ValueError(
+                "single-stream CBC encrypt is a sequential recurrence and "
+                "cannot split over workers (same contract as TpuBackend.cbc)"
+            )
         out, _ = ctx.cbc(iv, data, encrypt=True)
         return out
 
     def cfb128(self, ctx: NativeAES, data, iv, workers: int):
+        if workers != 1:
+            raise ValueError(
+                "single-stream CFB128 encrypt is a sequential recurrence and "
+                "cannot split over workers (same contract as TpuBackend.cfb128)"
+            )
         out, _, _ = ctx.cfb128(0, iv, data, encrypt=True)
         return out
 
