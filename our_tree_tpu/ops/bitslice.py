@@ -535,23 +535,173 @@ def tower_inv_planes(p: list) -> list:
     return b_out + a_out
 
 
-#: S-box implementation: "tower" (composite field, default — fewest vector
-#: ops) or "chain" (the x^254 addition chain, kept as an independent
-#: formulation for cross-checking and benchmarking). OT_SBOX overrides.
+#: S-box implementation: "tower" (composite field, default — derived
+#: construction, fewest ops among the derived forms), "bp" (the fixed
+#: Boyar–Peralta 115-gate circuit — fewer ops still, forward direction;
+#: see _bp_sbox_core), or "chain" (the x^254 addition chain, kept as an
+#: independent formulation for cross-checking and benchmarking).
+#: OT_SBOX overrides; all three are exhaustively pinned against
+#: tables.SBOX by tests/test_circuit_size.py + test_bitslice.py.
 SBOX_IMPL = os.environ.get("OT_SBOX", "tower")
-if SBOX_IMPL not in ("tower", "chain"):
-    raise ValueError(f"OT_SBOX must be 'tower' or 'chain', got {SBOX_IMPL!r}")
+if SBOX_IMPL not in ("tower", "bp", "chain"):
+    raise ValueError(
+        f"OT_SBOX must be 'tower', 'bp' or 'chain', got {SBOX_IMPL!r}"
+    )
+
+
+def _bp_sbox_core(p: list) -> list:
+    """Boyar–Peralta forward S-box, minus the final 0x63 complement.
+
+    The 115-gate (32 AND + 83 XOR/XNOR) combinational AES S-box from
+    Boyar & Peralta, "A new combinational logic minimization technique
+    with applications to cryptology" (SEA 2010) — a public, fixed circuit:
+    a 23-XOR top linear layer computing 22 shared signals, a 30-gate shared
+    GF(2^4) inversion middle, 18 AND "output multipliers", and a 26-XOR
+    bottom linear layer. Its four XNOR outputs are exactly the S-box affine
+    constant 0x63, so this core emits the pure-XOR form and the caller
+    applies the shared ``xor_const(…, AFF_CONST)`` — identical accounting
+    to the other formulations.
+
+    Wire convention: the circuit's U0/S0 are the byte's MSB; our plane
+    lists are LSB-first, hence the reversed pick-up/return order.
+    """
+    u0, u1, u2, u3, u4, u5, u6, u7 = reversed(p)
+    # Top linear layer.
+    y14 = u3 ^ u5
+    y13 = u0 ^ u6
+    y9 = u0 ^ u3
+    y8 = u0 ^ u5
+    t0 = u1 ^ u2
+    y1 = t0 ^ u7
+    y4 = y1 ^ u3
+    y12 = y13 ^ y14
+    y2 = y1 ^ u0
+    y5 = y1 ^ u6
+    y3 = y5 ^ y8
+    t1 = u4 ^ y12
+    y15 = t1 ^ u5
+    y20 = t1 ^ u1
+    y6 = y15 ^ u7
+    y10 = y15 ^ t0
+    y11 = y20 ^ y9
+    y7 = u7 ^ y11
+    y17 = y10 ^ y11
+    y19 = y10 ^ y8
+    y16 = t0 ^ y11
+    y21 = y13 ^ y16
+    y18 = u0 ^ y16
+    # Shared nonlinear middle (GF(2^4) inversion).
+    t2 = y12 & y15
+    t3 = y3 & y6
+    t4 = t3 ^ t2
+    t5 = y4 & u7
+    t6 = t5 ^ t2
+    t7 = y13 & y16
+    t8 = y5 & y1
+    t9 = t8 ^ t7
+    t10 = y2 & y7
+    t11 = t10 ^ t7
+    t12 = y9 & y11
+    t13 = y14 & y17
+    t14 = t13 ^ t12
+    t15 = y8 & y10
+    t16 = t15 ^ t12
+    t17 = t4 ^ t14
+    t18 = t6 ^ t16
+    t19 = t9 ^ t14
+    t20 = t11 ^ t16
+    t21 = t17 ^ y20
+    t22 = t18 ^ y19
+    t23 = t19 ^ y21
+    t24 = t20 ^ y18
+    t25 = t21 ^ t22
+    t26 = t21 & t23
+    t27 = t24 ^ t26
+    t28 = t25 & t27
+    t29 = t28 ^ t22
+    t30 = t23 ^ t24
+    t31 = t22 ^ t26
+    t32 = t31 & t30
+    t33 = t32 ^ t24
+    t34 = t23 ^ t33
+    t35 = t27 ^ t33
+    t36 = t24 & t35
+    t37 = t36 ^ t34
+    t38 = t27 ^ t36
+    t39 = t29 & t38
+    t40 = t25 ^ t39
+    t41 = t40 ^ t37
+    t42 = t29 ^ t33
+    t43 = t29 ^ t40
+    t44 = t33 ^ t37
+    t45 = t42 ^ t41
+    # Output multipliers.
+    z0 = t44 & y15
+    z1 = t37 & y6
+    z2 = t33 & u7
+    z3 = t43 & y16
+    z4 = t40 & y1
+    z5 = t29 & y7
+    z6 = t42 & y11
+    z7 = t45 & y17
+    z8 = t41 & y10
+    z9 = t44 & y12
+    z10 = t37 & y3
+    z11 = t33 & y4
+    z12 = t43 & y13
+    z13 = t40 & y5
+    z14 = t29 & y2
+    z15 = t42 & y9
+    z16 = t45 & y14
+    z17 = t41 & y8
+    # Bottom linear layer (XNORs dropped: folded into the 0x63 constant).
+    t46 = z15 ^ z16
+    t47 = z10 ^ z11
+    t48 = z5 ^ z13
+    t49 = z9 ^ z10
+    t50 = z2 ^ z12
+    t51 = z2 ^ z5
+    t52 = z7 ^ z8
+    t53 = z0 ^ z3
+    t54 = z6 ^ z7
+    t55 = z16 ^ z17
+    t56 = z12 ^ t48
+    t57 = t50 ^ t53
+    t58 = z4 ^ t46
+    t59 = z3 ^ t54
+    t60 = t46 ^ t57
+    t61 = z14 ^ t57
+    t62 = t52 ^ t58
+    t63 = t49 ^ t58
+    t64 = z4 ^ t59
+    t65 = t61 ^ t62
+    t66 = z1 ^ t63
+    s0 = t59 ^ t63
+    s6 = t56 ^ t62
+    s7 = t48 ^ t60
+    t67 = t64 ^ t65
+    s3 = t53 ^ t66
+    s4 = t51 ^ t66
+    s5 = t47 ^ t65
+    s1 = t64 ^ s3
+    s2 = t55 ^ t67
+    return [s7, s6, s5, s4, s3, s2, s1, s0]
 
 
 def sbox_planes(p: list) -> list:
     if SBOX_IMPL == "tower":
         t = tower_inv_planes(apply_linear(M_SBOX_IN, p))
         return xor_const(apply_linear(M_SBOX_OUT, t), AFF_CONST)
+    if SBOX_IMPL == "bp":
+        return xor_const(_bp_sbox_core(p), AFF_CONST)
     return xor_const(apply_linear(MAT_AFF, gf_inv_planes(p)), AFF_CONST)
 
 
 def inv_sbox_planes(p: list) -> list:
-    if SBOX_IMPL == "tower":
+    if SBOX_IMPL in ("tower", "bp"):
+        # Boyar–Peralta published no comparably small inverse circuit; the
+        # decrypt direction keeps the tower formulation under OT_SBOX=bp
+        # (the north-star path — CTR — only ever uses the forward S-box).
         t = apply_linear(M_ISBOX_IN, xor_const(list(p), AFF_CONST))
         return apply_linear(M_ISBOX_OUT, tower_inv_planes(t))
     return gf_inv_planes(apply_linear(MAT_AFF_INV, xor_const(list(p), AFF_CONST)))

@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""Hardware compile smoke for every Pallas kernel variant (run on TPU).
+
+Asserts that each kernel actually COMPILES under Mosaic (non-interpret) and
+matches the jnp engine bit-exactly on device — the guard against shipping
+kernels that only ever ran in interpreter mode (cf. the reference's GPU
+kernels, which never executed at benchmark sizes because launches failed
+unchecked — SURVEY.md §2 defect #4). Protects the tuning sweep
+(scripts/tune_tpu.py) from dying at compile time mid-run.
+
+Matrix: {ecb-enc, ecb-dec, ctr-fused, ctr-gen, ctr-sharded(mesh 1)}
+      x MC lowering {perm, roll}  x  tile {1024, 2048}.
+
+OT_PALLAS_TILE / OT_PALLAS_MC are read at module import, so each config
+runs in its own subprocess (also: exactly one jax process at a time —
+sequential children, never parallel, per the host's tunnel constraints).
+
+    python scripts/smoke_tpu.py                 # full matrix
+    python scripts/smoke_tpu.py --tiles 1024 --mc perm   # subset
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: 2 MiB -> 131072 blocks -> 4096 lanes: >= 2 grid steps even at tile 2048,
+#: so every config exercises a real multi-step grid, not a shrunken tile.
+NBYTES = int(os.environ.get("OT_SMOKE_BYTES", 2 << 20))
+
+
+def child() -> int:
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    sys.path.insert(0, REPO)
+    from our_tree_tpu.models.aes import AES
+    from our_tree_tpu.ops import pallas_aes
+    from our_tree_tpu.parallel import dist
+    from our_tree_tpu.utils import packing
+
+    platform = jax.devices()[0].platform
+    if platform == "cpu":
+        print(json.dumps({"config": "n/a", "ok": False,
+                          "error": "no accelerator (interpret mode)"}))
+        return 1
+    assert not pallas_aes._interpret(), "interpret mode on an accelerator?"
+
+    cfg = f"tile={pallas_aes.TILE},mc={pallas_aes.MC_LOWERING}"
+    a = AES(bytes(range(16)))
+    rng = np.random.default_rng(1337)
+    host = rng.integers(0, 256, NBYTES, dtype=np.uint8)
+    words = jax.device_put(jnp.asarray(packing.np_bytes_to_words(host)))
+    nonce = np.frombuffer(bytes(range(16)), np.uint8)
+    ctr_be = jax.device_put(jnp.asarray(
+        packing.np_bytes_to_words(nonce).byteswap()))
+
+    from our_tree_tpu.models import aes as aes_mod
+
+    def check(name, fn, want_fn):
+        t0 = time.perf_counter()
+        got = np.asarray(jax.block_until_ready(jax.jit(fn)(words)))
+        dt = time.perf_counter() - t0
+        want = np.asarray(jax.block_until_ready(jax.jit(want_fn)(words)))
+        ok = bool(np.array_equal(got, want))
+        print(json.dumps({"config": cfg, "kernel": name, "ok": ok,
+                          "compile_plus_run_s": round(dt, 1)}), flush=True)
+        if not ok:
+            raise SystemExit(f"{cfg} {name}: MISMATCH vs jnp engine")
+
+    check("ecb-enc",
+          lambda w: pallas_aes.encrypt_words(
+              w.reshape(-1, 4), a.rk_enc, a.nr),
+          lambda w: aes_mod.ecb_encrypt_words(w, a.rk_enc, a.nr, "jnp"))
+    check("ecb-dec",
+          lambda w: pallas_aes.decrypt_words(
+              w.reshape(-1, 4), a.rk_dec, a.nr),
+          lambda w: aes_mod.ecb_decrypt_words(w, a.rk_dec, a.nr, "jnp"))
+    check("ctr-fused",
+          lambda w: pallas_aes.ctr_crypt_words(
+              w.reshape(-1, 4),
+              aes_mod.ctr_le_blocks(
+                  ctr_be, jnp.arange(w.size // 4, dtype=jnp.uint32)),
+              a.rk_enc, a.nr),
+          lambda w: aes_mod.ctr_crypt_words(w, ctr_be, a.rk_enc, a.nr, "jnp"))
+    check("ctr-gen",
+          lambda w: pallas_aes.ctr_crypt_words_gen(
+              w.reshape(-1, 4), ctr_be, a.rk_enc, a.nr),
+          lambda w: aes_mod.ctr_crypt_words(w, ctr_be, a.rk_enc, a.nr, "jnp"))
+
+    # shard_map + pallas on hardware (the check_vma-workaround combination
+    # that CI only ever runs on CPU): a 1-device mesh on the real chip.
+    mesh = dist.make_mesh(1)
+    check("ctr-sharded-pallas",
+          lambda w: dist.ctr_crypt_sharded(
+              w, ctr_be, a.rk_enc, a.nr, mesh, engine="pallas"),
+          lambda w: aes_mod.ctr_crypt_words(w, ctr_be, a.rk_enc, a.nr, "jnp"))
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tiles", default="1024,2048")
+    ap.add_argument("--mc", default="perm,roll")
+    ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
+    args = ap.parse_args()
+    if args.child:
+        return child()
+
+    failures = 0
+    for tile in args.tiles.split(","):
+        for mc in args.mc.split(","):
+            env = dict(os.environ,
+                       OT_PALLAS_TILE=tile.strip(), OT_PALLAS_MC=mc.strip())
+            print(f"## tile={tile} mc={mc}", flush=True)
+            try:
+                rc = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__), "--child"],
+                    env=env, timeout=1800,
+                ).returncode
+            except subprocess.TimeoutExpired:
+                # A hung Mosaic compile is a failing config, not a reason to
+                # abandon the rest of the matrix — the survey must finish.
+                rc = -1
+            if rc:
+                failures += 1
+                print(f"## tile={tile} mc={mc} FAILED rc={rc}", flush=True)
+    print(f"SMOKE {'FAIL' if failures else 'PASS'} "
+          f"({failures} failing configs)")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -45,11 +45,12 @@ def test_inv_sbox_circuit_exhaustive():
     np.testing.assert_array_equal(out, np.asarray(tables.INV_SBOX, dtype=np.uint8))
 
 
-@pytest.mark.parametrize("impl", ["tower", "chain"])
+@pytest.mark.parametrize("impl", ["tower", "bp", "chain"])
 def test_sbox_impls_exhaustive(impl, monkeypatch):
-    """Both S-box formulations — the composite-field tower (default) and the
-    x^254 addition chain — must match the table for every byte, in both
-    directions. Two independent derivations cross-checking each other."""
+    """Every S-box formulation — the composite-field tower (default), the
+    fixed Boyar–Peralta circuit, and the x^254 addition chain — must match
+    the table for every byte, in both directions. Independent derivations
+    cross-checking each other."""
     monkeypatch.setattr(bitslice, "SBOX_IMPL", impl)
     pl = _all_bytes_planes()
     out = _planes_to_first_byte(bitslice.sbox_planes([pl[i] for i in range(8)]))

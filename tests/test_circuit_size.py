@@ -64,6 +64,20 @@ def _extract(planes) -> np.ndarray:
     ])
 
 
+def _mk_round_planes(seed):
+    """(8, 16) object planes of distinct OpInt bitsets for round-cost tests."""
+    arr = np.empty((8, 16), dtype=object)
+    for b in range(8):
+        for pos in range(16):
+            arr[b, pos] = OpInt((seed + b * 16 + pos)
+                                * 0x9E3779B97F4A7C15 & MASK)
+    return arr
+
+
+def _perm_stack(x, idx):
+    return np.array([x[int(j)] for j in idx], dtype=object)
+
+
 @pytest.fixture
 def int_circuit(monkeypatch):
     """Route the circuit's few jnp touchpoints to int-compatible stubs."""
@@ -100,22 +114,42 @@ def test_sbox_chain_formulation_exhaustive(int_circuit, monkeypatch):
     np.testing.assert_array_equal(out, np.asarray(tables.SBOX))
 
 
+def test_sbox_bp_formulation_exhaustive_and_budget(int_circuit, monkeypatch):
+    """Boyar–Peralta circuit: all 256 inputs + the op budget it exists for
+    (115 core gates + the 4 affine-constant complements = 119, vs the
+    tower's 174)."""
+    monkeypatch.setattr(bitslice, "SBOX_IMPL", "bp")
+    out = _extract(bitslice.sbox_planes(_planes_all_bytes()))
+    np.testing.assert_array_equal(out, np.asarray(tables.SBOX))
+    assert _total() <= 120, f"BP S-box grew to {_total()} vector ops"
+    assert OpInt.counts["and"] == 32, "BP nonlinearity must stay 32 ANDs"
+
+
+def test_sbox_bp_inverse_falls_back_exhaustive(int_circuit, monkeypatch):
+    """Under OT_SBOX=bp the inverse S-box keeps the tower formulation and
+    must still be exhaustively correct."""
+    monkeypatch.setattr(bitslice, "SBOX_IMPL", "bp")
+    out = _extract(bitslice.inv_sbox_planes(_planes_all_bytes()))
+    np.testing.assert_array_equal(out, np.asarray(tables.INV_SBOX))
+
+
 def test_round_budget(int_circuit):
     """Full rounds on (8, 16) object planes; budget in (16, W)-op units."""
-    def mk(seed):
-        arr = np.empty((8, 16), dtype=object)
-        for b in range(8):
-            for pos in range(16):
-                arr[b, pos] = OpInt((seed + b * 16 + pos)
-                                    * 0x9E3779B97F4A7C15 & MASK)
-        return arr
-
-    def perm_stack(x, idx):
-        return np.array([x[int(j)] for j in idx], dtype=object)
-
     for fn, budget in ((bitslice.encrypt_round, 230),
                        (bitslice.decrypt_round, 250)):
         _reset()
-        fn(mk(3), mk(5), False, perm=perm_stack, mc="perm")
+        fn(_mk_round_planes(3), _mk_round_planes(5), False,
+           perm=_perm_stack, mc="perm")
         per16 = _total() / 16
         assert per16 <= budget, f"{fn.__name__} grew to {per16:.0f} ops"
+
+
+def test_round_budget_bp(int_circuit, monkeypatch):
+    """Encrypt round under the Boyar–Peralta S-box: the 174 -> 119 S-box cut
+    must show up as a ~162-unit round (the whole point of OT_SBOX=bp)."""
+    monkeypatch.setattr(bitslice, "SBOX_IMPL", "bp")
+    _reset()
+    bitslice.encrypt_round(_mk_round_planes(3), _mk_round_planes(5), False,
+                           perm=_perm_stack, mc="perm")
+    per16 = _total() / 16
+    assert per16 <= 175, f"bp encrypt_round grew to {per16:.0f} ops"
