@@ -53,7 +53,10 @@ def child() -> int:
         return 1
     assert not pallas_aes._interpret(), "interpret mode on an accelerator?"
 
-    cfg = f"tile={pallas_aes.TILE},mc={pallas_aes.MC_LOWERING}"
+    from our_tree_tpu.ops import bitslice
+
+    cfg = (f"tile={pallas_aes.TILE},mc={pallas_aes.MC_LOWERING},"
+           f"sbox={bitslice.SBOX_IMPL}")
     a = AES(bytes(range(16)))
     rng = np.random.default_rng(1337)
     host = rng.integers(0, 256, NBYTES, dtype=np.uint8)
@@ -64,12 +67,25 @@ def child() -> int:
 
     from our_tree_tpu.models import aes as aes_mod
 
-    def check(name, fn, want_fn):
+    # Each distinct jnp reference is computed once per child (the CTR one
+    # serves three checks). ravel() both sides: the pallas entry points
+    # return (N, 4) where the flat-stream references return (4N,) — the
+    # byte streams are what must agree, not the container shape.
+    want_ecb = np.asarray(jax.block_until_ready(
+        jax.jit(lambda w: aes_mod.ecb_encrypt_words(
+            w, a.rk_enc, a.nr, "jnp"))(words))).ravel()
+    want_dec = np.asarray(jax.block_until_ready(
+        jax.jit(lambda w: aes_mod.ecb_decrypt_words(
+            w, a.rk_dec, a.nr, "jnp"))(words))).ravel()
+    want_ctr = np.asarray(jax.block_until_ready(
+        jax.jit(lambda w: aes_mod.ctr_crypt_words(
+            w, ctr_be, a.rk_enc, a.nr, "jnp"))(words))).ravel()
+
+    def check(name, fn, want):
         t0 = time.perf_counter()
         got = np.asarray(jax.block_until_ready(jax.jit(fn)(words)))
         dt = time.perf_counter() - t0
-        want = np.asarray(jax.block_until_ready(jax.jit(want_fn)(words)))
-        ok = bool(np.array_equal(got, want))
+        ok = bool(np.array_equal(got.ravel(), want))
         print(json.dumps({"config": cfg, "kernel": name, "ok": ok,
                           "compile_plus_run_s": round(dt, 1)}), flush=True)
         if not ok:
@@ -77,31 +93,26 @@ def child() -> int:
 
     check("ecb-enc",
           lambda w: pallas_aes.encrypt_words(
-              w.reshape(-1, 4), a.rk_enc, a.nr),
-          lambda w: aes_mod.ecb_encrypt_words(w, a.rk_enc, a.nr, "jnp"))
+              w.reshape(-1, 4), a.rk_enc, a.nr), want_ecb)
     check("ecb-dec",
           lambda w: pallas_aes.decrypt_words(
-              w.reshape(-1, 4), a.rk_dec, a.nr),
-          lambda w: aes_mod.ecb_decrypt_words(w, a.rk_dec, a.nr, "jnp"))
+              w.reshape(-1, 4), a.rk_dec, a.nr), want_dec)
     check("ctr-fused",
           lambda w: pallas_aes.ctr_crypt_words(
               w.reshape(-1, 4),
               aes_mod.ctr_le_blocks(
                   ctr_be, jnp.arange(w.size // 4, dtype=jnp.uint32)),
-              a.rk_enc, a.nr),
-          lambda w: aes_mod.ctr_crypt_words(w, ctr_be, a.rk_enc, a.nr, "jnp"))
+              a.rk_enc, a.nr), want_ctr)
     check("ctr-gen",
           lambda w: pallas_aes.ctr_crypt_words_gen(
-              w.reshape(-1, 4), ctr_be, a.rk_enc, a.nr),
-          lambda w: aes_mod.ctr_crypt_words(w, ctr_be, a.rk_enc, a.nr, "jnp"))
+              w.reshape(-1, 4), ctr_be, a.rk_enc, a.nr), want_ctr)
 
     # shard_map + pallas on hardware (the check_vma-workaround combination
     # that CI only ever runs on CPU): a 1-device mesh on the real chip.
     mesh = dist.make_mesh(1)
     check("ctr-sharded-pallas",
           lambda w: dist.ctr_crypt_sharded(
-              w, ctr_be, a.rk_enc, a.nr, mesh, engine="pallas"),
-          lambda w: aes_mod.ctr_crypt_words(w, ctr_be, a.rk_enc, a.nr, "jnp"))
+              w, ctr_be, a.rk_enc, a.nr, mesh, engine="pallas"), want_ctr)
     return 0
 
 
@@ -109,6 +120,9 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--tiles", default="1024,2048")
     ap.add_argument("--mc", default="perm,roll")
+    ap.add_argument("--sbox", default="tower,bp",
+                    help="S-box formulations to compile-test (the tuning "
+                         "sweep runs both; so must the smoke)")
     ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
     args = ap.parse_args()
     if args.child:
@@ -117,21 +131,24 @@ def main() -> int:
     failures = 0
     for tile in args.tiles.split(","):
         for mc in args.mc.split(","):
-            env = dict(os.environ,
-                       OT_PALLAS_TILE=tile.strip(), OT_PALLAS_MC=mc.strip())
-            print(f"## tile={tile} mc={mc}", flush=True)
-            try:
-                rc = subprocess.run(
-                    [sys.executable, os.path.abspath(__file__), "--child"],
-                    env=env, timeout=1800,
-                ).returncode
-            except subprocess.TimeoutExpired:
-                # A hung Mosaic compile is a failing config, not a reason to
-                # abandon the rest of the matrix — the survey must finish.
-                rc = -1
-            if rc:
-                failures += 1
-                print(f"## tile={tile} mc={mc} FAILED rc={rc}", flush=True)
+            for sbox in args.sbox.split(","):
+                env = dict(os.environ, OT_PALLAS_TILE=tile.strip(),
+                           OT_PALLAS_MC=mc.strip(), OT_SBOX=sbox.strip())
+                tag = f"tile={tile} mc={mc} sbox={sbox}"
+                print(f"## {tag}", flush=True)
+                try:
+                    rc = subprocess.run(
+                        [sys.executable, os.path.abspath(__file__), "--child"],
+                        env=env, timeout=1800,
+                    ).returncode
+                except subprocess.TimeoutExpired:
+                    # A hung Mosaic compile is a failing config, not a reason
+                    # to abandon the rest of the matrix — the survey must
+                    # finish.
+                    rc = -1
+                if rc:
+                    failures += 1
+                    print(f"## {tag} FAILED rc={rc}", flush=True)
     print(f"SMOKE {'FAIL' if failures else 'PASS'} "
           f"({failures} failing configs)")
     return 1 if failures else 0
