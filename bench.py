@@ -146,6 +146,13 @@ def _stage_alarm(seconds: float):
         signal.signal(signal.SIGALRM, old)
 
 
+def _stage_budget(preferred: float) -> float:
+    """Clamp a stage-alarm budget to the actually-remaining deadline (minus
+    reporting headroom) so a hung stage can never run the process past the
+    point where an external killer would cut it with no JSON line."""
+    return max(1.0, min(preferred, _left() - 5.0))
+
+
 def _native_cpu_bytes() -> int:
     n = int(os.environ.get("OT_BENCH_BYTES", 256 << 20))
     return n - n % 16
@@ -242,7 +249,8 @@ def main() -> None:
         # mid-readback must become a catchable failure, not a silent stall
         # past the driver's own timeout with no JSON line. Callers bound
         # cheap stages (probes) tighter than the headline.
-        with _stage_alarm(stage_budget or max(60.0, _left() - 30.0)):
+        with _stage_alarm(_stage_budget(
+                stage_budget or max(60.0, _left() - 30.0))):
             run(1)  # compile + warm-up (single executable for every k)
             t1 = min(run(1)[0] for _ in range(2))
             (tk, digest), (tk2, _) = run(1 + iters), run(1 + iters)
@@ -304,13 +312,16 @@ def main() -> None:
             print(f"# headline failed ({type(e).__name__}); "
                   "reporting probe-size result", file=sys.stderr)
             if not probes:
-                if platform == "cpu":
-                    raise  # plain CPU failure: no tunnel story to fall to
-                # Nothing device-side ever succeeded (e.g. half-recovered
-                # tunnel: init ok, execution hung until the stage alarm).
-                # Last resort: the native host runtime, clearly labeled, so
-                # the round still records a real framework number instead
-                # of a crash with no JSON line.
+                if platform == "cpu" or not isinstance(e, TimeoutError):
+                    # Plain CPU failure, or a real device-side error (compile
+                    # failure, OOM): surface it — converting a regression
+                    # into a plausible-looking CPU record would hide it.
+                    raise
+                # The stage alarm fired with nothing device-side succeeded:
+                # a half-recovered tunnel (init ok, execution hung). Last
+                # resort: the native host runtime, clearly labeled, so the
+                # round still records a real framework number instead of a
+                # crash with no JSON line.
                 print("# no device measurement succeeded; trying the "
                       "native host runtime", file=sys.stderr)
                 try:

@@ -124,6 +124,12 @@ def _out_struct(x: jnp.ndarray) -> jax.ShapeDtypeStruct:
     return jax.ShapeDtypeStruct(x.shape, x.dtype, vma=vma)
 
 
+def interpret_mode() -> bool:
+    """Public alias of _interpret for other modules (parallel/dist.py keys
+    its shard_map vma-check workaround on interpreter mode)."""
+    return _interpret()
+
+
 def _interpret() -> bool:
     """Interpreter mode unless a real TPU device is attached.
 

@@ -42,6 +42,7 @@ from ..models.aes import (CORES, CTR_FUSED, _add_counter_be, _as_block_words,
                           cbc_encrypt_words_batch, ctr_le_blocks,
                           resolve_engine)
 from ..models.arc4 import keystream_scan_batch
+from ..ops.pallas_aes import interpret_mode as _pallas_interpret
 
 AXIS = "shards"
 
@@ -132,15 +133,17 @@ def _ctr_sharded_jit(words, ctr_be, rk, *, nr, mesh, axis, engine="jnp"):
         mesh=mesh,
         in_specs=(P(axis), P(), P()),
         out_specs=P(axis),
-        # Disabled only where the engine routes into a pallas kernel: jax
-        # 0.9.0's pallas interpreter drops vma tags across its internal
-        # scan, so the kernel's round fori_loop fails shard_map's carry
-        # check ("Scan carry input and output got mismatched varying manual
-        # axes") even though values are correct — reproduced by
-        # ctr_crypt_sharded(engine="pallas") on an 8-virtual-device CPU
-        # mesh. Other engines keep the full vma safety check; pallas shard
+        # Disabled only where the engine routes into a pallas kernel AND the
+        # kernel runs in interpreter mode: jax 0.9.0's pallas *interpreter*
+        # drops vma tags across its internal scan, so the kernel's round
+        # fori_loop fails shard_map's carry check ("Scan carry input and
+        # output got mismatched varying manual axes") even though values are
+        # correct — reproduced by ctr_crypt_sharded(engine="pallas") on an
+        # 8-virtual-device CPU mesh. On real hardware (Mosaic compile, no
+        # interpreter) the full vma safety check stays on; CPU pallas shard
         # parity is covered by test_parallel instead.
-        check_vma=engine not in CTR_FUSED and engine != "pallas",
+        check_vma=(engine not in CTR_FUSED and engine != "pallas")
+        or not _pallas_interpret(),
     )
     return f(words, ctr_be, rk)
 
@@ -176,7 +179,7 @@ def _ecb_sharded_jit(words, rk, *, nr, encrypt, mesh, axis, engine="jnp"):
         in_specs=(P(axis), P()),
         out_specs=P(axis),
         # same pallas-interpreter vma drop; see _ctr_sharded_jit
-        check_vma=engine != "pallas",
+        check_vma=engine != "pallas" or not _pallas_interpret(),
     )
     return f(words, rk)
 

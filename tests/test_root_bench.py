@@ -1,0 +1,65 @@
+"""Driver-entry bench.py: stage alarm + fallback contract.
+
+The repo-root bench.py is the artifact the driver records each round
+(BENCH_r{N}.json); these tests pin the behaviors that keep it from ever
+stalling with no JSON line (the round-1 failure mode was a wedged tunnel).
+"""
+
+import importlib.util
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import time
+
+import pytest
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _load_root_bench():
+    spec = importlib.util.spec_from_file_location("rootbench", ROOT / "bench.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_stage_alarm_interrupts_and_clears():
+    rb = _load_root_bench()
+    t0 = time.perf_counter()
+    with pytest.raises(TimeoutError):
+        with rb._stage_alarm(1.0):
+            time.sleep(30)
+    assert time.perf_counter() - t0 < 5
+    with rb._stage_alarm(5):  # normal exit must leave no pending alarm
+        pass
+    time.sleep(0.1)
+
+
+def test_native_cpu_measure_digest_guard():
+    rb = _load_root_bench()
+    gbps, digest, label = rb._measure_native_cpu(1 << 20, 2)
+    assert gbps > 0
+    assert digest != 0  # the silently-skipped-work guard must be live
+    assert label in ("native-aesni", "native-c")
+
+
+def test_unreachable_accelerator_reports_native_json():
+    """End-to-end: no reachable accelerator -> one JSON line, native engine,
+    above-baseline value (the contract that makes a tunnel-outage round
+    still record a real framework number)."""
+    env = dict(os.environ, PYTHONPATH="", JAX_PLATFORMS="bogus",
+               OT_BENCH_DEADLINE="240", OT_BENCH_BYTES=str(32 << 20))
+    out = subprocess.run(
+        [sys.executable, str(ROOT / "bench.py")], env=env, cwd=ROOT,
+        capture_output=True, text=True, timeout=240, check=True,
+    )
+    line = json.loads(out.stdout.strip().splitlines()[-1])
+    assert line["unit"] == "GB/s"
+    assert "native" in line["metric"]
+    assert line["value"] > 0
+    if "native-aesni" in line["metric"]:
+        # With hardware AES the CPU fallback beats the reference baseline;
+        # the scalar native-c path (no AES-NI host) only needs to report.
+        assert line["value"] > 0.52
