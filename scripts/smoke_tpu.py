@@ -128,6 +128,10 @@ def main() -> int:
     if args.child:
         return child()
 
+    # Shared persistent compile cache across the per-config children (the
+    # jnp reference recompiles identically in every child otherwise).
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
+
     failures = 0
     for tile in args.tiles.split(","):
         for mc in args.mc.split(","):

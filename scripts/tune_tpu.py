@@ -72,6 +72,12 @@ def main() -> int:
     ap.add_argument("--engines", default="pallas")
     args = ap.parse_args()
 
+    # Every config is a fresh process that would recompile from scratch;
+    # the persistent compilation cache lets identical (engine, shape)
+    # executables reuse across children. Harmless if the platform's cache
+    # path is unsupported — jax degrades to a warning.
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
+
     grid = list(itertools.product(
         [int(t) for t in args.tiles.split(",")],
         args.mc.split(","),
