@@ -555,9 +555,10 @@ def _bp_sbox_core(p: list) -> list:
     The 115-gate (32 AND + 83 XOR/XNOR) combinational AES S-box from
     Boyar & Peralta, "A new combinational logic minimization technique
     with applications to cryptology" (SEA 2010) — a public, fixed circuit:
-    a 23-XOR top linear layer computing 22 shared signals, a 30-gate shared
-    GF(2^4) inversion middle, 18 AND "output multipliers", and a 26-XOR
-    bottom linear layer. Its four XNOR outputs are exactly the S-box affine
+    a 23-XOR top linear layer computing 22 shared signals, a 44-gate shared
+    GF(2^4) inversion middle (30 XOR + 14 AND), 18 AND "output
+    multipliers", and a 30-XOR bottom linear layer. Its four XNOR outputs
+    are exactly the S-box affine
     constant 0x63, so this core emits the pure-XOR form and the caller
     applies the shared ``xor_const(…, AFF_CONST)`` — identical accounting
     to the other formulations.

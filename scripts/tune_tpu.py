@@ -72,10 +72,11 @@ def main() -> int:
     ap.add_argument("--engines", default="pallas")
     args = ap.parse_args()
 
-    # Every config is a fresh process that would recompile from scratch;
-    # the persistent compilation cache lets identical (engine, shape)
-    # executables reuse across children. Harmless if the platform's cache
-    # path is unsupported — jax degrades to a warning.
+    # Tile/MC/S-box are baked into each child's HLO, so configs don't share
+    # executables within one sweep — the persistent cache pays off on
+    # REPEATED sweep invocations with overlapping configs (retries after a
+    # tunnel hiccup being the expected case). Harmless if the platform's
+    # cache path is unsupported — jax degrades to a warning.
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
 
     grid = list(itertools.product(
