@@ -63,10 +63,20 @@ CORES: dict[str, tuple] = {"jnp": (block.encrypt_words, block.decrypt_words)}
 CTR_FUSED: dict[str, object] = {}
 
 
-def register_core(name: str, encrypt_fn, decrypt_fn, ctr_fused_fn=None) -> None:
+#: Engines whose cores route into pl.pallas_call — the set parallel/dist.py
+#: keys its interpreter-mode check_vma workaround on (a name prefix would
+#: silently extend the workaround to any future engine that happens to be
+#: named "pallas-…" without being kernel-backed).
+PALLAS_BACKED: set[str] = set()
+
+
+def register_core(name: str, encrypt_fn, decrypt_fn, ctr_fused_fn=None,
+                  pallas_backed: bool = False) -> None:
     CORES[name] = (encrypt_fn, decrypt_fn)
     if ctr_fused_fn is not None:
         CTR_FUSED[name] = ctr_fused_fn
+    if pallas_backed:
+        PALLAS_BACKED.add(name)
 
 
 def resolve_engine(name: str | None = "auto") -> str:
@@ -454,4 +464,9 @@ from ..ops import pallas_aes as _pallas_aes  # noqa: E402
 
 register_core("bitslice", _bitslice.encrypt_words, _bitslice.decrypt_words)
 register_core("pallas", _pallas_aes.encrypt_words, _pallas_aes.decrypt_words,
-              ctr_fused_fn=_pallas_aes.ctr_crypt_words_gen)
+              ctr_fused_fn=_pallas_aes.ctr_crypt_words_gen,
+              pallas_backed=True)
+register_core("pallas-gt", _pallas_aes.encrypt_words_gt,
+              _pallas_aes.decrypt_words_gt,
+              ctr_fused_fn=_pallas_aes.ctr_crypt_words_gt,
+              pallas_backed=True)

@@ -788,6 +788,74 @@ def _transpose32(a: jnp.ndarray) -> jnp.ndarray:
     return a
 
 
+def _transpose32_lead(a: jnp.ndarray) -> jnp.ndarray:
+    """_transpose32 for a LEADING (32, ...) axis — the kernel-safe form.
+
+    Same masked-swap SWAR ladder, but the 32-axis is axis 0 and every
+    reshape/slice/stack touches only leading axes, leaving the minor
+    (sublane, lane) dims untouched — the conservative Mosaic feature set
+    (cf. pallas_aes._perm_stack). Involution, like _transpose32.
+    """
+    j = 16
+    m = jnp.uint32(0x0000FFFF)
+    while j:
+        sh = a.shape
+        b = a.reshape((32 // (2 * j), 2, j) + sh[1:])
+        lo, hi = b[:, 0], b[:, 1]
+        t = (lo >> j ^ hi) & m
+        a = jnp.stack([lo ^ (t << j), hi ^ t], axis=1).reshape(sh)
+        j >>= 1
+        m = m ^ (m << j)
+    return a
+
+
+def group_words(words: jnp.ndarray) -> jnp.ndarray:
+    """(N, 4) u32 words, N % 32 == 0 -> (32, 4, W) grouped layout:
+    [t, c, l] = word c of block 32*l + t.
+
+    One pure relayout (no bit math). The grouped form puts the lane axis
+    minor with the 32-block axis LEADING, so a Pallas kernel can run the
+    SWAR bit transposition itself on (32, 4, tile) VMEM tiles
+    (planes_from_grouped) instead of paying to/from_planes as separate
+    XLA passes over HBM around the kernel.
+    """
+    n = words.shape[0]
+    return words.reshape(n // 32, 32, 4).transpose(1, 2, 0)
+
+
+def ungroup_words(g: jnp.ndarray) -> jnp.ndarray:
+    """(32, 4, W) grouped layout -> (32*W, 4) u32 words (group_words⁻¹)."""
+    w = g.shape[2]
+    return g.transpose(2, 0, 1).reshape(32 * w, 4)
+
+
+def planes_from_grouped(g: jnp.ndarray) -> jnp.ndarray:
+    """(32, 4, T) grouped words -> (8, 16, T) bit planes, kernel-safe.
+
+    Equivalent to to_planes on the same blocks (pinned by tests), but the
+    ladder runs on the leading 32-axis and the byte/bit redistribution is
+    a static stack of leading-axis slices — legal inside a Mosaic kernel.
+    """
+    tr = _transpose32_lead(g)  # [i, c, l]: bit t of tr[i,c] = bit i of
+    #                            word c of block 32l + t
+    return jnp.stack([
+        jnp.concatenate(
+            [tr[8 * (p % 4) + b, p // 4][None] for p in range(16)], axis=0)
+        for b in range(8)
+    ])
+
+
+def grouped_from_planes(p: jnp.ndarray) -> jnp.ndarray:
+    """(8, 16, T) bit planes -> (32, 4, T) grouped words (kernel-safe
+    inverse of planes_from_grouped)."""
+    tr = jnp.stack([
+        jnp.concatenate(
+            [p[i % 8, 4 * c + i // 8][None] for c in range(4)], axis=0)
+        for i in range(32)
+    ])
+    return _transpose32_lead(tr)
+
+
 def to_planes(words: jnp.ndarray) -> jnp.ndarray:
     """(N, 4) u32 LE words, N % 32 == 0  ->  (8, 16, N/32) u32 planes."""
     n = words.shape[0]

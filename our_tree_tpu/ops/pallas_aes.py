@@ -89,12 +89,43 @@ def _run_rounds(p, kp, nr: int, round_fn, interpret: bool):
     return p
 
 
+#: Kernel-boundary layouts, shared by the ECB and counter-generating CTR
+#: entry points: name -> (relayout_in, relayout_out, tile_shape, unpack,
+#: pack). "planes" converts OUTSIDE the kernel (bitslice.to/from_planes as
+#: XLA passes; identity inside); "grouped" crosses the boundary in the
+#: (32, 4, W) grouped word layout (a pure relayout) and runs the SWAR
+#: bit-transposition ladder INSIDE the kernel on VMEM tiles (the
+#: "pallas-gt" engine). One table so padding/vma/grid plumbing exists once
+#: and cannot drift between the two engines.
+#:
+#: Known tradeoff of the grouped layout: its 4-wide second-minor (sublane)
+#: dim pads to 8 under TPU tiling, so grouped HBM streams and VMEM tiles
+#: carry 2x the logical bytes. The kernel is compute-bound (docs/PERF.md
+#: roofline: HBM ceiling is an order of magnitude above the VPU one), so
+#: this should not decide the pallas-vs-pallas-gt A/B — but it does halve
+#: the grouped path's buffer-size ceiling. If gt wins the A/B and a size
+#: ceiling matters, the dense follow-up is a (128, W) boundary with the
+#: ladder's masked swaps done via sublane rolls + row-index masks — not
+#: built now because sublane-roll support is generation-dependent (the
+#: same reason OT_PALLAS_MC=roll is a knob, not the default).
+_LAYOUTS = {
+    "planes": (lambda w: bitslice.to_planes(w), bitslice.from_planes,
+               lambda tile: (8, 16, tile), None, None),
+    "grouped": (lambda w: bitslice.group_words(w), bitslice.ungroup_words,
+                lambda tile: (32, 4, tile),
+                bitslice.planes_from_grouped, bitslice.grouped_from_planes),
+}
+
+
 def _aes_kernel(kp_ref, in_ref, out_ref, *, nr: int, decrypt: bool,
-                interpret: bool):
+                interpret: bool, unpack=None, pack=None):
     kp = kp_ref[...]
     round_fn = bitslice.decrypt_round if decrypt else bitslice.encrypt_round
-    p = _run_rounds(in_ref[...] ^ kp[0], kp, nr, round_fn, interpret)
-    out_ref[...] = round_fn(p, kp[nr], True, perm=_perm_stack)
+    x = in_ref[...]
+    p = unpack(x) if unpack is not None else x
+    p = _run_rounds(p ^ kp[0], kp, nr, round_fn, interpret)
+    p = round_fn(p, kp[nr], True, perm=_perm_stack)
+    out_ref[...] = pack(p) if pack is not None else p
 
 
 def _match_vma(x: jnp.ndarray, like: jnp.ndarray) -> jnp.ndarray:
@@ -149,24 +180,27 @@ def _interpret() -> bool:
         return True
 
 
-@functools.partial(jax.jit, static_argnames=("nr", "decrypt", "tile"))
-def _crypt_planes_pallas(planes, kp, *, nr, decrypt, tile):
-    w = planes.shape[2]
+@functools.partial(jax.jit,
+                   static_argnames=("nr", "decrypt", "tile", "layout"))
+def _crypt_planes_pallas(x, kp, *, nr, decrypt, tile, layout="planes"):
+    _, _, shape_fn, unpack, pack = _LAYOUTS[layout]
+    w = x.shape[2]
     interpret = _interpret()
     kernel = functools.partial(
-        _aes_kernel, nr=nr, decrypt=decrypt, interpret=interpret
+        _aes_kernel, nr=nr, decrypt=decrypt, interpret=interpret,
+        unpack=unpack, pack=pack,
     )
     return pl.pallas_call(
         kernel,
         grid=(w // tile,),
         in_specs=[
             pl.BlockSpec((nr + 1, 8, 16, 1), lambda i: (0, 0, 0, 0)),
-            pl.BlockSpec((8, 16, tile), lambda i: (0, 0, i)),
+            pl.BlockSpec(shape_fn(tile), lambda i: (0, 0, i)),
         ],
-        out_specs=pl.BlockSpec((8, 16, tile), lambda i: (0, 0, i)),
-        out_shape=_out_struct(planes),
+        out_specs=pl.BlockSpec(shape_fn(tile), lambda i: (0, 0, i)),
+        out_shape=_out_struct(x),
         interpret=interpret,
-    )(kp, planes)
+    )(kp, x)
 
 
 def _lane_pad_and_tile(n: int) -> tuple[int, int]:
@@ -184,17 +218,19 @@ def _lane_pad_and_tile(n: int) -> tuple[int, int]:
     return pad, tile
 
 
-def _crypt_words(words, rk, nr, decrypt):
+def _crypt_words(words, rk, nr, decrypt, layout="planes"):
     n = words.shape[0]
     if n == 0:
         return words
     pad, tile = _lane_pad_and_tile(n)
     if pad:
         words = jnp.concatenate([words, jnp.zeros((pad, 4), words.dtype)], axis=0)
-    planes = bitslice.to_planes(words)
-    kp = _match_vma(bitslice.key_planes(rk, nr), planes)
-    out = _crypt_planes_pallas(planes, kp, nr=nr, decrypt=decrypt, tile=tile)
-    return bitslice.from_planes(out)[:n]
+    pre, post, *_ = _LAYOUTS[layout]
+    x = pre(words)
+    kp = _match_vma(bitslice.key_planes(rk, nr), x)
+    out = _crypt_planes_pallas(x, kp, nr=nr, decrypt=decrypt, tile=tile,
+                               layout=layout)
+    return post(out)[:n]
 
 
 def encrypt_words(words: jnp.ndarray, rk: jnp.ndarray, nr: int) -> jnp.ndarray:
@@ -205,6 +241,17 @@ def encrypt_words(words: jnp.ndarray, rk: jnp.ndarray, nr: int) -> jnp.ndarray:
 def decrypt_words(words: jnp.ndarray, rk_dec: jnp.ndarray, nr: int) -> jnp.ndarray:
     """Pallas-kernel batch decrypt (InvMixColumns-folded schedule)."""
     return _crypt_words(words, rk_dec, nr, decrypt=True)
+
+
+def encrypt_words_gt(words: jnp.ndarray, rk: jnp.ndarray, nr: int):
+    """Grouped-transpose ECB encrypt (in-kernel SWAR ladder); contract of
+    encrypt_words. The "pallas-gt" engine."""
+    return _crypt_words(words, rk, nr, decrypt=False, layout="grouped")
+
+
+def decrypt_words_gt(words: jnp.ndarray, rk_dec: jnp.ndarray, nr: int):
+    """Grouped-transpose ECB decrypt; contract of decrypt_words."""
+    return _crypt_words(words, rk_dec, nr, decrypt=True, layout="grouped")
 
 
 # ---------------------------------------------------------------------------
@@ -345,21 +392,25 @@ def _ctr_planes_from_base(base, g, tile: int):
 
 
 def _ctr_gen_kernel(kp_ref, base_ref, data_ref, out_ref, *, nr: int,
-                    tile: int, interpret: bool):
+                    tile: int, interpret: bool, pack=None):
     kp = kp_ref[...]
     ctr = _ctr_planes_from_base(base_ref[...], pl.program_id(0), tile)
     p = _run_rounds(ctr ^ kp[0], kp, nr, bitslice.encrypt_round, interpret)
     ks = bitslice.encrypt_round(p, kp[nr], True, perm=_perm_stack)
-    out_ref[...] = data_ref[...] ^ ks
+    # In the grouped layout (pack set) the DATA tile is never bit-transposed
+    # at all: XOR commutes with the transposition, so only the synthesised
+    # keystream converts (bitslice.grouped_from_planes) before the XOR.
+    out_ref[...] = data_ref[...] ^ (pack(ks) if pack is not None else ks)
 
 
-@functools.partial(jax.jit, static_argnames=("nr", "tile"))
-def _ctr_gen_planes_pallas(data_planes, base_masks, kp, *, nr, tile):
-    w = data_planes.shape[2]
+@functools.partial(jax.jit, static_argnames=("nr", "tile", "layout"))
+def _ctr_gen_planes_pallas(x, base_masks, kp, *, nr, tile, layout="planes"):
+    _, _, shape_fn, _, pack = _LAYOUTS[layout]
+    w = x.shape[2]
     interpret = _interpret()
     kernel = functools.partial(_ctr_gen_kernel, nr=nr, tile=tile,
-                               interpret=interpret)
-    spec = pl.BlockSpec((8, 16, tile), lambda i: (0, 0, i))
+                               interpret=interpret, pack=pack)
+    spec = pl.BlockSpec(shape_fn(tile), lambda i: (0, 0, i))
     return pl.pallas_call(
         kernel,
         grid=(w // tile,),
@@ -369,9 +420,42 @@ def _ctr_gen_planes_pallas(data_planes, base_masks, kp, *, nr, tile):
             spec,
         ],
         out_specs=spec,
-        out_shape=_out_struct(data_planes),
+        out_shape=_out_struct(x),
         interpret=interpret,
-    )(kp, base_masks, data_planes)
+    )(kp, base_masks, x)
+
+
+def _ctr_gen_words(words, ctr_be_words, rk, nr, layout):
+    n = words.shape[0]
+    if n == 0:
+        return words
+    pad, tile = _lane_pad_and_tile(n)
+    if pad:
+        words = jnp.concatenate([words, jnp.zeros((pad, 4), words.dtype)],
+                                axis=0)
+    pre, post, *_ = _LAYOUTS[layout]
+    x = pre(words)
+    base = _match_vma(_base_bit_masks(ctr_be_words), x)
+    kp = _match_vma(bitslice.key_planes(rk, nr), x)
+    out = _ctr_gen_planes_pallas(x, base, kp, nr=nr, tile=tile, layout=layout)
+    return post(out)[:n]
+
+
+def ctr_crypt_words_gt(words: jnp.ndarray, ctr_be_words: jnp.ndarray,
+                       rk: jnp.ndarray, nr: int) -> jnp.ndarray:
+    """Fused counter-synthesising CTR in the grouped-transpose formulation.
+
+    Registered as the "pallas-gt" engine's CTR_FUSED entry. Same 128-bit
+    big-endian counter semantics as ctr_crypt_words_gen (block i's counter
+    = base + i, aes-modes/aes.c:869-901); the only structural difference is
+    where the bit transposition happens. Here the data is never
+    bit-transposed AT ALL — it crosses the boundary in the (32, 4, W)
+    grouped layout (one pure relayout) and the kernel converts only the
+    synthesised keystream before the XOR. Which formulation wins on a given
+    TPU generation depends on whether Mosaic schedules the in-kernel ladder
+    better than XLA schedules the to/from_planes HBM round-trips
+    (tune_tpu --engines pallas,pallas-gt measures both)."""
+    return _ctr_gen_words(words, ctr_be_words, rk, nr, layout="grouped")
 
 
 def _base_bit_masks(ctr_be_words: jnp.ndarray) -> jnp.ndarray:
@@ -393,15 +477,4 @@ def ctr_crypt_words_gen(words: jnp.ndarray, ctr_be_words: jnp.ndarray,
     transposition, and one full-buffer HBM input stream. Symmetric, so it
     serves both directions; sharded callers pre-offset ``ctr_be_words`` to
     their shard's first block (parallel/dist.py)."""
-    n = words.shape[0]
-    if n == 0:
-        return words
-    pad, tile = _lane_pad_and_tile(n)
-    if pad:
-        words = jnp.concatenate([words, jnp.zeros((pad, 4), words.dtype)],
-                                axis=0)
-    data_planes = bitslice.to_planes(words)
-    base = _match_vma(_base_bit_masks(ctr_be_words), data_planes)
-    kp = _match_vma(bitslice.key_planes(rk, nr), data_planes)
-    out = _ctr_gen_planes_pallas(data_planes, base, kp, nr=nr, tile=tile)
-    return bitslice.from_planes(out)[:n]
+    return _ctr_gen_words(words, ctr_be_words, rk, nr, layout="planes")

@@ -38,7 +38,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..models.aes import (CORES, CTR_FUSED, _add_counter_be, _as_block_words,
+from ..models.aes import (CORES, CTR_FUSED, PALLAS_BACKED, _add_counter_be,
+                          _as_block_words,
                           cbc_encrypt_words_batch, ctr_le_blocks,
                           resolve_engine)
 from ..models.arc4 import keystream_scan_batch
@@ -142,8 +143,7 @@ def _ctr_sharded_jit(words, ctr_be, rk, *, nr, mesh, axis, engine="jnp"):
         # 8-virtual-device CPU mesh. On real hardware (Mosaic compile, no
         # interpreter) the full vma safety check stays on; CPU pallas shard
         # parity is covered by test_parallel instead.
-        check_vma=(engine not in CTR_FUSED and engine != "pallas")
-        or not _pallas_interpret(),
+        check_vma=engine not in PALLAS_BACKED or not _pallas_interpret(),
     )
     return f(words, ctr_be, rk)
 
@@ -179,7 +179,7 @@ def _ecb_sharded_jit(words, rk, *, nr, encrypt, mesh, axis, engine="jnp"):
         in_specs=(P(axis), P()),
         out_specs=P(axis),
         # same pallas-interpreter vma drop; see _ctr_sharded_jit
-        check_vma=engine != "pallas" or not _pallas_interpret(),
+        check_vma=engine not in PALLAS_BACKED or not _pallas_interpret(),
     )
     return f(words, rk)
 

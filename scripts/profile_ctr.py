@@ -127,6 +127,27 @@ def main():
         planes, kp)
     report("ecb decrypt kernel alone", t, gb)
 
+    # Grouped-transpose ("pallas-gt") components: the relayout that replaces
+    # to/from_planes, and the kernels that run the SWAR ladder in VMEM.
+    t = chained_time(
+        lambda c, w, rk: aes_mod.ctr_crypt_words(w, c, rk, 10, "pallas-gt"),
+        ctr_be, flat, a.rk_enc)
+    report("full ctr (pallas-gt)", t, gb)
+
+    t = chained_time(bitslice.group_words, kwords)
+    report("group_words relayout", t)
+
+    grouped = jax.jit(bitslice.group_words)(kwords)
+    t = chained_time(bitslice.ungroup_words, grouped)
+    report("ungroup_words relayout", t)
+
+    base = jax.jit(pallas_aes._base_bit_masks)(ctr_be)
+    t = chained_time(
+        lambda g, b, kp: pallas_aes._ctr_gen_planes_pallas(
+            g, b, kp, nr=10, tile=tile, layout="grouped"),
+        grouped, base, kp)
+    report("ctr-gt kernel alone", t, gb)
+
 
 if __name__ == "__main__":
     sys.exit(main())

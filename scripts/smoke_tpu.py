@@ -8,8 +8,9 @@ kernels, which never executed at benchmark sizes because launches failed
 unchecked — SURVEY.md §2 defect #4). Protects the tuning sweep
 (scripts/tune_tpu.py) from dying at compile time mid-run.
 
-Matrix: {ecb-enc, ecb-dec, ctr-fused, ctr-gen, ctr-sharded(mesh 1)}
-      x MC lowering {perm, roll}  x  tile {1024, 2048}.
+Matrix: {ecb-enc, ecb-dec, ctr-fused, ctr-gen, ecb-gt-enc, ecb-gt-dec,
+       ctr-gt, ctr-sharded(mesh 1)}
+      x MC lowering {perm, roll}  x  tile {1024, 2048}  x  S-box.
 
 OT_PALLAS_TILE / OT_PALLAS_MC are read at module import, so each config
 runs in its own subprocess (also: exactly one jax process at a time —
@@ -107,12 +108,29 @@ def child() -> int:
           lambda w: pallas_aes.ctr_crypt_words_gen(
               w.reshape(-1, 4), ctr_be, a.rk_enc, a.nr), want_ctr)
 
+    # Grouped-transpose kernels (in-kernel SWAR ladder — the riskiest
+    # Mosaic surface in the repo; this smoke is their first hardware
+    # compile).
+    check("ecb-gt-enc",
+          lambda w: pallas_aes.encrypt_words_gt(
+              w.reshape(-1, 4), a.rk_enc, a.nr), want_ecb)
+    check("ecb-gt-dec",
+          lambda w: pallas_aes.decrypt_words_gt(
+              w.reshape(-1, 4), a.rk_dec, a.nr), want_dec)
+    check("ctr-gt",
+          lambda w: pallas_aes.ctr_crypt_words_gt(
+              w.reshape(-1, 4), ctr_be, a.rk_enc, a.nr), want_ctr)
+
     # shard_map + pallas on hardware (the check_vma-workaround combination
-    # that CI only ever runs on CPU): a 1-device mesh on the real chip.
+    # that CI only ever runs on CPU): a 1-device mesh on the real chip,
+    # both kernel-boundary layouts.
     mesh = dist.make_mesh(1)
     check("ctr-sharded-pallas",
           lambda w: dist.ctr_crypt_sharded(
               w, ctr_be, a.rk_enc, a.nr, mesh, engine="pallas"), want_ctr)
+    check("ctr-sharded-gt",
+          lambda w: dist.ctr_crypt_sharded(
+              w, ctr_be, a.rk_enc, a.nr, mesh, engine="pallas-gt"), want_ctr)
     return 0
 
 

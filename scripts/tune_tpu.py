@@ -69,7 +69,7 @@ def main() -> int:
     ap.add_argument("--tiles", default="512,1024,2048")
     ap.add_argument("--mc", default="perm,roll")
     ap.add_argument("--sbox", default="tower")
-    ap.add_argument("--engines", default="pallas")
+    ap.add_argument("--engines", default="pallas,pallas-gt")
     args = ap.parse_args()
 
     # Tile/MC/S-box are baked into each child's HLO, so configs don't share

@@ -59,6 +59,24 @@ def test_sbox_impls_exhaustive(impl, monkeypatch):
     np.testing.assert_array_equal(out, np.asarray(tables.INV_SBOX, dtype=np.uint8))
 
 
+def test_grouped_layout_helpers_match_to_planes():
+    """group_words/planes_from_grouped (the kernel-safe leading-axis forms
+    used by the pallas-gt kernels) must agree exactly with the reference
+    to_planes/from_planes pair, and both pairs must invert cleanly."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(31)
+    w = jnp.asarray(rng.integers(0, 2**32, (32 * 7, 4), dtype=np.uint32))
+    g = bitslice.group_words(w)
+    np.testing.assert_array_equal(np.asarray(bitslice.ungroup_words(g)),
+                                  np.asarray(w))
+    np.testing.assert_array_equal(np.asarray(bitslice.planes_from_grouped(g)),
+                                  np.asarray(bitslice.to_planes(w)))
+    np.testing.assert_array_equal(
+        np.asarray(bitslice.grouped_from_planes(bitslice.planes_from_grouped(g))),
+        np.asarray(g))
+
+
 def test_gf16_mul_planes_matches_field():
     """Bitsliced GF(2^4) multiply vs the scalar field op, all 256 pairs."""
     import jax.numpy as jnp

@@ -139,7 +139,7 @@ def test_ctr_flat_stream_equals_block_words():
     data = rng.integers(0, 256, 16 * 77, np.uint8)
     w2 = jnp.asarray(packing.np_bytes_to_words(data).reshape(-1, 4))
     wf = jnp.asarray(packing.np_bytes_to_words(data))
-    for engine in ("jnp", "bitslice", "pallas"):
+    for engine in ("jnp", "bitslice", "pallas", "pallas-gt"):
         o2 = np.asarray(aes_mod.ctr_crypt_words(w2, ctr_be, rk, nr, engine))
         of = np.asarray(aes_mod.ctr_crypt_words(wf, ctr_be, rk, nr, engine))
         assert of.shape == (4 * 77,)
@@ -155,8 +155,40 @@ def test_pallas_engine_ctr_context():
     data = np.random.default_rng(9).integers(0, 256, 16 * 40 + 7, np.uint8)
     nonce = np.arange(16, dtype=np.uint8)
     outs = {}
-    for engine in ("jnp", "pallas"):
+    for engine in ("jnp", "pallas", "pallas-gt"):
         a = AES(bytes(range(16)), engine=engine)
         outs[engine], *_ = a.crypt_ctr(0, nonce.copy(),
                                        np.zeros(16, np.uint8), data)
     np.testing.assert_array_equal(outs["jnp"], outs["pallas"])
+    np.testing.assert_array_equal(outs["jnp"], outs["pallas-gt"])
+
+
+def test_pallas_gt_engine_matches_jnp(monkeypatch):
+    """Grouped-transpose kernels (in-kernel SWAR ladder) vs the T-table
+    core: ECB both directions and counter-synthesising CTR, with a 3-step
+    grid so the lane/program_id bookkeeping is exercised past tile 0."""
+    from our_tree_tpu.ops import pallas_aes
+    from our_tree_tpu.utils import packing
+
+    monkeypatch.setattr(pallas_aes, "TILE", 128)
+    rng = np.random.default_rng(23)
+    nr, rk = expand_key_enc(bytes(range(16)))
+    rk = jnp.asarray(rk)
+    _, rk_dec = expand_key_dec(bytes(range(16)))
+    rk_dec = jnp.asarray(rk_dec)
+    # Near-wraparound nonce: the in-kernel ripple adder must carry across
+    # words exactly like ctr_le_blocks.
+    nonce = np.frombuffer(
+        bytes.fromhex("00000000fffffffffffffffffffffff0"), np.uint8)
+    ctr_be = jnp.asarray(packing.np_bytes_to_words(nonce).byteswap())
+    w = jnp.asarray(rng.integers(0, 2**32, (32 * 384, 4)).astype(np.uint32))
+
+    got = np.asarray(pallas_aes.encrypt_words_gt(w, rk, nr))
+    want = np.asarray(aes_mod.ecb_encrypt_words(w, rk, nr, "jnp"))
+    np.testing.assert_array_equal(got, want)
+    back = np.asarray(pallas_aes.decrypt_words_gt(jnp.asarray(got), rk_dec, nr))
+    np.testing.assert_array_equal(back, np.asarray(w))
+
+    got = np.asarray(pallas_aes.ctr_crypt_words_gt(w, ctr_be, rk, nr))
+    want = np.asarray(aes_mod.ctr_crypt_words(w, ctr_be, rk, nr, "jnp"))
+    np.testing.assert_array_equal(got, want)

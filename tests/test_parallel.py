@@ -60,10 +60,13 @@ def test_ctr_shard_invariance(nshards, nblocks):
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
 
 
-def test_ctr_sharded_fused_pallas_engine():
-    """engine="pallas" inside shard_map takes the fused-CTR kernel path
-    (CTR_FUSED registry) — regression for the vma/check_vma interaction of
-    pallas-interpret round loops under shard_map (parallel/dist.py)."""
+@pytest.mark.parametrize("engine", ["pallas", "pallas-gt"])
+def test_ctr_sharded_fused_pallas_engine(engine):
+    """Pallas-routed engines inside shard_map take the fused-CTR kernel
+    path (CTR_FUSED registry) — regression for the vma/check_vma
+    interaction of pallas-interpret round loops under shard_map
+    (parallel/dist.py), for both the plane and grouped-transpose
+    kernel-boundary layouts."""
     a = AES(KEY[:16])
     w = _words(16 * (32 * 8 + 3))  # uneven: exercises pad + per-shard tiles
     ctr_be = jnp.asarray(
@@ -71,7 +74,7 @@ def test_ctr_sharded_fused_pallas_engine():
     )
     ref = aes_mod.ctr_crypt_words(w, ctr_be, a.rk_enc, a.nr)
     out = ctr_crypt_sharded(w, ctr_be, a.rk_enc, a.nr, make_mesh(8),
-                            engine="pallas")
+                            engine=engine)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
 
 
