@@ -109,9 +109,9 @@ def _run_rounds(p, kp, nr: int, round_fn, interpret: bool):
 #: built now because sublane-roll support is generation-dependent (the
 #: same reason OT_PALLAS_MC=roll is a knob, not the default).
 _LAYOUTS = {
-    "planes": (lambda w: bitslice.to_planes(w), bitslice.from_planes,
+    "planes": (bitslice.to_planes, bitslice.from_planes,
                lambda tile: (8, 16, tile), None, None),
-    "grouped": (lambda w: bitslice.group_words(w), bitslice.ungroup_words,
+    "grouped": (bitslice.group_words, bitslice.ungroup_words,
                 lambda tile: (32, 4, tile),
                 bitslice.planes_from_grouped, bitslice.grouped_from_planes),
 }

@@ -163,6 +163,33 @@ def test_pallas_engine_ctr_context():
     np.testing.assert_array_equal(outs["jnp"], outs["pallas-gt"])
 
 
+@pytest.mark.parametrize("keybytes", [24, 32])
+def test_pallas_kernels_long_keys(keybytes, monkeypatch):
+    """AES-192/256 (nr = 12/14) through both pallas engines: the kernels
+    unroll rounds with nr as a static parameter, so the nr > 10 straight-
+    line paths are distinct compiled code that AES-128-only tests never
+    touch (cf. the reference CUDA kernels' Nr>10/Nr>12 guard blocks,
+    aes-gpu/Source/AES.cu:342-365 — which no test there exercised either)."""
+    from our_tree_tpu.ops import pallas_aes
+    from our_tree_tpu.utils import packing
+
+    monkeypatch.setattr(pallas_aes, "TILE", 128)
+    rng = np.random.default_rng(41)
+    key = bytes(range(keybytes))
+    nr, rk = expand_key_enc(key)
+    rk = jnp.asarray(rk)
+    nonce = np.frombuffer(bytes(range(200, 216)), np.uint8)
+    ctr_be = jnp.asarray(packing.np_bytes_to_words(nonce).byteswap())
+    w = jnp.asarray(rng.integers(0, 2**32, (32 * 128, 4)).astype(np.uint32))
+    want_ctr = np.asarray(aes_mod.ctr_crypt_words(w, ctr_be, rk, nr, "jnp"))
+    want_ecb = np.asarray(aes_mod.ecb_encrypt_words(w, rk, nr, "jnp"))
+    for engine in ("pallas", "pallas-gt"):
+        got = np.asarray(aes_mod.ctr_crypt_words(w, ctr_be, rk, nr, engine))
+        np.testing.assert_array_equal(got, want_ctr, err_msg=f"ctr {engine}")
+        got = np.asarray(aes_mod.ecb_encrypt_words(w, rk, nr, engine))
+        np.testing.assert_array_equal(got, want_ecb, err_msg=f"ecb {engine}")
+
+
 def test_pallas_gt_engine_matches_jnp(monkeypatch):
     """Grouped-transpose kernels (in-kernel SWAR ladder) vs the T-table
     core: ECB both directions and counter-synthesising CTR, with a 3-step
