@@ -49,6 +49,22 @@ INIT_TIMEOUT_S = float(os.environ.get("OT_BENCH_INIT_TIMEOUT", 240))
 _T0 = time.perf_counter()
 
 
+def _load_devlock():
+    """Load utils/devlock.py as a bare file: importing the package would
+    import jax before _ensure_live_backend has decided the platform."""
+    import importlib.util
+
+    p = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "our_tree_tpu", "utils", "devlock.py")
+    spec = importlib.util.spec_from_file_location("_ot_devlock", p)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+devlock = _load_devlock()
+
+
 def _left() -> float:
     return DEADLINE_S - (time.perf_counter() - _T0)
 
@@ -190,8 +206,38 @@ def _measure_native_cpu(nbytes: int, iters: int):
 
 
 def main() -> None:
-    _ensure_live_backend()
+    # Tunnelled single-tenant device: a concurrent jax process wedges the
+    # tunnel for everyone (observed: >1 h of failed PJRT inits after two
+    # processes overlapped). Wait out any advertised measurement job, then
+    # hold the devlock marker from BEFORE the first backend probe through
+    # the end of the measurement — a sweep launched mid-run waits on the
+    # same lock instead of wedging the tunnel under the headline. A
+    # CPU-pinned run never touches the tunnel, so it neither waits nor
+    # holds; a run demoted to CPU by a failed probe releases the marker so
+    # device jobs can proceed during its CPU measurement.
+    pinned_cpu = os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu"
+    owned = False
+    if not pinned_cpu:
+        devlock.wait(
+            0.3 * DEADLINE_S,
+            on_wait=lambda p: print(
+                f"# waiting for concurrent device job ({p})",
+                file=sys.stderr),
+        )
+        owned = devlock.acquire()
+    try:
+        _ensure_live_backend()
+        demoted = (os.environ.get("JAX_PLATFORMS", "").strip().lower()
+                   == "cpu" and not pinned_cpu)
+        if owned and demoted:
+            devlock.release(owned)
+            owned = False
+        _measure_and_report()
+    finally:
+        devlock.release(owned)
 
+
+def _measure_and_report() -> None:
     import jax
     import jax.numpy as jnp
 

@@ -1,0 +1,153 @@
+"""Advisory single-tenant lock for a tunnelled accelerator.
+
+On hosts where the device is reached through a single-tenant tunnel, two
+concurrent jax processes wedge the tunnel for every later process (observed:
+>1 h of failed PJRT inits). The benchmark entry points coordinate through a
+marker file: measurement jobs hold it, bench.py waits on it before probing
+the backend, sweep parents wait for a prior holder before starting.
+
+Design points (stdlib-only so the repo-root bench.py can load this file
+directly without importing the package, whose import pulls in jax):
+
+* **Atomic ownership** — acquisition is ``O_CREAT | O_EXCL`` with the PID
+  written into the file; an exists-then-create check would let two
+  processes both believe they own the marker.
+* **Staleness self-healing** — a marker is ignored (and reclaimed) when its
+  writer PID is dead or, for PID-less markers (``touch`` by an
+  orchestrator), when its mtime is older than STALE_S. A SIGKILLed job can
+  therefore never permanently tax every future bench run's deadline.
+* **Advisory, never blocking forever** — waiting callers proceed without
+  ownership once their budget is spent: on a bench host, progress beats
+  deadlock.
+
+Orchestrator contract: a plan that holds ONE marker around several child
+jobs must point the children at a different path (export
+``OT_BENCH_BUSY_FILE=/tmp/tpu_busy_<plan>``) — otherwise each child would
+dead-wait its budget on its own parent's marker. The recovery watcher does
+exactly this.
+
+Load sites (this file is loaded as a BARE file, not via the package, so
+jax-free parents stay jax-free — keep them in sync if this file moves):
+repo-root ``bench.py``, ``scripts/tune_tpu.py``, ``scripts/smoke_tpu.py``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+
+DEFAULT_PATH = "/tmp/tpu_busy"
+
+#: A PID-less marker older than this is considered abandoned. Must exceed
+#: the longest legitimate orchestrated plan that holds one marker across
+#: several jobs (the recovery watcher's full measurement plan is < 4 h).
+STALE_S = 4 * 3600.0
+
+
+def path() -> str:
+    return os.environ.get("OT_BENCH_BUSY_FILE", DEFAULT_PATH)
+
+
+def _writer_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except Exception:
+        return True  # EPERM etc.: someone's process — assume alive
+
+
+def is_held(p: str | None = None) -> bool:
+    """True if the marker exists and its holder still looks alive."""
+    p = p or path()
+    try:
+        st = os.stat(p)
+    except OSError:
+        return False
+    try:
+        with open(p) as f:
+            pid = int(f.read().strip() or "0")
+    except (OSError, ValueError):
+        pid = 0
+    fresh = time.time() - st.st_mtime <= STALE_S
+    if pid:
+        # The mtime bound applies here too: PID reuse could otherwise make
+        # a SIGKILLed job's marker look held forever once an unrelated
+        # long-lived process recycles the number.
+        return _writer_alive(pid) and fresh
+    # PID-less (touched by an orchestrator): only mtime can age it out.
+    return fresh
+
+
+def wait(budget_s: float, p: str | None = None, poll_s: float = 15.0,
+         on_wait=None) -> float:
+    """Block while the marker is held, up to budget_s; returns time waited."""
+    p = p or path()
+    t0 = time.time()
+    announced = False
+    while is_held(p) and time.time() - t0 < budget_s:
+        if not announced and on_wait is not None:
+            on_wait(p)
+            announced = True
+        time.sleep(poll_s)
+    return time.time() - t0
+
+
+def acquire(p: str | None = None) -> bool:
+    """Atomically claim the marker; True iff this process now owns it.
+
+    A stale marker (dead writer / aged-out) is reclaimed. Returning False
+    means another live holder exists (or the path is unwritable) — the
+    caller may still proceed, it just must not remove the marker.
+    """
+    p = p or path()
+    for _ in range(2):  # second try after reclaiming a stale marker
+        try:
+            fd = os.open(p, os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o644)
+            with os.fdopen(fd, "w") as f:
+                f.write(str(os.getpid()))
+            return True
+        except FileExistsError:
+            if is_held(p):
+                return False
+            # Stale: reclaim by atomic rename-aside. Of two concurrent
+            # reclaimers only one rename succeeds (the loser gets ENOENT),
+            # so a freshly re-created marker can never be deleted by the
+            # slower reclaimer — a bare remove() here would allow exactly
+            # that double-ownership race.
+            aside = f"{p}.stale.{os.getpid()}"
+            try:
+                os.rename(p, aside)
+                os.remove(aside)
+            except OSError:
+                return False
+        except OSError:
+            return False
+    return False
+
+
+def release(owned: bool, p: str | None = None) -> None:
+    if not owned:
+        return
+    try:
+        os.remove(p or path())
+    except OSError:
+        pass
+
+
+@contextlib.contextmanager
+def hold(p: str | None = None, wait_budget_s: float = 0.0, on_wait=None):
+    """Wait for any prior holder (bounded), then claim the marker for the
+    block's duration. Yields whether ownership was actually obtained —
+    callers proceed either way (advisory lock), but cleanup is only the
+    owner's."""
+    p = p or path()
+    if wait_budget_s > 0:
+        wait(wait_budget_s, p, on_wait=on_wait)
+    owned = acquire(p)
+    try:
+        yield owned
+    finally:
+        release(owned, p)

@@ -150,27 +150,42 @@ def main() -> int:
     # jnp reference recompiles identically in every child otherwise).
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
 
+    # Single-tenant device coordination (see utils/devlock.py): wait for a
+    # prior measurement job, then hold the marker for the matrix. Loaded as
+    # a bare file so this jax-free parent stays jax-free.
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "_ot_devlock",
+        os.path.join(REPO, "our_tree_tpu", "utils", "devlock.py"))
+    devlock = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(devlock)
+
     failures = 0
-    for tile in args.tiles.split(","):
-        for mc in args.mc.split(","):
-            for sbox in args.sbox.split(","):
-                env = dict(os.environ, OT_PALLAS_TILE=tile.strip(),
-                           OT_PALLAS_MC=mc.strip(), OT_SBOX=sbox.strip())
-                tag = f"tile={tile} mc={mc} sbox={sbox}"
-                print(f"## {tag}", flush=True)
-                try:
-                    rc = subprocess.run(
-                        [sys.executable, os.path.abspath(__file__), "--child"],
-                        env=env, timeout=1800,
-                    ).returncode
-                except subprocess.TimeoutExpired:
-                    # A hung Mosaic compile is a failing config, not a reason
-                    # to abandon the rest of the matrix — the survey must
-                    # finish.
-                    rc = -1
-                if rc:
-                    failures += 1
-                    print(f"## {tag} FAILED rc={rc}", flush=True)
+    with devlock.hold(wait_budget_s=900.0,
+                      on_wait=lambda p: print(f"# waiting for {p}",
+                                              file=sys.stderr)):
+        for tile in args.tiles.split(","):
+            for mc in args.mc.split(","):
+                for sbox in args.sbox.split(","):
+                    env = dict(os.environ, OT_PALLAS_TILE=tile.strip(),
+                               OT_PALLAS_MC=mc.strip(), OT_SBOX=sbox.strip())
+                    tag = f"tile={tile} mc={mc} sbox={sbox}"
+                    print(f"## {tag}", flush=True)
+                    try:
+                        rc = subprocess.run(
+                            [sys.executable, os.path.abspath(__file__),
+                             "--child"],
+                            env=env, timeout=1800,
+                        ).returncode
+                    except subprocess.TimeoutExpired:
+                        # A hung Mosaic compile is a failing config, not a
+                        # reason to abandon the rest of the matrix — the
+                        # survey must finish.
+                        rc = -1
+                    if rc:
+                        failures += 1
+                        print(f"## {tag} FAILED rc={rc}", flush=True)
     print(f"SMOKE {'FAIL' if failures else 'PASS'} "
           f"({failures} failing configs)")
     return 1 if failures else 0

@@ -22,6 +22,17 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+
+def _load_devlock():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "_ot_devlock",
+        os.path.join(REPO, "our_tree_tpu", "utils", "devlock.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
 CHILD = r"""
 import json, os, sys, time
 import numpy as np
@@ -85,30 +96,43 @@ def main() -> int:
         args.sbox.split(","),
         args.engines.split(","),
     ))
+    # Single-tenant device coordination: wait for any prior measurement
+    # job, then hold the marker for the sweep (bench.py waits on the same
+    # lock — a concurrent jax process wedges a tunnelled device). The
+    # watcher orchestrator holds its own marker around whole plans; this
+    # acquire simply fails then (advisory), which is fine — the plan is
+    # already serialized. devlock is file-loaded so this jax-free parent
+    # stays jax-free (the package import would pull jax in).
+    devlock = _load_devlock()
+
     results = []
     digests = set()
-    for tile, mc, sbox, engine in grid:
-        env = dict(os.environ, OT_PALLAS_TILE=str(tile), OT_PALLAS_MC=mc,
-                   OT_SBOX=sbox)
-        code = CHILD % {"repo": REPO, "nbytes": args.bytes,
-                        "iters": args.iters, "engine": engine}
-        tag = f"tile={tile:<5} mc={mc:<4} sbox={sbox:<5} engine={engine}"
-        try:
-            out = subprocess.run(
-                [sys.executable, "-u", "-c", code], env=env, timeout=args.timeout,
-                capture_output=True, text=True, check=True,
-            )
-            r = json.loads(out.stdout.strip().splitlines()[-1])
-            results.append((r["gbps"], tag))
-            digests.add(r["digest"])
-            print(f"{tag}  ->  {r['gbps']:7.3f} GB/s  digest={r['digest']:#010x}",
-                  flush=True)
-        except subprocess.TimeoutExpired:
-            print(f"{tag}  ->  TIMEOUT", flush=True)
-        except subprocess.CalledProcessError as e:
-            msg = (e.stderr or "").strip().splitlines()
-            print(f"{tag}  ->  FAILED ({msg[-1] if msg else 'no stderr'})",
-                  flush=True)
+    with devlock.hold(wait_budget_s=900.0,
+                      on_wait=lambda p: print(f"# waiting for {p}",
+                                              file=sys.stderr)):
+        for tile, mc, sbox, engine in grid:
+            env = dict(os.environ, OT_PALLAS_TILE=str(tile), OT_PALLAS_MC=mc,
+                       OT_SBOX=sbox)
+            code = CHILD % {"repo": REPO, "nbytes": args.bytes,
+                            "iters": args.iters, "engine": engine}
+            tag = f"tile={tile:<5} mc={mc:<4} sbox={sbox:<5} engine={engine}"
+            try:
+                out = subprocess.run(
+                    [sys.executable, "-u", "-c", code], env=env,
+                    timeout=args.timeout,
+                    capture_output=True, text=True, check=True,
+                )
+                r = json.loads(out.stdout.strip().splitlines()[-1])
+                results.append((r["gbps"], tag))
+                digests.add(r["digest"])
+                print(f"{tag}  ->  {r['gbps']:7.3f} GB/s  "
+                      f"digest={r['digest']:#010x}", flush=True)
+            except subprocess.TimeoutExpired:
+                print(f"{tag}  ->  TIMEOUT", flush=True)
+            except subprocess.CalledProcessError as e:
+                msg = (e.stderr or "").strip().splitlines()
+                print(f"{tag}  ->  FAILED ({msg[-1] if msg else 'no stderr'})",
+                      flush=True)
     if len(digests) > 1:
         print("WARNING: digests disagree across configs — a config computed "
               "different ciphertext; do not trust this sweep", file=sys.stderr)

@@ -1,0 +1,90 @@
+"""Single-tenant device lock (utils/devlock.py): the protocol bench.py and
+the sweep scripts use to never run two jax processes against the tunnel."""
+
+import os
+import time
+
+from our_tree_tpu.utils import devlock
+
+
+def test_acquire_release_roundtrip(tmp_path):
+    p = str(tmp_path / "busy")
+    assert not devlock.is_held(p)
+    assert devlock.acquire(p)
+    assert devlock.is_held(p)
+    assert int(open(p).read()) == os.getpid()
+    assert not devlock.acquire(p)  # second claim by a live holder fails
+    devlock.release(True, p)
+    assert not devlock.is_held(p)
+
+
+def test_stale_dead_pid_is_reclaimed(tmp_path):
+    p = str(tmp_path / "busy")
+    with open(p, "w") as f:
+        f.write("999999999")  # beyond pid_max: guaranteed dead
+    assert not devlock.is_held(p)
+    assert devlock.acquire(p)  # reclaims the stale marker atomically
+    assert int(open(p).read()) == os.getpid()
+    devlock.release(True, p)
+
+
+def test_pidless_marker_ages_out(tmp_path, monkeypatch):
+    p = str(tmp_path / "busy")
+    open(p, "w").close()  # orchestrator-style `touch` (no PID)
+    assert devlock.is_held(p)
+    monkeypatch.setattr(devlock, "STALE_S", 0.0)
+    time.sleep(0.05)
+    assert not devlock.is_held(p)
+    assert devlock.acquire(p)
+    devlock.release(True, p)
+
+
+def test_hold_is_advisory_and_owner_cleans_up(tmp_path):
+    p = str(tmp_path / "busy")
+    with devlock.hold(p) as owned:
+        assert owned
+        # a second holder proceeds without ownership and must NOT remove
+        # the first holder's marker on exit
+        with devlock.hold(p) as inner:
+            assert not inner
+        assert devlock.is_held(p)
+    assert not devlock.is_held(p)
+
+
+def test_pid_marker_also_ages_out(tmp_path, monkeypatch):
+    """A live-PID marker past STALE_S is ignored: PID reuse must not make a
+    SIGKILLed job's marker permanently 'held'."""
+    p = str(tmp_path / "busy")
+    with open(p, "w") as f:
+        f.write(str(os.getpid()))  # live writer
+    assert devlock.is_held(p)
+    monkeypatch.setattr(devlock, "STALE_S", 0.0)
+    time.sleep(0.05)
+    assert not devlock.is_held(p)
+
+
+def test_stale_reclaim_is_single_winner(tmp_path, monkeypatch):
+    """The rename-aside reclaim: once one reclaimer has taken the stale
+    marker, a second reclaimer attempting the same rename fails and must
+    NOT disturb the winner's fresh marker."""
+    p = str(tmp_path / "busy")
+    with open(p, "w") as f:
+        f.write("999999999")
+    assert devlock.acquire(p)  # winner reclaims
+    # A loser that raced past is_held would now hit rename(ENOENT) — the
+    # fresh marker survives and a plain second acquire still fails.
+    assert not devlock.acquire(p)
+    assert devlock.is_held(p)
+    assert int(open(p).read()) == os.getpid()
+    devlock.release(True, p)
+
+
+def test_wait_returns_when_released(tmp_path):
+    p = str(tmp_path / "busy")
+    assert devlock.wait(5.0, p) < 0.5  # not held: returns immediately
+    assert devlock.acquire(p)
+    t0 = time.time()
+    waited = devlock.wait(0.3, p, poll_s=0.05)
+    assert 0.25 <= time.time() - t0 < 2.0  # budget-bounded, then proceeds
+    assert waited >= 0.25
+    devlock.release(True, p)
