@@ -95,14 +95,24 @@ void ot_aesni_ctr_chunk(const ot_aes_ctx *ctx, uint8_t ctr[16],
                         size_t tail) {
     keyvec_t kv;
     __m128i b[STRIDE];
-    uint8_t ctrs[STRIDE][16];
     load_enc_keys(ctx, &kv);
+    /* The counter lives in two big-endian-valued qwords in registers; each
+     * block is built with one bswap pair + a vector set. The earlier
+     * per-block memcpy + byte-ripple through a stack buffer cost a
+     * store-forwarding round-trip per block that outweighed the AES
+     * pipeline itself. The 128-bit ripple semantics are unchanged:
+     * ++lo == 0 carries into hi (reference aes-modes/aes.c:879-884). */
+    uint64_t hi, lo;
+    memcpy(&hi, ctr, 8);
+    memcpy(&lo, ctr + 8, 8);
+    hi = __builtin_bswap64(hi);
+    lo = __builtin_bswap64(lo);
     for (size_t off = 0; off < nblocks; off += STRIDE) {
         int w = (int)(nblocks - off < STRIDE ? nblocks - off : STRIDE);
         for (int i = 0; i < w; i++) {
-            memcpy(ctrs[i], ctr, 16);
-            be_inc(ctr);
-            b[i] = _mm_loadu_si128((const __m128i *)ctrs[i]);
+            b[i] = _mm_set_epi64x((long long)__builtin_bswap64(lo),
+                                  (long long)__builtin_bswap64(hi));
+            if (++lo == 0) hi++;
         }
         enc_group(&kv, ctx->nr, b, w);
         for (int i = 0; i < w; i++) {
@@ -111,6 +121,13 @@ void ot_aesni_ctr_chunk(const ot_aes_ctx *ctx, uint8_t ctr[16],
             _mm_storeu_si128((__m128i *)(out + 16 * (off + i)),
                              _mm_xor_si128(d, b[i]));
         }
+    }
+    /* Write the advanced counter back for the caller/tail (in-place
+     * contract of this function, matching the resume-state semantics). */
+    {
+        uint64_t hb = __builtin_bswap64(hi), lb = __builtin_bswap64(lo);
+        memcpy(ctr, &hb, 8);
+        memcpy(ctr + 8, &lb, 8);
     }
     if (tail) {
         uint8_t ks[16];

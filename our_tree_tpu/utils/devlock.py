@@ -28,13 +28,15 @@ exactly this.
 
 Load sites (this file is loaded as a BARE file, not via the package, so
 jax-free parents stay jax-free — keep them in sync if this file moves):
-repo-root ``bench.py``, ``scripts/tune_tpu.py``, ``scripts/smoke_tpu.py``.
+repo-root ``bench.py`` (_load_devlock) and ``scripts/_devlock_loader.py``
+(shared by the sweep scripts).
 """
 
 from __future__ import annotations
 
 import contextlib
 import os
+import threading
 import time
 
 DEFAULT_PATH = "/tmp/tpu_busy"
@@ -138,16 +140,39 @@ def release(owned: bool, p: str | None = None) -> None:
 
 
 @contextlib.contextmanager
-def hold(p: str | None = None, wait_budget_s: float = 0.0, on_wait=None):
+def hold(p: str | None = None, wait_budget_s: float = 0.0, on_wait=None,
+         refresh_s: float = 600.0):
     """Wait for any prior holder (bounded), then claim the marker for the
     block's duration. Yields whether ownership was actually obtained —
     callers proceed either way (advisory lock), but cleanup is only the
-    owner's."""
+    owner's.
+
+    While owned, a daemon thread refreshes the marker's mtime every
+    ``refresh_s`` so a legitimately long-running holder (a wide sweep
+    matrix) never ages past STALE_S and gets its live lock reclaimed from
+    under it. Bare acquire()/release() users don't get the refresh — they
+    must finish within STALE_S (bench.py's deadline is minutes).
+    """
     p = p or path()
     if wait_budget_s > 0:
         wait(wait_budget_s, p, on_wait=on_wait)
     owned = acquire(p)
+    stop = threading.Event()
+    refresher = None
+    if owned and refresh_s > 0:
+        def _refresh():
+            while not stop.wait(refresh_s):
+                try:
+                    os.utime(p)
+                except OSError:
+                    break
+
+        refresher = threading.Thread(target=_refresh, daemon=True)
+        refresher.start()
     try:
         yield owned
     finally:
+        stop.set()
+        if refresher is not None:
+            refresher.join(timeout=2.0)
         release(owned, p)

@@ -1,0 +1,21 @@
+"""Shared loader for utils/devlock.py used by the sweep scripts.
+
+The sweep parents are deliberately jax-free (they only spawn jax children),
+so devlock is loaded as a bare file instead of through the package import,
+which would pull jax in. Scripts import this sibling module (the script's
+own directory is on sys.path when run as `python scripts/<name>.py`).
+"""
+
+import importlib.util
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_devlock():
+    spec = importlib.util.spec_from_file_location(
+        "_ot_devlock",
+        os.path.join(REPO, "our_tree_tpu", "utils", "devlock.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod

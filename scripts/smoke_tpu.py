@@ -151,15 +151,11 @@ def main() -> int:
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
 
     # Single-tenant device coordination (see utils/devlock.py): wait for a
-    # prior measurement job, then hold the marker for the matrix. Loaded as
-    # a bare file so this jax-free parent stays jax-free.
-    import importlib.util
+    # prior measurement job, then hold the marker for the matrix.
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from _devlock_loader import load_devlock
 
-    spec = importlib.util.spec_from_file_location(
-        "_ot_devlock",
-        os.path.join(REPO, "our_tree_tpu", "utils", "devlock.py"))
-    devlock = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(devlock)
+    devlock = load_devlock()
 
     failures = 0
     with devlock.hold(wait_budget_s=900.0,

@@ -21,17 +21,8 @@ import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-
-def _load_devlock():
-    import importlib.util
-
-    spec = importlib.util.spec_from_file_location(
-        "_ot_devlock",
-        os.path.join(REPO, "our_tree_tpu", "utils", "devlock.py"))
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _devlock_loader import load_devlock  # noqa: E402
 
 CHILD = r"""
 import json, os, sys, time
@@ -103,7 +94,7 @@ def main() -> int:
     # acquire simply fails then (advisory), which is fine — the plan is
     # already serialized. devlock is file-loaded so this jax-free parent
     # stays jax-free (the package import would pull jax in).
-    devlock = _load_devlock()
+    devlock = load_devlock()
 
     results = []
     digests = set()

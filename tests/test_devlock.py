@@ -79,6 +79,18 @@ def test_stale_reclaim_is_single_winner(tmp_path, monkeypatch):
     devlock.release(True, p)
 
 
+def test_hold_refreshes_mtime_for_long_holders(tmp_path):
+    """The owner's refresh thread touches the marker so a legitimately
+    long-running holder never ages past STALE_S mid-run."""
+    p = str(tmp_path / "busy")
+    with devlock.hold(p, refresh_s=0.05) as owned:
+        assert owned
+        m0 = os.stat(p).st_mtime
+        time.sleep(0.3)
+        assert os.stat(p).st_mtime > m0
+    assert not os.path.exists(p)
+
+
 def test_wait_returns_when_released(tmp_path):
     p = str(tmp_path / "busy")
     assert devlock.wait(5.0, p) < 0.5  # not held: returns immediately

@@ -42,6 +42,23 @@ def test_native_ctr_matches_jax_and_threads(bits):
         np.testing.assert_array_equal(out, expect)  # thread invariance too
 
 
+@pytest.mark.parametrize("nonce_hex", [
+    "0000000000000000fffffffffffffff0",  # low-qword carry into the high one
+    "fffffffffffffffffffffffffffffff0",  # full 128-bit wraparound
+])
+def test_native_ctr_qword_carry_seams(nonce_hex):
+    """The AES-NI CTR keeps its counter as two big-endian qwords in
+    registers; the carry between them (and the 128-bit wrap) must match the
+    byte-ripple semantics exactly (reference aes-modes/aes.c:879-884)."""
+    nonce = np.frombuffer(bytes.fromhex(nonce_hex), np.uint8)
+    nat, jx = NativeAES(KEY[128]), AES(KEY[128], engine="jnp")
+    expect, _, nc_jax, _ = jx.crypt_ctr(
+        0, nonce.copy(), np.zeros(16, np.uint8), ODD)
+    out, nc_nat = nat.ctr(nonce, ODD, nthreads=1)
+    np.testing.assert_array_equal(out, expect)
+    np.testing.assert_array_equal(nc_nat, nc_jax)
+
+
 def test_native_ctr_advances_nonce_like_jax():
     nat, jx = NativeAES(KEY[128]), AES(KEY[128], engine="jnp")
     _, _, nc_jax, _ = jx.crypt_ctr(0, IV.copy(), np.zeros(16, np.uint8), ODD)
