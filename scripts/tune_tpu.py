@@ -72,6 +72,9 @@ def main() -> int:
     ap.add_argument("--mc", default="perm,roll")
     ap.add_argument("--sbox", default="tower")
     ap.add_argument("--engines", default="pallas,pallas-gt")
+    ap.add_argument("--unroll", default="1",
+                    help="OT_BITSLICE_UNROLL values (XLA scan path; only "
+                         "meaningful with --engines bitslice)")
     args = ap.parse_args()
 
     # Tile/MC/S-box are baked into each child's HLO, so configs don't share
@@ -81,12 +84,20 @@ def main() -> int:
     # cache path is unsupported — jax degrades to a warning.
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
 
-    grid = list(itertools.product(
-        [int(t) for t in args.tiles.split(",")],
-        args.mc.split(","),
-        args.sbox.split(","),
-        args.engines.split(","),
-    ))
+    grid = [
+        cfg for cfg in itertools.product(
+            [int(t) for t in args.tiles.split(",")],
+            args.mc.split(","),
+            args.sbox.split(","),
+            args.engines.split(","),
+            [str(int(u)) for u in args.unroll.split(",")],
+        )
+        # Only the bitslice engine reads OT_BITSLICE_UNROLL (the Pallas
+        # engines keep all rounds in VMEM); crossing other engines with
+        # unroll values would just re-measure identical configs under
+        # mislabeled tags.
+        if cfg[3] == "bitslice" or cfg[4] == "1"
+    ]
     # Single-tenant device coordination: wait for any prior measurement
     # job, then hold the marker for the sweep (bench.py waits on the same
     # lock — a concurrent jax process wedges a tunnelled device). The
@@ -101,12 +112,14 @@ def main() -> int:
     with devlock.hold(wait_budget_s=900.0,
                       on_wait=lambda p: print(f"# waiting for {p}",
                                               file=sys.stderr)):
-        for tile, mc, sbox, engine in grid:
+        for tile, mc, sbox, engine, unroll in grid:
             env = dict(os.environ, OT_PALLAS_TILE=str(tile), OT_PALLAS_MC=mc,
-                       OT_SBOX=sbox)
+                       OT_SBOX=sbox, OT_BITSLICE_UNROLL=unroll)
             code = CHILD % {"repo": REPO, "nbytes": args.bytes,
                             "iters": args.iters, "engine": engine}
-            tag = f"tile={tile:<5} mc={mc:<4} sbox={sbox:<5} engine={engine}"
+            tag = (f"tile={tile:<5} mc={mc:<4} sbox={sbox:<5} "
+                   f"engine={engine}"
+                   + (f" unroll={unroll}" if unroll != "1" else ""))
             try:
                 out = subprocess.run(
                     [sys.executable, "-u", "-c", code], env=env,
