@@ -133,6 +133,37 @@ def test_bitslice_matches_ttable(bits):
     )
 
 
+def test_full_cipher_under_bp_sbox(monkeypatch):
+    """The whole CTR path through the bitslice AND pallas engines with the
+    Boyar–Peralta S-box selected — the exact configuration the hardware
+    tuning sweep runs under OT_SBOX=bp. jit caches don't key on SBOX_IMPL
+    (it's an import-time constant in production), so caches are cleared
+    around the monkeypatch to force a retrace under the bp circuit and to
+    keep other tests isolated from it."""
+    import jax
+
+    from our_tree_tpu.utils import packing
+
+    rng = np.random.default_rng(53)
+    key = bytes(range(16))
+    nr, rk = expand_key_enc(key)
+    rk = jnp.asarray(rk)
+    nonce = np.frombuffer(bytes(range(60, 76)), np.uint8)
+    ctr_be = jnp.asarray(packing.np_bytes_to_words(nonce).byteswap())
+    w = jnp.asarray(rng.integers(0, 2**32, (33, 4)).astype(np.uint32))
+    want = np.asarray(aes_mod.ctr_crypt_words(w, ctr_be, rk, nr, "jnp"))
+
+    jax.clear_caches()
+    monkeypatch.setattr(bitslice, "SBOX_IMPL", "bp")
+    try:
+        for engine in ("bitslice", "pallas", "pallas-gt"):
+            got = np.asarray(aes_mod.ctr_crypt_words(w, ctr_be, rk, nr,
+                                                     engine))
+            np.testing.assert_array_equal(got, want, err_msg=engine)
+    finally:
+        jax.clear_caches()  # don't leak bp-compiled executables
+
+
 def test_context_engine_parity_ctr():
     data = np.random.default_rng(7).integers(0, 256, 16 * 50 + 5, dtype=np.uint8)
     nonce = np.arange(16, dtype=np.uint8)
