@@ -13,6 +13,10 @@ goes to stdout and (with --out) to a `results.<host>.tpu` file — the L3
 results corpus of SURVEY.md §1, new backend column.
 
 Differences from the reference, on purpose:
+  * each timing row is followed by a `# derived: X GB/s` comment line
+    (SURVEY.md §5: the reference format "plus derived GB/s"); the µs rows
+    themselves stay byte-compatible, and `#` lines are trivially skipped
+    by any row parser.
   * correctness is checked, not assumed: after the sweeps, one message is
     run through every worker count and bit-compared (the shard-invariance
     check whose absence let reference defect #1 go unnoticed), the RC4 XOR
@@ -63,6 +67,17 @@ class Emitter:
 
 def _csv(times_us: list[int]) -> str:
     return "".join(f"{t}, " for t in times_us).rstrip()
+
+
+def _derived(em, nbytes: int, times_us: list[int]):
+    """Derived GB/s next to the raw µs row (SURVEY.md §5 metrics: the
+    reference format 'plus derived GB/s'). Best steady iteration, like
+    BASELINE.md derives its numbers; a comment-style line so the µs rows
+    stay byte-compatible with the reference parser."""
+    if not times_us or min(times_us) <= 0:
+        return
+    em.line(f"# derived: {nbytes / min(times_us) / 1e3:.3f} GB/s (best of "
+            f"{len(times_us)})")
 
 
 def _time_us(fn) -> tuple[int, object]:
@@ -148,6 +163,7 @@ def run_aes_mode(em, backend, mode, size, workers_list, iters, keybits, rng,
             times.append(us)
         label = backend.name.upper()
         em.line(f"{label} AES-{keybits} {mode.upper()}, {size}, {workers}, {_csv(times)}")
+        _derived(em, size, times)
 
 
 def run_cbc_batch(em, backend, size, workers_list, iters, keybits, rng,
@@ -189,6 +205,7 @@ def run_cbc_batch(em, backend, size, workers_list, iters, keybits, rng,
             times.append(us)
         em.line(f"{backend.name.upper()} AES-{keybits} CBC-BATCHx{streams}, "
                 f"{used}, {workers}, {_csv(times)}")
+        _derived(em, used, times)
         # Worker-count invariance on a fixed key/IV set (the same determinism
         # check the block-mode sweeps run); compare-and-discard so peak host
         # memory stays at one extra output regardless of the worker list.
@@ -237,6 +254,7 @@ def run_rc4_batch(em, backend, size, workers_list, iters, rng, streams):
             )
             times.append(us)
         em.line(f"RC4-KEYGEN-BATCHx{streams}, {used}, {workers}, {_csv(times)}")
+        _derived(em, used, times)
         got = np.asarray(out)
         if inv_ref is None:
             inv_ref = got
@@ -304,6 +322,7 @@ def run_rc4(em, backend, size, workers_list, iters, rng):
             )
             times.append(us)
         em.line(f"{_csv(times)}")
+        _derived(em, size, times)
         # XOR phase correctness (the reference checked nothing here).
         if out is not None and not np.array_equal(np.asarray(out), msg ^ np.asarray(ks)):
             em.line(f"RC4 XOR MISMATCH at workers={workers}")

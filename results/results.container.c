@@ -1,63 +1,99 @@
-C AES-256 ECB, 1048576, 1, 736, 758, 701, 742,
-C AES-256 ECB, 1048576, 2, 740, 833, 790, 739,
-C AES-256 ECB, 1048576, 4, 733, 782, 801, 795,
-C AES-256 ECB, 1048576, 8, 867, 850, 867, 832,
-C AES-256 ECB, 10485760, 1, 11912, 14851, 9509, 9476,
-C AES-256 ECB, 10485760, 2, 7668, 10196, 7341, 8526,
-C AES-256 ECB, 10485760, 4, 7634, 8000, 7771, 7803,
-C AES-256 ECB, 10485760, 8, 8626, 8799, 9060, 8308,
-C AES-256 ECB, 67108864, 1, 70325, 75572, 75064, 71080,
-C AES-256 ECB, 67108864, 2, 71011, 70484, 74522, 73056,
-C AES-256 ECB, 67108864, 4, 72461, 71447, 70669, 70209,
-C AES-256 ECB, 67108864, 8, 75261, 72566, 71783, 71284,
-C AES-256 CTR, 1048576, 1, 792, 1102, 676, 685,
-C AES-256 CTR, 1048576, 2, 879, 792, 806, 693,
-C AES-256 CTR, 1048576, 4, 751, 723, 782, 767,
-C AES-256 CTR, 1048576, 8, 839, 867, 884, 897,
-C AES-256 CTR, 10485760, 1, 7019, 10203, 6469, 7507,
-C AES-256 CTR, 10485760, 2, 6525, 7242, 6572, 8116,
-C AES-256 CTR, 10485760, 4, 7259, 7432, 7425, 7125,
-C AES-256 CTR, 10485760, 8, 7180, 7759, 6845, 7493,
-C AES-256 CTR, 67108864, 1, 66491, 66584, 67628, 66883,
-C AES-256 CTR, 67108864, 2, 65753, 68538, 65946, 66196,
-C AES-256 CTR, 67108864, 4, 72164, 71164, 68527, 75142,
-C AES-256 CTR, 67108864, 8, 69888, 72471, 67403, 67853,
+C AES-256 ECB, 1048576, 1, 747, 831, 721, 785,
+# derived: 1.454 GB/s (best of 4)
+C AES-256 ECB, 1048576, 2, 746, 1542, 733, 772,
+# derived: 1.431 GB/s (best of 4)
+C AES-256 ECB, 1048576, 4, 814, 763, 769, 778,
+# derived: 1.374 GB/s (best of 4)
+C AES-256 ECB, 1048576, 8, 961, 964, 896, 944,
+# derived: 1.170 GB/s (best of 4)
+C AES-256 ECB, 10485760, 1, 11002, 11387, 7885, 7737,
+# derived: 1.355 GB/s (best of 4)
+C AES-256 ECB, 10485760, 2, 8149, 7662, 7557, 7709,
+# derived: 1.388 GB/s (best of 4)
+C AES-256 ECB, 10485760, 4, 7398, 7492, 7731, 10357,
+# derived: 1.417 GB/s (best of 4)
+C AES-256 ECB, 10485760, 8, 7821, 13280, 8240, 11982,
+# derived: 1.341 GB/s (best of 4)
+C AES-256 ECB, 67108864, 1, 71190, 79478, 72643, 77458,
+# derived: 0.943 GB/s (best of 4)
+C AES-256 ECB, 67108864, 2, 74787, 86471, 92870, 87339,
+# derived: 0.897 GB/s (best of 4)
+C AES-256 ECB, 67108864, 4, 86748, 80100, 81052, 81091,
+# derived: 0.838 GB/s (best of 4)
+C AES-256 ECB, 67108864, 8, 82216, 83259, 96264, 80078,
+# derived: 0.838 GB/s (best of 4)
+C AES-256 CTR, 1048576, 1, 911, 950, 905, 924,
+# derived: 1.159 GB/s (best of 4)
+C AES-256 CTR, 1048576, 2, 941, 920, 928, 912,
+# derived: 1.150 GB/s (best of 4)
+C AES-256 CTR, 1048576, 4, 999, 1024, 936, 985,
+# derived: 1.120 GB/s (best of 4)
+C AES-256 CTR, 1048576, 8, 1145, 1229, 984, 951,
+# derived: 1.103 GB/s (best of 4)
+C AES-256 CTR, 10485760, 1, 7501, 11940, 7513, 7431,
+# derived: 1.411 GB/s (best of 4)
+C AES-256 CTR, 10485760, 2, 7185, 8238, 8138, 7465,
+# derived: 1.459 GB/s (best of 4)
+C AES-256 CTR, 10485760, 4, 9513, 7538, 10369, 8638,
+# derived: 1.391 GB/s (best of 4)
+C AES-256 CTR, 10485760, 8, 10310, 7457, 12545, 7855,
+# derived: 1.406 GB/s (best of 4)
+C AES-256 CTR, 67108864, 1, 69954, 70866, 74554, 73241,
+# derived: 0.959 GB/s (best of 4)
+C AES-256 CTR, 67108864, 2, 70583, 70647, 73976, 71025,
+# derived: 0.951 GB/s (best of 4)
+C AES-256 CTR, 67108864, 4, 84905, 76654, 68878, 67757,
+# derived: 0.990 GB/s (best of 4)
+C AES-256 CTR, 67108864, 8, 66395, 69954, 70247, 67587,
+# derived: 1.011 GB/s (best of 4)
 RC4, 1048576, 1, 
-Generated a new key in 3880, 
-870, 916, 854, 1171,
+Generated a new key in 3861, 
+832, 834, 820, 877,
+# derived: 1.279 GB/s (best of 4)
 RC4, 1048576, 2, 
-Generated a new key in 3783, 
-875, 902, 984, 893,
+Generated a new key in 3806, 
+860, 850, 863, 851,
+# derived: 1.234 GB/s (best of 4)
 RC4, 1048576, 4, 
-Generated a new key in 3810, 
-916, 874, 873, 876,
+Generated a new key in 3819, 
+856, 909, 884, 898,
+# derived: 1.225 GB/s (best of 4)
 RC4, 1048576, 8, 
-Generated a new key in 3817, 
-1162, 978, 978, 1037,
+Generated a new key in 3754, 
+1034, 986, 982, 978,
+# derived: 1.072 GB/s (best of 4)
 RC4, 10485760, 1, 
-Generated a new key in 41229, 
-8110, 12078, 8119, 7939,
+Generated a new key in 41805, 
+8565, 15112, 9396, 8162,
+# derived: 1.285 GB/s (best of 4)
 RC4, 10485760, 2, 
-Generated a new key in 37548, 
-8375, 11823, 8380, 8186,
+Generated a new key in 38258, 
+9066, 12819, 8661, 8373,
+# derived: 1.252 GB/s (best of 4)
 RC4, 10485760, 4, 
-Generated a new key in 37494, 
-11556, 11888, 7982, 8078,
+Generated a new key in 38591, 
+12281, 12393, 8505, 8350,
+# derived: 1.256 GB/s (best of 4)
 RC4, 10485760, 8, 
-Generated a new key in 37332, 
-8356, 12011, 8286, 8604,
+Generated a new key in 43344, 
+10861, 12828, 12231, 8540,
+# derived: 1.228 GB/s (best of 4)
 RC4, 67108864, 1, 
-Generated a new key in 275338, 
-76676, 82697, 79548, 78908,
+Generated a new key in 272049, 
+81908, 81671, 83196, 81441,
+# derived: 0.824 GB/s (best of 4)
 RC4, 67108864, 2, 
-Generated a new key in 265632, 
-78051, 77677, 77410, 79546,
+Generated a new key in 292651, 
+103933, 82914, 79604, 94613,
+# derived: 0.843 GB/s (best of 4)
 RC4, 67108864, 4, 
-Generated a new key in 266576, 
-82427, 80872, 90141, 77106,
+Generated a new key in 289878, 
+80149, 80660, 80586, 80005,
+# derived: 0.839 GB/s (best of 4)
 RC4, 67108864, 8, 
-Generated a new key in 264421, 
-77566, 78334, 77579, 80959,
+Generated a new key in 273611, 
+89241, 86122, 81358, 80454,
+# derived: 0.834 GB/s (best of 4)
 Shard invariance [1, 2, 4, 8]: passed
 ARC4 test #1: passed
 ARC4 test #2: passed

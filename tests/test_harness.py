@@ -27,6 +27,17 @@ def test_bench_sweep_csv(tmp_path, capsys):
         assert all(int(f) >= 0 for f in fields[3:])
     assert "Shard invariance [1, 2]: passed" in lines
     assert "ARC4 test #3: passed" in lines
+    # Every timing row carries a derived-GB/s companion line (SURVEY.md §5
+    # metrics: reference format "plus derived GB/s"), and the number matches
+    # bytes / best-µs exactly.
+    for i, row in enumerate(lines):
+        if row.startswith("TPU AES-256 ECB"):
+            fields = [f for f in row.split(",") if f.strip()]
+            best = min(int(f) for f in fields[3:])
+            want = int(fields[1]) / best / 1e3
+            derived = lines[i + 1]
+            assert derived.startswith("# derived: ")
+            assert abs(float(derived.split()[2]) - want) < 0.0005
 
 
 def test_bench_rejects_unknown_mode():
