@@ -58,6 +58,26 @@ WORKER = textwrap.dedent(
         jnp.asarray(words_np), jnp.asarray(ctr_be_np), a.rk_enc, a.nr, "jnp"))
     np.testing.assert_array_equal(gathered, ref)
     print(f"proc {pid}: multihost parity OK", flush=True)
+
+    # Multi-stream sequence parallelism across hosts: independent ARC4
+    # keystream scans sharded over the same DCN-spanning mesh (the batch
+    # path the sweep drives via --modes rc4-batch). Stream count is an
+    # exact mesh multiple so each process contributes whole shards.
+    from our_tree_tpu.models.arc4 import ARC4, key_schedule, keystream_np
+
+    S = 2 * mesh.devices.size
+    keys = [bytes([3 + i]) * 7 for i in range(S)]
+    xs, ys, ms = (np.asarray(a) for a in ARC4.batch_states(keys))
+    loc = slice(pid * S // nproc, (pid + 1) * S // nproc)
+    gx = multihost.host_local_to_global(xs[loc], mesh)
+    gy = multihost.host_local_to_global(ys[loc], mesh)
+    gm = multihost.host_local_to_global(ms[loc], mesh)
+    _, ksb = dist.arc4_prep_batch_sharded((gx, gy, gm), 48, mesh)
+    ksb = np.asarray(dist.gather_for_verification(ksb, mesh))
+    for i, k in enumerate(keys):
+        want, _ = keystream_np((0, 0, key_schedule(k)), 48)
+        np.testing.assert_array_equal(ksb[i], want)
+    print(f"proc {pid}: multihost arc4-batch parity OK", flush=True)
     """
 )
 
@@ -96,3 +116,4 @@ def test_two_process_global_mesh_ctr(tmp_path):
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {pid} failed:\n{out}"
         assert f"proc {pid}: multihost parity OK" in out
+        assert f"proc {pid}: multihost arc4-batch parity OK" in out
