@@ -1,0 +1,187 @@
+#!/usr/bin/env python
+"""Randomized bit-parity fuzz against the compiled reference oracle.
+
+The fixed suite pins known-answer vectors and hand-picked seams; this
+fuzzer drives the SAME parity contract through randomized configurations —
+key sizes, modes, directions, odd lengths, random chunk splits exercising
+every resume-state seam (CBC's chained IV, CFB128's iv_off register,
+CTR's nc_off/counter/stream_block), and random nonces including
+near-wraparound — and bit-compares outputs AND final resume states against
+the reference C oracle (scripts/gen_golden.py). The reference repo
+benchmarked without ever checking outputs (SURVEY.md §4 "output
+correctness is never checked"); this is the opposite discipline.
+
+    python scripts/fuzz_parity.py --iters 200 --seed 7
+
+Exit code 0 = every case bit-exact. On failure, prints the reproducing
+config (seed/case index) and exits 1. CPU-only by design (the oracle is
+host C; engines under test default to jnp for speed — use --engines to
+fuzz bitslice/pallas too, e.g. on real hardware).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import pathlib
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=100)
+    ap.add_argument("--seed", type=int, default=1337)
+    ap.add_argument("--max-bytes", type=int, default=1 << 16)
+    ap.add_argument("--engines", default="jnp",
+                    help="comma list; cipher engines to fuzz per case")
+    ap.add_argument("--reference", default="/root/reference",
+                    help="reference checkout to compile the oracle from")
+    ap.add_argument("--deadline", type=float, default=0,
+                    help="stop cleanly after this many seconds (0 = none)")
+    args = ap.parse_args()
+
+    import numpy as np
+
+    from gen_golden import Oracle, build_oracle
+    from our_tree_tpu.models.aes import AES, AES_DECRYPT, AES_ENCRYPT
+
+    oracle = Oracle(build_oracle(pathlib.Path(args.reference)))
+    rng = np.random.default_rng(args.seed)
+    engines = [e.strip() for e in args.engines.split(",") if e.strip()]
+    t0 = time.time()
+    done = 0
+
+    def rand_nonce():
+        # 1-in-4 cases sit near a counter-wrap seam — the hard part of the
+        # multi-chip bookkeeping (SURVEY.md §7 hard part #6).
+        if rng.integers(4) == 0:
+            n = np.full(16, 0xFF, np.uint8)
+            n[-1] = rng.integers(0xF0, 0x100)
+            cut = int(rng.integers(0, 16))
+            n[:cut] = rng.integers(0, 256, cut, np.uint8)
+            return n
+        return rng.integers(0, 256, 16, dtype=np.uint8)
+
+    def chunks(total, block_granular):
+        """Random split into 1..5 chunks (resume seams). Block-granular
+        modes (CBC) split on 16-byte boundaries only."""
+        k = int(rng.integers(1, 6))
+        if k == 1 or total < 16 * k:
+            return [total]
+        if block_granular:
+            cuts = 16 * np.sort(rng.integers(1, total // 16, k - 1))
+        else:
+            cuts = np.sort(rng.integers(1, total, k - 1))
+        sizes = np.diff(np.concatenate([[0], cuts, [total]]))
+        return [int(s) for s in sizes if s > 0]
+
+    def split(data, parts):
+        out, pos = [], 0
+        for p in parts:
+            out.append(data[pos:pos + p])
+            pos += p
+        return out
+
+    for case in range(args.iters):
+        if args.deadline and time.time() - t0 > args.deadline:
+            print(f"# deadline reached after {done} cases")
+            break
+        keybits = int(rng.choice([128, 192, 256]))
+        key = rng.integers(0, 256, keybits // 8, np.uint8).tobytes()
+        mode = str(rng.choice(["ecb", "cbc", "cfb128", "ctr"]))
+        encrypt = bool(rng.integers(2))
+        n = int(rng.integers(1, args.max_bytes + 1))
+        if mode in ("ecb", "cbc"):
+            n = max(16, n - n % 16)
+        data = rng.integers(0, 256, n, np.uint8)
+        iv = rand_nonce()
+        parts = chunks(n, block_granular=(mode == "cbc"))
+        chunk_note = f" chunks={parts}" if mode != "ecb" else ""
+        tag = (f"case {case}: {mode} {'enc' if encrypt else 'dec'} "
+               f"k{keybits} n={n}{chunk_note} seed={args.seed}")
+        data_parts = split(data, parts)
+
+        # Oracle reference — engine-independent, computed once per case.
+        # `want_state` is the final resume state, compared too: a wrong
+        # carried IV/offset/counter is invisible to output-only checks.
+        if mode == "ecb":
+            want = oracle.ecb(key, data.tobytes(), encrypt)
+            want_state = None
+        elif mode == "cbc":
+            wout, wiv = [], iv.tobytes()
+            for dp in data_parts:
+                w, wiv = oracle.cbc(key, wiv, dp.tobytes(), encrypt)
+                wout.append(w)
+            want, want_state = b"".join(wout), wiv
+        elif mode == "cfb128":
+            wchunks, woff, wiv = oracle.cfb128(
+                key, iv.tobytes(), [dp.tobytes() for dp in data_parts],
+                encrypt)
+            want, want_state = b"".join(wchunks), (woff, wiv)
+        else:  # ctr
+            wchunks, woff, wnc, wsb = oracle.ctr(
+                key, iv.tobytes(), [dp.tobytes() for dp in data_parts])
+            want, want_state = b"".join(wchunks), (woff, wnc, wsb)
+
+        for engine in engines:
+            a = AES(key, engine=engine)
+            got_state = None
+            if mode == "ecb":
+                got = a.crypt_ecb(AES_ENCRYPT if encrypt else AES_DECRYPT,
+                                  data).tobytes()
+            elif mode == "cbc":
+                out, reg = [], iv.copy()
+                for dp in data_parts:
+                    o, reg = a.crypt_cbc(
+                        AES_ENCRYPT if encrypt else AES_DECRYPT, reg, dp)
+                    out.append(o)
+                got = b"".join(o.tobytes() for o in out)
+                got_state = bytes(reg)
+            elif mode == "cfb128":
+                out, off, reg = [], 0, iv.copy()
+                for dp in data_parts:
+                    o, off, reg = a.crypt_cfb128(
+                        AES_ENCRYPT if encrypt else AES_DECRYPT, off, reg,
+                        dp)
+                    out.append(o)
+                got = b"".join(o.tobytes() for o in out)
+                got_state = (off, bytes(reg))
+            else:  # ctr (symmetric)
+                out, off, nc, sb = [], 0, iv.copy(), np.zeros(16, np.uint8)
+                for dp in data_parts:
+                    o, off, nc, sb = a.crypt_ctr(off, nc, sb, dp)
+                    out.append(o)
+                got = b"".join(o.tobytes() for o in out)
+                got_state = (off, bytes(nc), bytes(sb))
+
+            if got != want:
+                print(f"PARITY FAIL (output) [{engine}] {tag}",
+                      file=sys.stderr)
+                return 1
+            if want_state is not None and got_state != _norm(want_state):
+                print(f"PARITY FAIL (resume state) [{engine}] {tag}\n"
+                      f"  got  {got_state!r}\n  want {_norm(want_state)!r}",
+                      file=sys.stderr)
+                return 1
+        done += 1
+        if done % 25 == 0:
+            print(f"# {done} cases ok ({time.time() - t0:.0f}s)", flush=True)
+    print(f"FUZZ PASS: {done} randomized configs bit-exact vs the oracle, "
+          f"outputs and resume states (engines={engines})")
+    return 0
+
+
+def _norm(state):
+    """Oracle states to the fuzzer's comparison shape (bytes/ints)."""
+    if isinstance(state, bytes):
+        return state
+    return tuple(bytes(s) if isinstance(s, (bytes, bytearray)) else int(s)
+                 for s in state)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
