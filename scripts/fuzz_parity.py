@@ -169,6 +169,13 @@ def main() -> int:
                 return 1
         done += 1
         if done % 25 == 0:
+            # Every random length is a fresh XLA-CPU compilation; the
+            # compile caches leak enough that long sessions exhaust memory
+            # (same reason tests/conftest.py clears per module). Dropping
+            # them bounds the fuzzer's footprint at a small recompile cost.
+            import jax
+
+            jax.clear_caches()
             print(f"# {done} cases ok ({time.time() - t0:.0f}s)", flush=True)
     print(f"FUZZ PASS: {done} randomized configs bit-exact vs the oracle, "
           f"outputs and resume states (engines={engines})")
