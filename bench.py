@@ -44,6 +44,12 @@ import time
 import numpy as np
 
 BASELINE_GBPS = 0.520
+#: Probe buffer: 64 MiB, not smaller — at 4 MiB fixed dispatch overheads
+#: dominate and the ranking inverts (the probe picked pallas over
+#: pallas-gt, which is 3.6x faster at headline sizes; measured round 2).
+#: At 64 MiB the per-byte regime has set in while a probe still costs
+#: ~compile + a few hundred ms.
+PROBE_BYTES = 64 << 20
 DEADLINE_S = float(os.environ.get("OT_BENCH_DEADLINE", 1200))
 INIT_TIMEOUT_S = float(os.environ.get("OT_BENCH_INIT_TIMEOUT", 240))
 _T0 = time.perf_counter()
@@ -310,7 +316,11 @@ def _measure_and_report() -> None:
     # deadline budget runs short.
     probes, probe_digests = {}, {}
     if requested == "probe" and platform != "cpu":
-        for eng in sorted(aes_mod.CORES, key=lambda e: e == "jnp"):
+        # jnp is not probed: it is the fallback when every probe fails (and
+        # the slowest engine by ~40x — a 64 MiB jnp probe would burn its
+        # whole stage budget ranking an engine that can only ever be chosen
+        # by default).
+        for eng in sorted(e for e in aes_mod.CORES if e != "jnp"):
             if _left() < 0.35 * DEADLINE_S:
                 print(f"# probe budget exhausted before {eng}", file=sys.stderr)
                 break
@@ -318,7 +328,7 @@ def _measure_and_report() -> None:
                 # A probe is cheap when healthy; a hung one must not eat the
                 # other engines' chance — bound it well under the deadline.
                 probes[eng], probe_digests[eng] = measure(
-                    eng, 4 << 20, 2,
+                    eng, PROBE_BYTES, 2,
                     stage_budget=max(60.0, min(_left() / 2.0,
                                                0.15 * DEADLINE_S)))
             except Exception as e:  # an engine failing to compile is data
@@ -349,7 +359,7 @@ def _measure_and_report() -> None:
     # Degraded fallback = the probe's own measurement, digest included (the
     # digest is the guard against silently-skipped work; 0 would defeat it).
     gbps, digest = probes.get(engine, 0.0), probe_digests.get(engine, 0)
-    measured_bytes = 4 << 20
+    measured_bytes = PROBE_BYTES
     if _left() > 0.25 * DEADLINE_S or not probes:
         try:
             gbps, digest = measure(engine, nbytes, iters)

@@ -82,13 +82,25 @@ def register_core(name: str, encrypt_fn, decrypt_fn, ctr_fused_fn=None,
 def resolve_engine(name: str | None = "auto") -> str:
     """Map "auto" to the best available engine for the current backend.
 
-    The gather-based T-table core is fine on CPU; on TPU the VPU has no cheap
-    256-way gather (SURVEY.md §7 hard part #1), so batch paths default to the
-    bitsliced circuit engine there.
+    The gather-based T-table core is fine on CPU; on TPU the VPU has no
+    cheap 256-way gather (SURVEY.md §7 hard part #1), so batch paths use
+    the bitsliced circuit — preferably through the Pallas kernels. The
+    preference order is the round-2 hardware A/B (256 MiB CTR, v5e):
+    pallas-gt 5.93 GB/s > pallas 1.65 > bitslice ~0.2 (docs/PERF.md).
     """
     if name in (None, "auto"):
         if jax.default_backend() == "cpu":
             return "jnp"
+        from ..ops import pallas_aes
+
+        # The Pallas engines only beat the XLA circuit when they actually
+        # compile under Mosaic; on a non-TPU accelerator they would run in
+        # interpreter mode (Python emulation) — keep the compiled circuit
+        # there.
+        if not pallas_aes.interpret_mode():
+            for eng in ("pallas-gt", "pallas"):
+                if eng in CORES:
+                    return eng
         return "bitslice" if "bitslice" in CORES else "jnp"
     if name not in CORES:
         raise ValueError(f"unknown engine {name!r}; available: {sorted(CORES)}")

@@ -767,34 +767,22 @@ def inv_mixcolumns_planes(p: list, perm=None) -> list:
 # ---------------------------------------------------------------------------
 
 
-def _transpose32(a: jnp.ndarray) -> jnp.ndarray:
-    """Transpose the 32x32 bit matrix held in axis -2 (length 32, u32 rows).
+def _transpose32_lead(a: jnp.ndarray) -> jnp.ndarray:
+    """Transpose the 32x32 bit matrix held in the LEADING axis (u32 rows).
 
     Log-time SWAR ladder (the classic masked-swap network): 5 stages of
     half-word exchanges instead of materialising 8x-larger per-bit tensors.
     LSB-first convention: out[i] bit t == in[t] bit i. Involution — applying
     it twice is the identity — so the same function packs and unpacks.
-    """
-    j = 16
-    m = jnp.uint32(0x0000FFFF)
-    while j:
-        sh = a.shape
-        b = a.reshape(sh[:-2] + (32 // (2 * j), 2, j) + sh[-1:])
-        lo, hi = b[..., 0, :, :], b[..., 1, :, :]
-        t = (lo >> j ^ hi) & m
-        a = jnp.stack([lo ^ (t << j), hi ^ t], axis=-3).reshape(sh)
-        j >>= 1
-        m = m ^ (m << j)
-    return a
 
-
-def _transpose32_lead(a: jnp.ndarray) -> jnp.ndarray:
-    """_transpose32 for a LEADING (32, ...) axis — the kernel-safe form.
-
-    Same masked-swap SWAR ladder, but the 32-axis is axis 0 and every
-    reshape/slice/stack touches only leading axes, leaving the minor
-    (sublane, lane) dims untouched — the conservative Mosaic feature set
-    (cf. pallas_aes._perm_stack). Involution, like _transpose32.
+    The 32-axis is axis 0 and every reshape/slice/stack touches only
+    leading axes, leaving the minor (sublane, lane) dims untouched. That
+    makes it both the conservative Mosaic feature set for in-kernel use
+    (cf. pallas_aes._perm_stack) AND the only HBM-sane XLA form: a ladder
+    over a MINOR 32/4 axis materialises stage tensors whose 4-wide minor
+    dim pads to the 128-lane tile — 32x the logical bytes per stage, which
+    throttled conversions and OOMed 1 GiB buffers before to_planes was
+    routed through the grouped layout.
     """
     j = 16
     m = jnp.uint32(0x0000FFFF)
@@ -857,28 +845,25 @@ def grouped_from_planes(p: jnp.ndarray) -> jnp.ndarray:
 
 
 def to_planes(words: jnp.ndarray) -> jnp.ndarray:
-    """(N, 4) u32 LE words, N % 32 == 0  ->  (8, 16, N/32) u32 planes."""
-    n = words.shape[0]
-    w = n // 32
-    # Column c of a 32-block group is a 32x32 bit matrix: row t = word c of
-    # block t, whose bit 8a+b is bit b of state byte 4c+a. Transposing gives
-    # row 8a+b = plane(byte 4c+a, bit b) with lane t = block t.
-    grouped = words.reshape(w, 32, 4)
-    tr = _transpose32(grouped)                       # (W, 32, 4)
-    planes = tr.transpose(1, 2, 0).reshape(4, 8, 4, w)   # (a, b, c, W)
-    return planes.transpose(1, 2, 0, 3).reshape(8, 16, w)
+    """(N, 4) u32 LE words, N % 32 == 0  ->  (8, 16, N/32) u32 planes.
+
+    Column c of a 32-block group is a 32x32 bit matrix: row t = word c of
+    block t, whose bit 8a+b is bit b of state byte 4c+a. Transposing gives
+    row 8a+b = plane(byte 4c+a, bit b) with lane t = block t.
+
+    Routed through the grouped (32, 4, W) layout so the ladder's 32-axis is
+    LEADING and the lane axis stays minor in every stage tensor — the
+    direct (W, 32, 4) formulation's intermediates have a 4-wide minor dim
+    that TPU tiled layouts pad to 128 lanes (32x HBM inflation: measured as
+    the 1.65 GB/s pallas-engine ceiling, and a 32 GiB allocation — OOM —
+    on a 1 GiB buffer).
+    """
+    return planes_from_grouped(group_words(words))
 
 
 def from_planes(planes: jnp.ndarray) -> jnp.ndarray:
-    """(8, 16, W) u32 planes -> (32*W, 4) u32 LE words."""
-    w = planes.shape[2]
-    tr = (
-        planes.reshape(8, 4, 4, w)                   # (b, c, a, W)
-        .transpose(2, 0, 1, 3)                       # (a, b, c, W)
-        .reshape(32, 4, w)
-        .transpose(2, 0, 1)                          # (W, 32, 4)
-    )
-    return _transpose32(tr).reshape(32 * w, 4)
+    """(8, 16, W) u32 planes -> (32*W, 4) u32 LE words (to_planes⁻¹)."""
+    return ungroup_words(grouped_from_planes(planes))
 
 
 def key_planes(rk: jnp.ndarray, nr: int) -> jnp.ndarray:

@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""Tunnel-recovery watcher: probe the device, then run the measurement plan.
+
+The build host reaches its one TPU chip through a tunnel that wedges if a
+jax process dies mid-device-op (PJRT client init then blocks indefinitely
+for every later process, sometimes for hours, until the far side recovers).
+Nothing local can unwedge it — so this watcher probes `jax.devices()` in a
+throwaway subprocess on an interval and, the moment init succeeds, runs the
+full queued hardware measurement plan:
+
+  1. headline bench (probe-selected engine)
+  2. 1 GiB BASELINE-metric bench (pallas-gt)
+  3. Mosaic compile smoke, full kernel matrix      (scripts/smoke_tpu.py)
+  4. tile x MC x S-box x engine tuning sweep       (scripts/tune_tpu.py)
+  5. component profile                             (scripts/profile_ctr.py)
+  6. results.<host>.tpu sweep corpus               (harness.bench --default-out)
+
+Each step's full stdout+stderr (including the bench JSON lines) lands in
+<plan-dir>/<step>.log; the corpus step additionally writes the repo's
+results/results.<host>.tpu file itself.
+
+Steps run strictly sequentially (one jax process at a time — the tunnel is
+single-tenant; see utils/devlock.py). Every child gets an INTERNAL deadline
+(OT_BENCH_DEADLINE / per-config timeouts) below this script's outer timeout,
+so children exit by themselves; the outer kill is a last resort against a
+hang that is itself evidence the tunnel wedged again — in which case the
+watcher returns to probing and resumes the plan from the failed step.
+
+    python scripts/recover_watch.py [--probe-interval 780] [--budget-h 10]
+
+Logs to --plan-dir (default /tmp/ot_plan); prints one status line per event.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def probe(timeout_s: float) -> bool:
+    try:
+        subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s, check=True,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        return True
+    except (subprocess.TimeoutExpired, subprocess.CalledProcessError):
+        return False
+
+
+def plan():
+    """(name, argv, extra_env, outer_timeout_s) for each step, in order."""
+    py = sys.executable
+    harness = [py, "-m", "our_tree_tpu.harness.bench"]
+    return [
+        ("bench_headline", [py, os.path.join(REPO, "bench.py")],
+         {"OT_BENCH_DEADLINE": "1100"}, 1400),
+        ("bench_1gib", [py, os.path.join(REPO, "bench.py")],
+         {"OT_BENCH_DEADLINE": "1100",
+          "OT_BENCH_BYTES": str(1 << 30),
+          "OT_BENCH_ENGINE": "pallas-gt"}, 1400),
+        ("smoke", [py, os.path.join(REPO, "scripts", "smoke_tpu.py")],
+         {}, 4 * 3600),
+        ("tune", [py, os.path.join(REPO, "scripts", "tune_tpu.py"),
+                  "--bytes", str(128 << 20), "--iters", "3",
+                  "--tiles", "1024,2048", "--mc", "perm,roll",
+                  "--sbox", "tower,bp", "--engines", "pallas,pallas-gt",
+                  "--timeout", "700"],
+         {}, 4 * 3600),
+        ("profile", [py, os.path.join(REPO, "scripts", "profile_ctr.py")],
+         {}, 1800),
+        ("corpus", harness + ["--backend", "tpu", "--default-out"],
+         {}, 2 * 3600),
+    ]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--probe-interval", type=float, default=780.0,
+                    help="seconds between probes while wedged (~13 min)")
+    ap.add_argument("--probe-timeout", type=float, default=180.0)
+    ap.add_argument("--budget-h", type=float, default=10.0,
+                    help="give up after this many hours")
+    ap.add_argument("--plan-dir", default="/tmp/ot_plan")
+    ap.add_argument("--start-step", type=int, default=0,
+                    help="resume the plan from this step index")
+    args = ap.parse_args()
+
+    os.makedirs(args.plan_dir, exist_ok=True)
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
+    deadline = time.time() + args.budget_h * 3600
+    steps = plan()
+    idx = args.start_step
+
+    while idx < len(steps) and time.time() < deadline:
+        if not probe(args.probe_timeout):
+            print(f"# wedged; next step={steps[idx][0]}; sleeping "
+                  f"{args.probe_interval:.0f}s", flush=True)
+            time.sleep(args.probe_interval)
+            continue
+        name, argv, env, outer = steps[idx]
+        log = os.path.join(args.plan_dir, f"{name}.log")
+        print(f"# tunnel live -> running {name} (log: {log})", flush=True)
+        t0 = time.time()
+        # Append: a step retried after a re-wedge must not truncate the
+        # previous attempt's partial output — that log is the evidence of
+        # what was running when the wedge hit.
+        with open(log, "a") as fh:
+            fh.write(f"## attempt at {time.strftime('%F %T')}\n")
+            fh.flush()
+            try:
+                rc = subprocess.run(
+                    argv, env=dict(os.environ, **env), cwd=REPO,
+                    stdout=fh, stderr=subprocess.STDOUT,
+                    timeout=min(outer, max(deadline - time.time(), 60)),
+                ).returncode
+            except subprocess.TimeoutExpired:
+                rc = "timeout"
+        print(f"# {name}: rc={rc} in {time.time() - t0:.0f}s", flush=True)
+        if rc == "timeout":
+            continue  # evidence of a re-wedge: back to probing, same step
+        idx += 1  # non-zero rc is the step's own failure, not a wedge:
+        #           its log has the story; the plan moves on
+    done = idx >= len(steps)
+    print(f"PLAN {'COMPLETE' if done else f'ABANDONED at step {idx}'}",
+          flush=True)
+    return 0 if done else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
