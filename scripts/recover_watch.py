@@ -34,17 +34,43 @@ from __future__ import annotations
 
 import argparse
 import os
+import signal
 import subprocess
 import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+from _devlock_loader import load_devlock  # noqa: E402
+
+
+#: The probe must EXECUTE something, not just init: a half-recovered tunnel
+#: passes PJRT client init and then blocks forever on the first transfer or
+#: execute (observed round 2: init at 5 s, then 23 min of silence until the
+#: outer kill). A tiny device_put + compute + readback classifies that state
+#: as wedged, so the watcher keeps probing instead of launching a plan step
+#: that can only burn its timeout.
+#:
+#: Tradeoff, accepted deliberately: on timeout the child is killed
+#: mid-device-op — the very action the module docstring names as the wedge
+#: trigger. On an already-wedged tunnel that changes nothing; the risk case
+#: is a tunnel that is merely SLOW, which the generous default timeout
+#: (240 s for an op that takes <30 s healthy, init included) is sized to
+#: protect. An init-only probe has the same kill-mid-init exposure and
+#: cannot detect the half-recovered state at all.
+_PROBE_SRC = (
+    "import sys, jax, jax.numpy as jnp;"
+    "x = jax.device_put(jnp.arange(64, dtype=jnp.uint32));"
+    # not an assert: PYTHONOPTIMIZE/-O would strip it, silently degrading
+    # the probe to transfer-only
+    "sys.exit(0 if int((x ^ jnp.uint32(7)).sum()) == 2016 else 1)"
+)
 
 
 def probe(timeout_s: float) -> bool:
     try:
         subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
+            [sys.executable, "-c", _PROBE_SRC],
             timeout=timeout_s, check=True,
             stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
         )
@@ -83,7 +109,7 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--probe-interval", type=float, default=780.0,
                     help="seconds between probes while wedged (~13 min)")
-    ap.add_argument("--probe-timeout", type=float, default=180.0)
+    ap.add_argument("--probe-timeout", type=float, default=240.0)
     ap.add_argument("--budget-h", type=float, default=10.0,
                     help="give up after this many hours")
     ap.add_argument("--plan-dir", default="/tmp/ot_plan")
@@ -97,35 +123,78 @@ def main() -> int:
     steps = plan()
     idx = args.start_step
 
+    devlock = load_devlock()
+    #: Children are re-pointed at a plan-local marker so they serialize
+    #: among themselves (trivially — the plan is sequential) instead of
+    #: waiting out their budget on the watcher's own marker.
+    child_busy = devlock.path() + ".plan"
     while idx < len(steps) and time.time() < deadline:
-        if not probe(args.probe_timeout):
-            print(f"# wedged; next step={steps[idx][0]}; sleeping "
-                  f"{args.probe_interval:.0f}s", flush=True)
+        # Single-tenant tunnel: the marker is held across probe AND step,
+        # closing the check-then-act window where a concurrent job (driver
+        # bench, manual sweep) could start device work between our
+        # devlock check and the probe's own device op — two overlapping
+        # jax processes are the documented wedge trigger. acquire() fails
+        # while another live job holds the marker; then we just sleep.
+        # Stale markers (dead holders) are reclaimed inside acquire().
+        rc = "busy"  # sentinel: neither step-finished nor step-timeout
+        with devlock.hold() as owned:  # refresher keeps mtime < STALE_S
+            if not owned:
+                print("# device busy (devlock held); sleeping 60s",
+                      flush=True)
+            elif not probe(args.probe_timeout):
+                rc = "wedged"
+                print(f"# wedged; next step={steps[idx][0]}; sleeping "
+                      f"{args.probe_interval:.0f}s", flush=True)
+            else:
+                name, argv, env, outer = steps[idx]
+                log = os.path.join(args.plan_dir, f"{name}.log")
+                print(f"# tunnel live -> running {name} (log: {log})",
+                      flush=True)
+                t0 = time.time()
+                # Append: a step retried after a re-wedge must not truncate
+                # the previous attempt's partial output — that log is the
+                # evidence of what was running when the wedge hit.
+                with open(log, "a") as fh:
+                    fh.write(f"## attempt at {time.strftime('%F %T')}\n")
+                    fh.flush()
+                    # Own session so a timeout kills the whole process
+                    # GROUP: several steps (smoke, tune, corpus) are
+                    # parents of their own jax subprocesses, and killing
+                    # only the parent would orphan a grandchild that keeps
+                    # driving the device while we probe — the documented
+                    # two-process wedge trigger.
+                    proc = subprocess.Popen(
+                        argv,
+                        env=dict(os.environ,
+                                 OT_BENCH_BUSY_FILE=child_busy, **env),
+                        cwd=REPO,
+                        stdout=fh, stderr=subprocess.STDOUT,
+                        start_new_session=True,
+                    )
+                    try:
+                        rc = proc.wait(
+                            timeout=min(outer,
+                                        max(deadline - time.time(), 60)))
+                    except subprocess.TimeoutExpired:
+                        try:
+                            os.killpg(proc.pid, signal.SIGKILL)
+                        except OSError:
+                            pass
+                        proc.wait()
+                        rc = "timeout"
+                print(f"# {name}: rc={rc} in {time.time() - t0:.0f}s",
+                      flush=True)
+        # Sleeps happen AFTER the marker is released so a waiting job can
+        # take the device during them.
+        if rc == "busy":
+            time.sleep(60)
+        elif rc == "wedged":
             time.sleep(args.probe_interval)
-            continue
-        name, argv, env, outer = steps[idx]
-        log = os.path.join(args.plan_dir, f"{name}.log")
-        print(f"# tunnel live -> running {name} (log: {log})", flush=True)
-        t0 = time.time()
-        # Append: a step retried after a re-wedge must not truncate the
-        # previous attempt's partial output — that log is the evidence of
-        # what was running when the wedge hit.
-        with open(log, "a") as fh:
-            fh.write(f"## attempt at {time.strftime('%F %T')}\n")
-            fh.flush()
-            try:
-                rc = subprocess.run(
-                    argv, env=dict(os.environ, **env), cwd=REPO,
-                    stdout=fh, stderr=subprocess.STDOUT,
-                    timeout=min(outer, max(deadline - time.time(), 60)),
-                ).returncode
-            except subprocess.TimeoutExpired:
-                rc = "timeout"
-        print(f"# {name}: rc={rc} in {time.time() - t0:.0f}s", flush=True)
-        if rc == "timeout":
+        elif rc == "timeout":
             continue  # evidence of a re-wedge: back to probing, same step
-        idx += 1  # non-zero rc is the step's own failure, not a wedge:
-        #           its log has the story; the plan moves on
+        else:
+            idx += 1  # non-zero rc is the step's own failure, not a wedge:
+            #           its log has the story; the plan moves on
     done = idx >= len(steps)
     print(f"PLAN {'COMPLETE' if done else f'ABANDONED at step {idx}'}",
           flush=True)
