@@ -377,8 +377,13 @@ def _measure_and_report() -> None:
         # jnp is not probed: it is the fallback when every probe fails (and
         # the slowest engine by ~40x — a 64 MiB jnp probe would burn its
         # whole stage budget ranking an engine that can only ever be chosen
-        # by default).
-        for eng in sorted(e for e in aes_mod.CORES if e != "jnp"):
+        # by default). Probe order = expected-winner first (round-2 hardware
+        # A/B, docs/PERF.md): when the deadline budget cuts the probe stage
+        # short, it trims the least likely winners, not the favourites.
+        order = ("pallas-gt", "pallas-gt-bp", "pallas", "bitslice")
+        engines = [e for e in order if e in aes_mod.CORES] + sorted(
+            e for e in aes_mod.CORES if e != "jnp" and e not in order)
+        for eng in engines:
             if _left() < 0.35 * DEADLINE_S:
                 print(f"# probe budget exhausted before {eng}", file=sys.stderr)
                 break

@@ -482,3 +482,12 @@ register_core("pallas-gt", _pallas_aes.encrypt_words_gt,
               _pallas_aes.decrypt_words_gt,
               ctr_fused_fn=_pallas_aes.ctr_crypt_words_gt,
               pallas_backed=True)
+# Same kernel structure as pallas-gt with the Boyar–Peralta S-box circuit
+# pinned per-call (~25% less round arithmetic; decrypt shares pallas-gt's
+# tower path — there is no comparably small inverse circuit). A separate
+# engine NAME so bench.py's probe stage A/Bs the two formulations on
+# hardware in one run; under OT_SBOX=bp it coincides with pallas-gt.
+register_core("pallas-gt-bp", _pallas_aes.encrypt_words_gt_bp,
+              _pallas_aes.decrypt_words_gt,
+              ctr_fused_fn=_pallas_aes.ctr_crypt_words_gt_bp,
+              pallas_backed=True)

@@ -689,11 +689,24 @@ def _bp_sbox_core(p: list) -> list:
     return [s7, s6, s5, s4, s3, s2, s1, s0]
 
 
-def sbox_planes(p: list) -> list:
-    if SBOX_IMPL == "tower":
+def sbox_planes(p: list, impl: str | None = None) -> list:
+    """Forward S-box on 8 stacked bit planes.
+
+    ``impl`` overrides the module-level OT_SBOX choice per call site —
+    engines register formulation variants (models/aes.py "pallas-gt-bp")
+    so a single probing run can A/B the circuits on hardware without
+    re-importing the module under a different env.
+    """
+    impl = impl or SBOX_IMPL
+    if impl not in ("tower", "bp", "chain"):
+        # The module-level OT_SBOX value is validated at import; a typo'd
+        # per-call override must not silently fall through to the generic
+        # x^254 chain (~2.3x the ops) and skew a hardware A/B.
+        raise ValueError(f"unknown S-box impl {impl!r}")
+    if impl == "tower":
         t = tower_inv_planes(apply_linear(M_SBOX_IN, p))
         return xor_const(apply_linear(M_SBOX_OUT, t), AFF_CONST)
-    if SBOX_IMPL == "bp":
+    if impl == "bp":
         return xor_const(_bp_sbox_core(p), AFF_CONST)
     return xor_const(apply_linear(MAT_AFF, gf_inv_planes(p)), AFF_CONST)
 
@@ -891,16 +904,18 @@ def _perm_take(x: jnp.ndarray, idx: np.ndarray) -> jnp.ndarray:
 
 
 def encrypt_round(planes: jnp.ndarray, kp: jnp.ndarray, last: bool,
-                  perm=_perm_take, mc="auto") -> jnp.ndarray:
+                  perm=_perm_take, mc="auto", sbox: str | None = None) -> jnp.ndarray:
     """One forward round on stacked planes; kp = (8, 16, 1) key masks.
 
     ``mc`` picks the MixColumns rotation lowering: "auto" follows ``perm``
     (gather form -> reshape+roll, kernel form -> leading-axis perms);
     "roll"/"perm" force one — a tuning knob for Mosaic, where the relative
     cost of sublane rolls vs slice-stacks is hardware-generation-dependent.
+    ``sbox`` likewise overrides the S-box formulation per call (see
+    sbox_planes); None keeps the module-level OT_SBOX choice.
     """
     mc_perm = _resolve_mc(perm, mc)
-    p = sbox_planes([planes[i] for i in range(8)])
+    p = sbox_planes([planes[i] for i in range(8)], impl=sbox)
     p = [perm(x, SR_PERM) for x in p]
     if not last:
         p = mixcolumns_planes(p, perm=mc_perm)

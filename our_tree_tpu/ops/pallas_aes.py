@@ -118,9 +118,15 @@ _LAYOUTS = {
 
 
 def _aes_kernel(kp_ref, in_ref, out_ref, *, nr: int, decrypt: bool,
-                interpret: bool, unpack=None, pack=None):
+                interpret: bool, unpack=None, pack=None,
+                sbox: str | None = None):
     kp = kp_ref[...]
-    round_fn = bitslice.decrypt_round if decrypt else bitslice.encrypt_round
+    # sbox picks the forward S-box circuit per ENGINE (models/aes.py
+    # registers formulation variants like "pallas-gt-bp"); decrypt always
+    # takes the tower inverse — Boyar–Peralta published no comparably small
+    # inverse circuit (ops/bitslice.py:inv_sbox_planes).
+    round_fn = (bitslice.decrypt_round if decrypt
+                else functools.partial(bitslice.encrypt_round, sbox=sbox))
     x = in_ref[...]
     p = unpack(x) if unpack is not None else x
     p = _run_rounds(p ^ kp[0], kp, nr, round_fn, interpret)
@@ -181,14 +187,15 @@ def _interpret() -> bool:
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("nr", "decrypt", "tile", "layout"))
-def _crypt_planes_pallas(x, kp, *, nr, decrypt, tile, layout="planes"):
+                   static_argnames=("nr", "decrypt", "tile", "layout", "sbox"))
+def _crypt_planes_pallas(x, kp, *, nr, decrypt, tile, layout="planes",
+                         sbox=None):
     _, _, shape_fn, unpack, pack = _LAYOUTS[layout]
     w = x.shape[2]
     interpret = _interpret()
     kernel = functools.partial(
         _aes_kernel, nr=nr, decrypt=decrypt, interpret=interpret,
-        unpack=unpack, pack=pack,
+        unpack=unpack, pack=pack, sbox=sbox,
     )
     return pl.pallas_call(
         kernel,
@@ -218,7 +225,7 @@ def _lane_pad_and_tile(n: int) -> tuple[int, int]:
     return pad, tile
 
 
-def _crypt_words(words, rk, nr, decrypt, layout="planes"):
+def _crypt_words(words, rk, nr, decrypt, layout="planes", sbox=None):
     n = words.shape[0]
     if n == 0:
         return words
@@ -229,7 +236,7 @@ def _crypt_words(words, rk, nr, decrypt, layout="planes"):
     x = pre(words)
     kp = _match_vma(bitslice.key_planes(rk, nr), x)
     out = _crypt_planes_pallas(x, kp, nr=nr, decrypt=decrypt, tile=tile,
-                               layout=layout)
+                               layout=layout, sbox=sbox)
     return post(out)[:n]
 
 
@@ -247,6 +254,17 @@ def encrypt_words_gt(words: jnp.ndarray, rk: jnp.ndarray, nr: int):
     """Grouped-transpose ECB encrypt (in-kernel SWAR ladder); contract of
     encrypt_words. The "pallas-gt" engine."""
     return _crypt_words(words, rk, nr, decrypt=False, layout="grouped")
+
+
+def encrypt_words_gt_bp(words: jnp.ndarray, rk: jnp.ndarray, nr: int):
+    """Grouped-transpose ECB encrypt with the Boyar–Peralta S-box circuit
+    (119 vs the tower's 174 plane-ops — docs/PERF.md ledger item 7) pinned
+    per-call, regardless of OT_SBOX. The "pallas-gt-bp" engine: registering
+    the formulation as its own engine lets bench.py's probe stage A/B the
+    two circuits on hardware in ONE run instead of needing an env-var
+    re-import sweep (scripts/tune_tpu.py still covers the full matrix)."""
+    return _crypt_words(words, rk, nr, decrypt=False, layout="grouped",
+                        sbox="bp")
 
 
 def decrypt_words_gt(words: jnp.ndarray, rk_dec: jnp.ndarray, nr: int):
@@ -392,24 +410,28 @@ def _ctr_planes_from_base(base, g, tile: int):
 
 
 def _ctr_gen_kernel(kp_ref, base_ref, data_ref, out_ref, *, nr: int,
-                    tile: int, interpret: bool, pack=None):
+                    tile: int, interpret: bool, pack=None,
+                    sbox: str | None = None):
     kp = kp_ref[...]
     ctr = _ctr_planes_from_base(base_ref[...], pl.program_id(0), tile)
-    p = _run_rounds(ctr ^ kp[0], kp, nr, bitslice.encrypt_round, interpret)
-    ks = bitslice.encrypt_round(p, kp[nr], True, perm=_perm_stack)
+    round_fn = functools.partial(bitslice.encrypt_round, sbox=sbox)
+    p = _run_rounds(ctr ^ kp[0], kp, nr, round_fn, interpret)
+    ks = round_fn(p, kp[nr], True, perm=_perm_stack)
     # In the grouped layout (pack set) the DATA tile is never bit-transposed
     # at all: XOR commutes with the transposition, so only the synthesised
     # keystream converts (bitslice.grouped_from_planes) before the XOR.
     out_ref[...] = data_ref[...] ^ (pack(ks) if pack is not None else ks)
 
 
-@functools.partial(jax.jit, static_argnames=("nr", "tile", "layout"))
-def _ctr_gen_planes_pallas(x, base_masks, kp, *, nr, tile, layout="planes"):
+@functools.partial(jax.jit,
+                   static_argnames=("nr", "tile", "layout", "sbox"))
+def _ctr_gen_planes_pallas(x, base_masks, kp, *, nr, tile, layout="planes",
+                           sbox=None):
     _, _, shape_fn, _, pack = _LAYOUTS[layout]
     w = x.shape[2]
     interpret = _interpret()
     kernel = functools.partial(_ctr_gen_kernel, nr=nr, tile=tile,
-                               interpret=interpret, pack=pack)
+                               interpret=interpret, pack=pack, sbox=sbox)
     spec = pl.BlockSpec(shape_fn(tile), lambda i: (0, 0, i))
     return pl.pallas_call(
         kernel,
@@ -425,7 +447,7 @@ def _ctr_gen_planes_pallas(x, base_masks, kp, *, nr, tile, layout="planes"):
     )(kp, base_masks, x)
 
 
-def _ctr_gen_words(words, ctr_be_words, rk, nr, layout):
+def _ctr_gen_words(words, ctr_be_words, rk, nr, layout, sbox=None):
     n = words.shape[0]
     if n == 0:
         return words
@@ -437,7 +459,8 @@ def _ctr_gen_words(words, ctr_be_words, rk, nr, layout):
     x = pre(words)
     base = _match_vma(_base_bit_masks(ctr_be_words), x)
     kp = _match_vma(bitslice.key_planes(rk, nr), x)
-    out = _ctr_gen_planes_pallas(x, base, kp, nr=nr, tile=tile, layout=layout)
+    out = _ctr_gen_planes_pallas(x, base, kp, nr=nr, tile=tile, layout=layout,
+                                 sbox=sbox)
     return post(out)[:n]
 
 
@@ -456,6 +479,15 @@ def ctr_crypt_words_gt(words: jnp.ndarray, ctr_be_words: jnp.ndarray,
     better than XLA schedules the to/from_planes HBM round-trips
     (tune_tpu --engines pallas,pallas-gt measures both)."""
     return _ctr_gen_words(words, ctr_be_words, rk, nr, layout="grouped")
+
+
+def ctr_crypt_words_gt_bp(words: jnp.ndarray, ctr_be_words: jnp.ndarray,
+                          rk: jnp.ndarray, nr: int) -> jnp.ndarray:
+    """ctr_crypt_words_gt with the Boyar–Peralta S-box pinned per-call —
+    the "pallas-gt-bp" engine's CTR_FUSED entry (see encrypt_words_gt_bp
+    for why the formulation is its own engine)."""
+    return _ctr_gen_words(words, ctr_be_words, rk, nr, layout="grouped",
+                          sbox="bp")
 
 
 def _base_bit_masks(ctr_be_words: jnp.ndarray) -> jnp.ndarray:

@@ -156,7 +156,7 @@ def test_full_cipher_under_bp_sbox(monkeypatch):
     jax.clear_caches()
     monkeypatch.setattr(bitslice, "SBOX_IMPL", "bp")
     try:
-        for engine in ("bitslice", "pallas", "pallas-gt"):
+        for engine in ("bitslice", "pallas", "pallas-gt", "pallas-gt-bp"):
             got = np.asarray(aes_mod.ctr_crypt_words(w, ctr_be, rk, nr,
                                                      engine))
             np.testing.assert_array_equal(got, want, err_msg=engine)

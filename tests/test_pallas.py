@@ -139,7 +139,7 @@ def test_ctr_flat_stream_equals_block_words():
     data = rng.integers(0, 256, 16 * 77, np.uint8)
     w2 = jnp.asarray(packing.np_bytes_to_words(data).reshape(-1, 4))
     wf = jnp.asarray(packing.np_bytes_to_words(data))
-    for engine in ("jnp", "bitslice", "pallas", "pallas-gt"):
+    for engine in ("jnp", "bitslice", "pallas", "pallas-gt", "pallas-gt-bp"):
         o2 = np.asarray(aes_mod.ctr_crypt_words(w2, ctr_be, rk, nr, engine))
         of = np.asarray(aes_mod.ctr_crypt_words(wf, ctr_be, rk, nr, engine))
         assert of.shape == (4 * 77,)
@@ -155,12 +155,13 @@ def test_pallas_engine_ctr_context():
     data = np.random.default_rng(9).integers(0, 256, 16 * 40 + 7, np.uint8)
     nonce = np.arange(16, dtype=np.uint8)
     outs = {}
-    for engine in ("jnp", "pallas", "pallas-gt"):
+    for engine in ("jnp", "pallas", "pallas-gt", "pallas-gt-bp"):
         a = AES(bytes(range(16)), engine=engine)
         outs[engine], *_ = a.crypt_ctr(0, nonce.copy(),
                                        np.zeros(16, np.uint8), data)
     np.testing.assert_array_equal(outs["jnp"], outs["pallas"])
     np.testing.assert_array_equal(outs["jnp"], outs["pallas-gt"])
+    np.testing.assert_array_equal(outs["jnp"], outs["pallas-gt-bp"])
 
 
 @pytest.mark.parametrize("keybytes", [24, 32])
@@ -183,7 +184,7 @@ def test_pallas_kernels_long_keys(keybytes, monkeypatch):
     w = jnp.asarray(rng.integers(0, 2**32, (32 * 128, 4)).astype(np.uint32))
     want_ctr = np.asarray(aes_mod.ctr_crypt_words(w, ctr_be, rk, nr, "jnp"))
     want_ecb = np.asarray(aes_mod.ecb_encrypt_words(w, rk, nr, "jnp"))
-    for engine in ("pallas", "pallas-gt"):
+    for engine in ("pallas", "pallas-gt", "pallas-gt-bp"):
         got = np.asarray(aes_mod.ctr_crypt_words(w, ctr_be, rk, nr, engine))
         np.testing.assert_array_equal(got, want_ctr, err_msg=f"ctr {engine}")
         got = np.asarray(aes_mod.ecb_encrypt_words(w, rk, nr, engine))
