@@ -288,20 +288,24 @@ def _measure_and_report() -> None:
     requested = os.environ.get("OT_BENCH_ENGINE", "probe")
     iters = int(os.environ.get("OT_BENCH_ITERS", 5))
 
-    a = AES(bytes(range(16)))  # AES-128
     nonce = np.frombuffer(bytes.fromhex("f0f1f2f3f4f5f6f7f8f9fafbfcfdfeff"), np.uint8)
-    # Canary device op under an alarm: a half-recovered tunnel passes the
+    # Canary device ops under an alarm: a half-recovered tunnel passes the
     # init PROBE (PJRT client comes up) and then blocks forever on the first
     # real transfer/execute — which used to happen here, OUTSIDE every stage
     # alarm, burning the whole deadline with no JSON line (observed round 2:
-    # 18 min of silence until the watcher's outer kill). Bound the first
-    # staging op tightly; on timeout fall straight to the native host
-    # runtime so the run still reports a real framework number.
+    # 18 min of silence until the watcher's outer kill). The FIRST transfer
+    # of the run must therefore happen inside this alarm — including the
+    # AES context's round-key staging (jnp.asarray in AES.__post_init__
+    # goes through the same PJRT host-to-device path as device_put). On
+    # timeout fall straight to the native host runtime so the run still
+    # reports a real framework number.
     try:
         with _stage_alarm(_stage_budget(min(150.0, 0.2 * DEADLINE_S))):
             ctr_be = jax.device_put(
                 jnp.asarray(packing.np_bytes_to_words(nonce).byteswap()))
             jax.block_until_ready(ctr_be)
+            a = AES(bytes(range(16)))  # AES-128; stages round keys
+            jax.block_until_ready((a.rk_enc, a.rk_dec))
     except TimeoutError:
         if platform == "cpu":
             raise  # a hung CPU op is a real bug, not a tunnel symptom

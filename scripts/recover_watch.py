@@ -86,10 +86,12 @@ def plan():
     return [
         ("bench_headline", [py, os.path.join(REPO, "bench.py")],
          {"OT_BENCH_DEADLINE": "1100"}, 1400),
+        # Probe-selected engine (not pinned): the probe stage ranks the
+        # registered engines — including the pallas-gt-bp S-box variant —
+        # so the 1 GiB BASELINE metric lands on the measured winner.
         ("bench_1gib", [py, os.path.join(REPO, "bench.py")],
          {"OT_BENCH_DEADLINE": "1100",
-          "OT_BENCH_BYTES": str(1 << 30),
-          "OT_BENCH_ENGINE": "pallas-gt"}, 1400),
+          "OT_BENCH_BYTES": str(1 << 30)}, 1400),
         ("smoke", [py, os.path.join(REPO, "scripts", "smoke_tpu.py")],
          {}, 4 * 3600),
         ("tune", [py, os.path.join(REPO, "scripts", "tune_tpu.py"),
