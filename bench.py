@@ -224,13 +224,34 @@ def main() -> None:
     pinned_cpu = os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu"
     owned = False
     if not pinned_cpu:
+        # Wait cap stays at 0.3*DEADLINE: _ensure_live_backend's retry
+        # guard admits a second init attempt only while _left() >= 0.6 of
+        # the deadline, so a larger wait here would silently disable the
+        # retries it was tuned against.
         devlock.wait(
             0.3 * DEADLINE_S,
             on_wait=lambda p: print(
                 f"# waiting for concurrent device job ({p})",
                 file=sys.stderr),
         )
-        owned = devlock.acquire()
+        # acquire() can race a holder that exits between calls: returning
+        # False with no marker left on disk must not send this run to the
+        # device UNLOCKED (a sweep starting mid-run would overlap on the
+        # single-tenant tunnel). Bounded retry closes the window.
+        for _ in range(3):
+            owned = devlock.acquire()
+            if owned or devlock.is_held():
+                break
+        if not owned and devlock.is_held():
+            # A LIVE holder outlasted the wait budget. Proceeding anyway
+            # would put two jax processes on the single-tenant tunnel —
+            # the documented wedge trigger — corrupting both the holder's
+            # measurement and this one. The honest move is the native
+            # host-runtime number, clearly labeled.
+            print("# device busy (live devlock holder); not contending — "
+                  "reporting the native host runtime", file=sys.stderr)
+            _report_native("cpu (device busy)")
+            return
     try:
         _ensure_live_backend()
         demoted = (os.environ.get("JAX_PLATFORMS", "").strip().lower()
@@ -257,6 +278,14 @@ def _try_native(iters: int = 3):
         print(f"# native runtime unavailable ({type(e).__name__}: {e})"[:300],
               file=sys.stderr)
         return None
+
+
+def _report_native(platform_label: str) -> None:
+    """Native-runtime measurement reported under the given platform label;
+    zero-value line if even the native runtime is unavailable. The shared
+    tail of every no-device terminal path (canary hang, busy holder)."""
+    n, gbps, digest, engine = _try_native() or (0, 0.0, 0, "none")
+    _report(n, platform_label, engine, digest, gbps)
 
 
 def _report(measured_bytes: int, platform: str, engine: str, digest: int,
@@ -314,9 +343,7 @@ def _measure_and_report() -> None:
         # JSON line always prints, even with no native build on this host —
         # a zero-value line that names the failure beats a traceback the
         # driver can't parse.
-        r = _try_native() or (0, 0.0, 0, "none")
-        n_native, gbps, digest, engine = r
-        _report(n_native, "cpu (accelerator hung)", engine, digest, gbps)
+        _report_native("cpu (accelerator hung)")
         return
 
     # Words cross the jit boundary as a FLAT u32 stream by default: a (N, 4)

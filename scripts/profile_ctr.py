@@ -147,6 +147,17 @@ def main():
         grouped, base, kp)
     report("ctr-gt kernel alone", t, gb)
 
+    # Same kernel with the Boyar–Peralta S-box circuit (engine
+    # "pallas-gt-bp"): the difference vs "ctr-gt kernel alone" is the
+    # measured value of the 217→162-unit round-arithmetic cut with
+    # everything else held identical — the cleanest view of the tower/BP
+    # A/B, uncontaminated by boundary relayouts.
+    t = chained_time(
+        lambda g, b, kp: pallas_aes._ctr_gen_planes_pallas(
+            g, b, kp, nr=10, tile=tile, layout="grouped", sbox="bp"),
+        grouped, base, kp)
+    report("ctr-gt-bp kernel alone", t, gb)
+
 
 if __name__ == "__main__":
     sys.exit(main())
