@@ -14,9 +14,11 @@ correctness is never checked"); this is the opposite discipline.
     python scripts/fuzz_parity.py --iters 200 --seed 7
 
 Exit code 0 = every case bit-exact. On failure, prints the reproducing
-config (seed/case index) and exits 1. CPU-only by design (the oracle is
-host C; engines under test default to jnp for speed — use --engines to
-fuzz bitslice/pallas too, e.g. on real hardware).
+config (seed/case index) and exits 1. CPU-pinned by default (the oracle
+is host C; engines under test default to jnp for speed — use --engines
+to fuzz bitslice/pallas too). Pass --device to keep the platform
+unpinned and fuzz the pallas engines through REAL Mosaic kernels on a
+TPU host; without it they run in interpreter mode.
 """
 from __future__ import annotations
 
@@ -42,9 +44,26 @@ def main() -> int:
                     help="reference checkout to compile the oracle from")
     ap.add_argument("--deadline", type=float, default=0,
                     help="stop cleanly after this many seconds (0 = none)")
+    ap.add_argument("--device", action="store_true",
+                    help="do NOT pin the platform to CPU: fuzz pallas "
+                         "engines through real Mosaic kernels on a TPU "
+                         "host (single-tenant tunnels: coordinate via the "
+                         "devlock; do not run beside another device job)")
     args = ap.parse_args()
 
     import numpy as np
+
+    import jax
+
+    if not args.device:
+        # Pinned through jax.config, not just the env var: site hooks that
+        # pre-register an accelerator plugin clobber JAX_PLATFORMS at
+        # interpreter start (see tests/conftest.py), and on a tunnelled
+        # device host an env-only pin would initialize the very tunnel a
+        # CPU fuzz run must never touch (observed: a wedged tunnel hanging
+        # a "CPU" run at its first device op).
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        jax.config.update("jax_platforms", "cpu")
 
     from gen_golden import Oracle, build_oracle
     from our_tree_tpu.models.aes import AES, AES_DECRYPT, AES_ENCRYPT
@@ -173,8 +192,6 @@ def main() -> int:
             # compile caches leak enough that long sessions exhaust memory
             # (same reason tests/conftest.py clears per module). Dropping
             # them bounds the fuzzer's footprint at a small recompile cost.
-            import jax
-
             jax.clear_caches()
             print(f"# {done} cases ok ({time.time() - t0:.0f}s)", flush=True)
     print(f"FUZZ PASS: {done} randomized configs bit-exact vs the oracle, "

@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import argparse
 import os
+import shutil
 import signal
 import subprocess
 import sys
@@ -186,6 +187,18 @@ def main() -> int:
                         rc = "timeout"
                 print(f"# {name}: rc={rc} in {time.time() - t0:.0f}s",
                       flush=True)
+                # Mirror the step log into the repo: the plan-dir lives in
+                # /tmp and dies with the container, while the repo is the
+                # only thing that survives a round boundary — an
+                # end-of-round sweep of uncommitted files then preserves
+                # the measurement evidence even if nobody is around to
+                # commit it by hand.
+                try:
+                    dst = os.path.join(REPO, "docs", "hwlogs")
+                    os.makedirs(dst, exist_ok=True)
+                    shutil.copyfile(log, os.path.join(dst, f"{name}.log"))
+                except OSError as e:
+                    print(f"# log mirror failed: {e}", flush=True)
         # Sleeps happen AFTER the marker is released so a waiting job can
         # take the device during them.
         if rc == "busy":
