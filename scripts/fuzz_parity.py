@@ -44,6 +44,20 @@ def main() -> int:
                     help="reference checkout to compile the oracle from")
     ap.add_argument("--deadline", type=float, default=0,
                     help="stop cleanly after this many seconds (0 = none)")
+    def _positive_int(v):
+        n = int(v)
+        if n < 1:
+            raise argparse.ArgumentTypeError(
+                "--clear-every must be >= 1 (clearing is the fuzzer's "
+                "memory bound; there is no 'never' setting)")
+        return n
+
+    ap.add_argument("--clear-every", type=_positive_int, default=25,
+                    help="clear XLA compile caches every N cases; lower it "
+                         "when fuzzing the pallas engines (their interpret-"
+                         "mode compilations are much larger per case — a "
+                         "3-engine run at the default interval was observed "
+                         "dying on LLVM 'Cannot allocate memory')")
     ap.add_argument("--device", action="store_true",
                     help="do NOT pin the platform to CPU: fuzz pallas "
                          "engines through real Mosaic kernels on a TPU "
@@ -187,7 +201,7 @@ def main() -> int:
                       file=sys.stderr)
                 return 1
         done += 1
-        if done % 25 == 0:
+        if done % args.clear_every == 0:
             # Every random length is a fresh XLA-CPU compilation; the
             # compile caches leak enough that long sessions exhaust memory
             # (same reason tests/conftest.py clears per module). Dropping
