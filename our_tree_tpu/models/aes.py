@@ -458,6 +458,17 @@ class AES:
             take = b.size - pos
             out[pos:] = b[pos:] ^ stream_block[:take]
             n = take
+        elif nfull:
+            # Parity detail (found by scripts/fuzz_parity.py): the
+            # reference's byte loop regenerates stream_block for EVERY
+            # block (aes.c:876-884), so a call that ends exactly on a block
+            # boundary leaves stream_block = E(last counter) — dead state
+            # while nc_off == 0, since the next call regenerates before
+            # use, but the resume-state surface must be bit-identical. The
+            # bulk path never materialises the keystream (fused kernels),
+            # but CTR is an XOR stream: the last keystream block is just
+            # in ^ out of the final block — free, host-side.
+            stream_block = b[pos - 16 : pos] ^ out[pos - 16 : pos]
         return out, n, nonce_counter, stream_block
 
 

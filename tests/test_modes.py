@@ -115,6 +115,38 @@ def test_ctr_chunked_equals_oneshot():
     assert off == off1 and nc.tobytes() == nc1.tobytes() and sbl.tobytes() == sb1.tobytes()
 
 
+def test_ctr_block_aligned_end_stream_block():
+    """A CTR call that ends EXACTLY on a block boundary must still leave
+    stream_block = E(last counter): the reference's byte loop regenerates
+    it for every block (aes.c:876-884), so it is part of the bit-identical
+    resume surface even though it is dead state while nc_off == 0. The
+    bulk path's fused kernels never materialise the keystream, which hid
+    this until the randomized fuzzer caught it (chunks [2501, 2283]:
+    mid-block drain, then an aligned end)."""
+    a = AES(KEY128)
+    rng = np.random.default_rng(21)
+    data = rng.integers(0, 256, 4784, dtype=np.uint8)  # the fuzzer's repro
+    nc0 = np.frombuffer(CTR0, np.uint8)
+
+    # One-shot, aligned end (4784 = 299 blocks exactly).
+    out1, off1, nc1, sb1 = a.crypt_ctr(0, nc0.copy(), np.zeros(16, np.uint8),
+                                       data)
+    assert off1 == 0
+    last_ctr = (int.from_bytes(nc1.tobytes(), "big") - 1) % (1 << 128)
+    want_sb = a.crypt_ecb(AES_ENCRYPT, last_ctr.to_bytes(16, "big"))
+    assert sb1.tobytes() == want_sb.tobytes()
+
+    # Chunked with a mid-block seam, same aligned total: identical output
+    # AND identical full resume state.
+    out, off, nc, sb = [], 0, nc0.copy(), np.zeros(16, np.uint8)
+    for lo, hi in [(0, 2501), (2501, 4784)]:
+        o, off, nc, sb = a.crypt_ctr(off, nc, sb, data[lo:hi])
+        out.append(o)
+    assert np.concatenate(out).tobytes() == out1.tobytes()
+    assert (off, nc.tobytes(), sb.tobytes()) == (off1, nc1.tobytes(),
+                                                 sb1.tobytes())
+
+
 def test_cfb_chunked_equals_oneshot():
     rng = np.random.default_rng(4)
     a = AES(KEY256)
