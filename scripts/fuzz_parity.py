@@ -58,6 +58,12 @@ def main() -> int:
                          "mode compilations are much larger per case — a "
                          "3-engine run at the default interval was observed "
                          "dying on LLVM 'Cannot allocate memory')")
+    ap.add_argument("--native", action="store_true",
+                    help="also fuzz the native C runtime (runtime/csrc) "
+                         "against the oracle each case — bulk calls plus "
+                         "every resume surface the C API exposes (CBC "
+                         "chained IV, CFB128 iv_off; CTR is bulk-only "
+                         "there, compared one-shot with its counter)")
     ap.add_argument("--device", action="store_true",
                     help="do NOT pin the platform to CPU: fuzz pallas "
                          "engines through real Mosaic kernels on a TPU "
@@ -81,6 +87,10 @@ def main() -> int:
 
     from gen_golden import Oracle, build_oracle
     from our_tree_tpu.models.aes import AES, AES_DECRYPT, AES_ENCRYPT
+
+    NativeAES = None
+    if args.native:
+        from our_tree_tpu.runtime.native import NativeAES
 
     oracle = Oracle(build_oracle(pathlib.Path(args.reference)))
     rng = np.random.default_rng(args.seed)
@@ -198,6 +208,41 @@ def main() -> int:
             if want_state is not None and got_state != _norm(want_state):
                 print(f"PARITY FAIL (resume state) [{engine}] {tag}\n"
                       f"  got  {got_state!r}\n  want {_norm(want_state)!r}",
+                      file=sys.stderr)
+                return 1
+
+        if NativeAES is not None:
+            na = NativeAES(key)
+            got_state = state_want = None
+            if mode == "ecb":
+                got = na.ecb(data, encrypt).tobytes()
+            elif mode == "cbc":
+                out, reg = [], iv.copy()
+                for dp in data_parts:
+                    o, reg = na.cbc(reg, dp, encrypt)
+                    out.append(o)
+                got = b"".join(o.tobytes() for o in out)
+                got_state, state_want = bytes(reg), _norm(want_state)
+            elif mode == "cfb128":
+                out, off, reg = [], 0, iv.copy()
+                for dp in data_parts:
+                    o, off, reg = na.cfb128(off, reg, dp, encrypt)
+                    out.append(o)
+                got = b"".join(o.tobytes() for o in out)
+                got_state, state_want = (off, bytes(reg)), _norm(want_state)
+            else:  # ctr: the C API is bulk-only (no nc_off/stream_block
+                # surface) — one-shot output plus the advanced counter.
+                o, nc = na.ctr(iv, data)
+                got = o.tobytes()
+                got_state = bytes(nc)
+                state_want = _norm(want_state)[1]  # oracle (off, nc, sb)
+            if got != want:
+                print(f"PARITY FAIL (output) [native] {tag}",
+                      file=sys.stderr)
+                return 1
+            if state_want is not None and got_state != state_want:
+                print(f"PARITY FAIL (resume state) [native] {tag}\n"
+                      f"  got  {got_state!r}\n  want {state_want!r}",
                       file=sys.stderr)
                 return 1
         done += 1
