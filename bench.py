@@ -43,7 +43,13 @@ import time
 
 import numpy as np
 
-BASELINE_GBPS = 0.520
+#: Per-op reference bars (BASELINE.md). CTR: AES-NI CTR, 1 GiB, 8 threads
+#: (results.frankchn.aesni:32). ECB: AES-NI ECB, 8 threads, 0.551
+#: (results.frankchn.aesni:16). The reference never benchmarked decrypt at
+#: all (VERDICT r2 #4); AES-NI decrypt throughput ≈ encrypt (aesdec and
+#: aesenc share latency/throughput on that hardware), so its ECB bar is
+#: the nearest honest comparator for ecb-dec rather than a cross-mode one.
+BASELINES = {"ctr": 0.520, "ecb": 0.551, "ecb-dec": 0.551}
 #: Probe buffer: 64 MiB, not smaller — at 4 MiB fixed dispatch overheads
 #: dominate and the ranking inverts (the probe picked pallas over
 #: pallas-gt, which is 3.6x faster at headline sizes; measured round 2).
@@ -52,23 +58,25 @@ BASELINE_GBPS = 0.520
 PROBE_BYTES = 64 << 20
 DEADLINE_S = float(os.environ.get("OT_BENCH_DEADLINE", 1200))
 INIT_TIMEOUT_S = float(os.environ.get("OT_BENCH_INIT_TIMEOUT", 240))
+#: Measured operation. "ctr" is the north-star metric; "ecb" / "ecb-dec"
+#: run the same chained methodology on the forward / INVERSE block circuit
+#: (CTR is symmetric, so the decrypt direction is only measurable through
+#: ECB — VERDICT r2 #4: the inverse circuit's throughput was unknown).
+OP = os.environ.get("OT_BENCH_OP", "ctr")
+if OP not in ("ctr", "ecb", "ecb-dec"):
+    raise ValueError(f"OT_BENCH_OP must be ctr|ecb|ecb-dec, got {OP!r}")
 _T0 = time.perf_counter()
 
 
-def _load_devlock():
-    """Load utils/devlock.py as a bare file: importing the package would
-    import jax before _ensure_live_backend has decided the platform."""
-    import importlib.util
+# Bare-file loads (not package imports — the package pulls jax in before
+# _ensure_live_backend has decided the platform), through the ONE shared
+# loader the sweep scripts use.
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "scripts"))
+from _devlock_loader import load_devlock, load_ranking  # noqa: E402
 
-    p = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                     "our_tree_tpu", "utils", "devlock.py")
-    spec = importlib.util.spec_from_file_location("_ot_devlock", p)
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
-
-
-devlock = _load_devlock()
+devlock = load_devlock()
+ranking = load_ranking()
 
 
 def _left() -> float:
@@ -199,12 +207,18 @@ def _measure_native_cpu(nbytes: int, iters: int):
     nonce = np.frombuffer(
         bytes.fromhex("f0f1f2f3f4f5f6f7f8f9fafbfcfdfeff"), np.uint8)
     data = np.random.default_rng(1337).integers(0, 256, nbytes, dtype=np.uint8)
-    backend.ctr(ctx, data, nonce, 1)  # warm (first call may fault pages)
+    if OP == "ctr":
+        run1 = lambda: backend.ctr(ctx, data, nonce, 1)
+    elif OP == "ecb":
+        run1 = lambda: backend.ecb(ctx, data, 1)
+    else:
+        run1 = lambda: backend.ecb_dec(ctx, data, 1)
+    run1()  # warm (first call may fault pages)
     best = float("inf")
     out = None
     for _ in range(max(iters, 2)):
         t0 = time.perf_counter()
-        out = backend.ctr(ctx, data, nonce, 1)
+        out = run1()
         best = min(best, time.perf_counter() - t0)
     digest = int(np.sum(out.view(np.uint32), dtype=np.uint32))
     label = "native-aesni" if native.aesni_available() else "native-c"
@@ -237,12 +251,23 @@ def main() -> None:
         # acquire() can race a holder that exits between calls: returning
         # False with no marker left on disk must not send this run to the
         # device UNLOCKED (a sweep starting mid-run would overlap on the
-        # single-tenant tunnel). Bounded retry closes the window.
+        # single-tenant tunnel). Bounded retry closes the window. The
+        # held/owned decision is captured INSIDE the loop, on the same
+        # observation that made acquire() fail: re-checking is_held() after
+        # the loop races a holder that exits in between — the run would
+        # fall through to the device with owned=False and no marker on
+        # disk, exactly the overlap the retry exists to prevent. A holder
+        # that vanishes between acquire() and is_held() sends the loop
+        # back to acquire() instead.
+        held = False
         for _ in range(3):
             owned = devlock.acquire()
-            if owned or devlock.is_held():
+            if owned:
                 break
-        if not owned and devlock.is_held():
+            held = devlock.is_held()
+            if held:
+                break
+        if not owned and held:
             # A LIVE holder outlasted the wait budget. Proceeding anyway
             # would put two jax processes on the single-tenant tunnel —
             # the documented wedge trigger — corrupting both the holder's
@@ -297,11 +322,12 @@ def _report(measured_bytes: int, platform: str, engine: str, digest: int,
     # a post-report teardown hang (abandoned transfer on a wedged tunnel)
     # would otherwise get the process SIGKILLed with the line still queued.
     print(json.dumps({
-        "metric": f"AES-128-CTR throughput, {measured_bytes >> 20} MiB buffer, "
+        "metric": f"AES-128-{OP.upper()} throughput, "
+                  f"{measured_bytes >> 20} MiB buffer, "
                   f"1 {platform} device, engine={engine}, digest={digest:#010x}",
         "value": round(gbps, 4),
         "unit": "GB/s",
-        "vs_baseline": round(gbps / BASELINE_GBPS, 3),
+        "vs_baseline": round(gbps / BASELINES[OP], 3),
     }), flush=True)
 
 
@@ -359,26 +385,38 @@ def _measure_and_report() -> None:
         # identical buffers, regardless of how many probes ran before.
         host = np.random.default_rng(1337).integers(0, 256, nbytes, dtype=np.uint8)
         host_words = packing.np_bytes_to_words(host)
-        ctr_fn = aes_mod.ctr_crypt_fn(a.nr, engine=engine)
+        # The carry must perturb an input the expensive work DEPENDS on: in
+        # CTR the keystream depends only on the counter (a data-only carry
+        # lets XLA hoist all the AES work out of the loop), so the carry
+        # goes into the counter; in ECB the cipher reads the data, so the
+        # carry perturbs the data words. Either way a SUM digest (not XOR)
+        # keeps the carry alive through the reduction — an XOR-reduce over
+        # an even element count cancels it, leaving identical CSE-able
+        # iterations.
+        if OP == "ctr":
+            mode_fn = aes_mod.ctr_crypt_fn(a.nr, engine=engine)
+            crypt = lambda w, acc, rk: mode_fn(w, ctr_be ^ acc, rk)
+            rk_used = a.rk_enc
+        elif OP == "ecb":
+            crypt = lambda w, acc, rk: aes_mod.ecb_encrypt_words(
+                w ^ acc, rk, a.nr, engine)
+            rk_used = a.rk_enc
+        else:  # ecb-dec: the inverse circuit + folded decrypt schedule
+            crypt = lambda w, acc, rk: aes_mod.ecb_decrypt_words(
+                w ^ acc, rk, a.nr, engine)
+            rk_used = a.rk_dec
 
         @jax.jit
-        def chained(words, ctr_be, rk, k):
+        def chained(words, rk, k):
             def body(_, acc):
-                # The carry must perturb the COUNTER, not the data: in CTR
-                # the expensive work (the keystream) depends only on the
-                # counter, so a data-only dependency lets XLA hoist the
-                # whole AES computation out of the loop. A SUM digest (not
-                # XOR) keeps the carry alive through the reduction — an
-                # XOR-reduce over an even element count cancels it, leaving
-                # identical CSE-able iterations. k is traced: one compile
-                # serves every chain length.
-                out = ctr_fn(words, ctr_be ^ acc, rk)
+                # k is traced: one compile serves every chain length.
+                out = crypt(words, acc, rk)
                 return jnp.sum(out, dtype=jnp.uint32)
             return jax.lax.fori_loop(jnp.uint32(0), k, body, jnp.uint32(0))
 
         def run(k):
             t0 = time.perf_counter()
-            digest = int(chained(words, ctr_be, a.rk_enc, jnp.uint32(k)))
+            digest = int(chained(words, rk_used, jnp.uint32(k)))
             return time.perf_counter() - t0, digest
 
         # The whole stage — INCLUDING the H2D staging of the data buffer,
@@ -405,15 +443,31 @@ def _measure_and_report() -> None:
     # deadline budget runs short.
     probes, probe_digests = {}, {}
     if requested == "probe" and platform != "cpu":
-        # jnp is not probed: it is the fallback when every probe fails (and
-        # the slowest engine by ~40x — a 64 MiB jnp probe would burn its
-        # whole stage budget ranking an engine that can only ever be chosen
-        # by default). Probe order = expected-winner first (round-2 hardware
-        # A/B, docs/PERF.md): when the deadline budget cuts the probe stage
-        # short, it trims the least likely winners, not the favourites.
-        order = ("pallas-gt", "pallas-gt-bp", "pallas", "bitslice")
-        engines = [e for e in order if e in aes_mod.CORES] + sorted(
-            e for e in aes_mod.CORES if e != "jnp" and e not in order)
+        # Probe order = expected-winner first: when the deadline budget cuts
+        # the probe stage short, it trims the least likely winners, not the
+        # favourites. "Expected" is data, not a guess: the last persisted
+        # probe/tune ranking for this platform (results/engine_ranking.json,
+        # written below and by scripts/tune_tpu.py) leads; the static
+        # default order only seeds the first-ever run. jnp is never probed —
+        # see utils/ranking.py:probe_order.
+        engines = ranking.probe_order(platform, aes_mod.CORES)
+        if OP == "ecb-dec":
+            # The bp engines share their non-bp twin's decrypt function
+            # (no Boyar–Peralta inverse circuit exists), so a decrypt-op
+            # probe of both would measure the identical code twice — at a
+            # full 64 MiB compile+run each, against a budget guard that
+            # could then cut a genuinely distinct engine. Dedupe by the
+            # registered decrypt callable, representing each group by its
+            # SHORTEST name (the base twin): the evidence line must not
+            # read "engine=pallas-gt-bp" for a decrypt that ran the shared
+            # tower circuit.
+            by_fn: dict = {}
+            for e in engines:
+                fn = aes_mod.CORES[e][1]
+                if fn not in by_fn or len(e) < len(by_fn[fn]):
+                    by_fn[fn] = e
+            keep = set(by_fn.values())
+            engines = [e for e in engines if e in keep]
         for eng in engines:
             if _left() < 0.35 * DEADLINE_S:
                 print(f"# probe budget exhausted before {eng}", file=sys.stderr)
@@ -428,9 +482,51 @@ def _measure_and_report() -> None:
             except Exception as e:  # an engine failing to compile is data
                 print(f"# probe {eng}: failed ({type(e).__name__}: {e})"[:500],
                       file=sys.stderr)
+        if len(set(probe_digests.values())) > 1:
+            # Same buffer, same counter — every engine must produce the
+            # same ciphertext digest. A disagreement means some engine
+            # computes wrong bytes on THIS hardware (the cross-engine bug
+            # class the CPU suite can't see). A wrong engine is often also
+            # a FAST engine (skipped work), so it must not win the headline
+            # or enter the persisted ranking: keep only the majority-digest
+            # engines; a count tie breaks toward the digest whose engines
+            # include the slowest one (same skipped-work logic).
+            print("# WARNING: probe digests disagree across engines: "
+                  + ", ".join(f"{k}={v:#010x}"
+                              for k, v in sorted(probe_digests.items())),
+                  file=sys.stderr)
+            counts: dict = {}
+            for d in probe_digests.values():
+                counts[d] = counts.get(d, 0) + 1
+            majority = max(
+                counts,
+                key=lambda d: (counts[d], -min(
+                    probes[e] for e, dd in probe_digests.items() if dd == d)),
+            )
+            digest_dropped = sorted(e for e, d in probe_digests.items()
+                                    if d != majority)
+            print("# excluding digest-dissenting engines from selection "
+                  f"and ranking: {digest_dropped}", file=sys.stderr)
+            probes = {e: v for e, v in probes.items()
+                      if e not in digest_dropped}
+            probe_digests = {e: v for e, v in probe_digests.items()
+                             if e not in digest_dropped}
+        else:
+            digest_dropped = []
         engine = max(probes, key=probes.get) if probes else "jnp"
         print("# probe GB/s: " + ", ".join(
             f"{k}={v:.2f}" for k, v in sorted(probes.items())), file=sys.stderr)
+        # Persist the measured ranking so the next run's probe order — and
+        # resolve_engine("auto") — start from data instead of the static
+        # default (store() ignores rankings of < 2 engines). Only the
+        # north-star op persists: the ranking file is op-agnostic and feeds
+        # encrypt-path "auto" selection everywhere, so an ecb-dec run must
+        # not overwrite the CTR ranking with inverse-circuit numbers.
+        # Digest-dissenting engines are passed as drops so store()'s merge
+        # cannot resurrect their stale entries from a previous run.
+        if OP == "ctr" and ranking.store(platform, probes, "bench-probe",
+                                         PROBE_BYTES, drop=digest_dropped):
+            print(f"# ranking persisted to {ranking.path()}", file=sys.stderr)
     else:
         engine = aes_mod.resolve_engine(
             "auto" if requested == "probe" else requested

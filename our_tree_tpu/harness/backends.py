@@ -127,6 +127,35 @@ class TpuBackend:
             words, ctx.rk_enc, ctx.nr, self._mesh(workers), engine=self.engine
         )
 
+    def ecb_dec(self, ctx, words, workers: int):
+        """ECB decrypt — the inverse circuit (tower-only: no comparably
+        small Boyar–Peralta inverse exists, ops/bitslice.py:inv_sbox_planes)
+        whose throughput the encrypt-side sweeps never measured (VERDICT r2
+        #4; the reference exercised both directions via aes_self_test,
+        aes-modes/aes.c:1084-1330, and its decrypt CLI, main_ecb_d.cu)."""
+        if workers == 1:
+            return self._aes_mod.ecb_decrypt_words(
+                words, ctx.rk_dec, ctx.nr, self.engine
+            )
+        return self._dist.ecb_crypt_sharded(
+            words, ctx.rk_dec, ctx.nr, self._mesh(workers), encrypt=False,
+            engine=self.engine,
+        )
+
+    def cbc_dec(self, ctx, words, iv_words, workers: int):
+        """CBC decrypt — parallel (batch inverse cipher + shifted XOR), so
+        unlike CBC encrypt it shards over workers (dist.cbc_decrypt_sharded,
+        one-block halo exchange)."""
+        if workers == 1:
+            out, _ = self._aes_mod.cbc_decrypt_words(
+                words, iv_words, ctx.rk_dec, ctx.nr, self.engine
+            )
+            return out
+        return self._dist.cbc_decrypt_sharded(
+            words, iv_words, ctx.rk_dec, ctx.nr, self._mesh(workers),
+            engine=self.engine,
+        )
+
     def ctr(self, ctx, words, ctr_be, workers: int):
         if workers == 1:
             return self._aes_mod.ctr_crypt_words(

@@ -26,6 +26,12 @@ from ..models.aes import AES, AES_DECRYPT, AES_ENCRYPT
 
 
 def main(argv=None) -> int:
+    # Before any device op: a JAX_PLATFORMS=cpu caller must never
+    # initialize a (possibly wedged) accelerator tunnel — see
+    # utils/platform.py for why the env var alone does not guarantee that.
+    from ..utils.platform import pin_cpu_if_requested
+
+    pin_cpu_if_requested()
     ap = argparse.ArgumentParser(
         prog="decrypt", description="AES hex en/decrypt (aes_ecb_d equivalent)"
     )

@@ -85,22 +85,30 @@ def resolve_engine(name: str | None = "auto") -> str:
     The gather-based T-table core is fine on CPU; on TPU the VPU has no
     cheap 256-way gather (SURVEY.md §7 hard part #1), so batch paths use
     the bitsliced circuit — preferably through the Pallas kernels. The
-    preference order is the round-2 hardware A/B (256 MiB CTR, v5e):
-    pallas-gt 5.93 GB/s > pallas 1.65 > bitslice ~0.2 (docs/PERF.md).
+    preference order is DATA when data exists: the last persisted hardware
+    probe/tune ranking for this platform (utils/ranking.py, written by
+    bench.py's probe stage and scripts/tune_tpu.py); the static default
+    (the round-2 hardware A/B — docs/PERF.md) only seeds hosts that have
+    never measured.
     """
     if name in (None, "auto"):
         if jax.default_backend() == "cpu":
             return "jnp"
         from ..ops import pallas_aes
+        from ..utils import ranking
 
         # The Pallas engines only beat the XLA circuit when they actually
         # compile under Mosaic; on a non-TPU accelerator they would run in
         # interpreter mode (Python emulation) — keep the compiled circuit
         # there.
-        if not pallas_aes.interpret_mode():
-            for eng in ("pallas-gt", "pallas"):
-                if eng in CORES:
-                    return eng
+        allow_pallas = not pallas_aes.interpret_mode()
+        try:
+            platform = jax.devices()[0].platform
+        except Exception:
+            platform = jax.default_backend()
+        for eng in ranking.probe_order(platform, CORES):
+            if eng in CORES and (allow_pallas or eng not in PALLAS_BACKED):
+                return eng
         return "bitslice" if "bitslice" in CORES else "jnp"
     if name not in CORES:
         raise ValueError(f"unknown engine {name!r}; available: {sorted(CORES)}")
@@ -501,4 +509,17 @@ register_core("pallas-gt", _pallas_aes.encrypt_words_gt,
 register_core("pallas-gt-bp", _pallas_aes.encrypt_words_gt_bp,
               _pallas_aes.decrypt_words_gt,
               ctr_fused_fn=_pallas_aes.ctr_crypt_words_gt_bp,
+              pallas_backed=True)
+# The dense (128, W) boundary: pallas-gt's in-kernel ladder without the
+# grouped layout's 2x sublane-padding tax on HBM streams / VMEM tiles —
+# and without its halved buffer ceiling (the 1 GiB headline path). Its own
+# engine name so the first hardware probe A/Bs the two boundary layouts
+# and the persisted ranking (utils/ranking.py) retires the loser.
+register_core("pallas-dense", _pallas_aes.encrypt_words_dense,
+              _pallas_aes.decrypt_words_dense,
+              ctr_fused_fn=_pallas_aes.ctr_crypt_words_dense,
+              pallas_backed=True)
+register_core("pallas-dense-bp", _pallas_aes.encrypt_words_dense_bp,
+              _pallas_aes.decrypt_words_dense,
+              ctr_fused_fn=_pallas_aes.ctr_crypt_words_dense_bp,
               pallas_backed=True)

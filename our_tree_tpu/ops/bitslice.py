@@ -857,6 +857,84 @@ def grouped_from_planes(p: jnp.ndarray) -> jnp.ndarray:
     return _transpose32_lead(tr)
 
 
+def dense_words(words: jnp.ndarray) -> jnp.ndarray:
+    """(N, 4) u32 words, N % 32 == 0 -> (128, W) DENSE grouped layout:
+    row 4*t + c = word c of block 32*l + t (lane l).
+
+    The grouped (32, 4, W) boundary form pays a 2x tax on TPU: its 4-wide
+    second-minor (sublane) dim pads to 8 under tiled layouts, doubling both
+    the HBM streams and the VMEM tile footprint, and halving the buffer
+    ceiling (ops/pallas_aes.py layout notes). Merging the (32, 4) axes into
+    one leading 128 gives a sublane dim of 128 — an exact multiple of the
+    8-row tile — so the boundary array is DENSE: 128·W u32 = exactly the
+    logical bytes. Pure relayout (no bit math), same information as
+    group_words; transpose32_dense runs the SWAR ladder directly on this
+    form inside a kernel.
+    """
+    n = words.shape[0]
+    return words.reshape(n // 32, 32, 4).transpose(1, 2, 0).reshape(
+        128, n // 32)
+
+
+def undense_words(d: jnp.ndarray) -> jnp.ndarray:
+    """(128, W) dense grouped layout -> (32*W, 4) u32 words
+    (dense_words⁻¹)."""
+    w = d.shape[1]
+    return d.reshape(32, 4, w).transpose(2, 0, 1).reshape(32 * w, 4)
+
+
+def transpose32_dense(a: jnp.ndarray) -> jnp.ndarray:
+    """The 32x32 bit-transpose ladder on the dense (128, T) form.
+
+    Same masked-swap network as _transpose32_lead, with the block-index
+    axis t STRIDED at 4 inside the leading 128-axis (row = 4t + c): stage j
+    pairs rows 4t+c and 4(t+j)+c, i.e. contiguous 4j-row chunks, so each
+    stage is a leading-axis reshape to (32/(2j), 2, 4j, T) + the same
+    half-word exchange — no minor-dim reshapes, no rolls, the conservative
+    Mosaic feature set. Involution, like the grouped ladder.
+    """
+    j = 16
+    m = jnp.uint32(0x0000FFFF)
+    while j:
+        sh = a.shape
+        b = a.reshape((32 // (2 * j), 2, 4 * j) + sh[1:])
+        lo, hi = b[:, 0], b[:, 1]
+        t = (lo >> j ^ hi) & m
+        a = jnp.stack([lo ^ (t << j), hi ^ t], axis=1).reshape(sh)
+        j >>= 1
+        m = m ^ (m << j)
+    return a
+
+
+def planes_from_dense(d: jnp.ndarray) -> jnp.ndarray:
+    """(128, T) dense grouped words -> (8, 16, T) bit planes, kernel-safe.
+
+    Bit-identical to planes_from_grouped∘(reshape to (32, 4, T)) — pinned
+    by tests/test_bitslice.py — but every intermediate keeps the lane axis
+    minor with a leading dim that is a multiple of 8, so no tiling padding
+    anywhere. Row bookkeeping: after the ladder, transposed row 4i+c holds
+    (in bit t) bit i of word c of block 32l+t; plane[b][p] = bit b of state
+    byte p = bit (8*(p%4)+b) of word p//4.
+    """
+    tr = transpose32_dense(d)
+    return jnp.stack([
+        jnp.concatenate(
+            [tr[4 * (8 * (p % 4) + b) + p // 4][None] for p in range(16)],
+            axis=0)
+        for b in range(8)
+    ])
+
+
+def dense_from_planes(p: jnp.ndarray) -> jnp.ndarray:
+    """(8, 16, T) bit planes -> (128, T) dense grouped words (kernel-safe
+    inverse of planes_from_dense)."""
+    tr = jnp.concatenate([
+        p[(r // 4) % 8, 4 * (r % 4) + (r // 4) // 8][None]
+        for r in range(128)
+    ], axis=0)
+    return transpose32_dense(tr)
+
+
 def to_planes(words: jnp.ndarray) -> jnp.ndarray:
     """(N, 4) u32 LE words, N % 32 == 0  ->  (8, 16, N/32) u32 planes.
 

@@ -103,18 +103,33 @@ def _run_rounds(p, kp, nr: int, round_fn, interpret: bool):
 #: carry 2x the logical bytes. The kernel is compute-bound (docs/PERF.md
 #: roofline: HBM ceiling is an order of magnitude above the VPU one), so
 #: this should not decide the pallas-vs-pallas-gt A/B — but it does halve
-#: the grouped path's buffer-size ceiling. If gt wins the A/B and a size
-#: ceiling matters, the dense follow-up is a (128, W) boundary with the
-#: ladder's masked swaps done via sublane rolls + row-index masks — not
-#: built now because sublane-roll support is generation-dependent (the
-#: same reason OT_PALLAS_MC=roll is a knob, not the default).
+#: the grouped path's buffer-size ceiling. The "dense" layout is the
+#: follow-up that removes the tax: the (32, 4) axes merge into one leading
+#: 128-row sublane dim (an exact multiple of the 8-row tile — zero
+#: padding), and the in-kernel ladder runs directly on that form via
+#: leading-axis reshapes (bitslice.transpose32_dense) — the same
+#: conservative Mosaic feature set as the grouped ladder, no sublane
+#: rolls. Registered as its own engine ("pallas-dense") so the first
+#: hardware probe A/Bs the two boundary layouts and the ranking retires
+#: the loser (utils/ranking.py).
 _LAYOUTS = {
     "planes": (bitslice.to_planes, bitslice.from_planes,
                lambda tile: (8, 16, tile), None, None),
     "grouped": (bitslice.group_words, bitslice.ungroup_words,
                 lambda tile: (32, 4, tile),
                 bitslice.planes_from_grouped, bitslice.grouped_from_planes),
+    "dense": (bitslice.dense_words, bitslice.undense_words,
+              lambda tile: (128, tile),
+              bitslice.planes_from_dense, bitslice.dense_from_planes),
 }
+
+
+def _tile_spec(shape_fn, tile: int) -> pl.BlockSpec:
+    """BlockSpec gridding the LANE (last) axis, for any layout rank: the
+    leading dims are whole, block i covers lanes [i*tile, (i+1)*tile)."""
+    shape = shape_fn(tile)
+    zeros = (0,) * (len(shape) - 1)
+    return pl.BlockSpec(shape, lambda i, _z=zeros: _z + (i,))
 
 
 def _aes_kernel(kp_ref, in_ref, out_ref, *, nr: int, decrypt: bool,
@@ -191,7 +206,7 @@ def _interpret() -> bool:
 def _crypt_planes_pallas(x, kp, *, nr, decrypt, tile, layout="planes",
                          sbox=None):
     _, _, shape_fn, unpack, pack = _LAYOUTS[layout]
-    w = x.shape[2]
+    w = x.shape[-1]
     interpret = _interpret()
     kernel = functools.partial(
         _aes_kernel, nr=nr, decrypt=decrypt, interpret=interpret,
@@ -202,9 +217,9 @@ def _crypt_planes_pallas(x, kp, *, nr, decrypt, tile, layout="planes",
         grid=(w // tile,),
         in_specs=[
             pl.BlockSpec((nr + 1, 8, 16, 1), lambda i: (0, 0, 0, 0)),
-            pl.BlockSpec(shape_fn(tile), lambda i: (0, 0, i)),
+            _tile_spec(shape_fn, tile),
         ],
-        out_specs=pl.BlockSpec(shape_fn(tile), lambda i: (0, 0, i)),
+        out_specs=_tile_spec(shape_fn, tile),
         out_shape=_out_struct(x),
         interpret=interpret,
     )(kp, x)
@@ -270,6 +285,25 @@ def encrypt_words_gt_bp(words: jnp.ndarray, rk: jnp.ndarray, nr: int):
 def decrypt_words_gt(words: jnp.ndarray, rk_dec: jnp.ndarray, nr: int):
     """Grouped-transpose ECB decrypt; contract of decrypt_words."""
     return _crypt_words(words, rk_dec, nr, decrypt=True, layout="grouped")
+
+
+def encrypt_words_dense(words: jnp.ndarray, rk: jnp.ndarray, nr: int):
+    """Dense-boundary ECB encrypt: the (128, W) zero-padding layout with
+    the in-kernel ladder (bitslice.transpose32_dense). The "pallas-dense"
+    engine — pallas-gt minus the grouped layout's 2x HBM/VMEM tax."""
+    return _crypt_words(words, rk, nr, decrypt=False, layout="dense")
+
+
+def decrypt_words_dense(words: jnp.ndarray, rk_dec: jnp.ndarray, nr: int):
+    """Dense-boundary ECB decrypt; contract of decrypt_words."""
+    return _crypt_words(words, rk_dec, nr, decrypt=True, layout="dense")
+
+
+def encrypt_words_dense_bp(words: jnp.ndarray, rk: jnp.ndarray, nr: int):
+    """Dense-boundary ECB encrypt with the Boyar–Peralta S-box pinned
+    per-call (see encrypt_words_gt_bp). The "pallas-dense-bp" engine."""
+    return _crypt_words(words, rk, nr, decrypt=False, layout="dense",
+                        sbox="bp")
 
 
 # ---------------------------------------------------------------------------
@@ -428,11 +462,11 @@ def _ctr_gen_kernel(kp_ref, base_ref, data_ref, out_ref, *, nr: int,
 def _ctr_gen_planes_pallas(x, base_masks, kp, *, nr, tile, layout="planes",
                            sbox=None):
     _, _, shape_fn, _, pack = _LAYOUTS[layout]
-    w = x.shape[2]
+    w = x.shape[-1]
     interpret = _interpret()
     kernel = functools.partial(_ctr_gen_kernel, nr=nr, tile=tile,
                                interpret=interpret, pack=pack, sbox=sbox)
-    spec = pl.BlockSpec(shape_fn(tile), lambda i: (0, 0, i))
+    spec = _tile_spec(shape_fn, tile)
     return pl.pallas_call(
         kernel,
         grid=(w // tile,),
@@ -487,6 +521,24 @@ def ctr_crypt_words_gt_bp(words: jnp.ndarray, ctr_be_words: jnp.ndarray,
     the "pallas-gt-bp" engine's CTR_FUSED entry (see encrypt_words_gt_bp
     for why the formulation is its own engine)."""
     return _ctr_gen_words(words, ctr_be_words, rk, nr, layout="grouped",
+                          sbox="bp")
+
+
+def ctr_crypt_words_dense(words: jnp.ndarray, ctr_be_words: jnp.ndarray,
+                          rk: jnp.ndarray, nr: int) -> jnp.ndarray:
+    """Counter-synthesising fused CTR over the dense (128, W) boundary —
+    the "pallas-dense" engine's CTR_FUSED entry. Identical structure to
+    ctr_crypt_words_gt (data never bit-transposed; only the synthesised
+    keystream converts, via dense_from_planes, before the XOR), minus the
+    grouped layout's padding tax — so a 1 GiB stream stages 1 GiB."""
+    return _ctr_gen_words(words, ctr_be_words, rk, nr, layout="dense")
+
+
+def ctr_crypt_words_dense_bp(words: jnp.ndarray, ctr_be_words: jnp.ndarray,
+                             rk: jnp.ndarray, nr: int) -> jnp.ndarray:
+    """ctr_crypt_words_dense with the Boyar–Peralta S-box pinned per-call —
+    the "pallas-dense-bp" engine's CTR_FUSED entry."""
+    return _ctr_gen_words(words, ctr_be_words, rk, nr, layout="dense",
                           sbox="bp")
 
 

@@ -324,7 +324,12 @@ def _chained_dec_sharded_jit(words, iv, rk, *, nr, mesh, axis, engine, mode):
         return combine(words, prev, rk, nr, engine)
 
     f = jax.shard_map(
-        body, mesh=mesh, in_specs=(P(axis), P(), P()), out_specs=P(axis)
+        body, mesh=mesh, in_specs=(P(axis), P(), P()), out_specs=P(axis),
+        # same pallas-interpreter vma drop as _ctr_sharded_jit: the halo
+        # decrypt routes the per-shard bulk through CORES[engine], so a
+        # pallas engine under interpreter mode hits the identical scan-carry
+        # vma bug here (found by fuzz_parity --sharded --engines pallas)
+        check_vma=engine not in PALLAS_BACKED or not _pallas_interpret(),
     )
     return f(words, iv, rk)
 

@@ -221,6 +221,15 @@ class CBackend:
     def ecb(self, ctx: NativeAES, data, workers: int):
         return ctx.ecb(data, encrypt=True, nthreads=workers)
 
+    def ecb_dec(self, ctx: NativeAES, data, workers: int):
+        return ctx.ecb(data, encrypt=False, nthreads=workers)
+
+    def cbc_dec(self, ctx: NativeAES, data, iv, workers: int):
+        # Parallel in C too: ot_aes_cbc_decrypt threads over chunks (each
+        # chunk's prev-ciphertext comes from the input stream, not a carry).
+        out, _ = ctx.cbc(iv, data, encrypt=False, nthreads=workers)
+        return out
+
     def ctr(self, ctx: NativeAES, data, nonce, workers: int):
         out, _ = ctx.ctr(nonce, data, nthreads=workers)
         return out

@@ -1,0 +1,26 @@
+"""Honor a caller's JAX_PLATFORMS=cpu pin *through jax.config*.
+
+The env var alone is not enough on hosts whose site hooks pre-register an
+accelerator plugin at interpreter start: the plugin initializes the device
+backend regardless, and on a tunnelled single-tenant TPU host that means a
+"CPU" run blocks on a wedged tunnel at its first device op (observed: the
+decrypt CLI hanging 180 s under JAX_PLATFORMS=cpu — found by round-3
+verification). tests/conftest.py, repo-root bench.py, and the fuzzer each
+carry this re-assertion; this helper is the one shared home for the CLI
+entry points, so the next entry point cannot forget it.
+
+The update only binds while no backend has been initialized yet (it is a
+silent no-op afterwards) — call it FIRST in main(), before any jax-touching
+work.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def pin_cpu_if_requested() -> None:
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")

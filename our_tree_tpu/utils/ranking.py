@@ -1,0 +1,187 @@
+"""Persisted per-platform engine ranking — measurement over guesswork.
+
+The benchmark entry points pick a compute engine by probing the registered
+engines on the live device (bench.py) or by a full tuning sweep
+(scripts/tune_tpu.py). Both are measurements of THIS host's hardware, and
+both used to evaporate when the process exited: the probe order and the
+"auto" engine preference were hardcoded from one recorded session. This
+module makes the measurement durable: every successful probe/sweep stores
+its GB/s ranking in a small JSON file (``results/engine_ranking.json``,
+override via ``OT_ENGINE_RANKING``), and every later run — bench probe
+order, ``models.aes.resolve_engine("auto")`` — reads it back, falling back
+to the static defaults only when no measurement exists for the platform.
+
+Schema (one entry per device platform)::
+
+    {"tpu": {"ranking": [{"engine": "pallas-gt", "gbps": 5.93}, ...],
+             "source": "bench-probe", "bytes": 67108864,
+             "recorded_at": "2026-07-31T12:00:00"}}
+
+Stdlib-only, like utils/devlock.py, and for the same reason: the repo-root
+``bench.py`` loads this as a BARE file before deciding the jax platform, so
+it must not import the package (whose import pulls in jax). Writes are
+advisory — an unwritable path degrades to the static defaults, never to a
+failed benchmark run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+#: Static fallback order. Seeded from the round-2 hardware A/B
+#: (docs/PERF.md: pallas-gt 5.93 GB/s > pallas 1.65 > bitslice ~0.2). The
+#: dense-boundary variants — expected ≥ gt (same kernel, no padding tax)
+#: but never yet COMPILED under Mosaic — sit after the hardware-proven gt
+#: pair: resolve_engine("auto") has no compile-failure fallback, so on a
+#: never-measured TPU host the static seed must not route production
+#: calls through an unproven kernel. The first hardware probe measures
+#: dense anyway, and the persisted ranking supersedes this order.
+DEFAULT_ORDER = ("pallas-gt", "pallas-gt-bp", "pallas-dense",
+                 "pallas-dense-bp", "pallas", "bitslice")
+
+_DEFAULT_PATH = os.path.normpath(os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), os.pardir, os.pardir,
+    "results", "engine_ranking.json"))
+
+
+def path() -> str:
+    return os.environ.get("OT_ENGINE_RANKING", _DEFAULT_PATH)
+
+
+#: path -> ((mtime_ns, size), parsed dict). resolve_engine("auto") calls
+#: into this per crypt call on auto-engine contexts; a chunked streaming
+#: loop must not pay open+parse per chunk for a file that never changes
+#: mid-run. Invalidated by mtime/size, refreshed by store().
+_CACHE: dict = {}
+
+
+def _load_all() -> dict:
+    p = path()
+    try:
+        st = os.stat(p)
+    except OSError:
+        return {}
+    key = (st.st_mtime_ns, st.st_size)
+    cached = _CACHE.get(p)
+    if cached is not None and cached[0] == key:
+        return cached[1]
+    try:
+        with open(p) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        data = {}
+    if not isinstance(data, dict):
+        data = {}
+    _CACHE[p] = (key, data)
+    return data
+
+
+def load(platform: str) -> dict | None:
+    """The stored entry for a platform, or None if absent/malformed.
+
+    gbps values are type-checked here (bool excluded: it IS an int) so a
+    hand-edited or foreign file degrades to the static defaults instead of
+    crashing order()'s sort — the probe_order() contract is that a
+    left-over file can reorder probes but never crash them.
+    """
+    entry = _load_all().get(platform)
+    if not isinstance(entry, dict):
+        return None
+    rk = entry.get("ranking")
+    if not isinstance(rk, list) or not rk or not all(
+            isinstance(r, dict) and isinstance(r.get("engine"), str)
+            and isinstance(r.get("gbps", 0.0), (int, float))
+            and not isinstance(r.get("gbps", 0.0), bool)
+            for r in rk):
+        return None
+    return entry
+
+
+def order(platform: str) -> list[str] | None:
+    """Engine names best-first from the stored ranking, or None."""
+    entry = load(platform)
+    if entry is None:
+        return None
+    return [r["engine"] for r in sorted(
+        entry["ranking"],
+        key=lambda r: -float(r.get("gbps", 0.0)))]
+
+
+def probe_order(platform: str, available) -> list[str]:
+    """Full probe order for bench.py: persisted measurement first, static
+    defaults appended, then any other registered engine alphabetically.
+
+    "jnp" is never probed — it is the fallback when every probe fails (and
+    the slowest engine by ~40x; ranking it would burn a probe budget on an
+    engine only ever chosen by default). Unknown names in a stale ranking
+    (an engine since renamed/removed) are dropped, so a left-over file can
+    reorder probes but never crash them.
+    """
+    out = [e for e in (order(platform) or [])
+           if e in available and e != "jnp"]
+    out += [e for e in DEFAULT_ORDER if e in available and e not in out]
+    out += sorted(e for e in available if e != "jnp" and e not in out)
+    return out
+
+
+def store(platform: str, gbps_by_engine: dict, source: str,
+          nbytes: int, drop=()) -> bool:
+    """Persist a measured {engine: GB/s} ranking for a platform.
+
+    Rankings of fewer than two engines are not stored: a single data point
+    is not an order, and overwriting a real multi-engine ranking with it
+    would LOSE information. MERGE semantics for the same reason: engines
+    already ranked for the platform but absent from this measurement (a
+    deadline-truncated probe stage measures only the favourites) keep
+    their previous numbers instead of being deleted — re-measured engines
+    update. Returns True iff the file was written. Atomic (write-aside +
+    rename) so a crashed writer can't leave a torn file for the next
+    reader — a torn JSON would silently demote every later run to the
+    static defaults.
+
+    ``drop`` lists engines to REMOVE from the stored entry even where a
+    previous run ranked them (bench.py passes its digest-dissenting
+    engines: an engine just proven to compute wrong bytes must not be
+    resurrected into "auto" selection by the merge).
+    """
+    real = {e: float(g) for e, g in gbps_by_engine.items() if g > 0.0}
+    if len(real) < 2:
+        return False
+    p = path()
+    data = _load_all()
+    prev = data.get(platform)
+    merged = dict(real)
+    if isinstance(prev, dict) and isinstance(prev.get("ranking"), list):
+        for r in prev["ranking"]:
+            if (isinstance(r, dict) and isinstance(r.get("engine"), str)
+                    and r["engine"] not in merged):
+                try:
+                    merged[r["engine"]] = float(r.get("gbps", 0.0))
+                except (TypeError, ValueError):
+                    pass
+    for e in drop:
+        merged.pop(e, None)
+    data[platform] = {
+        "ranking": [{"engine": e, "gbps": round(g, 4)}
+                    for e, g in sorted(merged.items(), key=lambda kv: -kv[1])],
+        "source": source,
+        "bytes": int(nbytes),
+        "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+    }
+    tmp = f"{p}.tmp.{os.getpid()}"
+    try:
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump(data, f, indent=1, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, p)
+    except OSError:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        return False
+    _CACHE.pop(p, None)
+    return True

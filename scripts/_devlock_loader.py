@@ -12,10 +12,19 @@ import os
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def load_devlock():
+def _load_util(name):
     spec = importlib.util.spec_from_file_location(
-        "_ot_devlock",
-        os.path.join(REPO, "our_tree_tpu", "utils", "devlock.py"))
+        f"_ot_{name}",
+        os.path.join(REPO, "our_tree_tpu", "utils", f"{name}.py"))
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
+
+
+def load_devlock():
+    return _load_util("devlock")
+
+
+def load_ranking():
+    """utils/ranking.py, bare-loaded for the same jax-free reason."""
+    return _load_util("ranking")

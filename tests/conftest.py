@@ -29,6 +29,35 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_addoption(parser):
+    parser.addoption(
+        "--runslow", action="store_true", default=False,
+        help="run tests marked slow (interpreter-mode Pallas, 10k-iteration "
+             "KATs, multi-process rehearsals). OT_RUN_SLOW=1 does the same.")
+
+
+def pytest_collection_modifyitems(config, items):
+    """Tier the suite (VERDICT r2 #7): the full run stopped fitting any
+    practical single budget on this host (~37 min; test_pallas.py alone at
+    ~17 min in interpreter mode), so the realistic failure mode was nobody
+    running all of it. The default invocation now runs the core subset
+    (~9 min here — still every engine incl. a compact three-layout kernel
+    matrix, every mode, seam, and sharded path, via cheaper
+    representatives); `--runslow` / OT_RUN_SLOW=1 is the round-gate
+    invocation that runs everything. Explicitly selecting only slow tests
+    (`-m slow`) also runs them.
+    """
+    if (config.getoption("--runslow")
+            or os.environ.get("OT_RUN_SLOW", "") not in ("", "0", "false")
+            or "slow" in (config.getoption("markexpr", "") or "")):
+        return
+    skip = pytest.mark.skip(
+        reason="slow tier: pass --runslow (or OT_RUN_SLOW=1) to run")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip)
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _clear_jax_caches_between_modules():
     """Drop compiled executables after each test module.

@@ -77,6 +77,33 @@ def test_grouped_layout_helpers_match_to_planes():
         np.asarray(g))
 
 
+def test_dense_layout_helpers_match_to_planes():
+    """dense_words/planes_from_dense (the zero-padding (128, W) boundary
+    used by the pallas-dense kernels) must agree exactly with the
+    to_planes/from_planes pair and with the grouped form they replace, and
+    invert cleanly (transpose32_dense is an involution like the grouped
+    ladder)."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(37)
+    w = jnp.asarray(rng.integers(0, 2**32, (32 * 7, 4), dtype=np.uint32))
+    d = bitslice.dense_words(w)
+    assert d.shape == (128, 7)
+    np.testing.assert_array_equal(np.asarray(bitslice.undense_words(d)),
+                                  np.asarray(w))
+    # pure relayout of the grouped form: same bytes, merged leading axes
+    np.testing.assert_array_equal(
+        np.asarray(d), np.asarray(bitslice.group_words(w)).reshape(128, 7))
+    np.testing.assert_array_equal(np.asarray(bitslice.planes_from_dense(d)),
+                                  np.asarray(bitslice.to_planes(w)))
+    np.testing.assert_array_equal(
+        np.asarray(bitslice.dense_from_planes(bitslice.planes_from_dense(d))),
+        np.asarray(d))
+    np.testing.assert_array_equal(
+        np.asarray(bitslice.transpose32_dense(bitslice.transpose32_dense(d))),
+        np.asarray(d))
+
+
 def test_gf16_mul_planes_matches_field():
     """Bitsliced GF(2^4) multiply vs the scalar field op, all 256 pairs."""
     import jax.numpy as jnp
@@ -114,7 +141,7 @@ def test_transpose_roundtrip():
     np.testing.assert_array_equal(np.asarray(back), np.asarray(w))
 
 
-@pytest.mark.parametrize("bits", [128, 192, 256])
+@pytest.mark.parametrize("bits", [128, pytest.param(192, marks=pytest.mark.slow), pytest.param(256, marks=pytest.mark.slow)])
 def test_bitslice_matches_ttable(bits):
     rng = np.random.default_rng(bits)
     key = rng.integers(0, 256, bits // 8, dtype=np.uint8).tobytes()
@@ -133,6 +160,7 @@ def test_bitslice_matches_ttable(bits):
     )
 
 
+@pytest.mark.slow
 def test_full_cipher_under_bp_sbox(monkeypatch):
     """The whole CTR path through the bitslice AND pallas engines with the
     Boyar–Peralta S-box selected — the exact configuration the hardware
@@ -164,6 +192,7 @@ def test_full_cipher_under_bp_sbox(monkeypatch):
         jax.clear_caches()  # don't leak bp-compiled executables
 
 
+@pytest.mark.slow
 def test_context_engine_parity_ctr():
     data = np.random.default_rng(7).integers(0, 256, 16 * 50 + 5, dtype=np.uint8)
     nonce = np.arange(16, dtype=np.uint8)

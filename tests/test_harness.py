@@ -45,6 +45,7 @@ def test_bench_rejects_unknown_mode():
         bench_mod.main(["--sizes-mb", "0.001", "--modes", "rot13", "--iters", "1"])
 
 
+@pytest.mark.slow
 def test_bench_batch_modes(tmp_path):
     """cbc-batch / rc4-batch sweep rows: multi-stream sequence parallelism
     driven from the CLI, with worker-count invariance checked in-run."""
@@ -139,6 +140,7 @@ def test_decrypt_cli_cbc_ctr_match_context(capsys):
         assert got == expect.tobytes().hex()
 
 
+@pytest.mark.slow
 def test_decrypt_cli_cfb128_roundtrip_and_resume(capsys):
     """--mode cfb128: odd lengths are legal (byte-granular), decrypt inverts
     encrypt, and --iv-off resumes mid-block exactly like the context API's
@@ -200,6 +202,7 @@ def test_bench_c_backend_cli(tmp_path):
     assert "ARC4 test #3: passed" in lines
 
 
+@pytest.mark.slow
 def test_ctr_stream_chunked_parity():
     """backends.TpuBackend.ctr_stream: chunked staging with counter carry
     across seams must be byte-identical to the one-shot context API, for

@@ -58,14 +58,14 @@ CTR_CT = {
 KEYS = {128: KEY128, 192: KEY192, 256: KEY256}
 
 
-@pytest.mark.parametrize("bits", [128, 192, 256])
+@pytest.mark.parametrize("bits", [128, pytest.param(192, marks=pytest.mark.slow), pytest.param(256, marks=pytest.mark.slow)])
 def test_sp800_38a_ecb(bits):
     a = AES(KEYS[bits])
     assert a.crypt_ecb(AES_ENCRYPT, PT4).tobytes().hex() == ECB_CT[bits]
     assert a.crypt_ecb(AES_DECRYPT, bytes.fromhex(ECB_CT[bits])).tobytes() == PT4
 
 
-@pytest.mark.parametrize("bits", [128, 192, 256])
+@pytest.mark.parametrize("bits", [128, pytest.param(192, marks=pytest.mark.slow), pytest.param(256, marks=pytest.mark.slow)])
 def test_sp800_38a_cbc(bits):
     a = AES(KEYS[bits])
     ct, iv_out = a.crypt_cbc(AES_ENCRYPT, np.frombuffer(IV, np.uint8), PT4)
@@ -76,7 +76,7 @@ def test_sp800_38a_cbc(bits):
     assert div_out.tobytes() == ct.tobytes()[-16:]
 
 
-@pytest.mark.parametrize("bits", [128, 192, 256])
+@pytest.mark.parametrize("bits", [128, pytest.param(192, marks=pytest.mark.slow), pytest.param(256, marks=pytest.mark.slow)])
 def test_sp800_38a_cfb128(bits):
     a = AES(KEYS[bits])
     ct, off, iv_out = a.crypt_cfb128(AES_ENCRYPT, 0, np.frombuffer(IV, np.uint8), PT4)
@@ -86,7 +86,7 @@ def test_sp800_38a_cfb128(bits):
     assert pt.tobytes() == PT4
 
 
-@pytest.mark.parametrize("bits", [128, 192, 256])
+@pytest.mark.parametrize("bits", [128, pytest.param(192, marks=pytest.mark.slow), pytest.param(256, marks=pytest.mark.slow)])
 def test_sp800_38a_ctr(bits):
     a = AES(KEYS[bits])
     sb = np.zeros(16, np.uint8)
@@ -97,6 +97,7 @@ def test_sp800_38a_ctr(bits):
     assert pt.tobytes() == PT4
 
 
+@pytest.mark.slow
 def test_ctr_chunked_equals_oneshot():
     """Streaming resume: arbitrary chunking must be invisible in the output —
     the reference's nc_off/stream_block contract (aes.c:869-901)."""
@@ -115,6 +116,7 @@ def test_ctr_chunked_equals_oneshot():
     assert off == off1 and nc.tobytes() == nc1.tobytes() and sbl.tobytes() == sb1.tobytes()
 
 
+@pytest.mark.slow
 def test_ctr_block_aligned_end_stream_block():
     """A CTR call that ends EXACTLY on a block boundary must still leave
     stream_block = E(last counter): the reference's byte loop regenerates
@@ -147,6 +149,7 @@ def test_ctr_block_aligned_end_stream_block():
                                                  sb1.tobytes())
 
 
+@pytest.mark.slow
 def test_cfb_chunked_equals_oneshot():
     rng = np.random.default_rng(4)
     a = AES(KEY256)
@@ -191,6 +194,7 @@ def test_cbc_chaining_vs_blockwise():
     assert ct.tobytes() == np.concatenate(expect).tobytes()
 
 
+@pytest.mark.slow
 def test_mode_words_flat_stream_parity():
     """Every words-level mode entry point accepts a flat (4N,) u32 stream
     (the dense TPU boundary layout, models/aes.py:_as_block_words) and must

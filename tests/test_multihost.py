@@ -88,6 +88,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+@pytest.mark.slow
 def test_two_process_global_mesh_ctr(tmp_path):
     port = _free_port()
     coord = f"127.0.0.1:{port}"

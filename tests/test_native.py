@@ -23,7 +23,7 @@ ODD = RNG.integers(0, 256, 10_007, np.uint8)
 IV = RNG.integers(0, 256, 16, np.uint8)
 
 
-@pytest.mark.parametrize("bits", [128, 192, 256])
+@pytest.mark.parametrize("bits", [128, pytest.param(192, marks=pytest.mark.slow), pytest.param(256, marks=pytest.mark.slow)])
 def test_native_ecb_matches_jax(bits):
     nat, jx = NativeAES(KEY[bits]), AES(KEY[bits], engine="jnp")
     ct = nat.ecb(MSG, encrypt=True, nthreads=3)
@@ -33,7 +33,7 @@ def test_native_ecb_matches_jax(bits):
     )
 
 
-@pytest.mark.parametrize("bits", [128, 256])
+@pytest.mark.parametrize("bits", [128, pytest.param(256, marks=pytest.mark.slow)])
 def test_native_ctr_matches_jax_and_threads(bits):
     nat, jx = NativeAES(KEY[bits]), AES(KEY[bits], engine="jnp")
     expect, *_ = jx.crypt_ctr(0, IV.copy(), np.zeros(16, np.uint8), ODD)

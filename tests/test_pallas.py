@@ -16,6 +16,7 @@ from our_tree_tpu.ops.keyschedule import expand_key_dec, expand_key_enc
 
 
 @pytest.mark.parametrize("bits", [128, 192, 256])
+@pytest.mark.slow
 def test_pallas_matches_ttable(bits):
     rng = np.random.default_rng(bits)
     key = rng.integers(0, 256, bits // 8, dtype=np.uint8).tobytes()
@@ -33,6 +34,7 @@ def test_pallas_matches_ttable(bits):
     )
 
 
+@pytest.mark.slow
 def test_pallas_mc_roll_lowering(monkeypatch):
     """OT_PALLAS_MC=roll (reshape + sublane-roll MixColumns inside kernels)
     must be byte-identical to the T-table core — pinned in interpreter mode
@@ -50,6 +52,40 @@ def test_pallas_mc_roll_lowering(monkeypatch):
     got = np.asarray(aes_mod.ecb_encrypt_words(w, rk, nr, "pallas"))
     want = np.asarray(aes_mod.ecb_encrypt_words(w, rk, nr, "jnp"))
     np.testing.assert_array_equal(got, want)
+
+
+def test_headline_engines_small_fast(monkeypatch):
+    """FAST-tier correctness representative for every kernel engine
+    (pallas, pallas-gt, pallas-dense — all three boundary layouts): tiny
+    shapes (33 blocks -> the pad-to-32 path, one grid step) through ECB
+    both directions and the counter-synthesising CTR, vs the T-table
+    core. Exists so a kernel regression fails the DEFAULT test run — the
+    full-size multi-grid gauntlets stay in the gate tier. The -bp
+    variants differ only by the S-box circuit, which test_bitslice.py
+    pins exhaustively at the circuit level in the fast tier."""
+    from our_tree_tpu.ops import pallas_aes
+    from our_tree_tpu.utils import packing
+
+    monkeypatch.setattr(pallas_aes, "TILE", 128)
+    rng = np.random.default_rng(53)
+    nr, rk = expand_key_enc(bytes(range(16)))
+    rk = jnp.asarray(rk)
+    _, rk_dec = expand_key_dec(bytes(range(16)))
+    rk_dec = jnp.asarray(rk_dec)
+    nonce = np.frombuffer(
+        bytes.fromhex("000102030405060708ffffffffffffff"), np.uint8)
+    ctr_be = jnp.asarray(packing.np_bytes_to_words(nonce).byteswap())
+    w = jnp.asarray(rng.integers(0, 2**32, (33, 4)).astype(np.uint32))
+    want_e = np.asarray(aes_mod.ecb_encrypt_words(w, rk, nr, "jnp"))
+    want_c = np.asarray(aes_mod.ctr_crypt_words(w, ctr_be, rk, nr, "jnp"))
+    for engine in ("pallas", "pallas-gt", "pallas-dense"):
+        got = np.asarray(aes_mod.ecb_encrypt_words(w, rk, nr, engine))
+        np.testing.assert_array_equal(got, want_e, err_msg=engine)
+        back = np.asarray(aes_mod.ecb_decrypt_words(
+            jnp.asarray(got), rk_dec, nr, engine))
+        np.testing.assert_array_equal(back, np.asarray(w), err_msg=engine)
+        got = np.asarray(aes_mod.ctr_crypt_words(w, ctr_be, rk, nr, engine))
+        np.testing.assert_array_equal(got, want_c, err_msg=engine)
 
 
 def test_pallas_fused_ctr_counter_carry():
@@ -74,6 +110,7 @@ def test_pallas_fused_ctr_counter_carry():
     )
 
 
+@pytest.mark.slow
 def test_pallas_ctr_gen_matches_materialised():
     """The counter-synthesising kernel (ctr_crypt_words_gen — in-kernel
     bitsliced 128-bit ripple add) vs the counter-materialising fused kernel
@@ -103,6 +140,7 @@ def test_pallas_ctr_gen_matches_materialised():
     np.testing.assert_array_equal(got_mat, want)
 
 
+@pytest.mark.slow
 def test_pallas_ctr_gen_multi_grid_step(monkeypatch):
     """Counter synthesis across grid steps: with a 128-lane tile, 12288
     blocks give a 3-step grid, so the in-kernel block index j = 32*(g*tile
@@ -124,6 +162,7 @@ def test_pallas_ctr_gen_multi_grid_step(monkeypatch):
     np.testing.assert_array_equal(got, want)
 
 
+@pytest.mark.slow
 def test_ctr_flat_stream_equals_block_words():
     """ctr_crypt_words accepts a flat (4N,) u32 stream (the dense TPU
     boundary layout — a (N, 4) boundary array pads its minor dim to the
@@ -139,13 +178,15 @@ def test_ctr_flat_stream_equals_block_words():
     data = rng.integers(0, 256, 16 * 77, np.uint8)
     w2 = jnp.asarray(packing.np_bytes_to_words(data).reshape(-1, 4))
     wf = jnp.asarray(packing.np_bytes_to_words(data))
-    for engine in ("jnp", "bitslice", "pallas", "pallas-gt", "pallas-gt-bp"):
+    for engine in ("jnp", "bitslice", "pallas", "pallas-gt", "pallas-gt-bp",
+                   "pallas-dense"):
         o2 = np.asarray(aes_mod.ctr_crypt_words(w2, ctr_be, rk, nr, engine))
         of = np.asarray(aes_mod.ctr_crypt_words(wf, ctr_be, rk, nr, engine))
         assert of.shape == (4 * 77,)
         np.testing.assert_array_equal(of.reshape(-1, 4), o2, err_msg=engine)
 
 
+@pytest.mark.slow
 def test_pallas_engine_ctr_context():
     """The pallas core through the CTR mode path and the AES context."""
     import numpy as np
@@ -155,16 +196,18 @@ def test_pallas_engine_ctr_context():
     data = np.random.default_rng(9).integers(0, 256, 16 * 40 + 7, np.uint8)
     nonce = np.arange(16, dtype=np.uint8)
     outs = {}
-    for engine in ("jnp", "pallas", "pallas-gt", "pallas-gt-bp"):
+    for engine in ("jnp", "pallas", "pallas-gt", "pallas-gt-bp",
+                   "pallas-dense"):
         a = AES(bytes(range(16)), engine=engine)
         outs[engine], *_ = a.crypt_ctr(0, nonce.copy(),
                                        np.zeros(16, np.uint8), data)
-    np.testing.assert_array_equal(outs["jnp"], outs["pallas"])
-    np.testing.assert_array_equal(outs["jnp"], outs["pallas-gt"])
-    np.testing.assert_array_equal(outs["jnp"], outs["pallas-gt-bp"])
+    for engine in ("pallas", "pallas-gt", "pallas-gt-bp", "pallas-dense"):
+        np.testing.assert_array_equal(outs["jnp"], outs[engine],
+                                      err_msg=engine)
 
 
 @pytest.mark.parametrize("keybytes", [24, 32])
+@pytest.mark.slow
 def test_pallas_kernels_long_keys(keybytes, monkeypatch):
     """AES-192/256 (nr = 12/14) through both pallas engines: the kernels
     unroll rounds with nr as a static parameter, so the nr > 10 straight-
@@ -191,6 +234,42 @@ def test_pallas_kernels_long_keys(keybytes, monkeypatch):
         np.testing.assert_array_equal(got, want_ecb, err_msg=f"ecb {engine}")
 
 
+@pytest.mark.slow
+def test_pallas_dense_engine_matches_jnp(monkeypatch):
+    """Dense-boundary kernels ((128, W) layout, in-kernel ladder via
+    bitslice.transpose32_dense) vs the T-table core: ECB both directions
+    and counter-synthesising CTR (both S-box variants), 3-step grid, near-
+    wraparound nonce — the same gauntlet as the grouped twin below, since
+    the dense engine exists to replace it (VERDICT r2 #3)."""
+    from our_tree_tpu.ops import pallas_aes
+    from our_tree_tpu.utils import packing
+
+    monkeypatch.setattr(pallas_aes, "TILE", 128)
+    rng = np.random.default_rng(29)
+    nr, rk = expand_key_enc(bytes(range(16)))
+    rk = jnp.asarray(rk)
+    _, rk_dec = expand_key_dec(bytes(range(16)))
+    rk_dec = jnp.asarray(rk_dec)
+    nonce = np.frombuffer(
+        bytes.fromhex("00000000fffffffffffffffffffffff0"), np.uint8)
+    ctr_be = jnp.asarray(packing.np_bytes_to_words(nonce).byteswap())
+    w = jnp.asarray(rng.integers(0, 2**32, (32 * 384, 4)).astype(np.uint32))
+
+    got = np.asarray(pallas_aes.encrypt_words_dense(w, rk, nr))
+    want = np.asarray(aes_mod.ecb_encrypt_words(w, rk, nr, "jnp"))
+    np.testing.assert_array_equal(got, want)
+    back = np.asarray(
+        pallas_aes.decrypt_words_dense(jnp.asarray(got), rk_dec, nr))
+    np.testing.assert_array_equal(back, np.asarray(w))
+
+    want = np.asarray(aes_mod.ctr_crypt_words(w, ctr_be, rk, nr, "jnp"))
+    got = np.asarray(pallas_aes.ctr_crypt_words_dense(w, ctr_be, rk, nr))
+    np.testing.assert_array_equal(got, want)
+    got = np.asarray(pallas_aes.ctr_crypt_words_dense_bp(w, ctr_be, rk, nr))
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.slow
 def test_pallas_gt_engine_matches_jnp(monkeypatch):
     """Grouped-transpose kernels (in-kernel SWAR ladder) vs the T-table
     core: ECB both directions and counter-synthesising CTR, with a 3-step

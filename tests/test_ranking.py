@@ -1,0 +1,127 @@
+"""Persisted engine-ranking (utils/ranking.py).
+
+The ranking file is the bridge between one run's hardware measurement and
+the next run's engine choice (VERDICT r2 #8: the probe order and the
+"auto" preference must be data-driven, not a hardcoded session A/B). These
+tests pin the durable parts: store→order round-trip, the
+defaults-when-absent contract, corrupt-file degradation, and the refusal
+to overwrite a real ranking with a single data point.
+"""
+
+import json
+import os
+
+import pytest
+
+from our_tree_tpu.utils import ranking
+
+
+@pytest.fixture
+def rank_file(tmp_path, monkeypatch):
+    p = tmp_path / "engine_ranking.json"
+    monkeypatch.setenv("OT_ENGINE_RANKING", str(p))
+    return p
+
+
+def test_store_then_order_round_trip(rank_file):
+    assert ranking.store("tpu", {"pallas": 1.65, "pallas-gt": 5.93,
+                                 "bitslice": 0.2}, "test", 1 << 20)
+    assert ranking.order("tpu") == ["pallas-gt", "pallas", "bitslice"]
+    entry = ranking.load("tpu")
+    assert entry["source"] == "test"
+    assert entry["bytes"] == 1 << 20
+
+
+def test_order_none_when_absent(rank_file):
+    assert ranking.order("tpu") is None
+    assert ranking.load("tpu") is None
+
+
+def test_store_rejects_single_engine(rank_file):
+    # A one-engine "ranking" is not an order; storing it would overwrite a
+    # real multi-engine measurement with strictly less information.
+    assert ranking.store("tpu", {"pallas-gt": 5.93, "pallas": 0.0},
+                         "test", 1) is False
+    assert not rank_file.exists()
+
+
+def test_store_merges_unmeasured_engines(rank_file):
+    # A deadline-truncated probe that measured only two engines must not
+    # delete the earlier fuller measurement's other entries — re-measured
+    # engines update, absent ones survive.
+    ranking.store("tpu", {"a": 5.0, "b": 3.0, "c": 1.0}, "full", 1)
+    ranking.store("tpu", {"a": 4.0, "b": 6.0}, "truncated", 1)
+    entry = ranking.load("tpu")
+    got = {r["engine"]: r["gbps"] for r in entry["ranking"]}
+    assert got == {"a": 4.0, "b": 6.0, "c": 1.0}
+    assert ranking.order("tpu") == ["b", "a", "c"]
+    assert entry["source"] == "truncated"
+
+
+def test_store_drop_removes_previous_entries(rank_file):
+    # bench.py passes digest-dissenting engines as drops: the merge must
+    # not resurrect an engine the probe just proved computes wrong bytes.
+    ranking.store("tpu", {"a": 5.0, "b": 3.0, "c": 1.0}, "full", 1)
+    ranking.store("tpu", {"b": 2.0, "d": 4.0}, "probe", 1, drop=["a"])
+    assert ranking.order("tpu") == ["d", "b", "c"]
+
+
+def test_malformed_gbps_degrades_not_crashes(rank_file):
+    # probe_order contract: a left-over/foreign file can reorder probes
+    # but never crash them — a null gbps must degrade to the defaults.
+    rank_file.write_text(json.dumps({"tpu": {"ranking": [
+        {"engine": "x", "gbps": None}, {"engine": "y", "gbps": 1.0}]}}))
+    assert ranking.order("tpu") is None
+    assert ranking.probe_order("tpu", {"pallas-gt", "jnp"}) == ["pallas-gt"]
+
+
+def test_store_is_per_platform(rank_file):
+    ranking.store("tpu", {"a": 2.0, "b": 1.0}, "t1", 1)
+    ranking.store("cpu", {"b": 2.0, "a": 1.0}, "t2", 1)
+    assert ranking.order("tpu") == ["a", "b"]
+    assert ranking.order("cpu") == ["b", "a"]
+    # the second store must not have clobbered the first platform's entry
+    assert ranking.load("tpu")["source"] == "t1"
+
+
+def test_corrupt_file_degrades_to_defaults(rank_file):
+    rank_file.write_text("{not json")
+    assert ranking.order("tpu") is None
+    avail = {"pallas-gt", "pallas", "bitslice", "jnp"}
+    assert ranking.probe_order("tpu", avail) == [
+        "pallas-gt", "pallas", "bitslice"]
+
+
+def test_probe_order_measurement_leads_defaults_follow(rank_file):
+    # bitslice measured fastest on this (hypothetical) platform: it must
+    # lead; unmeasured registered engines follow in the static default
+    # order; jnp is never probed.
+    ranking.store("tpu", {"bitslice": 9.0, "pallas": 1.0}, "test", 1)
+    avail = {"pallas-gt", "pallas-gt-bp", "pallas", "bitslice", "jnp",
+             "zz-new"}
+    assert ranking.probe_order("tpu", avail) == [
+        "bitslice", "pallas", "pallas-gt", "pallas-gt-bp", "zz-new"]
+
+
+def test_probe_order_drops_stale_engine_names(rank_file):
+    ranking.store("tpu", {"renamed-away": 9.0, "pallas": 1.0}, "test", 1)
+    assert ranking.probe_order("tpu", {"pallas", "jnp"}) == ["pallas"]
+
+
+def test_store_writes_valid_json_atomically(rank_file):
+    ranking.store("tpu", {"a": 2.0, "b": 1.0}, "test", 64)
+    data = json.loads(rank_file.read_text())
+    assert data["tpu"]["ranking"][0] == {"engine": "a", "gbps": 2.0}
+    # no write-aside temp file left behind
+    assert [f for f in os.listdir(rank_file.parent)
+            if f.startswith("engine_ranking.json.tmp")] == []
+
+
+def test_unwritable_path_is_advisory(tmp_path, monkeypatch):
+    monkeypatch.setenv(
+        "OT_ENGINE_RANKING", str(tmp_path / "no" / "such" / "dir"))
+    # os.makedirs creates parents, so point at a path UNDER a file instead
+    blocker = tmp_path / "blocker"
+    blocker.write_text("")
+    monkeypatch.setenv("OT_ENGINE_RANKING", str(blocker / "x.json"))
+    assert ranking.store("tpu", {"a": 2.0, "b": 1.0}, "test", 1) is False

@@ -51,6 +51,7 @@ def test_native_cpu_measure_digest_guard():
     assert label in ("native-aesni", "native-c")
 
 
+@pytest.mark.slow
 def test_busy_devlock_holder_reports_native_json(tmp_path):
     """End-to-end: a LIVE devlock holder that outlasts the wait budget must
     divert the run to the native host runtime under a "device busy" label —
@@ -113,6 +114,7 @@ def test_watcher_probe_source_is_real_execution():
     assert rc == 0
 
 
+@pytest.mark.slow
 def test_unreachable_accelerator_reports_native_json(tmp_path):
     """End-to-end: no reachable accelerator -> one JSON line, native engine,
     above-baseline value (the contract that makes a tunnel-outage round
