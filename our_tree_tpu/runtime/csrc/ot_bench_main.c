@@ -30,6 +30,20 @@ static long long now_us(void) {
     return (long long)tv.tv_sec * 1000000 + tv.tv_usec;
 }
 
+/* Exact token match in a comma-separated mode list: plain strstr would
+ * make --modes=ecb-dec also enable the "ecb" sweep. */
+static int has_mode(const char *modes, const char *tok) {
+    size_t n = strlen(tok);
+    const char *p = modes;
+    while ((p = strstr(p, tok)) != NULL) {
+        int left_ok = (p == modes) || (p[-1] == ',');
+        int right_ok = (p[n] == '\0') || (p[n] == ',');
+        if (left_ok && right_ok) return 1;
+        p += 1;
+    }
+    return 0;
+}
+
 static int parse_list(const char *s, long long *out, int cap) {
     int n = 0;
     while (*s && n < cap) {
@@ -74,6 +88,15 @@ static void sweep_aes(const char *mode, size_t size, const long long *threads,
             long long t0 = now_us();
             if (strcmp(mode, "ECB") == 0)
                 ot_aes_ecb(&ctx, 1, msg, out, size / 16, nt);
+            else if (strcmp(mode, "ECB-DEC") == 0)
+                /* Inverse cipher (decrypt rows measure the inverse round
+                 * structure; throughput is data-independent, so decrypting
+                 * random bytes is a faithful measurement). */
+                ot_aes_ecb(&ctx, 0, msg, out, size / 16, nt);
+            else if (strcmp(mode, "CBC-DEC") == 0)
+                /* Chunk-parallel, unlike CBC encrypt (each chunk's chain
+                 * needs only ciphertext — ot_crypt.h). */
+                ot_aes_cbc_decrypt(&ctx, nonce, msg, out, size / 16, nt);
             else
                 ot_aes_ctr(&ctx, nonce, msg, out, size, nt);
             printf("%lld, ", now_us() - t0);
@@ -145,7 +168,8 @@ int main(int argc, char **argv) {
             fprintf(stderr,
                     "usage: ot_bench [--backend=c|tpu] [--sizes=MB,..]\n"
                     "                [--threads=N,..] [--iters=N]\n"
-                    "                [--keybits=128|192|256] [--modes=ecb,ctr,rc4]\n");
+                    "                [--keybits=128|192|256]\n"
+                    "                [--modes=ecb,ecb-dec,ctr,cbc-dec,rc4]\n");
             return 1;
         }
     }
@@ -163,13 +187,17 @@ int main(int argc, char **argv) {
     long long sizes[MAX_LIST], threads[MAX_LIST];
     int ns = parse_list(sizes_s, sizes, MAX_LIST);
     int nt = parse_list(threads_s, threads, MAX_LIST);
-    int do_ecb = strstr(modes, "ecb") != NULL;
-    int do_ctr = strstr(modes, "ctr") != NULL;
-    int do_rc4 = strstr(modes, "rc4") != NULL;
+    int do_ecb = has_mode(modes, "ecb");
+    int do_ecbd = has_mode(modes, "ecb-dec");
+    int do_cbcd = has_mode(modes, "cbc-dec");
+    int do_ctr = has_mode(modes, "ctr");
+    int do_rc4 = has_mode(modes, "rc4");
     for (int s = 0; s < ns; s++) {
         size_t bytes = (size_t)sizes[s] << 20;
         if (do_ecb) sweep_aes("ECB", bytes, threads, nt, iters, keybits);
+        if (do_ecbd) sweep_aes("ECB-DEC", bytes, threads, nt, iters, keybits);
         if (do_ctr) sweep_aes("CTR", bytes, threads, nt, iters, keybits);
+        if (do_cbcd) sweep_aes("CBC-DEC", bytes, threads, nt, iters, keybits);
         if (do_rc4) sweep_rc4(bytes, threads, nt, iters);
     }
     return 0;

@@ -150,7 +150,12 @@ def store(platform: str, gbps_by_engine: dict, source: str,
     if len(real) < 2:
         return False
     p = path()
-    data = _load_all()
+    # Shallow copy: _load_all() returns the CACHED dict, and mutating it in
+    # place would make a FAILED write leave a phantom never-persisted entry
+    # visible to every later in-process load()/order() call (and a later
+    # successful store for another platform would persist it). Top-level
+    # copy suffices — the previous entry is only read, never mutated.
+    data = dict(_load_all())
     prev = data.get(platform)
     merged = dict(real)
     if isinstance(prev, dict) and isinstance(prev.get("ranking"), list):

@@ -158,6 +158,30 @@ def main():
         grouped, base, kp)
     report("ctr-gt-bp kernel alone", t, gb)
 
+    # Dense (128, W) boundary components ("pallas-dense"): same kernel
+    # structure as gt minus the grouped layout's 2x sublane-padding tax.
+    # full-vs-kernel-alone difference = the dense relayout's cost; the
+    # gt-vs-dense kernel-alone difference = the padding tax + ladder-form
+    # scheduling delta, the A/B the layout decision rides on.
+    t = chained_time(
+        lambda c, w, rk: aes_mod.ctr_crypt_words(w, c, rk, 10,
+                                                 "pallas-dense"),
+        ctr_be, flat, a.rk_enc)
+    report("full ctr (pallas-dense)", t, gb)
+
+    dense = jax.jit(bitslice.dense_words)(kwords)
+    t = chained_time(
+        lambda d, b, kp: pallas_aes._ctr_gen_planes_pallas(
+            d, b, kp, nr=10, tile=tile, layout="dense"),
+        dense, base, kp)
+    report("ctr-dense kernel alone", t, gb)
+
+    t = chained_time(
+        lambda d, b, kp: pallas_aes._ctr_gen_planes_pallas(
+            d, b, kp, nr=10, tile=tile, layout="dense", sbox="bp"),
+        dense, base, kp)
+    report("ctr-dense-bp kernel alone", t, gb)
+
 
 if __name__ == "__main__":
     sys.exit(main())

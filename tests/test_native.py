@@ -158,3 +158,29 @@ print(json.dumps({"ecb": ct_ecb.tobytes().hex(), "ctr": out_ctr.tobytes().hex()}
                            text=True, env=env, check=True)
         outs[label] = json.loads(r.stdout.strip().splitlines()[-1])
     assert outs["hw"] == outs["portable"]
+
+
+def test_ot_bench_c_sweep_decrypt_modes():
+    """The pure-C harness executable (ot_bench --backend=c): builds, emits
+    reference-format CSV rows for the round-3 decrypt modes, and matches
+    mode tokens exactly — --modes=ecb-dec must not also run the plain ECB
+    sweep (the old strstr matching would have)."""
+    import pathlib
+    import subprocess
+
+    import our_tree_tpu.runtime as rt
+
+    csrc = pathlib.Path(rt.__file__).parent / "csrc"
+    subprocess.run(["make", "-C", str(csrc), "ot_bench"],
+                   check=True, capture_output=True)
+    out = subprocess.run(
+        [str(csrc / "ot_bench"), "--backend=c", "--sizes=1", "--threads=1",
+         "--iters=2", "--modes=ecb-dec,cbc-dec"],
+        check=True, capture_output=True, text=True).stdout
+    rows = [ln for ln in out.splitlines() if ln.strip()]
+    assert any(ln.startswith("C AES-256 ECB-DEC, 1048576, 1, ")
+               for ln in rows), rows
+    assert any(ln.startswith("C AES-256 CBC-DEC, 1048576, 1, ")
+               for ln in rows), rows
+    assert not any(ln.startswith("C AES-256 ECB, ") for ln in rows), rows
+    assert not any(ln.startswith("C AES-256 CTR, ") for ln in rows), rows

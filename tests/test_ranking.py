@@ -125,3 +125,19 @@ def test_unwritable_path_is_advisory(tmp_path, monkeypatch):
     blocker.write_text("")
     monkeypatch.setenv("OT_ENGINE_RANKING", str(blocker / "x.json"))
     assert ranking.store("tpu", {"a": 2.0, "b": 1.0}, "test", 1) is False
+
+
+def test_failed_store_leaves_no_phantom_entry(rank_file, monkeypatch):
+    # store() must not mutate the in-process cache on a FAILED write: a
+    # phantom never-persisted ranking would steer auto selection and leak
+    # into a later successful store for another platform.
+    ranking.store("tpu", {"a": 2.0, "b": 1.0}, "seed", 1)
+    assert ranking.order("tpu") == ["a", "b"]
+    blocker = rank_file.parent / "blocker"
+    blocker.write_text("")
+    monkeypatch.setenv("OT_ENGINE_RANKING", str(blocker / "x.json"))
+    ranking.load("tpu")  # prime the (empty) cache for the unwritable path
+    assert ranking.store("tpu", {"x": 9.0, "y": 8.0}, "fail", 1) is False
+    assert ranking.order("tpu") is None  # unwritable path: defaults, no phantom
+    monkeypatch.setenv("OT_ENGINE_RANKING", str(rank_file))
+    assert ranking.order("tpu") == ["a", "b"]  # original file untouched
