@@ -331,6 +331,33 @@ def _report(measured_bytes: int, platform: str, engine: str, digest: int,
     }), flush=True)
 
 
+def _majority_digest_filter(probes: dict, probe_digests: dict):
+    """Drop engines whose probe digest dissents from the majority.
+
+    Same buffer, same counter — every engine must produce the same
+    ciphertext digest; a dissenter computes wrong bytes on THIS hardware
+    (the cross-engine bug class the CPU suite can't see). A wrong engine
+    is often also a FAST engine (skipped work), so it must not win the
+    headline or enter the persisted ranking. A digest-count tie breaks
+    toward the cluster containing the slowest engine (same skipped-work
+    logic). Returns (kept_probes, kept_digests, dropped_names_sorted).
+    """
+    if len(set(probe_digests.values())) <= 1:
+        return probes, probe_digests, []
+    counts: dict = {}
+    for d in probe_digests.values():
+        counts[d] = counts.get(d, 0) + 1
+    majority = max(
+        counts,
+        key=lambda d: (counts[d], -min(
+            probes[e] for e, dd in probe_digests.items() if dd == d)),
+    )
+    dropped = sorted(e for e, d in probe_digests.items() if d != majority)
+    return ({e: v for e, v in probes.items() if e not in dropped},
+            {e: v for e, v in probe_digests.items() if e not in dropped},
+            dropped)
+
+
 def _measure_and_report() -> None:
     import jax
     import jax.numpy as jnp
@@ -483,36 +510,15 @@ def _measure_and_report() -> None:
                 print(f"# probe {eng}: failed ({type(e).__name__}: {e})"[:500],
                       file=sys.stderr)
         if len(set(probe_digests.values())) > 1:
-            # Same buffer, same counter — every engine must produce the
-            # same ciphertext digest. A disagreement means some engine
-            # computes wrong bytes on THIS hardware (the cross-engine bug
-            # class the CPU suite can't see). A wrong engine is often also
-            # a FAST engine (skipped work), so it must not win the headline
-            # or enter the persisted ranking: keep only the majority-digest
-            # engines; a count tie breaks toward the digest whose engines
-            # include the slowest one (same skipped-work logic).
             print("# WARNING: probe digests disagree across engines: "
                   + ", ".join(f"{k}={v:#010x}"
                               for k, v in sorted(probe_digests.items())),
                   file=sys.stderr)
-            counts: dict = {}
-            for d in probe_digests.values():
-                counts[d] = counts.get(d, 0) + 1
-            majority = max(
-                counts,
-                key=lambda d: (counts[d], -min(
-                    probes[e] for e, dd in probe_digests.items() if dd == d)),
-            )
-            digest_dropped = sorted(e for e, d in probe_digests.items()
-                                    if d != majority)
+        probes, probe_digests, digest_dropped = _majority_digest_filter(
+            probes, probe_digests)
+        if digest_dropped:
             print("# excluding digest-dissenting engines from selection "
                   f"and ranking: {digest_dropped}", file=sys.stderr)
-            probes = {e: v for e, v in probes.items()
-                      if e not in digest_dropped}
-            probe_digests = {e: v for e, v in probe_digests.items()
-                             if e not in digest_dropped}
-        else:
-            digest_dropped = []
         engine = max(probes, key=probes.get) if probes else "jnp"
         print("# probe GB/s: " + ", ".join(
             f"{k}={v:.2f}" for k, v in sorted(probes.items())), file=sys.stderr)

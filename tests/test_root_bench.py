@@ -138,3 +138,26 @@ def test_unreachable_accelerator_reports_native_json(tmp_path):
         # With hardware AES the CPU fallback beats the reference baseline;
         # the scalar native-c path (no AES-NI host) only needs to report.
         assert line["value"] > 0.52
+
+
+def test_majority_digest_filter():
+    """Digest-dissent exclusion (the probe stage's guard against a
+    miscompiled engine winning the headline or the persisted ranking):
+    majority digest wins; a count tie breaks toward the cluster holding
+    the SLOWEST engine (a wrong engine is typically fast — it skipped
+    work); agreement passes everything through untouched."""
+    rb = _load_root_bench()
+    # 2-vs-1: the dissenter is dropped even though it is fastest.
+    probes = {"a": 9.0, "b": 2.0, "c": 1.5}
+    digests = {"a": 111, "b": 222, "c": 222}
+    kept, kd, dropped = rb._majority_digest_filter(probes, digests)
+    assert dropped == ["a"]
+    assert kept == {"b": 2.0, "c": 1.5} and kd == {"b": 222, "c": 222}
+    # 1-vs-1 tie: the slow engine's digest is trusted.
+    kept, _, dropped = rb._majority_digest_filter(
+        {"fast": 9.0, "slow": 1.0}, {"fast": 111, "slow": 222})
+    assert dropped == ["fast"] and list(kept) == ["slow"]
+    # Agreement: untouched.
+    kept, kd, dropped = rb._majority_digest_filter(
+        {"a": 1.0, "b": 2.0}, {"a": 5, "b": 5})
+    assert dropped == [] and kept == {"a": 1.0, "b": 2.0}
