@@ -7,12 +7,26 @@ tile-padding path (n=33 -> one 32-block lane group + pad), the fori_loop
 round body, and the folded-schedule decrypt ordering.
 """
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
 from our_tree_tpu.models import aes as aes_mod
 from our_tree_tpu.ops.keyschedule import expand_key_dec, expand_key_enc
+
+
+@pytest.fixture(autouse=True)
+def _clear_caches_per_test():
+    """Interpreter-mode Pallas tests are the heaviest compilations in the
+    suite; with the round-3 engine-matrix additions the per-MODULE cache
+    clearing (tests/conftest.py) stopped bounding XLA-CPU's accumulated
+    compiler state — the gate run segfaulted in backend_compile partway
+    through this module (the crash class conftest documents). Per-test
+    clearing here keeps the footprint bounded; these tests compile fresh
+    shapes each time anyway, so nothing useful is evicted."""
+    yield
+    jax.clear_caches()
 
 
 @pytest.mark.parametrize("bits", [128, 192, 256])
