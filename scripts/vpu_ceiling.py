@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""Measured VPU ceiling: streamed u32 bitwise-op microbenchmark (Pallas).
+
+docs/PERF.md's roofline divides the AES number by an ESTIMATED VPU issue
+rate (2-4 T-u32-ops/s — "the exact issue rate per op mix isn't public"),
+which makes the quoted "11-20% of ceiling" a 2x-wide claim. This pins the
+denominator by measuring it: a Pallas kernel streams VMEM-tiled u32 data
+through a chain of XOR/AND ops — the exact op mix of the bitsliced AES
+round (ops/bitslice.py) — and reports achieved u32-ops/s.
+
+Two regimes, same kernel:
+  - compute-bound: CHAIN=128 dependent ops per element. HBM traffic is
+    amortized 128x, so the number is the VPU issue ceiling for this mix.
+  - stream-bound: CHAIN=1. One read + one write per 2 ops; the number is
+    HBM bandwidth expressed in ops (sanity floor, not the ceiling).
+
+The chain is a two-variable nonlinear feedback (a, b = b, a ^ (b & K))
+so neither XLA nor Mosaic can algebraically collapse it; one iteration
+costs exactly 2 vector ops (XOR + AND). Timing is bench.py's chained
+methodology (T(1+K)-T(1) with a carry perturbation and sum-digest
+readback) so per-call overhead and async-dispatch artifacts cancel.
+
+The reference never measured its hardware ceiling at all — its numbers
+are -O0 builds (Makefile:13, aes-modes/Makefile:15) with no roofline
+anywhere; this script exists so docs/PERF.md can say "X% of MEASURED".
+
+Run on TPU via the recover_watch plan; runs CPU/interpreter for tests
+(OT_VPU_BYTES / OT_VPU_ITERS shrink it).
+"""
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from our_tree_tpu.utils.platform import pin_cpu_if_requested
+
+NBYTES = int(os.environ.get("OT_VPU_BYTES", 64 << 20))
+ITERS = int(os.environ.get("OT_VPU_ITERS", 8))
+TILE = 512  # lanes per grid step; sized like pallas_aes.TILE
+
+
+def _chain_kernel(x_ref, o_ref, *, chain: int):
+    import jax
+    import jax.numpy as jnp
+
+    a = x_ref[...]
+    b = a ^ jnp.uint32(0x9E3779B9)
+
+    def body(_, ab):
+        a, b = ab
+        return b, a ^ (b & jnp.uint32(0x85EBCA6B))
+
+    a, b = jax.lax.fori_loop(0, chain, body, (a, b))
+    o_ref[...] = a ^ b
+
+
+@functools.lru_cache(None)
+def _build(chain: int, lanes: int, tile: int, interpret: bool):
+    import jax
+    from jax.experimental import pallas as pl
+
+    spec = pl.BlockSpec((8, tile), lambda i: (0, i))
+    return jax.jit(lambda x: pl.pallas_call(
+        functools.partial(_chain_kernel, chain=chain),
+        grid=(lanes // tile,),
+        in_specs=[spec],
+        out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=interpret,
+    )(x))
+
+
+def chained_time(fn, x, iters=ITERS):
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def chainrun(x, k):
+        def body(_, acc):
+            return jnp.sum(fn(x ^ acc), dtype=jnp.uint32)
+
+        return jax.lax.fori_loop(jnp.uint32(0), k, body, jnp.uint32(0))
+
+    def run(k):
+        t0 = time.perf_counter()
+        int(chainrun(x, jnp.uint32(k)))
+        return time.perf_counter() - t0
+
+    run(1)
+    t1 = min(run(1) for _ in range(2))
+    tk = min(run(1 + iters) for _ in range(2))
+    return max(tk - t1, 1e-9) / iters
+
+
+def main() -> int:
+    pin_cpu_if_requested()
+    import jax
+    import jax.numpy as jnp
+
+    from our_tree_tpu.ops.pallas_aes import _interpret
+
+    n = NBYTES // 4
+    lanes = max(n // 8, TILE)
+    lanes -= lanes % TILE
+    n = lanes * 8
+    interpret = _interpret()
+    x = jax.device_put(
+        jnp.arange(n, dtype=jnp.uint32).reshape(8, lanes))
+    dev = jax.devices()[0]
+    print(f"# {n * 4 >> 20} MiB u32, shape (8, {lanes}), tile={TILE}, "
+          f"device={dev.platform}/{dev.device_kind}, interpret={interpret}")
+
+    out = {"platform": dev.platform, "device_kind": dev.device_kind,
+           "bytes": n * 4}
+    for name, chain in (("stream", 1), ("compute", 128)):
+        fn = _build(chain, lanes, TILE, interpret)
+        t = chained_time(fn, x)
+        # 2 ops (XOR+AND) per chain step, +2 for the prologue/epilogue XORs.
+        ops = n * (2 * chain + 2)
+        gbps = n * 8 / t / 1e9  # one u32 read + one write per element
+        print(f"{name:8s} chain={chain:4d}: {t * 1e3:8.2f} ms  "
+              f"{ops / t / 1e12:6.3f} T-u32-ops/s  ({gbps:6.1f} GB/s mem)")
+        out[name] = {"chain": chain, "sec": t, "t_ops_per_s": ops / t / 1e12,
+                     "mem_gb_per_s": gbps}
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -47,9 +47,14 @@ def pytest_collection_modifyitems(config, items):
     invocation that runs everything. Explicitly selecting only slow tests
     (`-m slow`) also runs them.
     """
+    # The markexpr test matches "slow" as a whole word (ADVICE r3): a
+    # substring test would let any expression merely containing the letters
+    # — a future "slowio" marker, say — disable the skip-marking path.
+    # "not slow" matching too is correct: -m deselection already governs
+    # there, and adding skip markers on top would only muddy the report.
     if (config.getoption("--runslow")
             or os.environ.get("OT_RUN_SLOW", "") not in ("", "0", "false")
-            or "slow" in (config.getoption("markexpr", "") or "")):
+            or re.search(r"\bslow\b", config.getoption("markexpr", "") or "")):
         return
     skip = pytest.mark.skip(
         reason="slow tier: pass --runslow (or OT_RUN_SLOW=1) to run")

@@ -141,3 +141,105 @@ def test_failed_store_leaves_no_phantom_entry(rank_file, monkeypatch):
     assert ranking.order("tpu") is None  # unwritable path: defaults, no phantom
     monkeypatch.setenv("OT_ENGINE_RANKING", str(rank_file))
     assert ranking.order("tpu") == ["a", "b"]  # original file untouched
+
+
+def test_device_key_separates_generations():
+    """Rankings are keyed by device KIND (ADVICE r3): an entry measured on
+    one TPU generation must never feed auto-selection on another."""
+    assert ranking.device_key("cpu", "cpu") == "cpu"
+    assert ranking.device_key("tpu", None) == "tpu"
+    assert ranking.device_key("tpu", "TPU v5e") == "tpu:TPU v5e"
+    assert (ranking.device_key("tpu", "TPU v5e")
+            != ranking.device_key("tpu", "TPU v6 lite"))
+
+
+def test_drop_engines_removes_and_records(rank_file):
+    """drop_engines (the persistence half of the compile-failure fallback,
+    models/aes.py:_engine_compile_ok): a compile-broken engine disappears
+    from the stored ranking — even down to a single survivor, unlike
+    store()'s two-engine floor — and the drop record keeps it out of
+    probe_order entirely (including the static-default backfill)."""
+    ranking.store("tpu", {"a": 5.0, "b": 3.0}, "probe", 1)
+    assert ranking.drop_engines("tpu", ["a"])
+    assert ranking.order("tpu") == ["b"]
+    assert ranking.load("tpu")["dropped"] == ["a"]
+    assert "a" not in ranking.probe_order("tpu", {"a", "b", "jnp"})
+    # idempotent: nothing new to write
+    assert not ranking.drop_engines("tpu", ["a"])
+
+
+def test_drop_engines_sticks_on_fresh_host(rank_file):
+    """A compile failure on a never-measured host (no entry at all) must
+    still persist — the next process must not re-pay the failed compile.
+    DEFAULT_ORDER engines are excluded from the backfill too."""
+    eng = ranking.DEFAULT_ORDER[0]
+    assert ranking.drop_engines("tpu:TPU fresh", [eng])
+    assert eng not in ranking.probe_order("tpu:TPU fresh",
+                                          set(ranking.DEFAULT_ORDER))
+    assert not ranking.drop_engines("tpu:TPU fresh", [eng])  # idempotent
+
+
+def test_store_clears_remeasured_drops_keeps_others(rank_file):
+    """store() preserves the drop record across probe stores, EXCEPT for
+    engines the new measurement actually ran — a successful measurement is
+    the drop's designed recovery path (e.g. a tune sweep naming the engine
+    explicitly after a jax upgrade)."""
+    ranking.store("tpu", {"a": 5.0, "b": 3.0}, "probe", 1)
+    ranking.drop_engines("tpu", ["c", "d"])
+    ranking.store("tpu", {"a": 6.0, "c": 2.0}, "tune-sweep", 1)
+    assert ranking.dropped("tpu") == {"d"}
+    assert "c" in ranking.order("tpu")
+    assert "d" not in ranking.probe_order("tpu", {"a", "b", "c", "d"})
+
+
+def test_resolve_auto_compile_failure_falls_back(monkeypatch, tmp_path):
+    """resolve_engine("auto") on a (simulated) fresh accelerator host: the
+    static-order favourite has no measurement yet, fails its one-time
+    lowering probe, the runner-up is selected, and the failure is persisted
+    as a drop that later processes skip (VERDICT r3 #2 fallback half).
+    (An engine with a stored measurement under this device key skips the
+    probe entirely — the measurement is proof it compiled and ran here —
+    so the fallback's scope is exactly the never-measured first contact.)"""
+    import jax
+
+    from our_tree_tpu.models import aes as aes_mod
+    from our_tree_tpu.ops import pallas_aes
+
+    p = tmp_path / "engine_ranking.json"
+    monkeypatch.setenv("OT_ENGINE_RANKING", str(p))
+    for k in ("OT_PALLAS_TILE", "OT_PALLAS_MC", "OT_SBOX",
+              "OT_BITSLICE_UNROLL"):
+        monkeypatch.delenv(k, raising=False)  # drops persist only un-tuned
+    calls = []
+
+    def broken(words, rk, nr):
+        calls.append("broken")
+        raise RuntimeError("Mosaic lowering failed (simulated)")
+
+    monkeypatch.setitem(aes_mod.CORES, "fake-pallas", (broken, broken))
+    aes_mod.PALLAS_BACKED.add("fake-pallas")
+    monkeypatch.setattr(aes_mod, "_COMPILE_OK", {})
+    # Simulate hardware: non-cpu backend, compiled (non-interpreter) pallas,
+    # no ranking file yet, the fake engine first in the static order.
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(pallas_aes, "interpret_mode", lambda: False)
+    monkeypatch.setattr(ranking, "DEFAULT_ORDER",
+                        ("fake-pallas", "bitslice"))
+    monkeypatch.setattr(
+        ranking, "device_key", lambda *a, **k: "tpu:TPU test")
+    try:
+        got = aes_mod.resolve_engine("auto")
+        assert got == "bitslice"
+        assert calls == ["broken"]  # probed exactly once...
+        assert aes_mod.resolve_engine("auto") == got
+        assert calls == ["broken"]  # ...memoized on the second resolve
+        # and the drop persisted for the next process
+        assert ranking.dropped("tpu:TPU test") == {"fake-pallas"}
+        # a "next process" (cold memo) skips the engine via the persisted
+        # record — probe_order excludes it — instead of re-paying the
+        # failed compile
+        monkeypatch.setattr(aes_mod, "_COMPILE_OK", {})
+        assert aes_mod.resolve_engine("auto") == got
+        assert calls == ["broken"]
+    finally:
+        aes_mod.PALLAS_BACKED.discard("fake-pallas")
