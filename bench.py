@@ -372,6 +372,15 @@ def _measure_and_report() -> None:
     # drives the cpu-vs-accelerator logic below.
     rank_key = ranking.device_key(
         platform, getattr(jax.devices()[0], "device_kind", None))
+    if platform != "cpu":
+        # Reproduce the last tune sweep's winning tile/MC for this device
+        # kind (scripts/tune_tpu.py persists them) BEFORE any kernel is
+        # traced; explicit OT_PALLAS_* env still wins inside apply_knobs.
+        # The probe stage then measures engines under the SAME knobs every
+        # production context runs (resolve_engine("auto") applies them
+        # too), so the persisted ranking stays reproducible.
+        from our_tree_tpu.ops import pallas_aes
+        pallas_aes.apply_stored_knobs(jax.devices()[0])
     requested = os.environ.get("OT_BENCH_ENGINE", "probe")
     iters = int(os.environ.get("OT_BENCH_ITERS", 5))
 
@@ -575,8 +584,13 @@ def _measure_and_report() -> None:
             gbps, digest = measure(engine, nbytes, iters)
             measured_bytes = nbytes
         except Exception as e:
-            print(f"# headline failed ({type(e).__name__}); "
-                  "reporting probe-size result", file=sys.stderr)
+            # Full message, bounded: "JaxRuntimeError" alone cannot
+            # distinguish an HBM OOM from a Mosaic limit from a transfer
+            # hang, and the failed size's diagnosis IS the artifact a
+            # wedged-tunnel round leaves behind (r4: the 1 GiB step
+            # degraded with only the type name in the log).
+            print(f"# headline failed ({type(e).__name__}: {e})"[:500]
+                  + "; reporting probe-size result", file=sys.stderr)
             if not probes:
                 if platform == "cpu" or not isinstance(e, TimeoutError):
                     # Plain CPU failure, or a real device-side error (compile

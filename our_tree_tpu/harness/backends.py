@@ -43,6 +43,15 @@ class TpuBackend:
         self.max_workers = len(jax.devices())
         self._meshes: dict[int, object] = {}
 
+        # Reproduce the last tune sweep's winning tile/MC for this device
+        # kind (scripts/tune_tpu.py persists them via utils/ranking) before
+        # any kernel is traced — sweep/corpus rows then measure the tuned
+        # production config, not the static defaults. Explicit OT_PALLAS_*
+        # env still wins; no-op on CPU (interpreter mode).
+        from ..ops import pallas_aes
+
+        pallas_aes.apply_stored_knobs(jax.devices()[0])
+
         # ARC4 keystream implementation, resolved ONCE at construction so
         # the lazy native build (a `make` subprocess) can never land inside
         # a timed region, and so a fallback is visible rather than silent:

@@ -167,6 +167,16 @@ def _engine_compile_ok(eng: str, rank_key: str) -> bool:
             tuned = [k for k in ("OT_PALLAS_TILE", "OT_PALLAS_MC",
                                  "OT_SBOX", "OT_BITSLICE_UNROLL")
                      if os.environ.get(k)]
+            # Non-default EFFECTIVE knobs count as overrides too: stored
+            # tuned knobs (pallas_aes.apply_stored_knobs, no env involved)
+            # can make a lowering fail that succeeds under defaults — that
+            # must not be persisted as a durable engine drop any more than
+            # an env override's failure would be.
+            from ..ops import pallas_aes as _pa
+            if _pa.TILE != _pa.DEFAULT_TILE:
+                tuned.append(f"tile={_pa.TILE}")
+            if _pa.MC_LOWERING != _pa.DEFAULT_MC:
+                tuned.append(f"mc={_pa.MC_LOWERING}")
             if tuned:
                 # The failure may be the override's fault, not the
                 # engine's — don't poison default-config processes.
@@ -222,6 +232,13 @@ def resolve_engine(name: str | None = "auto") -> str:
             d = jax.devices()[0]
             rank_key = ranking.device_key(
                 d.platform, getattr(d, "device_kind", None))
+            # Every "auto" context reproduces the tune sweep's winning
+            # tile/MC (not just bench.py/TpuBackend): the persisted engine
+            # ranking is measured under these knobs, so selecting by it
+            # without applying them would pick by numbers this process
+            # cannot reproduce. Idempotent + mtime-cached — fine per call.
+            if allow_pallas:
+                pallas_aes.apply_stored_knobs(d)
         except Exception:
             rank_key = jax.default_backend()
         for eng in ranking.probe_order(rank_key, CORES):

@@ -35,11 +35,17 @@ from . import bitslice
 
 import os
 
+#: Import defaults for the tuning knobs, exported so other modules (the
+#: compile-probe's override guard in models/aes.py, scripts/tune_tpu.py's
+#: mirror) can ask "is the effective config the default one?" without
+#: re-stating the values.
+DEFAULT_TILE, DEFAULT_MC = 1024, "perm"
+
 #: Lanes per grid step. (8, 16, 1024) u32 = 512 KiB per tile buffer; with
 #: input + output + circuit intermediates this sits comfortably inside the
 #: ~16 MiB of VMEM while keeping the lane dimension a multiple of 128.
 #: OT_PALLAS_TILE overrides for on-hardware tuning without a code change.
-TILE = int(os.environ.get("OT_PALLAS_TILE", 1024))
+TILE = int(os.environ.get("OT_PALLAS_TILE", DEFAULT_TILE))
 if TILE <= 0 or TILE % 128:
     raise ValueError(
         f"OT_PALLAS_TILE must be a positive multiple of 128, got {TILE}"
@@ -49,11 +55,71 @@ if TILE <= 0 or TILE % 128:
 #: slice-stacks, the conservative Mosaic form) or "roll" (reshape + sublane
 #: roll — fewer data movements if the generation's Mosaic supports it).
 #: A hardware tuning knob, like OT_PALLAS_TILE.
-MC_LOWERING = os.environ.get("OT_PALLAS_MC", "perm")
+MC_LOWERING = os.environ.get("OT_PALLAS_MC", DEFAULT_MC)
 if MC_LOWERING not in ("perm", "roll"):
     raise ValueError(
         f"OT_PALLAS_MC must be 'perm' or 'roll', got {MC_LOWERING!r}"
     )
+
+
+def apply_knobs(kn: dict, respect_env: bool = True) -> dict:
+    """Apply persisted tuned kernel knobs (utils/ranking.py:knobs) to this
+    module's TILE / MC_LOWERING, returning what was actually applied.
+
+    Both knobs are read at PYTHON call time in the entry points (which is
+    also what lets tests monkeypatch them) and passed into the jitted
+    wrappers as static arguments — part of the compile-cache key — so a
+    mid-process change cleanly recompiles on the next call instead of
+    silently reusing an executable built under the old setting. With
+    ``respect_env`` (the default), a knob the user pinned explicitly via
+    OT_PALLAS_TILE / OT_PALLAS_MC is left alone: an explicit override
+    outranks a stored measurement, same precedence as OT_BENCH_ENGINE over
+    the engine ranking. Values are re-validated against the import-time
+    constraints — the source is a data file, so invalid entries are
+    skipped, never raised.
+    """
+    from ..utils.ranking import _KNOB_VALID  # single source of validity
+
+    global TILE, MC_LOWERING
+    applied = {}
+    tile = kn.get("tile")
+    if (_KNOB_VALID["tile"](tile) and tile != TILE
+            and not (respect_env and "OT_PALLAS_TILE" in os.environ)):
+        TILE = applied["tile"] = tile
+    mc = kn.get("mc")
+    if (_KNOB_VALID["mc"](mc) and mc != MC_LOWERING
+            and not (respect_env and "OT_PALLAS_MC" in os.environ)):
+        MC_LOWERING = applied["mc"] = mc
+    return applied
+
+
+def apply_stored_knobs(device=None, respect_env: bool = True) -> dict:
+    """Apply the persisted tuned knobs for `device` (default: the first
+    jax device), reporting to stderr the first time anything changes.
+
+    The ONE shared entry for every apply site — bench.py, the harness
+    TpuBackend, and resolve_engine("auto") — so knob precedence and
+    reporting cannot drift between copies. Cheap enough for per-call use:
+    the ranking read is mtime-cached, and apply_knobs is idempotent (an
+    already-applied knob reports nothing). No-op on CPU: stored knobs are
+    keyed by accelerator device kind, and interpreter-mode kernels have
+    nothing to tune.
+    """
+    if device is None:
+        device = jax.devices()[0]
+    if device.platform == "cpu":
+        return {}
+    from ..utils import ranking
+
+    key = ranking.device_key(device.platform,
+                             getattr(device, "device_kind", None))
+    applied = apply_knobs(ranking.knobs(key), respect_env=respect_env)
+    if applied:
+        import sys
+
+        print(f"# tuned knobs applied ({key}): " + " ".join(
+            f"{k}={v}" for k, v in sorted(applied.items())), file=sys.stderr)
+    return applied
 
 
 def _perm_stack(x: jnp.ndarray, idx) -> jnp.ndarray:
@@ -61,7 +127,7 @@ def _perm_stack(x: jnp.ndarray, idx) -> jnp.ndarray:
     return jnp.stack([x[int(j)] for j in idx], axis=0)
 
 
-def _run_rounds(p, kp, nr: int, round_fn, interpret: bool):
+def _run_rounds(p, kp, nr: int, round_fn, interpret: bool, mc: str):
     """Whitened state -> state after the nr-1 middle rounds.
 
     ShiftRows / MixColumns rotations inside kernels are always the
@@ -77,7 +143,7 @@ def _run_rounds(p, kp, nr: int, round_fn, interpret: bool):
         # graph pathologically slowly.
         def body(r, q):
             k = jax.lax.dynamic_index_in_dim(kp, r, axis=0, keepdims=False)
-            return round_fn(q, k, False, perm=_perm_stack, mc=MC_LOWERING)
+            return round_fn(q, k, False, perm=_perm_stack, mc=mc)
 
         return jax.lax.fori_loop(1, nr, body, p)
     # Compiled: fully unrolled straight-line rounds with *static* key
@@ -85,7 +151,7 @@ def _run_rounds(p, kp, nr: int, round_fn, interpret: bool):
     # aes-gpu/Source/AES.cu:35,298-365) — no dynamic slicing for Mosaic
     # to trip on, and the round keys fold into the instruction stream.
     for r in range(1, nr):
-        p = round_fn(p, kp[r], False, perm=_perm_stack, mc=MC_LOWERING)
+        p = round_fn(p, kp[r], False, perm=_perm_stack, mc=mc)
     return p
 
 
@@ -134,7 +200,7 @@ def _tile_spec(shape_fn, tile: int) -> pl.BlockSpec:
 
 def _aes_kernel(kp_ref, in_ref, out_ref, *, nr: int, decrypt: bool,
                 interpret: bool, unpack=None, pack=None,
-                sbox: str | None = None):
+                sbox: str | None = None, mc: str = "perm"):
     kp = kp_ref[...]
     # sbox picks the forward S-box circuit per ENGINE (models/aes.py
     # registers formulation variants like "pallas-gt-bp"); decrypt always
@@ -144,7 +210,7 @@ def _aes_kernel(kp_ref, in_ref, out_ref, *, nr: int, decrypt: bool,
                 else functools.partial(bitslice.encrypt_round, sbox=sbox))
     x = in_ref[...]
     p = unpack(x) if unpack is not None else x
-    p = _run_rounds(p ^ kp[0], kp, nr, round_fn, interpret)
+    p = _run_rounds(p ^ kp[0], kp, nr, round_fn, interpret, mc)
     p = round_fn(p, kp[nr], True, perm=_perm_stack)
     out_ref[...] = pack(p) if pack is not None else p
 
@@ -202,15 +268,16 @@ def _interpret() -> bool:
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("nr", "decrypt", "tile", "layout", "sbox"))
+                   static_argnames=("nr", "decrypt", "tile", "layout", "sbox",
+                                    "mc"))
 def _crypt_planes_pallas(x, kp, *, nr, decrypt, tile, layout="planes",
-                         sbox=None):
+                         sbox=None, mc="perm"):
     _, _, shape_fn, unpack, pack = _LAYOUTS[layout]
     w = x.shape[-1]
     interpret = _interpret()
     kernel = functools.partial(
         _aes_kernel, nr=nr, decrypt=decrypt, interpret=interpret,
-        unpack=unpack, pack=pack, sbox=sbox,
+        unpack=unpack, pack=pack, sbox=sbox, mc=mc,
     )
     return pl.pallas_call(
         kernel,
@@ -250,8 +317,11 @@ def _crypt_words(words, rk, nr, decrypt, layout="planes", sbox=None):
     pre, post, *_ = _LAYOUTS[layout]
     x = pre(words)
     kp = _match_vma(bitslice.key_planes(rk, nr), x)
+    # MC lowering is read at PYTHON call time and passed as a jit static:
+    # a mid-process apply_knobs("mc") change recompiles instead of silently
+    # reusing an executable traced under the old lowering.
     out = _crypt_planes_pallas(x, kp, nr=nr, decrypt=decrypt, tile=tile,
-                               layout=layout, sbox=sbox)
+                               layout=layout, sbox=sbox, mc=MC_LOWERING)
     return post(out)[:n]
 
 
@@ -321,19 +391,19 @@ def encrypt_words_dense_bp(words: jnp.ndarray, rk: jnp.ndarray, nr: int):
 
 
 def _ctr_kernel(kp_ref, ctr_ref, data_ref, out_ref, *, nr: int,
-                interpret: bool):
+                interpret: bool, mc: str = "perm"):
     kp = kp_ref[...]
     p = _run_rounds(ctr_ref[...] ^ kp[0], kp, nr, bitslice.encrypt_round,
-                    interpret)
+                    interpret, mc)
     ks = bitslice.encrypt_round(p, kp[nr], True, perm=_perm_stack)
     out_ref[...] = data_ref[...] ^ ks
 
 
-@functools.partial(jax.jit, static_argnames=("nr", "tile"))
-def _ctr_planes_pallas(ctr_planes, data_planes, kp, *, nr, tile):
+@functools.partial(jax.jit, static_argnames=("nr", "tile", "mc"))
+def _ctr_planes_pallas(ctr_planes, data_planes, kp, *, nr, tile, mc="perm"):
     w = ctr_planes.shape[2]
     interpret = _interpret()
-    kernel = functools.partial(_ctr_kernel, nr=nr, interpret=interpret)
+    kernel = functools.partial(_ctr_kernel, nr=nr, interpret=interpret, mc=mc)
     spec = pl.BlockSpec((8, 16, tile), lambda i: (0, 0, i))
     return pl.pallas_call(
         kernel,
@@ -374,6 +444,7 @@ def ctr_crypt_words(words: jnp.ndarray, ctr_le: jnp.ndarray, rk: jnp.ndarray,
         _match_vma(bitslice.key_planes(rk, nr), data_planes),
         nr=nr,
         tile=tile,
+        mc=MC_LOWERING,
     )
     return bitslice.from_planes(out)[:n]
 
@@ -445,11 +516,11 @@ def _ctr_planes_from_base(base, g, tile: int):
 
 def _ctr_gen_kernel(kp_ref, base_ref, data_ref, out_ref, *, nr: int,
                     tile: int, interpret: bool, pack=None,
-                    sbox: str | None = None):
+                    sbox: str | None = None, mc: str = "perm"):
     kp = kp_ref[...]
     ctr = _ctr_planes_from_base(base_ref[...], pl.program_id(0), tile)
     round_fn = functools.partial(bitslice.encrypt_round, sbox=sbox)
-    p = _run_rounds(ctr ^ kp[0], kp, nr, round_fn, interpret)
+    p = _run_rounds(ctr ^ kp[0], kp, nr, round_fn, interpret, mc)
     ks = round_fn(p, kp[nr], True, perm=_perm_stack)
     # In the grouped layout (pack set) the DATA tile is never bit-transposed
     # at all: XOR commutes with the transposition, so only the synthesised
@@ -458,14 +529,15 @@ def _ctr_gen_kernel(kp_ref, base_ref, data_ref, out_ref, *, nr: int,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("nr", "tile", "layout", "sbox"))
+                   static_argnames=("nr", "tile", "layout", "sbox", "mc"))
 def _ctr_gen_planes_pallas(x, base_masks, kp, *, nr, tile, layout="planes",
-                           sbox=None):
+                           sbox=None, mc="perm"):
     _, _, shape_fn, _, pack = _LAYOUTS[layout]
     w = x.shape[-1]
     interpret = _interpret()
     kernel = functools.partial(_ctr_gen_kernel, nr=nr, tile=tile,
-                               interpret=interpret, pack=pack, sbox=sbox)
+                               interpret=interpret, pack=pack, sbox=sbox,
+                               mc=mc)
     spec = _tile_spec(shape_fn, tile)
     return pl.pallas_call(
         kernel,
@@ -494,7 +566,7 @@ def _ctr_gen_words(words, ctr_be_words, rk, nr, layout, sbox=None):
     base = _match_vma(_base_bit_masks(ctr_be_words), x)
     kp = _match_vma(bitslice.key_planes(rk, nr), x)
     out = _ctr_gen_planes_pallas(x, base, kp, nr=nr, tile=tile, layout=layout,
-                                 sbox=sbox)
+                                 sbox=sbox, mc=MC_LOWERING)
     return post(out)[:n]
 
 

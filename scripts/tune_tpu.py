@@ -75,18 +75,29 @@ _DEFAULT_TILE, _DEFAULT_MC, _DEFAULT_UNROLL = 1024, "perm", "1"
 _BP_ALIAS = {"pallas-gt": "pallas-gt-bp", "pallas-dense": "pallas-dense-bp"}
 
 
-def _rankable_engine_name(engine, tile, mc, sbox, unroll):
+def _rankable_engine_name(engine, tile, mc, sbox, unroll,
+                          ref_tile, ref_mc):
     """The registered engine name a sweep config's GB/s may be attributed
     to in the persisted ranking — or None.
 
-    The ranking feeds resolve_engine("auto"), which runs engines under
-    DEFAULT knobs, so a number measured under tuned tile/MC/unroll must
-    not be stored against a name that cannot reproduce it (it would steer
-    production selection by an unreproducible measurement). sbox is the
-    one knob that maps onto a distinct registered engine (the -bp
-    variants), so those rows are attributed there instead of dropped.
+    The ranking must only hold numbers the production path can REPRODUCE
+    (else it steers engine selection by unreproducible measurements), and
+    all rows of one ranking must share a knob setting (mixing settings
+    would compare apples to oranges on merge). Since knob persistence
+    landed (round 4) the reproducible setting is (ref_tile, ref_mc) — the
+    knobs this sweep persists, which bench.py / TpuBackend /
+    resolve_engine("auto") all re-apply via apply_stored_knobs; when no
+    knobs are persisted the caller passes the defaults, restoring the old
+    behavior. Engines that IGNORE the Pallas knobs (bitslice/jnp) are
+    attributable from any (tile, mc) row — those rows measure identical
+    code. unroll must stay default for everyone: only bitslice reads it,
+    and nothing re-applies it. sbox is the one knob that maps onto a
+    distinct registered engine (the -bp variants), so those rows are
+    attributed there instead of dropped.
     """
-    if (tile, mc, unroll) != (_DEFAULT_TILE, _DEFAULT_MC, _DEFAULT_UNROLL):
+    if unroll != _DEFAULT_UNROLL:
+        return None
+    if engine.startswith("pallas") and (tile, mc) != (ref_tile, ref_mc):
         return None
     if sbox == "tower":
         return engine
@@ -141,7 +152,6 @@ def main() -> int:
 
     results = []
     digests = set()
-    best_by_engine: dict[str, float] = {}
     platforms = set()
     with devlock.hold(wait_budget_s=900.0,
                       on_wait=lambda p: print(f"# waiting for {p}",
@@ -161,12 +171,9 @@ def main() -> int:
                     capture_output=True, text=True, check=True,
                 )
                 r = json.loads(out.stdout.strip().splitlines()[-1])
-                results.append((r["gbps"], tag))
+                results.append((r["gbps"], tag, tile, mc, engine, sbox,
+                                unroll))
                 digests.add(r["digest"])
-                name = _rankable_engine_name(engine, tile, mc, sbox, unroll)
-                if name is not None:
-                    best_by_engine[name] = max(
-                        best_by_engine.get(name, 0.0), r["gbps"])
                 platforms.add(r.get("platform", "unknown"))
                 print(f"{tag}  ->  {r['gbps']:7.3f} GB/s  "
                       f"digest={r['digest']:#010x}", flush=True)
@@ -183,16 +190,79 @@ def main() -> int:
     if results:
         best = max(results)
         print(f"\nBEST: {best[1]}  {best[0]:.3f} GB/s")
-        # Persist the per-engine ranking (best config per engine) so
-        # bench.py's probe order and resolve_engine("auto") start from this
-        # sweep's data (utils/ranking.py). Only when every config agreed on
+        # Persist the measurements — but only when every config agreed on
         # the platform: a sweep that straddled a mid-run CPU demotion would
         # otherwise record cross-platform numbers as one ranking.
         if len(platforms) == 1:
+            platform = platforms.pop()
             ranking = load_ranking()
-            if ranking.store(platforms.pop(), best_by_engine, "tune-sweep",
-                             args.bytes):
-                print(f"# ranking persisted to {ranking.path()}")
+            # The winning tile/MC come from Pallas-engine rows only
+            # (bitslice/jnp ignore OT_PALLAS_*, so a bitslice row winning
+            # overall must not persist a tile it never exercised), and only
+            # when at least two distinct (tile, MC) settings were actually
+            # compared there — a single-setting sweep proves nothing about
+            # the grid. These knobs are what later runs re-apply
+            # (pallas_aes.apply_stored_knobs), so the engine ranking below
+            # is attributed from rows at the SAME setting: ranking and
+            # knobs persist as one consistent, reproducible pair.
+            pallas_rows = [r for r in results if r[4].startswith("pallas")]
+            persist_knobs = (
+                pallas_rows
+                and len({(t, m) for _, _, t, m, _, _, _ in pallas_rows}) >= 2)
+            if persist_knobs:
+                _, _, ref_tile, ref_mc, _, _, _ = max(pallas_rows)
+            else:
+                # No knob comparison in this sweep: attribute at the
+                # setting production will actually APPLY — the stored
+                # knobs when they exist (a focused re-tune at the tuned
+                # setting then updates the ranking consistently), else
+                # the defaults.
+                stored_kn = ranking.knobs(platform)
+                ref_tile = stored_kn.get("tile", _DEFAULT_TILE)
+                ref_mc = stored_kn.get("mc", _DEFAULT_MC)
+            best_by_engine = {}
+            for gbps, _, tile, mc, engine, sbox, unroll in results:
+                name = _rankable_engine_name(engine, tile, mc, sbox, unroll,
+                                             ref_tile, ref_mc)
+                if name is not None:
+                    best_by_engine[name] = max(
+                        best_by_engine.get(name, 0.0), gbps)
+            # When the sweep's winning knobs DIFFER from what was stored,
+            # previously-ranked Pallas rows not re-measured in this sweep
+            # were measured under the old setting — store()'s merge would
+            # otherwise carry them into a ranking whose knobs record says
+            # something else (apples vs oranges). Drop them; engines that
+            # ignore the knobs keep their rows.
+            new_knobs = {"tile": ref_tile, "mc": ref_mc}
+            # "Changed" is measured against the setting prior rows were
+            # ACTUALLY measured under — stored knobs when present, else
+            # the defaults. A never-stored file whose rows were measured
+            # at the defaults must not count as changed when the winner IS
+            # the defaults (that would drop every valid row a fresh host's
+            # bench probe just ranked).
+            prev_kn = ranking.knobs(platform)
+            prev_setting = {"tile": prev_kn.get("tile", _DEFAULT_TILE),
+                            "mc": prev_kn.get("mc", _DEFAULT_MC)}
+            knobs_changed = persist_knobs and prev_setting != new_knobs
+            drop = [e for e in (ranking.order(platform) or [])
+                    if e.startswith("pallas") and e not in best_by_engine
+                    ] if knobs_changed else []
+            stored = ranking.store(platform, best_by_engine, "tune-sweep",
+                                   args.bytes, drop=drop)
+            if stored:
+                print(f"# ranking persisted to {ranking.path()} "
+                      f"(rows at tile={ref_tile} mc={ref_mc}"
+                      + (f"; dropped stale {drop}" if drop else "") + ")")
+            # Knobs persist only beside a successful ranking write: the two
+            # records are applied as a pair (apply_stored_knobs + "auto"
+            # selection), so a knob update without its matching ranking —
+            # e.g. a single-engine sweep, where store() refuses a one-row
+            # "ranking" — would re-apply new knobs while selection still
+            # runs on old-knob numbers.
+            if persist_knobs and stored and ranking.store_knobs(
+                    platform, new_knobs, "tune-sweep", args.bytes):
+                print(f"# tuned knobs persisted: tile={ref_tile} "
+                      f"mc={ref_mc}")
     return 0
 
 

@@ -5,28 +5,22 @@ tens of seconds on this class of host, so the test drives a single batch
 through both directions and both a 128- and 256-bit key, which covers the
 tile-padding path (n=33 -> one 32-block lane group + pad), the fori_loop
 round body, and the folded-schedule decrypt ordering.
+
+This module is the CORE third of the Pallas suite; the multi-grid engine
+gauntlets live in test_pallas_grid.py and the many-engine mode/long-key
+gauntlets in test_pallas_modes.py (VERDICT r3 weak #4/#8: the former
+single module outgrew per-module cache clearing and needed a per-test
+`jax.clear_caches()` hammer that recompiled shared references every test;
+the three-way split re-bounds XLA-CPU compiler state at module granularity
+with no hammer and no lost coverage).
 """
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
 from our_tree_tpu.models import aes as aes_mod
 from our_tree_tpu.ops.keyschedule import expand_key_dec, expand_key_enc
-
-
-@pytest.fixture(autouse=True)
-def _clear_caches_per_test():
-    """Interpreter-mode Pallas tests are the heaviest compilations in the
-    suite; with the round-3 engine-matrix additions the per-MODULE cache
-    clearing (tests/conftest.py) stopped bounding XLA-CPU's accumulated
-    compiler state — the gate run segfaulted in backend_compile partway
-    through this module (the crash class conftest documents). Per-test
-    clearing here keeps the footprint bounded; these tests compile fresh
-    shapes each time anyway, so nothing useful is evicted."""
-    yield
-    jax.clear_caches()
 
 
 @pytest.mark.parametrize("bits", [128, 192, 256])
@@ -152,164 +146,3 @@ def test_pallas_ctr_gen_matches_materialised():
     )
     np.testing.assert_array_equal(got_gen, want)
     np.testing.assert_array_equal(got_mat, want)
-
-
-@pytest.mark.slow
-def test_pallas_ctr_gen_multi_grid_step(monkeypatch):
-    """Counter synthesis across grid steps: with a 128-lane tile, 12288
-    blocks give a 3-step grid, so the in-kernel block index j = 32*(g*tile
-    + lane) + t must mix the program_id into the adder correctly for g > 0
-    (a bug there is invisible to single-tile tests)."""
-    from our_tree_tpu.ops import pallas_aes
-
-    monkeypatch.setattr(pallas_aes, "TILE", 128)
-    rng = np.random.default_rng(5)
-    nr, rk = expand_key_enc(bytes(range(16)))
-    rk = jnp.asarray(rk)
-    from our_tree_tpu.utils import packing
-
-    nonce = np.frombuffer(bytes(range(100, 116)), dtype=np.uint8)
-    ctr_be = jnp.asarray(packing.np_bytes_to_words(nonce).byteswap())
-    w = jnp.asarray(rng.integers(0, 2**32, (32 * 384, 4)).astype(np.uint32))
-    got = np.asarray(pallas_aes.ctr_crypt_words_gen(w, ctr_be, rk, nr))
-    want = np.asarray(aes_mod.ctr_crypt_words(w, ctr_be, rk, nr, "jnp"))
-    np.testing.assert_array_equal(got, want)
-
-
-@pytest.mark.slow
-def test_ctr_flat_stream_equals_block_words():
-    """ctr_crypt_words accepts a flat (4N,) u32 stream (the dense TPU
-    boundary layout — a (N, 4) boundary array pads its minor dim to the
-    128-lane tile) and must produce byte-identical output to the (N, 4)
-    form on every engine."""
-    from our_tree_tpu.utils import packing
-
-    rng = np.random.default_rng(17)
-    nr, rk = expand_key_enc(bytes(range(16)))
-    rk = jnp.asarray(rk)
-    nonce = np.frombuffer(bytes(range(50, 66)), dtype=np.uint8)
-    ctr_be = jnp.asarray(packing.np_bytes_to_words(nonce).byteswap())
-    data = rng.integers(0, 256, 16 * 77, np.uint8)
-    w2 = jnp.asarray(packing.np_bytes_to_words(data).reshape(-1, 4))
-    wf = jnp.asarray(packing.np_bytes_to_words(data))
-    for engine in ("jnp", "bitslice", "pallas", "pallas-gt", "pallas-gt-bp",
-                   "pallas-dense"):
-        o2 = np.asarray(aes_mod.ctr_crypt_words(w2, ctr_be, rk, nr, engine))
-        of = np.asarray(aes_mod.ctr_crypt_words(wf, ctr_be, rk, nr, engine))
-        assert of.shape == (4 * 77,)
-        np.testing.assert_array_equal(of.reshape(-1, 4), o2, err_msg=engine)
-
-
-@pytest.mark.slow
-def test_pallas_engine_ctr_context():
-    """The pallas core through the CTR mode path and the AES context."""
-    import numpy as np
-
-    from our_tree_tpu.models.aes import AES
-
-    data = np.random.default_rng(9).integers(0, 256, 16 * 40 + 7, np.uint8)
-    nonce = np.arange(16, dtype=np.uint8)
-    outs = {}
-    for engine in ("jnp", "pallas", "pallas-gt", "pallas-gt-bp",
-                   "pallas-dense"):
-        a = AES(bytes(range(16)), engine=engine)
-        outs[engine], *_ = a.crypt_ctr(0, nonce.copy(),
-                                       np.zeros(16, np.uint8), data)
-    for engine in ("pallas", "pallas-gt", "pallas-gt-bp", "pallas-dense"):
-        np.testing.assert_array_equal(outs["jnp"], outs[engine],
-                                      err_msg=engine)
-
-
-@pytest.mark.parametrize("keybytes", [24, 32])
-@pytest.mark.slow
-def test_pallas_kernels_long_keys(keybytes, monkeypatch):
-    """AES-192/256 (nr = 12/14) through both pallas engines: the kernels
-    unroll rounds with nr as a static parameter, so the nr > 10 straight-
-    line paths are distinct compiled code that AES-128-only tests never
-    touch (cf. the reference CUDA kernels' Nr>10/Nr>12 guard blocks,
-    aes-gpu/Source/AES.cu:342-365 — which no test there exercised either)."""
-    from our_tree_tpu.ops import pallas_aes
-    from our_tree_tpu.utils import packing
-
-    monkeypatch.setattr(pallas_aes, "TILE", 128)
-    rng = np.random.default_rng(41)
-    key = bytes(range(keybytes))
-    nr, rk = expand_key_enc(key)
-    rk = jnp.asarray(rk)
-    nonce = np.frombuffer(bytes(range(200, 216)), np.uint8)
-    ctr_be = jnp.asarray(packing.np_bytes_to_words(nonce).byteswap())
-    w = jnp.asarray(rng.integers(0, 2**32, (32 * 128, 4)).astype(np.uint32))
-    want_ctr = np.asarray(aes_mod.ctr_crypt_words(w, ctr_be, rk, nr, "jnp"))
-    want_ecb = np.asarray(aes_mod.ecb_encrypt_words(w, rk, nr, "jnp"))
-    for engine in ("pallas", "pallas-gt", "pallas-gt-bp"):
-        got = np.asarray(aes_mod.ctr_crypt_words(w, ctr_be, rk, nr, engine))
-        np.testing.assert_array_equal(got, want_ctr, err_msg=f"ctr {engine}")
-        got = np.asarray(aes_mod.ecb_encrypt_words(w, rk, nr, engine))
-        np.testing.assert_array_equal(got, want_ecb, err_msg=f"ecb {engine}")
-
-
-@pytest.mark.slow
-def test_pallas_dense_engine_matches_jnp(monkeypatch):
-    """Dense-boundary kernels ((128, W) layout, in-kernel ladder via
-    bitslice.transpose32_dense) vs the T-table core: ECB both directions
-    and counter-synthesising CTR (both S-box variants), 3-step grid, near-
-    wraparound nonce — the same gauntlet as the grouped twin below, since
-    the dense engine exists to replace it (VERDICT r2 #3)."""
-    from our_tree_tpu.ops import pallas_aes
-    from our_tree_tpu.utils import packing
-
-    monkeypatch.setattr(pallas_aes, "TILE", 128)
-    rng = np.random.default_rng(29)
-    nr, rk = expand_key_enc(bytes(range(16)))
-    rk = jnp.asarray(rk)
-    _, rk_dec = expand_key_dec(bytes(range(16)))
-    rk_dec = jnp.asarray(rk_dec)
-    nonce = np.frombuffer(
-        bytes.fromhex("00000000fffffffffffffffffffffff0"), np.uint8)
-    ctr_be = jnp.asarray(packing.np_bytes_to_words(nonce).byteswap())
-    w = jnp.asarray(rng.integers(0, 2**32, (32 * 384, 4)).astype(np.uint32))
-
-    got = np.asarray(pallas_aes.encrypt_words_dense(w, rk, nr))
-    want = np.asarray(aes_mod.ecb_encrypt_words(w, rk, nr, "jnp"))
-    np.testing.assert_array_equal(got, want)
-    back = np.asarray(
-        pallas_aes.decrypt_words_dense(jnp.asarray(got), rk_dec, nr))
-    np.testing.assert_array_equal(back, np.asarray(w))
-
-    want = np.asarray(aes_mod.ctr_crypt_words(w, ctr_be, rk, nr, "jnp"))
-    got = np.asarray(pallas_aes.ctr_crypt_words_dense(w, ctr_be, rk, nr))
-    np.testing.assert_array_equal(got, want)
-    got = np.asarray(pallas_aes.ctr_crypt_words_dense_bp(w, ctr_be, rk, nr))
-    np.testing.assert_array_equal(got, want)
-
-
-@pytest.mark.slow
-def test_pallas_gt_engine_matches_jnp(monkeypatch):
-    """Grouped-transpose kernels (in-kernel SWAR ladder) vs the T-table
-    core: ECB both directions and counter-synthesising CTR, with a 3-step
-    grid so the lane/program_id bookkeeping is exercised past tile 0."""
-    from our_tree_tpu.ops import pallas_aes
-    from our_tree_tpu.utils import packing
-
-    monkeypatch.setattr(pallas_aes, "TILE", 128)
-    rng = np.random.default_rng(23)
-    nr, rk = expand_key_enc(bytes(range(16)))
-    rk = jnp.asarray(rk)
-    _, rk_dec = expand_key_dec(bytes(range(16)))
-    rk_dec = jnp.asarray(rk_dec)
-    # Near-wraparound nonce: the in-kernel ripple adder must carry across
-    # words exactly like ctr_le_blocks.
-    nonce = np.frombuffer(
-        bytes.fromhex("00000000fffffffffffffffffffffff0"), np.uint8)
-    ctr_be = jnp.asarray(packing.np_bytes_to_words(nonce).byteswap())
-    w = jnp.asarray(rng.integers(0, 2**32, (32 * 384, 4)).astype(np.uint32))
-
-    got = np.asarray(pallas_aes.encrypt_words_gt(w, rk, nr))
-    want = np.asarray(aes_mod.ecb_encrypt_words(w, rk, nr, "jnp"))
-    np.testing.assert_array_equal(got, want)
-    back = np.asarray(pallas_aes.decrypt_words_gt(jnp.asarray(got), rk_dec, nr))
-    np.testing.assert_array_equal(back, np.asarray(w))
-
-    got = np.asarray(pallas_aes.ctr_crypt_words_gt(w, ctr_be, rk, nr))
-    want = np.asarray(aes_mod.ctr_crypt_words(w, ctr_be, rk, nr, "jnp"))
-    np.testing.assert_array_equal(got, want)

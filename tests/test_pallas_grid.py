@@ -1,0 +1,107 @@
+"""Multi-grid-step Pallas gauntlets (interpreter mode on CPU), all engines.
+
+Split out of test_pallas.py (VERDICT r3 weak #4/#8): these three gauntlets
+share the (32*384, 4) boundary shape and TILE=128, so the T-table reference
+compilations (jnp ECB encrypt + jnp fused CTR at that shape) are compiled
+ONCE here and reused across all three — under test_pallas.py's per-test
+`jax.clear_caches()` mitigation they were recompiled per test. Keeping the
+heaviest interpreter-mode compiles in their own module also re-bounds
+XLA-CPU's accumulated compiler state at module granularity (the crash class
+tests/conftest.py documents) without the per-test hammer.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from our_tree_tpu.models import aes as aes_mod
+from our_tree_tpu.ops.keyschedule import expand_key_dec, expand_key_enc
+
+
+@pytest.mark.slow
+def test_pallas_ctr_gen_multi_grid_step(monkeypatch):
+    """Counter synthesis across grid steps: with a 128-lane tile, 12288
+    blocks give a 3-step grid, so the in-kernel block index j = 32*(g*tile
+    + lane) + t must mix the program_id into the adder correctly for g > 0
+    (a bug there is invisible to single-tile tests)."""
+    from our_tree_tpu.ops import pallas_aes
+    from our_tree_tpu.utils import packing
+
+    monkeypatch.setattr(pallas_aes, "TILE", 128)
+    rng = np.random.default_rng(5)
+    nr, rk = expand_key_enc(bytes(range(16)))
+    rk = jnp.asarray(rk)
+
+    nonce = np.frombuffer(bytes(range(100, 116)), dtype=np.uint8)
+    ctr_be = jnp.asarray(packing.np_bytes_to_words(nonce).byteswap())
+    w = jnp.asarray(rng.integers(0, 2**32, (32 * 384, 4)).astype(np.uint32))
+    got = np.asarray(pallas_aes.ctr_crypt_words_gen(w, ctr_be, rk, nr))
+    want = np.asarray(aes_mod.ctr_crypt_words(w, ctr_be, rk, nr, "jnp"))
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.slow
+def test_pallas_dense_engine_matches_jnp(monkeypatch):
+    """Dense-boundary kernels ((128, W) layout, in-kernel ladder via
+    bitslice.transpose32_dense) vs the T-table core: ECB both directions
+    and counter-synthesising CTR (both S-box variants), 3-step grid, near-
+    wraparound nonce — the same gauntlet as the grouped twin below, since
+    the dense engine exists to replace it (VERDICT r2 #3)."""
+    from our_tree_tpu.ops import pallas_aes
+    from our_tree_tpu.utils import packing
+
+    monkeypatch.setattr(pallas_aes, "TILE", 128)
+    rng = np.random.default_rng(29)
+    nr, rk = expand_key_enc(bytes(range(16)))
+    rk = jnp.asarray(rk)
+    _, rk_dec = expand_key_dec(bytes(range(16)))
+    rk_dec = jnp.asarray(rk_dec)
+    nonce = np.frombuffer(
+        bytes.fromhex("00000000fffffffffffffffffffffff0"), np.uint8)
+    ctr_be = jnp.asarray(packing.np_bytes_to_words(nonce).byteswap())
+    w = jnp.asarray(rng.integers(0, 2**32, (32 * 384, 4)).astype(np.uint32))
+
+    got = np.asarray(pallas_aes.encrypt_words_dense(w, rk, nr))
+    want = np.asarray(aes_mod.ecb_encrypt_words(w, rk, nr, "jnp"))
+    np.testing.assert_array_equal(got, want)
+    back = np.asarray(
+        pallas_aes.decrypt_words_dense(jnp.asarray(got), rk_dec, nr))
+    np.testing.assert_array_equal(back, np.asarray(w))
+
+    want = np.asarray(aes_mod.ctr_crypt_words(w, ctr_be, rk, nr, "jnp"))
+    got = np.asarray(pallas_aes.ctr_crypt_words_dense(w, ctr_be, rk, nr))
+    np.testing.assert_array_equal(got, want)
+    got = np.asarray(pallas_aes.ctr_crypt_words_dense_bp(w, ctr_be, rk, nr))
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.slow
+def test_pallas_gt_engine_matches_jnp(monkeypatch):
+    """Grouped-transpose kernels (in-kernel SWAR ladder) vs the T-table
+    core: ECB both directions and counter-synthesising CTR, with a 3-step
+    grid so the lane/program_id bookkeeping is exercised past tile 0."""
+    from our_tree_tpu.ops import pallas_aes
+    from our_tree_tpu.utils import packing
+
+    monkeypatch.setattr(pallas_aes, "TILE", 128)
+    rng = np.random.default_rng(23)
+    nr, rk = expand_key_enc(bytes(range(16)))
+    rk = jnp.asarray(rk)
+    _, rk_dec = expand_key_dec(bytes(range(16)))
+    rk_dec = jnp.asarray(rk_dec)
+    # Near-wraparound nonce: the in-kernel ripple adder must carry across
+    # words exactly like ctr_le_blocks.
+    nonce = np.frombuffer(
+        bytes.fromhex("00000000fffffffffffffffffffffff0"), np.uint8)
+    ctr_be = jnp.asarray(packing.np_bytes_to_words(nonce).byteswap())
+    w = jnp.asarray(rng.integers(0, 2**32, (32 * 384, 4)).astype(np.uint32))
+
+    got = np.asarray(pallas_aes.encrypt_words_gt(w, rk, nr))
+    want = np.asarray(aes_mod.ecb_encrypt_words(w, rk, nr, "jnp"))
+    np.testing.assert_array_equal(got, want)
+    back = np.asarray(pallas_aes.decrypt_words_gt(jnp.asarray(got), rk_dec, nr))
+    np.testing.assert_array_equal(back, np.asarray(w))
+
+    got = np.asarray(pallas_aes.ctr_crypt_words_gt(w, ctr_be, rk, nr))
+    want = np.asarray(aes_mod.ctr_crypt_words(w, ctr_be, rk, nr, "jnp"))
+    np.testing.assert_array_equal(got, want)

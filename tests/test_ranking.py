@@ -243,3 +243,154 @@ def test_resolve_auto_compile_failure_falls_back(monkeypatch, tmp_path):
         assert calls == ["broken"]
     finally:
         aes_mod.PALLAS_BACKED.discard("fake-pallas")
+
+
+# -- tuned kernel knobs (store_knobs / knobs / apply_knobs) ----------------
+
+
+def test_store_knobs_round_trip(rank_file):
+    assert ranking.store_knobs("tpu:TPU v5e", {"tile": 2048, "mc": "roll"},
+                               "tune-sweep", 128 << 20)
+    assert ranking.knobs("tpu:TPU v5e") == {"tile": 2048, "mc": "roll"}
+    assert ranking.knobs("tpu:TPU v4") == {}  # keyed per device kind
+
+
+def test_knobs_validation_on_read(rank_file):
+    # A foreign/hand-edited file must never feed values pallas_aes's own
+    # import-time validation would reject: invalid tile (not a multiple of
+    # 128, or a bool), unknown MC lowering, unknown keys -> all dropped.
+    rank_file.write_text(json.dumps({"tpu": {"ranking": [], "knobs": {
+        "tile": 1000, "mc": "spin", "unroll": 4}}}))
+    assert ranking.knobs("tpu") == {}
+    rank_file.write_text(json.dumps({"tpu": {"ranking": [], "knobs": {
+        "tile": True, "mc": "roll"}}}))
+    assert ranking.knobs("tpu") == {"mc": "roll"}
+
+
+def test_store_knobs_rejects_all_invalid(rank_file):
+    assert ranking.store_knobs("tpu", {"tile": 7}, "t", 1) is False
+    assert not rank_file.exists()
+
+
+def test_ranking_store_preserves_knobs(rank_file):
+    # A later bench-probe ranking store must not delete the tune sweep's
+    # knob record — only store_knobs writes that field.
+    ranking.store_knobs("tpu", {"tile": 2048}, "tune-sweep", 1 << 20)
+    ranking.store("tpu", {"a": 2.0, "b": 1.0}, "bench-probe", 1 << 20)
+    assert ranking.knobs("tpu") == {"tile": 2048}
+    assert ranking.order("tpu") == ["a", "b"]
+
+
+def test_apply_knobs_sets_module_attrs(monkeypatch):
+    from our_tree_tpu.ops import pallas_aes
+
+    monkeypatch.setattr(pallas_aes, "TILE", 1024)
+    monkeypatch.setattr(pallas_aes, "MC_LOWERING", "perm")
+    monkeypatch.delenv("OT_PALLAS_TILE", raising=False)
+    monkeypatch.delenv("OT_PALLAS_MC", raising=False)
+    applied = pallas_aes.apply_knobs({"tile": 2048, "mc": "roll"})
+    assert applied == {"tile": 2048, "mc": "roll"}
+    assert pallas_aes.TILE == 2048 and pallas_aes.MC_LOWERING == "roll"
+    # Idempotent: equal values report nothing applied.
+    assert pallas_aes.apply_knobs({"tile": 2048, "mc": "roll"}) == {}
+
+
+def test_apply_knobs_respects_explicit_env(monkeypatch):
+    # An explicit OT_PALLAS_* pin outranks the stored measurement, same
+    # precedence as OT_BENCH_ENGINE over the engine ranking.
+    from our_tree_tpu.ops import pallas_aes
+
+    monkeypatch.setattr(pallas_aes, "TILE", 1024)
+    monkeypatch.setattr(pallas_aes, "MC_LOWERING", "perm")
+    monkeypatch.setenv("OT_PALLAS_TILE", "1024")
+    monkeypatch.delenv("OT_PALLAS_MC", raising=False)
+    applied = pallas_aes.apply_knobs({"tile": 2048, "mc": "roll"})
+    assert applied == {"mc": "roll"}
+    assert pallas_aes.TILE == 1024 and pallas_aes.MC_LOWERING == "roll"
+
+
+def test_apply_knobs_skips_invalid_values(monkeypatch):
+    # Defense on the apply side too: the source is a data file.
+    from our_tree_tpu.ops import pallas_aes
+
+    monkeypatch.setattr(pallas_aes, "TILE", 1024)
+    monkeypatch.setattr(pallas_aes, "MC_LOWERING", "perm")
+    monkeypatch.delenv("OT_PALLAS_TILE", raising=False)
+    monkeypatch.delenv("OT_PALLAS_MC", raising=False)
+    assert pallas_aes.apply_knobs({"tile": 1000, "mc": "spin"}) == {}
+    assert pallas_aes.TILE == 1024 and pallas_aes.MC_LOWERING == "perm"
+
+
+def test_apply_stored_knobs_by_device_kind(rank_file, monkeypatch, capsys):
+    # The one shared apply entry (bench.py / TpuBackend / resolve_engine
+    # "auto"): looks up by device kind, applies, reports once, idempotent.
+    from our_tree_tpu.ops import pallas_aes
+
+    class FakeDev:
+        platform = "tpu"
+        device_kind = "TPU v5e"
+
+    monkeypatch.setattr(pallas_aes, "TILE", 1024)
+    monkeypatch.setattr(pallas_aes, "MC_LOWERING", "perm")
+    monkeypatch.delenv("OT_PALLAS_TILE", raising=False)
+    monkeypatch.delenv("OT_PALLAS_MC", raising=False)
+    ranking.store_knobs("tpu:TPU v5e", {"tile": 2048, "mc": "roll"},
+                        "tune-sweep", 1 << 20)
+    assert pallas_aes.apply_stored_knobs(FakeDev()) == {
+        "tile": 2048, "mc": "roll"}
+    assert pallas_aes.TILE == 2048 and pallas_aes.MC_LOWERING == "roll"
+    assert "tuned knobs applied (tpu:TPU v5e)" in capsys.readouterr().err
+    # Second call: nothing newly applied, nothing printed.
+    assert pallas_aes.apply_stored_knobs(FakeDev()) == {}
+    assert capsys.readouterr().err == ""
+
+    class CpuDev:
+        platform = "cpu"
+        device_kind = "cpu"
+
+    # CPU is a hard no-op even with a (bogus) stored entry.
+    ranking.store_knobs("cpu", {"tile": 1920}, "t", 1)
+    monkeypatch.setattr(pallas_aes, "TILE", 1024)
+    assert pallas_aes.apply_stored_knobs(CpuDev()) == {}
+    assert pallas_aes.TILE == 1024
+
+
+def test_compile_failure_under_applied_knobs_not_persisted(monkeypatch,
+                                                           tmp_path):
+    """A lowering failure while NON-DEFAULT knobs are in effect — via env
+    OR via apply_stored_knobs, which sets no env vars — must stay
+    process-local: the failure may be the tuned config's fault, and a
+    persisted drop would exile an engine that lowers fine under defaults
+    (code-review r4 finding on the stored-knob bypass of the override
+    guard)."""
+    import jax
+
+    from our_tree_tpu.models import aes as aes_mod
+    from our_tree_tpu.ops import pallas_aes
+
+    p = tmp_path / "engine_ranking.json"
+    monkeypatch.setenv("OT_ENGINE_RANKING", str(p))
+    for k in ("OT_PALLAS_TILE", "OT_PALLAS_MC", "OT_SBOX",
+              "OT_BITSLICE_UNROLL"):
+        monkeypatch.delenv(k, raising=False)
+    # Simulate stored knobs having been applied: effective config differs
+    # from the import defaults with no env var involved.
+    monkeypatch.setattr(pallas_aes, "TILE", 2048)
+
+    def broken(words, rk, nr):
+        raise RuntimeError("Mosaic lowering failed (simulated)")
+
+    monkeypatch.setitem(aes_mod.CORES, "fake-pallas", (broken, broken))
+    aes_mod.PALLAS_BACKED.add("fake-pallas")
+    monkeypatch.setattr(aes_mod, "_COMPILE_OK", {})
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(pallas_aes, "interpret_mode", lambda: False)
+    monkeypatch.setattr(ranking, "DEFAULT_ORDER",
+                        ("fake-pallas", "bitslice"))
+    monkeypatch.setattr(
+        ranking, "device_key", lambda *a, **k: "tpu:TPU test")
+    try:
+        assert aes_mod.resolve_engine("auto") == "bitslice"  # fell back...
+        assert ranking.dropped("tpu:TPU test") == set()  # ...no durable drop
+    finally:
+        aes_mod.PALLAS_BACKED.discard("fake-pallas")

@@ -1,0 +1,47 @@
+"""Ranking attribution rules of the tuning sweep (scripts/tune_tpu.py).
+
+The persisted engine ranking must only hold rows the production path can
+reproduce — since round 4 that means rows measured at the knob setting the
+sweep persists (pallas_aes.apply_stored_knobs re-applies it everywhere),
+with engines that ignore the Pallas knobs attributable from any row. These
+tests pin the attribution function directly; the sweep's subprocess grid is
+exercised on hardware by the watcher plan.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts"))
+
+from tune_tpu import _rankable_engine_name  # noqa: E402
+
+
+def test_pallas_rows_attributed_only_at_ref_knobs():
+    assert _rankable_engine_name(
+        "pallas-dense", 2048, "roll", "tower", "1", 2048, "roll"
+    ) == "pallas-dense"
+    # Off-reference tile or MC: not reproducible by the applied config.
+    assert _rankable_engine_name(
+        "pallas-dense", 1024, "roll", "tower", "1", 2048, "roll") is None
+    assert _rankable_engine_name(
+        "pallas-dense", 2048, "perm", "tower", "1", 2048, "roll") is None
+
+
+def test_knob_blind_engines_attributed_from_any_row():
+    # bitslice ignores OT_PALLAS_TILE/MC — every such row measures the
+    # identical code, so any (tile, mc) qualifies...
+    assert _rankable_engine_name(
+        "bitslice", 512, "perm", "tower", "1", 2048, "roll") == "bitslice"
+    # ...but unroll IS read by bitslice and nothing re-applies it.
+    assert _rankable_engine_name(
+        "bitslice", 512, "perm", "tower", "2", 2048, "roll") is None
+
+
+def test_bp_sbox_maps_to_registered_bp_engine():
+    assert _rankable_engine_name(
+        "pallas-gt", 1024, "perm", "bp", "1", 1024, "perm"
+    ) == "pallas-gt-bp"
+    # No registered bp twin (no Boyar-Peralta bitslice engine): dropped.
+    assert _rankable_engine_name(
+        "bitslice", 1024, "perm", "bp", "1", 1024, "perm") is None
