@@ -1,73 +1,109 @@
 #!/usr/bin/env python
 """Component timing for the north-star CTR path (run on TPU).
 
-Separates: full models/aes.py CTR path, the fused Pallas kernel alone
-(planes pre-made), plane transposition, counter materialisation — so
-optimization effort goes where the time is.
+Separates: full models/aes.py CTR paths (per engine), the fused Pallas
+kernels alone (planes pre-made), plane transposition, counter
+materialisation — so optimization effort goes where the time is.
 
 Timing uses bench.py's chained methodology: K iterations chained inside
 one jit via a carry that perturbs the input (so XLA cannot hoist/CSE the
 work) and a scalar sum-digest readback (so completion is real even on
 async/tunnelled platforms where block_until_ready returns early); the
 reported time is T(1+K) - T(1), cancelling per-call overhead.
+
+Each component runs in its OWN sequential subprocess (the smoke_tpu /
+tune_tpu pattern): the first hardware run of this profile crashed the
+axon TPU worker on its first component ("TPU worker process crashed or
+restarted ... kernel fault", round 4), and a PJRT client whose worker
+died cannot recover in-process — every later component would have
+reported the same UNAVAILABLE. Isolated children turn one crash into one
+CRASHED row while the other 12 components still measure; the per-child
+setup cost (re-staging the buffer) is seconds against a wedge-resistant
+profile. The parent stays jax-free and holds the devlock for manual runs
+(under the watcher the plan's own marker already serializes).
 """
+import argparse
+import json
 import os
+import subprocess
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
-import numpy as np
-
-import jax
-import jax.numpy as jnp
-
-from our_tree_tpu.models import aes as aes_mod
-from our_tree_tpu.models.aes import AES
-from our_tree_tpu.ops import bitslice, pallas_aes
-from our_tree_tpu.utils import packing
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 NBYTES = int(os.environ.get("OT_PROF_BYTES", 128 << 20))
 ITERS = int(os.environ.get("OT_PROF_ITERS", 5))
 
-
-def chained_time(fn, x, *rest, iters=ITERS):
-    """T(1+iters) - T(1) for out = fn(x ^ acc, *rest), acc = sum(out)."""
-
-    @jax.jit
-    def chain(x, k, *rest):
-        def body(_, acc):
-            out = fn(x ^ acc, *rest)
-            return jnp.sum(out, dtype=jnp.uint32)
-
-        return jax.lax.fori_loop(jnp.uint32(0), k, body, jnp.uint32(0))
-
-    def run(k):
-        t0 = time.perf_counter()
-        int(chain(x, jnp.uint32(k), *rest))
-        return time.perf_counter() - t0
-
-    run(1)
-    t1 = min(run(1) for _ in range(2))
-    tk = min(run(1 + iters) for _ in range(2))
-    return max(tk - t1, 1e-9) / iters
-
-
-def report(name, t, gb=None):
-    rate = f"  {gb/t:7.2f} GB/s" if gb else ""
-    print(f"{name:28s}: {t*1e3:8.2f} ms{rate}")
+#: Component registry: name -> human label. Order = report order; the
+#: engine-reference rows using the T-table/XLA paths go LAST so a crash
+#: there (the observed axon worker fault) cannot shadow the kernel rows.
+COMPONENTS = [
+    ("ctr-flat-auto", "full ctr (flat, production engine)"),
+    ("ctr-gt-full", "full ctr (pallas-gt)"),
+    ("ctr-dense-full", "full ctr (pallas-dense)"),
+    ("counter-mat", "counter materialisation"),
+    ("to-planes", "to_planes (one stream)"),
+    ("from-planes", "from_planes"),
+    ("ctr-kernel", "fused CTR kernel alone"),
+    ("ecb-kernel", "ecb kernel alone"),
+    ("ecb-dec-kernel", "ecb decrypt kernel alone"),
+    ("ctr-gt-kernel", "ctr-gt kernel alone"),
+    ("ctr-gt-bp-kernel", "ctr-gt-bp kernel alone"),
+    ("ctr-dense-kernel", "ctr-dense kernel alone"),
+    ("ctr-dense-bp-kernel", "ctr-dense-bp kernel alone"),
+    ("ctr-flat-jnp", "full ctr (flat, jnp T-table ref)"),
+    ("ctr-n4-jnp", "full ctr ((N,4), jnp T-table ref)"),
+]
 
 
-def main():
+def child(component: str) -> int:
+    """Measure ONE component and print a JSON line."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    sys.path.insert(0, REPO)
+    from our_tree_tpu.models import aes as aes_mod
+    from our_tree_tpu.models.aes import AES
+    from our_tree_tpu.ops import bitslice, pallas_aes
+    from our_tree_tpu.utils import packing
+
+    # Profile the PRODUCTION config: stored tuned knobs (tile/MC) applied
+    # exactly like bench.py / TpuBackend / resolve_engine("auto") do.
+    pallas_aes.apply_stored_knobs()
+
+    def chained_time(fn, x, *rest, iters=ITERS):
+        """T(1+iters) - T(1) for out = fn(x ^ acc, *rest), acc = sum(out)."""
+
+        @jax.jit
+        def chain(x, k, *rest):
+            def body(_, acc):
+                out = fn(x ^ acc, *rest)
+                return jnp.sum(out, dtype=jnp.uint32)
+
+            return jax.lax.fori_loop(jnp.uint32(0), k, body, jnp.uint32(0))
+
+        def run(k):
+            t0 = time.perf_counter()
+            int(chain(x, jnp.uint32(k), *rest))
+            return time.perf_counter() - t0
+
+        run(1)
+        t1 = min(run(1) for _ in range(2))
+        tk = min(run(1 + iters) for _ in range(2))
+        return max(tk - t1, 1e-9) / iters
+
     a = AES(bytes(range(16)))
     host = np.random.default_rng(1337).integers(0, 256, NBYTES, dtype=np.uint8)
     host_words = packing.np_bytes_to_words(host)
     flat = jax.device_put(jnp.asarray(host_words))          # dense layout
     words = jax.device_put(jnp.asarray(host_words.reshape(-1, 4)))  # padded
     nonce = np.frombuffer(bytes(range(16)), np.uint8)
-    ctr_be = jax.device_put(jnp.asarray(packing.np_bytes_to_words(nonce).byteswap()))
+    ctr_be = jax.device_put(
+        jnp.asarray(packing.np_bytes_to_words(nonce).byteswap()))
     n = words.shape[0]
-    gb = NBYTES / 1e9
     # The raw _*_planes_pallas helpers are called below with pre-made plane
     # tiles and no padding of their own, so pad the block batch exactly the
     # way every production entry point does (_lane_pad_and_tile) — the
@@ -78,109 +114,153 @@ def main():
     if pad:
         kwords = jnp.concatenate(
             [words, jnp.zeros((pad, 4), words.dtype)], axis=0)
-    print(f"# {NBYTES >> 20} MiB, {n} blocks, tile={tile}, "
-          f"device={jax.devices()[0].platform}")
 
-    t = chained_time(
-        lambda c, w, rk: aes_mod.ctr_crypt_words(w, c, rk, 10), ctr_be, flat,
-        a.rk_enc)
-    report("full ctr (flat boundary)", t, gb)
+    def full_ctr(engine):
+        return chained_time(
+            lambda c, w, rk: aes_mod.ctr_crypt_words(w, c, rk, 10, engine),
+            ctr_be, flat, a.rk_enc)
 
-    t = chained_time(
-        lambda c, w, rk: aes_mod.ctr_crypt_words(w, c, rk, 10), ctr_be, words,
-        a.rk_enc)
-    report("full ctr ((N,4) boundary)", t, gb)
+    engine = None
+    if component == "ctr-flat-auto":
+        engine = aes_mod.resolve_engine("auto")
+        t = full_ctr(engine)
+    elif component == "ctr-gt-full":
+        t = full_ctr("pallas-gt")
+    elif component == "ctr-dense-full":
+        t = full_ctr("pallas-dense")
+    elif component == "ctr-flat-jnp":
+        t = full_ctr("jnp")
+    elif component == "ctr-n4-jnp":
+        t = chained_time(
+            lambda c, w, rk: aes_mod.ctr_crypt_words(w, c, rk, 10, "jnp"),
+            ctr_be, words, a.rk_enc)
+    elif component == "counter-mat":
+        idx = jnp.arange(n + pad, dtype=jnp.uint32)
+        t = chained_time(lambda c: aes_mod.ctr_le_blocks(c, idx), ctr_be)
+    elif component == "to-planes":
+        t = chained_time(bitslice.to_planes, kwords)
+    elif component == "from-planes":
+        planes = jax.jit(bitslice.to_planes)(kwords)
+        t = chained_time(bitslice.from_planes, planes)
+    else:
+        # Kernel-alone components: pre-made inputs, pallas_call only.
+        idx = jnp.arange(n + pad, dtype=jnp.uint32)
+        kp = jax.jit(lambda rk: bitslice.key_planes(rk, 10))(a.rk_enc)
+        if component == "ctr-kernel":
+            ctr_le = jax.jit(lambda c: aes_mod.ctr_le_blocks(c, idx))(ctr_be)
+            ctr_planes = jax.jit(bitslice.to_planes)(ctr_le)
+            planes = jax.jit(bitslice.to_planes)(kwords)
+            t = chained_time(
+                lambda cp, dp, kp: pallas_aes._ctr_planes_pallas(
+                    cp, dp, kp, nr=10, tile=tile,
+                    mc=pallas_aes.MC_LOWERING),
+                ctr_planes, planes, kp)
+        elif component in ("ecb-kernel", "ecb-dec-kernel"):
+            planes = jax.jit(bitslice.to_planes)(kwords)
+            t = chained_time(
+                lambda dp, kp: pallas_aes._crypt_planes_pallas(
+                    dp, kp, nr=10, decrypt=(component == "ecb-dec-kernel"),
+                    tile=tile, mc=pallas_aes.MC_LOWERING),
+                planes, kp)
+        elif component in ("ctr-gt-kernel", "ctr-gt-bp-kernel",
+                           "ctr-dense-kernel", "ctr-dense-bp-kernel"):
+            layout = "grouped" if "gt" in component else "dense"
+            sbox = "bp" if "-bp-" in component else None
+            pre = (bitslice.group_words if layout == "grouped"
+                   else bitslice.dense_words)
+            x = jax.jit(pre)(kwords)
+            base = jax.jit(pallas_aes._base_bit_masks)(ctr_be)
+            t = chained_time(
+                lambda g, b, kp: pallas_aes._ctr_gen_planes_pallas(
+                    g, b, kp, nr=10, tile=tile, layout=layout, sbox=sbox,
+                    mc=pallas_aes.MC_LOWERING),
+                x, base, kp)
+        else:
+            print(json.dumps({"component": component,
+                              "error": "unknown component"}))
+            return 2
+    d = jax.devices()[0]
+    print(json.dumps({"component": component, "sec": t, "tile": tile,
+                      "mc": pallas_aes.MC_LOWERING, "engine": engine,
+                      "platform": d.platform,
+                      "device_kind": getattr(d, "device_kind", None)}))
+    return 0
 
-    # Kernel-alone components run on the padded batch (kwords), matching the
-    # block count and tile the production entry points hand the kernels.
-    idx = jnp.arange(n + pad, dtype=jnp.uint32)
-    t = chained_time(lambda c: aes_mod.ctr_le_blocks(c, idx), ctr_be)
-    report("counter materialisation", t)
 
-    t = chained_time(bitslice.to_planes, kwords)
-    report("to_planes (one stream)", t)
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--component", help="(internal) run one component")
+    ap.add_argument("--timeout", type=float,
+                    default=float(os.environ.get("OT_PROF_TIMEOUT", 240.0)),
+                    help="per-component subprocess timeout (healthy "
+                         "children finish in ~60-90s incl. compile)")
+    ap.add_argument("--budget", type=float,
+                    default=float(os.environ.get("OT_PROF_BUDGET", 1500.0)),
+                    help="total wall budget; children that would not fit "
+                         "are SKIPPED with partial rows reported — sized "
+                         "under recover_watch's 1800s outer kill so a "
+                         "wedged tunnel yields partial data, not a "
+                         "SIGKILLed step retried from scratch")
+    args = ap.parse_args()
+    if args.component:
+        return child(args.component)
 
-    planes = jax.jit(bitslice.to_planes)(kwords)
-    t = chained_time(bitslice.from_planes, planes)
-    report("from_planes", t)
+    from _devlock_loader import load_devlock
 
-    ctr_le = jax.jit(lambda c: aes_mod.ctr_le_blocks(c, idx))(ctr_be)
-    ctr_planes = jax.jit(bitslice.to_planes)(ctr_le)
-    kp = jax.jit(lambda rk: bitslice.key_planes(rk, 10))(a.rk_enc)
-    t = chained_time(
-        lambda cp, dp, kp: pallas_aes._ctr_planes_pallas(cp, dp, kp, nr=10,
-                                                         tile=tile),
-        ctr_planes, planes, kp)
-    report("fused CTR kernel alone", t, gb)
-
-    t = chained_time(
-        lambda dp, kp: pallas_aes._crypt_planes_pallas(dp, kp, nr=10,
-                                                       decrypt=False,
-                                                       tile=tile),
-        planes, kp)
-    report("ecb kernel alone", t, gb)
-
-    t = chained_time(
-        lambda dp, kp: pallas_aes._crypt_planes_pallas(dp, kp, nr=10,
-                                                       decrypt=True,
-                                                       tile=tile),
-        planes, kp)
-    report("ecb decrypt kernel alone", t, gb)
-
-    # Grouped-transpose ("pallas-gt") components: the relayout that replaces
-    # to/from_planes, and the kernels that run the SWAR ladder in VMEM.
-    t = chained_time(
-        lambda c, w, rk: aes_mod.ctr_crypt_words(w, c, rk, 10, "pallas-gt"),
-        ctr_be, flat, a.rk_enc)
-    report("full ctr (pallas-gt)", t, gb)
-
-    # The group/ungroup relayouts cannot be timed standalone: the chained
-    # digest is a permutation-invariant sum, so XLA deletes a bare
-    # transpose entirely (sum∘perm == sum). Their cost is the difference
-    # between "full ctr (pallas-gt)" and "ctr-gt kernel alone" — the
-    # pallas_call is opaque to XLA, so relayouts feeding it are real.
-    grouped = jax.jit(bitslice.group_words)(kwords)
-    base = jax.jit(pallas_aes._base_bit_masks)(ctr_be)
-    t = chained_time(
-        lambda g, b, kp: pallas_aes._ctr_gen_planes_pallas(
-            g, b, kp, nr=10, tile=tile, layout="grouped"),
-        grouped, base, kp)
-    report("ctr-gt kernel alone", t, gb)
-
-    # Same kernel with the Boyar–Peralta S-box circuit (engine
-    # "pallas-gt-bp"): the difference vs "ctr-gt kernel alone" is the
-    # measured value of the 217→162-unit round-arithmetic cut with
-    # everything else held identical — the cleanest view of the tower/BP
-    # A/B, uncontaminated by boundary relayouts.
-    t = chained_time(
-        lambda g, b, kp: pallas_aes._ctr_gen_planes_pallas(
-            g, b, kp, nr=10, tile=tile, layout="grouped", sbox="bp"),
-        grouped, base, kp)
-    report("ctr-gt-bp kernel alone", t, gb)
-
-    # Dense (128, W) boundary components ("pallas-dense"): same kernel
-    # structure as gt minus the grouped layout's 2x sublane-padding tax.
-    # full-vs-kernel-alone difference = the dense relayout's cost; the
-    # gt-vs-dense kernel-alone difference = the padding tax + ladder-form
-    # scheduling delta, the A/B the layout decision rides on.
-    t = chained_time(
-        lambda c, w, rk: aes_mod.ctr_crypt_words(w, c, rk, 10,
-                                                 "pallas-dense"),
-        ctr_be, flat, a.rk_enc)
-    report("full ctr (pallas-dense)", t, gb)
-
-    dense = jax.jit(bitslice.dense_words)(kwords)
-    t = chained_time(
-        lambda d, b, kp: pallas_aes._ctr_gen_planes_pallas(
-            d, b, kp, nr=10, tile=tile, layout="dense"),
-        dense, base, kp)
-    report("ctr-dense kernel alone", t, gb)
-
-    t = chained_time(
-        lambda d, b, kp: pallas_aes._ctr_gen_planes_pallas(
-            d, b, kp, nr=10, tile=tile, layout="dense", sbox="bp"),
-        dense, base, kp)
-    report("ctr-dense-bp kernel alone", t, gb)
+    gb = NBYTES / 1e9
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
+    devlock = load_devlock()
+    failures = 0
+    t_start = time.time()
+    header_done = False
+    with devlock.hold(wait_budget_s=900.0,
+                      on_wait=lambda p: print(f"# waiting for {p}",
+                                              file=sys.stderr)):
+        print(f"# {NBYTES >> 20} MiB, iters={ITERS}, one subprocess per "
+              f"component, {args.timeout:.0f}s each within a "
+              f"{args.budget:.0f}s budget")
+        for name, label in COMPONENTS:
+            left = args.budget - (time.time() - t_start)
+            if left < min(args.timeout, 120.0):
+                print(f"{label:36s}: SKIPPED (budget exhausted, "
+                      f"{left:.0f}s left)", flush=True)
+                continue
+            try:
+                out = subprocess.run(
+                    [sys.executable, "-u", os.path.abspath(__file__),
+                     "--component", name],
+                    timeout=min(args.timeout, left),
+                    capture_output=True, text=True,
+                )
+                if out.returncode != 0:
+                    err_lines = (out.stderr or "").strip().splitlines()
+                    raise RuntimeError(
+                        err_lines[-1] if err_lines
+                        else f"rc={out.returncode}, empty stderr")
+                r = json.loads(out.stdout.strip().splitlines()[-1])
+                t = r["sec"]
+                if not header_done:
+                    # Provenance once, from the first successful child —
+                    # the hwlog artifact must say which config measured.
+                    print(f"# tile={r.get('tile')} mc={r.get('mc')} "
+                          f"device={r.get('platform')}/"
+                          f"{r.get('device_kind')}", flush=True)
+                    header_done = True
+                eng = f" [{r['engine']}]" if r.get("engine") else ""
+                # GB/s only for rows that stream the whole buffer.
+                rate = (f"  {gb / t:7.2f} GB/s"
+                        if not name.startswith(("counter-",)) else "")
+                print(f"{label:36s}: {t * 1e3:8.2f} ms{rate}{eng}",
+                      flush=True)
+            except subprocess.TimeoutExpired:
+                failures += 1
+                print(f"{label:36s}: TIMEOUT ({args.timeout:.0f}s)",
+                      flush=True)
+            except Exception as e:
+                failures += 1
+                print(f"{label:36s}: CRASHED ({str(e)[:160]})", flush=True)
+    # Partial success is success: the rows that measured are the artifact.
+    return 0 if failures < len(COMPONENTS) else 1
 
 
 if __name__ == "__main__":
