@@ -50,12 +50,6 @@ import numpy as np
 #: aesenc share latency/throughput on that hardware), so its ECB bar is
 #: the nearest honest comparator for ecb-dec rather than a cross-mode one.
 BASELINES = {"ctr": 0.520, "ecb": 0.551, "ecb-dec": 0.551}
-#: Probe buffer: 64 MiB, not smaller — at 4 MiB fixed dispatch overheads
-#: dominate and the ranking inverts (the probe picked pallas over
-#: pallas-gt, which is 3.6x faster at headline sizes; measured round 2).
-#: At 64 MiB the per-byte regime has set in while a probe still costs
-#: ~compile + a few hundred ms.
-PROBE_BYTES = 64 << 20
 DEADLINE_S = float(os.environ.get("OT_BENCH_DEADLINE", 1200))
 INIT_TIMEOUT_S = float(os.environ.get("OT_BENCH_INIT_TIMEOUT", 240))
 #: Measured operation. "ctr" is the north-star metric; "ecb" / "ecb-dec"
@@ -183,9 +177,16 @@ def _stage_budget(preferred: float) -> float:
     return max(1.0, min(preferred, _left() - 5.0))
 
 
+def _env_bytes(default: int) -> int:
+    """OT_BENCH_BYTES (with `default`), 16-byte aligned — the ONE parse all
+    three size sites (probe, headline, native-CPU fallback) share, so they
+    cannot drift into probing a different size than they measure."""
+    n = int(os.environ.get("OT_BENCH_BYTES", default))
+    return max(16, n - n % 16)
+
+
 def _native_cpu_bytes() -> int:
-    n = int(os.environ.get("OT_BENCH_BYTES", 256 << 20))
-    return n - n % 16
+    return _env_bytes(256 << 20)
 
 
 def _measure_native_cpu(nbytes: int, iters: int):
@@ -492,6 +493,26 @@ def _measure_and_report() -> None:
     # generation's VPU/Mosaic compiler prefers. Probes stop early if the
     # deadline budget runs short.
     probes, probe_digests = {}, {}
+    # Probe in the headline's size regime: min(intended headline, 256 MiB)
+    # — equal to the headline below the cap, so selection fidelity is
+    # exact there, and 256 MiB above it, which measures in the same
+    # regime as 1 GiB. Floors and history: at 4 MiB fixed dispatch
+    # overheads dominate and the ranking inverts (round 2: the probe
+    # picked pallas over pallas-gt, 3.6x faster at headline sizes); at
+    # 64 MiB it inverts AGAIN vs the large regime (round 4, after the
+    # dense relayout fix: dense-bp 6.0 vs gt-bp 6.7 at 64 MiB, then 22.5
+    # vs 5.8 at 256 MiB — picking by the 64 MiB order would cost the
+    # headline a factor ~3). Probe cost is compile-dominated, so the
+    # larger buffer adds little wall time; the persisted ranking names
+    # the size measured (store()'s nbytes field). The intended size is
+    # read optimistically before the engine is chosen: env override,
+    # else the 256 MiB throughput-engine default. The non-flat (N, 4)
+    # A/B layout mirrors the headline's 128 MiB HBM cap (~32x minor-dim
+    # padding; see default_bytes below) — without it every probe would
+    # OOM device-side and the A/B would silently fall back to jnp.
+    probe_bytes = min(_env_bytes(256 << 20), 256 << 20)
+    if not flat:
+        probe_bytes = min(probe_bytes, 128 << 20)
     if requested == "probe" and platform != "cpu":
         # Probe order = expected-winner first: when the deadline budget cuts
         # the probe stage short, it trims the least likely winners, not the
@@ -526,7 +547,7 @@ def _measure_and_report() -> None:
                 # A probe is cheap when healthy; a hung one must not eat the
                 # other engines' chance — bound it well under the deadline.
                 probes[eng], probe_digests[eng] = measure(
-                    eng, PROBE_BYTES, 2,
+                    eng, probe_bytes, 2,
                     stage_budget=max(60.0, min(_left() / 2.0,
                                                0.15 * DEADLINE_S)))
             except Exception as e:  # an engine failing to compile is data
@@ -554,7 +575,7 @@ def _measure_and_report() -> None:
         # Digest-dissenting engines are passed as drops so store()'s merge
         # cannot resurrect their stale entries from a previous run.
         if OP == "ctr" and ranking.store(rank_key, probes, "bench-probe",
-                                         PROBE_BYTES, drop=digest_dropped):
+                                         probe_bytes, drop=digest_dropped):
             print(f"# ranking persisted to {ranking.path()}", file=sys.stderr)
     else:
         engine = aes_mod.resolve_engine(
@@ -572,13 +593,12 @@ def _measure_and_report() -> None:
         default_bytes = min(default_bytes, 128 << 20)
     if platform == "cpu":
         default_bytes = min(default_bytes, 64 << 20)
-    nbytes = int(os.environ.get("OT_BENCH_BYTES", default_bytes))
-    nbytes -= nbytes % 16
+    nbytes = _env_bytes(default_bytes)
 
     # Degraded fallback = the probe's own measurement, digest included (the
     # digest is the guard against silently-skipped work; 0 would defeat it).
     gbps, digest = probes.get(engine, 0.0), probe_digests.get(engine, 0)
-    measured_bytes = PROBE_BYTES
+    measured_bytes = probe_bytes
     if _left() > 0.25 * DEADLINE_S or not probes:
         try:
             gbps, digest = measure(engine, nbytes, iters)

@@ -870,17 +870,29 @@ def dense_words(words: jnp.ndarray) -> jnp.ndarray:
     logical bytes. Pure relayout (no bit math), same information as
     group_words; transpose32_dense runs the SWAR ladder directly on this
     form inside a kernel.
+
+    Implementation note (round-4 hardware OOM): the obvious composition
+    reshape(W, 32, 4) -> transpose(1, 2, 0) -> reshape(128, W) materialises
+    a (W, 32, 4) stage tensor whose 4-wide minor dim pads to the 128-lane
+    tile — 32x the logical bytes (a 1000 MiB buffer asked for a 32 GiB
+    allocation on the 16 GiB v5e: "Allocation would exceed memory ...
+    shape = u32[2048000,32,4]{2,1,0:T(8,128)}", docs/hwlogs/corpus.log —
+    the failure that broke both the 1 GiB headline step and the corpus
+    sweep). Row 4t+c, lane l of the dense form is flat-stream element
+    128*l + 4t + c, so the SAME mapping is one reshape to (W, 128) — dense
+    under tiling in BOTH dims — and one transpose between two dense tiled
+    layouts: no intermediate with a padded minor dim anywhere.
     """
     n = words.shape[0]
-    return words.reshape(n // 32, 32, 4).transpose(1, 2, 0).reshape(
-        128, n // 32)
+    return words.reshape(n // 32, 128).T
 
 
 def undense_words(d: jnp.ndarray) -> jnp.ndarray:
     """(128, W) dense grouped layout -> (32*W, 4) u32 words
-    (dense_words⁻¹)."""
+    (dense_words⁻¹). Same padded-intermediate avoidance as dense_words:
+    transpose first (dense->dense), then reshape."""
     w = d.shape[1]
-    return d.reshape(32, 4, w).transpose(2, 0, 1).reshape(32 * w, 4)
+    return d.T.reshape(32 * w, 4)
 
 
 def transpose32_dense(a: jnp.ndarray) -> jnp.ndarray:
