@@ -384,10 +384,23 @@ def _cbc_decrypt_words_impl(words, iv_words, rk_dec, nr, engine="jnp"):
     # Parallel: P_i = D(C_i) ^ C_{i-1} (C_{-1} = IV). Reference does this
     # serially (aes.c:782-796); the dependency chain only involves ciphertext,
     # so the TPU version is one batched decrypt + shifted XOR.
-    w2 = _as_block_words(words)
-    prev = jnp.concatenate([iv_words[None, :], w2[:-1]], axis=0)
-    out = CORES[engine][1](w2, rk_dec, nr) ^ prev
-    return out.reshape(words.shape), w2[-1]
+    #
+    # The shifted-prev stream is built in the CALLER's boundary layout: on
+    # a flat (4N,) stream the concat stays flat (minor dim 4N — dense
+    # under tiling), where an (N, 4) prev tensor materialises with its
+    # 4-wide minor dim padded to the 128-lane tile, 32x the logical bytes
+    # — the round-4 corpus OOM at 1000 MiB (docs/hwlogs/corpus.log class,
+    # second instance; cf. ops/bitslice.py:dense_words).
+    # One always-flat form for both call layouts: the internal reshape
+    # fuses (same reasoning as _as_block_words), the shift/concat keeps a
+    # 4N-wide minor dim for (N, 4) callers too, and the engine call goes
+    # through the models-level entry — the layer that accepts the flat
+    # stream for EVERY engine (raw CORES callables are only uniform over
+    # (N, 4)).
+    flat = words.reshape(-1)
+    prev = jnp.concatenate([iv_words, flat[:-4]])
+    out = ecb_decrypt_words(flat, rk_dec, nr, engine) ^ prev
+    return out.reshape(words.shape), flat[-4:]
 
 
 def cbc_decrypt_words(words, iv_words, rk_dec, nr, engine="jnp"):
@@ -411,10 +424,13 @@ def cfb128_encrypt_words(words, iv_words, rk, nr):
 @functools.partial(jax.jit, static_argnums=(3, 4))
 def cfb128_decrypt_words(words, iv_words, rk, nr, engine="jnp"):
     # Keystream block i = E(C_{i-1}) — all known up front, so parallel.
-    w2 = _as_block_words(words)
-    prev = jnp.concatenate([iv_words[None, :], w2[:-1]], axis=0)
-    out = w2 ^ CORES[engine][0](prev, rk, nr)
-    return out.reshape(words.shape), w2[-1]
+    # Always-flat shift + models-level engine entry, same rationale as
+    # _cbc_decrypt_words_impl (a flat concat stays dense; an (N, 4) one
+    # pads its minor dim 32x).
+    flat = words.reshape(-1)
+    prev = jnp.concatenate([iv_words, flat[:-4]])
+    out = flat ^ ecb_encrypt_words(prev, rk, nr, engine)
+    return out.reshape(words.shape), flat[-4:]
 
 
 def ctr_crypt_fn(nr: int, engine: str = "auto"):

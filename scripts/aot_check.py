@@ -119,6 +119,40 @@ def main() -> int:
                           lambda a, c, b, _f=fused: _f(a, c, b, nr),
                           (w, ctr, rke)))
 
+    # HBM-fit gate (round 4): the 32x padded-intermediate OOM
+    # (ops/bitslice.py:dense_words notes — a (W, 32, 4) stage tensor
+    # asking 32 GiB for a 1 GiB buffer) surfaced as a COMPILE-time
+    # allocation failure, so the chipless compiler regression-gates it:
+    # the 1 GiB flat-boundary dense CTR must compile for one v5e's 16 GiB
+    # HBM. Catches any relayout composition whose intermediate re-grows a
+    # padded minor dim — the class, not just the instance.
+    dense_sel = [e for e in ("pallas-dense-bp", "pallas-dense")
+                 if e in engines]
+    if dense_sel:
+        # Through the models layer with the FLAT (4N,) boundary — the
+        # production staging form (a (N, 4) boundary input would itself
+        # carry the padded layout: feeding it directly here correctly
+        # fails this same gate with a 32 GiB copy, which is the staging
+        # tax bench.py's flat default exists to avoid, not a regression).
+        # Keyed on EITHER dense engine being selected, and compiled with
+        # whichever is — the two share the relayout under test (the bp
+        # twin differs only by S-box circuit).
+        big = arg((1 << 28,))  # 1 GiB of u32, flat dense boundary
+        cases.append((
+            "dense-ctr-1gib-hbm-fit",
+            lambda a, c, b: aes_mod.ctr_crypt_words(
+                a, c, b, nr, dense_sel[0]),
+            (big, ctr, rke)))
+        # The corpus OOM's second instance: CBC decrypt's shifted-prev
+        # stream, built flat since round 4 (models/aes.py:
+        # _cbc_decrypt_words_impl) — an (N, 4) shift materialised 32 GiB
+        # at 1000 MiB.
+        cases.append((
+            "cbcdec-1gib-hbm-fit",
+            lambda a, i, b: aes_mod.cbc_decrypt_words(
+                a, i, b, nr, dense_sel[0])[0],
+            (big, ctr, rkd)))
+
     if not args.skip_sharded and len(topo.devices) > 1:
         from our_tree_tpu.parallel import dist
 
