@@ -34,17 +34,17 @@ import json
 import os
 import time
 
-#: Static fallback order. Seeded from the round-2 hardware A/B
-#: (docs/PERF.md: pallas-gt 5.93 GB/s > pallas 1.65 > bitslice ~0.2). The
-#: dense-boundary variants — expected ≥ gt (same kernel, no padding tax)
-#: — sit after the hardware-MEASURED gt pair: all engines now pass the
-#: deviceless Mosaic compile gate (scripts/aot_check.py, round 4) and
-#: "auto" carries a runtime compile-failure fallback
-#: (models/aes.py:_engine_compile_ok), but a measured number still
-#: outranks an expected one. The first hardware probe measures dense
-#: anyway, and the persisted ranking supersedes this order.
-DEFAULT_ORDER = ("pallas-gt", "pallas-gt-bp", "pallas-dense",
-                 "pallas-dense-bp", "pallas", "bitslice")
+#: Static fallback order. Seeded from the round-4 hardware measurements
+#: (docs/PERF.md: dense-bp 22.5 / dense 23.2-at-probe / gt-bp 5.8-7.0 /
+#: pallas ~3-5 / bitslice ~1.4 GB/s at 256 MiB after the dense-relayout
+#: fix): the dense pair leads — hardware-proven fastest, Mosaic-compiled
+#: on-device (104/104 smoke) and gated deviceless every CI run
+#: (scripts/aot_check.py), with "auto" additionally carrying a runtime
+#: compile-failure fallback (models/aes.py:_engine_compile_ok). Only a
+#: never-measured host ever sees this order; the first probe writes the
+#: real one.
+DEFAULT_ORDER = ("pallas-dense-bp", "pallas-dense", "pallas-gt-bp",
+                 "pallas-gt", "pallas", "bitslice")
 
 def device_key(platform: str, device_kind: str | None = None) -> str:
     """Ranking key for a device: ``"tpu:TPU v5e"``.

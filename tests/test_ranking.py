@@ -100,7 +100,7 @@ def test_probe_order_measurement_leads_defaults_follow(rank_file):
     avail = {"pallas-gt", "pallas-gt-bp", "pallas", "bitslice", "jnp",
              "zz-new"}
     assert ranking.probe_order("tpu", avail) == [
-        "bitslice", "pallas", "pallas-gt", "pallas-gt-bp", "zz-new"]
+        "bitslice", "pallas", "pallas-gt-bp", "pallas-gt", "zz-new"]
 
 
 def test_probe_order_drops_stale_engine_names(rank_file):
