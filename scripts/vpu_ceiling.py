@@ -44,29 +44,40 @@ ITERS = int(os.environ.get("OT_VPU_ITERS", 8))
 TILE = 512  # lanes per grid step; sized like pallas_aes.TILE
 
 
-def _chain_kernel(x_ref, o_ref, *, chain: int):
+def _chain_kernel(x_ref, o_ref, *, chain: int, ilp: int = 1):
     import jax
     import jax.numpy as jnp
 
-    a = x_ref[...]
-    b = a ^ jnp.uint32(0x9E3779B9)
+    x = x_ref[...]
+    # `ilp` INDEPENDENT two-variable feedback chains (distinct constants),
+    # interleavable by the compiler across the VPU's parallel ALUs. ilp=1
+    # serializes on a 2-op dependency and measures the single-chain issue
+    # rate — which round 4's AES kernels exceeded by ~70% (the round
+    # circuit has abundant ILP), so the ilp>1 regimes exist to measure
+    # the saturated rate the roofline actually needs.
+    st = tuple((x ^ jnp.uint32((0x9E3779B9 * (2 * i + 1)) & 0xFFFFFFFF),
+                x ^ jnp.uint32((0x85EBCA6B * (2 * i + 1)) & 0xFFFFFFFF))
+               for i in range(ilp))
 
-    def body(_, ab):
-        a, b = ab
-        return b, a ^ (b & jnp.uint32(0x85EBCA6B))
+    def body(_, st):
+        return tuple((b, a ^ (b & jnp.uint32(0xC2B2AE35))) for a, b in st)
 
-    a, b = jax.lax.fori_loop(0, chain, body, (a, b))
-    o_ref[...] = a ^ b
+    st = jax.lax.fori_loop(0, chain, body, st)
+    acc = None
+    for a, b in st:
+        acc = (a ^ b) if acc is None else acc ^ a ^ b
+    o_ref[...] = acc
 
 
 @functools.lru_cache(None)
-def _build(chain: int, lanes: int, tile: int, interpret: bool):
+def _build(chain: int, lanes: int, tile: int, interpret: bool,
+           ilp: int = 1):
     import jax
     from jax.experimental import pallas as pl
 
     spec = pl.BlockSpec((8, tile), lambda i: (0, i))
     return jax.jit(lambda x: pl.pallas_call(
-        functools.partial(_chain_kernel, chain=chain),
+        functools.partial(_chain_kernel, chain=chain, ilp=ilp),
         grid=(lanes // tile,),
         in_specs=[spec],
         out_specs=spec,
@@ -117,16 +128,19 @@ def main() -> int:
 
     out = {"platform": dev.platform, "device_kind": dev.device_kind,
            "bytes": n * 4}
-    for name, chain in (("stream", 1), ("compute", 128)):
-        fn = _build(chain, lanes, TILE, interpret)
+    for name, chain, ilp in (("stream", 1, 1), ("compute", 128, 1),
+                             ("compute-ilp4", 128, 4),
+                             ("compute-ilp8", 128, 8)):
+        fn = _build(chain, lanes, TILE, interpret, ilp)
         t = chained_time(fn, x)
-        # 2 ops (XOR+AND) per chain step, +2 for the prologue/epilogue XORs.
-        ops = n * (2 * chain + 2)
+        # 2 ops (XOR+AND) per chain step per independent chain, + the
+        # prologue/epilogue XORs (2 per chain + the ilp-1 reduction XORs).
+        ops = n * (ilp * (2 * chain + 2) + max(0, 2 * (ilp - 1)))
         gbps = n * 8 / t / 1e9  # one u32 read + one write per element
-        print(f"{name:8s} chain={chain:4d}: {t * 1e3:8.2f} ms  "
+        print(f"{name:12s} chain={chain:4d} ilp={ilp}: {t * 1e3:8.2f} ms  "
               f"{ops / t / 1e12:6.3f} T-u32-ops/s  ({gbps:6.1f} GB/s mem)")
-        out[name] = {"chain": chain, "sec": t, "t_ops_per_s": ops / t / 1e12,
-                     "mem_gb_per_s": gbps}
+        out[name] = {"chain": chain, "ilp": ilp, "sec": t,
+                     "t_ops_per_s": ops / t / 1e12, "mem_gb_per_s": gbps}
     print(json.dumps(out))
     return 0
 
