@@ -188,7 +188,10 @@ def _engine_compile_ok(eng: str, rank_key: str) -> bool:
                 print(f"# engine {eng}:{label}: Mosaic lowering failed "
                       f"({type(e).__name__}); dropping from auto "
                       f"selection: {str(e)[:200]}", file=sys.stderr)
-                ranking.drop_engines(rank_key, (eng,))
+                ranking.drop_engines(
+                    rank_key, (eng,),
+                    reason=f"Mosaic lowering failed under default knobs "
+                           f"({type(e).__name__}: {str(e)[:120]})")
             _COMPILE_OK[eng] = False
             return False
         try:

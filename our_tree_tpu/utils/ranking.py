@@ -284,6 +284,11 @@ def store(platform: str, gbps_by_engine: dict, source: str,
     still_dropped = prev_dropped - set(real)
     if still_dropped:
         entry["dropped"] = sorted(still_dropped)
+        if isinstance(prev.get("drop_reasons"), dict):
+            reasons = {e: r for e, r in prev["drop_reasons"].items()
+                       if e in still_dropped}
+            if reasons:
+                entry["drop_reasons"] = reasons
     # Tuned knobs survive ranking re-stores unchanged: a bench probe
     # measures ENGINES (under whatever knobs are applied), it never
     # re-measures the knob grid — only store_knobs() writes that record.
@@ -293,7 +298,7 @@ def store(platform: str, gbps_by_engine: dict, source: str,
     return _write_all(data)
 
 
-def drop_engines(platform: str, engines) -> bool:
+def drop_engines(platform: str, engines, reason: str | None = None) -> bool:
     """Persist `engines` as compile-broken for `platform`.
 
     The persistence half of the compile-failure fallback
@@ -305,6 +310,15 @@ def drop_engines(platform: str, engines) -> bool:
     from the stored ranking list. Unlike store(), a resulting ranking of
     < 2 engines (or zero) is kept: this records known-bad data, not a new
     ordering. Returns True iff the file changed.
+
+    ``reason`` is recorded per engine in ``drop_reasons`` (VERDICT r4 #4:
+    a drop record a future maintainer cannot re-derive is a landmine, so
+    the file must say WHY — e.g. "chained bench form RESOURCE_EXHAUSTED at
+    256 MiB"). The recovery path clears the reason with the drop: store()
+    removes both when a measurement runs the engine successfully. Note
+    store()'s two-engine floor applies to the recovery too — a sweep must
+    measure the dropped engine AND at least one other, or nothing is
+    written and the drop stands.
     """
     data = dict(_load_all())
     entry = data.get(platform)
@@ -320,11 +334,23 @@ def drop_engines(platform: str, engines) -> bool:
                     if isinstance(e, str)} if isinstance(
                         entry.get("dropped"), list) else set()
     new_dropped = prev_dropped | bad
-    if len(kept) == len(ranking_list) and new_dropped == prev_dropped:
+    prev_reasons = (dict(entry["drop_reasons"])
+                    if isinstance(entry.get("drop_reasons"), dict) else {})
+    reasons = dict(prev_reasons)
+    if reason:
+        reasons.update({e: str(reason) for e in bad})
+    reasons = {e: r for e, r in reasons.items() if e in new_dropped}
+    if (len(kept) == len(ranking_list) and new_dropped == prev_dropped
+            and reasons == prev_reasons):
         return False
-    data[platform] = {**entry, "ranking": kept,
-                      "dropped": sorted(new_dropped),
-                      "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%S")}
+    new_entry = {**entry, "ranking": kept,
+                 "dropped": sorted(new_dropped),
+                 "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%S")}
+    if reasons:
+        new_entry["drop_reasons"] = reasons
+    else:
+        new_entry.pop("drop_reasons", None)
+    data[platform] = new_entry
     return _write_all(data)
 
 

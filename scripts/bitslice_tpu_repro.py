@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Reproduce / bisect the bitslice XLA engine's TPU compile failure.
+
+Round-4 bench probe died with `remote_compile: HTTP 500:
+tpu_compile_helper subprocess exit code 1` at the 256 MiB probe size
+(BENCH_r04.json tail; VERDICT r4 missing #3). This script runs the
+bitslice CTR path at escalating sizes, each in its own subprocess (the
+axon worker can crash and take the parent's PJRT client with it —
+axon-tpu-pitfalls rule 5), and prints one JSON line per size.
+
+    python scripts/bitslice_tpu_repro.py              # default ladder
+    python scripts/bitslice_tpu_repro.py --sizes 1,16 # MiB subset
+    OT_BITSLICE_UNROLL=1 python scripts/bitslice_tpu_repro.py ...
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def child(mib: float, op: str) -> int:
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    sys.path.insert(0, REPO)
+    from our_tree_tpu.models import aes as aes_mod
+    from our_tree_tpu.models.aes import AES
+    from our_tree_tpu.ops import pallas_aes
+    from our_tree_tpu.utils import packing
+
+    dev = jax.devices()[0]
+    assert dev.platform != "cpu", "need the real chip"
+    pallas_aes.apply_stored_knobs(dev)
+
+    nbytes = int(mib * (1 << 20))
+    a = AES(bytes(range(16)))
+    host = np.random.default_rng(1337).integers(0, 256, nbytes, dtype=np.uint8)
+    words = jax.device_put(jnp.asarray(packing.np_bytes_to_words(host)))
+    nonce = np.frombuffer(bytes(range(16)), np.uint8)
+    ctr_be = jax.device_put(
+        jnp.asarray(packing.np_bytes_to_words(nonce).byteswap()))
+
+    if op == "ctr":
+        fn = jax.jit(lambda w: aes_mod.ctr_crypt_words(
+            w, ctr_be, a.rk_enc, a.nr, "bitslice"))
+    else:
+        fn = jax.jit(lambda w: aes_mod.ecb_encrypt_words(
+            w, a.rk_enc, a.nr, "bitslice"))
+    t0 = time.perf_counter()
+    out = jax.block_until_ready(fn(words))
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    out = jax.block_until_ready(fn(words))
+    run_s = time.perf_counter() - t0
+    digest = int(np.asarray(out).ravel().view(np.uint32).sum(dtype=np.uint32))
+    print(json.dumps({
+        "mib": mib, "op": op, "ok": True,
+        "compile_s": round(compile_s, 1), "run_s": round(run_s, 4),
+        "gbps": round(nbytes / run_s / 1e9, 2), "digest": f"{digest:#010x}",
+        "unroll": os.environ.get("OT_BITSLICE_UNROLL", ""),
+    }), flush=True)
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sizes", default="1,16,64,256")
+    ap.add_argument("--op", default="ctr")
+    ap.add_argument("--timeout", type=float, default=600)
+    ap.add_argument("--child-mib", type=float, help=argparse.SUPPRESS)
+    args = ap.parse_args()
+    if args.child_mib is not None:
+        return child(args.child_mib, args.op)
+
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from _devlock_loader import load_devlock
+
+    # Parse the whole ladder up front: a malformed token must fail the run
+    # before any device work, not crash the failure-reporting path later.
+    sizes = [float(s) for s in args.sizes.split(",")]
+
+    devlock = load_devlock()
+    rc_all = 0
+    with devlock.hold(wait_budget_s=600.0):
+        for mib in sizes:
+            tag = f"bitslice {args.op} {mib:g} MiB"
+            print(f"## {tag}", flush=True)
+            try:
+                p = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__),
+                     "--child-mib", str(mib), "--op", args.op],
+                    timeout=args.timeout, capture_output=True, text=True)
+                sys.stdout.write(p.stdout)
+                if p.returncode:
+                    rc_all = 1
+                    tail = (p.stderr or "").strip().splitlines()[-12:]
+                    print(json.dumps({"mib": mib, "ok": False,
+                                      "rc": p.returncode,
+                                      "stderr_tail": tail}), flush=True)
+            except subprocess.TimeoutExpired:
+                rc_all = 1
+                print(json.dumps({"mib": mib, "ok": False,
+                                  "rc": "timeout"}), flush=True)
+    return rc_all
+
+
+if __name__ == "__main__":
+    sys.exit(main())

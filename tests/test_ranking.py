@@ -179,6 +179,37 @@ def test_drop_engines_sticks_on_fresh_host(rank_file):
     assert not ranking.drop_engines("tpu:TPU fresh", [eng])  # idempotent
 
 
+def test_drop_reason_persists_and_clears_on_recovery(rank_file):
+    """The drop record carries a human-readable reason per engine
+    (VERDICT r4 #4: the file must say WHY an engine is excluded), and the
+    reason dies with the drop when a later measurement proves the engine
+    works again — a stale reason beside a cleared drop would be a lie."""
+    ranking.store("tpu", {"a": 5.0, "b": 3.0}, "probe", 1)
+    assert ranking.drop_engines("tpu", ["c"], reason="chained form OOMs")
+    entry = ranking.load("tpu")
+    assert entry["drop_reasons"] == {"c": "chained form OOMs"}
+    # idempotent with the same reason: nothing new to write
+    assert not ranking.drop_engines("tpu", ["c"], reason="chained form OOMs")
+    # a changed reason IS a change
+    assert ranking.drop_engines("tpu", ["c"], reason="still OOMs on v6")
+    # recovery: a store that measured the engine clears drop AND reason
+    ranking.store("tpu", {"a": 6.0, "c": 2.0}, "tune-sweep", 1)
+    entry = ranking.load("tpu")
+    assert "drop_reasons" not in entry and "dropped" not in entry
+
+
+def test_drop_reason_kept_for_still_dropped(rank_file):
+    """store() keeps the reason of engines still dropped after its merge,
+    while clearing only the recovered engine's."""
+    ranking.store("tpu", {"a": 5.0, "b": 3.0}, "probe", 1)
+    ranking.drop_engines("tpu", ["c"], reason="r-c")
+    ranking.drop_engines("tpu", ["d"], reason="r-d")
+    ranking.store("tpu", {"a": 6.0, "c": 2.0}, "tune-sweep", 1)
+    entry = ranking.load("tpu")
+    assert entry["dropped"] == ["d"]
+    assert entry["drop_reasons"] == {"d": "r-d"}
+
+
 def test_store_clears_remeasured_drops_keeps_others(rank_file):
     """store() preserves the drop record across probe stores, EXCEPT for
     engines the new measurement actually ran — a successful measurement is
