@@ -276,16 +276,47 @@ def _as_block_words(words):
     return words.reshape(-1, 4) if words.ndim == 1 else words
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3))
-def ecb_encrypt_words(words, rk, nr, engine="jnp"):
-    """Batch ECB encrypt over (N, 4) block words or a flat (4N,) stream."""
+def _engine_knobs_key(engine: str):
+    """The tuned-knob component of an engine entry point's compile key.
+
+    Pallas engines read TILE / MC_LOWERING at trace time, so a jit keyed
+    only on (shape, nr, engine) would silently pin whatever knobs were
+    live at FIRST trace — a pallas engine traced before apply_stored_knobs
+    runs would keep default knobs for those shapes forever (ADVICE r4 #1).
+    Returning the live values for pallas-backed engines makes a knob
+    change a cache miss (clean recompile); None for other engines, whose
+    traces don't read the knobs — keying them would only cause spurious
+    recompiles.
+    """
+    if engine in PALLAS_BACKED:
+        from ..ops import pallas_aes
+
+        return (pallas_aes.TILE, pallas_aes.MC_LOWERING)
+    return None
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4))
+def _ecb_encrypt_words_jit(words, rk, nr, engine, knobs):
+    del knobs  # compile-cache key only (see _engine_knobs_key)
     return CORES[engine][0](_as_block_words(words), rk, nr).reshape(words.shape)
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3))
+def ecb_encrypt_words(words, rk, nr, engine="jnp"):
+    """Batch ECB encrypt over (N, 4) block words or a flat (4N,) stream."""
+    return _ecb_encrypt_words_jit(words, rk, nr, engine,
+                                  _engine_knobs_key(engine))
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4))
+def _ecb_decrypt_words_jit(words, rk_dec, nr, engine, knobs):
+    del knobs
+    return CORES[engine][1](_as_block_words(words), rk_dec, nr).reshape(words.shape)
+
+
 def ecb_decrypt_words(words, rk_dec, nr, engine="jnp"):
     """Batch ECB decrypt; flat-stream contract of ecb_encrypt_words."""
-    return CORES[engine][1](_as_block_words(words), rk_dec, nr).reshape(words.shape)
+    return _ecb_decrypt_words_jit(words, rk_dec, nr, engine,
+                                  _engine_knobs_key(engine))
 
 
 def _add_counter_be(ctr_be: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
@@ -321,23 +352,22 @@ def ctr_le_blocks(ctr_be_words, idx):
     return packing.byteswap32(_add_counter_be(ctr_be_words, idx))
 
 
-@functools.partial(jax.jit, static_argnums=(2, 4))
-def ctr_keystream_words(ctr_be_words, rk, nr, nblocks_idx, engine="jnp"):
-    """Keystream for blocks counter0+idx. ctr_be_words: (4,) u32 BE."""
+@functools.partial(jax.jit, static_argnums=(2, 4, 5))
+def _ctr_keystream_words_jit(ctr_be_words, rk, nr, nblocks_idx, engine,
+                             knobs):
+    del knobs
     return CORES[engine][0](ctr_le_blocks(ctr_be_words, nblocks_idx), rk, nr)
 
 
-@functools.partial(jax.jit, static_argnums=(3, 4))
-def ctr_crypt_words(words, ctr_be_words, rk, nr, engine="jnp"):
-    """CTR over (N, 4) u32 block words — or a flat (4N,) u32 stream.
+def ctr_keystream_words(ctr_be_words, rk, nr, nblocks_idx, engine="jnp"):
+    """Keystream for blocks counter0+idx. ctr_be_words: (4,) u32 BE."""
+    return _ctr_keystream_words_jit(ctr_be_words, rk, nr, nblocks_idx,
+                                    engine, _engine_knobs_key(engine))
 
-    Flat inputs exist for the jit *boundary*: a (N, 4) boundary array gets
-    the default TPU layout with its 4-wide minor dim padded to the 128-lane
-    tile (~32x HBM footprint and bandwidth on staging and readback); a flat
-    stream lays out densely, and the (N, 4) view below is internal, where
-    the compiler fuses the reshape instead of materialising the padded
-    form. Same byte semantics either way.
-    """
+
+@functools.partial(jax.jit, static_argnums=(3, 4, 5))
+def _ctr_crypt_words_jit(words, ctr_be_words, rk, nr, engine, knobs):
+    del knobs
     w2 = _as_block_words(words)
     fused = CTR_FUSED.get(engine)
     if fused is not None:
@@ -349,6 +379,20 @@ def ctr_crypt_words(words, ctr_be_words, rk, nr, engine="jnp"):
         idx = jnp.arange(w2.shape[0], dtype=jnp.uint32)
         out = w2 ^ ctr_keystream_words(ctr_be_words, rk, nr, idx, engine)
     return out.reshape(words.shape)
+
+
+def ctr_crypt_words(words, ctr_be_words, rk, nr, engine="jnp"):
+    """CTR over (N, 4) u32 block words — or a flat (4N,) u32 stream.
+
+    Flat inputs exist for the jit *boundary*: a (N, 4) boundary array gets
+    the default TPU layout with its 4-wide minor dim padded to the 128-lane
+    tile (~32x HBM footprint and bandwidth on staging and readback); a flat
+    stream lays out densely, and the (N, 4) view below is internal, where
+    the compiler fuses the reshape instead of materialising the padded
+    form. Same byte semantics either way.
+    """
+    return _ctr_crypt_words_jit(words, ctr_be_words, rk, nr, engine,
+                                _engine_knobs_key(engine))
 
 
 @functools.partial(jax.jit, static_argnums=(3,))
@@ -382,8 +426,8 @@ def cbc_encrypt_words_batch(words, iv_words, rk, nr):
     )
 
 
-@functools.partial(jax.jit, static_argnums=(3, 4))
-def _cbc_decrypt_words_impl(words, iv_words, rk_dec, nr, engine="jnp"):
+@functools.partial(jax.jit, static_argnums=(3, 4, 5))
+def _cbc_decrypt_words_jit(words, iv_words, rk_dec, nr, engine, knobs):
     # Parallel: P_i = D(C_i) ^ C_{i-1} (C_{-1} = IV). Reference does this
     # serially (aes.c:782-796); the dependency chain only involves ciphertext,
     # so the TPU version is one batched decrypt + shifted XOR.
@@ -400,6 +444,7 @@ def _cbc_decrypt_words_impl(words, iv_words, rk_dec, nr, engine="jnp"):
     # through the models-level entry — the layer that accepts the flat
     # stream for EVERY engine (raw CORES callables are only uniform over
     # (N, 4)).
+    del knobs
     flat = words.reshape(-1)
     prev = jnp.concatenate([iv_words, flat[:-4]])
     out = ecb_decrypt_words(flat, rk_dec, nr, engine) ^ prev
@@ -409,7 +454,8 @@ def _cbc_decrypt_words_impl(words, iv_words, rk_dec, nr, engine="jnp"):
 def cbc_decrypt_words(words, iv_words, rk_dec, nr, engine="jnp"):
     if words.shape[0] == 0:  # length-0 is a no-op, as in the reference
         return words, iv_words
-    return _cbc_decrypt_words_impl(words, iv_words, rk_dec, nr, engine)
+    return _cbc_decrypt_words_jit(words, iv_words, rk_dec, nr, engine,
+                                  _engine_knobs_key(engine))
 
 
 @functools.partial(jax.jit, static_argnums=(3,))
@@ -424,16 +470,22 @@ def cfb128_encrypt_words(words, iv_words, rk, nr):
     return out.reshape(words.shape), iv_out
 
 
-@functools.partial(jax.jit, static_argnums=(3, 4))
-def cfb128_decrypt_words(words, iv_words, rk, nr, engine="jnp"):
+@functools.partial(jax.jit, static_argnums=(3, 4, 5))
+def _cfb128_decrypt_words_jit(words, iv_words, rk, nr, engine, knobs):
     # Keystream block i = E(C_{i-1}) — all known up front, so parallel.
     # Always-flat shift + models-level engine entry, same rationale as
-    # _cbc_decrypt_words_impl (a flat concat stays dense; an (N, 4) one
+    # _cbc_decrypt_words_jit (a flat concat stays dense; an (N, 4) one
     # pads its minor dim 32x).
+    del knobs
     flat = words.reshape(-1)
     prev = jnp.concatenate([iv_words, flat[:-4]])
     out = flat ^ ecb_encrypt_words(prev, rk, nr, engine)
     return out.reshape(words.shape), flat[-4:]
+
+
+def cfb128_decrypt_words(words, iv_words, rk, nr, engine="jnp"):
+    return _cfb128_decrypt_words_jit(words, iv_words, rk, nr, engine,
+                                     _engine_knobs_key(engine))
 
 
 def ctr_crypt_fn(nr: int, engine: str = "auto"):

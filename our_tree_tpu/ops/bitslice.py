@@ -51,9 +51,12 @@ from . import gf, tables
 #: Rounds inlined per scan step in the XLA path. >1 halves the scan-carry
 #: HBM round-trips at the cost of a larger compiled body; tune on hardware
 #: via env without a code change (the Pallas engine keeps all rounds in
-#: VMEM and doesn't use this).
+#: VMEM and doesn't use this). DEFAULT_UNROLL exists so jax-free parents
+#: (scripts/tune_tpu.py) can be pinned against it by tests rather than
+#: mirroring a literal (same pattern as pallas_aes.DEFAULT_TILE).
+DEFAULT_UNROLL = 1
 try:
-    ROUND_UNROLL = int(os.environ.get("OT_BITSLICE_UNROLL", 1))
+    ROUND_UNROLL = int(os.environ.get("OT_BITSLICE_UNROLL", DEFAULT_UNROLL))
 except ValueError as e:
     raise ValueError(f"OT_BITSLICE_UNROLL must be an integer: {e}") from None
 if ROUND_UNROLL < 1:

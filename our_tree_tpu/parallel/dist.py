@@ -39,7 +39,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..models.aes import (CORES, CTR_FUSED, PALLAS_BACKED, _add_counter_be,
-                          _as_block_words,
+                          _as_block_words, _engine_knobs_key,
                           cbc_encrypt_words_batch, ctr_le_blocks,
                           resolve_engine)
 from ..models.arc4 import keystream_scan_batch
@@ -194,9 +194,13 @@ def _ctr_shard_body(words, ctr_be, rk, nr, axis, engine="jnp"):
 
 @functools.partial(jax.jit,
                    static_argnames=("nr", "mesh", "axis", "engine",
-                                    "check_vma"))
+                                    "check_vma", "knobs"))
 def _ctr_sharded_jit(words, ctr_be, rk, *, nr, mesh, axis, engine="jnp",
-                     check_vma=True):
+                     check_vma=True, knobs=None):
+    # `knobs` is compile-cache key only: pallas engines read TILE/MC at
+    # trace time (models/aes.py:_engine_knobs_key — ADVICE r4 #1 applies
+    # to the sharded paths too).
+    del knobs
     f = jax.shard_map(
         functools.partial(_ctr_shard_body, nr=nr, axis=axis, engine=engine),
         mesh=mesh,
@@ -227,7 +231,8 @@ def ctr_crypt_sharded(words, ctr_be, rk, nr, mesh: Mesh, axis: str = AXIS,
     padded, n = pad(words, n_shards)
     eng = resolve_engine(engine)
     out = _ctr_sharded_jit(padded, ctr_be, rk, nr=nr, mesh=mesh, axis=axis,
-                           engine=eng, check_vma=_shard_check_vma(eng))
+                           engine=eng, check_vma=_shard_check_vma(eng),
+                           knobs=_engine_knobs_key(eng))
     return out[:n]
 
 
@@ -238,9 +243,10 @@ def _ecb_shard_body(words, rk, nr, encrypt, engine="jnp"):
 
 @functools.partial(jax.jit,
                    static_argnames=("nr", "encrypt", "mesh", "axis", "engine",
-                                    "check_vma"))
+                                    "check_vma", "knobs"))
 def _ecb_sharded_jit(words, rk, *, nr, encrypt, mesh, axis, engine="jnp",
-                     check_vma=True):
+                     check_vma=True, knobs=None):
+    del knobs  # compile-cache key only (see _ctr_sharded_jit)
     f = jax.shard_map(
         functools.partial(_ecb_shard_body, nr=nr, encrypt=encrypt, engine=engine),
         mesh=mesh,
@@ -262,7 +268,8 @@ def ecb_crypt_sharded(words, rk, nr, mesh: Mesh, encrypt: bool = True,
     eng = resolve_engine(engine)
     out = _ecb_sharded_jit(padded, rk, nr=nr, encrypt=encrypt, mesh=mesh,
                            axis=axis, engine=eng,
-                           check_vma=_shard_check_vma(eng))
+                           check_vma=_shard_check_vma(eng),
+                           knobs=_engine_knobs_key(eng))
     return out[:n]
 
 
@@ -387,9 +394,10 @@ _CHAIN_COMBINE = {"cbc": _cbc_combine, "cfb128": _cfb_combine}
 
 @functools.partial(jax.jit,
                    static_argnames=("nr", "mesh", "axis", "engine", "mode",
-                                    "check_vma"))
+                                    "check_vma", "knobs"))
 def _chained_dec_sharded_jit(words, iv, rk, *, nr, mesh, axis, engine, mode,
-                             check_vma=True):
+                             check_vma=True, knobs=None):
+    del knobs  # compile-cache key only (see _ctr_sharded_jit)
     combine = _CHAIN_COMBINE[mode]
 
     def body(words, iv, rk):
@@ -422,6 +430,7 @@ def _chained_dec_sharded(words, iv_words, rk, nr, mesh, axis, engine, mode):
     out = _chained_dec_sharded_jit(
         w2, iv_words, rk, nr=nr, mesh=mesh, axis=axis,
         engine=eng, mode=mode, check_vma=_shard_check_vma(eng),
+        knobs=_engine_knobs_key(eng),
     )
     return out.reshape(words.shape)
 

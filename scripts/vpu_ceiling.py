@@ -133,9 +133,10 @@ def main() -> int:
                              ("compute-ilp8", 128, 8)):
         fn = _build(chain, lanes, TILE, interpret, ilp)
         t = chained_time(fn, x)
-        # 2 ops (XOR+AND) per chain step per independent chain, + the
-        # prologue/epilogue XORs (2 per chain + the ilp-1 reduction XORs).
-        ops = n * (ilp * (2 * chain + 2) + max(0, 2 * (ilp - 1)))
+        # Exact per-element count (ADVICE r4 #4): 2 ops (XOR+AND) per chain
+        # step per independent chain, + 2*ilp init XORs, + the tree-free
+        # reduction's 1 + 2*(ilp-1) = 2*ilp-1 XORs.
+        ops = n * (ilp * 2 * chain + 2 * ilp + 2 * ilp - 1)
         gbps = n * 8 / t / 1e9  # one u32 read + one write per element
         print(f"{name:12s} chain={chain:4d} ilp={ilp}: {t * 1e3:8.2f} ms  "
               f"{ops / t / 1e12:6.3f} T-u32-ops/s  ({gbps:6.1f} GB/s mem)")

@@ -326,6 +326,35 @@ def test_apply_knobs_sets_module_attrs(monkeypatch):
     assert pallas_aes.apply_knobs({"tile": 2048, "mc": "roll"}) == {}
 
 
+def test_models_entry_points_key_on_knobs(monkeypatch):
+    """A knob change AFTER a pallas engine was traced through a
+    models-level entry point must recompile, not silently reuse the old
+    executable (ADVICE r4 #1): the knobs ride the compile key via
+    _engine_knobs_key. Interpreter-mode pallas on CPU traces TILE the
+    same way hardware does, so a mismatch would reproduce here."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from our_tree_tpu.models import aes as aes_mod
+    from our_tree_tpu.models.aes import AES
+    from our_tree_tpu.ops import pallas_aes
+
+    monkeypatch.delenv("OT_PALLAS_TILE", raising=False)
+    a = AES(bytes(range(16)))
+    w = jnp.asarray(np.arange(128 * 4, dtype=np.uint32))
+    monkeypatch.setattr(pallas_aes, "TILE", 1024)
+    want = np.asarray(aes_mod.ecb_encrypt_words(w, a.rk_enc, a.nr, "jnp"))
+    out1 = np.asarray(aes_mod.ecb_encrypt_words(w, a.rk_enc, a.nr, "pallas"))
+    # Same shapes, different knob: must re-trace (observable via the knob
+    # key), and the bytes must stay identical either way.
+    monkeypatch.setattr(pallas_aes, "TILE", 256)
+    out2 = np.asarray(aes_mod.ecb_encrypt_words(w, a.rk_enc, a.nr, "pallas"))
+    assert aes_mod._engine_knobs_key("pallas")[0] == 256
+    assert aes_mod._engine_knobs_key("jnp") is None
+    np.testing.assert_array_equal(out1, want)
+    np.testing.assert_array_equal(out2, want)
+
+
 def test_apply_knobs_respects_explicit_env(monkeypatch):
     # An explicit OT_PALLAS_* pin outranks the stored measurement, same
     # precedence as OT_BENCH_ENGINE over the engine ranking.

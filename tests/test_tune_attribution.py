@@ -45,3 +45,19 @@ def test_bp_sbox_maps_to_registered_bp_engine():
     # No registered bp twin (no Boyar-Peralta bitslice engine): dropped.
     assert _rankable_engine_name(
         "bitslice", 1024, "perm", "bp", "1", 1024, "perm") is None
+
+
+def test_parent_default_knobs_match_library():
+    """tune_tpu's parent stays jax-free, so it mirrors the library's
+    default knobs by hand (_DEFAULT_TILE/_DEFAULT_MC/_DEFAULT_UNROLL). If
+    the library defaults drift, sweep attribution and the knobs_changed
+    computation silently diverge (ADVICE r4 #2) — pin them equal here,
+    where importing jax is fine."""
+    import tune_tpu
+
+    from our_tree_tpu.ops import bitslice, pallas_aes
+
+    assert tune_tpu._DEFAULT_TILE == pallas_aes.DEFAULT_TILE
+    assert tune_tpu._DEFAULT_MC == pallas_aes.DEFAULT_MC
+    # The parent mirrors unroll in env-string form.
+    assert int(tune_tpu._DEFAULT_UNROLL) == bitslice.DEFAULT_UNROLL
