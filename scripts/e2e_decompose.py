@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""Decompose the e2e corpus time into its stages (VERDICT r4 #5).
+
+The committed e2e rows (results/results.vm.tpu) read 0.028–0.033 GB/s at
+1 GiB — 16x BELOW the reference's -O0 CPU baseline — while the device
+kernel runs at ~35 GB/s. This script times each stage of one e2e pass
+separately so the corpus footnote can say exactly where those seconds go:
+
+  pack      host bytes -> u32 LE words (pure numpy view/copy)
+  h2d       jax.device_put + block_until_ready (tunnel upload)
+  kernel    chained-difference CTR pass (the compute)
+  d2h       np.asarray(out) full readback (tunnel download)
+  unpack    u32 words -> host bytes
+
+Each size runs in its own subprocess (axon worker crashes must not kill
+the ladder), one JSON line per size. The tunnel-transport stages dominate
+on this host by construction: the TPU is reached through an RPC tunnel at
+~15–30 MB/s effective staging bandwidth (axon-tpu-pitfalls rule 4). On a
+co-located host (PCIe/DMA, tens of GB/s), h2d/d2h shrink by ~3 orders of
+magnitude and e2e approaches the kernel rate — the expectation the corpus
+footnote states.
+
+    python scripts/e2e_decompose.py                # 256 MiB + 1 GiB
+    python scripts/e2e_decompose.py --sizes 64     # MiB subset
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def child(mib: float) -> int:
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    sys.path.insert(0, REPO)
+    from our_tree_tpu.harness.backends import TpuBackend
+    from our_tree_tpu.utils import packing
+
+    assert jax.devices()[0].platform != "cpu", "need the real chip"
+    backend = TpuBackend("auto")  # applies stored knobs, resolves engine
+
+    nbytes = int(mib * (1 << 20))
+    ctx = backend.make_key(bytes(range(16)))
+    host = np.random.default_rng(1337).integers(0, 256, nbytes, dtype=np.uint8)
+    nonce = np.frombuffer(
+        bytes.fromhex("f0f1f2f3f4f5f6f7f8f9fafbfcfdfeff"), np.uint8)
+    ctr_be = backend.ctr_be_words(nonce)
+
+    def t(fn):
+        t0 = time.perf_counter()
+        out = fn()
+        return time.perf_counter() - t0, out
+
+    r = {"mib": mib, "engine": backend.engine}
+
+    # pack: best of 2 (first call may fault pages)
+    _, words_np = t(lambda: packing.np_bytes_to_words(host))
+    pack_s, words_np = t(lambda: packing.np_bytes_to_words(host))
+    r["pack_s"] = round(pack_s, 4)
+
+    # h2d (the tunnel upload; barrier = the backend's completion readback)
+    h2d_s, words = t(lambda: backend.block_until_ready(
+        jax.device_put(jnp.asarray(words_np))))
+    r["h2d_s"] = round(h2d_s, 3)
+
+    # kernel: the harness's own chained-difference helper (no third copy
+    # of the methodology — backends.py:chained_device_times_us)
+    crypt = lambda w, acc: backend.ctr(ctx, w, ctr_be ^ acc, 1)
+    us = sorted(backend.chained_device_times_us(crypt, words, 3, 4))
+    kernel_s = us[1] / 1e6  # median of 3
+    r["kernel_s"] = round(kernel_s, 4)
+
+    # One per-call sync'd pass isolates the fixed transport dispatch+sync
+    # cost as (call time - kernel time); also yields the ciphertext for
+    # the d2h stage.
+    out_dev = backend.block_until_ready(backend.ctr(ctx, words, ctr_be, 1))
+    call_s, out_dev = t(lambda: backend.block_until_ready(
+        backend.ctr(ctx, words, ctr_be, 1)))
+    r["dispatch_sync_s"] = round(max(call_s - kernel_s, 0.0), 3)
+
+    # d2h: full ciphertext readback (what an e2e pass pays)
+    d2h_s, out_np = t(lambda: np.asarray(out_dev))
+    r["d2h_s"] = round(d2h_s, 3)
+
+    unpack_s, _ = t(lambda: packing.np_words_to_bytes(
+        out_np.reshape(-1, 4)))
+    r["unpack_s"] = round(unpack_s, 4)
+
+    total = pack_s + h2d_s + kernel_s + d2h_s + unpack_s
+    r["e2e_sum_s"] = round(total, 3)
+    r["e2e_gbps"] = round(nbytes / total / 1e9, 4)
+    r["kernel_gbps"] = round(nbytes / kernel_s / 1e9, 2)
+    r["h2d_mbps"] = round(nbytes / h2d_s / 1e6, 1)
+    r["d2h_mbps"] = round(nbytes / d2h_s / 1e6, 1)
+    print(json.dumps(r), flush=True)
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sizes", default="256,1024")
+    ap.add_argument("--timeout", type=float, default=900)
+    ap.add_argument("--child-mib", type=float, help=argparse.SUPPRESS)
+    args = ap.parse_args()
+    if args.child_mib is not None:
+        return child(args.child_mib)
+
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from _devlock_loader import load_devlock
+
+    sizes = [float(s) for s in args.sizes.split(",")]
+    devlock = load_devlock()
+    rc_all = 0
+    with devlock.hold(wait_budget_s=600.0):
+        for mib in sizes:
+            print(f"## e2e decompose {mib:g} MiB", flush=True)
+            try:
+                p = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__),
+                     "--child-mib", str(mib)],
+                    timeout=args.timeout, capture_output=True, text=True)
+                sys.stdout.write(p.stdout)
+                if p.returncode:
+                    rc_all = 1
+                    tail = (p.stderr or "").strip().splitlines()[-10:]
+                    print(json.dumps({"mib": mib, "ok": False,
+                                      "rc": p.returncode,
+                                      "stderr_tail": tail}), flush=True)
+            except subprocess.TimeoutExpired:
+                rc_all = 1
+                print(json.dumps({"mib": mib, "ok": False,
+                                  "rc": "timeout"}), flush=True)
+    return rc_all
+
+
+if __name__ == "__main__":
+    sys.exit(main())

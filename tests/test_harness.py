@@ -40,6 +40,32 @@ def test_bench_sweep_csv(tmp_path, capsys):
             assert abs(float(derived.split()[2]) - want) < 0.0005
 
 
+def test_bench_device_timing_chained(tmp_path):
+    """--timing device rows come from the chained-difference methodology
+    (backends.chained_device_times_us): the sweep still emits the
+    reference CSV shape with non-negative µs values and derived lines.
+    On CPU the helper clamps the chain length, so this stays fast while
+    tracing the exact code path the TPU corpus capture runs."""
+    out = tmp_path / "results.test.tpu"
+    rc = bench_mod.main([
+        "--sizes-mb", "0.0625", "--workers", "1", "--iters", "2",
+        "--modes", "ecb,ctr,cbc,rc4", "--timing", "device",
+        "--out", str(out),
+    ])
+    assert rc == 0
+    lines = out.read_text().splitlines()
+    for prefix in ("TPU AES-256 ECB,", "TPU AES-256 CTR,",
+                   "TPU AES-256 CBC,"):
+        rows = [l for l in lines if l.startswith(prefix)]
+        assert len(rows) == 1, prefix
+        fields = [f for f in rows[0].split(",") if f.strip()]
+        assert len(fields) == 3 + 2
+        assert all(int(f) >= 0 for f in fields[3:])
+    # RC4's XOR row (the line after the keygen line) is chained too.
+    assert any(l.startswith("RC4, 65536, 1") for l in lines)
+    assert sum(1 for l in lines if l.startswith("# derived: ")) >= 3
+
+
 def test_bench_rejects_unknown_mode():
     with pytest.raises(ValueError):
         bench_mod.main(["--sizes-mb", "0.001", "--modes", "rot13", "--iters", "1"])
