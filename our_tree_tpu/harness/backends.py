@@ -27,6 +27,11 @@ class TpuBackend:
 
     name = "tpu"
 
+    #: Chained-difference timings below this are jitter artifacts, emitted
+    #: as exactly this sentinel so reporting code can tell a floor from a
+    #: measurement (chained_device_times_us / bench._derived).
+    FLOOR_US = 1
+
     def __init__(self, engine: str = "auto"):
         import os
         import sys
@@ -168,12 +173,13 @@ class TpuBackend:
 
         run(1)  # compile + warm (one executable for every chain length)
         t1 = min(run(1) for _ in range(2))
-        # Floor at 1 µs, not 0: transport jitter can push a chained
+        # Floor at FLOOR_US, not 0: transport jitter can push a chained
         # difference negative when k*pass_time is below the round-trip
-        # noise; a 0 row would kill the derived-GB/s line and divide a
-        # reference-format consumer's bytes/min(times) by zero. 1 µs is
-        # visibly a floor, not a measurement.
-        return [max(int((run(1 + k) - t1) / k * 1e6), 1)
+        # noise; a 0 row would divide a reference-format consumer's
+        # bytes/min(times) by zero. The sentinel is excluded from derived
+        # GB/s (bench._derived) so a jitter artifact can never masquerade
+        # as a best-of measurement.
+        return [max(int((run(1 + k) - t1) / k * 1e6), self.FLOOR_US)
                 for _ in range(iters)]
 
     # -- AES ---------------------------------------------------------------

@@ -73,15 +73,28 @@ def _csv(times_us: list[int]) -> str:
     return "".join(f"{t}, " for t in times_us).rstrip()
 
 
-def _derived(em, nbytes: int, times_us: list[int]):
+def _derived(em, nbytes: int, times_us: list[int], floor_us: int = 0):
     """Derived GB/s next to the raw µs row (SURVEY.md §5 metrics: the
     reference format 'plus derived GB/s'). Best steady iteration, like
     BASELINE.md derives its numbers; a comment-style line so the µs rows
-    stay byte-compatible with the reference parser."""
-    if not times_us or min(times_us) <= 0:
+    stay byte-compatible with the reference parser. `floor_us` entries are
+    the chained-timing jitter sentinel (backends.TpuBackend.FLOOR_US) —
+    excluded so an artifact can never win best-of."""
+    # A chained difference at (or truncated to) the floor is below the
+    # methodology's resolution — jitter artifact or not, bytes/1µs would
+    # not be a trustworthy rate, so such rows get no derived line rather
+    # than a fabricated one.
+    valid = [t for t in times_us if t > floor_us]
+    if not valid:
+        if times_us:
+            em.line("# derived: n/a (all iterations at/below the chained-"
+                    "timing resolution floor)")
         return
-    em.line(f"# derived: {nbytes / min(times_us) / 1e3:.3f} GB/s (best of "
-            f"{len(times_us)})")
+    v = nbytes / min(valid) / 1e3
+    # Sequential-recurrence rows land far below 1 MB/s; fixed 3-decimal
+    # formatting would print them all as "0.000".
+    text = f"{v:.3f}" if v >= 0.1 else f"{v:.3g}"
+    em.line(f"# derived: {text} GB/s (best of {len(valid)})")
 
 
 def _time_us(fn) -> tuple[int, object]:
@@ -90,37 +103,44 @@ def _time_us(fn) -> tuple[int, object]:
     return (time.perf_counter_ns() - t0) // 1000, out
 
 
-def _chain_k(size: int, cap_mib: int = 512, max_k: int = 512) -> int:
+def _chain_k(size: int, cap_mib: int = 512, max_k: int = 512,
+             min_k: int = 4) -> int:
     """Chain length for chained-difference device timing (backends.py:
     chained_device_times_us) — THE one policy every chained row shares:
     scale inversely with buffer size so the chained work dominates timer
     noise at small buffers without making the 1 GiB rows pay hundreds of
     passes. `cap_mib` bounds the total chained bytes and `max_k` the pass
-    count — the sequential scan modes pass small ones: each of their
-    passes is already tens of ms of serial recurrence, so a long chain
-    buys no noise margin and costs minutes."""
-    return max(4, min(max_k, (cap_mib * MIB) // max(size, 1)))
+    count; the sequential scan modes pass small ones with `min_k=1`: a
+    single scan pass is already seconds of serial recurrence (noise-free
+    without chaining), so at sizes past `cap_mib` the chain collapses to
+    one pass instead of costing minutes."""
+    return max(min_k, min(max_k, (cap_mib * MIB) // max(size, 1)))
 
 
-def _mode_crypt(backend, mode, ctx, workers, ctr_be=None, ivw=None):
+def _mode_crypt(backend, mode, ctx, workers, ctr_be=None, ivw=None,
+                chained=True):
     """The ONE mode dispatch both timing paths share: returns
-    crypt(words, acc) with the chain carry injected where the mode's
-    expensive work reads it — CTR: the counter (a data-only carry lets
-    XLA hoist the whole keystream out of a chained loop); every other
-    mode: the data words. The per-call paths run crypt(w, 0); inside jit
-    the ^0 folds away, outside it is one cheap pass."""
+    crypt(words, acc). When `chained`, the carry is injected where the
+    mode's expensive work reads it — CTR: the counter (a data-only carry
+    lets XLA hoist the whole keystream out of a chained loop); every
+    other mode: the data words. The per-call paths pass chained=False so
+    the injection disappears entirely: the backend mode functions are
+    themselves the jit boundary, so an eager `w ^ 0` here would be a
+    full-buffer device (or numpy, --backend c) pass INSIDE the timed
+    region."""
+    mix = (lambda x, acc: x ^ acc) if chained else (lambda x, acc: x)
     if mode == "ctr":
-        return lambda w, acc: backend.ctr(ctx, w, ctr_be ^ acc, workers)
+        return lambda w, acc: backend.ctr(ctx, w, mix(ctr_be, acc), workers)
     if mode == "ecb":
-        return lambda w, acc: backend.ecb(ctx, w ^ acc, workers)
+        return lambda w, acc: backend.ecb(ctx, mix(w, acc), workers)
     if mode == "ecb-dec":
-        return lambda w, acc: backend.ecb_dec(ctx, w ^ acc, workers)
+        return lambda w, acc: backend.ecb_dec(ctx, mix(w, acc), workers)
     if mode == "cbc":
-        return lambda w, acc: backend.cbc(ctx, w ^ acc, ivw, workers)
+        return lambda w, acc: backend.cbc(ctx, mix(w, acc), ivw, workers)
     if mode == "cbc-dec":
-        return lambda w, acc: backend.cbc_dec(ctx, w ^ acc, ivw, workers)
+        return lambda w, acc: backend.cbc_dec(ctx, mix(w, acc), ivw, workers)
     if mode == "cfb128":
-        return lambda w, acc: backend.cfb128(ctx, w ^ acc, ivw, workers)
+        return lambda w, acc: backend.cfb128(ctx, mix(w, acc), ivw, workers)
     raise ValueError(mode)
 
 
@@ -163,13 +183,13 @@ def run_aes_mode(em, backend, mode, size, workers_list, iters, keybits, rng,
                 ivw=backend.iv_words(IV) if needs_iv else None)
             words = backend.stage_words(msg)
             backend.block_until_ready(words)
-            k = (_chain_k(size, 8, max_k=4) if mode in ("cbc", "cfb128")
-                 else _chain_k(size))
+            k = (_chain_k(size, 8, max_k=4, min_k=1)
+                 if mode in ("cbc", "cfb128") else _chain_k(size))
             times = backend.chained_device_times_us(crypt, words, iters, k)
             label = backend.name.upper()
             em.line(f"{label} AES-{keybits} {mode.upper()}, {size}, "
                     f"{workers}, {_csv(times)}")
-            _derived(em, size, times)
+            _derived(em, size, times, backend.FLOOR_US)
             continue
         times = []
         warmed = False
@@ -199,7 +219,8 @@ def run_aes_mode(em, backend, mode, size, workers_list, iters, keybits, rng,
             crypt = _mode_crypt(
                 backend, mode, ctx, workers,
                 ctr_be=backend.ctr_be_words(NONCE) if mode == "ctr" else None,
-                ivw=backend.iv_words(IV) if needs_iv else None)
+                ivw=backend.iv_words(IV) if needs_iv else None,
+                chained=False)
             run = lambda w: crypt(w, 0)
 
             if not warmed:
@@ -255,8 +276,10 @@ def run_cbc_batch(em, backend, size, workers_list, iters, keybits, rng,
                                                      workers)
             words = backend.stage_batch_words(msg)
             backend.block_until_ready(words)
+            # min_k=1 like the cbc/cfb128 rows: per-stream this is the same
+            # serial scan, so past cap_mib one pass is already noise-free.
             times = backend.chained_device_times_us(
-                crypt, words, iters, _chain_k(used, 64, max_k=16))
+                crypt, words, iters, _chain_k(used, 64, max_k=16, min_k=1))
         else:
             times = []
             warmed = False
@@ -283,7 +306,8 @@ def run_cbc_batch(em, backend, size, workers_list, iters, keybits, rng,
                 times.append(us)
         em.line(f"{backend.name.upper()} AES-{keybits} CBC-BATCHx{streams}, "
                 f"{used}, {workers}, {_csv(times)}")
-        _derived(em, used, times)
+        _derived(em, used, times,
+                 getattr(backend, "FLOOR_US", 0) if chained_ok else 0)
         # Worker-count invariance on a fixed key/IV set (the same determinism
         # check the block-mode sweeps run); compare-and-discard so peak host
         # memory stays at one extra output regardless of the worker list.
@@ -409,7 +433,8 @@ def run_rc4(em, backend, size, workers_list, iters, rng, timing="e2e"):
                 )
                 times.append(us)
         em.line(f"{_csv(times)}")
-        _derived(em, size, times)
+        _derived(em, size, times,
+                 getattr(backend, "FLOOR_US", 0) if chained_ok else 0)
         # XOR phase correctness (the reference checked nothing here).
         if out is not None and not np.array_equal(np.asarray(out), msg ^ np.asarray(ks)):
             em.line(f"RC4 XOR MISMATCH at workers={workers}")

@@ -400,11 +400,14 @@ def cbc_encrypt_words(words, iv_words, rk, nr):
     w2 = _as_block_words(words)
 
     def step(iv, p):
-        c = block.encrypt_words(p ^ iv, rk, nr)
+        c = block.encrypt_block_fused(p ^ iv, rk, nr)
         return c, c
 
-    # unroll amortises per-step scan overhead over the unavoidable
-    # block-to-block dependency (SURVEY.md §7 hard part #3).
+    # Fused-gather body (block.encrypt_block_fused: one gather per round
+    # instead of 16) — the scan recurrence is latency-bound, 3.4x measured
+    # on chip vs the per-word core; unroll amortises per-step scan overhead
+    # over the unavoidable block-to-block dependency (SURVEY.md §7 hard
+    # part #3; unroll itself measured a null lever, docs/PERF.md).
     iv_out, out = jax.lax.scan(step, iv_words, w2, unroll=4)
     return out.reshape(words.shape), iv_out
 
@@ -463,7 +466,7 @@ def cfb128_encrypt_words(words, iv_words, rk, nr):
     w2 = _as_block_words(words)
 
     def step(iv, p):
-        c = p ^ block.encrypt_words(iv, rk, nr)
+        c = p ^ block.encrypt_block_fused(iv, rk, nr)
         return c, c
 
     iv_out, out = jax.lax.scan(step, iv_words, w2, unroll=4)

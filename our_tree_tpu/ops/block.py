@@ -84,6 +84,53 @@ def encrypt_words(x: jnp.ndarray, rk: jnp.ndarray, nr: int) -> jnp.ndarray:
     return jnp.stack(out, axis=-1)
 
 
+def encrypt_block_fused(x: jnp.ndarray, rk: jnp.ndarray, nr: int) -> jnp.ndarray:
+    """Latency-oriented single-block encrypt: ONE gather per round.
+
+    `encrypt_words` issues 16 independent scalar gathers per round — fine
+    when a large block axis amortises them, but inside a sequential-mode
+    `lax.scan` body (CBC/CFB encrypt, reference aes.c:757-816/822-863,
+    necessarily serial) each gather pays device dispatch latency and the
+    measured cost is ~103 us/block on a v5e chip. The reference's round
+    reads each output word from T-tables indexed by a rotating byte
+    pattern (AES_FROUND, aes.c:601-622): src(j, i) = (j + i) mod 4 — i.e.
+    byte-plane i of the state, rolled by i. Stacking the four rolled
+    byte-planes gives all 16 T-table indices as one (16,) vector into the
+    concatenated (1024,) table, so a round is one fused gather + a 4-way
+    XOR reduce: ~30 us/block measured, 3.4x the per-word formulation
+    (docs/PERF.md ledger; one-hot MXU lookups measure the same, the floor
+    is per-round dependency latency, not the lookup mechanism).
+
+    x: (4,) u32 LE state words of ONE block. Batch callers should keep
+    using `encrypt_words`; scan bodies and their vmapped stream batches
+    use this.
+    """
+    tcat = jnp.asarray(np.concatenate([tables.FT0, tables.FT1,
+                                       tables.FT2, tables.FT3]))
+    fsb = jnp.asarray(tables.SBOX)
+    rk = rk.astype(jnp.uint32)
+    x = x ^ rk[0:4]
+
+    def rolled_idx(x, offset_stride):
+        # idx[j, i] = byte-plane i of word (j + i) mod 4  (+ table offset)
+        cols = []
+        for i in range(4):
+            bi = (x >> jnp.uint32(8 * i)) & jnp.uint32(0xFF)
+            cols.append(jnp.roll(bi, -i) + jnp.uint32(offset_stride * i))
+        return jnp.stack(cols, axis=1).reshape(-1)  # (16,)
+
+    for r in range(1, nr):
+        vals = _tbl(tcat, rolled_idx(x, 256)).reshape(4, 4)
+        x = (rk[4 * r : 4 * r + 4]
+             ^ vals[:, 0] ^ vals[:, 1] ^ vals[:, 2] ^ vals[:, 3])
+
+    # Final round: SubBytes + ShiftRows + AddRoundKey (no MixColumns) —
+    # same roll pattern, S-box values recombined by byte position.
+    sv = _tbl(fsb, rolled_idx(x, 0)).reshape(4, 4)
+    y = sv[:, 0] | (sv[:, 1] << 8) | (sv[:, 2] << 16) | (sv[:, 3] << 24)
+    return rk[4 * nr : 4 * nr + 4] ^ y
+
+
 def decrypt_words(x: jnp.ndarray, rk_dec: jnp.ndarray, nr: int) -> jnp.ndarray:
     """Decrypt a batch of blocks with a decryption schedule from `expand_key_dec`."""
     rt0, rt1, rt2, rt3 = (jnp.asarray(t) for t in (tables.RT0, tables.RT1, tables.RT2, tables.RT3))
