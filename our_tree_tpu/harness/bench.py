@@ -103,7 +103,7 @@ def _time_us(fn) -> tuple[int, object]:
     return (time.perf_counter_ns() - t0) // 1000, out
 
 
-def _chain_k(size: int, cap_mib: int = 512, max_k: int = 512,
+def _chain_k(size: int, cap_mib: int = 2048, max_k: int = 2048,
              min_k: int = 4) -> int:
     """Chain length for chained-difference device timing (backends.py:
     chained_device_times_us) — THE one policy every chained row shares:
@@ -113,7 +113,14 @@ def _chain_k(size: int, cap_mib: int = 512, max_k: int = 512,
     count; the sequential scan modes pass small ones with `min_k=1`: a
     single scan pass is already seconds of serial recurrence (noise-free
     without chaining), so at sizes past `cap_mib` the chain collapses to
-    one pass instead of costing minutes."""
+    one pass instead of costing minutes.
+
+    Sizing rule: per-pass noise is (dispatch+sync jitter)/k — ms-scale on
+    a tunnelled transport — so k must be large enough that noise is a few
+    percent of a pass, or best-of-N picks the noise floor and the derived
+    GB/s overstates the kernel (observed: 1.5 TB/s "XOR" rows, above HBM
+    bandwidth, under the old 512 MiB cap). The fast XOR phase passes a
+    bigger cap than the AES modes for the same reason (run_rc4)."""
     return max(min_k, min(max_k, (cap_mib * MIB) // max(size, 1)))
 
 
@@ -421,8 +428,11 @@ def run_rc4(em, backend, size, workers_list, iters, rng, timing="e2e"):
             # carry keeps the passes data-dependent; see backends.py).
             crypt = lambda d, acc: backend.arc4_crypt(
                 d ^ acc.astype(d.dtype), ks_dev, workers)
+            # XOR is ~25x faster per byte than the AES kernels: the chain
+            # needs proportionally more passes before the chained work
+            # dominates transport jitter (see _chain_k's sizing rule).
             times = backend.chained_device_times_us(
-                crypt, data_dev, iters, _chain_k(size))
+                crypt, data_dev, iters, _chain_k(size, 8192, 8192))
         else:
             times = []
             for _ in range(iters):

@@ -291,7 +291,11 @@ def _engine_knobs_key(engine: str):
     if engine in PALLAS_BACKED:
         from ..ops import pallas_aes
 
-        return (pallas_aes.TILE, pallas_aes.MC_LOWERING)
+        # The per-size map is part of the key: its selection is a pure
+        # function of (map, shape) and shape is already a trace key, so
+        # keying the map itself is what makes a map change a cache miss.
+        return (pallas_aes.TILE, pallas_aes.MC_LOWERING,
+                tuple(sorted(pallas_aes.TILE_BY_MIB.items())))
     return None
 
 

@@ -61,6 +61,25 @@ if MC_LOWERING not in ("perm", "roll"):
         f"OT_PALLAS_MC must be 'perm' or 'roll', got {MC_LOWERING!r}"
     )
 
+#: Per-size tile overrides: {MiB ceiling: tile}, applied by message size
+#: BEFORE the flat TILE (tile_for_blocks) — the per-size tune sweep
+#: (scripts/tune_tile_sizes.py) persists winners here when a size bucket
+#: prefers a different tile than the global winner (VERDICT r4 #7).
+#: Empty by default; an explicit OT_PALLAS_TILE pin outranks the map
+#: (enforced at the apply site, same precedence as the flat knob).
+TILE_BY_MIB: dict[int, int] = {}
+
+
+def tile_for_blocks(n_blocks: int) -> int:
+    """Effective tile knob for an n-block batch: the smallest configured
+    size-bucket ceiling that covers the batch, else the flat TILE."""
+    if TILE_BY_MIB:
+        nbytes = 16 * n_blocks
+        for ceil_mib in sorted(TILE_BY_MIB):
+            if nbytes <= ceil_mib << 20:
+                return TILE_BY_MIB[ceil_mib]
+    return TILE
+
 
 def apply_knobs(kn: dict, respect_env: bool = True) -> dict:
     """Apply persisted tuned kernel knobs (utils/ranking.py:knobs) to this
@@ -84,12 +103,21 @@ def apply_knobs(kn: dict, respect_env: bool = True) -> dict:
     """
     from ..utils.ranking import _KNOB_VALID  # single source of validity
 
-    global TILE, MC_LOWERING
+    global TILE, MC_LOWERING, TILE_BY_MIB
     applied = {}
+    tile_pinned = respect_env and "OT_PALLAS_TILE" in os.environ
     tile = kn.get("tile")
-    if (_KNOB_VALID["tile"](tile) and tile != TILE
-            and not (respect_env and "OT_PALLAS_TILE" in os.environ)):
+    if _KNOB_VALID["tile"](tile) and tile != TILE and not tile_pinned:
         TILE = applied["tile"] = tile
+    # The per-size map rides the same env pin as the flat tile: an
+    # explicit OT_PALLAS_TILE means "this tile, for everything".
+    by_mib = kn.get("tile_by_mib")
+    if _KNOB_VALID["tile_by_mib"](by_mib) and not tile_pinned:
+        as_int = {int(k): v for k, v in by_mib.items()}
+        if as_int != TILE_BY_MIB:
+            TILE_BY_MIB = as_int
+            applied["tile_by_mib"] = ",".join(
+                f"<={k}MiB:{v}" for k, v in sorted(as_int.items()))
     mc = kn.get("mc")
     if (_KNOB_VALID["mc"](mc) and mc != MC_LOWERING
             and not (respect_env and "OT_PALLAS_MC" in os.environ)):
@@ -319,7 +347,7 @@ def _lane_pad_and_tile(n: int) -> tuple[int, int]:
     point so the padding invariant cannot drift between them.
     """
     w_lanes = (n + 31) // 32
-    tile = min(TILE, w_lanes)
+    tile = min(tile_for_blocks(n), w_lanes)
     pad = 32 * ((w_lanes + tile - 1) // tile * tile) - n
     return pad, tile
 

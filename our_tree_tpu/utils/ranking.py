@@ -179,9 +179,24 @@ def probe_order(platform: str, available) -> list[str]:
 #: Mirrors the OT_PALLAS_TILE / OT_PALLAS_MC import-time constraints.
 #: Invalid values are dropped on READ, not trusted because a writer once
 #: validated them — the file may be foreign or hand-edited.
+def _valid_tile(v) -> bool:
+    return (isinstance(v, int) and not isinstance(v, bool)
+            and v > 0 and v % 128 == 0)
+
+
+def _valid_tile_by_mib(v) -> bool:
+    """{"<=MiB ceiling as str-int>": tile} — JSON object keys are strings,
+    so the ceiling is serialized as a decimal string; values obey the same
+    constraint as "tile". The map may be empty-invalid but not empty-valid:
+    an empty dict stores nothing worth remembering."""
+    return (isinstance(v, dict) and bool(v)
+            and all(isinstance(k, str) and k.isdigit() and int(k) > 0
+                    and _valid_tile(t) for k, t in v.items()))
+
+
 _KNOB_VALID = {
-    "tile": lambda v: (isinstance(v, int) and not isinstance(v, bool)
-                       and v > 0 and v % 128 == 0),
+    "tile": _valid_tile,
+    "tile_by_mib": _valid_tile_by_mib,
     "mc": lambda v: v in ("perm", "roll"),
 }
 

@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""The honest sequential baseline: ARC4 keystream generation ON DEVICE.
+
+The framework routes ARC4's sequential keygen phase to the native C core
+by design (the phase split exists so serial work runs on the best serial
+processor — harness/backends.py:arc4_setup_prep); the on-device lax.scan
+path exists for parity and for hosts without a C toolchain. VERDICT r4 #6
+asks what that scan actually costs on the chip — the reference published
+its own sequential baseline (RC4 keygen 0.037 GB/s, results.myth.1:38),
+so this framework publishes its device scan rate too, however bad.
+
+Measures, on the real chip: the single-stream device scan at --sizes-kb,
+warmed (compile excluded), per-call sync timing (passes are seconds, the
+~0.1 s transport round trip is noise); the native C keygen on the same
+host for contrast. Prints one JSON line per measurement plus a derived
+s/GiB extrapolation for the device scan.
+
+    python scripts/arc4_device_keygen.py          # 64 KiB + 1 MiB
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _devlock_loader import load_devlock  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sizes-kb", default="64,1024")
+    ap.add_argument("--iters", type=int, default=3)
+    args = ap.parse_args()
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
+
+    import numpy as np
+    import jax
+
+    from our_tree_tpu.models.arc4 import ARC4, keystream_scan
+
+    assert jax.devices()[0].platform != "cpu", "need the real chip"
+    key = bytes(range(1, 17))
+    devlock = load_devlock()
+    with devlock.hold(wait_budget_s=900.0):
+        for kb in [int(s) for s in args.sizes_kb.split(",") if s]:
+            n = kb << 10
+            import jax.numpy as jnp
+
+            rc = ARC4(key)  # host KSA; the scan times pure PRGA
+            state = (jnp.uint32(rc.x), jnp.uint32(rc.y),
+                     jnp.asarray(rc.m, jnp.uint32))
+            run = lambda st: keystream_scan(st, n)[1]
+
+            def barrier(x):
+                # Scalar readback = the real completion barrier on the
+                # tunnelled transport (backends.py:block_until_ready:
+                # jax.block_until_ready alone can return early there).
+                jax.block_until_ready(x)
+                np.asarray(x.ravel()[-1:])
+                return x
+
+            ref = np.asarray(barrier(run(state)))  # compile
+            # Parity against the host path before trusting the timing.
+            assert np.array_equal(ref, ARC4(key).prep(n)), "device != host"
+            times = []
+            for _ in range(args.iters):
+                t0 = time.perf_counter()
+                barrier(run(state))
+                times.append(time.perf_counter() - t0)
+            best = min(times)
+            print(json.dumps({
+                "what": "arc4-keygen-device-scan", "bytes": n,
+                "best_s": round(best, 3),
+                "mb_per_s": round(n / best / 1e6, 4),
+                "s_per_gib_extrapolated": round(best * (1 << 30) / n, 1),
+            }), flush=True)
+
+        # Native C keygen on the same host, same sizes, for the contrast
+        # line (this is what production arc4_setup_prep actually runs).
+        try:
+            from our_tree_tpu.runtime import native
+
+            native.load()
+            for kb in [int(s) for s in args.sizes_kb.split(",") if s]:
+                n = kb << 10
+                nat = native.NativeARC4(key)
+                t0 = time.perf_counter()
+                ks = nat.prep(n)
+                dt = time.perf_counter() - t0
+                assert np.array_equal(np.asarray(ks), ARC4(key).prep(n))
+                print(json.dumps({
+                    "what": "arc4-keygen-native-c", "bytes": n,
+                    "best_s": round(dt, 5),
+                    "mb_per_s": round(n / dt / 1e6, 1),
+                }), flush=True)
+        except Exception as e:  # no C toolchain: the device row stands alone
+            print(json.dumps({"what": "arc4-keygen-native-c",
+                              "unavailable": type(e).__name__}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

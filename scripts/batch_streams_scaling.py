@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Stream-axis scaling of the batch sequence-parallel modes (PARITY's
+"what cannot parallelise within a stream scales across streams").
+
+Fixes the total byte count and sweeps the stream count: each doubling
+halves the per-stream serial scan length while filling more VPU lanes, so
+total GB/s should rise until the lane axis saturates. Measured for both
+batch surfaces — cbc-batch (AES recurrence per stream) and rc4-batch
+(per-byte PRGA per stream) — on the live chip, per-call sync timing
+(passes are long; the ~0.1 s transport round trip is noise).
+
+    python scripts/batch_streams_scaling.py            # 16 MiB total
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _devlock_loader import load_devlock  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--total-mb", type=float, default=16)
+    ap.add_argument("--streams", default="32,128,512,2048,8192")
+    ap.add_argument("--iters", type=int, default=2)
+    args = ap.parse_args()
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
+
+    import numpy as np
+    import jax
+
+    from our_tree_tpu.harness.backends import TpuBackend
+
+    assert jax.devices()[0].platform != "cpu", "need the real chip"
+    backend = TpuBackend("auto")
+    total = int(args.total_mb * (1 << 20))
+    rng = np.random.default_rng(1337)
+
+    def timed_best(fn):
+        # backend.block_until_ready, NOT jax.block_until_ready: on the
+        # tunnelled transport the latter can return before the work is
+        # done (backends.py:block_until_ready docstring) — timing around
+        # it would under-report exactly like the jitter class PERF.md
+        # ledger #13 documents.
+        backend.block_until_ready(fn())  # compile + warm
+        best = None
+        for _ in range(args.iters):
+            t0 = time.perf_counter()
+            backend.block_until_ready(fn())
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        return best
+
+    devlock = load_devlock()
+    with devlock.hold(wait_budget_s=900.0):
+        for streams in [int(s) for s in args.streams.split(",") if s]:
+            per = (total // streams) // 16 * 16
+            if per < 16:
+                continue
+            used = per * streams
+            # cbc-batch: S independent CBC-encrypt scans.
+            msg = rng.integers(0, 256, (streams, per), dtype=np.uint8)
+            ctx = backend.make_key(bytes(range(16)))
+            words = backend.stage_batch_words(msg)
+            ivw = backend.stage_batch_words(
+                rng.integers(0, 256, (streams, 16), dtype=np.uint8))
+            best = timed_best(lambda: backend.cbc_batch(ctx, words, ivw, 1))
+            print(json.dumps({
+                "what": "cbc-batch", "streams": streams, "bytes": used,
+                "best_s": round(best, 3),
+                "mb_per_s": round(used / best / 1e6, 2)}), flush=True)
+            # rc4-batch: S independent PRGA scans (keystream stays on
+            # device, no staging by construction).
+            keys = [rng.integers(0, 256, 16, dtype=np.uint8).tobytes()
+                    for _ in range(streams)]
+            states = backend.arc4_batch_states(keys)
+            ks_len = total // streams
+            best = timed_best(
+                lambda: backend.arc4_prep_batch(states, ks_len, 1))
+            print(json.dumps({
+                "what": "rc4-batch", "streams": streams,
+                "bytes": ks_len * streams, "best_s": round(best, 3),
+                "mb_per_s": round(ks_len * streams / best / 1e6, 2)}),
+                flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""Per-size tile tuning: does a buffer-size bucket prefer its own tile?
+
+VERDICT r4 #7: the flat tuned tile (utils/ranking knob "tile") was chosen
+at one probe size; small buffers might prefer a different grid shape. This
+sweep measures CTR GB/s for tiles x sizes on the live chip (tune_tpu's
+chained-difference child, one subprocess per cell — tile is an import-time
+constant) and persists `tile_by_mib` entries ONLY for buckets whose winner
+beats the stored flat tile by a real margin; otherwise it reports the
+documented null result. Run alone (single-tenant tunnel).
+
+    python scripts/tune_tile_sizes.py                # 1,8,64 MiB x tiles
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _devlock_loader import load_devlock, load_ranking  # noqa: E402
+import tune_tpu  # noqa: E402  (CHILD snippet + default mirrors)
+
+#: A per-size override must beat the flat tile by this factor to be
+#: persisted — chained-difference run-to-run spread at small sizes is a
+#: few percent, and a map entry costs every later reader a compile key.
+MARGIN = 1.05
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sizes-mb", default="1,8,64")
+    ap.add_argument("--tiles", default="128,256,512,1024")
+    ap.add_argument("--engine", default="auto",
+                    help="engine per cell; 'auto' resolves the persisted "
+                         "ranking winner in the child")
+    ap.add_argument("--timeout", type=float, default=420.0)
+    ap.add_argument("--dry-run", action="store_true",
+                    help="measure and report; do not persist")
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
+    sizes = [float(s) for s in args.sizes_mb.split(",") if s]
+    tiles = [int(t) for t in args.tiles.split(",") if t]
+    devlock = load_devlock()
+    ranking = load_ranking()
+
+    cells: dict[float, dict[int, float]] = {}
+    digests: dict[float, set] = {}
+    platforms = set()
+    with devlock.hold(wait_budget_s=900.0,
+                      on_wait=lambda p: print(f"# waiting for {p}",
+                                              file=sys.stderr)):
+        for mib in sizes:
+            nbytes = int(mib * (1 << 20)) // 16 * 16
+            # Chain sizing follows harness/bench.py:_chain_k's rule: ~2 GiB
+            # of chained work so per-pass noise (ms jitter / k) is well
+            # under the 5% persist margin — the 512 MiB cap measurably
+            # inflated 1-100 MiB best-of rows 10-15% (PERF.md ledger #13).
+            k = max(4, min(2048, (2048 << 20) // nbytes))
+            for tile in tiles:
+                env = dict(os.environ, OT_PALLAS_TILE=str(tile))
+                code = tune_tpu.CHILD % {"repo": REPO, "nbytes": nbytes,
+                                         "iters": k, "engine": args.engine}
+                tag = f"size={mib:g}MiB tile={tile:<5}"
+                try:
+                    out = subprocess.run(
+                        [sys.executable, "-u", "-c", code], env=env,
+                        timeout=args.timeout,
+                        capture_output=True, text=True, check=True)
+                    r = json.loads(out.stdout.strip().splitlines()[-1])
+                    cells.setdefault(mib, {})[tile] = r["gbps"]
+                    digests.setdefault(mib, set()).add(r["digest"])
+                    platforms.add(r.get("platform", "unknown"))
+                    print(f"{tag} ->  {r['gbps']:7.3f} GB/s  "
+                          f"digest={r['digest']:#010x}", flush=True)
+                except subprocess.TimeoutExpired:
+                    print(f"{tag} ->  TIMEOUT", flush=True)
+                except subprocess.CalledProcessError as e:
+                    msg = (e.stderr or "").strip().splitlines()
+                    print(f"{tag} ->  FAILED "
+                          f"({msg[-1] if msg else 'no stderr'})", flush=True)
+
+    bad = [m for m, d in digests.items() if len(d) > 1]
+    if bad:
+        print(f"WARNING: digests disagree within size(s) {bad} — a tile "
+              "computed different ciphertext; not persisting",
+              file=sys.stderr)
+        return 1
+    if not cells or len(platforms) != 1:
+        print("# nothing measured on a single platform; not persisting")
+        return 1
+    platform = platforms.pop()
+    stored = ranking.knobs(platform)
+    flat_tile = stored.get("tile", tune_tpu._DEFAULT_TILE)
+
+    overrides = {}
+    for mib in sorted(cells):
+        row = cells[mib]
+        best_tile = max(row, key=row.get)
+        base = row.get(flat_tile)
+        verdict = f"winner tile={best_tile} ({row[best_tile]:.3f} GB/s)"
+        if base is None:
+            verdict += f"; flat tile={flat_tile} not measured — skipping"
+        elif best_tile != flat_tile and row[best_tile] > MARGIN * base:
+            # ceil, not truncate: a 1.5 MiB measurement must label a
+            # bucket that COVERS 1.5 MiB ("<=2"), and a sub-MiB size must
+            # not produce the key "0" (invalid, and _valid_tile_by_mib is
+            # all-or-nothing on read — one bad key drops the whole map).
+            overrides[str(max(1, math.ceil(mib)))] = best_tile
+            verdict += (f" beats flat tile={flat_tile} ({base:.3f}) by "
+                        f"{row[best_tile] / base:.2f}x -> persist")
+        else:
+            verdict += (f"; flat tile={flat_tile} ({base:.3f}) within "
+                        f"{MARGIN:.2f}x -> null result, no override")
+        print(f"# {mib:g} MiB: {verdict}")
+
+    if not overrides:
+        print("# NULL RESULT: no size bucket beats the flat tile by "
+              f">{MARGIN:.2f}x; tile_by_mib left unset")
+        return 0
+    if args.dry_run:
+        print(f"# dry run: would persist tile_by_mib={overrides}")
+        return 0
+    # store_knobs REPLACES the knob record — carry the flat knobs through
+    # so the per-size map lands beside them, not instead of them.
+    merged = {k: v for k, v in stored.items() if k in ("tile", "mc")}
+    merged["tile_by_mib"] = overrides
+    if ranking.store_knobs(platform, merged, "tile-size-sweep",
+                           int(max(sizes) * (1 << 20))):
+        print(f"# persisted tile_by_mib={overrides} beside {merged}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -234,6 +234,13 @@ def main() -> int:
             # something else (apples vs oranges). Drop them; engines that
             # ignore the knobs keep their rows.
             new_knobs = {"tile": ref_tile, "mc": ref_mc}
+            # Carry a persisted per-size tile map through: store_knobs
+            # REPLACES the knob record, and this flat sweep measured
+            # nothing about the per-size buckets (tune_tile_sizes.py owns
+            # that record; it carries tile/mc through symmetrically).
+            prev_by_mib = ranking.knobs(platform).get("tile_by_mib")
+            if prev_by_mib:
+                new_knobs["tile_by_mib"] = prev_by_mib
             # "Changed" is measured against the setting prior rows were
             # ACTUALLY measured under — stored knobs when present, else
             # the defaults. A never-stored file whose rows were measured
@@ -243,7 +250,10 @@ def main() -> int:
             prev_kn = ranking.knobs(platform)
             prev_setting = {"tile": prev_kn.get("tile", _DEFAULT_TILE),
                             "mc": prev_kn.get("mc", _DEFAULT_MC)}
-            knobs_changed = persist_knobs and prev_setting != new_knobs
+            # Compare the flat setting only: the carried-through per-size
+            # map is not part of what this sweep measured or changed.
+            knobs_changed = persist_knobs and prev_setting != {
+                "tile": new_knobs["tile"], "mc": new_knobs["mc"]}
             drop = [e for e in (ranking.order(platform) or [])
                     if e.startswith("pallas") and e not in best_by_engine
                     ] if knobs_changed else []

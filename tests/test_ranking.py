@@ -454,3 +454,52 @@ def test_compile_failure_under_applied_knobs_not_persisted(monkeypatch,
         assert ranking.dropped("tpu:TPU test") == set()  # ...no durable drop
     finally:
         aes_mod.PALLAS_BACKED.discard("fake-pallas")
+
+
+def test_tile_by_mib_validation_on_read(rank_file):
+    # Per-size map: str-digit MiB ceilings -> tile-valid values; anything
+    # else (bad key, bad tile, bool, empty map) drops on read.
+    good = {"1": 128, "64": 256}
+    rank_file.write_text(json.dumps({"tpu": {"ranking": [], "knobs": {
+        "tile": 256, "tile_by_mib": good}}}))
+    assert ranking.knobs("tpu") == {"tile": 256, "tile_by_mib": good}
+    for bad in ({"x": 128}, {"1": 100}, {"1": True}, {}, {"-1": 128}):
+        rank_file.write_text(json.dumps({"tpu": {"ranking": [], "knobs": {
+            "tile_by_mib": bad}}}))
+        assert ranking.knobs("tpu") == {}, bad
+
+
+def test_tile_for_blocks_selection(monkeypatch):
+    from our_tree_tpu.ops import pallas_aes
+
+    monkeypatch.setattr(pallas_aes, "TILE", 1024)
+    monkeypatch.setattr(pallas_aes, "TILE_BY_MIB", {1: 128, 64: 256})
+    mib_blocks = (1 << 20) // 16
+    assert pallas_aes.tile_for_blocks(mib_blocks) == 128          # <= 1 MiB
+    assert pallas_aes.tile_for_blocks(mib_blocks + 1) == 256      # <= 64 MiB
+    assert pallas_aes.tile_for_blocks(64 * mib_blocks) == 256
+    assert pallas_aes.tile_for_blocks(65 * mib_blocks) == 1024    # flat TILE
+    monkeypatch.setattr(pallas_aes, "TILE_BY_MIB", {})
+    assert pallas_aes.tile_for_blocks(1) == 1024
+
+
+def test_apply_knobs_tile_by_mib(monkeypatch):
+    from our_tree_tpu.models import aes as aes_mod
+    from our_tree_tpu.ops import pallas_aes
+
+    monkeypatch.setattr(pallas_aes, "TILE", 1024)
+    monkeypatch.setattr(pallas_aes, "TILE_BY_MIB", {})
+    monkeypatch.delenv("OT_PALLAS_TILE", raising=False)
+    applied = pallas_aes.apply_knobs({"tile_by_mib": {"8": 256}})
+    assert applied == {"tile_by_mib": "<=8MiB:256"}
+    assert pallas_aes.TILE_BY_MIB == {8: 256}
+    # Idempotent, and part of the pallas compile key (a map change must be
+    # a cache miss through the models-level entry points).
+    assert pallas_aes.apply_knobs({"tile_by_mib": {"8": 256}}) == {}
+    assert aes_mod._engine_knobs_key("pallas")[2] == ((8, 256),)
+    # An explicit OT_PALLAS_TILE pin means "this tile for everything":
+    # the map is ignored alongside the flat knob.
+    monkeypatch.setattr(pallas_aes, "TILE_BY_MIB", {})
+    monkeypatch.setenv("OT_PALLAS_TILE", "1024")
+    assert pallas_aes.apply_knobs({"tile_by_mib": {"8": 256}}) == {}
+    assert pallas_aes.TILE_BY_MIB == {}
