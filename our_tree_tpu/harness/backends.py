@@ -320,7 +320,8 @@ class TpuBackend:
         what cannot parallelise within a chained stream scales across
         streams (parallel/dist.py:cbc_encrypt_batch_sharded)."""
         out, _ = self._dist.cbc_encrypt_batch_sharded(
-            words_2d, ivs_2d, ctx.rk_enc, ctx.nr, self._mesh(workers)
+            words_2d, ivs_2d, ctx.rk_enc, ctx.nr, self._mesh(workers),
+            engine=self.engine,
         )
         return out
 

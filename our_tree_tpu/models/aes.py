@@ -416,21 +416,51 @@ def cbc_encrypt_words(words, iv_words, rk, nr):
     return out.reshape(words.shape), iv_out
 
 
-@functools.partial(jax.jit, static_argnums=(3,))
-def cbc_encrypt_words_batch(words, iv_words, rk, nr):
-    """Many independent CBC streams at once: vmap over the stream axis.
+@functools.partial(jax.jit, static_argnums=(3, 4, 5))
+def _cbc_encrypt_words_batch_jit(words, iv_words, rk, nr, engine, knobs):
+    del knobs  # compile-cache key only (see _engine_knobs_key)
+    iv0 = iv_words.reshape(-1, 4)
+    s = iv0.shape[0]
+    w3 = words.reshape(s, -1, 4)
+    # Scan over the BLOCK axis; each step encrypts one block from every
+    # stream as a single (S, 4) batch through the selected engine. The
+    # earlier formulation (vmap of the single-block scan body) was
+    # gather-bound at ~11 MB/s regardless of S; the batched engine step
+    # measured 65-126 MB/s at S=32-8192 on chip — the Pallas kernel's
+    # launch cost per step is far below S fused gathers (docs/PERF.md
+    # ledger #14). xs is kept flat (N, 4S) across the scan boundary so no
+    # materialised tensor carries a 4-wide minor dim (the 32x tiling-pad
+    # class of ledger #10).
+    xs = jnp.swapaxes(w3, 0, 1).reshape(w3.shape[1], -1)
+    enc = CORES[engine][0]
+
+    def step(iv, p):
+        c = enc(p.reshape(s, 4) ^ iv, rk, nr)
+        # Emit FLAT: lax.scan stacks the per-step outputs, and a stacked
+        # (N, S, 4) tensor pads its 4-wide minor dim 32x under TPU tiling
+        # (33.5 GiB asked for a 1 GiB batch — the ledger #10 class, third
+        # instance); (N, 4S) stacks dense.
+        return c, c.reshape(-1)
+
+    iv_out, ys = jax.lax.scan(step, iv0, xs)
+    out = jnp.swapaxes(ys.reshape(ys.shape[0], s, 4), 0, 1)
+    return out.reshape(words.shape), iv_out
+
+
+def cbc_encrypt_words_batch(words, iv_words, rk, nr, engine="jnp"):
+    """Many independent CBC streams at once: one engine call per block step.
 
     CBC encryption is a true per-stream recurrence (reference
     aes.c:799-813, necessarily serial there). The sequence-parallel answer
     is the same as ARC4's prep_batch (models/arc4.py): work that cannot
-    parallelise *within* a stream scales *across* streams — the batch axis
-    fills the VPU lanes, and parallel/dist.py shards it over chips.
+    parallelise *within* a stream scales *across* streams — each scan step
+    batches one block from every stream through the engine, and
+    parallel/dist.py shards the stream axis over chips.
     words: (S, N, 4) block words or (S, 4N) flat streams; iv_words: (S, 4).
     Returns (outputs, final ivs) just like cbc_encrypt_words, per stream.
     """
-    return jax.vmap(lambda w, iv: cbc_encrypt_words(w, iv, rk, nr))(
-        words, iv_words
-    )
+    return _cbc_encrypt_words_batch_jit(words, iv_words, rk, nr, engine,
+                                        _engine_knobs_key(engine))
 
 
 @functools.partial(jax.jit, static_argnums=(3, 4, 5))

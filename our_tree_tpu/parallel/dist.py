@@ -435,18 +435,23 @@ def _chained_dec_sharded(words, iv_words, rk, nr, mesh, axis, engine, mode):
     return out.reshape(words.shape)
 
 
-@functools.partial(jax.jit, static_argnames=("nr", "mesh", "axis"))
-def _cbc_batch_sharded_jit(words, ivs, rk, *, nr, mesh, axis):
+@functools.partial(jax.jit,
+                   static_argnames=("nr", "mesh", "axis", "engine",
+                                    "check_vma", "knobs"))
+def _cbc_batch_sharded_jit(words, ivs, rk, *, nr, mesh, axis, engine,
+                           check_vma, knobs):
+    del knobs  # compile-cache key only (models/aes.py:_engine_knobs_key)
     f = jax.shard_map(
-        lambda w, iv, k: cbc_encrypt_words_batch(w, iv, k, nr),
+        lambda w, iv, k: cbc_encrypt_words_batch(w, iv, k, nr, engine),
         mesh=mesh, in_specs=(P(axis), P(axis), P()),
         out_specs=(P(axis), P(axis)),
+        check_vma=check_vma,
     )
     return f(words, ivs, rk)
 
 
 def cbc_encrypt_batch_sharded(words, ivs, rk, nr, mesh: Mesh,
-                              axis: str = AXIS):
+                              axis: str = AXIS, engine: str = "auto"):
     """Independent CBC streams sharded over chips — pipeline-style sequence
     parallelism for the chained mode: each chip runs its own streams'
     recurrences concurrently; streams are independent so there is no
@@ -460,8 +465,10 @@ def cbc_encrypt_batch_sharded(words, ivs, rk, nr, mesh: Mesh,
     n_shards = mesh.devices.size
     padded_w, s = _pad_blocks(words, n_shards)
     padded_iv, _ = _pad_blocks(ivs, n_shards)
-    out, iv_out = _cbc_batch_sharded_jit(padded_w, padded_iv, rk, nr=nr,
-                                         mesh=mesh, axis=axis)
+    eng = resolve_engine(engine)
+    out, iv_out = _cbc_batch_sharded_jit(
+        padded_w, padded_iv, rk, nr=nr, mesh=mesh, axis=axis, engine=eng,
+        check_vma=_shard_check_vma(eng), knobs=_engine_knobs_key(eng))
     return out[:s], iv_out[:s]
 
 

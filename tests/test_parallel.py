@@ -256,6 +256,17 @@ def test_cbc_encrypt_batch_sharded_streams():
         np.asarray(outf).reshape(S, N, 4), np.asarray(out)
     )
     np.testing.assert_array_equal(np.asarray(ivf), np.asarray(iv_out))
+    # The production TPU path runs a pallas engine as the per-step batch
+    # body (docs/PERF.md ledger #14); interpreter-mode equality here pins
+    # the engine-bodied scan against the jnp reference per stream — for
+    # the base planes layout AND the production dense-bp engine, whose
+    # boundary relayout sees the small (S, 4) per-step batch shape no
+    # other path feeds it.
+    for eng in ("pallas", "pallas-dense-bp"):
+        outp, ivp = cbc_encrypt_batch_sharded(words, ivs, a.rk_enc, a.nr,
+                                              mesh, engine=eng)
+        np.testing.assert_array_equal(np.asarray(outp), np.asarray(out))
+        np.testing.assert_array_equal(np.asarray(ivp), np.asarray(iv_out))
 
 
 @pytest.mark.parametrize("nshards", [2, 4, 8])
