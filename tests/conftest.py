@@ -63,16 +63,30 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(skip)
 
 
+#: Modules that compile XLA programs by the dozens (engine gauntlets,
+#: mode matrices, sharded paths). The cache-clear mitigation below is
+#: scoped to these: the light modules (tables, ranking, keyschedule,
+#: devlock, ...) contribute a handful of compiles each, far below the
+#: accumulation threshold, and clearing after them buys nothing.
+_COMPILE_HEAVY = ("test_pallas", "test_pallas_modes", "test_pallas_grid",
+                  "test_modes", "test_parallel", "test_bitslice",
+                  "test_harness", "test_parity", "test_aot_compile",
+                  "test_multihost")
+
+
 @pytest.fixture(autouse=True, scope="module")
-def _clear_jax_caches_between_modules():
-    """Drop compiled executables after each test module.
+def _clear_jax_caches_between_modules(request):
+    """Drop compiled executables after each compile-heavy test module.
 
     The full suite compiles hundreds of XLA CPU programs in one process;
     past ~130 tests the accumulated compiler state reproducibly segfaulted
     XLA's CPU backend_compile on this class of host (single-core container,
     jaxlib 0.9.x) — always at the same downstream compile. Each module's
-    compilations are independent, so clearing between modules keeps the
-    per-process compiler footprint bounded without affecting coverage.
+    compilations are independent, so clearing between the heavy modules
+    keeps the per-process compiler footprint bounded without affecting
+    coverage (VERDICT r4 #9: scoped down from the every-module hammer —
+    the light modules' few compiles are noise against the threshold).
     """
     yield
-    jax.clear_caches()
+    if request.module.__name__.rsplit(".", 1)[-1] in _COMPILE_HEAVY:
+        jax.clear_caches()

@@ -35,10 +35,16 @@ def test_pallas_kernels_long_keys(keybytes, monkeypatch):
     rk = jnp.asarray(rk)
     nonce = np.frombuffer(bytes(range(200, 216)), np.uint8)
     ctr_be = jnp.asarray(packing.np_bytes_to_words(nonce).byteswap())
-    w = jnp.asarray(rng.integers(0, 2**32, (32 * 128, 4)).astype(np.uint32))
+    # 32*32 blocks (a partial 32-lane tile): the property under test is
+    # the nr>10/nr>12 straight-line ROUND paths, which are per-grid-step
+    # code independent of tile fill; full-tile multi-grid coverage lives
+    # in test_pallas_grid (AES-128). gt-bp shares gt's round structure —
+    # only the S-box circuit differs, pinned exhaustively in
+    # test_bitslice — so the tower/bp pair needs no long-key twin here.
+    w = jnp.asarray(rng.integers(0, 2**32, (32 * 32, 4)).astype(np.uint32))
     want_ctr = np.asarray(aes_mod.ctr_crypt_words(w, ctr_be, rk, nr, "jnp"))
     want_ecb = np.asarray(aes_mod.ecb_encrypt_words(w, rk, nr, "jnp"))
-    for engine in ("pallas", "pallas-gt", "pallas-gt-bp"):
+    for engine in ("pallas", "pallas-gt"):
         got = np.asarray(aes_mod.ctr_crypt_words(w, ctr_be, rk, nr, engine))
         np.testing.assert_array_equal(got, want_ctr, err_msg=f"ctr {engine}")
         got = np.asarray(aes_mod.ecb_encrypt_words(w, rk, nr, engine))
@@ -58,14 +64,19 @@ def test_ctr_flat_stream_equals_block_words():
     rk = jnp.asarray(rk)
     nonce = np.frombuffer(bytes(range(50, 66)), np.uint8)
     ctr_be = jnp.asarray(packing.np_bytes_to_words(nonce).byteswap())
-    data = rng.integers(0, 256, 16 * 77, np.uint8)
+    # 33 blocks: crosses the 32-block lane boundary (pad path) while
+    # keeping interpreter cost bounded; one engine per boundary layout —
+    # the property under test is the models-level flat/(N, 4) wrapper,
+    # and the -bp variants share their base engine's boundary code
+    # exactly (they differ only in the in-kernel S-box circuit).
+    data = rng.integers(0, 256, 16 * 33, np.uint8)
     w2 = jnp.asarray(packing.np_bytes_to_words(data).reshape(-1, 4))
     wf = jnp.asarray(packing.np_bytes_to_words(data))
-    for engine in ("jnp", "bitslice", "pallas", "pallas-gt", "pallas-gt-bp",
+    for engine in ("jnp", "bitslice", "pallas", "pallas-gt",
                    "pallas-dense"):
         o2 = np.asarray(aes_mod.ctr_crypt_words(w2, ctr_be, rk, nr, engine))
         of = np.asarray(aes_mod.ctr_crypt_words(wf, ctr_be, rk, nr, engine))
-        assert of.shape == (4 * 77,)
+        assert of.shape == (4 * 33,)
         np.testing.assert_array_equal(of.reshape(-1, 4), o2, err_msg=engine)
 
 
@@ -74,14 +85,15 @@ def test_pallas_engine_ctr_context():
     """The pallas core through the CTR mode path and the AES context."""
     from our_tree_tpu.models.aes import AES
 
-    data = np.random.default_rng(9).integers(0, 256, 16 * 40 + 7, np.uint8)
+    # One engine per boundary layout + the ragged tail; gt-bp differs
+    # from gt only in the S-box circuit (exhaustively pinned elsewhere).
+    data = np.random.default_rng(9).integers(0, 256, 16 * 20 + 7, np.uint8)
     nonce = np.arange(16, dtype=np.uint8)
     outs = {}
-    for engine in ("jnp", "pallas", "pallas-gt", "pallas-gt-bp",
-                   "pallas-dense"):
+    for engine in ("jnp", "pallas", "pallas-gt", "pallas-dense"):
         a = AES(bytes(range(16)), engine=engine)
         outs[engine], *_ = a.crypt_ctr(0, nonce.copy(),
                                        np.zeros(16, np.uint8), data)
-    for engine in ("pallas", "pallas-gt", "pallas-gt-bp", "pallas-dense"):
+    for engine in ("pallas", "pallas-gt", "pallas-dense"):
         np.testing.assert_array_equal(outs["jnp"], outs[engine],
                                       err_msg=engine)
