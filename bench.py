@@ -74,17 +74,45 @@ _T0 = time.perf_counter()
 
 # Bare-file loads (not package imports — the package pulls jax in before
 # _ensure_live_backend has decided the platform), through the ONE shared
-# loader the sweep scripts use.
+# loader the sweep scripts use. The resilience modules are registered
+# under their canonical dotted names so the jax-side package code shares
+# the same fault counters and degradation ledger (docs/RESILIENCE.md).
 sys.path.insert(0, os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "scripts"))
-from _devlock_loader import load_devlock, load_ranking  # noqa: E402
+from _devlock_loader import load_devlock, load_ranking, load_resilience  # noqa: E402
 
 devlock = load_devlock()
 ranking = load_ranking()
+faults = load_resilience("faults")
+repolicy = load_resilience("policy")
+degrade = load_resilience("degrade")
 
 
 def _left() -> float:
     return DEADLINE_S - (time.perf_counter() - _T0)
+
+
+def _burn(seconds: float) -> None:
+    """Debit `seconds` from the deadline budget without sleeping.
+
+    Injected hangs (OT_FAULTS=init_hang) go through here: a real hang
+    burns its attempt's full timeout of wall clock, and the retry/stop
+    arithmetic below is tuned against exactly that cost — simulating the
+    failure without simulating its budget debit would rehearse a cheaper
+    outage than the one that actually happens.
+    """
+    global _T0
+    _T0 -= seconds
+
+
+def _demote_to_cpu(why: str) -> None:
+    """THE tpu->cpu demotion: env + jax.config pin plus the visible
+    degradation record every fallback JSON line carries (_report)."""
+    degrade.degrade("tpu->cpu", why)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 
 def _ensure_live_backend() -> None:
@@ -101,56 +129,66 @@ def _ensure_live_backend() -> None:
     pre-register an accelerator plugin can clobber JAX_PLATFORMS at
     interpreter start (see tests/conftest.py), and the env var alone would
     leave this process initializing the very tunnel the caller opted out of.
+
+    Retry shape (resilience.policy.RetryPolicy, shared with the native
+    build and the recovery watcher): up to 3 attempts — a tunnelled
+    backend can be wedged transiently (observed: PJRT init hanging for
+    minutes after a remote-pool hiccup, then recovering), and one failed
+    probe must not demote a healthy accelerator run to CPU numbers — with
+    retries stopping early once the deadline budget drops under 0.6x.
+    The FIRST attempt (and any explicitly-set OT_BENCH_INIT_TIMEOUT) gets
+    the full init window — a healthy-but-slow tunnel recovery must not be
+    demoted by an over-eager cap. RETRIES are capped at DEADLINE/4 and
+    half the remaining budget: a genuinely hung backend burns two full
+    default windows (2 x INIT_TIMEOUT_S = 0.4x the default deadline),
+    crosses the 0.6 stop threshold, and demotes after exactly two hanging
+    attempts — leaving the CPU-fallback headline real wall clock. The
+    deterministic rehearsal of that worst case is OT_FAULTS=init_hang:2
+    (each injected hang debits its attempt's timeout via _burn), which is
+    also the fault-matrix CI job's scenario (docs/RESILIENCE.md).
     """
     if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
         import jax
 
         jax.config.update("jax_platforms", "cpu")
         return
-    # Up to 3 probe attempts: a tunnelled device backend can be wedged
-    # transiently (observed: PJRT client init hanging for minutes after a
-    # remote-pool hiccup, then recovering), and one failed probe would
-    # otherwise demote a healthy accelerator run to CPU numbers. Attempts
-    # stop early when the overall deadline budget runs short.
-    # The FIRST attempt (and any explicitly-set OT_BENCH_INIT_TIMEOUT) gets
-    # the full init window — a healthy-but-slow tunnel recovery must not be
-    # demoted to CPU numbers by an over-eager cap. RETRIES are capped at
-    # DEADLINE/6 and half the remaining budget, so a genuinely hung backend
-    # cannot eat 3 full INIT_TIMEOUT_S windows and squeeze the CPU-fallback
-    # headline against the deadline.
     explicit = "OT_BENCH_INIT_TIMEOUT" in os.environ
-    last = None
-    for attempt in range(3):
-        if attempt and _left() < 0.6 * DEADLINE_S:
-            break
-        if attempt == 0:
+
+    def probe(attempt):
+        if attempt.index == 0:
             probe_timeout = max(min(INIT_TIMEOUT_S, _left() - 30.0), 5.0)
         else:
-            # An explicit OT_BENCH_INIT_TIMEOUT lifts the DEADLINE/6 cap on
+            # An explicit OT_BENCH_INIT_TIMEOUT lifts the DEADLINE/4 cap on
             # retries, but never the half-remaining-budget one: the fallback
             # headline must keep real wall clock even with env-pinned values.
             cap = _left() / 2.0 if explicit else min(
-                DEADLINE_S / 6.0, _left() / 2.0)
+                DEADLINE_S / 4.0, _left() / 2.0)
             probe_timeout = max(min(INIT_TIMEOUT_S, cap), 5.0)
-        try:
-            subprocess.run(
-                [sys.executable, "-c", "import jax; jax.devices()"],
-                timeout=probe_timeout,
-                check=True,
-                stdout=subprocess.DEVNULL,
-                stderr=subprocess.DEVNULL,
-            )
-            return
-        except (subprocess.TimeoutExpired, subprocess.CalledProcessError) as e:
-            last = e
-            print(f"# accelerator init probe attempt {attempt + 1} failed "
-                  f"({type(e).__name__})", file=sys.stderr)
-    print(f"# accelerator init unavailable ({type(last).__name__}); "
-          "falling back to CPU", file=sys.stderr)
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    import jax
+        if faults.fire("init_hang"):
+            _burn(probe_timeout)
+            raise faults.InjectedFault(
+                f"init_hang (simulated {probe_timeout:.0f}s probe hang)")
+        subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=probe_timeout,
+            check=True,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
 
-    jax.config.update("jax_platforms", "cpu")
+    repolicy.RetryPolicy(
+        attempts=3,
+        name="pjrt-init-probe",
+        retry_on=(subprocess.TimeoutExpired, subprocess.CalledProcessError,
+                  faults.InjectedFault),
+        stop_when=lambda a: _left() < 0.6 * DEADLINE_S,
+        log=lambda a, e: print(
+            f"# accelerator init probe attempt {a.index + 1} failed "
+            f"({type(e).__name__})", file=sys.stderr),
+        on_exhausted=lambda last: _demote_to_cpu(
+            f"accelerator init unavailable "
+            f"({type(last).__name__ if last else 'unknown'})"),
+    ).run(probe)
 
 
 @contextlib.contextmanager
@@ -300,6 +338,7 @@ def main() -> None:
             # host-runtime number, clearly labeled.
             print("# device busy (live devlock holder); not contending — "
                   "reporting the native host runtime", file=sys.stderr)
+            degrade.degrade("tpu->cpu", "device busy (live devlock holder)")
             _report_native("cpu (device busy)")
             return
     try:
@@ -347,7 +386,11 @@ def _report(measured_bytes: int, platform: str, engine: str, digest: int,
     funnels through here so the schema cannot drift between them. `value`
     is a MEDIAN whenever `spread` (min, max, count) is present; min/max
     ride in the same line so a judge comparing rounds sees the error bars,
-    not just the lottery draw (VERDICT r4 weak #3)."""
+    not just the lottery draw (VERDICT r4 weak #3). Any graceful demotion
+    recorded through the shared chokepoint (resilience.degrade — tpu->cpu,
+    device->native, engine fallbacks) rides the line as `degraded:[...]`,
+    so a fallback run can never masquerade as a healthy one; a healthy run
+    carries no such key."""
     line = {
         "metric": f"AES-128-{OP.upper()} throughput, "
                   f"{measured_bytes >> 20} MiB buffer, "
@@ -360,6 +403,8 @@ def _report(measured_bytes: int, platform: str, engine: str, digest: int,
         lo, hi, n = spread
         line["value_min"], line["value_max"] = round(lo, 4), round(hi, 4)
         line["reps"] = n
+    if degrade.events():
+        line["degraded"] = degrade.events()
     # flush: under an orchestrator stdout is a block-buffered log file, and
     # a post-report teardown hang (abandoned transfer on a wedged tunnel)
     # would otherwise get the process SIGKILLed with the line still queued.
@@ -442,6 +487,8 @@ def _measure_and_report() -> None:
             raise  # a hung CPU op is a real bug, not a tunnel symptom
         print("# first device op hung (init ok, execution wedged); "
               "falling back to the native host runtime", file=sys.stderr)
+        degrade.degrade("tpu->cpu", "first device op hung "
+                        "(init ok, execution wedged)")
         # JSON line always prints, even with no native build on this host —
         # a zero-value line that names the failure beats a traceback the
         # driver can't parse.
@@ -509,7 +556,11 @@ def _measure_and_report() -> None:
         # sits under a wall-clock alarm: a device that hangs mid-transfer or
         # mid-readback must become a catchable failure, not a silent stall
         # past the driver's own timeout with no JSON line. Callers bound
-        # cheap stages (probes) tighter than the headline.
+        # cheap stages (probes) tighter than the headline. The
+        # dispatch_fail injection point sits at the same seam: a scripted
+        # OT_FAULTS sequence rehearses exactly the failure the alarm
+        # exists for, without needing a wedged device.
+        faults.check("dispatch_fail", "bench measure dispatch")
         with _stage_alarm(_stage_budget(
                 stage_budget or max(60.0, _left() - 30.0))):
             words = jax.device_put(
@@ -664,11 +715,16 @@ def _measure_and_report() -> None:
             # degraded with only the type name in the log).
             print(f"# headline failed ({type(e).__name__}: {e})"[:500]
                   + "; reporting probe-size result", file=sys.stderr)
+            injected = isinstance(e, faults.InjectedFault)
             if not probes:
-                if platform == "cpu" or not isinstance(e, TimeoutError):
+                if (platform == "cpu" and not injected) or not isinstance(
+                        e, (TimeoutError, faults.InjectedFault)):
                     # Plain CPU failure, or a real device-side error (compile
                     # failure, OOM): surface it — converting a regression
-                    # into a plausible-looking CPU record would hide it.
+                    # into a plausible-looking CPU record would hide it. An
+                    # INJECTED failure is exempt: it stands in for a device
+                    # that died mid-dispatch, and the contract under test
+                    # is the JSON-line-always fallback, not the bug guard.
                     raise
                 # The stage alarm fired with nothing device-side succeeded:
                 # a half-recovered tunnel (init ok, execution hung). Last
@@ -680,8 +736,21 @@ def _measure_and_report() -> None:
                 r = _try_native()
                 if r is None:
                     raise e
+                degrade.degrade(
+                    "device->native",
+                    f"no device measurement succeeded "
+                    f"({type(e).__name__})")
                 measured_bytes, gbps, digest, engine, spread = r
-                platform = "cpu (accelerator hung)"
+                platform = ("cpu (accelerator hung)" if platform != "cpu"
+                            else platform)
+            else:
+                # Probe-size degraded result: a real number, but NOT the
+                # headline config — say so in the machine-readable record,
+                # not only in this stderr note.
+                degrade.degrade(
+                    "headline->probe",
+                    f"headline measurement failed ({type(e).__name__}); "
+                    f"probe-size result reported")
 
     # No accelerator reachable: the framework's own native runtime (C, with
     # AES-NI when the host has it) is the honest CPU number — report it when

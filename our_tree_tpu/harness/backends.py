@@ -21,6 +21,9 @@ import time
 
 import numpy as np
 
+from ..resilience import degrade as _degrade
+from ..resilience import faults as _faults
+
 
 class TpuBackend:
     """JAX/TPU execution: batched kernels, optional multi-chip sharding."""
@@ -81,6 +84,13 @@ class TpuBackend:
             except Exception as e:
                 if mode == "native":
                     raise
+                # Through the shared chokepoint: a sweep whose keygen rows
+                # silently timed the ~1000x-slower scan path is exactly
+                # the masquerade degrade() exists to prevent — the journal
+                # and any JSON reporting stamp this demotion.
+                _degrade.degrade(
+                    "native->lax.scan",
+                    f"native runtime unavailable ({type(e).__name__})")
                 print(f"# arc4 prep: native runtime unavailable "
                       f"({type(e).__name__}); keygen rows will time the "
                       "lax.scan path", file=sys.stderr)
@@ -117,7 +127,13 @@ class TpuBackend:
         O(N) pass to the timed region); the fixed round-trips are honest
         sync cost (the reference's GPU timings likewise include their sync,
         main_ecb_e.cu:37-44).
+
+        Carries the ``dispatch_fail`` injection point: the barrier is
+        where a wedged transport's hang actually surfaces, so
+        ``OT_FAULTS=dispatch_fail:N`` makes the first N barriers raise —
+        CI's stand-in for a mid-sweep tunnel death (docs/RESILIENCE.md).
         """
+        _faults.check("dispatch_fail", "TpuBackend.block_until_ready")
         self._jax.block_until_ready(x)
         for leaf in self._jax.tree_util.tree_leaves(x):
             if not getattr(leaf, "size", 0):
@@ -167,6 +183,12 @@ class TpuBackend:
             return jax.lax.fori_loop(jnp.uint32(0), kk, body, jnp.uint32(0))
 
         def run(kk):
+            # Injection on the dispatch itself (not only the staging
+            # barrier): a tunnel that wedges BETWEEN rows dies here, in
+            # the chained readback, and the sweep journal's resume story
+            # is rehearsed against exactly this raise.
+            _faults.check("dispatch_fail",
+                          "TpuBackend.chained_device_times_us")
             t0 = time.perf_counter()
             int(chained(words, jnp.uint32(kk)))
             return time.perf_counter() - t0

@@ -38,12 +38,15 @@ Differences from the reference, on purpose:
 from __future__ import annotations
 
 import argparse
+import os
 import socket
 import sys
 import time
 
 import numpy as np
 
+from ..resilience import degrade as degrade_mod
+from ..resilience import journal as journal_mod
 from .backends import make_backend
 
 MIB = 1 << 20
@@ -57,12 +60,24 @@ IV = np.frombuffer(bytes.fromhex("000102030405060708090a0b0c0d0e0f"), np.uint8)
 class Emitter:
     def __init__(self, path: str | None):
         self.f = open(path, "w") if path else None
+        self._capture: list[str] | None = None
 
     def line(self, text: str):
         print(text, flush=True)
         if self.f:
             self.f.write(text + "\n")
             self.f.flush()
+        if self._capture is not None:
+            self._capture.append(text)
+
+    def begin_capture(self):
+        """Start recording emitted lines (journal checkpointing: a resumed
+        sweep re-emits a completed unit's lines verbatim)."""
+        self._capture = []
+
+    def end_capture(self) -> list[str]:
+        lines, self._capture = self._capture or [], None
+        return lines
 
     def close(self):
         if self.f:
@@ -100,7 +115,16 @@ def _derived(em, nbytes: int, times_us: list[int], floor_us: int = 0):
 def _time_us(fn) -> tuple[int, object]:
     t0 = time.perf_counter_ns()
     out = fn()
-    return (time.perf_counter_ns() - t0) // 1000, out
+    us = (time.perf_counter_ns() - t0) // 1000
+    # Deterministic-clock test seam: with OT_FAKE_TIME_US set, every timed
+    # region reports that fixed µs value (the work still runs — only the
+    # CLOCK is faked). The journal-resume tests use it to make an
+    # interrupted+resumed sweep corpus byte-comparable to an uninterrupted
+    # one; timing rows are meaningless under it by construction.
+    fake = os.environ.get("OT_FAKE_TIME_US")
+    if fake:
+        return max(int(fake), 1), out
+    return us, out
 
 
 def _chain_k(size: int, cap_mib: int = 2048, max_k: int = 2048,
@@ -526,6 +550,16 @@ def main(argv=None) -> int:
                          "(e.g. results.$(hostname).tpu)")
     ap.add_argument("--default-out", action="store_true",
                     help="write to results.<host>.<backend>")
+    ap.add_argument("--journal", default=None, metavar="PATH",
+                    help="checkpoint/resume journal (JSONL; env "
+                         "OT_SWEEP_JOURNAL is the default): completed "
+                         "sweep units append as they finish, and a rerun "
+                         "with the SAME config skips them — re-emitting "
+                         "their recorded rows and restoring the RNG "
+                         "stream — so a SIGKILL/tunnel wedge mid-corpus "
+                         "resumes at the failed row instead of losing the "
+                         "run (docs/RESILIENCE.md). A changed config "
+                         "invalidates the journal")
     args = ap.parse_args(argv)
 
     backend = make_backend(args.backend, args.engine)
@@ -550,6 +584,64 @@ def main(argv=None) -> int:
     modes = [m.strip() for m in args.modes.split(",") if m.strip()]
     rng = np.random.default_rng(args.seed)  # srand(1337) of the reference
 
+    journal = None
+    journal_path = args.journal or os.environ.get("OT_SWEEP_JOURNAL")
+    if journal_path:
+        # The sweep's identity: everything that shapes the unit sequence
+        # or the bytes each unit emits. A rerun whose config hashes
+        # differently must NOT replay this journal (wrong rows into wrong
+        # slots); SweepJournal invalidates and starts fresh.
+        config = {
+            "backend": args.backend, "engine": args.engine, "sizes": sizes,
+            "workers": workers_list, "iters": args.iters,
+            "keybits": args.keybits, "modes": modes, "streams": args.streams,
+            "seed": args.seed, "timing": args.timing,
+            "stream_chunk_mb": args.stream_chunk_mb,
+        }
+        journal = journal_mod.SweepJournal(journal_path, config)
+        if journal.pending:
+            print(f"# journal: {journal.pending} completed unit(s) on file "
+                  f"({journal_path}); resuming", file=sys.stderr)
+
+    # The sweep as an ordered list of named UNITS — the journal's resume
+    # granularity. Unit order is a pure function of the config (the
+    # journal's replay contract); names carry mode and byte size so a
+    # human can read the journal.
+    def aes_unit(mode, size):
+        return lambda: run_aes_mode(em, backend, mode, size, workers_list,
+                                    args.iters, args.keybits, rng,
+                                    args.timing,
+                                    stream_chunk=args.stream_chunk_mb * MIB)
+
+    units = []
+    for mode in modes:
+        for size in sizes:
+            if mode == "rc4":
+                units.append((f"rc4:{size}",
+                              lambda size=size: run_rc4(
+                                  em, backend, size, workers_list,
+                                  args.iters, rng, args.timing)))
+            elif mode == "cbc-batch":
+                units.append((f"cbc-batch:{size}",
+                              lambda size=size: run_cbc_batch(
+                                  em, backend, size, workers_list,
+                                  args.iters, args.keybits, rng,
+                                  args.timing, args.streams)))
+            elif mode == "rc4-batch":
+                units.append((f"rc4-batch:{size}",
+                              lambda size=size: run_rc4_batch(
+                                  em, backend, size, workers_list,
+                                  args.iters, rng, args.streams)))
+            else:
+                units.append((f"{mode}:{size}", aes_unit(mode, size)))
+    if len(workers_list) > 1 and {"ecb", "ctr"} & set(modes):
+        units.append(("shard-invariance",
+                      lambda: check_shard_invariance(
+                          em, backend, min(sizes), workers_list,
+                          args.keybits, rng)))
+    if "rc4" in modes:
+        units.append(("arc4-self-test", lambda: arc4_self_test(em)))
+
     profiler_cm = None
     if args.profile and args.backend == "tpu":
         import contextlib
@@ -559,29 +651,50 @@ def main(argv=None) -> int:
         profiler_cm = contextlib.ExitStack()
         profiler_cm.enter_context(jax.profiler.trace(args.profile))
     try:
-        for mode in modes:
-            for size in sizes:
-                if mode == "rc4":
-                    run_rc4(em, backend, size, workers_list, args.iters, rng,
-                            args.timing)
-                elif mode == "cbc-batch":
-                    run_cbc_batch(em, backend, size, workers_list, args.iters,
-                                  args.keybits, rng, args.timing, args.streams)
-                elif mode == "rc4-batch":
-                    run_rc4_batch(em, backend, size, workers_list, args.iters,
-                                  rng, args.streams)
-                else:
-                    run_aes_mode(em, backend, mode, size, workers_list,
-                                 args.iters, args.keybits, rng, args.timing,
-                                 stream_chunk=args.stream_chunk_mb * MIB)
-        if len(workers_list) > 1 and {"ecb", "ctr"} & set(modes):
-            check_shard_invariance(em, backend, min(sizes), workers_list,
-                                   args.keybits, rng)
-        if "rc4" in modes:
-            arc4_self_test(em)
+        for name, run_unit in units:
+            entry = journal.skip(name) if journal is not None else None
+            if entry is not None:
+                # Completed in a previous (interrupted) run: re-emit the
+                # recorded rows verbatim, restore the shared RNG stream to
+                # its post-unit state, and restore the unit's recorded
+                # demotions into the live ledger — a degraded run resumed
+                # must still end with the same `# degraded:` trailer (and
+                # the same journal stamps) as its uninterrupted twin.
+                for line in entry.get("lines", []):
+                    em.line(line)
+                state = entry.get("rng_state")
+                if state is not None:
+                    rng.bit_generator.state = state
+                for kind in entry.get("degraded", []):
+                    degrade_mod.degrade(kind, "restored from journal")
+                continue
+            before = set(degrade_mod.events())
+            em.begin_capture()
+            try:
+                run_unit()
+            finally:
+                lines = em.end_capture()
+            if journal is not None:
+                # The DELTA, not the cumulative snapshot: each entry names
+                # the demotions its own unit introduced, so replay can
+                # restore them without every entry re-listing history.
+                journal.record(name, lines, rng.bit_generator.state,
+                               [k for k in degrade_mod.events()
+                                if k not in before])
+        if journal is not None and journal.resumed:
+            print(f"# journal: skipped {journal.resumed} completed unit(s)",
+                  file=sys.stderr)
+        # The visible degradation record (resilience.degrade): a corpus
+        # produced by a demoted configuration (native->lax.scan keygen,
+        # engine fallback) says so in the artifact itself, not only on a
+        # stderr stream some orchestrator rotated away.
+        if degrade_mod.events():
+            em.line("# degraded: " + ",".join(degrade_mod.events()))
     finally:
         if profiler_cm is not None:
             profiler_cm.close()
+        if journal is not None:
+            journal.close()
         em.close()
     return 0
 

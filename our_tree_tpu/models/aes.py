@@ -206,6 +206,20 @@ def _engine_compile_ok(eng: str, rank_key: str) -> bool:
     return True
 
 
+def _note_engine_demotion(skipped: list, chosen: str) -> None:
+    """Engine fallback through the shared degradation chokepoint
+    (resilience.degrade): "auto" routing around a compile-broken favourite
+    is the right call, but the run it happens in must carry the record —
+    the bench JSON line and the sweep journal stamp it as e.g.
+    ``degraded:["pallas-dense-bp->bitslice"]`` instead of the fallback
+    masquerading as the measured winner."""
+    from ..resilience import degrade as _degrade
+
+    _degrade.degrade(
+        f"{skipped[0]}->{chosen}",
+        f"engine(s) failed the compile probe: {', '.join(skipped)}")
+
+
 def resolve_engine(name: str | None = "auto") -> str:
     """Map "auto" to the best available engine for the current backend.
 
@@ -244,6 +258,7 @@ def resolve_engine(name: str | None = "auto") -> str:
                 pallas_aes.apply_stored_knobs(d)
         except Exception:
             rank_key = jax.default_backend()
+        skipped = []
         for eng in ranking.probe_order(rank_key, CORES):
             if eng not in CORES or (eng in PALLAS_BACKED and not allow_pallas):
                 continue
@@ -252,9 +267,15 @@ def resolve_engine(name: str | None = "auto") -> str:
             # interpreter mode have no first-contact compile risk.
             if (eng in PALLAS_BACKED and allow_pallas
                     and not _engine_compile_ok(eng, rank_key)):
+                skipped.append(eng)
                 continue
+            if skipped:
+                _note_engine_demotion(skipped, eng)
             return eng
-        return "bitslice" if "bitslice" in CORES else "jnp"
+        fallback = "bitslice" if "bitslice" in CORES else "jnp"
+        if skipped:
+            _note_engine_demotion(skipped, fallback)
+        return fallback
     if name not in CORES:
         raise ValueError(f"unknown engine {name!r}; available: {sorted(CORES)}")
     return name

@@ -1,0 +1,36 @@
+"""Shared resilience layer: retry policy, fault injection, journal, degrade.
+
+The repo grew three disjoint ad-hoc defenses against real failures (wedged
+PJRT tunnels, >1 h init hangs, SIGKILLed sweeps — utils/devlock.py,
+bench.py:_ensure_live_backend, scripts/recover_watch.py) with no shared
+policy and no way to exercise any of them in CI without a genuinely broken
+device. This package is the shared layer:
+
+* ``policy``  — the one retry/backoff/deadline primitive (attempts,
+  exponential backoff with deterministic jitter, per-attempt and total
+  budgets, on-exhaustion fallback) every hand-rolled retry loop routes
+  through.
+* ``faults``  — the deterministic injection seam (``OT_FAULTS=
+  init_hang:2,dispatch_fail:1,build_fail``): named points wired into the
+  real failure seams, a single dict lookup when unset, exact scripted
+  failure sequences when set — CI can rehearse a wedged tunnel on CPU.
+* ``journal`` — sweep checkpoint/resume: harness rows append to a JSONL
+  journal as they complete; a restarted sweep (same config hash) skips
+  completed rows instead of losing the run.
+* ``degrade`` — the one chokepoint every graceful demotion (tpu->cpu,
+  pallas->bitslice->jnp, native->lax.scan) reports through, so a fallback
+  run carries a visible ``degraded:[...]`` record and can never masquerade
+  as a healthy one.
+
+Every module here is stdlib-only and free of intra-package imports, for the
+same reason utils/devlock.py is: the repo-root ``bench.py`` and the sweep
+scripts load them as BARE files before deciding the jax platform (the
+package import pulls in jax). Bare loaders MUST register the module in
+``sys.modules`` under its canonical dotted name
+(``our_tree_tpu.resilience.<name>``) — see scripts/_devlock_loader.py —
+so the fault counters and the degradation record stay one-per-process no
+matter which context (bare or package) touches them first.
+
+The full fault matrix and the journal/resume contract are documented in
+docs/RESILIENCE.md.
+"""

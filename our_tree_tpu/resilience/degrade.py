@@ -1,0 +1,65 @@
+"""The one chokepoint every graceful demotion reports through.
+
+The repo degrades on purpose in several places — a failed init probe
+demotes tpu->cpu (repo-root bench.py), a Pallas engine that cannot compile
+is skipped for its ranked runner-up (models/aes.py:resolve_engine), an
+unbuildable native runtime sends ARC4 keygen to the lax.scan path
+(harness/backends.py) — and before this module each site only printed to
+stderr, which an orchestrator's log rotation eats. A fallback run could
+therefore masquerade as a healthy one in the artifacts that matter (the
+bench JSON line, the sweep journal).
+
+``degrade(kind, why)`` records the demotion in a process-global ledger;
+``events()`` returns the kinds in first-occurrence order for stamping into
+the bench JSON line (``"degraded": ["tpu->cpu"]`` — bench.py:_report) and
+the sweep journal entries (harness/bench.py). Kinds are small arrows
+naming the demotion: ``tpu->cpu``, ``pallas->bitslice``,
+``native->lax.scan``, ``device->native``, ``headline->probe``.
+
+Duplicate kinds collapse (resolve_engine runs per crypt-context; one
+demotion is one fact); the full (kind, why) pairs stay available via
+``detail()`` for diagnostics.
+
+Stdlib-only, no intra-package imports; bare loaders must register this
+module under ``our_tree_tpu.resilience.degrade`` in ``sys.modules`` so the
+ledger is one-per-process across bare and package import contexts (the
+repo-root bench.py records tpu->cpu in bare context but the engine
+demotion it must also report happens inside the package).
+"""
+
+from __future__ import annotations
+
+import sys
+
+#: (kind, why) in record order, duplicates (by kind) dropped.
+_EVENTS: list[tuple[str, str]] = []
+
+
+def degrade(kind: str, why: str = "") -> None:
+    """Record a graceful demotion and announce it on stderr.
+
+    `kind` is the arrow (``"tpu->cpu"``); `why` one human line. A kind
+    already recorded is not re-announced — callers may hit the same
+    chokepoint per-context (resolve_engine) without spamming the ledger.
+    """
+    if any(k == kind for k, _ in _EVENTS):
+        return
+    _EVENTS.append((kind, why))
+    print(f"# degraded: {kind}" + (f" ({why})" if why else ""),
+          file=sys.stderr, flush=True)
+
+
+def events() -> list[str]:
+    """Recorded demotion kinds, first-occurrence order. Empty = healthy."""
+    return [k for k, _ in _EVENTS]
+
+
+def detail() -> list[tuple[str, str]]:
+    """(kind, why) pairs, for diagnostics/tests."""
+    return list(_EVENTS)
+
+
+def clear() -> None:
+    """Reset the ledger (tests only — a real process's demotions are
+    facts about this process and must survive to the report)."""
+    del _EVENTS[:]

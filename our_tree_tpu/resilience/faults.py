@@ -1,0 +1,165 @@
+"""Deterministic fault injection seam (``OT_FAULTS``).
+
+The repo's defenses exist because of real failures — wedged PJRT tunnels,
+init hangs, SIGKILLed sweeps — but none of them could be exercised in CI
+without a genuinely broken device. This module is the seam: named injection
+points wired into the real failure sites consult a registry parsed once
+from ``OT_FAULTS``, so CI can script exact failure sequences on CPU and the
+production paths pay a single dict lookup when the variable is unset.
+
+Grammar::
+
+    OT_FAULTS=init_hang:2,dispatch_fail:1,build_fail
+
+Comma-separated tokens, each ``<point>[:<count>]``. A counted token arms
+the point for exactly ``count`` firings (the first ``count`` calls to
+``fire(point)`` return True, every later call False); a bare token arms it
+forever. Whitespace around tokens is tolerated; unknown point names are
+accepted but warned about on stderr (a typo that silently never fires
+would make a CI fault job vacuously green).
+
+Registered injection points (the fault matrix, docs/RESILIENCE.md):
+
+=================  ========================================================
+point              wired into
+=================  ========================================================
+``init_hang``      the PJRT init probe (repo-root ``bench.py:
+                   _ensure_live_backend``): the attempt behaves as a probe
+                   subprocess that hung for its full timeout.
+``dispatch_fail``  device dispatch: the first real device op of a
+                   measurement (``bench.py:measure``), the harness
+                   backend's completion barrier
+                   (``harness.backends.TpuBackend.block_until_ready``) and
+                   its chained-difference timing dispatch
+                   (``chained_device_times_us``).
+``build_fail``     the lazy native build (``runtime.native._build``): the
+                   ``make`` attempt fails as if the compiler had.
+``lock_busy``      devlock acquisition (``utils.devlock.acquire``): the
+                   marker behaves as held by a live concurrent job.
+=================  ========================================================
+
+Determinism contract: firings consume counts in call order within ONE
+process (the registry is process-local state; subprocesses re-parse the
+inherited env and count independently). ``fire`` never sleeps and never
+raises — simulating the *cost* of a fault (e.g. the wall clock a hang
+burns) is the injection point's job, so each seam stays honest about what
+its real failure does.
+
+Stdlib-only and free of intra-package imports: bare loaders (repo-root
+bench.py via scripts/_devlock_loader.py, utils/devlock.py's lazy hook)
+must register this module in ``sys.modules`` under
+``our_tree_tpu.resilience.faults`` so the counters stay one-per-process
+across bare and package import contexts.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+#: The names wired into real seams. Parsing accepts others (forward
+#: compat, tests), but warns — see module docstring.
+KNOWN_POINTS = ("init_hang", "dispatch_fail", "build_fail", "lock_busy")
+
+#: Sentinel count for a bare (uncounted) token: armed forever.
+ALWAYS = -1
+
+#: point -> remaining firings (ALWAYS = unbounded). ``None`` until the
+#: first fire()/reset() parses OT_FAULTS; ``{}`` thereafter when unset —
+#: the steady-state no-op is one None-check + one ``not {}``.
+_REGISTRY: dict[str, int] | None = None
+
+
+class InjectedFault(RuntimeError):
+    """Raised by injection points when their fault fires.
+
+    A subclass of RuntimeError so seams whose real failures are runtime
+    errors (a failed ``make``, a failed dispatch) retry/fall back through
+    the same handlers; sites that must tell an injected fault from a real
+    one (e.g. bench.py's don't-mask-real-CPU-bugs guard) test the type
+    explicitly.
+    """
+
+
+def _parse(spec: str) -> dict[str, int]:
+    reg: dict[str, int] = {}
+    for tok in spec.split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        name, sep, count = tok.partition(":")
+        name = name.strip()
+        if sep:
+            try:
+                n = int(count.strip())
+            except ValueError:
+                print(f"# OT_FAULTS: malformed token {tok!r} ignored",
+                      file=sys.stderr)
+                continue
+            if n <= 0:
+                continue  # zero-count = disarmed, silently fine
+        else:
+            n = ALWAYS
+        if name not in KNOWN_POINTS:
+            print(f"# OT_FAULTS: unknown injection point {name!r} "
+                  f"(known: {', '.join(KNOWN_POINTS)}) — armed anyway",
+                  file=sys.stderr)
+        # Repeated tokens accumulate (":2,x:1" == "x:3"); ALWAYS absorbs.
+        prev = reg.get(name, 0)
+        reg[name] = ALWAYS if ALWAYS in (prev, n) else prev + n
+    return reg
+
+
+def reset() -> None:
+    """Re-parse OT_FAULTS (tests that set the env after import)."""
+    global _REGISTRY
+    _REGISTRY = _parse(os.environ.get("OT_FAULTS", ""))
+
+
+def active() -> bool:
+    """True when any point is still armed (cheap post-parse)."""
+    if _REGISTRY is None:
+        reset()
+    return bool(_REGISTRY)
+
+
+def fire(point: str) -> bool:
+    """Consume one shot at `point`; True iff the fault fires now.
+
+    The ONE call every injection point makes. Never raises, never sleeps;
+    the point itself decides what its failure looks like (raise
+    InjectedFault, return a busy marker, debit a deadline budget...).
+    """
+    global _REGISTRY
+    reg = _REGISTRY
+    if reg is None:
+        reset()
+        reg = _REGISTRY
+    if not reg:
+        return False
+    n = reg.get(point, 0)
+    if n == 0:
+        return False
+    if n != ALWAYS:
+        if n == 1:
+            del reg[point]
+        else:
+            reg[point] = n - 1
+    print(f"# OT_FAULTS: injecting {point} "
+          f"({'unbounded' if n == ALWAYS else f'{n - 1} left'})",
+          file=sys.stderr)
+    return True
+
+
+def check(point: str, detail: str = "") -> None:
+    """Raise InjectedFault iff `point` fires — the common seam shape."""
+    if fire(point):
+        raise InjectedFault(f"injected fault: {point}"
+                            + (f" ({detail})" if detail else ""))
+
+
+def remaining(point: str) -> int:
+    """Shots left at `point` (ALWAYS for unbounded, 0 when disarmed)."""
+    if _REGISTRY is None:
+        reset()
+    return _REGISTRY.get(point, 0)

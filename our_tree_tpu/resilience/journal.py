@@ -1,0 +1,169 @@
+"""Sweep checkpoint/resume journal (JSONL).
+
+A SIGKILLed or tunnel-wedged sweep used to lose the whole run: the results
+corpus is written row-by-row, but a restart re-ran everything — including
+the rows that already completed — and on a flaky device usually died again
+before reaching the row that killed it. This journal is the moral
+extension of the reference's ``nc_off`` resume state (PAPER.md §5) from
+streams to whole sweeps: the harness appends one JSONL entry per completed
+sweep unit, and a restarted sweep with the SAME config hash replays the
+recorded units (re-emitting their result lines verbatim and restoring the
+shared RNG stream) and resumes execution at the first unfinished one.
+
+File format — line 1 is the header, every later line one completed unit::
+
+    {"kind": "ot-sweep-journal", "v": 1, "config_hash": "...", "config": {...}}
+    {"unit": "ecb:65536", "lines": [...], "rng_state": {...}, "degraded": []}
+
+Durability: entries are flushed + fsync'd as they complete, so a SIGKILL
+can tear at most the in-flight line; a torn or otherwise unparseable tail
+is truncated away on load (the valid prefix is trusted, nothing after it).
+A header whose ``config_hash`` does not match the current sweep's config
+invalidates the journal — the file is restarted fresh, because replaying
+rows from a different sweep shape would corrupt both the corpus and the
+RNG stream.
+
+Resume correctness rests on two facts the harness guarantees:
+
+* unit order is a pure function of the config (so the journal's entries
+  are a prefix of the rerun's unit sequence), and
+* each entry records the RNG state AFTER its unit ran, so skipping the
+  unit and restoring the state leaves later units byte-identical to an
+  uninterrupted run.
+
+Stdlib-only, no intra-package imports (bare-loadable; see the package
+docstring).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+KIND = "ot-sweep-journal"
+VERSION = 1
+
+
+def config_hash(config: dict) -> str:
+    """Stable hash of a sweep's identity (JSON-serializable config)."""
+    blob = json.dumps(config, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+class SweepJournal:
+    """One sweep's checkpoint file. See the module docstring for format.
+
+    ``skip(unit)`` returns the recorded entry when `unit` is the next
+    replayable one (consume in sweep order), else None — and a unit-order
+    mismatch (possible only if the unit sequence stopped being a pure
+    function of the hashed config) distrusts and truncates the remaining
+    tail rather than replaying rows into the wrong slots.
+    """
+
+    def __init__(self, path: str, config: dict):
+        self.path = path
+        self.config_hash = config_hash(config)
+        self._replay: list[dict] = []
+        self._resumed = 0
+        valid_bytes = 0
+        header_ok = False
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+        except OSError:
+            raw = b""
+        offset = 0
+        for line in raw.splitlines(keepends=True):
+            if not line.endswith(b"\n"):
+                break  # torn in-flight write: trust nothing from here on
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                break
+            if offset == 0:
+                if not (isinstance(rec, dict) and rec.get("kind") == KIND
+                        and rec.get("v") == VERSION
+                        and rec.get("config_hash") == self.config_hash):
+                    break  # foreign/changed config: invalidate everything
+                header_ok = True
+            elif isinstance(rec, dict) and isinstance(rec.get("unit"), str):
+                self._replay.append(rec)
+            else:
+                break
+            offset += len(line)
+            valid_bytes = offset
+        if not header_ok:
+            self._replay = []
+            valid_bytes = 0
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        # Truncate away any distrusted tail, then hold the file open in
+        # append mode; a fresh/invalidated journal gets its header now so
+        # a kill before the first completed row still leaves a valid file.
+        self._fh = open(path, "ab")
+        if self._fh.tell() != valid_bytes:
+            self._fh.truncate(valid_bytes)
+            self._fh.seek(valid_bytes)
+        if valid_bytes == 0:
+            self._append({"kind": KIND, "v": VERSION,
+                          "config_hash": self.config_hash, "config": config})
+
+    # -- internals ---------------------------------------------------------
+    def _append(self, rec: dict) -> None:
+        self._fh.write(json.dumps(rec, separators=(",", ":")).encode()
+                       + b"\n")
+        self._fh.flush()
+        os.fsync(self._fh.fileno())
+
+    # -- API ---------------------------------------------------------------
+    @property
+    def pending(self) -> int:
+        """Completed units not yet replayed this run."""
+        return len(self._replay)
+
+    @property
+    def resumed(self) -> int:
+        """Units replayed from the journal so far this run."""
+        return self._resumed
+
+    def skip(self, unit: str) -> dict | None:
+        """The recorded entry for `unit` iff it is next in replay order."""
+        if not self._replay:
+            return None
+        if self._replay[0].get("unit") != unit:
+            # Order mismatch: the stored tail cannot be mapped onto this
+            # run's remaining units. Re-run them (correctness over thrift)
+            # and drop the stale records so re-recorded entries don't
+            # duplicate them.
+            self._replay = []
+            self._truncate_to_consumed()
+            return None
+        self._resumed += 1
+        return self._replay.pop(0)
+
+    def _truncate_to_consumed(self) -> None:
+        """Rewrite the file as header + already-consumed entries. Only
+        reached on the defensive order-mismatch path; everything still in
+        self._replay is stale. Rebuild from scratch: cheapest correct
+        move for a path that should never execute."""
+        self._fh.close()
+        with open(self.path, "rb") as f:
+            lines = f.read().splitlines(keepends=True)
+        keep = 1 + self._resumed  # header + consumed prefix
+        self._fh = open(self.path, "wb")
+        for line in lines[:keep]:
+            self._fh.write(line)
+        self._fh.flush()
+        os.fsync(self._fh.fileno())
+
+    def record(self, unit: str, lines: list[str], rng_state=None,
+               degraded=()) -> None:
+        """Append one completed unit (flushed + fsync'd before return)."""
+        self._append({"unit": unit, "lines": list(lines),
+                      "rng_state": rng_state, "degraded": list(degraded)})
+
+    def close(self) -> None:
+        try:
+            self._fh.close()
+        except OSError:
+            pass

@@ -1,0 +1,173 @@
+"""The one retry/backoff/deadline primitive (``RetryPolicy``).
+
+Three hand-rolled retry loops grew independently in this repo — the PJRT
+init probe (repo-root ``bench.py:_ensure_live_backend``), the lazy native
+build (``runtime/native.py:_build``), and the tunnel-recovery watcher's
+probe loop (``scripts/recover_watch.py``) — each with its own attempt
+counting, budget arithmetic, and exhaustion behavior, and none testable
+against the others. This module is the shared policy they all route
+through:
+
+* bounded or unbounded **attempts**;
+* **exponential backoff** with optional deterministic jitter (seeded —
+  the same policy config always produces the same delay sequence, so CI
+  fault scripts stay exactly reproducible);
+* a per-attempt timeout hint and a **total budget** that stops retries
+  when spent;
+* per-exception **delay overrides** (an exception carrying
+  ``retry_delay_s`` names its own wait — the watcher's "device busy, poll
+  sooner" case — without the policy growing outcome-specific branches);
+* an **on-exhaustion fallback** callback, so "give up" is a visible,
+  typed decision (demote to CPU, raise) instead of loop fall-through.
+
+Stdlib-only, no intra-package imports (bare-loadable — see the package
+docstring). Stateless between ``run()`` calls: one policy object can be
+reused.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+
+
+class PolicyExhausted(Exception):
+    """Every attempt failed and no on_exhausted fallback was given.
+
+    ``last`` carries the final attempt's exception (also chained as
+    ``__cause__``); ``attempts`` how many were made.
+    """
+
+    def __init__(self, name: str, attempts: int, last: BaseException | None):
+        self.name, self.attempts, self.last = name, attempts, last
+        super().__init__(
+            f"{name or 'retry policy'}: exhausted after {attempts} "
+            f"attempt(s); last failure: "
+            f"{type(last).__name__ if last else 'none'}: {last}")
+
+
+class Attempt:
+    """What one attempt knows: its 0-based ``index``, the policy's
+    ``remaining_s`` budget (None = unbudgeted), and a ``timeout_s`` hint
+    (``per_attempt_s`` clamped to the remaining budget; None when neither
+    is configured). Ops are free to derive their own tighter timeout from
+    ``index``/``remaining_s`` — the bench init probe does."""
+
+    __slots__ = ("index", "timeout_s", "remaining_s")
+
+    def __init__(self, index: int, timeout_s: float | None,
+                 remaining_s: float | None):
+        self.index, self.timeout_s, self.remaining_s = (
+            index, timeout_s, remaining_s)
+
+
+class RetryPolicy:
+    """Configurable retry/backoff/deadline runner.
+
+    Parameters
+    ----------
+    attempts : int | None
+        Maximum attempts (None = unbounded; then ``budget_s`` and/or
+        ``stop_when`` must end the loop).
+    base_delay_s, factor, jitter_frac :
+        Backoff between failures: ``base_delay_s * factor**index``,
+        multiplied by ``1 + jitter_frac * u`` with ``u`` drawn from a
+        ``random.Random(jitter_seed)`` private to the run — deterministic
+        for a given config, never shared global-RNG state.
+    per_attempt_s : float | None
+        Timeout hint surfaced on each ``Attempt`` (clamped to the
+        remaining budget).
+    budget_s : float | None
+        Total wall budget measured by ``clock`` from ``run()`` entry;
+        once spent, no further retries (the in-flight attempt is not
+        interrupted — interruption stays the op's job, e.g. bench.py's
+        stage alarm).
+    stop_when : callable(Attempt) -> bool
+        Extra stop predicate checked before every RETRY (never before the
+        first attempt): return True to give up early.
+    retry_on : tuple[type, ...]
+        Exception types that mean "failed, maybe retry". Anything else
+        propagates immediately.
+    on_exhausted : callable(last_exc) -> value
+        Fallback producing ``run()``'s return value when every attempt
+        failed; when absent, ``PolicyExhausted`` is raised.
+    log : callable(Attempt, BaseException) | None
+        Per-failure observer (the callers' existing stderr diagnostics).
+    sleep, clock :
+        Injectable for tests (and for the watcher's ledger-aware sleep).
+    """
+
+    def __init__(self, *, attempts: int | None = 3, base_delay_s: float = 0.0,
+                 factor: float = 2.0, jitter_frac: float = 0.0,
+                 per_attempt_s: float | None = None,
+                 budget_s: float | None = None, stop_when=None,
+                 retry_on: tuple = (Exception,), on_exhausted=None,
+                 log=None, name: str = "", jitter_seed: int = 0,
+                 sleep=time.sleep, clock=time.monotonic):
+        if attempts is not None and attempts < 1:
+            raise ValueError(f"attempts must be >= 1 or None, got {attempts}")
+        self.attempts = attempts
+        self.base_delay_s = base_delay_s
+        self.factor = factor
+        self.jitter_frac = jitter_frac
+        self.per_attempt_s = per_attempt_s
+        self.budget_s = budget_s
+        self.stop_when = stop_when
+        self.retry_on = retry_on
+        self.on_exhausted = on_exhausted
+        self.log = log
+        self.name = name
+        self.jitter_seed = jitter_seed
+        self.sleep = sleep
+        self.clock = clock
+
+    def _delay(self, index: int, rng) -> float:
+        d = self.base_delay_s * (self.factor ** index)
+        if self.jitter_frac:
+            d *= 1.0 + self.jitter_frac * rng.random()
+        return d
+
+    def run(self, op):
+        """Call ``op(attempt)`` until it returns, retries are exhausted,
+        the budget is spent, or ``stop_when`` fires. Returns op's value,
+        the fallback's value, or raises ``PolicyExhausted`` / the first
+        non-``retry_on`` exception."""
+        rng = random.Random(self.jitter_seed)
+        t0 = self.clock()
+        last: BaseException | None = None
+        index = 0
+        while True:
+            remaining = (None if self.budget_s is None
+                         else self.budget_s - (self.clock() - t0))
+            timeout = self.per_attempt_s
+            if remaining is not None and timeout is not None:
+                timeout = max(min(timeout, remaining), 0.0)
+            attempt = Attempt(index, timeout, remaining)
+            try:
+                return op(attempt)
+            except self.retry_on as e:
+                last = e
+                if self.log is not None:
+                    self.log(attempt, e)
+            index += 1
+            if self.attempts is not None and index >= self.attempts:
+                break
+            remaining = (None if self.budget_s is None
+                         else self.budget_s - (self.clock() - t0))
+            if remaining is not None and remaining <= 0:
+                break
+            if self.stop_when is not None and self.stop_when(
+                    Attempt(index, self.per_attempt_s, remaining)):
+                break
+            # An exception that knows its own retry cadence overrides the
+            # computed backoff (e.g. the watcher's Busy-vs-Wedged polls).
+            delay = getattr(last, "retry_delay_s", None)
+            if delay is None:
+                delay = self._delay(index - 1, rng)
+            if delay > 0:
+                if remaining is not None:
+                    delay = min(delay, max(remaining, 0.0))
+                self.sleep(delay)
+        if self.on_exhausted is not None:
+            return self.on_exhausted(last)
+        raise PolicyExhausted(self.name, index, last) from last

@@ -13,12 +13,15 @@ cipher semantics, our own implementation.
 
 from __future__ import annotations
 
+import contextlib
 import ctypes
 import os
 import pathlib
 import subprocess
 
 import numpy as np
+
+from ..resilience import faults, policy
 
 _CSRC = pathlib.Path(__file__).parent / "csrc"
 _LIB_PATH = _CSRC / "libotcrypt.so"
@@ -34,22 +37,74 @@ class Arc4Ctx(ctypes.Structure):
                 ("m", ctypes.c_uint8 * 256)]
 
 
-def _build() -> None:
+def _fresh() -> bool:
     srcs = sorted(_CSRC.glob("*.c")) + sorted(_CSRC.glob("*.h")) + [
         _CSRC / "Makefile"
     ]
-    if _LIB_PATH.exists() and all(
+    return _LIB_PATH.exists() and all(
         _LIB_PATH.stat().st_mtime >= s.stat().st_mtime for s in srcs
-    ):
-        return
-    proc = subprocess.run(
-        ["make", "-C", str(_CSRC), "libotcrypt.so"],  # bindings need only
-        capture_output=True, text=True,               # the lib, not ot_bench
     )
-    if proc.returncode != 0:
-        raise RuntimeError(
-            f"native runtime build failed:\n{proc.stdout}\n{proc.stderr}"
-        )
+
+
+@contextlib.contextmanager
+def _build_lock():
+    """Exclusive flock on a sidecar lockfile for the `make` critical
+    section: two processes building the same libotcrypt.so concurrently
+    (the first importer in a sweep + a child job) interleave compiler
+    output and can corrupt the .so. Advisory-degrading like devlock — an
+    unopenable lockfile (read-only tree) yields without the lock, because
+    in that case `make` itself will fail with the real diagnostic."""
+    lockfile = str(_LIB_PATH) + ".lock"
+    try:
+        import fcntl
+        fd = os.open(lockfile, os.O_CREAT | os.O_RDWR, 0o644)
+    except (ImportError, OSError):
+        yield
+        return
+    try:
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX)
+        except OSError:
+            # Filesystem without flock support (some NFS mounts): degrade
+            # to the unguarded build rather than reporting the native
+            # runtime unavailable over a lock nobody could take.
+            yield
+            return
+        yield
+    finally:
+        os.close(fd)  # closing the fd releases the flock
+
+
+def _build() -> None:
+    if _fresh():
+        return
+    with _build_lock():
+        if _fresh():
+            return  # a concurrent builder won the lock and already built
+
+        def make(attempt):
+            # The injection point CI's fault matrix uses to prove the
+            # retry path: `OT_FAULTS=build_fail:1` fails exactly the
+            # first make attempt (docs/RESILIENCE.md).
+            faults.check("build_fail", "native runtime make")
+            proc = subprocess.run(
+                ["make", "-C", str(_CSRC), "libotcrypt.so"],  # bindings need
+                capture_output=True, text=True,  # only the lib, not ot_bench
+            )
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"native runtime build failed:\n{proc.stdout}\n"
+                    f"{proc.stderr}"
+                )
+
+        # Two attempts: a transiently-failing make (ENOSPC blip, a racing
+        # clean) gets one more try before the callers' own fallbacks
+        # (OT_ARC4_PREP=auto -> lax.scan, bench zero-line) take over; a
+        # deterministic compile error still fails fast with its full log.
+        policy.RetryPolicy(
+            attempts=2, base_delay_s=0.5, retry_on=(RuntimeError,),
+            name="native-build",
+        ).run(make)
 
 
 _u8p = np.ctypeslib.ndpointer(dtype=np.uint8, flags="C_CONTIGUOUS")

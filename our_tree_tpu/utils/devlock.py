@@ -9,13 +9,26 @@ the backend, sweep parents wait for a prior holder before starting.
 Design points (stdlib-only so the repo-root bench.py can load this file
 directly without importing the package, whose import pulls in jax):
 
-* **Atomic ownership** — acquisition is ``O_CREAT | O_EXCL`` with the PID
-  written into the file; an exists-then-create check would let two
-  processes both believe they own the marker.
+* **Atomic ownership** — acquisition is ``O_CREAT | O_EXCL`` with
+  ``pid:starttime`` written into the file (starttime from
+  ``/proc/<pid>/stat`` field 22 where available); an exists-then-create
+  check would let two processes both believe they own the marker.
 * **Staleness self-healing** — a marker is ignored (and reclaimed) when its
   writer PID is dead or, for PID-less markers (``touch`` by an
   orchestrator), when its mtime is older than STALE_S. A SIGKILLed job can
-  therefore never permanently tax every future bench run's deadline.
+  therefore never permanently tax every future bench run's deadline. The
+  recorded starttime closes the PID-reuse hole: a marker whose PID was
+  recycled by an unrelated long-lived process used to look live until
+  STALE_S (4 h); with both recorded, a starttime mismatch proves the
+  writer is gone and the marker is reclaimed immediately. Bare-PID markers
+  (older writers, other tooling) keep the previous PID+mtime semantics.
+* **Deterministic failure rehearsal** — the ``lock_busy`` fault-injection
+  point (resilience/faults.py): while armed, ``is_held`` reports a live
+  holder (a PEEK — no shot consumed) and each ``acquire`` consumes one
+  shot and fails. ``OT_FAULTS=lock_busy:N`` = N failed acquisitions;
+  bare ``OT_FAULTS=lock_busy`` = a holder that never goes away, which
+  drives the callers' full busy fallback (wait-out-budget ->
+  acquire-fails -> is_held-confirms) without a second process.
 * **Advisory, never blocking forever** — waiting callers proceed without
   ownership once their budget is spent: on a bench host, progress beats
   deadlock.
@@ -51,7 +64,54 @@ def path() -> str:
     return os.environ.get("OT_BENCH_BUSY_FILE", DEFAULT_PATH)
 
 
-def _writer_alive(pid: int) -> bool:
+def _faults():
+    """resilience/faults.py, loaded lazily WITHOUT importing the package
+    (this file is bare-loaded by jax-free parents — see module docstring).
+    Registered under the canonical dotted name so the counters are shared
+    with every other load context; see scripts/_devlock_loader.py."""
+    import sys
+    canonical = "our_tree_tpu.resilience.faults"
+    mod = sys.modules.get(canonical)
+    if mod is None:
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            canonical,
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         os.pardir, "resilience", "faults.py"))
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[canonical] = mod
+        try:
+            spec.loader.exec_module(mod)
+        except BaseException:
+            sys.modules.pop(canonical, None)
+            raise
+    return mod
+
+
+def _proc_starttime(pid: int) -> str | None:
+    """Kernel starttime ticks for `pid` (/proc/<pid>/stat field 22), or
+    None off-Linux / on any read failure. The (pid, starttime) pair is
+    unique for the machine's uptime — the identity a bare PID lacks."""
+    try:
+        with open(f"/proc/{pid}/stat", "rb") as f:
+            stat = f.read()
+        # comm (field 2) may contain spaces/parens; fields resume after
+        # the LAST ')'. starttime is overall field 22 -> index 19 after.
+        return stat.rsplit(b")", 1)[1].split()[19].decode()
+    except (OSError, IndexError, ValueError):
+        return None
+
+
+def _writer_alive(pid: int, starttime: str | None = None) -> bool:
+    if starttime:
+        now = _proc_starttime(pid)
+        if now is not None:
+            # Definitive either way: same starttime = same process still
+            # running; different = the writer died and the PID was
+            # recycled — the marker is stale NOW, not after STALE_S.
+            return now == starttime
+        # /proc says no such process — but distinguish "dead" from
+        # "unreadable" (non-Linux) via the signal probe below.
     try:
         os.kill(pid, 0)
         return True
@@ -61,24 +121,42 @@ def _writer_alive(pid: int) -> bool:
         return True  # EPERM etc.: someone's process — assume alive
 
 
+def _read_marker(p: str) -> tuple[int, str | None]:
+    """(pid, starttime) from a marker file: ``pid:starttime`` for writers
+    of this module, bare ``pid`` for older writers, (0, None) for a
+    PID-less orchestrator touch or an unreadable file."""
+    try:
+        with open(p) as f:
+            body = f.read().strip()
+    except OSError:
+        return 0, None
+    pid_s, _, start = body.partition(":")
+    try:
+        return int(pid_s or "0"), (start or None)
+    except ValueError:
+        return 0, None
+
+
 def is_held(p: str | None = None) -> bool:
     """True if the marker exists and its holder still looks alive."""
     p = p or path()
+    if _faults().remaining("lock_busy"):
+        # Peek, never consume: while lock_busy is armed the simulated
+        # holder "exists"; only acquire() attempts burn shots. This is
+        # what lets a counted config fail exactly N acquisitions while a
+        # bare config simulates a holder that outlasts any wait budget.
+        return True
     try:
         st = os.stat(p)
     except OSError:
         return False
-    try:
-        with open(p) as f:
-            pid = int(f.read().strip() or "0")
-    except (OSError, ValueError):
-        pid = 0
+    pid, start = _read_marker(p)
     fresh = time.time() - st.st_mtime <= STALE_S
     if pid:
-        # The mtime bound applies here too: PID reuse could otherwise make
-        # a SIGKILLed job's marker look held forever once an unrelated
-        # long-lived process recycles the number.
-        return _writer_alive(pid) and fresh
+        # The mtime bound still applies: for bare-PID markers it is the
+        # only cap on PID reuse, and even a starttime-carrying marker
+        # must not outlive the longest legitimate plan.
+        return _writer_alive(pid, start) and fresh
     # PID-less (touched by an orchestrator): only mtime can age it out.
     return fresh
 
@@ -100,16 +178,21 @@ def wait(budget_s: float, p: str | None = None, poll_s: float = 15.0,
 def acquire(p: str | None = None) -> bool:
     """Atomically claim the marker; True iff this process now owns it.
 
-    A stale marker (dead writer / aged-out) is reclaimed. Returning False
-    means another live holder exists (or the path is unwritable) — the
-    caller may still proceed, it just must not remove the marker.
+    A stale marker (dead writer / aged-out / recycled PID) is reclaimed.
+    Returning False means another live holder exists (or the path is
+    unwritable) — the caller may still proceed, it just must not remove
+    the marker.
     """
     p = p or path()
+    if _faults().fire("lock_busy"):
+        return False  # injected: behave as if a live holder owns the marker
     for _ in range(2):  # second try after reclaiming a stale marker
         try:
             fd = os.open(p, os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o644)
             with os.fdopen(fd, "w") as f:
-                f.write(str(os.getpid()))
+                pid = os.getpid()
+                start = _proc_starttime(pid)
+                f.write(f"{pid}:{start}" if start else str(pid))
             return True
         except FileExistsError:
             if is_held(p):

@@ -1,13 +1,15 @@
-"""Shared loader for utils/devlock.py used by the sweep scripts.
+"""Shared loader for jax-free bare-file imports used by the sweep scripts.
 
 The sweep parents are deliberately jax-free (they only spawn jax children),
-so devlock is loaded as a bare file instead of through the package import,
-which would pull jax in. Scripts import this sibling module (the script's
-own directory is on sys.path when run as `python scripts/<name>.py`).
+so devlock/ranking/resilience modules are loaded as bare files instead of
+through the package import, which would pull jax in. Scripts import this
+sibling module (the script's own directory is on sys.path when run as
+`python scripts/<name>.py`).
 """
 
 import importlib.util
 import os
+import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -28,3 +30,27 @@ def load_devlock():
 def load_ranking():
     """utils/ranking.py, bare-loaded for the same jax-free reason."""
     return _load_util("ranking")
+
+
+def load_resilience(name):
+    """resilience/<name>.py, bare-loaded — registered in sys.modules under
+    its CANONICAL dotted name so the fault counters / degradation ledger
+    stay one-per-process: a later package import (`from
+    our_tree_tpu.resilience import faults` inside jax-side code) finds and
+    reuses this very module instead of creating a second registry. The
+    utils/devlock.py lazy hook uses the same key for the same reason."""
+    canonical = f"our_tree_tpu.resilience.{name}"
+    mod = sys.modules.get(canonical)
+    if mod is not None:
+        return mod
+    spec = importlib.util.spec_from_file_location(
+        canonical,
+        os.path.join(REPO, "our_tree_tpu", "resilience", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[canonical] = mod
+    try:
+        spec.loader.exec_module(mod)
+    except BaseException:
+        sys.modules.pop(canonical, None)
+        raise
+    return mod

@@ -49,7 +49,32 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.join(REPO, "scripts"))
-from _devlock_loader import load_devlock  # noqa: E402
+from _devlock_loader import load_devlock, load_resilience  # noqa: E402
+
+repolicy = load_resilience("policy")
+
+
+class _Busy(Exception):
+    """Another live job holds the devlock; poll again soon (the marker can
+    clear any time, so the cadence is tighter than the wedge probe's)."""
+
+    retry_delay_s = 60.0
+
+
+class _Wedged(Exception):
+    """The tunnel probe failed; wait a full probe interval (delay set per
+    instance from --probe-interval)."""
+
+    def __init__(self, msg: str, interval_s: float):
+        super().__init__(msg)
+        self.retry_delay_s = interval_s
+
+
+class _ReWedged(Exception):
+    """A plan step hit its outer timeout — evidence the tunnel wedged
+    mid-step. Re-probe immediately (the probe itself gates the retry)."""
+
+    retry_delay_s = 0.0
 
 
 #: The probe must EXECUTE something, not just init: a half-recovered tunnel
@@ -184,94 +209,112 @@ def main() -> int:
     #: among themselves (trivially — the plan is sequential) instead of
     #: waiting out their budget on the watcher's own marker.
     child_busy = devlock.path() + ".plan"
-    while idx < len(steps) and time.time() < deadline:
-        # Single-tenant tunnel: the marker is held across probe AND step,
-        # closing the check-then-act window where a concurrent job (driver
-        # bench, manual sweep) could start device work between our
-        # devlock check and the probe's own device op — two overlapping
-        # jax processes are the documented wedge trigger. acquire() fails
-        # while another live job holds the marker; then we just sleep.
-        # Stale markers (dead holders) are reclaimed inside acquire().
-        rc = "busy"  # sentinel: neither step-finished nor step-timeout
+
+    def attempt_step(step):
+        """ONE attempt at one plan step, under the devlock.
+
+        Single-tenant tunnel: the marker is held across probe AND step,
+        closing the check-then-act window where a concurrent job (driver
+        bench, manual sweep) could start device work between our devlock
+        check and the probe's own device op — two overlapping jax
+        processes are the documented wedge trigger. acquire() fails while
+        another live job holds the marker; then _Busy's short poll takes
+        over. Stale markers (dead holders, recycled PIDs) are reclaimed
+        inside acquire(). Raises _Busy/_Wedged/_ReWedged for the retry
+        policy — whose sleeps happen AFTER this function returns, i.e.
+        after the marker is released, so a waiting job can take the
+        device during them. Returns the step's own exit code otherwise.
+        """
+        name, argv, env, outer = step
         with devlock.hold() as owned:  # refresher keeps mtime < STALE_S
-            alive = lat = None
-            if owned:
-                alive, lat = probe(args.probe_timeout)
-                ledger("probe", outcome="live" if alive else "wedged",
-                       latency_s=f"{lat:.1f}", next_step=steps[idx][0])
             if not owned:
-                ledger("busy", next_step=steps[idx][0])
+                ledger("busy", next_step=name)
                 print("# device busy (devlock held); sleeping 60s",
                       flush=True)
-            elif not alive:
-                rc = "wedged"
-                print(f"# wedged (probe {lat:.0f}s); next "
-                      f"step={steps[idx][0]}; sleeping "
-                      f"{args.probe_interval:.0f}s", flush=True)
-            else:
-                name, argv, env, outer = steps[idx]
-                log = os.path.join(args.plan_dir, f"{name}.log")
-                print(f"# tunnel live -> running {name} (log: {log})",
-                      flush=True)
-                t0 = time.time()
-                # Append: a step retried after a re-wedge must not truncate
-                # the previous attempt's partial output — that log is the
-                # evidence of what was running when the wedge hit.
-                with open(log, "a") as fh:
-                    fh.write(f"## attempt at {time.strftime('%F %T')}\n")
-                    fh.flush()
-                    # Own session so a timeout kills the whole process
-                    # GROUP: several steps (smoke, tune, corpus) are
-                    # parents of their own jax subprocesses, and killing
-                    # only the parent would orphan a grandchild that keeps
-                    # driving the device while we probe — the documented
-                    # two-process wedge trigger.
-                    proc = subprocess.Popen(
-                        argv,
-                        env=dict(os.environ,
-                                 OT_BENCH_BUSY_FILE=child_busy, **env),
-                        cwd=REPO,
-                        stdout=fh, stderr=subprocess.STDOUT,
-                        start_new_session=True,
-                    )
-                    try:
-                        rc = proc.wait(
-                            timeout=min(outer,
-                                        max(deadline - time.time(), 60)))
-                    except subprocess.TimeoutExpired:
-                        try:
-                            os.killpg(proc.pid, signal.SIGKILL)
-                        except OSError:
-                            pass
-                        proc.wait()
-                        rc = "timeout"
-                print(f"# {name}: rc={rc} in {time.time() - t0:.0f}s",
-                      flush=True)
-                ledger("step", name=name, rc=rc,
-                       wall_s=f"{time.time() - t0:.0f}")
-                # Mirror the step log into the repo: the plan-dir lives in
-                # /tmp and dies with the container, while the repo is the
-                # only thing that survives a round boundary — an
-                # end-of-round sweep of uncommitted files then preserves
-                # the measurement evidence even if nobody is around to
-                # commit it by hand.
+                raise _Busy(name)
+            alive, lat = probe(args.probe_timeout)
+            ledger("probe", outcome="live" if alive else "wedged",
+                   latency_s=f"{lat:.1f}", next_step=name)
+            if not alive:
+                print(f"# wedged (probe {lat:.0f}s); next step={name}; "
+                      f"sleeping {args.probe_interval:.0f}s", flush=True)
+                raise _Wedged(name, args.probe_interval)
+            log = os.path.join(args.plan_dir, f"{name}.log")
+            print(f"# tunnel live -> running {name} (log: {log})",
+                  flush=True)
+            t0 = time.time()
+            # Append: a step retried after a re-wedge must not truncate
+            # the previous attempt's partial output — that log is the
+            # evidence of what was running when the wedge hit.
+            with open(log, "a") as fh:
+                fh.write(f"## attempt at {time.strftime('%F %T')}\n")
+                fh.flush()
+                # Own session so a timeout kills the whole process
+                # GROUP: several steps (smoke, tune, corpus) are
+                # parents of their own jax subprocesses, and killing
+                # only the parent would orphan a grandchild that keeps
+                # driving the device while we probe — the documented
+                # two-process wedge trigger.
+                proc = subprocess.Popen(
+                    argv,
+                    env=dict(os.environ,
+                             OT_BENCH_BUSY_FILE=child_busy, **env),
+                    cwd=REPO,
+                    stdout=fh, stderr=subprocess.STDOUT,
+                    start_new_session=True,
+                )
                 try:
-                    dst = os.path.join(REPO, "docs", "hwlogs")
-                    os.makedirs(dst, exist_ok=True)
-                    shutil.copyfile(log, os.path.join(dst, f"{name}.log"))
-                except OSError as e:
-                    print(f"# log mirror failed: {e}", flush=True)
-        # Sleeps happen AFTER the marker is released so a waiting job can
-        # take the device during them.
-        if rc == "busy":
-            time.sleep(60)
-        elif rc == "wedged":
-            time.sleep(args.probe_interval)
-        elif rc == "timeout":
-            continue  # evidence of a re-wedge: back to probing, same step
-        else:
-            idx += 1  # non-zero rc is the step's own failure, not a wedge:
-            #           its log has the story; the plan moves on
+                    rc = proc.wait(
+                        timeout=min(outer,
+                                    max(deadline - time.time(), 60)))
+                except subprocess.TimeoutExpired:
+                    try:
+                        os.killpg(proc.pid, signal.SIGKILL)
+                    except OSError:
+                        pass
+                    proc.wait()
+                    rc = "timeout"
+            print(f"# {name}: rc={rc} in {time.time() - t0:.0f}s",
+                  flush=True)
+            ledger("step", name=name, rc=rc,
+                   wall_s=f"{time.time() - t0:.0f}")
+            # Mirror the step log into the repo: the plan-dir lives in
+            # /tmp and dies with the container, while the repo is the
+            # only thing that survives a round boundary — an
+            # end-of-round sweep of uncommitted files then preserves
+            # the measurement evidence even if nobody is around to
+            # commit it by hand.
+            try:
+                dst = os.path.join(REPO, "docs", "hwlogs")
+                os.makedirs(dst, exist_ok=True)
+                shutil.copyfile(log, os.path.join(dst, f"{name}.log"))
+            except OSError as e:
+                print(f"# log mirror failed: {e}", flush=True)
+            if rc == "timeout":
+                # Evidence of a re-wedge: back to probing, same step.
+                raise _ReWedged(name)
+            return rc  # non-zero rc is the step's own failure, not a
+            #            wedge: its log has the story; the plan moves on
+
+    abandon = object()
+    while idx < len(steps) and time.time() < deadline:
+        # The probe-until-live loop is the shared retry primitive
+        # (resilience.policy): unbounded attempts, per-outcome delays
+        # (the exceptions carry their own retry_delay_s), total budget =
+        # whatever is left of --budget-h. Exhausting the budget while
+        # still busy/wedged abandons the plan at this step, exactly the
+        # old loop's semantics.
+        step = steps[idx]
+        rc = repolicy.RetryPolicy(
+            attempts=None,
+            budget_s=max(deadline - time.time(), 0.0),
+            retry_on=(_Busy, _Wedged, _ReWedged),
+            on_exhausted=lambda last: abandon,
+            name=f"recover-watch:{step[0]}",
+        ).run(lambda a: attempt_step(step))
+        if rc is abandon:
+            break
+        idx += 1
     done = idx >= len(steps)
     ledger("watcher_exit", done=done, next_step_idx=idx)
     print(f"PLAN {'COMPLETE' if done else f'ABANDONED at step {idx}'}",

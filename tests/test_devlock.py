@@ -7,12 +7,17 @@ import time
 from our_tree_tpu.utils import devlock
 
 
+def _marker_pid(p: str) -> int:
+    """PID from a marker that may be ``pid`` or ``pid:starttime``."""
+    return int(open(p).read().split(":")[0])
+
+
 def test_acquire_release_roundtrip(tmp_path):
     p = str(tmp_path / "busy")
     assert not devlock.is_held(p)
     assert devlock.acquire(p)
     assert devlock.is_held(p)
-    assert int(open(p).read()) == os.getpid()
+    assert _marker_pid(p) == os.getpid()
     assert not devlock.acquire(p)  # second claim by a live holder fails
     devlock.release(True, p)
     assert not devlock.is_held(p)
@@ -24,8 +29,70 @@ def test_stale_dead_pid_is_reclaimed(tmp_path):
         f.write("999999999")  # beyond pid_max: guaranteed dead
     assert not devlock.is_held(p)
     assert devlock.acquire(p)  # reclaims the stale marker atomically
-    assert int(open(p).read()) == os.getpid()
+    assert _marker_pid(p) == os.getpid()
     devlock.release(True, p)
+
+
+def test_marker_records_starttime(tmp_path):
+    """Markers carry pid:starttime (from /proc/<pid>/stat field 22) so PID
+    reuse is detectable; the recorded starttime matches this process's."""
+    p = str(tmp_path / "busy")
+    assert devlock.acquire(p)
+    body = open(p).read()
+    pid_s, sep, start = body.partition(":")
+    assert int(pid_s) == os.getpid()
+    if devlock._proc_starttime(os.getpid()) is not None:  # Linux
+        assert sep == ":" and start == devlock._proc_starttime(os.getpid())
+    devlock.release(True, p)
+
+
+def test_recycled_pid_marker_is_stale(tmp_path):
+    """A marker whose PID was recycled by an unrelated process (live PID,
+    WRONG starttime) must read stale immediately — not after STALE_S (4 h),
+    the PID-reuse hole the starttime exists to close."""
+    if devlock._proc_starttime(os.getpid()) is None:
+        return  # no /proc: the mtime bound is the only defense off-Linux
+    p = str(tmp_path / "busy")
+    with open(p, "w") as f:
+        # Own (live) PID with an impossible starttime = the recycled case.
+        f.write(f"{os.getpid()}:1")
+    assert not devlock.is_held(p)
+    assert devlock.acquire(p)  # and it is reclaimable right now
+    assert devlock.is_held(p)
+    devlock.release(True, p)
+
+
+def test_bare_pid_marker_back_compat(tmp_path):
+    """Markers from older writers (bare PID, no starttime) keep the
+    previous semantics: live PID + fresh mtime = held."""
+    p = str(tmp_path / "busy")
+    with open(p, "w") as f:
+        f.write(str(os.getpid()))
+    assert devlock.is_held(p)
+    assert not devlock.acquire(p)
+    os.remove(p)
+
+
+def test_injected_lock_busy(tmp_path, monkeypatch):
+    """OT_FAULTS=lock_busy:N makes the first N acquisitions behave as if a
+    live concurrent holder owned the marker — the deterministic rehearsal
+    of the busy path (docs/RESILIENCE.md)."""
+    from our_tree_tpu.resilience import faults
+
+    p = str(tmp_path / "busy")
+    monkeypatch.setenv("OT_FAULTS", "lock_busy:2")
+    faults.reset()
+    try:
+        assert devlock.is_held(p)  # peek: the simulated holder "exists"
+        assert not devlock.acquire(p)  # ...and consumes shot 1
+        assert devlock.is_held(p)  # peeking burned nothing
+        assert not devlock.acquire(p)
+        assert not devlock.is_held(p)  # shots consumed: real state resumes
+        assert devlock.acquire(p)
+        devlock.release(True, p)
+    finally:
+        monkeypatch.delenv("OT_FAULTS")
+        faults.reset()
 
 
 def test_pidless_marker_ages_out(tmp_path, monkeypatch):
@@ -75,7 +142,7 @@ def test_stale_reclaim_is_single_winner(tmp_path, monkeypatch):
     # fresh marker survives and a plain second acquire still fails.
     assert not devlock.acquire(p)
     assert devlock.is_held(p)
-    assert int(open(p).read()) == os.getpid()
+    assert _marker_pid(p) == os.getpid()
     devlock.release(True, p)
 
 

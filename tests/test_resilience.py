@@ -1,0 +1,431 @@
+"""Resilience layer (our_tree_tpu/resilience/): retry policy, fault
+injection seam, degradation ledger, sweep journal, and the native-build
+lock/retry — the shared defenses every entry point now routes through
+(docs/RESILIENCE.md)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from our_tree_tpu.resilience import degrade, faults, journal, policy
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state(monkeypatch):
+    """Every test starts with no armed faults and an empty ledger, and
+    leaves none behind (the registries are process-global on purpose)."""
+    monkeypatch.delenv("OT_FAULTS", raising=False)
+    faults.reset()
+    degrade.clear()
+    yield
+    monkeypatch.delenv("OT_FAULTS", raising=False)
+    faults.reset()
+    degrade.clear()
+
+
+# ---------------------------------------------------------------------------
+# policy.RetryPolicy
+# ---------------------------------------------------------------------------
+
+
+def test_policy_first_try_success_no_sleep():
+    slept = []
+    out = policy.RetryPolicy(attempts=3, base_delay_s=9,
+                             sleep=slept.append).run(lambda a: "v")
+    assert out == "v" and slept == []
+
+
+def test_policy_retries_with_exponential_backoff():
+    slept = []
+
+    def op(a):
+        if a.index < 2:
+            raise ValueError(a.index)
+        return a.index
+
+    out = policy.RetryPolicy(attempts=3, base_delay_s=0.5, factor=2.0,
+                             retry_on=(ValueError,),
+                             sleep=slept.append).run(op)
+    assert out == 2
+    assert slept == [0.5, 1.0]  # base * factor**index, deterministic
+
+
+def test_policy_jitter_is_seeded_deterministic():
+    def delays(seed):
+        slept = []
+
+        def op(a):
+            if a.index < 2:
+                raise ValueError
+            return 1
+
+        policy.RetryPolicy(attempts=3, base_delay_s=1.0, jitter_frac=0.5,
+                           jitter_seed=seed, retry_on=(ValueError,),
+                           sleep=slept.append).run(op)
+        return slept
+
+    a, b = delays(7), delays(7)
+    assert a == b  # same seed -> same sequence: CI scripts reproduce
+    # delay_i = base * factor**i * (1 + jitter_frac * u), u in [0, 1)
+    assert 1.0 <= a[0] <= 1.5 and 2.0 <= a[1] <= 3.0
+    assert delays(8) != a  # and the jitter is real
+
+
+def test_policy_exhaustion_raises_with_cause():
+    with pytest.raises(policy.PolicyExhausted) as ei:
+        policy.RetryPolicy(attempts=2, retry_on=(ValueError,),
+                           sleep=lambda s: None, name="t").run(
+            lambda a: (_ for _ in ()).throw(ValueError("boom")))
+    assert ei.value.attempts == 2
+    assert isinstance(ei.value.last, ValueError)
+    assert isinstance(ei.value.__cause__, ValueError)
+
+
+def test_policy_on_exhausted_fallback_returns():
+    seen = []
+    out = policy.RetryPolicy(
+        attempts=1, retry_on=(ValueError,),
+        on_exhausted=lambda last: seen.append(type(last).__name__) or "fb",
+    ).run(lambda a: (_ for _ in ()).throw(ValueError()))
+    assert out == "fb" and seen == ["ValueError"]
+
+
+def test_policy_unlisted_exception_propagates_immediately():
+    calls = []
+
+    def op(a):
+        calls.append(a.index)
+        raise KeyError("not retryable")
+
+    with pytest.raises(KeyError):
+        policy.RetryPolicy(attempts=3, retry_on=(ValueError,),
+                           sleep=lambda s: None).run(op)
+    assert calls == [0]
+
+
+def test_policy_budget_stops_retries():
+    clock = [0.0]
+    calls = []
+
+    def op(a):
+        calls.append(a.remaining_s)
+        clock[0] += 10.0  # each attempt costs 10 "seconds"
+        raise ValueError
+
+    with pytest.raises(policy.PolicyExhausted) as ei:
+        policy.RetryPolicy(attempts=None, budget_s=25.0,
+                           retry_on=(ValueError,), sleep=lambda s: None,
+                           clock=lambda: clock[0]).run(op)
+    # Attempts at t=0, 10, 20; at t=30 the budget (25) is spent.
+    assert ei.value.attempts == 3
+    assert calls == [25.0, 15.0, 5.0]
+
+
+def test_policy_stop_when_predicate():
+    calls = []
+
+    def op(a):
+        calls.append(a.index)
+        raise ValueError
+
+    with pytest.raises(policy.PolicyExhausted):
+        policy.RetryPolicy(attempts=5, retry_on=(ValueError,),
+                           sleep=lambda s: None,
+                           stop_when=lambda a: a.index >= 2).run(op)
+    assert calls == [0, 1]  # retry #2 was vetoed before running
+
+
+def test_policy_exception_retry_delay_overrides_backoff():
+    class Busy(Exception):
+        retry_delay_s = 7.5
+
+    slept = []
+
+    def op(a):
+        if a.index == 0:
+            raise Busy
+        return "ok"
+
+    assert policy.RetryPolicy(attempts=2, base_delay_s=99, retry_on=(Busy,),
+                              sleep=slept.append).run(op) == "ok"
+    assert slept == [7.5]
+
+
+def test_policy_attempt_timeout_clamped_to_budget():
+    clock = [0.0]
+    seen = []
+
+    def op(a):
+        seen.append(a.timeout_s)
+        clock[0] += 8.0
+        raise ValueError
+
+    with pytest.raises(policy.PolicyExhausted):
+        policy.RetryPolicy(attempts=3, per_attempt_s=10.0, budget_s=12.0,
+                           retry_on=(ValueError,), sleep=lambda s: None,
+                           clock=lambda: clock[0]).run(op)
+    assert seen == [10.0, 4.0]  # second attempt sees only what's left
+
+
+# ---------------------------------------------------------------------------
+# faults: the OT_FAULTS grammar and the registry semantics
+# ---------------------------------------------------------------------------
+
+
+def test_faults_unset_is_inert():
+    assert not faults.active()
+    assert not faults.fire("init_hang")
+    faults.check("dispatch_fail")  # must not raise
+
+
+def test_faults_counted_token_fires_exactly_n_times(monkeypatch):
+    monkeypatch.setenv("OT_FAULTS", "dispatch_fail:2")
+    faults.reset()
+    assert faults.fire("dispatch_fail")
+    assert faults.fire("dispatch_fail")
+    assert not faults.fire("dispatch_fail")
+    assert not faults.fire("dispatch_fail")  # stays quiet forever after
+
+
+def test_faults_bare_token_fires_forever(monkeypatch):
+    monkeypatch.setenv("OT_FAULTS", "build_fail")
+    faults.reset()
+    for _ in range(5):
+        assert faults.fire("build_fail")
+    assert faults.remaining("build_fail") == faults.ALWAYS
+
+
+def test_faults_grammar_whitespace_accumulation_and_zero(monkeypatch):
+    monkeypatch.setenv("OT_FAULTS", " init_hang:1 , init_hang:2 ,"
+                                    " lock_busy:0 ,, dispatch_fail : nope")
+    faults.reset()
+    # repeated tokens accumulate; zero-count disarms; malformed ignored
+    assert faults.remaining("init_hang") == 3
+    assert faults.remaining("lock_busy") == 0
+    assert faults.remaining("dispatch_fail") == 0
+
+
+def test_faults_check_raises_injected_fault(monkeypatch):
+    monkeypatch.setenv("OT_FAULTS", "dispatch_fail:1")
+    faults.reset()
+    with pytest.raises(faults.InjectedFault, match="dispatch_fail"):
+        faults.check("dispatch_fail", "here")
+    faults.check("dispatch_fail")  # consumed: second check passes
+    assert issubclass(faults.InjectedFault, RuntimeError)
+
+
+def test_faults_unknown_point_warns_but_arms(monkeypatch, capsys):
+    monkeypatch.setenv("OT_FAULTS", "tpyo_fail:1")
+    faults.reset()
+    assert "unknown injection point" in capsys.readouterr().err
+    assert faults.fire("tpyo_fail")  # armed anyway (forward compat)
+
+
+# ---------------------------------------------------------------------------
+# degrade: the demotion ledger
+# ---------------------------------------------------------------------------
+
+
+def test_degrade_records_in_order_and_dedupes():
+    degrade.degrade("tpu->cpu", "first")
+    degrade.degrade("native->lax.scan", "second")
+    degrade.degrade("tpu->cpu", "repeat must not duplicate")
+    assert degrade.events() == ["tpu->cpu", "native->lax.scan"]
+    assert degrade.detail()[0] == ("tpu->cpu", "first")
+    degrade.clear()
+    assert degrade.events() == []
+
+
+def test_degrade_is_shared_across_import_contexts():
+    """The bare-loaded module (what repo-root bench.py uses) and the
+    package import must be the SAME object — a split ledger would let a
+    package-context demotion vanish from the bare-context JSON line."""
+    loader = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts")
+    sys.path.insert(0, loader)
+    try:
+        from _devlock_loader import load_resilience
+
+        assert load_resilience("degrade") is degrade
+        assert load_resilience("faults") is faults
+    finally:
+        sys.path.remove(loader)
+
+
+# ---------------------------------------------------------------------------
+# journal.SweepJournal
+# ---------------------------------------------------------------------------
+
+
+def _mkjournal(tmp_path, config=None, name="j.jsonl"):
+    return journal.SweepJournal(str(tmp_path / name),
+                                config if config is not None else {"s": 1})
+
+
+def test_journal_record_and_replay_roundtrip(tmp_path):
+    j = _mkjournal(tmp_path)
+    j.record("ecb:65536", ["row1,", "# derived"], {"st": 42}, ["tpu->cpu"])
+    j.record("ctr:65536", ["row2,"], {"st": 43}, [])
+    j.close()
+    j2 = _mkjournal(tmp_path)
+    assert j2.pending == 2
+    e = j2.skip("ecb:65536")
+    assert e["lines"] == ["row1,", "# derived"]
+    assert e["rng_state"] == {"st": 42} and e["degraded"] == ["tpu->cpu"]
+    assert j2.skip("ctr:65536")["lines"] == ["row2,"]
+    assert j2.skip("rc4:65536") is None  # nothing left
+    j2.close()
+
+
+def test_journal_config_hash_mismatch_invalidates(tmp_path):
+    j = _mkjournal(tmp_path, {"seed": 1})
+    j.record("u", ["l"], None, [])
+    j.close()
+    j2 = _mkjournal(tmp_path, {"seed": 2})  # changed sweep identity
+    assert j2.pending == 0  # nothing replayable
+    j2.close()
+    # and the file was restarted for the NEW config
+    head = json.loads(open(tmp_path / "j.jsonl").readline())
+    assert head["config_hash"] == journal.config_hash({"seed": 2})
+
+
+def test_journal_torn_tail_is_truncated(tmp_path):
+    j = _mkjournal(tmp_path)
+    j.record("a", ["1"], None, [])
+    j.record("b", ["2"], None, [])
+    j.close()
+    p = tmp_path / "j.jsonl"
+    with open(p, "ab") as f:  # the SIGKILL-mid-write artifact
+        f.write(b'{"unit": "c", "lines": ["tor')
+    j2 = _mkjournal(tmp_path)
+    assert j2.pending == 2  # the valid prefix survives, the tear is gone
+    assert j2.skip("a") and j2.skip("b")
+    j2.record("c", ["3"], None, [])
+    j2.close()
+    recs = [json.loads(l) for l in open(p)]
+    assert [r.get("unit") for r in recs] == [None, "a", "b", "c"]
+
+
+def test_journal_order_mismatch_distrusts_tail(tmp_path):
+    j = _mkjournal(tmp_path)
+    j.record("a", ["1"], None, [])
+    j.record("b", ["2"], None, [])
+    j.close()
+    j2 = _mkjournal(tmp_path)
+    assert j2.skip("a")
+    assert j2.skip("ZZZ") is None  # order broke: replay must stop
+    assert j2.skip("b") is None  # ...and the stale tail is not offered
+    j2.record("ZZZ", ["3"], None, [])
+    j2.close()
+    recs = [json.loads(l) for l in open(tmp_path / "j.jsonl")]
+    assert [r.get("unit") for r in recs] == [None, "a", "ZZZ"]
+
+
+def test_journal_fresh_file_has_header_immediately(tmp_path):
+    j = _mkjournal(tmp_path, {"x": 9})
+    j.close()  # killed before the first completed row
+    head = json.loads(open(tmp_path / "j.jsonl").readline())
+    assert head["kind"] == journal.KIND
+    assert head["config_hash"] == journal.config_hash({"x": 9})
+
+
+# ---------------------------------------------------------------------------
+# native build: flock + retry + build_fail injection
+# ---------------------------------------------------------------------------
+
+
+def test_native_build_retries_past_injected_failure(tmp_path, monkeypatch):
+    """OT_FAULTS=build_fail:1 fails exactly the first make attempt; the
+    shared policy's second attempt builds — the deterministic rehearsal of
+    a transiently-failing make."""
+    from our_tree_tpu.runtime import native
+
+    calls = []
+    monkeypatch.setattr(native, "_CSRC", tmp_path)
+    monkeypatch.setattr(native, "_LIB_PATH", tmp_path / "libotcrypt.so")
+    (tmp_path / "x.c").write_text("int x;\n")  # staleness: lib missing
+
+    def fake_make(argv, capture_output, text):
+        calls.append(argv)
+
+        class P:
+            returncode = 0
+            stdout = stderr = ""
+
+        return P()
+
+    monkeypatch.setattr(native.subprocess, "run", fake_make)
+    monkeypatch.setenv("OT_FAULTS", "build_fail:1")
+    faults.reset()
+    native._build()
+    assert len(calls) == 1  # attempt 1 injected-failed, attempt 2 ran make
+
+
+def test_native_build_deterministic_failure_raises(tmp_path, monkeypatch):
+    from our_tree_tpu.runtime import native
+
+    monkeypatch.setattr(native, "_CSRC", tmp_path)
+    monkeypatch.setattr(native, "_LIB_PATH", tmp_path / "libotcrypt.so")
+    (tmp_path / "x.c").write_text("int x;\n")
+
+    def fake_make(argv, capture_output, text):
+        class P:
+            returncode = 2
+            stdout = ""
+            stderr = "cc: error"
+
+        return P()
+
+    monkeypatch.setattr(native.subprocess, "run", fake_make)
+    with pytest.raises(policy.PolicyExhausted) as ei:
+        native._build()
+    assert "cc: error" in str(ei.value.last)
+
+
+def test_native_build_lock_serializes_concurrent_builders(tmp_path,
+                                                          monkeypatch):
+    """The flock critical section: while another process holds the sidecar
+    lock, _build blocks; after the holder (having built) releases, _build
+    re-checks staleness and skips the make entirely — the
+    concurrent-corruption race is closed at both ends."""
+    from our_tree_tpu.runtime import native
+
+    monkeypatch.setattr(native, "_CSRC", tmp_path)
+    lib = tmp_path / "libotcrypt.so"
+    monkeypatch.setattr(native, "_LIB_PATH", lib)
+    (tmp_path / "Makefile").write_text("libotcrypt.so:\n")
+    src = tmp_path / "x.c"
+    src.write_text("int x;\n")
+
+    lockfile = str(lib) + ".lock"
+    holder = subprocess.Popen(
+        [sys.executable, "-c", f"""
+import fcntl, os, sys, time
+fd = os.open({lockfile!r}, os.O_CREAT | os.O_RDWR, 0o644)
+fcntl.flock(fd, fcntl.LOCK_EX)
+print("locked", flush=True)
+time.sleep(1.0)
+# the concurrent builder finishes its build before releasing:
+open({str(lib)!r}, "w").write("built-by-holder")
+os.utime({str(lib)!r})
+os.close(fd)
+"""],
+        stdout=subprocess.PIPE, text=True)
+    try:
+        assert holder.stdout.readline().strip() == "locked"
+        os.utime(src)  # stale from this process's point of view
+
+        def fail_make(*a, **kw):  # must never run: holder's build wins
+            raise AssertionError("make ran despite a concurrent build")
+
+        monkeypatch.setattr(native.subprocess, "run", fail_make)
+        import time
+        t0 = time.perf_counter()
+        native._build()  # blocks on the flock, then sees the fresh lib
+        assert time.perf_counter() - t0 > 0.3  # it really waited
+        assert lib.read_text() == "built-by-holder"
+    finally:
+        holder.wait()
