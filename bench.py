@@ -45,10 +45,8 @@ import contextlib
 import functools
 import json
 import os
-import signal
 import subprocess
 import sys
-import threading
 import time
 
 import numpy as np
@@ -86,6 +84,7 @@ ranking = load_ranking()
 faults = load_resilience("faults")
 repolicy = load_resilience("policy")
 degrade = load_resilience("degrade")
+watchdog = load_resilience("watchdog")
 
 
 def _left() -> float:
@@ -192,30 +191,23 @@ def _ensure_live_backend() -> None:
 
 
 @contextlib.contextmanager
-def _stage_alarm(seconds: float):
-    """Raise TimeoutError in the main thread if a stage runs past `seconds`.
+def _stage_alarm(seconds: float, what: str = "bench stage"):
+    """Deadline-guard a stage via the shared dispatch watchdog.
 
     The deadline checks between stages cannot see a hang *inside* one: a
     half-recovered tunnel (PJRT init succeeds, then a readback blocks
     forever) would block the process with no JSON line ever printed.
-    SIGALRM interrupts the wait as long as the blocking call releases the
-    GIL (PJRT readbacks do). No-op off the main thread.
+    Formerly a local SIGALRM timer; now the resilience watchdog
+    (resilience/watchdog.py), which interrupts the same way — a signal-
+    delivered raise, effective while the blocking call releases the GIL
+    (PJRT readbacks do) — and additionally dumps all-thread stacks to a
+    crash report and stamps the demotion through degrade(), so a fired
+    alarm leaves evidence of WHERE the process was stuck, not only that
+    it was. DispatchTimeout subclasses TimeoutError, so every existing
+    fallback handler below catches it unchanged.
     """
-    if (threading.current_thread() is not threading.main_thread()
-            or not hasattr(signal, "SIGALRM")):
+    with watchdog.deadline(max(seconds, 1.0), what=what):
         yield
-        return
-
-    def handler(signum, frame):
-        raise TimeoutError(f"stage exceeded {seconds:.0f}s")
-
-    old = signal.signal(signal.SIGALRM, handler)
-    signal.setitimer(signal.ITIMER_REAL, max(seconds, 1.0))
-    try:
-        yield
-    finally:
-        signal.setitimer(signal.ITIMER_REAL, 0)
-        signal.signal(signal.SIGALRM, old)
 
 
 def _stage_budget(preferred: float) -> float:
@@ -476,7 +468,8 @@ def _measure_and_report() -> None:
     # timeout fall straight to the native host runtime so the run still
     # reports a real framework number.
     try:
-        with _stage_alarm(_stage_budget(min(150.0, 0.2 * DEADLINE_S))):
+        with _stage_alarm(_stage_budget(min(150.0, 0.2 * DEADLINE_S)),
+                          what="first device op (canary)"):
             ctr_be = jax.device_put(
                 jnp.asarray(packing.np_bytes_to_words(nonce).byteswap()))
             jax.block_until_ready(ctr_be)
@@ -562,7 +555,14 @@ def _measure_and_report() -> None:
         # exists for, without needing a wedged device.
         faults.check("dispatch_fail", "bench measure dispatch")
         with _stage_alarm(_stage_budget(
-                stage_budget or max(60.0, _left() - 30.0))):
+                stage_budget or max(60.0, _left() - 30.0)),
+                what=f"measure({engine}, {nbytes >> 20} MiB)"):
+            # The hang variant of the same seam, INSIDE the alarm: an
+            # armed dispatch_hang blocks here in a GIL-releasing sleep,
+            # and the stage alarm — now the shared watchdog — is what
+            # ends it: the deterministic CPU rehearsal of a transfer
+            # that never returns.
+            watchdog.injected_hang("dispatch_hang", "bench measure dispatch")
             words = jax.device_put(
                 jnp.asarray(host_words if flat else host_words.reshape(-1, 4))
             )
@@ -715,7 +715,12 @@ def _measure_and_report() -> None:
             # degraded with only the type name in the log).
             print(f"# headline failed ({type(e).__name__}: {e})"[:500]
                   + "; reporting probe-size result", file=sys.stderr)
-            injected = isinstance(e, faults.InjectedFault)
+            # A DispatchTimeout that interrupted an INJECTED sleep is a
+            # rehearsal too: the raise-on-cpu bug guard below must not
+            # convert the fault-matrix dispatch_hang row into a crash.
+            injected = (isinstance(e, faults.InjectedFault)
+                        or (isinstance(e, watchdog.DispatchTimeout)
+                            and watchdog.hangs_injected() > 0))
             if not probes:
                 if (platform == "cpu" and not injected) or not isinstance(
                         e, (TimeoutError, faults.InjectedFault)):

@@ -23,6 +23,7 @@ import numpy as np
 
 from ..resilience import degrade as _degrade
 from ..resilience import faults as _faults
+from ..resilience import watchdog as _watchdog
 
 
 class TpuBackend:
@@ -128,12 +129,18 @@ class TpuBackend:
         sync cost (the reference's GPU timings likewise include their sync,
         main_ecb_e.cu:37-44).
 
-        Carries the ``dispatch_fail`` injection point: the barrier is
-        where a wedged transport's hang actually surfaces, so
-        ``OT_FAULTS=dispatch_fail:N`` makes the first N barriers raise —
-        CI's stand-in for a mid-sweep tunnel death (docs/RESILIENCE.md).
+        Carries the ``dispatch_fail`` and ``dispatch_hang`` injection
+        points: the barrier is where a wedged transport's failure
+        actually surfaces. ``OT_FAULTS=dispatch_fail:N`` makes the first
+        N barriers raise — CI's stand-in for a mid-sweep tunnel death —
+        and ``dispatch_hang`` makes the barrier block "forever" (a
+        GIL-releasing sleep), the stand-in for the tunnel that never
+        answers, which only the watchdog or the --isolate supervisor can
+        end (docs/RESILIENCE.md).
         """
         _faults.check("dispatch_fail", "TpuBackend.block_until_ready")
+        _watchdog.injected_hang("dispatch_hang",
+                                "TpuBackend.block_until_ready")
         self._jax.block_until_ready(x)
         for leaf in self._jax.tree_util.tree_leaves(x):
             if not getattr(leaf, "size", 0):
@@ -189,6 +196,8 @@ class TpuBackend:
             # is rehearsed against exactly this raise.
             _faults.check("dispatch_fail",
                           "TpuBackend.chained_device_times_us")
+            _watchdog.injected_hang("dispatch_hang",
+                                    "TpuBackend.chained_device_times_us")
             t0 = time.perf_counter()
             int(chained(words, jnp.uint32(kk)))
             return time.perf_counter() - t0

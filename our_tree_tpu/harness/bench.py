@@ -46,7 +46,10 @@ import time
 import numpy as np
 
 from ..resilience import degrade as degrade_mod
+from ..resilience import faults as faults_mod
+from ..resilience import isolate as isolate_mod
 from ..resilience import journal as journal_mod
+from ..resilience import watchdog as watchdog_mod
 from .backends import make_backend
 
 MIB = 1 << 20
@@ -113,6 +116,11 @@ def _derived(em, nbytes: int, times_us: list[int], floor_us: int = 0):
 
 
 def _time_us(fn) -> tuple[int, object]:
+    # The backend-agnostic dispatch seam: every timed region of every
+    # backend passes through here, so an armed dispatch_hang wedges the
+    # sweep exactly where a dead transport would — inside a timed device
+    # call — for the watchdog / --isolate supervisor to deal with.
+    watchdog_mod.injected_hang("dispatch_hang", "harness timed region")
     t0 = time.perf_counter_ns()
     out = fn()
     us = (time.perf_counter_ns() - t0) // 1000
@@ -495,6 +503,36 @@ def arc4_self_test(em):
             raise SystemExit(2)
 
 
+def _sweep_config(args, sizes, workers_list, modes) -> dict:
+    """The sweep's identity: everything that shapes the unit sequence or
+    the bytes each unit emits. A rerun whose config hashes differently
+    must NOT replay a journal recorded under this one (wrong rows into
+    wrong slots); SweepJournal invalidates and starts fresh. The ONE
+    builder shared by the isolate parent, its children, and plain
+    --journal runs — a drifted copy would make every child invalidate
+    its parent's journal."""
+    return {
+        "backend": args.backend, "engine": args.engine, "sizes": sizes,
+        "workers": workers_list, "iters": args.iters,
+        "keybits": args.keybits, "modes": modes, "streams": args.streams,
+        "seed": args.seed, "timing": args.timing,
+        "stream_chunk_mb": args.stream_chunk_mb,
+    }
+
+
+def _unit_names(modes, sizes, workers_list) -> list[str]:
+    """Ordered unit names as a pure function of the config — the
+    journal's replay contract, and what lets the isolate parent plan a
+    sweep without constructing a backend. MUST mirror the unit-closure
+    construction in main() exactly (main() asserts it does)."""
+    names = [f"{mode}:{size}" for mode in modes for size in sizes]
+    if len(workers_list) > 1 and {"ecb", "ctr"} & set(modes):
+        names.append("shard-invariance")
+    if "rc4" in modes:
+        names.append("arc4-self-test")
+    return names
+
+
 def main(argv=None) -> int:
     # Honor a JAX_PLATFORMS=cpu pin through jax.config before the backend
     # constructor's first jax call — the env var alone does not stop a
@@ -560,9 +598,39 @@ def main(argv=None) -> int:
                          "resumes at the failed row instead of losing the "
                          "run (docs/RESILIENCE.md). A changed config "
                          "invalidates the journal")
+    ap.add_argument("--isolate", action="store_true",
+                    help="run each sweep unit in its own child process "
+                         "with a wall deadline (--unit-deadline): a hung "
+                         "unit is SIGKILLed and journaled as failed "
+                         "instead of wedging the sweep, and a unit that "
+                         "fails --quarantine-after times is quarantined — "
+                         "skipped on this and every resumed run with "
+                         "degraded:[quarantined:<unit>] stamped. Requires "
+                         "--journal and an explicit --workers list (the "
+                         "supervising parent never touches the device, so "
+                         "it cannot ask it for a worker cap)")
+    ap.add_argument("--unit-deadline", type=float, metavar="S",
+                    default=float(os.environ.get("OT_UNIT_DEADLINE", 600)),
+                    help="--isolate: per-unit wall deadline in seconds "
+                         "before the child process group is SIGKILLed "
+                         "(env OT_UNIT_DEADLINE)")
+    ap.add_argument("--quarantine-after", type=int, metavar="N",
+                    default=int(os.environ.get("OT_QUARANTINE_AFTER", 3)),
+                    help="quarantine a unit after N recorded failures "
+                         "(journal failure rows, counted across runs; "
+                         "env OT_QUARANTINE_AFTER)")
+    ap.add_argument("--dispatch-deadline", type=float, metavar="S",
+                    default=watchdog_mod.default_deadline_s(),
+                    help="in-process watchdog deadline around each unit's "
+                         "device work (resilience/watchdog.py): on expiry "
+                         "all-thread stacks are dumped, the unit fails "
+                         "with DispatchTimeout, and a journaled sweep "
+                         "moves on instead of wedging. 0 disables "
+                         "(env OT_DISPATCH_DEADLINE)")
+    ap.add_argument("--isolate-child", default=None, metavar="UNIT",
+                    help=argparse.SUPPRESS)  # internal: run exactly UNIT
     args = ap.parse_args(argv)
 
-    backend = make_backend(args.backend, args.engine)
     sizes = []
     for tok in args.sizes_mb.split(","):
         if not tok:
@@ -571,9 +639,82 @@ def main(argv=None) -> int:
         if nbytes <= 0:
             ap.error(f"--sizes-mb entry {tok!r} is below one 16-byte block")
         sizes.append(nbytes)
+    modes = [m.strip() for m in args.modes.split(",") if m.strip()]
+    journal_path = args.journal or os.environ.get("OT_SWEEP_JOURNAL")
+
+    isolate_parent = args.isolate and args.isolate_child is None
+    if isolate_parent:
+        # The supervising parent never constructs a backend (never
+        # touches jax, let alone the device) — the whole point of
+        # isolation is that only disposable children face a possibly
+        # wedged transport. Everything config-shaped must therefore be
+        # derivable without a device, hence the explicit-workers rule.
+        if not journal_path:
+            ap.error("--isolate requires --journal (or OT_SWEEP_JOURNAL): "
+                     "the journal is the supervisor's unit ledger")
+        if not args.workers:
+            ap.error("--isolate requires an explicit --workers list (the "
+                     "parent cannot ask the device for a worker cap)")
     if args.workers:
         workers_list = [int(w) for w in args.workers.split(",") if w]
-    else:
+
+    if isolate_parent:
+        out_path = args.out
+        if args.default_out and not out_path:
+            out_path = (f"results.{socket.gethostname().split('.')[0]}"
+                        f".{args.backend}")
+        em = Emitter(out_path)
+        config = _sweep_config(args, sizes, workers_list, modes)
+        names = _unit_names(modes, sizes, workers_list)
+        # Every sweep-shaping flag, forwarded so each child derives the
+        # SAME config hash (a child hashing differently would invalidate
+        # — truncate — the parent's journal mid-sweep). The assert makes
+        # adding a field to _sweep_config without a matching flag here a
+        # loud failure instead of that silent truncation.
+        child_config_flags = {
+            "backend": ("--backend", args.backend),
+            "engine": ("--engine", args.engine),
+            "sizes": ("--sizes-mb", args.sizes_mb),
+            "workers": ("--workers", args.workers),
+            "iters": ("--iters", str(args.iters)),
+            "keybits": ("--keybits", str(args.keybits)),
+            "modes": ("--modes", args.modes),
+            "streams": ("--streams", str(args.streams)),
+            "seed": ("--seed", str(args.seed)),
+            "timing": ("--timing", args.timing),
+            "stream_chunk_mb": ("--stream-chunk-mb",
+                                str(args.stream_chunk_mb)),
+        }
+        assert set(child_config_flags) == set(config), (
+            "sweep-config fields without a forwarded child flag: "
+            f"{set(config) ^ set(child_config_flags)}")
+        child_base = [
+            sys.executable, "-m", "our_tree_tpu.harness.bench",
+            *(tok for flag in child_config_flags.values() for tok in flag),
+            "--journal", journal_path,
+            "--quarantine-after", str(args.quarantine_after),
+            "--dispatch-deadline", str(args.dispatch_deadline),
+            "--isolate",
+        ]
+        try:
+            quarantined = isolate_mod.run_isolated_sweep(
+                units=names,
+                child_argv=lambda unit: child_base + ["--isolate-child",
+                                                      unit],
+                journal_path=journal_path, config=config, emit=em.line,
+                unit_deadline_s=args.unit_deadline,
+                quarantine_after=args.quarantine_after)
+            if quarantined:
+                print(f"# isolate: quarantined unit(s): "
+                      f"{','.join(quarantined)}", file=sys.stderr)
+            if degrade_mod.events():
+                em.line("# degraded: " + ",".join(degrade_mod.events()))
+        finally:
+            em.close()
+        return 0
+
+    backend = make_backend(args.backend, args.engine)
+    if not args.workers:
         cap = getattr(backend, "max_workers", 8)
         workers_list = [w for w in (1, 2, 4, 8) if w <= cap] or [1]
 
@@ -581,24 +722,12 @@ def main(argv=None) -> int:
     if args.default_out and not out_path:
         out_path = f"results.{socket.gethostname().split('.')[0]}.{args.backend}"
     em = Emitter(out_path)
-    modes = [m.strip() for m in args.modes.split(",") if m.strip()]
     rng = np.random.default_rng(args.seed)  # srand(1337) of the reference
 
     journal = None
-    journal_path = args.journal or os.environ.get("OT_SWEEP_JOURNAL")
     if journal_path:
-        # The sweep's identity: everything that shapes the unit sequence
-        # or the bytes each unit emits. A rerun whose config hashes
-        # differently must NOT replay this journal (wrong rows into wrong
-        # slots); SweepJournal invalidates and starts fresh.
-        config = {
-            "backend": args.backend, "engine": args.engine, "sizes": sizes,
-            "workers": workers_list, "iters": args.iters,
-            "keybits": args.keybits, "modes": modes, "streams": args.streams,
-            "seed": args.seed, "timing": args.timing,
-            "stream_chunk_mb": args.stream_chunk_mb,
-        }
-        journal = journal_mod.SweepJournal(journal_path, config)
+        journal = journal_mod.SweepJournal(
+            journal_path, _sweep_config(args, sizes, workers_list, modes))
         if journal.pending:
             print(f"# journal: {journal.pending} completed unit(s) on file "
                   f"({journal_path}); resuming", file=sys.stderr)
@@ -641,6 +770,10 @@ def main(argv=None) -> int:
                           args.keybits, rng)))
     if "rc4" in modes:
         units.append(("arc4-self-test", lambda: arc4_self_test(em)))
+    # The isolate supervisor plans from _unit_names without a backend;
+    # any drift between that pure function and this closure list would
+    # strand its children on units that don't exist.
+    assert [n for n, _ in units] == _unit_names(modes, sizes, workers_list)
 
     profiler_cm = None
     if args.profile and args.backend == "tpu":
@@ -650,28 +783,74 @@ def main(argv=None) -> int:
 
         profiler_cm = contextlib.ExitStack()
         profiler_cm.enter_context(jax.profiler.trace(args.profile))
+    target = args.isolate_child
     try:
         for name, run_unit in units:
-            entry = journal.skip(name) if journal is not None else None
-            if entry is not None:
-                # Completed in a previous (interrupted) run: re-emit the
-                # recorded rows verbatim, restore the shared RNG stream to
-                # its post-unit state, and restore the unit's recorded
-                # demotions into the live ledger — a degraded run resumed
-                # must still end with the same `# degraded:` trailer (and
-                # the same journal stamps) as its uninterrupted twin.
-                for line in entry.get("lines", []):
-                    em.line(line)
-                state = entry.get("rng_state")
-                if state is not None:
-                    rng.bit_generator.state = state
-                for kind in entry.get("degraded", []):
-                    degrade_mod.degrade(kind, "restored from journal")
+            if journal is not None:
+                if (target is None
+                        and journal.fail_count(name)
+                        >= args.quarantine_after):
+                    # The quarantine ledger: this unit hung/crashed its
+                    # way past the threshold in earlier (isolated or
+                    # watchdogged) runs. Re-running it would re-burn the
+                    # budget on a known-bad config; skipping silently
+                    # would masquerade as health. Skip LOUDLY.
+                    degrade_mod.degrade(
+                        f"quarantined:{name}",
+                        f"{journal.fail_count(name)} journaled failure(s)")
+                    continue
+                # Gate on is_completed: with failure rows on file a unit
+                # can be legitimately absent from the replay list, and a
+                # bare skip() would misread that as corruption.
+                entry = (journal.skip(name) if journal.is_completed(name)
+                         else None)
+                if entry is not None:
+                    # Completed in a previous (interrupted) run: re-emit
+                    # the recorded rows verbatim, restore the shared RNG
+                    # stream to its post-unit state, and restore the
+                    # unit's recorded demotions into the live ledger — a
+                    # degraded run resumed must still end with the same
+                    # `# degraded:` trailer (and the same journal stamps)
+                    # as its uninterrupted twin.
+                    for line in entry.get("lines", []):
+                        em.line(line)
+                    state = entry.get("rng_state")
+                    if state is not None:
+                        rng.bit_generator.state = state
+                    for kind in entry.get("degraded", []):
+                        degrade_mod.degrade(kind, "restored from journal")
+                    continue
+            if target is not None and name != target:
+                # Isolated child aimed at a later unit: this one failed or
+                # was quarantined — the SUPERVISOR owns its story. Skip.
+                # (The RNG stream diverges from an uninterrupted run's
+                # here; result rows never encode RNG bytes, so surviving
+                # units' output is unaffected — docs/RESILIENCE.md.)
                 continue
             before = set(degrade_mod.events())
             em.begin_capture()
             try:
-                run_unit()
+                # unit_crash: the injected stand-in for a child process
+                # dying mid-unit (segfaulting XLA compile, OOM-killed
+                # worker). In-process it IS a crash: the raise escapes
+                # main() and the sweep dies nonzero — which is exactly
+                # what --isolate exists to contain.
+                faults_mod.check("unit_crash", f"unit {name}")
+                with watchdog_mod.deadline(args.dispatch_deadline,
+                                           what=f"sweep unit {name}"):
+                    run_unit()
+            except watchdog_mod.DispatchTimeout as e:
+                em.end_capture()  # partial rows already hit stdout/--out
+                print(f"# watchdog: {e}", file=sys.stderr, flush=True)
+                if target is not None:
+                    # The child dies nonzero and the SUPERVISOR records
+                    # the failure row — recording here too would double-
+                    # count the attempt toward quarantine.
+                    raise
+                if journal is not None:
+                    journal.record_failure(
+                        name, f"watchdog:{args.dispatch_deadline:.0f}s")
+                continue  # journaled sweep: a hung unit, not a hung sweep
             finally:
                 lines = em.end_capture()
             if journal is not None:
@@ -681,6 +860,13 @@ def main(argv=None) -> int:
                 journal.record(name, lines, rng.bit_generator.state,
                                [k for k in degrade_mod.events()
                                 if k not in before])
+            if target is not None:
+                return 0  # child: exactly one unit per process
+        if target is not None:
+            # The target never came up: either it was already journaled
+            # (benign race with the supervisor) or the configs diverged.
+            return 0 if (journal is not None
+                         and journal.resumed) else 3
         if journal is not None and journal.resumed:
             print(f"# journal: skipped {journal.resumed} completed unit(s)",
                   file=sys.stderr)

@@ -23,6 +23,7 @@ import sys
 import numpy as np
 
 from ..models.aes import AES, AES_DECRYPT, AES_ENCRYPT
+from ..resilience import watchdog as watchdog_mod
 
 
 def main(argv=None) -> int:
@@ -47,6 +48,13 @@ def main(argv=None) -> int:
                     help="cfb128 resume offset into the feedback register "
                          "(reference aes.h iv_off; 0..15)")
     ap.add_argument("--engine", default="auto")
+    ap.add_argument("--deadline", type=float, metavar="S",
+                    default=watchdog_mod.default_deadline_s(),
+                    help="watchdog deadline per crypt dispatch (seconds): "
+                         "a wedged device turns into a diagnosed error "
+                         "with an all-thread stack dump instead of a CLI "
+                         "that never returns. 0 disables "
+                         "(env OT_DISPATCH_DEADLINE)")
     args = ap.parse_args(argv)
 
     try:
@@ -88,22 +96,39 @@ def main(argv=None) -> int:
             print("Data size must be a multiple of AES block size.",
                   file=sys.stderr)
             return 1
-        if args.mode == "ecb":
-            out = a.crypt_ecb(direction, data)
-        elif args.mode == "cbc":
-            out, _ = a.crypt_cbc(direction, np.frombuffer(iv, np.uint8), data)
-        elif args.mode == "cfb128":
-            # Byte-granular: any data length is legal, and --iv-off resumes
-            # mid-block exactly like the reference's iv_off carry
-            # (aes.c:822-863).
-            out, _, _ = a.crypt_cfb128(
-                direction, args.iv_off, np.frombuffer(iv, np.uint8), data,
-            )
-        else:  # ctr is symmetric
-            out, _, _, _ = a.crypt_ctr(
-                0, np.frombuffer(iv, np.uint8), np.zeros(16, np.uint8), data,
-            )
-        print(out.tobytes().hex())
+        try:
+            # The whole crypt — including any engine compile and the
+            # readback `.tobytes()` forces — under the dispatch watchdog:
+            # this CLI is the cross-backend parity path, and a wedged
+            # device must yield a diagnosed nonzero exit (with a stack
+            # dump naming where it stuck), not a pipe that never closes.
+            with watchdog_mod.deadline(
+                    args.deadline, what=f"decrypt {args.mode} dispatch"):
+                watchdog_mod.injected_hang("dispatch_hang",
+                                           "decrypt dispatch")
+                if args.mode == "ecb":
+                    out = a.crypt_ecb(direction, data)
+                elif args.mode == "cbc":
+                    out, _ = a.crypt_cbc(
+                        direction, np.frombuffer(iv, np.uint8), data)
+                elif args.mode == "cfb128":
+                    # Byte-granular: any data length is legal, and
+                    # --iv-off resumes mid-block exactly like the
+                    # reference's iv_off carry (aes.c:822-863).
+                    out, _, _ = a.crypt_cfb128(
+                        direction, args.iv_off, np.frombuffer(iv, np.uint8),
+                        data,
+                    )
+                else:  # ctr is symmetric
+                    out, _, _, _ = a.crypt_ctr(
+                        0, np.frombuffer(iv, np.uint8),
+                        np.zeros(16, np.uint8), data,
+                    )
+                text = out.tobytes().hex()
+        except watchdog_mod.DispatchTimeout as e:
+            print(f"Dispatch watchdog fired: {e}", file=sys.stderr)
+            return 1
+        print(text)
     return 0
 
 

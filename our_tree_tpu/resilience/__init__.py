@@ -21,6 +21,17 @@ device. This package is the shared layer:
   pallas->bitslice->jnp, native->lax.scan) reports through, so a fallback
   run carries a visible ``degraded:[...]`` record and can never masquerade
   as a healthy one.
+* ``watchdog`` — phase 2: a monitor-thread deadline around any device
+  call; on expiry it dumps all-thread stacks to a crash report, stamps
+  the demotion through ``degrade``, and raises ``DispatchTimeout`` in
+  the main thread. Also hosts ``injected_hang`` (the ``dispatch_hang``
+  fault's sleeping stand-in for a wedged dispatch).
+* ``isolate`` — phase 2: the shared deadline-guarded child runner
+  (``run_child``, SIGKILLs the whole process group, retries through
+  ``policy``) and the ``harness.bench --isolate`` supervisor: one child
+  process per sweep unit, failures journaled, repeat offenders
+  QUARANTINED (skipped on every resume with ``quarantined:<unit>``
+  stamped) so a sweep always terminates.
 
 Every module here is stdlib-only and free of intra-package imports, for the
 same reason utils/devlock.py is: the repo-root ``bench.py`` and the sweep

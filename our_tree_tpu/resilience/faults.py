@@ -36,6 +36,14 @@ point              wired into
                    ``make`` attempt fails as if the compiler had.
 ``lock_busy``      devlock acquisition (``utils.devlock.acquire``): the
                    marker behaves as held by a live concurrent job.
+``dispatch_hang``  device dispatch, the wedged-not-failed variant: the
+                   seam (``harness.bench._time_us``, the TpuBackend
+                   barriers, the Pallas dispatch in ``ops.pallas_aes``)
+                   blocks "forever" in a GIL-releasing sleep
+                   (``watchdog.injected_hang``), for the watchdog to
+                   interrupt or the ``--isolate`` supervisor to SIGKILL.
+``unit_crash``     sweep-unit execution (``harness.bench``): the unit
+                   dies as if the process had crashed mid-row.
 =================  ========================================================
 
 Determinism contract: firings consume counts in call order within ONE
@@ -59,7 +67,8 @@ import sys
 
 #: The names wired into real seams. Parsing accepts others (forward
 #: compat, tests), but warns — see module docstring.
-KNOWN_POINTS = ("init_hang", "dispatch_fail", "build_fail", "lock_busy")
+KNOWN_POINTS = ("init_hang", "dispatch_fail", "build_fail", "lock_busy",
+                "dispatch_hang", "unit_crash")
 
 #: Sentinel count for a bare (uncounted) token: armed forever.
 ALWAYS = -1
@@ -163,3 +172,17 @@ def remaining(point: str) -> int:
     if _REGISTRY is None:
         reset()
     return _REGISTRY.get(point, 0)
+
+
+def armed() -> tuple[str, ...]:
+    """Currently armed point names (a snapshot — safe to fire() while
+    iterating). Supervisors that spawn children use this to METER counted
+    faults instead of letting every child re-arm the full spec: each
+    child spawn consumes one shot per armed counted point and hands the
+    child exactly that shot (``<point>:1``), while bare points pass
+    through unmetered — so ``dispatch_hang:1`` under ``--isolate`` means
+    ONE hung child across the whole sweep, not one per child
+    (resilience/isolate.py:_meter_faults)."""
+    if _REGISTRY is None:
+        reset()
+    return tuple(_REGISTRY)

@@ -1,0 +1,280 @@
+"""Process isolation: the shared child runner + the isolated-sweep
+supervisor (``harness.bench --isolate``).
+
+The watchdog (watchdog.py) can interrupt a hang only while the blocked
+call releases the GIL and only in the main thread; a dispatch wedged
+inside native code — the pathology that actually wedges PJRT tunnels —
+is unkillable from inside its own process. The only defense that always
+works is the one the recovery watcher already uses for whole plans:
+run the risky work in a CHILD process, give it a deadline, and SIGKILL
+the whole process group when the deadline expires. This module makes
+that pattern a primitive instead of four hand-rolled copies
+(scripts/tune_tpu.py, scripts/bitslice_tpu_repro.py,
+scripts/e2e_decompose.py, and now the sweep itself):
+
+* ``run_child`` — run an argv with a wall deadline in its own session,
+  SIGKILL the process GROUP on expiry (several callers' children are
+  themselves parents of jax subprocesses; killing only the child would
+  orphan a grandchild that keeps driving the device), classify the
+  outcome (``ok`` / ``timeout`` / ``crash``), and optionally retry
+  through the shared ``RetryPolicy`` — attempts, backoff, and
+  exhaustion live in ONE place.
+
+* ``run_isolated_sweep`` — the ``--isolate`` mode's supervisor: each
+  sweep unit runs in a child process (the child targets exactly one
+  unit and appends it to the shared journal itself), hangs are
+  SIGKILLed at the unit deadline, failures are recorded as journal
+  failure rows, and a unit that fails ``quarantine_after`` times is
+  QUARANTINED: skipped now and on every later resume, with
+  ``quarantined:<unit>`` stamped through the degrade() chokepoint —
+  a sweep always terminates and never re-burns its budget on a
+  known-bad config. The parent re-emits completed units' lines from
+  the journal (the child's stdout is quarantined with it), so the
+  surviving corpus is byte-identical to a healthy run's rows.
+
+Stdlib-only and free of intra-package imports (bare-loadable by the
+jax-free sweep parents via scripts/_devlock_loader.py); siblings load
+lazily under their canonical dotted names.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+
+def _sibling(name: str):
+    """resilience/<name>.py under its canonical dotted name (see
+    watchdog._sibling — same pattern, kept local so either module is
+    bare-loadable on its own)."""
+    canonical = f"our_tree_tpu.resilience.{name}"
+    mod = sys.modules.get(canonical)
+    if mod is None:
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            canonical,
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         f"{name}.py"))
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[canonical] = mod
+        try:
+            spec.loader.exec_module(mod)
+        except BaseException:
+            sys.modules.pop(canonical, None)
+            raise
+    return mod
+
+
+def _meter_faults(base_env: dict) -> dict:
+    """Meter this process's armed faults into ONE child's environment.
+
+    Children re-parse OT_FAULTS independently (the faults contract), so
+    an unmetered ``dispatch_hang:1`` would hang EVERY child's first
+    dispatch — "one wedged unit among healthy ones", the scenario the
+    quarantine ledger exists for, would be unrehearsable. Instead the
+    supervisor holds the process-wide counters: each spawn consumes one
+    shot per armed counted point and hands the child exactly that shot;
+    bare (fire-forever) points pass through unmetered. With OT_FAULTS
+    unset or exhausted the child env carries no armed points.
+    """
+    if not base_env.get("OT_FAULTS"):
+        return base_env
+    faults = _sibling("faults")
+    tokens = []
+    for point in faults.armed():
+        if faults.remaining(point) == faults.ALWAYS:
+            tokens.append(point)
+        elif faults.fire(point):
+            tokens.append(f"{point}:1")
+    env = dict(base_env)
+    env["OT_FAULTS"] = ",".join(tokens)
+    return env
+
+
+class ChildResult:
+    """One child run's classified outcome.
+
+    ``kind`` is ``"ok"`` (exit 0), ``"crash"`` (any other exit, signal
+    deaths included — ``rc`` is then negative, the POSIX convention), or
+    ``"timeout"`` (deadline expired; the group was SIGKILLed; ``rc`` is
+    whatever the reaped process reported, typically -9). ``out``/``err``
+    are captured text ("" when ``capture=False``); ``wall_s`` the
+    attempt's wall clock.
+    """
+
+    __slots__ = ("kind", "rc", "out", "err", "wall_s")
+
+    def __init__(self, kind: str, rc, out: str, err: str, wall_s: float):
+        self.kind, self.rc = kind, rc
+        self.out, self.err, self.wall_s = out, err, wall_s
+
+    @property
+    def ok(self) -> bool:
+        return self.kind == "ok"
+
+    def __repr__(self):
+        return (f"ChildResult({self.kind!r}, rc={self.rc}, "
+                f"wall_s={self.wall_s:.1f})")
+
+
+def _kill_group(proc) -> None:
+    """SIGKILL the child's whole session (it was started as a session
+    leader); fall back to the single process if the group is gone."""
+    try:
+        os.killpg(proc.pid, signal.SIGKILL)
+    except (OSError, AttributeError):
+        try:
+            proc.kill()
+        except OSError:
+            pass
+
+
+def _attempt(argv, timeout_s, env, cwd, capture) -> ChildResult:
+    t0 = time.monotonic()
+    pipe = subprocess.PIPE if capture else None
+    proc = subprocess.Popen(argv, env=env, cwd=cwd, stdout=pipe, stderr=pipe,
+                            text=True, start_new_session=True)
+    try:
+        out, err = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        _kill_group(proc)
+        # Reap + drain whatever the child managed to write before dying;
+        # partial stderr is often the only evidence of WHERE it hung.
+        out, err = proc.communicate()
+        return ChildResult("timeout", proc.returncode, out or "", err or "",
+                           time.monotonic() - t0)
+    kind = "ok" if proc.returncode == 0 else "crash"
+    return ChildResult(kind, proc.returncode, out or "", err or "",
+                       time.monotonic() - t0)
+
+
+def run_child(argv, timeout_s: float | None = None, *, env=None, cwd=None,
+              capture: bool = True, attempts: int = 1,
+              base_delay_s: float = 0.0, name: str = "",
+              log=None) -> ChildResult:
+    """Run ``argv`` in its own session with a wall deadline; retry
+    non-``ok`` outcomes through the shared RetryPolicy.
+
+    Always returns the LAST attempt's ``ChildResult`` (never raises for
+    child failures — classification is the caller's data, same contract
+    as the hand-rolled loops this replaces). ``attempts=1`` is a plain
+    deadline-guarded run. ``log(attempt, exc)`` is the policy's
+    per-failure observer; the exception's message carries the kind.
+    """
+    policy = _sibling("policy")
+    last: dict = {}
+
+    class _ChildFailed(Exception):
+        pass
+
+    def op(attempt):
+        r = _attempt(argv, timeout_s, env, cwd, capture)
+        last["r"] = r
+        if not r.ok:
+            raise _ChildFailed(f"{r.kind} (rc={r.rc})")
+        return r
+
+    return policy.RetryPolicy(
+        attempts=max(attempts, 1), base_delay_s=base_delay_s,
+        retry_on=(_ChildFailed,), log=log,
+        on_exhausted=lambda e: last["r"],
+        name=name or f"run_child:{os.path.basename(str(argv[0]))}",
+    ).run(op)
+
+
+def run_isolated_sweep(*, units, child_argv, journal_path: str, config: dict,
+                       emit, unit_deadline_s: float, quarantine_after: int,
+                       env=None, cwd=None, log=None) -> list[str]:
+    """Supervise one sweep, one child process per unit attempt.
+
+    ``units`` is the ordered unit-name list (the journal's replay
+    contract: a pure function of ``config``); ``child_argv(unit)``
+    builds the argv of a child that replays the journal, runs exactly
+    that unit, appends it to the journal itself, and exits.
+    ``emit(line)`` is the parent's result emitter (stdout + --out);
+    completed units' lines are re-emitted from the journal whether they
+    completed in this run's child or a previous run's.
+
+    Per unit: spawn, deadline, SIGKILL on expiry, record a failure row
+    on any non-completion; after ``quarantine_after`` recorded failures
+    (across runs — the journal is the ledger) the unit is quarantined:
+    skipped with ``quarantined:<unit>`` stamped through degrade().
+    Returns the quarantined unit names, in sweep order.
+    """
+    journal_mod = _sibling("journal")
+    degr = _sibling("degrade")
+    note = log or (lambda msg: print(msg, file=sys.stderr, flush=True))
+    journal = journal_mod.SweepJournal(journal_path, config)
+    if journal.pending:
+        note(f"# journal: {journal.pending} completed unit(s) on file "
+             f"({journal_path}); resuming")
+    quarantined: list[str] = []
+
+    def emit_entry(entry: dict) -> None:
+        for line in entry.get("lines", []):
+            emit(line)
+        for kind in entry.get("degraded", []):
+            degr.degrade(kind, "restored from journal")
+
+    def consume(name: str) -> bool:
+        """skip+emit `name` iff its completed record is replayable.
+
+        ``skip()`` can return None even after ``is_completed``: a
+        journal whose completed rows are out of sweep order (possible
+        when in-process watchdog failures and later successes
+        interleave across runs) is distrusted and truncated rather
+        than replayed into the wrong slots. The unit then simply
+        re-runs — the safe direction.
+        """
+        if not journal.is_completed(name):
+            return False
+        entry = journal.skip(name)
+        if entry is None:
+            return False
+        emit_entry(entry)
+        return True
+
+    try:
+        for name in units:
+            if consume(name):
+                continue
+            while (journal.fail_count(name) < quarantine_after
+                   and not journal.is_completed(name)):
+                n_prev = journal.fail_count(name)
+                r = run_child(child_argv(name), unit_deadline_s,
+                              env=_meter_faults(dict(env if env is not None
+                                                     else os.environ)),
+                              cwd=cwd, name=f"isolate:{name}")
+                journal.reload_tail()
+                if journal.is_completed(name):
+                    break
+                reason = (f"timeout:{unit_deadline_s:.0f}s"
+                          if r.kind == "timeout" else f"crash:rc={r.rc}")
+                journal.record_failure(name, reason)
+                tail = r.err.strip().splitlines()[-3:]
+                note(f"# isolate: unit {name} failed "
+                     f"({reason}; failure {n_prev + 1}/{quarantine_after})"
+                     + (": " + " | ".join(tail) if tail else ""))
+            if not consume(name):
+                if journal.fail_count(name) >= quarantine_after:
+                    quarantined.append(name)
+                    degr.degrade(
+                        f"quarantined:{name}",
+                        f"{journal.fail_count(name)} recorded failure(s); "
+                        "skipping on this and every resumed run")
+                else:
+                    # Defensive corner: the unit completed but its record
+                    # was distrusted by an order-mismatch truncation. The
+                    # work happened; only the re-emission is lost. Say so
+                    # rather than mislabeling it quarantined.
+                    note(f"# isolate: unit {name} completed but its "
+                         "journal record was distrusted; rows not "
+                         "re-emitted")
+        if journal.resumed:
+            note(f"# journal: skipped {journal.resumed} completed unit(s)")
+    finally:
+        journal.close()
+    return quarantined

@@ -10,10 +10,19 @@ sweep unit, and a restarted sweep with the SAME config hash replays the
 recorded units (re-emitting their result lines verbatim and restoring the
 shared RNG stream) and resumes execution at the first unfinished one.
 
-File format — line 1 is the header, every later line one completed unit::
+File format — line 1 is the header; every later line is either one
+completed unit or one recorded FAILURE of a unit (an isolated child that
+hung or crashed — resilience/isolate.py)::
 
     {"kind": "ot-sweep-journal", "v": 1, "config_hash": "...", "config": {...}}
     {"unit": "ecb:65536", "lines": [...], "rng_state": {...}, "degraded": []}
+    {"unit": "ctr:65536", "failed": true, "reason": "timeout:20s"}
+
+Failure rows are counted (``fail_count``), never replayed: a unit whose
+count reaches the caller's quarantine threshold is skipped on resume
+with a ``quarantined:<unit>`` demotion stamped through degrade() —
+the quarantine ledger of docs/RESILIENCE.md. Completed and failure rows
+interleave freely (a unit can fail twice and then complete).
 
 Durability: entries are flushed + fsync'd as they complete, so a SIGKILL
 can tear at most the in-flight line; a torn or otherwise unparseable tail
@@ -65,6 +74,7 @@ class SweepJournal:
         self.path = path
         self.config_hash = config_hash(config)
         self._replay: list[dict] = []
+        self._fail_counts: dict[str, int] = {}
         self._resumed = 0
         valid_bytes = 0
         header_ok = False
@@ -88,7 +98,13 @@ class SweepJournal:
                     break  # foreign/changed config: invalidate everything
                 header_ok = True
             elif isinstance(rec, dict) and isinstance(rec.get("unit"), str):
-                self._replay.append(rec)
+                if rec.get("failed"):
+                    # A failure row is evidence, not a checkpoint: count
+                    # it toward quarantine, never offer it for replay.
+                    u = rec["unit"]
+                    self._fail_counts[u] = self._fail_counts.get(u, 0) + 1
+                else:
+                    self._replay.append(rec)
             else:
                 break
             offset += len(line)
@@ -126,6 +142,84 @@ class SweepJournal:
         """Units replayed from the journal so far this run."""
         return self._resumed
 
+    def is_completed(self, unit: str) -> bool:
+        """Whether `unit` has an unconsumed replayable record — one
+        loaded from a previous run or absorbed via ``reload_tail`` (a
+        unit this handle ``record()``-ed itself is done, not replayable:
+        its lines were already emitted live).
+
+        Callers MUST gate ``skip()`` on this: with failure rows on file a
+        unit can be absent from the replay list without any disorder
+        (it failed; the next completed unit is a later one), and calling
+        ``skip()`` for it would misread the head mismatch as corruption
+        and truncate a perfectly good tail.
+        """
+        return any(e.get("unit") == unit for e in self._replay)
+
+    def fail_count(self, unit: str) -> int:
+        """Recorded failures of `unit` (the quarantine ledger's count)."""
+        return self._fail_counts.get(unit, 0)
+
+    def record_failure(self, unit: str, reason: str) -> None:
+        """Append one failure row (fsync'd) and count it in-memory.
+
+        Written by the SUPERVISOR (isolate.py's parent — the child that
+        hung was SIGKILLed and cannot write anything), or by the in-
+        process watchdog path when a unit's dispatch times out.
+        """
+        self._fail_counts[unit] = self._fail_counts.get(unit, 0) + 1
+        self._append({"unit": unit, "failed": True, "reason": reason})
+
+    def reload_tail(self) -> int:
+        """Re-read rows appended by another process (an isolated child)
+        since this handle last looked; returns how many completed-unit
+        rows arrived. New completed rows join the replay list (the
+        supervisor consumes them via ``skip`` to re-emit their lines);
+        new failure rows join the counts.
+
+        A torn trailing fragment — the child was SIGKILLed mid-append,
+        which is exactly what the isolate supervisor does to a hung
+        child — is TRUNCATED away before returning: this handle is
+        about to append its own rows (the failure record for that very
+        kill), and appending onto a partial line would glue two records
+        into one unparseable line, silently discarding every later row
+        at the next load. Only called once the child is dead, so there
+        is no live writer to race.
+        """
+        self._fh.flush()
+        seen = self._fh.tell()
+        added = 0
+        with open(self.path, "rb") as f:
+            f.seek(seen)
+            raw = f.read()
+        consumed = 0
+        for line in raw.splitlines(keepends=True):
+            if not line.endswith(b"\n"):
+                break
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                break
+            if not (isinstance(rec, dict) and isinstance(rec.get("unit"),
+                                                         str)):
+                break
+            if rec.get("failed"):
+                u = rec["unit"]
+                self._fail_counts[u] = self._fail_counts.get(u, 0) + 1
+            else:
+                self._replay.append(rec)
+                added += 1
+            consumed += len(line)
+        seen += consumed
+        if consumed < len(raw):  # torn/unparseable tail: cut it off
+            self._fh.truncate(seen)
+        # Keep our append handle pointed past what we just absorbed, so a
+        # later record()/record_failure() lands after the child's rows
+        # (O_APPEND writes at EOF regardless — this only keeps tell()
+        # honest for the next reload).
+        self._fh.seek(seen)
+        return added
+
     def skip(self, unit: str) -> dict | None:
         """The recorded entry for `unit` iff it is next in replay order."""
         if not self._replay:
@@ -149,10 +243,21 @@ class SweepJournal:
         self._fh.close()
         with open(self.path, "rb") as f:
             lines = f.read().splitlines(keepends=True)
-        keep = 1 + self._resumed  # header + consumed prefix
         self._fh = open(self.path, "wb")
-        for line in lines[:keep]:
+        consumed = 0
+        for i, line in enumerate(lines):
+            if i == 0:  # header
+                self._fh.write(line)
+                continue
+            if consumed >= self._resumed:
+                break
             self._fh.write(line)
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                break
+            if not rec.get("failed"):  # failure rows ride along, uncounted
+                consumed += 1
         self._fh.flush()
         os.fsync(self._fh.fileno())
 

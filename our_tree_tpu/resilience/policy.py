@@ -46,6 +46,44 @@ class PolicyExhausted(Exception):
             f"{type(last).__name__ if last else 'none'}: {last}")
 
 
+class Budget:
+    """Shared wall-clock budget accounting (the deadline primitive that
+    kept being re-implemented as ``time.time() - t0 > deadline``).
+
+    One object owns the arithmetic: ``remaining()`` / ``exhausted()``
+    read it, and ``debit(seconds)`` charges simulated costs against it —
+    the generalization of repo-root bench.py's ``_burn``: an injected
+    fault that stands in for a hang must debit the wall clock the real
+    hang would have burned, or the rehearsal exercises a cheaper outage
+    than the real one. ``total_s=0`` (or negative) means unbudgeted:
+    never exhausted, infinite remaining — callers need no None-checks.
+    ``clock`` is injectable for tests, like RetryPolicy's.
+    """
+
+    def __init__(self, total_s: float = 0.0, clock=time.monotonic):
+        self.total_s = max(float(total_s), 0.0)
+        self._clock = clock
+        self._t0 = clock()
+        self._debited = 0.0
+
+    def spent(self) -> float:
+        """Wall seconds consumed so far, simulated debits included."""
+        return (self._clock() - self._t0) + self._debited
+
+    def remaining(self) -> float:
+        """Seconds left (``inf`` when unbudgeted, floored at 0)."""
+        if not self.total_s:
+            return float("inf")
+        return max(self.total_s - self.spent(), 0.0)
+
+    def exhausted(self) -> bool:
+        return bool(self.total_s) and self.spent() >= self.total_s
+
+    def debit(self, seconds: float) -> None:
+        """Charge `seconds` without sleeping (simulated fault cost)."""
+        self._debited += max(float(seconds), 0.0)
+
+
 class Attempt:
     """What one attempt knows: its 0-based ``index``, the policy's
     ``remaining_s`` budget (None = unbudgeted), and a ``timeout_s`` hint

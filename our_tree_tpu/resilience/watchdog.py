@@ -1,0 +1,251 @@
+"""Dispatch watchdog: a monitor-thread deadline around any device call.
+
+PR 1's resilience core protects the *seams around* device work — init,
+build, lock — but a wedged XLA/Pallas dispatch still hangs the whole
+process from the inside: `block_until_ready` on a dead tunnel never
+returns, the deadline checks between stages never run, and nothing can
+even say where the process was stuck. This module is the in-process
+answer (the out-of-process one is ``isolate.py``):
+
+``deadline(seconds, what=...)`` arms a daemon monitor thread that waits
+on an Event. If the guarded block finishes first, the monitor is
+cancelled and the cost was one Event + one thread. If the deadline
+expires first, the monitor — which is NOT blocked, that is the point of
+a second thread —
+
+1. dumps **all-thread stacks** to a crash-report file
+   (``OT_CRASH_DIR``, default ``/tmp/ot_crash``), so a hang leaves
+   evidence of *where* every thread was, not just that it happened;
+2. interrupts the main thread with ``DispatchTimeout``, recording the
+   demotion through the shared ``degrade()`` chokepoint (kind
+   ``dispatch-timeout``) as the exception is raised — ledger stamp and
+   exception appear together or not at all, so a block that completes
+   exactly at the deadline edge is never marked degraded — and the
+   bench JSON line / sweep journal of whatever survives carries the
+   fact.
+
+The interruption rides the same mechanism as bench.py's stage alarm: a
+signal handler raising in the main thread, which works exactly when the
+blocking call releases the GIL (PJRT readbacks, ``time.sleep``,
+subprocess waits do; a C loop that holds the GIL does not — that class
+of hang is what process isolation exists for). Off the main thread, or
+on platforms without SIGALRM, the guard degrades to dump-and-record:
+the stacks and the degradation ledger still happen, only the raise
+cannot.
+
+``DispatchTimeout`` subclasses ``TimeoutError`` on purpose: every
+existing stage-alarm handler (bench.py's fallback chains) catches
+``TimeoutError``, and the watchdog must slot into those paths without
+each one learning a new type.
+
+``injected_hang(point)`` is the fault side of the same seam: when the
+named ``OT_FAULTS`` point (``dispatch_hang``) is armed it sleeps
+"forever" (OT_HANG_S, default 24 h) — a GIL-releasing stand-in for a
+wedged dispatch that the watchdog can interrupt and a supervising
+parent can SIGKILL, so the whole layer is exercisable on CPU in CI.
+
+Stdlib-only and free of intra-package imports (bare-loadable — see the
+package docstring); the sibling degrade/faults modules are loaded
+lazily under their canonical dotted names so the ledger and fault
+counters stay one-per-process across bare and package import contexts.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import signal
+import sys
+import threading
+import time
+import traceback
+
+
+class DispatchTimeout(TimeoutError):
+    """A guarded device call exceeded its watchdog deadline.
+
+    ``what`` names the guarded call; ``report`` is the crash-report path
+    (None when the dump itself failed — the raise still happens).
+    """
+
+    def __init__(self, what: str, seconds: float, report: str | None):
+        self.what, self.seconds, self.report = what, seconds, report
+        super().__init__(
+            f"{what} exceeded its {seconds:.0f}s watchdog deadline"
+            + (f" (stacks: {report})" if report else ""))
+
+
+def _sibling(name: str):
+    """resilience/<name>.py under its canonical dotted name, without an
+    intra-package import (same pattern as utils/devlock.py:_faults)."""
+    canonical = f"our_tree_tpu.resilience.{name}"
+    mod = sys.modules.get(canonical)
+    if mod is None:
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            canonical,
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         f"{name}.py"))
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[canonical] = mod
+        try:
+            spec.loader.exec_module(mod)
+        except BaseException:
+            sys.modules.pop(canonical, None)
+            raise
+    return mod
+
+
+def crash_dir() -> str:
+    return os.environ.get("OT_CRASH_DIR", "/tmp/ot_crash")
+
+
+def default_deadline_s() -> float:
+    """The opt-in global dispatch deadline (OT_DISPATCH_DEADLINE, seconds).
+
+    0 / unset = disabled: the watchdog costs nothing unless a caller or
+    the environment asks for it. Callers that take an explicit deadline
+    flag use this as the flag's default so one env var arms every seam.
+    """
+    try:
+        return max(float(os.environ.get("OT_DISPATCH_DEADLINE", 0) or 0), 0.0)
+    except ValueError:
+        return 0.0
+
+
+def dump_stacks(what: str, seconds: float) -> str | None:
+    """Write every thread's current stack to a crash-report file.
+
+    Returns the path, or None when nothing could be written (an
+    unwritable crash dir must not turn the watchdog's raise into a
+    second, stranger failure). ``sys._current_frames`` over
+    ``faulthandler`` because the report should carry thread NAMES —
+    "which thread is the PJRT callback" is half the diagnosis.
+    """
+    try:
+        d = crash_dir()
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(
+            d, f"watchdog-{os.getpid()}-{int(time.time())}.txt")
+        names = {t.ident: t.name for t in threading.enumerate()}
+        with open(path, "w") as fh:
+            fh.write(f"# watchdog: {what!r} exceeded {seconds:.0f}s "
+                     f"(pid {os.getpid()}, "
+                     f"{time.strftime('%Y-%m-%dT%H:%M:%S%z')})\n")
+            for ident, frame in sorted(sys._current_frames().items()):
+                fh.write(f"\n## thread {names.get(ident, '?')} "
+                         f"(ident {ident})\n")
+                fh.write("".join(traceback.format_stack(frame)))
+        return path
+    except OSError:
+        return None
+
+
+@contextlib.contextmanager
+def deadline(seconds: float | None, what: str = "device dispatch",
+             degrade_kind: str = "dispatch-timeout"):
+    """Guard a block with a monitor-thread deadline.
+
+    ``seconds`` None or <= 0 disarms the guard entirely (the common
+    production case: OT_DISPATCH_DEADLINE unset). On expiry: stacks are
+    dumped, ``degrade(degrade_kind, ...)`` is recorded, and
+    ``DispatchTimeout`` is raised in the main thread via a temporarily
+    installed SIGALRM handler (see module docstring for the off-main /
+    no-SIGALRM degradation). Nesting: the guard saves and restores the
+    previous SIGALRM disposition, so it composes with bench.py's stage
+    alarm as long as the scopes nest properly — but prefer ONE deadline
+    per region; the innermost armed one wins the signal.
+    """
+    if not seconds or seconds <= 0:
+        yield
+        return
+    on_main = (threading.current_thread() is threading.main_thread()
+               and hasattr(signal, "SIGALRM"))
+    fired: dict = {}
+    done = threading.Event()
+
+    def monitor():
+        if done.wait(seconds):
+            return
+        if done.is_set():  # completed exactly at the edge: stand down
+            return
+        fired["report"] = dump_stacks(what, seconds)
+        if on_main and not done.is_set():
+            # Deliver to the Python-level handler (which runs in the
+            # main thread) — this is what interrupts a GIL-releasing
+            # blocking call.
+            try:
+                signal.pthread_kill(threading.main_thread().ident,
+                                    signal.SIGALRM)
+            except (OSError, RuntimeError):
+                pass
+
+    def _record_and_build():
+        # The degrade stamp rides the RAISE, not the monitor: a block
+        # that completes at ~the deadline while the monitor is mid-fire
+        # must not end up permanently marked degraded in a run that
+        # never saw a timeout (the ledger's masquerade guarantee,
+        # inverted). The stack dump may still be written — a harmless
+        # diagnostic file — but the ledger and the exception appear
+        # together or not at all.
+        _sibling("degrade").degrade(
+            degrade_kind,
+            f"{what} exceeded {seconds:.0f}s watchdog deadline")
+        return DispatchTimeout(what, seconds, fired.get("report"))
+
+    old = None
+    if on_main:
+        def handler(signum, frame):
+            raise _record_and_build()
+
+        old = signal.signal(signal.SIGALRM, handler)
+    t = threading.Thread(target=monitor, daemon=True,
+                         name=f"ot-watchdog:{what}")
+    t.start()
+    try:
+        yield
+        # A hang the guard could NOT interrupt (off-main, GIL-held) that
+        # nevertheless returned after expiry: surface the miss rather
+        # than silently continuing past an expired deadline.
+        if "report" in fired and not on_main:
+            raise _record_and_build()
+    finally:
+        done.set()
+        t.join(timeout=2.0)
+        if old is not None:
+            signal.signal(signal.SIGALRM, old)
+
+
+#: Injected hangs fired so far in this process. Callers that must tell
+#: a rehearsed hang from a real one (repo-root bench.py's don't-mask-
+#: real-CPU-bugs guard: a DispatchTimeout that interrupted an INJECTED
+#: sleep is exempt from the raise-on-cpu rule) read ``hangs_injected``.
+_INJECTED_HANGS = 0
+
+
+def hangs_injected() -> int:
+    return _INJECTED_HANGS
+
+
+def injected_hang(point: str, detail: str = "", budget=None) -> None:
+    """Simulate a wedged dispatch when the ``point`` fault is armed.
+
+    Fires one shot at ``point`` (``dispatch_hang``); when it fires,
+    either sleeps OT_HANG_S seconds (default 24 h — "forever" at sweep
+    scale; a GIL-releasing sleep, so the watchdog can interrupt it and a
+    parent can SIGKILL it) or, when a ``policy.Budget`` is passed,
+    debits the hang's cost from it WITHOUT sleeping — the same
+    no-wall-clock rehearsal bench.py's ``_burn`` gives init_hang.
+    No-op while the point is unarmed: one dict lookup.
+    """
+    if not _sibling("faults").fire(point):
+        return
+    global _INJECTED_HANGS
+    _INJECTED_HANGS += 1
+    hang_s = float(os.environ.get("OT_HANG_S", 24 * 3600))
+    if budget is not None:
+        budget.debit(hang_s)
+        return
+    print(f"# OT_FAULTS: {point} sleeping {hang_s:.0f}s"
+          + (f" ({detail})" if detail else ""), file=sys.stderr, flush=True)
+    time.sleep(hang_s)

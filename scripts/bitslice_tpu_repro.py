@@ -17,7 +17,6 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import subprocess
 import sys
 import time
 
@@ -81,34 +80,36 @@ def main() -> int:
 
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    from _devlock_loader import load_devlock
+    from _devlock_loader import load_devlock, load_resilience
 
     # Parse the whole ladder up front: a malformed token must fail the run
     # before any device work, not crash the failure-reporting path later.
     sizes = [float(s) for s in args.sizes.split(",")]
 
     devlock = load_devlock()
+    # Shared deadline-guarded child runner (resilience/isolate.py):
+    # timeout, process-group SIGKILL, and outcome classification in one
+    # place instead of a third hand-rolled copy.
+    reisolate = load_resilience("isolate")
     rc_all = 0
     with devlock.hold(wait_budget_s=600.0):
         for mib in sizes:
             tag = f"bitslice {args.op} {mib:g} MiB"
             print(f"## {tag}", flush=True)
-            try:
-                p = subprocess.run(
-                    [sys.executable, os.path.abspath(__file__),
-                     "--child-mib", str(mib), "--op", args.op],
-                    timeout=args.timeout, capture_output=True, text=True)
-                sys.stdout.write(p.stdout)
-                if p.returncode:
-                    rc_all = 1
-                    tail = (p.stderr or "").strip().splitlines()[-12:]
-                    print(json.dumps({"mib": mib, "ok": False,
-                                      "rc": p.returncode,
-                                      "stderr_tail": tail}), flush=True)
-            except subprocess.TimeoutExpired:
+            r = reisolate.run_child(
+                [sys.executable, os.path.abspath(__file__),
+                 "--child-mib", str(mib), "--op", args.op],
+                args.timeout, name=f"bitslice-repro:{mib:g}MiB")
+            sys.stdout.write(r.out)
+            if r.kind == "timeout":
                 rc_all = 1
                 print(json.dumps({"mib": mib, "ok": False,
                                   "rc": "timeout"}), flush=True)
+            elif r.kind == "crash":
+                rc_all = 1
+                tail = r.err.strip().splitlines()[-12:]
+                print(json.dumps({"mib": mib, "ok": False, "rc": r.rc,
+                                  "stderr_tail": tail}), flush=True)
     return rc_all
 
 

@@ -28,7 +28,6 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import subprocess
 import sys
 import time
 
@@ -118,30 +117,31 @@ def main() -> int:
 
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    from _devlock_loader import load_devlock
+    from _devlock_loader import load_devlock, load_resilience
 
     sizes = [float(s) for s in args.sizes.split(",")]
     devlock = load_devlock()
+    # Shared deadline-guarded child runner (resilience/isolate.py) — see
+    # run_child: timeout, process-group SIGKILL, outcome classification.
+    reisolate = load_resilience("isolate")
     rc_all = 0
     with devlock.hold(wait_budget_s=600.0):
         for mib in sizes:
             print(f"## e2e decompose {mib:g} MiB", flush=True)
-            try:
-                p = subprocess.run(
-                    [sys.executable, os.path.abspath(__file__),
-                     "--child-mib", str(mib)],
-                    timeout=args.timeout, capture_output=True, text=True)
-                sys.stdout.write(p.stdout)
-                if p.returncode:
-                    rc_all = 1
-                    tail = (p.stderr or "").strip().splitlines()[-10:]
-                    print(json.dumps({"mib": mib, "ok": False,
-                                      "rc": p.returncode,
-                                      "stderr_tail": tail}), flush=True)
-            except subprocess.TimeoutExpired:
+            r = reisolate.run_child(
+                [sys.executable, os.path.abspath(__file__),
+                 "--child-mib", str(mib)],
+                args.timeout, name=f"e2e-decompose:{mib:g}MiB")
+            sys.stdout.write(r.out)
+            if r.kind == "timeout":
                 rc_all = 1
                 print(json.dumps({"mib": mib, "ok": False,
                                   "rc": "timeout"}), flush=True)
+            elif r.kind == "crash":
+                rc_all = 1
+                tail = r.err.strip().splitlines()[-10:]
+                print(json.dumps({"mib": mib, "ok": False, "rc": r.rc,
+                                  "stderr_tail": tail}), flush=True)
     return rc_all
 
 

@@ -26,7 +26,6 @@ import argparse
 import os
 import pathlib
 import sys
-import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
@@ -108,6 +107,8 @@ def main() -> int:
 
     from gen_golden import Oracle, build_oracle
     from our_tree_tpu.models.aes import AES, AES_DECRYPT, AES_ENCRYPT
+    from our_tree_tpu.resilience import policy as repolicy
+    from our_tree_tpu.resilience import watchdog as rewatchdog
 
     NativeAES = None
     if args.native:
@@ -129,7 +130,14 @@ def main() -> int:
     oracle = Oracle(build_oracle(pathlib.Path(args.reference)))
     rng = np.random.default_rng(args.seed)
     engines = [e.strip() for e in args.engines.split(",") if e.strip()]
-    t0 = time.time()
+    # The deadline through the shared budget accounting (resilience.
+    # policy.Budget) instead of a hand-rolled `time.time() - t0` check:
+    # one object owns the arithmetic, and injected faults DEBIT it —
+    # an armed dispatch_hang below charges the budget the hang would
+    # have burned (without sleeping), so a faulted fuzz run stops at
+    # the same budget its wedged real twin would, instead of running
+    # the full case count as if nothing happened.
+    budget = repolicy.Budget(args.deadline)
     done = 0
 
     def rand_nonce():
@@ -164,7 +172,8 @@ def main() -> int:
         return out
 
     for case in range(args.iters):
-        if args.deadline and time.time() - t0 > args.deadline:
+        rewatchdog.injected_hang("dispatch_hang", "fuzz case", budget=budget)
+        if budget.exhausted():
             print(f"# deadline reached after {done} cases")
             break
         keybits = int(rng.choice([128, 192, 256]))
@@ -402,7 +411,7 @@ def main() -> int:
             # (same reason tests/conftest.py clears per module). Dropping
             # them bounds the fuzzer's footprint at a small recompile cost.
             jax.clear_caches()
-            print(f"# {done} cases ok ({time.time() - t0:.0f}s)", flush=True)
+            print(f"# {done} cases ok ({budget.spent():.0f}s)", flush=True)
     print(f"FUZZ PASS: {done} randomized configs bit-exact vs the oracle, "
           f"outputs and resume states (engines={engines})")
     return 0

@@ -36,6 +36,12 @@ watcher returns to probing and resumes the plan from the failed step.
     python scripts/recover_watch.py [--probe-interval 780] [--budget-h 10]
 
 Logs to --plan-dir (default /tmp/ot_plan); prints one status line per event.
+Completed steps are checkpointed through the shared sweep journal
+(resilience.journal, ``--journal``; default ``<plan-dir>/plan.jsonl``):
+a watcher restarted after a container death resumes at the first
+unfinished step with no hand-carried ``--start-step`` index, and a
+changed plan invalidates the record instead of replaying into the wrong
+steps.
 """
 from __future__ import annotations
 
@@ -52,6 +58,7 @@ sys.path.insert(0, os.path.join(REPO, "scripts"))
 from _devlock_loader import load_devlock, load_resilience  # noqa: E402
 
 repolicy = load_resilience("policy")
+rejournal = load_resilience("journal")
 
 
 class _Busy(Exception):
@@ -191,18 +198,39 @@ def main() -> int:
     ap.add_argument("--budget-h", type=float, default=10.0,
                     help="give up after this many hours")
     ap.add_argument("--plan-dir", default="/tmp/ot_plan")
+    ap.add_argument("--journal", default=None, metavar="PATH",
+                    help="plan-step journal (resilience.journal JSONL; "
+                         "default <plan-dir>/plan.jsonl): each completed "
+                         "step appends as it finishes, and a restarted "
+                         "watcher with the SAME plan resumes at the first "
+                         "unfinished step — the hand-rolled --start-step "
+                         "bookkeeping, journaled. A changed plan "
+                         "invalidates the journal")
     ap.add_argument("--start-step", type=int, default=0,
-                    help="resume the plan from this step index")
+                    help="manual override: resume the plan from this step "
+                         "index, regardless of the journal (escape hatch; "
+                         "the journal resume needs no index)")
     args = ap.parse_args()
 
     os.makedirs(args.plan_dir, exist_ok=True)
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
     deadline = time.time() + args.budget_h * 3600
     steps = plan()
+    # The plan's identity, for the journal's config hash: step names,
+    # argv (minus the interpreter path — it is host detail, not plan
+    # shape), env overlays, and outer timeouts. Any edit to the plan
+    # invalidates recorded progress — replaying "step 3 done" into a
+    # different step 3 is exactly the wrong-slot replay the journal's
+    # hash exists to prevent.
+    journal = rejournal.SweepJournal(
+        args.journal or os.path.join(args.plan_dir, "plan.jsonl"),
+        {"plan": [[name, argv[1:], env, outer]
+                  for name, argv, env, outer in steps]})
     idx = args.start_step
     ledger("watcher_start", interval_s=f"{args.probe_interval:.0f}",
            probe_timeout_s=f"{args.probe_timeout:.0f}",
-           budget_h=args.budget_h, start_step=idx, pid=os.getpid())
+           budget_h=args.budget_h, start_step=idx,
+           journaled_steps=journal.pending, pid=os.getpid())
 
     devlock = load_devlock()
     #: Children are re-pointed at a plan-local marker so they serialize
@@ -298,13 +326,33 @@ def main() -> int:
 
     abandon = object()
     while idx < len(steps) and time.time() < deadline:
+        step = steps[idx]
+        # Journal resume: a step completed by a previous watcher run (the
+        # container died, the watcher was restarted) is skipped here —
+        # what --start-step used to do by hand, now read from the
+        # journal. The manual index still wins when given: steps it
+        # jumps over are simply not recorded, and the journal's own
+        # order check distrusts any tail that stops matching.
+        if journal.is_completed(step[0]):
+            # skip() can still return None: a manual --start-step that
+            # jumped over recorded steps breaks replay order, and the
+            # journal distrusts (and truncates) the tail rather than
+            # replaying into the wrong slots. Fall through and run the
+            # step — re-running is the safe direction.
+            entry = journal.skip(step[0])
+            if entry is not None:
+                ledger("step_resumed", name=step[0],
+                       recorded=";".join(entry.get("lines", [])))
+                print(f"# {step[0]}: completed in a previous run "
+                      f"(journal); skipping", flush=True)
+                idx += 1
+                continue
         # The probe-until-live loop is the shared retry primitive
         # (resilience.policy): unbounded attempts, per-outcome delays
         # (the exceptions carry their own retry_delay_s), total budget =
         # whatever is left of --budget-h. Exhausting the budget while
         # still busy/wedged abandons the plan at this step, exactly the
         # old loop's semantics.
-        step = steps[idx]
         rc = repolicy.RetryPolicy(
             attempts=None,
             budget_s=max(deadline - time.time(), 0.0),
@@ -314,8 +362,15 @@ def main() -> int:
         ).run(lambda a: attempt_step(step))
         if rc is abandon:
             break
+        # A non-timeout return — success OR the step's own failure — is
+        # this plan's definition of "done with the step" (the old loop
+        # moved on either way; the log has the story). Record it so a
+        # restarted watcher does not re-run a 4 h sweep that already
+        # finished.
+        journal.record(step[0], [f"rc={rc}"])
         idx += 1
     done = idx >= len(steps)
+    journal.close()
     ledger("watcher_exit", done=done, next_step_idx=idx)
     print(f"PLAN {'COMPLETE' if done else f'ABANDONED at step {idx}'}",
           flush=True)

@@ -17,12 +17,13 @@ import argparse
 import itertools
 import json
 import os
-import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-from _devlock_loader import load_devlock, load_ranking  # noqa: E402
+from _devlock_loader import load_devlock, load_ranking, load_resilience  # noqa: E402
+
+reisolate = load_resilience("isolate")
 
 CHILD = r"""
 import json, os, sys, time
@@ -164,25 +165,28 @@ def main() -> int:
             tag = (f"tile={tile:<5} mc={mc:<4} sbox={sbox:<5} "
                    f"engine={engine}"
                    + (f" unroll={unroll}" if unroll != "1" else ""))
-            try:
-                out = subprocess.run(
-                    [sys.executable, "-u", "-c", code], env=env,
-                    timeout=args.timeout,
-                    capture_output=True, text=True, check=True,
-                )
-                r = json.loads(out.stdout.strip().splitlines()[-1])
-                results.append((r["gbps"], tag, tile, mc, engine, sbox,
-                                unroll))
-                digests.add(r["digest"])
-                platforms.add(r.get("platform", "unknown"))
-                print(f"{tag}  ->  {r['gbps']:7.3f} GB/s  "
-                      f"digest={r['digest']:#010x}", flush=True)
-            except subprocess.TimeoutExpired:
+            # The shared deadline-guarded child runner (resilience/
+            # isolate.py): one place owns the timeout, the process-GROUP
+            # SIGKILL (a hung config must not leave a grandchild driving
+            # the device), and the outcome classification the three
+            # sweep scripts used to hand-roll separately.
+            r = reisolate.run_child([sys.executable, "-u", "-c", code],
+                                    args.timeout, env=env,
+                                    name=f"tune:{engine}")
+            if r.kind == "timeout":
                 print(f"{tag}  ->  TIMEOUT", flush=True)
-            except subprocess.CalledProcessError as e:
-                msg = (e.stderr or "").strip().splitlines()
+            elif r.kind == "crash":
+                msg = r.err.strip().splitlines()
                 print(f"{tag}  ->  FAILED ({msg[-1] if msg else 'no stderr'})",
                       flush=True)
+            else:
+                rr = json.loads(r.out.strip().splitlines()[-1])
+                results.append((rr["gbps"], tag, tile, mc, engine, sbox,
+                                unroll))
+                digests.add(rr["digest"])
+                platforms.add(rr.get("platform", "unknown"))
+                print(f"{tag}  ->  {rr['gbps']:7.3f} GB/s  "
+                      f"digest={rr['digest']:#010x}", flush=True)
     if len(digests) > 1:
         print("WARNING: digests disagree across configs — a config computed "
               "different ciphertext; do not trust this sweep", file=sys.stderr)

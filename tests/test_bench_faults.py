@@ -91,6 +91,30 @@ def test_lock_busy_diverts_to_native_without_contending(tmp_path):
     assert "not contending" in err
 
 
+def test_dispatch_hang_watchdog_interrupts_and_reports(tmp_path):
+    """OT_FAULTS=dispatch_hang:1 — the wedged-not-failed dispatch: the
+    measure stage blocks in a GIL-releasing sleep, the stage watchdog
+    (resilience/watchdog.py) interrupts it at the stage budget, dumps
+    all-thread stacks to a crash report, and the fallback chain still
+    ends in one parseable JSON line whose degraded record names BOTH
+    facts: the watchdog demotion and the native fallback."""
+    line, err = _run_bench(tmp_path, {
+        "OT_FAULTS": "dispatch_hang:1",
+        "JAX_PLATFORMS": "cpu",
+        "OT_BENCH_DEADLINE": "12",  # stage budget ≈ 7 s: a fast rehearsal
+        "OT_HANG_S": "300",
+        "OT_BENCH_CPU_NATIVE": "0",
+        "OT_CRASH_DIR": str(tmp_path / "crash"),
+    })
+    assert line["unit"] == "GB/s"
+    assert line["degraded"] == ["dispatch-timeout", "device->native"]
+    assert "native" in line["metric"]
+    assert "headline failed (DispatchTimeout" in err
+    import pathlib
+
+    assert list(pathlib.Path(tmp_path / "crash").glob("watchdog-*.txt"))
+
+
 def test_faults_unset_healthy_line_has_no_degraded_key(tmp_path):
     """The no-op guarantee: with OT_FAULTS unset the injection seam must
     not perturb the output contract — same schema, no degraded key."""

@@ -1,0 +1,242 @@
+"""Process-isolated sweep units (harness.bench --isolate) and the shared
+child runner (resilience/isolate.py): a hung unit is SIGKILLed at its
+deadline and journaled as failed, repeat offenders are quarantined and
+skipped on resume with a degraded stamp, and the surviving units' corpus
+stays byte-identical to a non-faulted run."""
+
+import json
+import os
+import pathlib
+import sys
+import time
+
+import pytest
+
+from our_tree_tpu.resilience import faults, isolate
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+#: The journal-resume suite's fast deterministic sweep config: portable-C
+#: rows under a fake clock, so corpora are byte-comparable across runs.
+ARGS = ["--backend", "c", "--modes", "ecb,rc4", "--sizes-mb", "0.0625",
+        "--workers", "1", "--iters", "2"]
+ENV = {"OT_FAKE_TIME_US": "7", "OT_C_FORCE_PORTABLE": "1",
+       "JAX_PLATFORMS": "cpu", "PYTHONPATH": ""}
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv("OT_FAULTS", raising=False)
+    faults.reset()
+    yield
+    monkeypatch.delenv("OT_FAULTS", raising=False)
+    faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# run_child: the shared deadline-guarded subprocess runner.
+# ---------------------------------------------------------------------------
+
+
+def test_run_child_classifies_ok_crash_timeout():
+    ok = isolate.run_child([sys.executable, "-c", "print('x')"], 30)
+    assert ok.ok and ok.kind == "ok" and ok.out.strip() == "x"
+    crash = isolate.run_child(
+        [sys.executable, "-c", "import sys; sys.exit(5)"], 30)
+    assert crash.kind == "crash" and crash.rc == 5
+    t0 = time.monotonic()
+    hung = isolate.run_child(
+        [sys.executable, "-c", "import time; time.sleep(60)"], 1.0)
+    assert hung.kind == "timeout"
+    assert time.monotonic() - t0 < 15  # killed at the deadline, not 60 s
+
+
+def test_run_child_sigkills_whole_process_group(tmp_path):
+    """A child that spawns its own grandchild (smoke/tune/corpus steps
+    do) must die as a GROUP: an orphaned grandchild that keeps driving
+    the device is the documented two-process wedge trigger."""
+    pidfile = tmp_path / "grandchild.pid"
+    code = (
+        "import os, subprocess, sys, time\n"
+        "p = subprocess.Popen([sys.executable, '-c',"
+        " 'import time; time.sleep(60)'])\n"
+        f"open({str(pidfile)!r}, 'w').write(str(p.pid))\n"
+        "time.sleep(60)\n")
+    r = isolate.run_child([sys.executable, "-c", code], 2.0)
+    assert r.kind == "timeout"
+    gpid = int(pidfile.read_text())
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        try:
+            os.kill(gpid, 0)
+        except ProcessLookupError:
+            break  # grandchild reaped with the group
+        time.sleep(0.1)
+    else:
+        os.kill(gpid, 9)
+        raise AssertionError("grandchild survived the group SIGKILL")
+
+
+def test_run_child_retries_through_shared_policy(tmp_path):
+    """attempts>1 routes through RetryPolicy: fail once, then succeed."""
+    flag = tmp_path / "flag"
+    code = (f"import os, sys\n"
+            f"sys.exit(0) if os.path.exists({str(flag)!r}) else None\n"
+            f"open({str(flag)!r}, 'w').close(); sys.exit(1)\n")
+    r = isolate.run_child([sys.executable, "-c", code], 30, attempts=2)
+    assert r.ok
+    # exhaustion returns the LAST result instead of raising
+    r = isolate.run_child([sys.executable, "-c", "import sys; sys.exit(2)"],
+                          30, attempts=2)
+    assert r.kind == "crash" and r.rc == 2
+
+
+def test_meter_faults_hands_one_shot_per_spawn(monkeypatch):
+    monkeypatch.setenv("OT_FAULTS", "dispatch_hang:1,build_fail")
+    faults.reset()
+    env1 = isolate._meter_faults({"OT_FAULTS": "dispatch_hang:1,build_fail"})
+    # first spawn: the counted shot travels, the bare point passes through
+    toks = set(env1["OT_FAULTS"].split(","))
+    assert toks == {"dispatch_hang:1", "build_fail"}
+    env2 = isolate._meter_faults({"OT_FAULTS": "dispatch_hang:1,build_fail"})
+    assert set(env2["OT_FAULTS"].split(",")) == {"build_fail"}  # exhausted
+    assert isolate._meter_faults({}) == {}  # unset spec: untouched
+
+
+# ---------------------------------------------------------------------------
+# harness.bench --isolate end-to-end (the PR's acceptance scenario).
+# ---------------------------------------------------------------------------
+
+
+def _env(extra=None):
+    env = dict(os.environ)
+    env.update(ENV)
+    env.update(extra or {})
+    return env
+
+
+def _run_bench(out, journal, extra_args=(), extra_env=None, timeout=300):
+    import subprocess
+
+    argv = [sys.executable, "-m", "our_tree_tpu.harness.bench", *ARGS,
+            "--isolate", "--journal", str(journal), "--out", str(out),
+            *extra_args]
+    return subprocess.run(argv, env=_env(extra_env), cwd=ROOT,
+                          capture_output=True, text=True, timeout=timeout)
+
+
+def _journal_records(path):
+    return [json.loads(line) for line in open(path)][1:]  # minus header
+
+
+def test_isolate_acceptance_hang_quarantine_resume(tmp_path):
+    """The acceptance criterion end-to-end: under OT_FAULTS=dispatch_hang:1
+    the hung unit is SIGKILLed at its deadline and journaled as failed,
+    the sweep completes, and a re-run resumes past the quarantined unit
+    with degraded:["quarantined:..."] while the healthy units' output is
+    byte-identical to a non-faulted run."""
+    # 1. Non-faulted isolated reference run.
+    ref = _run_bench(tmp_path / "ref.txt", tmp_path / "jref.jsonl",
+                     ["--unit-deadline", "60"])
+    assert ref.returncode == 0, ref.stderr[-2000:]
+    ref_lines = (tmp_path / "ref.txt").read_text().splitlines()
+    ref_entries = {e["unit"]: e for e in _journal_records(tmp_path
+                                                          / "jref.jsonl")}
+
+    # 2. Faulted run: the first child's first timed region sleeps
+    # "forever"; the supervisor SIGKILLs it at the 25 s unit deadline.
+    t0 = time.monotonic()
+    r1 = _run_bench(tmp_path / "run1.txt", tmp_path / "j.jsonl",
+                    ["--unit-deadline", "25", "--quarantine-after", "1"],
+                    {"OT_FAULTS": "dispatch_hang:1"})
+    assert r1.returncode == 0, r1.stderr[-2000:]  # the sweep completed
+    assert time.monotonic() - t0 < 250
+    recs = _journal_records(tmp_path / "j.jsonl")
+    fails = [e for e in recs if e.get("failed")]
+    assert len(fails) == 1 and fails[0]["unit"] == "ecb:65536"
+    assert fails[0]["reason"].startswith("timeout:")
+    assert "quarantined:ecb:65536" in r1.stderr
+
+    # 3. Re-run with the same journal: the quarantined unit is skipped
+    # (no child is even spawned for it), the degraded stamp rides the
+    # corpus, and the journal entry for every later unit carries its
+    # degraded:[...] JSON field untouched.
+    r2 = _run_bench(tmp_path / "run2.txt", tmp_path / "j.jsonl",
+                    ["--unit-deadline", "25", "--quarantine-after", "1"],
+                    {"OT_FAULTS": "dispatch_hang:1"})
+    assert r2.returncode == 0, r2.stderr[-2000:]
+    out2 = (tmp_path / "run2.txt").read_text().splitlines()
+    assert out2[-1] == "# degraded: quarantined:ecb:65536"
+
+    # 4. Byte-identity of the surviving units: the reference corpus
+    # minus the quarantined unit's own SEGMENT (positional, from the ref
+    # journal — rc4's rows repeat ecb's derived line verbatim under the
+    # fake clock, so set-subtraction would over-remove) == the faulted
+    # corpus minus its trailer.
+    want = []
+    for e in _journal_records(tmp_path / "jref.jsonl"):
+        if e["unit"] != "ecb:65536":
+            want.extend(e["lines"])
+    assert sum((e["lines"] for e in
+                _journal_records(tmp_path / "jref.jsonl")), []) == ref_lines
+    assert out2[:-1] == want
+    assert (tmp_path / "run1.txt").read_text().splitlines()[:-1] == want
+
+
+def test_isolate_unit_crash_quarantines_after_n(tmp_path):
+    """unit_crash (the injected mid-unit process death): with the default
+    metering one child crashes, the RETRY succeeds (the shot is spent),
+    and the unit completes with its failure row as evidence."""
+    r = _run_bench(tmp_path / "out.txt", tmp_path / "j.jsonl",
+                   ["--unit-deadline", "60", "--quarantine-after", "2"],
+                   {"OT_FAULTS": "unit_crash:1"})
+    assert r.returncode == 0, r.stderr[-2000:]
+    recs = _journal_records(tmp_path / "j.jsonl")
+    fails = [e for e in recs if e.get("failed")]
+    assert len(fails) == 1 and fails[0]["reason"].startswith("crash:")
+    done = [e["unit"] for e in recs if not e.get("failed")]
+    assert "ecb:65536" in done  # crashed once, then completed
+    assert "quarantined" not in (tmp_path / "out.txt").read_text()
+
+
+def test_watchdog_in_sweep_journals_failure_and_continues(tmp_path):
+    """The in-process variant (no --isolate): a unit whose dispatch hangs
+    past --dispatch-deadline fails via the watchdog — failure row in the
+    journal, sweep continues to completion instead of wedging."""
+    import subprocess
+
+    # 8 s: far above any healthy unit (ms-scale portable-C rows) so a
+    # loaded host cannot time out HEALTHY units, far below the 120 s
+    # injected hang so the test stays quick.
+    argv = [sys.executable, "-m", "our_tree_tpu.harness.bench", *ARGS,
+            "--journal", str(tmp_path / "j.jsonl"),
+            "--out", str(tmp_path / "out.txt"),
+            "--dispatch-deadline", "8"]
+    r = subprocess.run(
+        argv, env=_env({"OT_FAULTS": "dispatch_hang:1", "OT_HANG_S": "120",
+                        "OT_CRASH_DIR": str(tmp_path / "crash")}),
+        cwd=ROOT, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "# watchdog:" in r.stderr
+    recs = _journal_records(tmp_path / "j.jsonl")
+    fails = [e for e in recs if e.get("failed")]
+    assert len(fails) == 1 and fails[0]["reason"].startswith("watchdog:")
+    assert [e["unit"] for e in recs if not e.get("failed")] == [
+        "rc4:65536", "arc4-self-test"]
+    assert list((tmp_path / "crash").glob("watchdog-*.txt"))
+
+
+def test_isolate_requires_journal_and_explicit_workers(tmp_path):
+    import subprocess
+
+    r = subprocess.run(
+        [sys.executable, "-m", "our_tree_tpu.harness.bench", *ARGS,
+         "--isolate"],
+        env=_env(), cwd=ROOT, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 2 and "--journal" in r.stderr
+    r = subprocess.run(
+        [sys.executable, "-m", "our_tree_tpu.harness.bench",
+         "--backend", "c", "--modes", "ecb", "--sizes-mb", "0.0625",
+         "--isolate", "--journal", str(tmp_path / "j.jsonl")],
+        env=_env(), cwd=ROOT, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 2 and "--workers" in r.stderr

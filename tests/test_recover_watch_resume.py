@@ -1,0 +1,94 @@
+"""recover_watch's plan-step resume through resilience.journal (the
+ROADMAP follow-up this PR absorbs): completed steps are journaled as they
+finish, a restarted watcher skips them without any hand-carried
+--start-step index, and an edited plan invalidates the record."""
+
+import importlib.util
+import json
+import os
+import pathlib
+import sys
+
+import pytest
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture()
+def rw(tmp_path, monkeypatch):
+    """A recover_watch module instance sandboxed into tmp_path: its
+    committed ledger, log mirror target, and devlock marker must never
+    touch the real repo from a test."""
+    spec = importlib.util.spec_from_file_location(
+        "_rw_under_test", ROOT / "scripts" / "recover_watch.py")
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["_rw_under_test"] = mod
+    spec.loader.exec_module(mod)
+    monkeypatch.setattr(mod, "REPO", str(tmp_path))
+    monkeypatch.setattr(mod, "LEDGER", str(tmp_path / "probes.log"))
+    monkeypatch.setattr(mod, "probe", lambda timeout_s: (True, 0.1))
+    monkeypatch.setenv("OT_BENCH_BUSY_FILE", str(tmp_path / "busy"))
+    steps = [
+        ("s1", [sys.executable, "-c", "print('one')"], {}, 60),
+        ("s2", [sys.executable, "-c", "import sys; sys.exit(3)"], {}, 60),
+    ]
+    monkeypatch.setattr(mod, "plan", lambda: steps)
+    yield mod
+    sys.modules.pop("_rw_under_test", None)
+
+
+def _run(mod, monkeypatch, plan_dir, extra=()):
+    monkeypatch.setattr(sys, "argv",
+                        ["recover_watch.py", "--plan-dir", str(plan_dir),
+                         "--budget-h", "0.05", "--probe-interval", "1",
+                         *extra])
+    return mod.main()
+
+
+def test_completed_steps_resume_from_journal(rw, tmp_path, monkeypatch):
+    plan_dir = tmp_path / "plan"
+    assert _run(rw, monkeypatch, plan_dir) == 0  # both steps ran
+    journal = plan_dir / "plan.jsonl"
+    recs = [json.loads(l) for l in open(journal)][1:]
+    # Both steps recorded — including s2, whose NONZERO rc is this
+    # plan's "done with the step" (the log has its story; a restart must
+    # not re-run a finished 4 h sweep because its rc was 3).
+    assert [(r["unit"], r["lines"]) for r in recs] == [
+        ("s1", ["rc=0"]), ("s2", ["rc=3"])]
+    log1 = (plan_dir / "s1.log").read_text()
+
+    # Restart: both steps skip via the journal; no child runs again.
+    assert _run(rw, monkeypatch, plan_dir) == 0
+    assert (plan_dir / "s1.log").read_text() == log1  # not re-attempted
+    recs2 = [json.loads(l) for l in open(journal)][1:]
+    assert len(recs2) == 2  # no duplicate records
+
+
+def test_start_step_override_skips_journal_and_reruns_safely(
+        rw, tmp_path, monkeypatch):
+    """The manual --start-step escape hatch jumps over journaled steps,
+    which breaks replay order; the journal distrusts the tail and the
+    watcher must RE-RUN the step (safe direction), not crash
+    dereferencing a distrusted record."""
+    plan_dir = tmp_path / "plan"
+    assert _run(rw, monkeypatch, plan_dir) == 0  # journals s1 and s2
+    assert _run(rw, monkeypatch, plan_dir, ["--start-step", "1"]) == 0
+    # The jumped-over record is distrusted along with the tail (replay
+    # is strictly ordered); re-running is the accepted cost of the
+    # manual override. s2 ran again and was re-recorded.
+    recs = [json.loads(l) for l in open(plan_dir / "plan.jsonl")][1:]
+    assert [r["unit"] for r in recs] == ["s2"]
+
+
+def test_changed_plan_invalidates_step_journal(rw, tmp_path, monkeypatch):
+    plan_dir = tmp_path / "plan"
+    assert _run(rw, monkeypatch, plan_dir) == 0
+    # Edit the plan: replaying "step done" into different steps would be
+    # the wrong-slot replay the config hash exists to prevent.
+    monkeypatch.setattr(rw, "plan", lambda: [
+        ("s1", [sys.executable, "-c", "print('changed')"], {}, 60)])
+    assert _run(rw, monkeypatch, plan_dir) == 0
+    recs = [json.loads(l) for l in open(plan_dir / "plan.jsonl")]
+    assert len(recs) == 2  # fresh header + the re-run step
+    assert recs[1]["unit"] == "s1" and recs[1]["lines"] == ["rc=0"]
+    assert "changed" in (plan_dir / "s1.log").read_text()

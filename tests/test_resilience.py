@@ -170,6 +170,25 @@ def test_policy_attempt_timeout_clamped_to_budget():
     assert seen == [10.0, 4.0]  # second attempt sees only what's left
 
 
+def test_budget_accounting_and_debit():
+    clock = [0.0]
+    b = policy.Budget(10.0, clock=lambda: clock[0])
+    clock[0] = 3.0
+    assert b.spent() == 3.0 and b.remaining() == 7.0 and not b.exhausted()
+    # A simulated fault debits without wall clock passing — the shared
+    # _burn: the rehearsal must cost what the real outage costs.
+    b.debit(6.0)
+    assert b.spent() == 9.0 and not b.exhausted()
+    b.debit(1.0)
+    assert b.exhausted() and b.remaining() == 0.0
+
+
+def test_budget_zero_means_unbudgeted():
+    b = policy.Budget(0)
+    b.debit(1e9)
+    assert b.remaining() == float("inf") and not b.exhausted()
+
+
 # ---------------------------------------------------------------------------
 # faults: the OT_FAULTS grammar and the registry semantics
 # ---------------------------------------------------------------------------
@@ -222,6 +241,27 @@ def test_faults_unknown_point_warns_but_arms(monkeypatch, capsys):
     faults.reset()
     assert "unknown injection point" in capsys.readouterr().err
     assert faults.fire("tpyo_fail")  # armed anyway (forward compat)
+
+
+def test_faults_new_points_are_known(monkeypatch, capsys):
+    """dispatch_hang / unit_crash are registered names: arming them must
+    not trip the unknown-point warning (a warned-but-armed point is how
+    TYPOS are caught; a real point warning would train people to ignore
+    it)."""
+    monkeypatch.setenv("OT_FAULTS", "dispatch_hang:1,unit_crash:1")
+    faults.reset()
+    assert sorted(faults.armed()) == ["dispatch_hang", "unit_crash"]
+    assert "unknown" not in capsys.readouterr().err
+
+
+def test_faults_armed_snapshot_is_fire_safe(monkeypatch):
+    monkeypatch.setenv("OT_FAULTS", "init_hang:1,build_fail")
+    faults.reset()
+    for point in faults.armed():  # the metering loop's shape
+        faults.fire(point)
+    assert faults.remaining("init_hang") == 0
+    assert faults.remaining("build_fail") == faults.ALWAYS
+    assert faults.armed() == ("build_fail",)
 
 
 # ---------------------------------------------------------------------------
@@ -322,6 +362,70 @@ def test_journal_order_mismatch_distrusts_tail(tmp_path):
     j2.close()
     recs = [json.loads(l) for l in open(tmp_path / "j.jsonl")]
     assert [r.get("unit") for r in recs] == [None, "a", "ZZZ"]
+
+
+def test_journal_failure_rows_count_but_never_replay(tmp_path):
+    """The quarantine ledger's substrate: failure rows accumulate counts
+    (across handles — the ledger survives restarts), stay out of the
+    replay list, and interleave freely with completed rows."""
+    j = _mkjournal(tmp_path)
+    j.record_failure("a", "timeout:5s")
+    j.record_failure("a", "crash:rc=-9")
+    j.record("b", ["rowB"], None, [])
+    j.record_failure("c", "timeout:5s")
+    assert j.fail_count("a") == 2 and j.fail_count("c") == 1
+    j.close()
+    j2 = _mkjournal(tmp_path)
+    assert j2.fail_count("a") == 2 and j2.fail_count("c") == 1
+    assert j2.pending == 1  # only b replays
+    assert not j2.is_completed("a") and j2.is_completed("b")
+    # is_completed gates skip(): asking for the failed unit must not be
+    # treated as an order mismatch (which would truncate b away).
+    assert not j2.is_completed("a")
+    assert j2.skip("b")["lines"] == ["rowB"]
+    # a late success after failures: the unit completes normally
+    j2.record("a", ["rowA"], None, [])
+    j2.close()
+    j3 = _mkjournal(tmp_path)
+    assert j3.is_completed("a") and j3.fail_count("a") == 2
+
+
+def test_journal_reload_tail_absorbs_other_writers(tmp_path):
+    """The isolate supervisor's read path: rows appended by a CHILD
+    process (same file, separate handle) become visible to the parent's
+    open handle via reload_tail — completed rows join replay, failure
+    rows join the counts, and the parent's own appends still land after
+    them."""
+    j = _mkjournal(tmp_path)
+    other = _mkjournal(tmp_path)  # stands in for the child's handle
+    other.record("u1", ["r1"], None, [])
+    other.record_failure("u2", "timeout:1s")
+    other.close()
+    assert j.pending == 0  # not yet visible to the parent handle
+    assert j.reload_tail() == 1
+    assert j.is_completed("u1") and j.fail_count("u2") == 1
+    j.record_failure("u2", "timeout:1s")
+    j.close()
+    j2 = _mkjournal(tmp_path)
+    assert j2.fail_count("u2") == 2 and j2.is_completed("u1")
+
+
+def test_journal_reload_tail_truncates_torn_child_write(tmp_path):
+    """The SIGKILL-mid-append artifact, supervisor-side: a child killed
+    while writing leaves a partial line; reload_tail must cut it off
+    BEFORE the parent appends its failure row, or the two glue into one
+    unparseable line and the next load discards everything after it —
+    quarantine counts would reset every run."""
+    j = _mkjournal(tmp_path)
+    with open(tmp_path / "j.jsonl", "ab") as f:  # the killed child's torn row
+        f.write(b'{"unit": "x", "lines": ["par')
+    assert j.reload_tail() == 0
+    j.record_failure("x", "timeout:1s")
+    j.record("y", ["rowY"], None, [])
+    j.close()
+    j2 = _mkjournal(tmp_path)
+    assert j2.fail_count("x") == 1  # the failure row survived the tear
+    assert j2.skip("y")["lines"] == ["rowY"]
 
 
 def test_journal_fresh_file_has_header_immediately(tmp_path):
