@@ -77,7 +77,8 @@ _T0 = time.perf_counter()
 # the same fault counters and degradation ledger (docs/RESILIENCE.md).
 sys.path.insert(0, os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "scripts"))
-from _devlock_loader import load_devlock, load_ranking, load_resilience  # noqa: E402
+from _devlock_loader import (  # noqa: E402
+    load_devlock, load_obs, load_ranking, load_resilience)
 
 devlock = load_devlock()
 ranking = load_ranking()
@@ -85,6 +86,8 @@ faults = load_resilience("faults")
 repolicy = load_resilience("policy")
 degrade = load_resilience("degrade")
 watchdog = load_resilience("watchdog")
+obstrace = load_obs("trace")
+obstrace.ensure_run()
 
 
 def _left() -> float:
@@ -175,19 +178,20 @@ def _ensure_live_backend() -> None:
             stderr=subprocess.DEVNULL,
         )
 
-    repolicy.RetryPolicy(
-        attempts=3,
-        name="pjrt-init-probe",
-        retry_on=(subprocess.TimeoutExpired, subprocess.CalledProcessError,
-                  faults.InjectedFault),
-        stop_when=lambda a: _left() < 0.6 * DEADLINE_S,
-        log=lambda a, e: print(
-            f"# accelerator init probe attempt {a.index + 1} failed "
-            f"({type(e).__name__})", file=sys.stderr),
-        on_exhausted=lambda last: _demote_to_cpu(
-            f"accelerator init unavailable "
-            f"({type(last).__name__ if last else 'unknown'})"),
-    ).run(probe)
+    with obstrace.span("init-probe", timeout_s=INIT_TIMEOUT_S):
+        repolicy.RetryPolicy(
+            attempts=3,
+            name="pjrt-init-probe",
+            retry_on=(subprocess.TimeoutExpired,
+                      subprocess.CalledProcessError, faults.InjectedFault),
+            stop_when=lambda a: _left() < 0.6 * DEADLINE_S,
+            log=lambda a, e: print(
+                f"# accelerator init probe attempt {a.index + 1} failed "
+                f"({type(e).__name__})", file=sys.stderr),
+            on_exhausted=lambda last: _demote_to_cpu(
+                f"accelerator init unavailable "
+                f"({type(last).__name__ if last else 'unknown'})"),
+        ).run(probe)
 
 
 @contextlib.contextmanager
@@ -397,6 +401,13 @@ def _report(measured_bytes: int, platform: str, engine: str, digest: int,
         line["reps"] = n
     if degrade.events():
         line["degraded"] = degrade.events()
+    # The flat metrics snapshot: with tracing on (OT_TRACE_DIR), the run
+    # id + counter/gauge totals ride the same one-line artifact, so the
+    # JSON record points straight at its own trace. Healthy untraced
+    # runs carry no such key (schema unchanged for every existing
+    # consumer).
+    if obstrace.enabled():
+        line["obs"] = obstrace.metrics_snapshot()
     # flush: under an orchestrator stdout is a block-buffered log file, and
     # a post-report teardown hang (abandoned transfer on a wedged tunnel)
     # would otherwise get the process SIGKILLed with the line still queued.
@@ -468,8 +479,9 @@ def _measure_and_report() -> None:
     # timeout fall straight to the native host runtime so the run still
     # reports a real framework number.
     try:
-        with _stage_alarm(_stage_budget(min(150.0, 0.2 * DEADLINE_S)),
-                          what="first device op (canary)"):
+        with obstrace.span("canary", platform=platform), \
+                _stage_alarm(_stage_budget(min(150.0, 0.2 * DEADLINE_S)),
+                             what="first device op (canary)"):
             ctr_be = jax.device_put(
                 jnp.asarray(packing.np_bytes_to_words(nonce).byteswap()))
             jax.block_until_ready(ctr_be)
@@ -554,9 +566,11 @@ def _measure_and_report() -> None:
         # OT_FAULTS sequence rehearses exactly the failure the alarm
         # exists for, without needing a wedged device.
         faults.check("dispatch_fail", "bench measure dispatch")
-        with _stage_alarm(_stage_budget(
-                stage_budget or max(60.0, _left() - 30.0)),
-                what=f"measure({engine}, {nbytes >> 20} MiB)"):
+        with obstrace.span("measure", engine=engine, mib=nbytes >> 20,
+                           iters=iters, reps=reps), \
+                _stage_alarm(_stage_budget(
+                    stage_budget or max(60.0, _left() - 30.0)),
+                    what=f"measure({engine}, {nbytes >> 20} MiB)"):
             # The hang variant of the same seam, INSIDE the alarm: an
             # armed dispatch_hang blocks here in a GIL-releasing sleep,
             # and the stage alarm — now the shared watchdog — is what

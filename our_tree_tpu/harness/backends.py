@@ -21,6 +21,7 @@ import time
 
 import numpy as np
 
+from ..obs import trace as _trace
 from ..resilience import degrade as _degrade
 from ..resilience import faults as _faults
 from ..resilience import watchdog as _watchdog
@@ -138,19 +139,22 @@ class TpuBackend:
         answers, which only the watchdog or the --isolate supervisor can
         end (docs/RESILIENCE.md).
         """
-        _faults.check("dispatch_fail", "TpuBackend.block_until_ready")
-        _watchdog.injected_hang("dispatch_hang",
-                                "TpuBackend.block_until_ready")
-        self._jax.block_until_ready(x)
-        for leaf in self._jax.tree_util.tree_leaves(x):
-            if not getattr(leaf, "size", 0):
-                continue
-            shards = getattr(leaf, "addressable_shards", None)
-            if shards:
-                for s in shards:
-                    np.asarray(s.data.ravel()[-1:])
-            else:
-                np.asarray(leaf.ravel()[-1:])
+        # The "barrier" span is where a wedged transport's wall time
+        # actually accrues — obs.report counts it as device-seam time.
+        with _trace.span("barrier", seam="TpuBackend.block_until_ready"):
+            _faults.check("dispatch_fail", "TpuBackend.block_until_ready")
+            _watchdog.injected_hang("dispatch_hang",
+                                    "TpuBackend.block_until_ready")
+            self._jax.block_until_ready(x)
+            for leaf in self._jax.tree_util.tree_leaves(x):
+                if not getattr(leaf, "size", 0):
+                    continue
+                shards = getattr(leaf, "addressable_shards", None)
+                if shards:
+                    for s in shards:
+                        np.asarray(s.data.ravel()[-1:])
+                else:
+                    np.asarray(leaf.ravel()[-1:])
         return x
 
     def chained_device_times_us(self, crypt, words, iters: int, k: int):
@@ -193,14 +197,20 @@ class TpuBackend:
             # Injection on the dispatch itself (not only the staging
             # barrier): a tunnel that wedges BETWEEN rows dies here, in
             # the chained readback, and the sweep journal's resume story
-            # is rehearsed against exactly this raise.
-            _faults.check("dispatch_fail",
-                          "TpuBackend.chained_device_times_us")
-            _watchdog.injected_hang("dispatch_hang",
-                                    "TpuBackend.chained_device_times_us")
-            t0 = time.perf_counter()
-            int(chained(words, jnp.uint32(kk)))
-            return time.perf_counter() - t0
+            # is rehearsed against exactly this raise. The span makes
+            # each chained dispatch+readback a device-seam region in the
+            # trace (~µs of span overhead inside the timed window when
+            # tracing is ON; a no-op check when off — kernel timings in
+            # production runs are unaffected).
+            with _trace.span("chained-dispatch", k=int(kk),
+                             seam="TpuBackend.chained_device_times_us"):
+                _faults.check("dispatch_fail",
+                              "TpuBackend.chained_device_times_us")
+                _watchdog.injected_hang("dispatch_hang",
+                                        "TpuBackend.chained_device_times_us")
+                t0 = time.perf_counter()
+                int(chained(words, jnp.uint32(kk)))
+                return time.perf_counter() - t0
 
         run(1)  # compile + warm (one executable for every chain length)
         t1 = min(run(1) for _ in range(2))

@@ -45,6 +45,7 @@ import time
 
 import numpy as np
 
+from ..obs import trace as trace_mod
 from ..resilience import degrade as degrade_mod
 from ..resilience import faults as faults_mod
 from ..resilience import isolate as isolate_mod
@@ -81,6 +82,14 @@ class Emitter:
     def end_capture(self) -> list[str]:
         lines, self._capture = self._capture or [], None
         return lines
+
+    def capture_len(self) -> int:
+        """Current capture length — a row checkpoint's slice mark."""
+        return len(self._capture or ())
+
+    def capture_since(self, mark: int) -> list[str]:
+        """Lines captured since ``mark`` (one worker row's output)."""
+        return list((self._capture or [])[mark:])
 
     def close(self):
         if self.f:
@@ -119,11 +128,16 @@ def _time_us(fn) -> tuple[int, object]:
     # The backend-agnostic dispatch seam: every timed region of every
     # backend passes through here, so an armed dispatch_hang wedges the
     # sweep exactly where a dead transport would — inside a timed device
-    # call — for the watchdog / --isolate supervisor to deal with.
-    watchdog_mod.injected_hang("dispatch_hang", "harness timed region")
-    t0 = time.perf_counter_ns()
-    out = fn()
-    us = (time.perf_counter_ns() - t0) // 1000
+    # call — for the watchdog / --isolate supervisor to deal with. The
+    # "timed-call" span is the per-phase attribution substrate
+    # (obs.report sums these per unit as device-seam time); the injected
+    # hang sleeps INSIDE it, so a SIGKILLed child leaves an orphaned
+    # timed-call span naming exactly where it died.
+    with trace_mod.span("timed-call", seam="harness._time_us"):
+        watchdog_mod.injected_hang("dispatch_hang", "harness timed region")
+        t0 = time.perf_counter_ns()
+        out = fn()
+        us = (time.perf_counter_ns() - t0) // 1000
     # Deterministic-clock test seam: with OT_FAKE_TIME_US set, every timed
     # region reports that fixed µs value (the work still runs — only the
     # CLOCK is faked). The journal-resume tests use it to make an
@@ -184,7 +198,7 @@ def _mode_crypt(backend, mode, ctx, workers, ctr_be=None, ivw=None,
 
 
 def run_aes_mode(em, backend, mode, size, workers_list, iters, keybits, rng,
-                 timing, stream_chunk=0):
+                 timing, stream_chunk=0, rows=None):
     msg = rng.integers(0, 256, size, dtype=np.uint8)
     if mode in ("cbc", "cfb128") and workers_list != [1]:
         # Single-stream chained encrypt is a sequential recurrence — the
@@ -210,7 +224,8 @@ def run_aes_mode(em, backend, mode, size, workers_list, iters, keybits, rng,
     chained_ok = (timing == "device" and not streaming
                   and hasattr(backend, "chained_device_times_us"))
     needs_iv = mode in ("cbc", "cbc-dec", "cfb128")
-    for workers in workers_list:
+
+    def one_row(workers):
         if chained_ok:
             # Chained-difference device timing (backends.py docstring): one
             # key per row (keys are data, not timing).
@@ -229,7 +244,7 @@ def run_aes_mode(em, backend, mode, size, workers_list, iters, keybits, rng,
             em.line(f"{label} AES-{keybits} {mode.upper()}, {size}, "
                     f"{workers}, {_csv(times)}")
             _derived(em, size, times, backend.FLOOR_US)
-            continue
+            return
         times = []
         warmed = False
         for it in range(iters):
@@ -286,6 +301,19 @@ def run_aes_mode(em, backend, mode, size, workers_list, iters, keybits, rng,
         label = backend.name.upper()
         em.line(f"{label} AES-{keybits} {mode.upper()}, {size}, {workers}, {_csv(times)}")
         _derived(em, size, times)
+
+    for i, workers in enumerate(workers_list):
+        # Per-worker-ROW resume granularity: a recorded row replays (its
+        # lines re-emitted, the shared RNG stream restored to its
+        # post-row state) and a fresh one runs inside a "row" span, so
+        # a SIGKILLed unit's re-run resumes at the last completed row
+        # and the trace tells replayed from fresh (docs/OBSERVABILITY.md).
+        if rows is not None and rows.replay(workers):
+            continue
+        with trace_mod.span("row", mode=mode, size=size, workers=workers):
+            one_row(workers)
+        if rows is not None:
+            rows.record(workers, last=(i == len(workers_list) - 1))
 
 
 def run_cbc_batch(em, backend, size, workers_list, iters, keybits, rng,
@@ -439,11 +467,13 @@ def check_shard_invariance(em, backend, size, workers_list, keybits, rng):
     em.line(f"Shard invariance {workers_list}: passed")
 
 
-def run_rc4(em, backend, size, workers_list, iters, rng, timing="e2e"):
+def run_rc4(em, backend, size, workers_list, iters, rng, timing="e2e",
+            rows=None):
     msg = rng.integers(0, 256, size, dtype=np.uint8)
     chained_ok = (timing == "device"
                   and hasattr(backend, "chained_device_times_us"))
-    for workers in workers_list:
+
+    def one_row(workers):
         em.line(f"RC4, {size}, {workers}, ")
         key = rng.integers(0, 256, 16, dtype=np.uint8).tobytes()
         # Phase 1+2 (key schedule + keystream gen): sequential, timed once
@@ -482,6 +512,15 @@ def run_rc4(em, backend, size, workers_list, iters, rng, timing="e2e"):
             em.line(f"RC4 XOR MISMATCH at workers={workers}")
             raise SystemExit(2)
 
+    for i, workers in enumerate(workers_list):
+        # Same per-worker-ROW resume granularity as run_aes_mode.
+        if rows is not None and rows.replay(workers):
+            continue
+        with trace_mod.span("row", mode="rc4", size=size, workers=workers):
+            one_row(workers)
+        if rows is not None:
+            rows.record(workers, last=(i == len(workers_list) - 1))
+
 
 def arc4_self_test(em):
     """Rescorla-1994 vectors through setup->prep->crypt, like arc4_self_test
@@ -501,6 +540,53 @@ def arc4_self_test(em):
         em.line(f"ARC4 test #{i}: {'passed' if ok else 'FAILED'}")
         if not ok:
             raise SystemExit(2)
+
+
+class _RowCheckpoint:
+    """Intra-unit worker-row checkpointing (ROADMAP follow-up closed in
+    the obs PR): each completed worker row of a journaled unit is
+    recorded — its emitted lines plus the post-row RNG state — the
+    moment it finishes, so a unit that dies midway (SIGKILLed child,
+    watchdog failure) re-runs from the last completed row instead of
+    from the top. ``replay(row)`` re-emits a recorded row verbatim and
+    restores the shared RNG stream (later rows stay byte-identical to
+    an uninterrupted run's); a fresh row runs under a "row" span while
+    a replayed one emits a "row-replayed" point, so the trace tells the
+    two apart. Lines are sliced out of the unit-level Emitter capture
+    (``capture_len``/``capture_since``), so the completed unit's record
+    still carries the full line list."""
+
+    def __init__(self, journal, unit, em, rng):
+        self._journal, self._unit = journal, unit
+        self._em, self._rng = em, rng
+        self._recs = journal.rows(unit)
+        self._mark = 0
+        self.replayed = 0
+
+    def replay(self, row) -> bool:
+        rec = self._recs.get(str(row))
+        if rec is None:
+            self._mark = self._em.capture_len()
+            return False
+        for line in rec.get("lines", []):
+            self._em.line(line)
+        state = rec.get("rng_state")
+        if state is not None:
+            self._rng.bit_generator.state = state
+        trace_mod.point("row-replayed", unit=self._unit, row=str(row))
+        self.replayed += 1
+        return True
+
+    def record(self, row, last=False) -> None:
+        # The unit's LAST row is never recorded: the unit's own completed
+        # record lands immediately after (nothing can fail in between),
+        # so the row record would be pure journal bloat — and the common
+        # single-worker sweep keeps a row-free journal.
+        if last:
+            return
+        self._journal.record_row(self._unit, str(row),
+                                 self._em.capture_since(self._mark),
+                                 self._rng.bit_generator.state)
 
 
 def _sweep_config(args, sizes, workers_list, modes) -> dict:
@@ -541,6 +627,10 @@ def main(argv=None) -> int:
     from ..utils.platform import pin_cpu_if_requested
 
     pin_cpu_if_requested()
+    # Mint (or adopt) the trace run id BEFORE anything can spawn a
+    # child: publishing it into os.environ is what lets every isolated
+    # child join this run instead of starting its own (obs/trace.py).
+    trace_mod.ensure_run()
     ap = argparse.ArgumentParser(
         description="our-tree-tpu benchmark sweep (reference CSV format)"
     )
@@ -627,6 +717,14 @@ def main(argv=None) -> int:
                          "with DispatchTimeout, and a journaled sweep "
                          "moves on instead of wedging. 0 disables "
                          "(env OT_DISPATCH_DEADLINE)")
+    ap.add_argument("--unquarantine", action="append", default=None,
+                    metavar="UNIT",
+                    help="clear UNIT's recorded failure rows from the "
+                         "journal (repeatable) — the quarantine-release "
+                         "flow: the unit runs again on the next sweep "
+                         "instead of being skipped forever. Requires "
+                         "--journal (or OT_SWEEP_JOURNAL); no sweep runs. "
+                         "Emits a quarantine-release trace event")
     ap.add_argument("--isolate-child", default=None, metavar="UNIT",
                     help=argparse.SUPPRESS)  # internal: run exactly UNIT
     args = ap.parse_args(argv)
@@ -641,6 +739,23 @@ def main(argv=None) -> int:
         sizes.append(nbytes)
     modes = [m.strip() for m in args.modes.split(",") if m.strip()]
     journal_path = args.journal or os.environ.get("OT_SWEEP_JOURNAL")
+
+    if args.unquarantine:
+        # Quarantine release: a ledger edit, not a sweep — it must work
+        # without a backend and regardless of the journal's config hash
+        # (the operator releasing a unit may not reproduce the exact
+        # sweep flags that quarantined it).
+        if not journal_path:
+            ap.error("--unquarantine requires --journal "
+                     "(or OT_SWEEP_JOURNAL): the journal holds the "
+                     "failure rows to clear")
+        cleared = journal_mod.clear_failures(journal_path, args.unquarantine)
+        for unit, n in sorted(cleared.items()):
+            trace_mod.point("quarantine-release", unit=unit, cleared=n)
+            print(f"# unquarantine: {unit}: cleared {n} failure row(s)"
+                  + ("" if n else " — none were on file"),
+                  file=sys.stderr, flush=True)
+        return 0
 
     isolate_parent = args.isolate and args.isolate_child is None
     if isolate_parent:
@@ -697,13 +812,15 @@ def main(argv=None) -> int:
             "--isolate",
         ]
         try:
-            quarantined = isolate_mod.run_isolated_sweep(
-                units=names,
-                child_argv=lambda unit: child_base + ["--isolate-child",
-                                                      unit],
-                journal_path=journal_path, config=config, emit=em.line,
-                unit_deadline_s=args.unit_deadline,
-                quarantine_after=args.quarantine_after)
+            with trace_mod.span("sweep", role="supervisor",
+                                backend=args.backend, modes=args.modes):
+                quarantined = isolate_mod.run_isolated_sweep(
+                    units=names,
+                    child_argv=lambda unit: child_base + ["--isolate-child",
+                                                          unit],
+                    journal_path=journal_path, config=config, emit=em.line,
+                    unit_deadline_s=args.unit_deadline,
+                    quarantine_after=args.quarantine_after)
             if quarantined:
                 print(f"# isolate: quarantined unit(s): "
                       f"{','.join(quarantined)}", file=sys.stderr)
@@ -736,40 +853,44 @@ def main(argv=None) -> int:
     # granularity. Unit order is a pure function of the config (the
     # journal's replay contract); names carry mode and byte size so a
     # human can read the journal.
+    # Every unit closure takes the unit's row checkpoint (None outside
+    # journaled runs; the batch + check units take and ignore it — their
+    # cross-row invariance comparisons need every row live, so they keep
+    # unit-level resume granularity).
     def aes_unit(mode, size):
-        return lambda: run_aes_mode(em, backend, mode, size, workers_list,
-                                    args.iters, args.keybits, rng,
-                                    args.timing,
-                                    stream_chunk=args.stream_chunk_mb * MIB)
+        return lambda rows=None: run_aes_mode(
+            em, backend, mode, size, workers_list, args.iters, args.keybits,
+            rng, args.timing, stream_chunk=args.stream_chunk_mb * MIB,
+            rows=rows)
 
     units = []
     for mode in modes:
         for size in sizes:
             if mode == "rc4":
                 units.append((f"rc4:{size}",
-                              lambda size=size: run_rc4(
+                              lambda size=size, rows=None: run_rc4(
                                   em, backend, size, workers_list,
-                                  args.iters, rng, args.timing)))
+                                  args.iters, rng, args.timing, rows=rows)))
             elif mode == "cbc-batch":
                 units.append((f"cbc-batch:{size}",
-                              lambda size=size: run_cbc_batch(
+                              lambda size=size, rows=None: run_cbc_batch(
                                   em, backend, size, workers_list,
                                   args.iters, args.keybits, rng,
                                   args.timing, args.streams)))
             elif mode == "rc4-batch":
                 units.append((f"rc4-batch:{size}",
-                              lambda size=size: run_rc4_batch(
+                              lambda size=size, rows=None: run_rc4_batch(
                                   em, backend, size, workers_list,
                                   args.iters, rng, args.streams)))
             else:
                 units.append((f"{mode}:{size}", aes_unit(mode, size)))
     if len(workers_list) > 1 and {"ecb", "ctr"} & set(modes):
         units.append(("shard-invariance",
-                      lambda: check_shard_invariance(
+                      lambda rows=None: check_shard_invariance(
                           em, backend, min(sizes), workers_list,
                           args.keybits, rng)))
     if "rc4" in modes:
-        units.append(("arc4-self-test", lambda: arc4_self_test(em)))
+        units.append(("arc4-self-test", lambda rows=None: arc4_self_test(em)))
     # The isolate supervisor plans from _unit_names without a backend;
     # any drift between that pure function and this closure list would
     # strand its children on units that don't exist.
@@ -795,15 +916,28 @@ def main(argv=None) -> int:
                     # watchdogged) runs. Re-running it would re-burn the
                     # budget on a known-bad config; skipping silently
                     # would masquerade as health. Skip LOUDLY.
+                    trace_mod.point("quarantine", unit=name,
+                                    fails=journal.fail_count(name))
                     degrade_mod.degrade(
                         f"quarantined:{name}",
                         f"{journal.fail_count(name)} journaled failure(s)")
                     continue
                 # Gate on is_completed: with failure rows on file a unit
                 # can be legitimately absent from the replay list, and a
-                # bare skip() would misread that as corruption.
-                entry = (journal.skip(name) if journal.is_completed(name)
-                         else None)
+                # bare skip() would misread that as corruption. An
+                # isolated CHILD consumes by NAME (journal.take): after a
+                # quarantine release, a completed unit's record can sit
+                # out of sweep order on file, and skip()'s order-mismatch
+                # defense would rewrite the journal out from under the
+                # supervising parent's open handle. The child iterates
+                # units in sweep order anyway, so per-entry RNG
+                # restoration lands in the right order either way; the
+                # plain in-process path keeps the strict-order skip()
+                # (its truncate-and-re-run fallback is safe when no
+                # other process holds the file).
+                entry = ((journal.take(name) if target is not None
+                          else journal.skip(name))
+                         if journal.is_completed(name) else None)
                 if entry is not None:
                     # Completed in a previous (interrupted) run: re-emit
                     # the recorded rows verbatim, restore the shared RNG
@@ -819,6 +953,7 @@ def main(argv=None) -> int:
                         rng.bit_generator.state = state
                     for kind in entry.get("degraded", []):
                         degrade_mod.degrade(kind, "restored from journal")
+                    trace_mod.point("unit-replayed", unit=name)
                     continue
             if target is not None and name != target:
                 # Isolated child aimed at a later unit: this one failed or
@@ -829,16 +964,23 @@ def main(argv=None) -> int:
                 continue
             before = set(degrade_mod.events())
             em.begin_capture()
+            rows_cp = (_RowCheckpoint(journal, name, em, rng)
+                       if journal is not None else None)
             try:
-                # unit_crash: the injected stand-in for a child process
-                # dying mid-unit (segfaulting XLA compile, OOM-killed
-                # worker). In-process it IS a crash: the raise escapes
-                # main() and the sweep dies nonzero — which is exactly
-                # what --isolate exists to contain.
-                faults_mod.check("unit_crash", f"unit {name}")
-                with watchdog_mod.deadline(args.dispatch_deadline,
-                                           what=f"sweep unit {name}"):
-                    run_unit()
+                # The "unit" span wraps the whole unit attempt — an
+                # injected crash or a watchdog raise closes it with its
+                # error status on the way out; a SIGKILL leaves it
+                # orphaned, which IS the record of where the child died.
+                with trace_mod.span("unit", unit=name):
+                    # unit_crash: the injected stand-in for a child
+                    # process dying mid-unit (segfaulting XLA compile,
+                    # OOM-killed worker). In-process it IS a crash: the
+                    # raise escapes main() and the sweep dies nonzero —
+                    # which is exactly what --isolate exists to contain.
+                    faults_mod.check("unit_crash", f"unit {name}")
+                    with watchdog_mod.deadline(args.dispatch_deadline,
+                                               what=f"sweep unit {name}"):
+                        run_unit(rows=rows_cp)
             except watchdog_mod.DispatchTimeout as e:
                 em.end_capture()  # partial rows already hit stdout/--out
                 print(f"# watchdog: {e}", file=sys.stderr, flush=True)
@@ -848,8 +990,9 @@ def main(argv=None) -> int:
                     # count the attempt toward quarantine.
                     raise
                 if journal is not None:
-                    journal.record_failure(
-                        name, f"watchdog:{args.dispatch_deadline:.0f}s")
+                    reason = f"watchdog:{args.dispatch_deadline:.0f}s"
+                    journal.record_failure(name, reason)
+                    trace_mod.point("unit-failed", unit=name, reason=reason)
                 continue  # journaled sweep: a hung unit, not a hung sweep
             finally:
                 lines = em.end_capture()

@@ -1,0 +1,32 @@
+"""Run-wide tracing & metrics (`our_tree_tpu/obs/`).
+
+The resilience layer (PR 1-2) made failures survivable; this layer makes
+runs *legible*. Before it, the evidence of what a sweep actually did was
+smeared across four places — stderr notes, `# degraded:` trailers,
+journal rows, and `OT_CRASH_DIR` stack dumps — none of them
+machine-readable as one story. The AES-multicore paper (PAPERS.md) could
+attribute its scaling cliffs only because it measured per-phase times
+per worker; this package gives every run the same per-phase attribution:
+
+* ``trace``  — the process-global tracer: ``span(name, **attrs)``
+  context manager plus ``counter``/``gauge``/``point`` helpers,
+  appending structured JSONL events to a per-run directory
+  (``OT_TRACE_DIR``; off and near-free when unset). The run id is
+  generated at top level and propagated to child processes via
+  ``OT_TRACE_RUN``; a parent span id travels via ``OT_TRACE_PARENT`` so
+  an ``--isolate`` child's spans nest under its supervisor's unit
+  attempt. Stdlib-only and bare-loadable like ``resilience/degrade.py``
+  (registered in ``sys.modules`` under its canonical dotted name so the
+  counters stay one-per-process across bare and package contexts).
+* ``export`` — run-dir parsing (schema validation, begin/end span
+  pairing, orphan detection — an orphaned span IS the evidence of a
+  SIGKILLed child) and the Chrome/Perfetto ``trace.json`` exporter.
+* ``report`` — ``python -m our_tree_tpu.obs.report <run-dir>``: per-unit
+  wall/device time, retries, faults injected vs. observed,
+  degradations, quarantines, and the slowest-span table; ``--check``
+  fails on schema violations or orphaned spans (the CI gate);
+  ``--trace-json`` writes the Perfetto export.
+
+The instrumented seams, the event schema, and the Perfetto how-to are
+documented in docs/OBSERVABILITY.md.
+"""

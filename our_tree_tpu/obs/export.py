@@ -1,0 +1,220 @@
+"""Run-dir parsing + the Chrome/Perfetto ``trace.json`` exporter.
+
+``load_run(run_dir)`` stitches every ``trace-*.jsonl`` in a run
+directory back into one picture: spans paired from their begin/end
+events (an unmatched begin is an ORPHAN — the durable evidence of a
+process SIGKILLed mid-span, rendered with the run's end as its close
+and flagged), points/counters/gauges kept as events, and every line
+checked against the v1 schema (violations are collected, not raised —
+a half-written file from a killed child must not hide the rest of the
+run). ``write_chrome_trace`` emits the Trace Event Format JSON that
+both ``chrome://tracing`` and https://ui.perfetto.dev open directly.
+
+Stdlib-only, no intra-package imports (the report CLI and tests load it
+without jax in sight).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+
+from . import trace as _trace
+
+#: Required fields per event type (the schema the --check gate enforces).
+_REQUIRED = {
+    "b": ("id", "name", "ts"),
+    "e": ("id", "ts", "status"),
+    "c": ("name", "ts", "n"),
+    "g": ("name", "ts", "value"),
+    "p": ("name", "ts"),
+}
+
+
+class SpanRec:
+    """One reconstructed span. ``end_ts`` is None for an orphan (no end
+    event reached the file — the process died inside the span); callers
+    use ``dur_us(run_end)`` which closes orphans at the run's end."""
+
+    __slots__ = ("id", "name", "parent", "ts", "end_ts", "status", "attrs",
+                 "pid", "proc", "tid")
+
+    def __init__(self, rec: dict, pid: int, proc: str):
+        self.id = rec["id"]
+        self.name = rec["name"]
+        self.parent = rec.get("parent")
+        self.ts = rec["ts"]
+        self.attrs = rec.get("attrs", {})
+        self.pid, self.proc, self.tid = pid, proc, rec.get("tid", 0)
+        self.end_ts = None
+        self.status = None
+
+    @property
+    def orphan(self) -> bool:
+        return self.end_ts is None
+
+    def dur_us(self, run_end: int) -> int:
+        return max((self.end_ts if self.end_ts is not None else run_end)
+                   - self.ts, 0)
+
+
+class Run:
+    """A parsed run: ``spans`` (id -> SpanRec, orphans included),
+    ``events`` (the raw c/g/p records, each annotated with ``pid``),
+    ``procs`` (pid -> header), ``violations`` (file, line-no, reason),
+    ``t0``/``t1`` (first/last event timestamps, µs)."""
+
+    def __init__(self):
+        self.spans: dict[str, SpanRec] = {}
+        self.events: list[dict] = []
+        self.procs: dict[int, dict] = {}
+        self.violations: list[tuple[str, int, str]] = []
+        self.t0: int | None = None
+        self.t1: int | None = None
+
+    def _see(self, ts) -> None:
+        if isinstance(ts, int):
+            self.t0 = ts if self.t0 is None else min(self.t0, ts)
+            self.t1 = ts if self.t1 is None else max(self.t1, ts)
+
+    def orphans(self) -> list[SpanRec]:
+        return [s for s in self.spans.values() if s.orphan]
+
+    def points(self, name: str | None = None) -> list[dict]:
+        return [e for e in self.events
+                if e["ev"] == "p" and (name is None or e["name"] == name)]
+
+    def counter_totals(self) -> dict[str, float]:
+        out: dict[str, float] = {}
+        for e in self.events:
+            if e["ev"] == "c":
+                out[e["name"]] = out.get(e["name"], 0) + e.get("n", 0)
+        return out
+
+    def ancestor_attr(self, span: SpanRec, key: str):
+        """Walk the (cross-process) parent chain until a span carrying
+        ``attrs[key]`` — how a barrier span deep inside a child is
+        attributed to the supervisor's unit attempt."""
+        seen = set()
+        cur: SpanRec | None = span
+        while cur is not None and cur.id not in seen:
+            seen.add(cur.id)
+            if key in cur.attrs:
+                return cur.attrs[key]
+            cur = self.spans.get(cur.parent) if cur.parent else None
+        return None
+
+
+def load_run(run_dir: str) -> Run:
+    """Parse every ``trace-*.jsonl`` under ``run_dir`` into a ``Run``."""
+    run = Run()
+    for path in sorted(glob.glob(os.path.join(run_dir, "trace-*.jsonl"))):
+        fname = os.path.basename(path)
+        pid, proc = -1, "?"
+        with open(path, "r", encoding="utf-8") as fh:
+            for lineno, line in enumerate(fh, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    # Unparseable line — a torn tail from a killed
+                    # writer, or a writer bug. Recorded as a violation
+                    # either way: --check fails on any of them, which is
+                    # fine because a run with killed children fails the
+                    # orphan check regardless (healthy runs tear
+                    # nothing: every event is written with one flushed
+                    # write()).
+                    run.violations.append((fname, lineno, "unparseable"))
+                    continue
+                if lineno == 1:
+                    if (rec.get("kind") != _trace.KIND
+                            or rec.get("v") != _trace.VERSION):
+                        run.violations.append(
+                            (fname, 1, "bad or missing header"))
+                        break
+                    pid, proc = rec.get("pid", -1), rec.get("proc", "?")
+                    run.procs[pid] = rec
+                    run._see(rec.get("start_us"))
+                    continue
+                ev = rec.get("ev")
+                if ev not in _REQUIRED:
+                    run.violations.append(
+                        (fname, lineno, f"unknown ev {ev!r}"))
+                    continue
+                missing = [k for k in _REQUIRED[ev] if k not in rec]
+                if missing:
+                    run.violations.append(
+                        (fname, lineno, f"{ev} missing {missing}"))
+                    continue
+                run._see(rec.get("ts"))
+                if ev == "b":
+                    run.spans[rec["id"]] = SpanRec(rec, pid, proc)
+                elif ev == "e":
+                    sp = run.spans.get(rec["id"])
+                    if sp is None:
+                        run.violations.append(
+                            (fname, lineno, f"end without begin {rec['id']}"))
+                        continue
+                    sp.end_ts, sp.status = rec["ts"], rec["status"]
+                else:
+                    rec["pid"] = pid
+                    run.events.append(rec)
+    return run
+
+
+def to_chrome_trace(run: Run) -> dict:
+    """The run as a Trace Event Format object (Perfetto/chrome loadable).
+
+    Closed spans become complete ("X") events; orphans become "X" events
+    stretched to the run's end with ``killed: true`` in their args — in
+    the Perfetto timeline the hung child's dispatch reads as a bar cut
+    off at the kill, which is exactly the picture that matters. Points
+    are instants ("i"), counters cumulative "C" tracks, gauges "C"
+    tracks of their raw value. Timestamps are rebased to the run's
+    first event so traces open at t=0.
+    """
+    t0 = run.t0 or 0
+    run_end = run.t1 if run.t1 is not None else t0
+    out: list[dict] = []
+    for pid, hdr in sorted(run.procs.items()):
+        out.append({"ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+                    "args": {"name": hdr.get("argv", "?")}})
+    for sp in sorted(run.spans.values(), key=lambda s: s.ts):
+        args = dict(sp.attrs)
+        if sp.orphan:
+            args["killed"] = True
+        elif sp.status != "ok":
+            args["status"] = sp.status
+        out.append({"ph": "X", "cat": "ot", "name": sp.name, "pid": sp.pid,
+                    "tid": sp.tid, "ts": sp.ts - t0,
+                    "dur": sp.dur_us(run_end), "args": args})
+    # Counter tracks are per-PROCESS in the Trace Event Format, so the
+    # cumulative totals must be too — one shared total would show the
+    # second child's track starting where the first's ended.
+    totals: dict[tuple, float] = {}
+    for e in sorted(run.events, key=lambda e: e["ts"]):
+        if e["ev"] == "p":
+            out.append({"ph": "i", "cat": "ot", "name": e["name"],
+                        "pid": e["pid"], "tid": 0, "ts": e["ts"] - t0,
+                        "s": "p", "args": e.get("attrs", {})})
+        elif e["ev"] == "c":
+            key = (e["pid"], e["name"])
+            totals[key] = totals.get(key, 0) + e.get("n", 0)
+            out.append({"ph": "C", "name": e["name"], "pid": e["pid"],
+                        "ts": e["ts"] - t0,
+                        "args": {"value": totals[key]}})
+        elif e["ev"] == "g":
+            out.append({"ph": "C", "name": e["name"], "pid": e["pid"],
+                        "ts": e["ts"] - t0,
+                        "args": {"value": e.get("value", 0)}})
+    return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+
+def write_chrome_trace(run: Run, path: str) -> str:
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(to_chrome_trace(run), fh, separators=(",", ":"),
+                  default=repr)
+    return path

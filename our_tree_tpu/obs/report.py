@@ -1,0 +1,271 @@
+"""``python -m our_tree_tpu.obs.report <run-dir>`` — reconstruct a run.
+
+The report answers, from the trace stream alone, the questions that used
+to require stitching stderr + journal + crash dumps together: what did
+each sweep unit cost (wall and device-seam time), which units were
+retried or quarantined and why, which faults were injected vs. actually
+observed, what degraded, and where the time went (slowest spans). An
+orphaned span — a begin with no end — is rendered as what it is: a span
+closed by the kill of its process, with the unit it belonged to.
+
+Flags:
+
+* ``--check``       exit nonzero on schema violations or orphaned spans
+                    (the CI gate: a healthy traced sweep must produce a
+                    clean, fully-closed stream).
+* ``--trace-json P``  also write the Chrome/Perfetto export to P.
+* ``--top N``       size of the slowest-span table (default 10).
+
+``<run-dir>`` is ``$OT_TRACE_DIR/<run-id>``; passing ``$OT_TRACE_DIR``
+itself picks the newest run inside it (and says so).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import sys
+
+from . import export
+
+#: Span names that count as device-seam time in the per-unit table
+#: (the tracer's analogue of the AES-multicore paper's per-phase,
+#: per-worker attribution).
+DEVICE_SPANS = ("timed-call", "barrier", "chained-dispatch")
+
+#: Span names that represent one attempt at one sweep unit. The
+#: supervisor's view ("unit-attempt", includes spawn/kill overhead)
+#: wins over the in-process view ("unit") when both exist for a unit —
+#: counting both would double every isolated unit's wall time.
+ATTEMPT_SPANS = ("unit-attempt", "unit")
+
+
+def _resolve_run_dir(path: str, say=print) -> str:
+    if glob.glob(os.path.join(path, "trace-*.jsonl")):
+        return path
+    runs = sorted(
+        d for d in glob.glob(os.path.join(path, "*"))
+        if os.path.isdir(d) and glob.glob(os.path.join(d, "trace-*.jsonl")))
+    if runs:
+        say(f"# {path} holds {len(runs)} run(s); reporting the newest: "
+            f"{os.path.basename(runs[-1])}")
+        return runs[-1]
+    return path
+
+
+def _s(us: int) -> str:
+    return f"{us / 1e6:.3f}"
+
+
+def _unit_of(run: export.Run, sp: export.SpanRec):
+    return sp.attrs.get("unit") or run.ancestor_attr(sp, "unit")
+
+
+def _nested_in_device_span(run: export.Run, sp: export.SpanRec) -> bool:
+    """Whether another device-seam span encloses ``sp``. The e2e timing
+    path opens a "barrier" span INSIDE its "timed-call" span (the timed
+    region is `block_until_ready(run(...))`), so summing both would
+    book the same wall time twice — only the outermost device span of a
+    chain counts toward a unit's device_s."""
+    seen = set()
+    cur = run.spans.get(sp.parent) if sp.parent else None
+    while cur is not None and cur.id not in seen:
+        if cur.name in DEVICE_SPANS:
+            return True
+        seen.add(cur.id)
+        cur = run.spans.get(cur.parent) if cur.parent else None
+    return False
+
+
+def _table(rows: list[list[str]], header: list[str], out) -> None:
+    widths = [max(len(r[i]) for r in [header] + rows)
+              for i in range(len(header))]
+    for r in [header] + rows:
+        out.write("  " + "  ".join(c.ljust(w)
+                                   for c, w in zip(r, widths)).rstrip()
+                  + "\n")
+
+
+def render(run: export.Run, top: int = 10, out=sys.stdout) -> None:
+    run_id = next((h.get("run", "?") for h in run.procs.values()), "?")
+    run_end = run.t1 if run.t1 is not None else 0
+    orphans = sorted(run.orphans(), key=lambda s: (s.ts, s.id))
+    wall = (run.t1 - run.t0) if run.t0 is not None else 0
+    out.write(f"run {run_id}: {len(run.procs)} process(es), "
+              f"{len(run.spans)} span(s) ({len(orphans)} orphaned), "
+              f"{len(run.events)} event(s), wall {_s(wall)}s\n")
+    out.write("schema: " + ("OK" if not run.violations else
+                            f"{len(run.violations)} violation(s)") + "\n")
+    for fname, lineno, why in run.violations:
+        out.write(f"  ! {fname}:{lineno}: {why}\n")
+
+    # -- per-unit table ----------------------------------------------------
+    attempts: dict[str, list[export.SpanRec]] = {}
+    preferred: dict[str, str] = {}
+    for sp in run.spans.values():
+        if sp.name not in ATTEMPT_SPANS:
+            continue
+        unit = sp.attrs.get("unit")
+        if unit is None:
+            continue
+        # First listed attempt-span name present for a unit wins
+        # (supervisor view over in-process view).
+        have = preferred.get(unit)
+        if have is None or (ATTEMPT_SPANS.index(sp.name)
+                            < ATTEMPT_SPANS.index(have)):
+            preferred[unit] = sp.name
+        attempts.setdefault(unit, []).append(sp)
+    device: dict[str, int] = {}
+    rows_fresh: dict[str, int] = {}
+    for sp in run.spans.values():
+        unit = _unit_of(run, sp)
+        if unit is None:
+            continue
+        if sp.name in DEVICE_SPANS:
+            # Closed spans only: an orphan's "duration" runs to the end
+            # of the run, which would book the whole post-kill sweep as
+            # this unit's device time. Orphans are reported as kills,
+            # not as measurements. And outermost-of-chain only: a
+            # barrier span nested inside its timed-call span is the
+            # same wall time twice.
+            if not sp.orphan and not _nested_in_device_span(run, sp):
+                device[unit] = device.get(unit, 0) + sp.dur_us(run_end)
+        elif sp.name == "row":
+            rows_fresh[unit] = rows_fresh.get(unit, 0) + 1
+    rows_replayed: dict[str, int] = {}
+    for p in run.points("row-replayed"):
+        u = p.get("attrs", {}).get("unit", "?")
+        rows_replayed[u] = rows_replayed.get(u, 0) + 1
+    replayed_units = {p.get("attrs", {}).get("unit")
+                      for p in run.points("unit-replayed")}
+    failures: dict[str, list[str]] = {}
+    for p in run.points("unit-failed"):
+        a = p.get("attrs", {})
+        failures.setdefault(a.get("unit", "?"), []).append(
+            a.get("reason", "?"))
+    quarantined = {p.get("attrs", {}).get("unit")
+                   for p in run.points("quarantine")}
+    released = {p.get("attrs", {}).get("unit")
+                for p in run.points("quarantine-release")}
+
+    units = sorted(set(attempts) | set(failures) | quarantined - {None}
+                   | (replayed_units - {None}))
+    if units:
+        out.write("\nper-unit:\n")
+        table = []
+        for unit in units:
+            sps = sorted((s for s in attempts.get(unit, [])
+                          if s.name == preferred.get(unit)),
+                         key=lambda s: s.ts)
+            n_kill = sum(1 for s in sps if s.orphan)
+            wall_us = sum(s.dur_us(run_end) for s in sps)
+            if unit in quarantined:
+                outcome = "quarantined"
+            elif sps and sps[-1].end_ts is not None \
+                    and sps[-1].status == "ok":
+                outcome = "ok"
+            elif unit in replayed_units and not sps:
+                outcome = "replayed"
+            elif sps and sps[-1].orphan:
+                outcome = "killed"
+            else:
+                outcome = (sps[-1].status if sps else "failed")
+            fr = rows_fresh.get(unit, 0)
+            rp = rows_replayed.get(unit, 0)
+            table.append([
+                unit, str(len(sps)), _s(wall_us),
+                _s(device.get(unit, 0)),
+                f"{fr}/{rp}" if fr or rp else "-",
+                str(len(failures.get(unit, []))) + (
+                    f" kill={n_kill}" if n_kill else ""),
+                outcome,
+            ])
+        _table(table, ["unit", "attempts", "wall_s", "device_s",
+                       "rows f/r", "failures", "outcome"], out)
+
+    # -- faults: injected vs observed --------------------------------------
+    injected: dict[str, int] = {}
+    for p in run.points("fault-injected"):
+        name = p.get("attrs", {}).get("point", "?")
+        injected[name] = injected.get(name, 0) + 1
+    observed = {
+        "watchdog-expired": len(run.points("watchdog-expired")),
+        "child-killed": len(run.points("child-killed")),
+        "unit-failed": len(run.points("unit-failed")),
+    }
+    out.write("\nfaults injected: "
+              + (", ".join(f"{k} x{v}" for k, v in sorted(injected.items()))
+                 if injected else "none") + "\n")
+    out.write("faults observed: "
+              + ", ".join(f"{k}={v}" for k, v in sorted(observed.items()))
+              + "\n")
+
+    # -- degradations / quarantines ----------------------------------------
+    degr = run.points("degrade")
+    out.write("degradations: " + (
+        "; ".join(
+            f"{p['attrs'].get('kind', '?')}"
+            + (f" ({p['attrs'].get('why')})" if p.get("attrs", {}).get("why")
+               else "")
+            for p in degr) if degr else "none") + "\n")
+    q = sorted(u for u in quarantined if u)
+    out.write("quarantined: " + (", ".join(q) if q else "none"))
+    r = sorted(u for u in released if u)
+    out.write((f"  released: {', '.join(r)}" if r else "") + "\n")
+
+    # -- slowest spans ------------------------------------------------------
+    ranked = sorted(run.spans.values(),
+                    key=lambda s: (-s.dur_us(run_end), s.ts, s.id))[:top]
+    if ranked:
+        out.write(f"\nslowest spans (top {min(top, len(ranked))}):\n")
+        _table([[sp.name, _unit_of(run, sp) or "-", str(sp.pid),
+                 _s(sp.dur_us(run_end)),
+                 "killed" if sp.orphan else (sp.status or "?")]
+                for sp in ranked],
+               ["span", "unit", "pid", "dur_s", "status"], out)
+
+    # -- orphans ------------------------------------------------------------
+    if orphans:
+        out.write(f"\norphaned spans ({len(orphans)} — begin with no end: "
+                  "the process was killed or died mid-span):\n")
+        for sp in orphans:
+            out.write(f"  {sp.name} (unit={_unit_of(run, sp) or '-'}, "
+                      f"pid {sp.pid}) open {_s(sp.dur_us(run_end))}s "
+                      "until end of run — closed by kill\n")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="reconstruct a traced run (our_tree_tpu.obs)")
+    ap.add_argument("run_dir", help="$OT_TRACE_DIR/<run-id> (or "
+                                    "$OT_TRACE_DIR: newest run inside)")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 2 on schema violations or orphaned spans")
+    ap.add_argument("--trace-json", default=None, metavar="PATH",
+                    help="also write the Chrome/Perfetto trace.json")
+    ap.add_argument("--top", type=int, default=10,
+                    help="slowest-span table size")
+    args = ap.parse_args(argv)
+
+    run_dir = _resolve_run_dir(args.run_dir,
+                               say=lambda m: print(m, file=sys.stderr))
+    run = export.load_run(run_dir)
+    if not run.procs:
+        print(f"no trace-*.jsonl files under {run_dir}", file=sys.stderr)
+        return 1
+    render(run, top=args.top)
+    if args.trace_json:
+        path = export.write_chrome_trace(run, args.trace_json)
+        print(f"# perfetto export: {path} "
+              f"({len(run.spans)} spans) — open at https://ui.perfetto.dev",
+              file=sys.stderr)
+    if args.check and (run.violations or run.orphans()):
+        print(f"CHECK FAILED: {len(run.violations)} schema violation(s), "
+              f"{len(run.orphans())} orphaned span(s)", file=sys.stderr)
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

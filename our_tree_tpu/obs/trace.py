@@ -1,0 +1,387 @@
+"""The process-global tracer: spans, counters, gauges, instant points.
+
+One process = one append-only JSONL event file under the run directory
+``$OT_TRACE_DIR/<run-id>/trace-<pid>-<tok>.jsonl``; one run = every
+process that inherited the same ``OT_TRACE_RUN`` (the supervisor
+generates the id at top level and children get it through the
+environment). ``obs.export`` stitches the files back into one story —
+including across the process boundary: a child's root spans carry the
+parent span id handed down via ``OT_TRACE_PARENT`` (``child_env``), so
+an ``--isolate`` child's dispatch spans nest under the supervisor's
+unit-attempt span exactly as in-process spans nest under their
+enclosing ``with``.
+
+Design constraints, in order:
+
+* **Off means free.** With ``OT_TRACE_DIR`` unset every public call is
+  one module-global check; ``span()`` returns a shared no-op context
+  manager. The instrumented seams include per-iteration timed regions
+  (``harness.bench._time_us``, the TpuBackend barrier), so the disabled
+  path must not show up in benchmark numbers.
+* **SIGKILL-durable.** Span *begin* and span *end* are separate events,
+  flushed as written: a child SIGKILLed mid-dispatch leaves its begin
+  event on disk, and the unmatched begin — an *orphaned span* — is the
+  primary evidence of where it died (``obs.report`` renders it as
+  "closed by kill"). A single buffered end-of-span record would lose
+  exactly the spans that matter most.
+* **Never raises.** Tracing is an observer: a full disk or an
+  unserializable attr must degrade to a dropped event (counted in
+  ``_DROPPED``, surfaced in ``metrics_snapshot``), never to a failed
+  sweep. Attrs serialize with ``default=repr`` so arbitrary objects
+  cannot poison an event line.
+
+Event schema (v1; every file starts with a header line — the full field
+tables live in docs/OBSERVABILITY.md)::
+
+    {"kind":"ot-trace","v":1,"run":...,"pid":...,"proc":"a1b2c3d4",
+     "argv":"...","start_us":...}
+    {"ev":"b","id":"a1b2c3d4.1","parent":null,"name":"unit","ts":...,
+     "tid":0,"attrs":{"unit":"ecb:65536"}}
+    {"ev":"e","id":"a1b2c3d4.1","ts":...,"status":"ok"}
+    {"ev":"c","name":"retry_failures","ts":...,"n":1,"attrs":{...}}
+    {"ev":"g","name":"hbm_gib","ts":...,"value":1.5,"attrs":{...}}
+    {"ev":"p","name":"fault-injected","ts":...,"attrs":{...}}
+
+``ts`` is epoch microseconds (``time.time_ns()//1000``) — the one clock
+that is comparable across the processes of a run; span ids are
+``<proc-token>.<seq>`` and globally unique within a run (the 8-hex
+process token absorbs pid reuse).
+
+Stdlib-only, no intra-package imports (bare-loadable by the jax-free
+sweep parents and the repo-root bench.py). Bare loaders must register
+this module under ``our_tree_tpu.obs.trace`` in ``sys.modules`` (see
+``scripts/_devlock_loader.py:load_obs``) so span stacks and counters
+stay one-per-process across bare and package import contexts.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import uuid
+
+KIND = "ot-trace"
+VERSION = 1
+
+#: Aggregated in-process metrics (the ``"obs"`` stamp in the bench JSON
+#: line): name -> total for counters, name -> last value for gauges.
+_COUNTS: dict[str, float] = {}
+_GAUGES: dict[str, float] = {}
+_SPANS_STARTED = 0
+_DROPPED = 0
+
+_LOCK = threading.Lock()
+_TLS = threading.local()
+_TIDS: dict[int, int] = {}
+
+#: Lazily-opened per-process state: {"run","dir","fh","proc","seq"}.
+#: None until the first enabled event; reset_for_tests() clears it.
+_STATE: dict | None = None
+
+
+def enabled() -> bool:
+    """Tracing is on iff ``OT_TRACE_DIR`` is set (the one switch)."""
+    return bool(os.environ.get("OT_TRACE_DIR"))
+
+
+def _now_us() -> int:
+    return time.time_ns() // 1000
+
+
+def _tid() -> int:
+    """Small per-thread index (0 = whichever thread traced first) —
+    readable in the event stream and in Perfetto's track names, unlike
+    the raw 64-bit ``threading.get_ident``."""
+    ident = threading.get_ident()
+    with _LOCK:
+        return _TIDS.setdefault(ident, len(_TIDS))
+
+
+def _stack() -> list:
+    st = getattr(_TLS, "stack", None)
+    if st is None:
+        st = _TLS.stack = []
+    return st
+
+
+def run_id() -> str | None:
+    """The current run id (None while disabled)."""
+    if not enabled():
+        return None
+    state = _STATE
+    if state is not None:
+        return state["run"]
+    return os.environ.get("OT_TRACE_RUN") or None
+
+
+def ensure_run() -> str | None:
+    """Generate-or-adopt the run id and publish it into ``os.environ``.
+
+    Top-level entry points (harness.bench main, repo-root bench.py)
+    call this once, early: a fresh id is minted only when the
+    environment carries none, so an ``--isolate`` child — or any
+    subprocess — joins its parent's run instead of starting a new one.
+    Publishing into ``os.environ`` is what makes plain ``subprocess``
+    spawns inherit the id without every call site learning about
+    tracing. Returns the id, or None while disabled.
+    """
+    if not enabled():
+        return None
+    rid = os.environ.get("OT_TRACE_RUN")
+    if not rid:
+        rid = time.strftime("%Y%m%d-%H%M%S") + f"-{os.getpid()}"
+        os.environ["OT_TRACE_RUN"] = rid
+    return rid
+
+
+def run_dir() -> str | None:
+    """``$OT_TRACE_DIR/<run-id>`` (created on first event; None while
+    disabled)."""
+    if not enabled():
+        return None
+    return os.path.join(os.environ["OT_TRACE_DIR"], ensure_run())
+
+
+def _state() -> dict | None:
+    """Open this process's event file (header included) on first use.
+
+    Creation is serialized under ``_LOCK`` (double-checked): worker
+    threads and the watchdog monitor can emit their first event
+    concurrently, and an unguarded check-then-create would open two
+    files, leak the loser's handle, and pair one state's span ids with
+    the other's header. The header is written inline — ``_write`` takes
+    the same non-reentrant lock.
+    """
+    global _STATE, _DROPPED
+    with _LOCK:
+        if _STATE is not None:
+            # A run id that changed under us (tests re-pointing
+            # OT_TRACE_RUN) means a new logical run: reopen rather than
+            # cross-write.
+            if _STATE["run"] == os.environ.get("OT_TRACE_RUN",
+                                               _STATE["run"]):
+                return _STATE
+            _close_state_locked()
+        try:
+            d = run_dir()
+            os.makedirs(d, exist_ok=True)
+            proc = uuid.uuid4().hex[:8]
+            path = os.path.join(d, f"trace-{os.getpid()}-{proc}.jsonl")
+            fh = open(path, "a", encoding="utf-8")
+            header = {"kind": KIND, "v": VERSION,
+                      "run": os.environ["OT_TRACE_RUN"],
+                      "pid": os.getpid(), "proc": proc,
+                      "argv": " ".join(sys.argv[:6])[:300],
+                      "start_us": _now_us()}
+            fh.write(json.dumps(header, separators=(",", ":"),
+                                default=repr) + "\n")
+            fh.flush()
+            _STATE = {"run": header["run"], "dir": d, "fh": fh,
+                      "proc": proc, "seq": 0, "path": path}
+            return _STATE
+        except OSError:
+            _DROPPED += 1
+            return None
+
+
+def _close_state_locked() -> None:
+    """Close + clear _STATE; caller holds _LOCK."""
+    global _STATE
+    if _STATE is not None:
+        try:
+            _STATE["fh"].close()
+        except OSError:
+            pass
+        _STATE = None
+
+
+def _close_state() -> None:
+    with _LOCK:
+        _close_state_locked()
+
+
+def _write(rec: dict) -> None:
+    """One JSONL line, flushed (flush reaches the OS, so it survives the
+    process's own SIGKILL — only a machine crash could lose it; fsync
+    per event would tax the per-iteration seams for no added safety
+    against the failure mode tracing exists for)."""
+    global _DROPPED
+    state = _STATE
+    if state is None:
+        return
+    try:
+        line = json.dumps(rec, separators=(",", ":"), default=repr)
+    except (TypeError, ValueError):
+        _DROPPED += 1
+        return
+    try:
+        with _LOCK:
+            state["fh"].write(line + "\n")
+            state["fh"].flush()
+    except (OSError, ValueError):
+        # ValueError covers a racing reopen/close ("I/O operation on
+        # closed file"): the never-raises contract holds over losing
+        # one event at a run-id switch.
+        _DROPPED += 1
+
+
+class Span:
+    """One live span (what ``span()`` yields): ``id`` is the handle a
+    supervisor passes to children via ``child_env``."""
+
+    __slots__ = ("id", "name")
+
+    def __init__(self, sid: str, name: str):
+        self.id, self.name = sid, name
+
+
+class _SpanCM:
+    def __init__(self, name: str, attrs: dict):
+        self._name, self._attrs = name, attrs
+        self._span: Span | None = None
+
+    def __enter__(self) -> Span | None:
+        global _SPANS_STARTED
+        st = _state()  # the returned dict, NOT a re-read of _STATE: a
+        if st is None:  # racing reopen may null the global between them
+            return None
+        with _LOCK:
+            st["seq"] += 1
+            sid = f"{st['proc']}.{st['seq']}"
+        stack = _stack()
+        parent = (stack[-1] if stack
+                  else os.environ.get("OT_TRACE_PARENT") or None)
+        _SPANS_STARTED += 1
+        rec = {"ev": "b", "id": sid, "parent": parent, "name": self._name,
+               "ts": _now_us(), "tid": _tid()}
+        if self._attrs:
+            rec["attrs"] = self._attrs
+        _write(rec)
+        stack.append(sid)
+        self._span = Span(sid, self._name)
+        return self._span
+
+    def __exit__(self, exc_type, exc, tb):
+        if self._span is None:
+            return False
+        stack = _stack()
+        if stack and stack[-1] == self._span.id:
+            stack.pop()
+        status = "ok" if exc_type is None else f"error:{exc_type.__name__}"
+        _write({"ev": "e", "id": self._span.id, "ts": _now_us(),
+                "status": status})
+        return False
+
+
+class _NullCM:
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL = _NullCM()
+
+
+def span(name: str, **attrs):
+    """Context manager timing a region. Yields a ``Span`` (or None when
+    disabled). Nesting is tracked per thread; a root span's parent comes
+    from ``OT_TRACE_PARENT`` when a supervisor handed one down."""
+    if not enabled():
+        return _NULL
+    return _SpanCM(name, attrs)
+
+
+def current_span_id() -> str | None:
+    """The innermost live span's id on this thread (for ``child_env``)."""
+    stack = getattr(_TLS, "stack", None)
+    return stack[-1] if stack else None
+
+
+def point(name: str, **attrs) -> None:
+    """One instant event (fault firings, degradations, kills, ...)."""
+    if not enabled() or _state() is None:
+        return
+    rec = {"ev": "p", "name": name, "ts": _now_us()}
+    if attrs:
+        rec["attrs"] = attrs
+    _write(rec)
+
+
+def counter(name: str, n: float = 1, **attrs) -> None:
+    """Add ``n`` to the named counter (aggregated into
+    ``metrics_snapshot``) and emit one ``c`` event."""
+    if not enabled() or _state() is None:
+        return
+    with _LOCK:
+        _COUNTS[name] = _COUNTS.get(name, 0) + n
+    rec = {"ev": "c", "name": name, "ts": _now_us(), "n": n}
+    if attrs:
+        rec["attrs"] = attrs
+    _write(rec)
+
+
+def gauge(name: str, value: float, **attrs) -> None:
+    """Set the named gauge (last-write-wins in ``metrics_snapshot``)
+    and emit one ``g`` event."""
+    if not enabled() or _state() is None:
+        return
+    with _LOCK:
+        _GAUGES[name] = value
+    rec = {"ev": "g", "name": name, "ts": _now_us(), "value": value}
+    if attrs:
+        rec["attrs"] = attrs
+    _write(rec)
+
+
+def metrics_snapshot() -> dict:
+    """The flat snapshot stamped into the bench JSON line
+    (``"obs": {...}``): run id, span count, counter totals, gauge
+    values, and the dropped-event count when nonzero (a snapshot that
+    hid drops would overstate its own completeness)."""
+    snap: dict = {"run": run_id(), "spans": _SPANS_STARTED}
+    with _LOCK:
+        if _COUNTS:
+            snap["counters"] = dict(sorted(_COUNTS.items()))
+        if _GAUGES:
+            snap["gauges"] = dict(sorted(_GAUGES.items()))
+    if _DROPPED:
+        snap["dropped"] = _DROPPED
+    return snap
+
+
+def child_env(env: dict) -> dict:
+    """Copy ``env`` with the run id and the CURRENT span id injected
+    (``OT_TRACE_RUN`` / ``OT_TRACE_PARENT``), so a child process's root
+    spans nest under the caller's live span. No-op while disabled."""
+    if not enabled():
+        return env
+    out = dict(env)
+    out["OT_TRACE_DIR"] = os.environ["OT_TRACE_DIR"]
+    out["OT_TRACE_RUN"] = ensure_run()
+    parent = current_span_id()
+    if parent:
+        out["OT_TRACE_PARENT"] = parent
+    else:
+        out.pop("OT_TRACE_PARENT", None)
+    return out
+
+
+def reset_for_tests() -> None:
+    """Close the event file and clear every aggregate (tests only — a
+    real process's trace is a fact about this process)."""
+    global _SPANS_STARTED, _DROPPED
+    _close_state()
+    with _LOCK:
+        _COUNTS.clear()
+        _GAUGES.clear()
+        _TIDS.clear()
+    _SPANS_STARTED = 0
+    _DROPPED = 0
+    _TLS.stack = []

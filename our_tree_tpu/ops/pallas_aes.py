@@ -32,6 +32,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 from . import bitslice
+from ..obs import trace as _trace
 from ..resilience import faults as _faults
 from ..resilience import watchdog as _watchdog
 
@@ -49,7 +50,14 @@ def _dispatch_seam(what: str) -> None:
     SIGKILL. A point *inside* the traced grid loop cannot exist — the
     kernel body is staged once and replayed by Mosaic — so the honest
     seam is the dispatch itself. One dict lookup each while unarmed.
+
+    With tracing on, every launch also counts into the
+    ``pallas_dispatch`` counter (obs/trace.py) — the trace-side answer
+    to "how many kernel launches did this row actually make", which is
+    a span-free counter because the launch itself is async: the wall
+    time lands in the caller's barrier span, not here.
     """
+    _trace.counter("pallas_dispatch", what=what)
     _faults.check("dispatch_fail", what)
     _watchdog.injected_hang("dispatch_hang", what)
 

@@ -29,10 +29,34 @@ demotion it must also report happens inside the package).
 
 from __future__ import annotations
 
+import os
 import sys
 
 #: (kind, why) in record order, duplicates (by kind) dropped.
 _EVENTS: list[tuple[str, str]] = []
+
+
+def _trace():
+    """our_tree_tpu.obs.trace, lazily, under its canonical dotted name
+    (the degrade-ledger -> trace bridge; same bare-load pattern as
+    watchdog._sibling). None when unloadable — tracing is an observer
+    and must never break the ledger."""
+    canonical = "our_tree_tpu.obs.trace"
+    mod = sys.modules.get(canonical)
+    if mod is None:
+        try:
+            import importlib.util
+            spec = importlib.util.spec_from_file_location(
+                canonical, os.path.join(
+                    os.path.dirname(os.path.dirname(os.path.abspath(
+                        __file__))), "obs", "trace.py"))
+            mod = importlib.util.module_from_spec(spec)
+            sys.modules[canonical] = mod
+            spec.loader.exec_module(mod)
+        except Exception:
+            sys.modules.pop(canonical, None)
+            return None
+    return mod
 
 
 def degrade(kind: str, why: str = "") -> None:
@@ -45,6 +69,12 @@ def degrade(kind: str, why: str = "") -> None:
     if any(k == kind for k, _ in _EVENTS):
         return
     _EVENTS.append((kind, why))
+    # The degrade-ledger -> trace bridge: every demotion is also one
+    # instant trace event WITH its cause, so a run's trace stream tells
+    # the demotion story without the bench JSON line or the journal.
+    t = _trace()
+    if t is not None:
+        t.point("degrade", kind=kind, why=why)
     print(f"# degraded: {kind}" + (f" ({why})" if why else ""),
           file=sys.stderr, flush=True)
 

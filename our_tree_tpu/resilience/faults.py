@@ -9,14 +9,19 @@ production paths pay a single dict lookup when the variable is unset.
 
 Grammar::
 
-    OT_FAULTS=init_hang:2,dispatch_fail:1,build_fail
+    OT_FAULTS=init_hang:2,dispatch_fail:1,build_fail,dispatch_hang:1@2
 
-Comma-separated tokens, each ``<point>[:<count>]``. A counted token arms
-the point for exactly ``count`` firings (the first ``count`` calls to
-``fire(point)`` return True, every later call False); a bare token arms it
-forever. Whitespace around tokens is tolerated; unknown point names are
-accepted but warned about on stderr (a typo that silently never fires
-would make a CI fault job vacuously green).
+Comma-separated tokens, each ``<point>[:<count>[@<skip>]]``. A counted
+token arms the point for exactly ``count`` firings (the first ``count``
+calls to ``fire(point)`` return True, every later call False); a bare
+token arms it forever. ``@<skip>`` defers a counted point past its
+first ``skip`` calls (``dispatch_hang:1@2`` skips two dispatches, then
+hangs the third) — the deterministic way to land a fault MID-unit
+(e.g. on the second worker row) instead of always on the first call;
+an in-process affordance: the ``--isolate`` supervisor's metering hands
+children plain ``:1`` shots. Whitespace around tokens is tolerated;
+unknown point names are accepted but warned about on stderr (a typo
+that silently never fires would make a CI fault job vacuously green).
 
 Registered injection points (the fault matrix, docs/RESILIENCE.md):
 
@@ -78,6 +83,33 @@ ALWAYS = -1
 #: the steady-state no-op is one None-check + one ``not {}``.
 _REGISTRY: dict[str, int] | None = None
 
+#: point -> calls still to skip before the counted shots start firing
+#: (the ``@<skip>`` grammar; absent = fire immediately).
+_SKIPS: dict[str, int] = {}
+
+
+def _trace():
+    """our_tree_tpu.obs.trace, lazily, under its canonical dotted name
+    (the fault -> trace bridge: every firing is an instant event, so a
+    fault-matrix run's trace names what was injected). None when
+    unloadable — tracing must never break the injection seam."""
+    canonical = "our_tree_tpu.obs.trace"
+    mod = sys.modules.get(canonical)
+    if mod is None:
+        try:
+            import importlib.util
+            spec = importlib.util.spec_from_file_location(
+                canonical, os.path.join(
+                    os.path.dirname(os.path.dirname(os.path.abspath(
+                        __file__))), "obs", "trace.py"))
+            mod = importlib.util.module_from_spec(spec)
+            sys.modules[canonical] = mod
+            spec.loader.exec_module(mod)
+        except Exception:
+            sys.modules.pop(canonical, None)
+            return None
+    return mod
+
 
 class InjectedFault(RuntimeError):
     """Raised by injection points when their fault fires.
@@ -90,8 +122,9 @@ class InjectedFault(RuntimeError):
     """
 
 
-def _parse(spec: str) -> dict[str, int]:
+def _parse(spec: str) -> tuple[dict[str, int], dict[str, int]]:
     reg: dict[str, int] = {}
+    skips: dict[str, int] = {}
     for tok in spec.split(","):
         tok = tok.strip()
         if not tok:
@@ -99,8 +132,11 @@ def _parse(spec: str) -> dict[str, int]:
         name, sep, count = tok.partition(":")
         name = name.strip()
         if sep:
+            count, at, skip = count.partition("@")
             try:
                 n = int(count.strip())
+                if at:  # last token's skip wins (skips don't accumulate)
+                    skips[name] = max(int(skip.strip()), 0)
             except ValueError:
                 print(f"# OT_FAULTS: malformed token {tok!r} ignored",
                       file=sys.stderr)
@@ -116,13 +152,15 @@ def _parse(spec: str) -> dict[str, int]:
         # Repeated tokens accumulate (":2,x:1" == "x:3"); ALWAYS absorbs.
         prev = reg.get(name, 0)
         reg[name] = ALWAYS if ALWAYS in (prev, n) else prev + n
-    return reg
+    return reg, {k: v for k, v in skips.items() if k in reg and v > 0}
 
 
 def reset() -> None:
     """Re-parse OT_FAULTS (tests that set the env after import)."""
     global _REGISTRY
-    _REGISTRY = _parse(os.environ.get("OT_FAULTS", ""))
+    _REGISTRY, skips = _parse(os.environ.get("OT_FAULTS", ""))
+    _SKIPS.clear()
+    _SKIPS.update(skips)
 
 
 def active() -> bool:
@@ -130,6 +168,17 @@ def active() -> bool:
     if _REGISTRY is None:
         reset()
     return bool(_REGISTRY)
+
+
+def _take_shot(reg: dict, point: str, n: int) -> None:
+    """The one counted-shot decrement (shared by fire/consume so the
+    supervisor's metering pool can never desynchronize from in-process
+    firing)."""
+    if n != ALWAYS:
+        if n == 1:
+            del reg[point]
+        else:
+            reg[point] = n - 1
 
 
 def fire(point: str) -> bool:
@@ -149,11 +198,15 @@ def fire(point: str) -> bool:
     n = reg.get(point, 0)
     if n == 0:
         return False
-    if n != ALWAYS:
-        if n == 1:
-            del reg[point]
-        else:
-            reg[point] = n - 1
+    skip = _SKIPS.get(point, 0)
+    if skip:  # deferred shot (the @<skip> grammar): not yet
+        _SKIPS[point] = skip - 1
+        return False
+    _take_shot(reg, point, n)
+    t = _trace()
+    if t is not None:
+        t.point("fault-injected", point=point,
+                left=("unbounded" if n == ALWAYS else n - 1))
     print(f"# OT_FAULTS: injecting {point} "
           f"({'unbounded' if n == ALWAYS else f'{n - 1} left'})",
           file=sys.stderr)
@@ -167,6 +220,27 @@ def check(point: str, detail: str = "") -> None:
                             + (f" ({detail})" if detail else ""))
 
 
+def consume(point: str) -> bool:
+    """Take one shot at `point` WITHOUT it counting as an injection: no
+    stderr note, no ``fault-injected`` trace event. For supervisors that
+    METER shots into children (isolate._meter_faults) — the injection
+    happens at the child's seam (and is traced there); the supervisor's
+    consumption is bookkeeping, and recording it as a firing would
+    double-count every metered fault in the run's injected-vs-observed
+    ledger. Skips (the ``@`` grammar) are not consumed: metering hands
+    children plain ``:1`` shots."""
+    global _REGISTRY
+    reg = _REGISTRY
+    if reg is None:
+        reset()
+        reg = _REGISTRY
+    n = reg.get(point, 0) if reg else 0
+    if n == 0:
+        return False
+    _take_shot(reg, point, n)
+    return True
+
+
 def remaining(point: str) -> int:
     """Shots left at `point` (ALWAYS for unbounded, 0 when disarmed)."""
     if _REGISTRY is None:
@@ -176,12 +250,15 @@ def remaining(point: str) -> int:
 
 def armed() -> tuple[str, ...]:
     """Currently armed point names (a snapshot — safe to fire() while
-    iterating). Supervisors that spawn children use this to METER counted
-    faults instead of letting every child re-arm the full spec: each
-    child spawn consumes one shot per armed counted point and hands the
-    child exactly that shot (``<point>:1``), while bare points pass
-    through unmetered — so ``dispatch_hang:1`` under ``--isolate`` means
-    ONE hung child across the whole sweep, not one per child
+    iterating). Supervisors that spawn children use this to METER faults
+    instead of letting every child re-arm the full spec: each child
+    spawn hands the child exactly one shot (``<point>:1``) per armed
+    point — counted points debit the supervisor's pool (via
+    ``consume``, so the metering is not itself recorded as an
+    injection), bare points draw from an inexhaustible one. So
+    ``dispatch_hang:1`` under ``--isolate`` means ONE hung child across
+    the whole sweep, and a bare point means one firing per child
+    attempt rather than fire-forever in every child
     (resilience/isolate.py:_meter_faults)."""
     if _REGISTRY is None:
         reset()

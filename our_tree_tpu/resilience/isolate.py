@@ -39,11 +39,14 @@ lazily under their canonical dotted names.
 
 from __future__ import annotations
 
+import contextlib
 import os
 import signal
 import subprocess
 import sys
 import time
+
+_null_cm = contextlib.nullcontext
 
 
 def _sibling(name: str):
@@ -68,6 +71,29 @@ def _sibling(name: str):
     return mod
 
 
+def _trace():
+    """our_tree_tpu.obs.trace, lazily, under its canonical dotted name
+    (the child-lifecycle -> trace bridge; same bare-load pattern as
+    _sibling, different package). None when unloadable — tracing must
+    never break isolation."""
+    canonical = "our_tree_tpu.obs.trace"
+    mod = sys.modules.get(canonical)
+    if mod is None:
+        try:
+            import importlib.util
+            spec = importlib.util.spec_from_file_location(
+                canonical, os.path.join(
+                    os.path.dirname(os.path.dirname(os.path.abspath(
+                        __file__))), "obs", "trace.py"))
+            mod = importlib.util.module_from_spec(spec)
+            sys.modules[canonical] = mod
+            spec.loader.exec_module(mod)
+        except Exception:
+            sys.modules.pop(canonical, None)
+            return None
+    return mod
+
+
 def _meter_faults(base_env: dict) -> dict:
     """Meter this process's armed faults into ONE child's environment.
 
@@ -76,18 +102,28 @@ def _meter_faults(base_env: dict) -> dict:
     dispatch — "one wedged unit among healthy ones", the scenario the
     quarantine ledger exists for, would be unrehearsable. Instead the
     supervisor holds the process-wide counters: each spawn consumes one
-    shot per armed counted point and hands the child exactly that shot;
-    bare (fire-forever) points pass through unmetered. With OT_FAULTS
-    unset or exhausted the child env carries no armed points.
+    shot per armed counted point and hands the child exactly that shot.
+
+    Bare (fire-forever) points are metered the same way (ROADMAP
+    follow-up): each spawn hands the child ONE shot (``<point>:1``) from
+    the supervisor's inexhaustible pool, instead of forwarding the bare
+    token — which the child would re-parse as fire-forever and fault on
+    EVERY call of every seam. Under ``--isolate`` a bare point therefore
+    means "one firing per child attempt": each child rehearses its
+    single-fault recovery path (a ``build_fail`` retries and builds, a
+    ``dispatch_fail`` falls back once) rather than every child drowning
+    in unbounded failures. With OT_FAULTS unset or exhausted the child
+    env carries no armed points.
     """
     if not base_env.get("OT_FAULTS"):
         return base_env
     faults = _sibling("faults")
     tokens = []
     for point in faults.armed():
-        if faults.remaining(point) == faults.ALWAYS:
-            tokens.append(point)
-        elif faults.fire(point):
+        # consume(), not fire(): the supervisor's metering is
+        # bookkeeping — the injection itself happens (and is traced) at
+        # the child's seam.
+        if faults.remaining(point) == faults.ALWAYS or faults.consume(point):
             tokens.append(f"{point}:1")
     env = dict(base_env)
     env["OT_FAULTS"] = ",".join(tokens)
@@ -165,13 +201,28 @@ def run_child(argv, timeout_s: float | None = None, *, env=None, cwd=None,
     per-failure observer; the exception's message carries the kind.
     """
     policy = _sibling("policy")
+    tr = _trace()
     last: dict = {}
 
     class _ChildFailed(Exception):
         pass
 
     def op(attempt):
-        r = _attempt(argv, timeout_s, env, cwd, capture)
+        if tr is None:
+            r = _attempt(argv, timeout_s, env, cwd, capture)
+        else:
+            # The child span is the cross-process stitch: child_env
+            # hands its id down via OT_TRACE_PARENT, so the subprocess's
+            # own root spans nest under this attempt in the merged run.
+            with tr.span("child",
+                         label=name or os.path.basename(str(argv[0])),
+                         attempt=attempt.index):
+                cenv = tr.child_env(dict(env if env is not None
+                                         else os.environ))
+                r = _attempt(argv, timeout_s, cenv, cwd, capture)
+                if r.kind == "timeout":
+                    tr.point("child-killed", label=name,
+                             wall_s=round(r.wall_s, 3))
         last["r"] = r
         if not r.ok:
             raise _ChildFailed(f"{r.kind} (rc={r.rc})")
@@ -206,6 +257,7 @@ def run_isolated_sweep(*, units, child_argv, journal_path: str, config: dict,
     """
     journal_mod = _sibling("journal")
     degr = _sibling("degrade")
+    tr = _trace()
     note = log or (lambda msg: print(msg, file=sys.stderr, flush=True))
     journal = journal_mod.SweepJournal(journal_path, config)
     if journal.pending:
@@ -220,40 +272,56 @@ def run_isolated_sweep(*, units, child_argv, journal_path: str, config: dict,
             degr.degrade(kind, "restored from journal")
 
     def consume(name: str) -> bool:
-        """skip+emit `name` iff its completed record is replayable.
+        """take+emit `name` iff its completed record is replayable.
 
-        ``skip()`` can return None even after ``is_completed``: a
-        journal whose completed rows are out of sweep order (possible
-        when in-process watchdog failures and later successes
-        interleave across runs) is distrusted and truncated rather
-        than replayed into the wrong slots. The unit then simply
-        re-runs — the safe direction.
+        ``take()`` consumes by NAME, not replay order: the supervisor
+        only re-emits recorded lines (no RNG state is restored here),
+        and out-of-order completion is routine for it — a
+        quarantine-released or failed-then-retried unit completes
+        after its successors' records are already on file. The
+        in-process resume path keeps the strict-order ``skip()``
+        (there the RNG stream makes order the contract).
         """
         if not journal.is_completed(name):
             return False
-        entry = journal.skip(name)
+        entry = journal.take(name)
         if entry is None:
             return False
         emit_entry(entry)
+        if tr is not None:
+            tr.point("unit-replayed", unit=name)
         return True
 
     try:
         for name in units:
             if consume(name):
                 continue
+            attempt_no = 0
             while (journal.fail_count(name) < quarantine_after
                    and not journal.is_completed(name)):
                 n_prev = journal.fail_count(name)
-                r = run_child(child_argv(name), unit_deadline_s,
-                              env=_meter_faults(dict(env if env is not None
-                                                     else os.environ)),
-                              cwd=cwd, name=f"isolate:{name}")
+                attempt_no += 1
+                # The unit-attempt span is the supervisor's per-unit
+                # wall clock (spawn through reap/kill); run_child's own
+                # child span nests inside it, and the subprocess's spans
+                # nest under THAT via OT_TRACE_PARENT.
+                with (tr.span("unit-attempt", unit=name,
+                              attempt=attempt_no)
+                      if tr is not None else _null_cm()):
+                    r = run_child(child_argv(name), unit_deadline_s,
+                                  env=_meter_faults(
+                                      dict(env if env is not None
+                                           else os.environ)),
+                                  cwd=cwd, name=f"isolate:{name}")
                 journal.reload_tail()
                 if journal.is_completed(name):
                     break
                 reason = (f"timeout:{unit_deadline_s:.0f}s"
                           if r.kind == "timeout" else f"crash:rc={r.rc}")
                 journal.record_failure(name, reason)
+                if tr is not None:
+                    tr.point("unit-failed", unit=name, reason=reason,
+                             attempt=attempt_no)
                 tail = r.err.strip().splitlines()[-3:]
                 note(f"# isolate: unit {name} failed "
                      f"({reason}; failure {n_prev + 1}/{quarantine_after})"
@@ -261,6 +329,9 @@ def run_isolated_sweep(*, units, child_argv, journal_path: str, config: dict,
             if not consume(name):
                 if journal.fail_count(name) >= quarantine_after:
                     quarantined.append(name)
+                    if tr is not None:
+                        tr.point("quarantine", unit=name,
+                                 fails=journal.fail_count(name))
                     degr.degrade(
                         f"quarantined:{name}",
                         f"{journal.fail_count(name)} recorded failure(s); "

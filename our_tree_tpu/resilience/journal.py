@@ -10,19 +10,32 @@ sweep unit, and a restarted sweep with the SAME config hash replays the
 recorded units (re-emitting their result lines verbatim and restoring the
 shared RNG stream) and resumes execution at the first unfinished one.
 
-File format — line 1 is the header; every later line is either one
-completed unit or one recorded FAILURE of a unit (an isolated child that
-hung or crashed — resilience/isolate.py)::
+File format — line 1 is the header; every later line is one completed
+unit, one recorded FAILURE of a unit (an isolated child that hung or
+crashed — resilience/isolate.py), or one completed worker ROW inside a
+still-running unit (the intra-unit checkpoint)::
 
     {"kind": "ot-sweep-journal", "v": 1, "config_hash": "...", "config": {...}}
     {"unit": "ecb:65536", "lines": [...], "rng_state": {...}, "degraded": []}
     {"unit": "ctr:65536", "failed": true, "reason": "timeout:20s"}
+    {"unit": "ctr:65536", "row": "2", "lines": [...], "rng_state": {...}}
 
 Failure rows are counted (``fail_count``), never replayed: a unit whose
 count reaches the caller's quarantine threshold is skipped on resume
 with a ``quarantined:<unit>`` demotion stamped through degrade() —
 the quarantine ledger of docs/RESILIENCE.md. Completed and failure rows
 interleave freely (a unit can fail twice and then complete).
+
+Row records are the PER-WORKER-ROW granularity (docs/OBSERVABILITY.md):
+a unit SIGKILLed or watchdog-failed midway leaves its completed rows on
+file, and the unit's RE-run replays them (re-emitting their lines,
+restoring the post-row RNG state) and resumes at the first fresh row —
+instead of re-running every worker row of a half-done unit. They are
+consulted only while their unit is incomplete; once the unit's own
+completed record lands, stale row records are inert (never replayed,
+never counted). ``clear_failures`` is the quarantine-release edit: it
+rewrites the file without the named units' failure rows (the
+``--unquarantine`` flow).
 
 Durability: entries are flushed + fsync'd as they complete, so a SIGKILL
 can tear at most the in-flight line; a torn or otherwise unparseable tail
@@ -75,6 +88,7 @@ class SweepJournal:
         self.config_hash = config_hash(config)
         self._replay: list[dict] = []
         self._fail_counts: dict[str, int] = {}
+        self._rows: dict[str, dict[str, dict]] = {}
         self._resumed = 0
         valid_bytes = 0
         header_ok = False
@@ -103,6 +117,12 @@ class SweepJournal:
                     # it toward quarantine, never offer it for replay.
                     u = rec["unit"]
                     self._fail_counts[u] = self._fail_counts.get(u, 0) + 1
+                elif rec.get("row") is not None:
+                    # An intra-unit worker-row checkpoint: replayable
+                    # only from INSIDE its unit's re-run, never as a
+                    # completed unit.
+                    self._rows.setdefault(rec["unit"], {})[
+                        str(rec["row"])] = rec
                 else:
                     self._replay.append(rec)
             else:
@@ -206,6 +226,8 @@ class SweepJournal:
             if rec.get("failed"):
                 u = rec["unit"]
                 self._fail_counts[u] = self._fail_counts.get(u, 0) + 1
+            elif rec.get("row") is not None:
+                self._rows.setdefault(rec["unit"], {})[str(rec["row"])] = rec
             else:
                 self._replay.append(rec)
                 added += 1
@@ -219,6 +241,25 @@ class SweepJournal:
         # honest for the next reload).
         self._fh.seek(seen)
         return added
+
+    def take(self, unit: str) -> dict | None:
+        """The recorded entry for `unit` regardless of replay position.
+
+        For EMIT-ONLY consumers — the isolate supervisor re-emits
+        entries by name and restores no RNG state, so replay order is
+        not a correctness surface for it the way it is for ``skip()``.
+        Out-of-order completion is routine there: a quarantine-released
+        (or failed-then-retried) unit completes AFTER its successors,
+        and the strict-order ``skip()`` would distrust and truncate a
+        perfectly attributable tail. In-process resume (harness.bench
+        without --isolate) MUST keep using ``skip()``: it restores the
+        shared RNG stream, where order is the whole contract.
+        """
+        for i, entry in enumerate(self._replay):
+            if entry.get("unit") == unit:
+                self._resumed += 1
+                return self._replay.pop(i)
+        return None
 
     def skip(self, unit: str) -> dict | None:
         """The recorded entry for `unit` iff it is next in replay order."""
@@ -256,10 +297,27 @@ class SweepJournal:
                 rec = json.loads(line)
             except ValueError:
                 break
-            if not rec.get("failed"):  # failure rows ride along, uncounted
+            # Failure and worker-row records ride along, uncounted: only
+            # completed-unit records were consumed via skip().
+            if not rec.get("failed") and rec.get("row") is None:
                 consumed += 1
         self._fh.flush()
         os.fsync(self._fh.fileno())
+
+    def rows(self, unit: str) -> dict[str, dict]:
+        """`unit`'s recorded worker-row checkpoints (row-key -> record),
+        for replay inside the unit's re-run. Meaningful only while the
+        unit is incomplete — a completed unit's replay supersedes them."""
+        return dict(self._rows.get(unit, {}))
+
+    def record_row(self, unit: str, row: str, lines: list[str],
+                   rng_state=None) -> None:
+        """Append one completed worker row of a still-running unit
+        (fsync'd — the whole point is surviving the unit's SIGKILL)."""
+        self._rows.setdefault(unit, {})[str(row)] = rec = {
+            "unit": unit, "row": str(row), "lines": list(lines),
+            "rng_state": rng_state}
+        self._append(rec)
 
     def record(self, unit: str, lines: list[str], rng_state=None,
                degraded=()) -> None:
@@ -272,3 +330,47 @@ class SweepJournal:
             self._fh.close()
         except OSError:
             pass
+
+
+def clear_failures(path: str, units: list[str]) -> dict[str, int]:
+    """Drop the named units' failure rows from the journal at ``path``
+    (the quarantine-release edit behind ``harness.bench --unquarantine``).
+
+    Returns unit -> number of failure rows removed (0 entries included,
+    so a typo'd unit name is visible to the caller). Works on any
+    parseable journal regardless of config hash — releasing a unit is a
+    ledger edit, not a replay, so it must not depend on reproducing the
+    exact sweep config that quarantined it. Every non-failure line
+    (header, completed units, worker rows, OTHER units' failures) is
+    preserved byte-for-byte; the rewrite goes through a temp file +
+    rename so a kill mid-edit leaves the original intact.
+    """
+    cleared = {u: 0 for u in units}
+    targets = set(units)
+    try:
+        with open(path, "rb") as f:
+            lines = f.read().splitlines(keepends=True)
+    except OSError:
+        return cleared
+    kept = []
+    for i, line in enumerate(lines):
+        drop = False
+        if i > 0 and line.endswith(b"\n"):
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                rec = None
+            if (isinstance(rec, dict) and rec.get("failed")
+                    and rec.get("unit") in targets):
+                cleared[rec["unit"]] += 1
+                drop = True
+        if not drop:
+            kept.append(line)
+    if any(cleared.values()):
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(b"".join(kept))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    return cleared

@@ -27,8 +27,33 @@ reused.
 
 from __future__ import annotations
 
+import os
 import random
+import sys
 import time
+
+
+def _trace():
+    """our_tree_tpu.obs.trace, lazily, under its canonical dotted name
+    (the retry -> trace bridge: every failed attempt and every
+    exhaustion becomes a trace event carrying the policy's name). None
+    when unloadable — tracing must never break the retry machinery."""
+    canonical = "our_tree_tpu.obs.trace"
+    mod = sys.modules.get(canonical)
+    if mod is None:
+        try:
+            import importlib.util
+            spec = importlib.util.spec_from_file_location(
+                canonical, os.path.join(
+                    os.path.dirname(os.path.dirname(os.path.abspath(
+                        __file__))), "obs", "trace.py"))
+            mod = importlib.util.module_from_spec(spec)
+            sys.modules[canonical] = mod
+            spec.loader.exec_module(mod)
+        except Exception:
+            sys.modules.pop(canonical, None)
+            return None
+    return mod
 
 
 class PolicyExhausted(Exception):
@@ -185,6 +210,11 @@ class RetryPolicy:
                 return op(attempt)
             except self.retry_on as e:
                 last = e
+                t = _trace()
+                if t is not None:
+                    t.counter("retry_failures",
+                              policy=self.name or "retry", attempt=index,
+                              error=type(e).__name__)
                 if self.log is not None:
                     self.log(attempt, e)
             index += 1
@@ -206,6 +236,11 @@ class RetryPolicy:
                 if remaining is not None:
                     delay = min(delay, max(remaining, 0.0))
                 self.sleep(delay)
+        t = _trace()
+        if t is not None:
+            t.point("retry-exhausted", policy=self.name or "retry",
+                    attempts=index,
+                    error=type(last).__name__ if last else None)
         if self.on_exhausted is not None:
             return self.on_exhausted(last)
         raise PolicyExhausted(self.name, index, last) from last

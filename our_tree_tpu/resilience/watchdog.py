@@ -96,6 +96,29 @@ def _sibling(name: str):
     return mod
 
 
+def _trace():
+    """our_tree_tpu.obs.trace, lazily, under its canonical dotted name
+    (the watchdog -> trace bridge: arm and expiry become instant
+    events). None when unloadable — tracing must never break the
+    watchdog; same bare-load pattern as _sibling, different package."""
+    canonical = "our_tree_tpu.obs.trace"
+    mod = sys.modules.get(canonical)
+    if mod is None:
+        try:
+            import importlib.util
+            spec = importlib.util.spec_from_file_location(
+                canonical, os.path.join(
+                    os.path.dirname(os.path.dirname(os.path.abspath(
+                        __file__))), "obs", "trace.py"))
+            mod = importlib.util.module_from_spec(spec)
+            sys.modules[canonical] = mod
+            spec.loader.exec_module(mod)
+        except Exception:
+            sys.modules.pop(canonical, None)
+            return None
+    return mod
+
+
 def crash_dir() -> str:
     return os.environ.get("OT_CRASH_DIR", "/tmp/ot_crash")
 
@@ -159,6 +182,9 @@ def deadline(seconds: float | None, what: str = "device dispatch",
     if not seconds or seconds <= 0:
         yield
         return
+    t = _trace()
+    if t is not None:
+        t.point("watchdog-arm", what=what, seconds=seconds)
     on_main = (threading.current_thread() is threading.main_thread()
                and hasattr(signal, "SIGALRM"))
     fired: dict = {}
@@ -191,6 +217,10 @@ def deadline(seconds: float | None, what: str = "device dispatch",
         _sibling("degrade").degrade(
             degrade_kind,
             f"{what} exceeded {seconds:.0f}s watchdog deadline")
+        tt = _trace()
+        if tt is not None:
+            tt.point("watchdog-expired", what=what, seconds=seconds,
+                     report=fired.get("report"))
         return DispatchTimeout(what, seconds, fired.get("report"))
 
     old = None

@@ -21,6 +21,7 @@ import subprocess
 
 import numpy as np
 
+from ..obs import trace as _trace
 from ..resilience import faults, policy
 
 _CSRC = pathlib.Path(__file__).parent / "csrc"
@@ -101,10 +102,14 @@ def _build() -> None:
         # clean) gets one more try before the callers' own fallbacks
         # (OT_ARC4_PREP=auto -> lax.scan, bench zero-line) take over; a
         # deterministic compile error still fails fast with its full log.
-        policy.RetryPolicy(
-            attempts=2, base_delay_s=0.5, retry_on=(RuntimeError,),
-            name="native-build",
-        ).run(make)
+        # The span makes a cold-start build visible in the run trace —
+        # a `make` landing inside a sweep's setup is exactly the kind of
+        # one-off wall-clock sink per-row timings can't explain.
+        with _trace.span("native-build", target="libotcrypt.so"):
+            policy.RetryPolicy(
+                attempts=2, base_delay_s=0.5, retry_on=(RuntimeError,),
+                name="native-build",
+            ).run(make)
 
 
 _u8p = np.ctypeslib.ndpointer(dtype=np.uint8, flags="C_CONTIGUOUS")

@@ -32,20 +32,12 @@ def load_ranking():
     return _load_util("ranking")
 
 
-def load_resilience(name):
-    """resilience/<name>.py, bare-loaded — registered in sys.modules under
-    its CANONICAL dotted name so the fault counters / degradation ledger
-    stay one-per-process: a later package import (`from
-    our_tree_tpu.resilience import faults` inside jax-side code) finds and
-    reuses this very module instead of creating a second registry. The
-    utils/devlock.py lazy hook uses the same key for the same reason."""
-    canonical = f"our_tree_tpu.resilience.{name}"
+def _load_canonical(canonical, *relpath):
     mod = sys.modules.get(canonical)
     if mod is not None:
         return mod
     spec = importlib.util.spec_from_file_location(
-        canonical,
-        os.path.join(REPO, "our_tree_tpu", "resilience", f"{name}.py"))
+        canonical, os.path.join(REPO, *relpath))
     mod = importlib.util.module_from_spec(spec)
     sys.modules[canonical] = mod
     try:
@@ -54,3 +46,23 @@ def load_resilience(name):
         sys.modules.pop(canonical, None)
         raise
     return mod
+
+
+def load_resilience(name):
+    """resilience/<name>.py, bare-loaded — registered in sys.modules under
+    its CANONICAL dotted name so the fault counters / degradation ledger
+    stay one-per-process: a later package import (`from
+    our_tree_tpu.resilience import faults` inside jax-side code) finds and
+    reuses this very module instead of creating a second registry. The
+    utils/devlock.py lazy hook uses the same key for the same reason."""
+    return _load_canonical(f"our_tree_tpu.resilience.{name}",
+                           "our_tree_tpu", "resilience", f"{name}.py")
+
+
+def load_obs(name="trace"):
+    """obs/<name>.py, bare-loaded under its canonical dotted name for the
+    same one-per-process reason (the span stack, counters, and the open
+    trace file must be shared between the jax-free driver shell and the
+    package-imported jax-side code)."""
+    return _load_canonical(f"our_tree_tpu.obs.{name}",
+                           "our_tree_tpu", "obs", f"{name}.py")

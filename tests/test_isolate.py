@@ -95,12 +95,21 @@ def test_meter_faults_hands_one_shot_per_spawn(monkeypatch):
     monkeypatch.setenv("OT_FAULTS", "dispatch_hang:1,build_fail")
     faults.reset()
     env1 = isolate._meter_faults({"OT_FAULTS": "dispatch_hang:1,build_fail"})
-    # first spawn: the counted shot travels, the bare point passes through
+    # First spawn: one shot per armed point — the counted point's shot
+    # travels, and the BARE point is metered to one shot per child too
+    # (ROADMAP follow-up: an unmetered bare token would re-parse as
+    # fire-forever in every child and fault every call of every seam).
     toks = set(env1["OT_FAULTS"].split(","))
-    assert toks == {"dispatch_hang:1", "build_fail"}
+    assert toks == {"dispatch_hang:1", "build_fail:1"}
     env2 = isolate._meter_faults({"OT_FAULTS": "dispatch_hang:1,build_fail"})
-    assert set(env2["OT_FAULTS"].split(",")) == {"build_fail"}  # exhausted
+    # Second spawn: the counted point is exhausted; the bare point's
+    # supervisor-side pool never is.
+    assert set(env2["OT_FAULTS"].split(",")) == {"build_fail:1"}
     assert isolate._meter_faults({}) == {}  # unset spec: untouched
+    # Metering consumes supervisor-side shots WITHOUT counting them as
+    # injections (the injection happens at the child's seam).
+    assert faults.remaining("dispatch_hang") == 0
+    assert faults.remaining("build_fail") == faults.ALWAYS
 
 
 # ---------------------------------------------------------------------------

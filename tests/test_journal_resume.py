@@ -44,13 +44,21 @@ def _entries(journal_path):
         return [json.loads(line) for line in f]
 
 
+def _units(journal_path):
+    """Completed-UNIT records only: the journal also carries intra-unit
+    worker-row checkpoints (``"row"`` records, docs/OBSERVABILITY.md)
+    and failure rows, neither of which is a completed unit."""
+    return [e for e in _entries(journal_path)[1:]
+            if e.get("row") is None and not e.get("failed")]
+
+
 def test_sigterm_resume_reproduces_uninterrupted_corpus(tmp_path):
     # 1. The uninterrupted reference corpus.
     ref = tmp_path / "ref.txt"
     subprocess.run(_cmd(ref, tmp_path / "jref.jsonl"), env=_env(), cwd=ROOT,
                    capture_output=True, text=True, timeout=420, check=True)
     ref_bytes = ref.read_bytes()
-    n_units = len(_entries(tmp_path / "jref.jsonl")) - 1  # minus header
+    n_units = len(_units(tmp_path / "jref.jsonl"))
     assert n_units == 8
 
     # 2. Same sweep, SIGTERMed mid-run: poll the journal until at least
@@ -64,7 +72,7 @@ def test_sigterm_resume_reproduces_uninterrupted_corpus(tmp_path):
         deadline = time.time() + 300
         while time.time() < deadline:
             try:
-                if len(_entries(journal)) >= 3:  # header + >= 2 units
+                if len(_units(journal)) >= 2:  # >= 2 completed units
                     break
             except (OSError, ValueError):
                 pass
@@ -78,7 +86,7 @@ def test_sigterm_resume_reproduces_uninterrupted_corpus(tmp_path):
     finally:
         proc.kill()
     assert rc != 0  # killed, not completed
-    done = len(_entries(journal)) - 1
+    done = len(_units(journal))
     assert 2 <= done < n_units  # genuinely mid-sweep
 
     # 3. Resume: completed rows are skipped, the corpus is byte-identical.
@@ -90,8 +98,8 @@ def test_sigterm_resume_reproduces_uninterrupted_corpus(tmp_path):
     assert f"# journal: skipped {done} completed unit(s)" in res.stderr
     assert out2.read_bytes() == ref_bytes
     # ...and the journal now holds every unit exactly once, in order.
-    names = [e["unit"] for e in _entries(journal)[1:]]
-    assert names == [e["unit"] for e in _entries(tmp_path / "jref.jsonl")[1:]]
+    names = [e["unit"] for e in _units(journal)]
+    assert names == [e["unit"] for e in _units(tmp_path / "jref.jsonl")]
 
 
 def test_replay_restores_degraded_record(tmp_path):

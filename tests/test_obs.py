@@ -1,0 +1,451 @@
+"""Run-wide tracing & metrics (our_tree_tpu/obs): the tracer contract —
+JSONL schema stability, span nesting across process boundaries — the
+report CLI's golden output on a synthetic run, per-worker-row journal
+resume (spans recording replayed-vs-fresh rows), the quarantine-release
+flow, and the fault-matrix acceptance run: injected faults appear as
+trace events and the hung child's span reads as closed by SIGKILL."""
+
+import io
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+from our_tree_tpu.obs import export, report, trace
+from our_tree_tpu.resilience import faults, isolate
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+TRACE_PY = str(ROOT / "our_tree_tpu" / "obs" / "trace.py")
+
+#: The journal-suite's fast deterministic sweep config (fake clock,
+#: portable C), plus two worker counts so units have two ROWS.
+ARGS = ["--backend", "c", "--modes", "ecb", "--sizes-mb", "0.0625",
+        "--workers", "1,2", "--iters", "2"]
+ENV = {"OT_FAKE_TIME_US": "7", "OT_C_FORCE_PORTABLE": "1",
+       "JAX_PLATFORMS": "cpu", "PYTHONPATH": ""}
+
+
+@pytest.fixture
+def traced(tmp_path, monkeypatch):
+    """Point the process-global tracer at a fresh dir with a pinned run
+    id; reset its state on both sides (it is process-global on purpose)."""
+    monkeypatch.setenv("OT_TRACE_DIR", str(tmp_path / "tr"))
+    monkeypatch.setenv("OT_TRACE_RUN", "t-run")
+    monkeypatch.delenv("OT_TRACE_PARENT", raising=False)
+    trace.reset_for_tests()
+    yield tmp_path / "tr" / "t-run"
+    trace.reset_for_tests()
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv("OT_FAULTS", raising=False)
+    faults.reset()
+    yield
+    monkeypatch.delenv("OT_FAULTS", raising=False)
+    faults.reset()
+
+
+def _env(extra=None):
+    env = dict(os.environ)
+    env.update(ENV)
+    # This pytest process may itself be traced (the `traced` fixture);
+    # subprocess runs must not join ITS run unless the test says so.
+    env.pop("OT_TRACE_DIR", None)
+    env.pop("OT_TRACE_RUN", None)
+    env.pop("OT_TRACE_PARENT", None)
+    env.update(extra or {})
+    return env
+
+
+# ---------------------------------------------------------------------------
+# Tracer contract.
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_tracer_is_inert(tmp_path, monkeypatch):
+    monkeypatch.delenv("OT_TRACE_DIR", raising=False)
+    trace.reset_for_tests()
+    assert not trace.enabled()
+    with trace.span("x", a=1) as sp:
+        assert sp is None
+    trace.counter("c")
+    trace.point("p")
+    assert trace.run_id() is None and trace.ensure_run() is None
+    assert trace.metrics_snapshot()["spans"] == 0
+    assert trace.child_env({"A": "1"}) == {"A": "1"}
+
+
+def test_jsonl_schema_and_nesting(traced):
+    """Schema stability: exact key sets per event type, parent ids from
+    the thread-local span stack, error statuses from exceptions."""
+    with trace.span("outer", unit="u1") as outer:
+        with trace.span("inner") as inner:
+            trace.counter("hits", 2, where="inner")
+            trace.gauge("depth", 1.5)
+        trace.point("marker", note="x")
+    with pytest.raises(ValueError):
+        with trace.span("boom"):
+            raise ValueError("no")
+    files = list(traced.glob("trace-*.jsonl"))
+    assert len(files) == 1
+    recs = [json.loads(line) for line in files[0].read_text().splitlines()]
+    header, events = recs[0], recs[1:]
+    assert set(header) == {"kind", "v", "run", "pid", "proc", "argv",
+                           "start_us"}
+    assert header["kind"] == "ot-trace" and header["v"] == 1
+    assert header["run"] == "t-run" and header["pid"] == os.getpid()
+    by_ev = {}
+    for r in events:
+        by_ev.setdefault(r["ev"], []).append(r)
+    assert set(by_ev) == {"b", "e", "c", "g", "p"}
+    for b in by_ev["b"]:
+        assert set(b) <= {"ev", "id", "parent", "name", "ts", "tid", "attrs"}
+        assert set(b) >= {"ev", "id", "parent", "name", "ts", "tid"}
+    for e in by_ev["e"]:
+        assert set(e) == {"ev", "id", "ts", "status"}
+    assert set(by_ev["c"][0]) == {"ev", "name", "ts", "n", "attrs"}
+    assert set(by_ev["g"][0]) == {"ev", "name", "ts", "value"}
+    assert set(by_ev["p"][0]) == {"ev", "name", "ts", "attrs"}
+    # Nesting: inner.parent == outer.id; outer is a root (parent None).
+    b = {r["name"]: r for r in by_ev["b"]}
+    assert b["outer"]["parent"] is None
+    assert b["inner"]["parent"] == outer.id
+    assert inner.id != outer.id
+    # End statuses: ok for clean exits, error:<Type> for the raise.
+    status = {r["id"]: r["status"] for r in by_ev["e"]}
+    assert status[outer.id] == "ok"
+    assert status[b["boom"]["id"]] == "error:ValueError"
+    # The aggregate snapshot mirrors the stream.
+    snap = trace.metrics_snapshot()
+    assert snap["run"] == "t-run" and snap["spans"] == 3
+    assert snap["counters"] == {"hits": 2} and snap["gauges"] == {"depth": 1.5}
+    # load_run agrees and sees no orphans or violations.
+    run = export.load_run(str(traced))
+    assert not run.violations and not run.orphans()
+    assert run.counter_totals() == {"hits": 2}
+    assert run.ancestor_attr(run.spans[inner.id], "unit") == "u1"
+
+
+def test_span_nesting_across_process_boundary(traced):
+    """A subprocess spawned through isolate.run_child inherits the run id
+    and a parent span id (child_env), so its spans nest under the
+    caller's live span in the merged run."""
+    code = (
+        "import importlib.util, sys\n"
+        f"spec = importlib.util.spec_from_file_location("
+        f"'our_tree_tpu.obs.trace', {TRACE_PY!r})\n"
+        "m = importlib.util.module_from_spec(spec)\n"
+        "sys.modules['our_tree_tpu.obs.trace'] = m\n"
+        "spec.loader.exec_module(m)\n"
+        "with m.span('childwork'):\n"
+        "    pass\n")
+    with trace.span("parentwork", unit="xp"):
+        r = isolate.run_child([sys.executable, "-c", code], 60,
+                              name="obs-test")
+    assert r.ok, (r.out, r.err)
+    run = export.load_run(str(traced))
+    assert not run.violations and not run.orphans()
+    childwork = next(s for s in run.spans.values() if s.name == "childwork")
+    # The chain crosses the process boundary: childwork -> (run_child's
+    # "child" span) -> parentwork, so the parent's unit attr resolves.
+    assert run.spans[childwork.parent].name == "child"
+    assert run.ancestor_attr(childwork, "unit") == "xp"
+    assert {s.name for s in run.spans.values()} == {"parentwork", "child",
+                                                    "childwork"}
+
+
+# ---------------------------------------------------------------------------
+# Report golden output on a synthetic run.
+# ---------------------------------------------------------------------------
+
+
+def _synthetic_run(d: pathlib.Path) -> None:
+    """A hand-written two-process run: a supervisor whose first unit
+    attempt was killed (the child's spans never closed), then failed,
+    quarantined; fixed timestamps so the report is byte-stable."""
+    sup = [
+        {"kind": "ot-trace", "v": 1, "run": "synth", "pid": 100,
+         "proc": "aaaa0000", "argv": "bench --isolate", "start_us": 1000000},
+        {"ev": "b", "id": "aaaa0000.1", "parent": None, "name": "sweep",
+         "ts": 1000000, "tid": 0},
+        {"ev": "b", "id": "aaaa0000.2", "parent": "aaaa0000.1",
+         "name": "unit-attempt", "ts": 1100000, "tid": 0,
+         "attrs": {"unit": "ecb:65536", "attempt": 1}},
+        {"ev": "b", "id": "aaaa0000.3", "parent": "aaaa0000.2",
+         "name": "child", "ts": 1150000, "tid": 0,
+         "attrs": {"label": "isolate:ecb:65536", "attempt": 0}},
+        {"ev": "p", "name": "child-killed", "ts": 3150000,
+         "attrs": {"label": "isolate:ecb:65536", "wall_s": 2.0}},
+        {"ev": "e", "id": "aaaa0000.3", "ts": 3160000, "status": "ok"},
+        {"ev": "e", "id": "aaaa0000.2", "ts": 3170000, "status": "ok"},
+        {"ev": "p", "name": "unit-failed", "ts": 3180000,
+         "attrs": {"unit": "ecb:65536", "reason": "timeout:2s",
+                   "attempt": 1}},
+        {"ev": "p", "name": "quarantine", "ts": 3190000,
+         "attrs": {"unit": "ecb:65536", "fails": 1}},
+        {"ev": "p", "name": "degrade", "ts": 3200000,
+         "attrs": {"kind": "quarantined:ecb:65536",
+                   "why": "1 recorded failure(s)"}},
+        {"ev": "e", "id": "aaaa0000.1", "ts": 3500000, "status": "ok"},
+    ]
+    child = [
+        {"kind": "ot-trace", "v": 1, "run": "synth", "pid": 200,
+         "proc": "bbbb0000", "argv": "bench --isolate-child ecb:65536",
+         "start_us": 1200000},
+        {"ev": "b", "id": "bbbb0000.1", "parent": "aaaa0000.3",
+         "name": "unit", "ts": 1210000, "tid": 0,
+         "attrs": {"unit": "ecb:65536"}},
+        {"ev": "b", "id": "bbbb0000.2", "parent": "bbbb0000.1",
+         "name": "row", "ts": 1220000, "tid": 0,
+         "attrs": {"mode": "ecb", "size": 65536, "workers": 1}},
+        {"ev": "b", "id": "bbbb0000.3", "parent": "bbbb0000.2",
+         "name": "timed-call", "ts": 1230000, "tid": 0,
+         "attrs": {"seam": "harness._time_us"}},
+        {"ev": "p", "name": "fault-injected", "ts": 1240000,
+         "attrs": {"point": "dispatch_hang", "left": 0}},
+    ]
+    d.mkdir(parents=True)
+    for fname, recs in (("trace-100-aaaa0000.jsonl", sup),
+                        ("trace-200-bbbb0000.jsonl", child)):
+        (d / fname).write_text(
+            "".join(json.dumps(r, separators=(",", ":")) + "\n"
+                    for r in recs))
+
+
+GOLDEN = """\
+run synth: 2 process(es), 6 span(s) (3 orphaned), 5 event(s), wall 2.500s
+schema: OK
+
+per-unit:
+  unit       attempts  wall_s  device_s  rows f/r  failures  outcome
+  ecb:65536  1         2.070   0.000     1/0       1         quarantined
+
+faults injected: dispatch_hang x1
+faults observed: child-killed=1, unit-failed=1, watchdog-expired=0
+degradations: quarantined:ecb:65536 (1 recorded failure(s))
+quarantined: ecb:65536
+
+slowest spans (top 5):
+  span          unit       pid  dur_s  status
+  sweep         -          100  2.500  ok
+  unit          ecb:65536  200  2.290  killed
+  row           ecb:65536  200  2.280  killed
+  timed-call    ecb:65536  200  2.270  killed
+  unit-attempt  ecb:65536  100  2.070  ok
+
+orphaned spans (3 — begin with no end: the process was killed or died mid-span):
+  unit (unit=ecb:65536, pid 200) open 2.290s until end of run — closed by kill
+  row (unit=ecb:65536, pid 200) open 2.280s until end of run — closed by kill
+  timed-call (unit=ecb:65536, pid 200) open 2.270s until end of run — closed by kill
+"""
+
+
+def test_report_golden_on_synthetic_run(tmp_path):
+    d = tmp_path / "synth"
+    _synthetic_run(d)
+    run = export.load_run(str(d))
+    out = io.StringIO()
+    report.render(run, top=5, out=out)
+    assert out.getvalue() == GOLDEN
+    # --check semantics: orphans present -> nonzero.
+    assert report.main([str(d), "--check"]) == 2
+    # The Perfetto export loads as Trace Event Format and carries the
+    # kill evidence.
+    path = tmp_path / "trace.json"
+    export.write_chrome_trace(run, str(path))
+    t = json.loads(path.read_text())
+    evs = t["traceEvents"]
+    assert evs and all("ph" in e and "pid" in e for e in evs)
+    killed = [e for e in evs
+              if e["ph"] == "X" and e.get("args", {}).get("killed")]
+    assert {e["name"] for e in killed} == {"unit", "row", "timed-call"}
+    assert any(e["ph"] == "i" and e["name"] == "fault-injected"
+               for e in evs)
+
+
+def test_report_check_flags_schema_violations(tmp_path):
+    d = tmp_path / "bad"
+    d.mkdir()
+    (d / "trace-1-x.jsonl").write_text(
+        json.dumps({"kind": "ot-trace", "v": 1, "run": "r", "pid": 1,
+                    "proc": "x", "argv": "", "start_us": 0}) + "\n"
+        + json.dumps({"ev": "b", "id": "x.1", "ts": 5}) + "\n"  # no name
+        + "{torn")
+    run = export.load_run(str(d))
+    assert len(run.violations) == 2
+    assert report.main([str(d), "--check"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# Per-worker-row journal granularity (+ replayed-vs-fresh spans).
+# ---------------------------------------------------------------------------
+
+
+def _run_bench(out, journal, extra_args=(), extra_env=None, timeout=300):
+    argv = [sys.executable, "-m", "our_tree_tpu.harness.bench", *ARGS,
+            "--journal", str(journal), "--out", str(out), *extra_args]
+    return subprocess.run(argv, env=_env(extra_env), cwd=ROOT,
+                          capture_output=True, text=True, timeout=timeout)
+
+
+def _points(run_dir, name):
+    out = []
+    for f in pathlib.Path(run_dir).glob("*/trace-*.jsonl"):
+        for line in f.read_text().splitlines():
+            rec = json.loads(line)
+            if rec.get("ev") == "p" and rec.get("name") == name:
+                out.append(rec.get("attrs", {}))
+    return out
+
+
+def test_row_granularity_resume_at_last_completed_row(tmp_path):
+    """A unit that dies on its SECOND worker row (dispatch_hang:1@2 — the
+    @skip grammar defers the hang past row 1's two timed calls) resumes
+    at row 2: row 1 replays from its journal checkpoint, the resumed
+    corpus is byte-identical to an uninterrupted run's, and the trace
+    records the replayed row as a point and the fresh one as a span."""
+    ref = _run_bench(tmp_path / "ref.txt", tmp_path / "jref.jsonl")
+    assert ref.returncode == 0, ref.stderr[-2000:]
+
+    r1 = _run_bench(tmp_path / "r1.txt", tmp_path / "j.jsonl",
+                    ["--dispatch-deadline", "6"],
+                    {"OT_FAULTS": "dispatch_hang:1@2", "OT_HANG_S": "60",
+                     "OT_CRASH_DIR": str(tmp_path / "crash")})
+    assert r1.returncode == 0, r1.stderr[-2000:]
+    assert "# watchdog:" in r1.stderr
+    recs = [json.loads(line) for line in open(tmp_path / "j.jsonl")][1:]
+    rows = [e for e in recs if e.get("row") is not None]
+    assert [(e["unit"], e["row"]) for e in rows] == [("ecb:65536", "1")]
+    fails = [e for e in recs if e.get("failed")]
+    assert len(fails) == 1 and fails[0]["reason"].startswith("watchdog:")
+
+    r2 = _run_bench(tmp_path / "r2.txt", tmp_path / "j.jsonl",
+                    extra_env={"OT_TRACE_DIR": str(tmp_path / "tr")})
+    assert r2.returncode == 0, r2.stderr[-2000:]
+    assert (tmp_path / "r2.txt").read_bytes() == \
+        (tmp_path / "ref.txt").read_bytes()
+    # Replayed-vs-fresh in the trace: row 1 replayed as a point, row 2 a
+    # fresh "row" span with workers=2.
+    assert _points(tmp_path / "tr", "row-replayed") == [
+        {"unit": "ecb:65536", "row": "1"}]
+    run = export.load_run(str(next((tmp_path / "tr").iterdir())))
+    fresh = [s for s in run.spans.values() if s.name == "row"]
+    assert [s.attrs["workers"] for s in fresh] == [2]
+
+
+# ---------------------------------------------------------------------------
+# Quarantine release (--unquarantine).
+# ---------------------------------------------------------------------------
+
+
+def test_unquarantine_clears_failures_and_traces_release(tmp_path):
+    iso = ["--isolate", "--unit-deadline", "15", "--quarantine-after", "1"]
+    r1 = _run_bench(tmp_path / "r1.txt", tmp_path / "j.jsonl", iso,
+                    {"OT_FAULTS": "dispatch_hang:1"})
+    assert r1.returncode == 0, r1.stderr[-2000:]
+    assert "quarantined:ecb:65536" in r1.stderr
+
+    rq = subprocess.run(
+        [sys.executable, "-m", "our_tree_tpu.harness.bench",
+         "--journal", str(tmp_path / "j.jsonl"),
+         "--unquarantine", "ecb:65536"],
+        env=_env({"OT_TRACE_DIR": str(tmp_path / "tr")}), cwd=ROOT,
+        capture_output=True, text=True, timeout=120)
+    assert rq.returncode == 0, rq.stderr[-2000:]
+    assert "cleared 1 failure row(s)" in rq.stderr
+    assert _points(tmp_path / "tr", "quarantine-release") == [
+        {"unit": "ecb:65536", "cleared": 1}]
+    recs = [json.loads(line) for line in open(tmp_path / "j.jsonl")][1:]
+    assert not [e for e in recs if e.get("failed")]
+
+    # The released unit runs again (no quarantine skip, no degraded
+    # trailer) on the next sweep.
+    r2 = _run_bench(tmp_path / "r2.txt", tmp_path / "j.jsonl", iso)
+    assert r2.returncode == 0, r2.stderr[-2000:]
+    out2 = (tmp_path / "r2.txt").read_text()
+    assert "quarantined" not in out2
+    assert "ECB, 65536, 1" in out2.replace("C AES-256 ECB", "ECB")
+
+
+def test_unquarantine_requires_journal():
+    r = subprocess.run(
+        [sys.executable, "-m", "our_tree_tpu.harness.bench",
+         "--unquarantine", "ecb:65536"],
+        env=_env(), cwd=ROOT, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 2 and "--journal" in r.stderr
+
+
+# ---------------------------------------------------------------------------
+# Fault-matrix acceptance: kill -> retry -> quarantine, all in the trace.
+# ---------------------------------------------------------------------------
+
+
+def test_fault_matrix_run_traces_kill_retry_quarantine(tmp_path):
+    """The PR's acceptance scenario: OT_TRACE_DIR + OT_FAULTS under
+    --isolate yields a trace where the hung child's span is closed by
+    SIGKILL (orphaned), its retry and the quarantine are events, the
+    report shows per-unit timings, and the Perfetto export loads."""
+    tr = tmp_path / "tr"
+    r = _run_bench(tmp_path / "out.txt", tmp_path / "j.jsonl",
+                   ["--isolate", "--unit-deadline", "15",
+                    "--quarantine-after", "2"],
+                   {"OT_FAULTS": "dispatch_hang:2",
+                    "OT_TRACE_DIR": str(tr)})
+    assert r.returncode == 0, r.stderr[-2000:]
+    run_dir = str(next(tr.iterdir()))
+    run = export.load_run(run_dir)
+    assert not run.violations
+    # Injected faults appear as trace events — exactly the two CHILD
+    # firings (the supervisor's metering is bookkeeping, not injection).
+    inj = _points(tr, "fault-injected")
+    assert [a["point"] for a in inj] == ["dispatch_hang", "dispatch_hang"]
+    # The hung children's dispatch spans never closed: orphans, i.e.
+    # closed by the supervisor's SIGKILL; both attempts are spans.
+    orphan_names = {s.name for s in run.orphans()}
+    assert "timed-call" in orphan_names
+    attempts = [s for s in run.spans.values() if s.name == "unit-attempt"
+                and s.attrs.get("unit") == "ecb:65536"]
+    assert sorted(s.attrs["attempt"] for s in attempts) == [1, 2]
+    assert len(_points(tr, "child-killed")) == 2
+    assert _points(tr, "quarantine") == [{"unit": "ecb:65536", "fails": 2}]
+    # The report renders the story and --check flags the orphans.
+    out = io.StringIO()
+    report.render(run, out=out)
+    text = out.getvalue()
+    assert "quarantined" in text and "closed by kill" in text
+    assert report.main([run_dir, "--check"]) == 2
+    # Perfetto export: loads as JSON, kill evidence in args.
+    path = tmp_path / "trace.json"
+    export.write_chrome_trace(run, str(path))
+    t = json.loads(path.read_text())
+    assert any(e.get("args", {}).get("killed") for e in t["traceEvents"])
+
+
+# ---------------------------------------------------------------------------
+# The bench JSON line's "obs" stamp.
+# ---------------------------------------------------------------------------
+
+
+def test_root_bench_report_stamps_obs_snapshot(tmp_path, monkeypatch, capsys):
+    monkeypatch.setenv("OT_TRACE_DIR", str(tmp_path / "tr"))
+    monkeypatch.setenv("OT_TRACE_RUN", "t-bench")
+    trace.reset_for_tests()
+    try:
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "rootbench_obs", ROOT / "bench.py")
+        rb = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(rb)
+        with trace.span("measure", engine="test"):
+            pass
+        rb._report(16 << 20, "cpu", "test-engine", 0x1, 1.5,
+                   (1.0, 2.0, 3))
+        line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert line["obs"]["run"] == "t-bench"
+        assert line["obs"]["spans"] >= 1
+    finally:
+        trace.reset_for_tests()
