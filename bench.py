@@ -45,7 +45,6 @@ import contextlib
 import functools
 import json
 import os
-import subprocess
 import sys
 import time
 
@@ -86,6 +85,7 @@ faults = load_resilience("faults")
 repolicy = load_resilience("policy")
 degrade = load_resilience("degrade")
 watchdog = load_resilience("watchdog")
+isolate = load_resilience("isolate")
 obstrace = load_obs("trace")
 obstrace.ensure_run()
 
@@ -123,7 +123,11 @@ def _ensure_live_backend() -> None:
     A wedged device tunnel hangs inside PJRT client init — in-process
     watchdog threads can't recover from that (the second jax.devices()
     would block on the same backend lock), so the probe runs in a child
-    process. On timeout/failure the parent — which has not touched any
+    process via the shared runner (resilience.isolate.run_child: wall
+    deadline + process-GROUP SIGKILL, so a PJRT helper grandchild the
+    probe spawned cannot outlive the timeout holding the tunnel — the
+    single-child kill of a plain subprocess timeout could strand exactly
+    that). On timeout/failure the parent — which has not touched any
     backend yet — switches to CPU so the benchmark still reports a line.
     Skipped when CPU is already pinned: no tunnel is involved there, and
     the probe would just double the startup cost. The pin is re-asserted
@@ -156,6 +160,9 @@ def _ensure_live_backend() -> None:
         return
     explicit = "OT_BENCH_INIT_TIMEOUT" in os.environ
 
+    class ProbeFailed(RuntimeError):
+        """The throwaway init-probe child failed or timed out."""
+
     def probe(attempt):
         if attempt.index == 0:
             probe_timeout = max(min(INIT_TIMEOUT_S, _left() - 30.0), 5.0)
@@ -170,20 +177,17 @@ def _ensure_live_backend() -> None:
             _burn(probe_timeout)
             raise faults.InjectedFault(
                 f"init_hang (simulated {probe_timeout:.0f}s probe hang)")
-        subprocess.run(
+        r = isolate.run_child(
             [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=probe_timeout,
-            check=True,
-            stdout=subprocess.DEVNULL,
-            stderr=subprocess.DEVNULL,
-        )
+            timeout_s=probe_timeout, name="pjrt-init-probe")
+        if not r.ok:
+            raise ProbeFailed(f"{r.kind} (rc={r.rc})")
 
     with obstrace.span("init-probe", timeout_s=INIT_TIMEOUT_S):
         repolicy.RetryPolicy(
             attempts=3,
             name="pjrt-init-probe",
-            retry_on=(subprocess.TimeoutExpired,
-                      subprocess.CalledProcessError, faults.InjectedFault),
+            retry_on=(ProbeFailed, faults.InjectedFault),
             stop_when=lambda a: _left() < 0.6 * DEADLINE_S,
             log=lambda a, e: print(
                 f"# accelerator init probe attempt {a.index + 1} failed "

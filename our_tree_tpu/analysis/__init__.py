@@ -1,0 +1,42 @@
+"""otlint — the repo-invariant static-analysis subsystem.
+
+Two layers, one CLI (``python -m our_tree_tpu.analysis``), one findings
+baseline (``analysis/baseline.json`` at the repo root):
+
+* **Layer 1 — AST linter** (``astrules.py``): pluggable rules over the
+  package source encoding the invariants PRs 1-3 established by
+  convention — child processes only via ``resilience.isolate.run_child``
+  (no bare ``subprocess``/``os.fork``), raw device dispatch only under a
+  watchdog guard or inside the designated barrier seam, demotions only
+  through the ``degrade()`` chokepoint (and its ``# degraded`` emission
+  format only fed by the ledger), no wall-clock reads in timed code
+  outside ``obs``, trace span/point attrs statically JSON-serializable,
+  and every ``OT_FAULTS`` seam point drawn from ``faults.KNOWN_POINTS``.
+
+* **Layer 2 — jaxpr auditor** (``jaxpr_audit.py``): traces the public
+  crypto entry points (AES ECB/CBC/CFB/CTR per engine, RC4 prep/crypt,
+  the bitsliced kernels) with abstract inputs and walks the jaxprs with
+  a taint analysis seeded from the key/plaintext arguments. It flags
+  data-dependent ``gather``/``dynamic_slice``/``scatter`` indexed by
+  secret-tainted values (the AES T-table timing channel — the paper's
+  phase-split correctness story depends on the TPU port *not* acquiring
+  one silently; cf. arxiv 1902.05234, which leans on exactly such
+  lookups), argument-derived host↔device transfers and host callbacks
+  inside kernels, dtype widening past 32 bits, and shape-specialized
+  structure (eqn graphs whose size depends on the batch dim — the
+  recompile-storm hazard).
+
+Findings carry ``file:line`` / entry-point provenance, a severity, and
+a STABLE fingerprint (line-number-independent), so a committed baseline
+suppresses known findings and CI gates on *new* ones only
+(``--baseline analysis/baseline.json --fail-on-new``). The baseline is
+not an escape hatch: every entry requires a reason, and the loader
+rejects reasonless ones. See docs/ANALYSIS.md for the rule catalog,
+the taint model, the baseline workflow, and how to add a rule.
+
+Layer 1 is stdlib-only (usable without jax in sight); layer 2 imports
+jax lazily and pins CPU — auditing is structural and must never touch
+a possibly-wedged device tunnel.
+"""
+
+from .findings import Finding  # noqa: F401

@@ -1,0 +1,16 @@
+"""``python -m our_tree_tpu.analysis`` — the otlint CLI (driver.main).
+
+CPU is pinned BEFORE any jax import: the jaxpr audit is structural and
+must never initialize a (possibly wedged) accelerator tunnel just to
+read graphs.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from .driver import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,417 @@
+"""Layer 1: the AST linter — pluggable rules encoding repo invariants.
+
+Each rule is a ``Rule`` instance with an ``id``, a severity, a one-line
+``doc`` (the catalog in docs/ANALYSIS.md is generated from these), and a
+``check(ctx)`` generator yielding ``(node, message)`` pairs. Rules see a
+``FileContext`` (repo-relative path, source, parsed tree) and decide
+scope themselves — e.g. the wallclock rule skips ``obs/`` (the tracer
+owns the epoch clock), the subprocess rule skips ``resilience/isolate.py``
+(the chokepoint *is* the allowed caller).
+
+The rules are deliberately syntactic: they encode *who may say what
+where*, not deep dataflow (that is the jaxpr auditor's job). A guarded
+dispatch is recognized lexically — a call inside a ``with`` whose
+context expression routes through ``watchdog.deadline`` (or a wrapper
+whose name says so, like the root bench's ``_stage_alarm``). That is
+exactly the shape the repo's seams actually have, and a seam that
+launders a dispatch past the lexical check is a code-review problem no
+static analyzer solves.
+
+Stdlib-only except for ``resilience.faults.KNOWN_POINTS`` (itself a
+stdlib-only module) — the fault-point rule checks literals against the
+live registry so the two can never drift.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass
+from typing import Callable, Iterator
+
+from .findings import Finding, anchored
+
+# The live injection-point registry (resilience/faults.py is stdlib-only
+# and import-safe). Falling back to a frozen copy keeps the linter
+# usable on a tree where faults.py itself is being refactored.
+try:
+    from ..resilience.faults import KNOWN_POINTS
+except Exception:  # pragma: no cover - only on a broken tree
+    KNOWN_POINTS = ("init_hang", "dispatch_fail", "build_fail", "lock_busy",
+                    "dispatch_hang", "unit_crash")
+
+
+@dataclass
+class FileContext:
+    relpath: str          #: repo-relative, forward slashes
+    src: str
+    tree: ast.Module
+    lines: list[str]
+
+    def line_text(self, node: ast.AST) -> str:
+        ln = getattr(node, "lineno", 0)
+        if 1 <= ln <= len(self.lines):
+            return self.lines[ln - 1].strip()
+        return ""
+
+    def in_dir(self, *parts: str) -> bool:
+        return self.relpath.startswith(tuple(
+            p if p.endswith("/") else p + "/" for p in parts))
+
+    def is_file(self, *names: str) -> bool:
+        return any(self.relpath.endswith(n) for n in names)
+
+
+@dataclass
+class Rule:
+    id: str
+    severity: str
+    doc: str
+    check: Callable[[FileContext], Iterator[tuple[ast.AST, str]]]
+
+
+def _dotted(node: ast.AST) -> str:
+    """Best-effort dotted-name string of an expression ("jax",
+    "self._jax.block_until_ready", "_sibling('faults').fire", ...)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return f"{_dotted(node.value)}.{node.attr}"
+    if isinstance(node, ast.Call):
+        base = _dotted(node.func)
+        args = ",".join(
+            repr(a.value) if isinstance(a, ast.Constant) else "?"
+            for a in node.args)
+        return f"{base}({args})"
+    return ""
+
+
+def _str_prefix(node: ast.AST) -> str:
+    """The static string prefix of an expression, if any: a constant, the
+    leading constant of an f-string, or of a +-concatenation."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr) and node.values:
+        return _str_prefix(node.values[0])
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        return _str_prefix(node.left)
+    return ""
+
+
+def _mentions(node: ast.AST, needle: str) -> bool:
+    return any(needle in (getattr(n, "id", "") + getattr(n, "attr", ""))
+               for n in ast.walk(node))
+
+
+# ---------------------------------------------------------------------------
+# subprocess-isolate: child processes only via resilience.isolate
+# ---------------------------------------------------------------------------
+
+_SPAWN_CALLS = {"os.fork", "os.forkpty", "os.system", "os.popen",
+                "pty.fork", "os.spawnv", "os.spawnvp", "os.spawnl",
+                "os.spawnlp", "os.posix_spawn"}
+
+
+def _check_subprocess(ctx: FileContext):
+    if ctx.is_file("resilience/isolate.py"):
+        return
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name.split(".")[0] in ("subprocess",
+                                                "multiprocessing"):
+                    yield node, (
+                        f"bare `import {alias.name}`: child processes go "
+                        "through resilience.isolate.run_child (deadline, "
+                        "process-group SIGKILL, retry policy, trace "
+                        "nesting) — not hand-rolled spawns")
+        elif isinstance(node, ast.ImportFrom):
+            if (node.module or "").split(".")[0] in ("subprocess",
+                                                     "multiprocessing"):
+                yield node, (
+                    f"bare `from {node.module} import ...`: route child "
+                    "processes through resilience.isolate.run_child")
+        elif isinstance(node, ast.Call):
+            name = _dotted(node.func)
+            if name in _SPAWN_CALLS:
+                yield node, (
+                    f"`{name}()` spawns outside the isolate chokepoint; "
+                    "use resilience.isolate.run_child")
+
+
+# ---------------------------------------------------------------------------
+# dispatch-watchdog: raw device dispatch only under a watchdog guard
+# ---------------------------------------------------------------------------
+
+#: Receivers that denote the raw jax module (vs a harness backend object,
+#: whose block_until_ready IS the guarded seam).
+_JAX_RECEIVERS = ("jax", "self._jax", "_jax", "jax.experimental")
+_DISPATCH_ATTRS = ("block_until_ready", "device_put")
+#: Seam files where the raw call IS the guarded chokepoint (the barrier
+#: carries the fault + injected-hang seam itself).
+_DISPATCH_SEAM_FILES = ("harness/backends.py",)
+
+
+def _is_guard_cm(expr: ast.AST) -> bool:
+    """A `with` context expression that arms a watchdog deadline: a call
+    whose dotted name ends in `.deadline`/`deadline`, or a wrapper whose
+    name says alarm/deadline (root bench's `_stage_alarm`)."""
+    if not isinstance(expr, ast.Call):
+        return False
+    name = _dotted(expr.func)
+    tail = name.rsplit(".", 1)[-1]
+    return (tail == "deadline" or "alarm" in tail or "deadline" in tail)
+
+
+def _check_dispatch(ctx: FileContext):
+    if ctx.is_file(*_DISPATCH_SEAM_FILES):
+        return
+
+    def visit(node, guarded):
+        if isinstance(node, ast.With):
+            if any(_is_guard_cm(item.context_expr) for item in node.items):
+                guarded = True
+        if isinstance(node, ast.Call):
+            name = _dotted(node.func)
+            recv, _, attr = name.rpartition(".")
+            if (attr in _DISPATCH_ATTRS and recv in _JAX_RECEIVERS
+                    and not guarded):
+                yield node, (
+                    f"raw `{name}()` outside a watchdog guard: wrap the "
+                    "region in `watchdog.deadline(...)` (or route through "
+                    "the harness backend barrier seam) so a wedged "
+                    "transport becomes a DispatchTimeout, not a hang")
+        for child in ast.iter_child_nodes(node):
+            yield from visit(child, guarded)
+
+    yield from visit(ctx.tree, False)
+
+
+# ---------------------------------------------------------------------------
+# degrade-chokepoint: demotions only through degrade()
+# ---------------------------------------------------------------------------
+
+#: Literal degrade kinds that are not "x->y" arrows.
+_DEGRADE_KINDS_EXTRA = ("dispatch-timeout",)
+_DEGRADE_PREFIXES = ("quarantined:",)
+
+#: Call names that emit text (the `# degraded` format check only looks at
+#: these — a string-method call like startswith("# degraded") is not an
+#: emission).
+_EMITTER_TAILS = ("print", "line", "write", "emit", "note", "log",
+                  "info", "warning", "error")
+
+
+def _kind_ok(kind: str) -> bool:
+    if kind in _DEGRADE_KINDS_EXTRA or kind.startswith(_DEGRADE_PREFIXES):
+        return True
+    left, arrow, right = kind.partition("->")
+    return bool(arrow and left and right and " " not in kind)
+
+
+def _check_degrade(ctx: FileContext):
+    in_degrade_mod = ctx.is_file("resilience/degrade.py")
+    for node in ast.walk(ctx.tree):
+        # (a) nobody reaches into the ledger's private state
+        if (not in_degrade_mod and isinstance(node, ast.Attribute)
+                and node.attr == "_EVENTS"):
+            yield node, ("direct access to the degrade ledger's private "
+                         "state; use degrade()/events()/detail()")
+        if not isinstance(node, ast.Call):
+            continue
+        name = _dotted(node.func)
+        # (b) a "# degraded" line not fed by the ledger masquerades as it
+        if (not in_degrade_mod
+                and name.rsplit(".", 1)[-1] in _EMITTER_TAILS):
+            for arg in node.args:
+                if (_str_prefix(arg).startswith("# degraded")
+                        and not _mentions(arg, "degrade")):
+                    yield node, (
+                        "emits a `# degraded` line not derived from the "
+                        "resilience.degrade ledger — record the demotion "
+                        "with degrade() and report events()")
+        # (c) degrade() called with a malformed kind literal
+        if name.rsplit(".", 1)[-1] == "degrade" and node.args:
+            first = node.args[0]
+            if (isinstance(first, ast.Constant)
+                    and isinstance(first.value, str)
+                    and not _kind_ok(first.value)):
+                yield node, (
+                    f"degrade kind {first.value!r} is not a known form "
+                    "(an `from->to` arrow, `dispatch-timeout`, or "
+                    "`quarantined:<unit>`) — the ledger's consumers "
+                    "parse these")
+
+
+# ---------------------------------------------------------------------------
+# wallclock: no time.time() outside obs/ (timed regions use monotonic
+# clocks; epoch time belongs to the tracer and to mtime comparisons)
+# ---------------------------------------------------------------------------
+
+
+def _check_wallclock(ctx: FileContext):
+    if ctx.in_dir("obs", "our_tree_tpu/obs"):
+        return
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call):
+            name = _dotted(node.func)
+            if name in ("time.time", "time.time_ns"):
+                yield node, (
+                    f"`{name}()` reads the wall clock: timed regions and "
+                    "budgets use time.monotonic()/perf_counter() (NTP "
+                    "steps corrupt durations); epoch time belongs to "
+                    "obs.trace and to file-mtime comparisons")
+
+
+# ---------------------------------------------------------------------------
+# trace-attrs: span/point/counter/gauge attrs statically JSON-serializable
+# ---------------------------------------------------------------------------
+
+_TRACE_METHODS = ("span", "point", "counter", "gauge")
+_TRACE_RECEIVERS = ("trace", "_trace", "trace_mod", "obstrace",
+                    "tr", "t", "tt", "m")
+
+
+def _json_unsafe(node: ast.AST) -> str | None:
+    """The reason an attr value is provably not JSON-clean, or None.
+    Names/calls/arithmetic pass (runtime values are the tracer's
+    default=repr problem); only structurally-wrong literals flag."""
+    if isinstance(node, ast.Constant):
+        if isinstance(node.value, bytes):
+            return "bytes literal"
+        if isinstance(node.value, complex):
+            return "complex literal"
+        if node.value is Ellipsis:
+            return "Ellipsis"
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return "set (JSON has no set type)"
+    if isinstance(node, ast.Lambda):
+        return "lambda"
+    for child in ast.iter_child_nodes(node):
+        why = _json_unsafe(child)
+        if why:
+            return why
+    return None
+
+
+def _check_trace_attrs(ctx: FileContext):
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if not (isinstance(func, ast.Attribute)
+                and func.attr in _TRACE_METHODS):
+            continue
+        recv = _dotted(func.value)
+        if not (recv in _TRACE_RECEIVERS or "trace" in recv):
+            continue
+        for kw in node.keywords:
+            if kw.arg is None or kw.value is None:
+                continue
+            why = _json_unsafe(kw.value)
+            if why:
+                yield node, (
+                    f"trace attr `{kw.arg}` is not statically "
+                    f"JSON-serializable ({why}); the tracer would stringify "
+                    "it with repr(), making the event unreadable to "
+                    "obs.report")
+
+
+# ---------------------------------------------------------------------------
+# fault-points: OT_FAULTS seam names drawn from faults.KNOWN_POINTS
+# ---------------------------------------------------------------------------
+
+_FAULT_METHODS = ("fire", "check", "consume", "remaining", "injected_hang")
+
+
+def _check_fault_points(ctx: FileContext):
+    if ctx.is_file("resilience/faults.py"):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        func = node.func
+        if not (isinstance(func, ast.Attribute)
+                and func.attr in _FAULT_METHODS):
+            continue
+        recv = _dotted(func.value)
+        if not ("fault" in recv or "watchdog" in recv):
+            continue
+        first = node.args[0]
+        if (isinstance(first, ast.Constant)
+                and isinstance(first.value, str)
+                and first.value not in KNOWN_POINTS):
+            yield node, (
+                f"injection point {first.value!r} is not in "
+                f"faults.KNOWN_POINTS {tuple(KNOWN_POINTS)}: an "
+                "unregistered seam silently never fires, making fault "
+                "CI vacuously green — register it in faults.py first")
+
+
+RULES: tuple[Rule, ...] = (
+    Rule("subprocess-isolate", "error",
+         "Child processes only via resilience.isolate.run_child — no bare "
+         "subprocess/multiprocessing/os.fork outside resilience/isolate.py.",
+         _check_subprocess),
+    Rule("dispatch-watchdog", "error",
+         "Raw jax device dispatch (block_until_ready / device_put) only "
+         "inside a watchdog.deadline guard or the harness barrier seam.",
+         _check_dispatch),
+    Rule("degrade-chokepoint", "error",
+         "Demotions only through resilience.degrade(): no private-ledger "
+         "access, no hand-rolled `# degraded` lines, kinds well-formed.",
+         _check_degrade),
+    Rule("wallclock", "warning",
+         "No time.time()/time_ns() outside obs/ — durations use monotonic "
+         "clocks; epoch time is the tracer's and mtime comparisons'.",
+         _check_wallclock),
+    Rule("trace-attrs", "error",
+         "span/point/counter/gauge attrs must be statically "
+         "JSON-serializable (no bytes/set/lambda/complex literals).",
+         _check_trace_attrs),
+    Rule("fault-points", "error",
+         "String literals passed to faults.fire/check/consume/remaining "
+         "and watchdog.injected_hang must be registered KNOWN_POINTS.",
+         _check_fault_points),
+)
+
+
+def lint_file(path: str, relpath: str) -> list[Finding]:
+    """Run every rule over one file; unparseable files yield one
+    finding (a syntax error in the package is itself a violation)."""
+    with open(path, encoding="utf-8") as fh:
+        src = fh.read()
+    try:
+        tree = ast.parse(src, filename=relpath)
+    except SyntaxError as e:
+        return [Finding("parse", "error", f"does not parse: {e.msg}",
+                        relpath, e.lineno or 0, anchor="syntax-error")]
+    ctx = FileContext(relpath, src, tree, src.splitlines())
+    out: list[Finding] = []
+    for rule in RULES:
+        for node, message in rule.check(ctx):
+            out.append(Finding(
+                rule.id, rule.severity, message, relpath,
+                getattr(node, "lineno", 0), anchor=ctx.line_text(node)))
+    return out
+
+
+def lint_paths(paths: list[str], repo_root: str) -> list[Finding]:
+    """Lint every .py under ``paths`` (files or directories), findings
+    keyed by repo-root-relative path."""
+    files: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames
+                               if d != "__pycache__" and not d.startswith(".")]
+                files.extend(os.path.join(dirpath, f)
+                             for f in sorted(filenames) if f.endswith(".py"))
+        elif p.endswith(".py"):
+            files.append(p)
+    out: list[Finding] = []
+    for f in sorted(set(files)):
+        rel = os.path.relpath(os.path.abspath(f),
+                              os.path.abspath(repo_root)).replace(os.sep, "/")
+        out.extend(lint_file(f, rel))
+    return anchored(out)

@@ -1,0 +1,149 @@
+"""The otlint CLI driver: collect findings, apply the baseline, report.
+
+``python -m our_tree_tpu.analysis`` with no arguments lints the package
+plus the repo-root ``bench.py`` (the production entry that bare-loads
+the resilience modules) and audits the default engine set. The CI
+invocation is::
+
+    python -m our_tree_tpu.analysis --baseline analysis/baseline.json \\
+        --fail-on-new
+
+which exits 1 on any finding not fingerprint-matched by the committed
+baseline — new violations gate, known ones report as suppressed, and
+STALE baseline entries (fixed violations) are named so the file cannot
+rot. See docs/ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from . import astrules, baseline as baseline_mod
+from .findings import SEVERITIES
+
+
+def _repo_root() -> str:
+    """The repo root: parent of the our_tree_tpu package directory."""
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.dirname(pkg)
+
+
+def _default_paths(root: str) -> list[str]:
+    paths = [os.path.join(root, "our_tree_tpu")]
+    bench = os.path.join(root, "bench.py")
+    if os.path.exists(bench):
+        paths.append(bench)
+    return paths
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m our_tree_tpu.analysis",
+        description="otlint: repo-invariant AST linter + jaxpr auditor "
+                    "(docs/ANALYSIS.md)")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: the our_tree_tpu "
+                         "package + repo-root bench.py)")
+    ap.add_argument("--baseline", default=None, metavar="PATH",
+                    help="suppress findings fingerprint-matched by this "
+                         "baseline file (analysis/baseline.json)")
+    ap.add_argument("--fail-on-new", action="store_true",
+                    help="exit 1 when any non-baselined finding exists "
+                         "(the CI gate)")
+    ap.add_argument("--write-baseline", default=None, metavar="PATH",
+                    help="write the current findings as a baseline, "
+                         "preserving reasons from --baseline; new entries "
+                         "get a TODO reason the loader rejects until "
+                         "justified")
+    ap.add_argument("--no-ast", action="store_true",
+                    help="skip layer 1 (the AST linter)")
+    ap.add_argument("--no-jaxpr", action="store_true",
+                    help="skip layer 2 (the jaxpr auditor) — the AST layer "
+                         "then runs without jax in sight")
+    ap.add_argument("--engines", default=None,
+                    help="comma list of engines for the jaxpr audit "
+                         "(default: jnp,bitslice; pallas engines trace "
+                         "too but add wall time)")
+    ap.add_argument("--format", default="text", choices=("text", "json"))
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule in astrules.RULES:
+            print(f"{rule.id} ({rule.severity}): {rule.doc}")
+        from .jaxpr_audit import DEFAULT_ENGINES
+
+        print("constant-time (error): [jaxpr] no gather/dynamic_slice/"
+              "scatter indexed by secret-tainted values.")
+        print("kernel-transfer (error): [jaxpr] no argument-derived "
+              "device_put or host callbacks inside traced kernels.")
+        print("dtype-widening (warning): [jaxpr] no avals wider than 32 "
+              "bits.")
+        print("shape-unroll (error): [jaxpr] traced graph size must not "
+              "depend on the batch dim.")
+        print(f"default audited engines: {', '.join(DEFAULT_ENGINES)}")
+        return 0
+
+    root = _repo_root()
+    findings = []
+    if not args.no_ast:
+        paths = ([os.path.abspath(p) for p in args.paths]
+                 if args.paths else _default_paths(root))
+        findings += astrules.lint_paths(paths, root)
+    if not args.no_jaxpr:
+        from . import jaxpr_audit
+
+        engines = (tuple(e for e in args.engines.split(",") if e)
+                   if args.engines else jaxpr_audit.DEFAULT_ENGINES)
+        findings += jaxpr_audit.audit(engines)
+
+    stale: list[str] = []
+    base: dict[str, dict] = {}
+    if args.baseline and os.path.exists(args.baseline):
+        try:
+            base = baseline_mod.load(args.baseline)
+        except baseline_mod.BaselineError as e:
+            print(f"BASELINE ERROR: {e}", file=sys.stderr)
+            return 2
+        stale = baseline_mod.apply(findings, base)
+
+    if args.write_baseline:
+        n = baseline_mod.write(args.write_baseline, findings, base)
+        print(f"# wrote {n} baseline entr{'y' if n == 1 else 'ies'} to "
+              f"{args.write_baseline}", file=sys.stderr)
+
+    new = [f for f in findings if not f.baselined]
+    known = [f for f in findings if f.baselined]
+    order = {s: i for i, s in enumerate(SEVERITIES)}
+    key = lambda f: (order.get(f.severity, 9), f.path, f.line, f.rule)
+
+    if args.format == "json":
+        print(json.dumps({
+            "new": [f.to_json() for f in sorted(new, key=key)],
+            "baselined": [f.to_json() for f in sorted(known, key=key)],
+            "stale_baseline": stale,
+        }, indent=2))
+    else:
+        for f in sorted(new, key=key) + sorted(known, key=key):
+            print(f.render())
+        for fp in stale:
+            entry = base.get(fp, {})
+            print(f"# stale baseline entry {fp} "
+                  f"({entry.get('location', '?')}, {entry.get('rule', '?')})"
+                  " — the violation is gone; delete the entry",
+                  file=sys.stderr)
+        print(f"# otlint: {len(new)} new finding(s), {len(known)} "
+              f"baselined, {len(stale)} stale baseline entr"
+              f"{'y' if len(stale) == 1 else 'ies'}", file=sys.stderr)
+
+    if new and args.fail_on_new:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

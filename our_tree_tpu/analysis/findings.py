@@ -1,0 +1,72 @@
+"""The one finding shape both analyzer layers emit.
+
+A finding's identity is its **fingerprint** — a stable hash of the rule
+plus a location anchor that survives line-number drift: AST findings
+anchor on the normalized source *text* of the flagged line (plus an
+occurrence index for textually identical lines), jaxpr findings on the
+(entry point, primitive) pair. Line numbers ride along for humans and
+go stale harmlessly; the baseline matches by fingerprint only.
+
+Stdlib-only: layer 1 and the baseline machinery must load without jax.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+
+#: Severities, most severe first (report ordering).
+SEVERITIES = ("error", "warning")
+
+
+@dataclass
+class Finding:
+    rule: str          #: rule id, e.g. "constant-time" or "subprocess-isolate"
+    severity: str      #: "error" | "warning"
+    message: str       #: one human line naming the violation
+    path: str          #: repo-relative file, or "<jaxpr>" for layer 2
+    line: int = 0      #: 1-based; 0 = no source location (jaxpr findings
+                       #: put any recovered file:line in the message)
+    anchor: str = ""   #: stable identity component (see module docstring)
+    layer: str = "ast"  #: "ast" | "jaxpr"
+    baselined: bool = field(default=False, compare=False)
+    baseline_reason: str = field(default="", compare=False)
+
+    @property
+    def fingerprint(self) -> str:
+        h = hashlib.sha1(
+            f"{self.layer}|{self.rule}|{self.path}|{self.anchor}"
+            .encode()).hexdigest()[:16]
+        return f"{self.layer}:{self.rule}:{h}"
+
+    @property
+    def location(self) -> str:
+        if self.layer == "jaxpr":
+            return f"<jaxpr:{self.anchor}>"
+        return f"{self.path}:{self.line}" if self.line else self.path
+
+    def render(self) -> str:
+        tag = " [baselined]" if self.baselined else ""
+        return (f"{self.location}: {self.severity}: {self.rule}: "
+                f"{self.message}{tag}")
+
+    def to_json(self) -> dict:
+        return {
+            "fingerprint": self.fingerprint, "rule": self.rule,
+            "severity": self.severity, "message": self.message,
+            "location": self.location, "layer": self.layer,
+        }
+
+
+def anchored(findings: list[Finding]) -> list[Finding]:
+    """Disambiguate findings whose (rule, path, anchor) collide by
+    suffixing an occurrence index — two textually identical violations
+    in one file stay two baseline entries, in source order."""
+    seen: dict[tuple, int] = {}
+    for f in sorted(findings, key=lambda f: (f.path, f.line)):
+        key = (f.layer, f.rule, f.path, f.anchor)
+        n = seen.get(key, 0)
+        seen[key] = n + 1
+        if n:
+            f.anchor = f"{f.anchor}#{n}"
+    return findings

@@ -1,0 +1,381 @@
+"""Layer 2: the jaxpr auditor — trace the crypto entry points, walk the
+graph, taint-check the lookups.
+
+The paper's phase-split design stays *correct and constant-time* on TPU
+only as long as the kernels keep properties nothing type-checks:
+
+* **Constant time.** The classic GPU-AES formulation leans on
+  data-dependent T-table lookups (arxiv 1902.05234) — a timing channel
+  on any hardware with an addressed memory path. The bitsliced engines
+  exist precisely to avoid it; this auditor proves they still do. A
+  taint analysis seeded from the key/plaintext arguments propagates
+  through every equation; a ``gather``/``dynamic_slice``/``scatter``
+  whose *index* operand is tainted is a secret-dependent lookup.
+  Constant-index permutations (bitslice's ShiftRows ``x[SR_PERM]``) and
+  iota-derived addressing stay untainted and pass.
+
+* **No silent transfers.** A ``device_put`` of an argument-derived value
+  mid-kernel, or any host callback, serializes the data-parallel phase
+  through the host. Constant staging (closed-over table constants) is
+  expected and exempt.
+
+* **No dtype widening.** Avals wider than 32 bits mean an accidental
+  x64 promotion — 2x HBM on every stream for a cipher defined on u8/u32.
+
+* **No shape-specialized structure.** Each entry is traced at two batch
+  sizes; if the equation count differs, Python-level code is unrolling
+  over the data axis — the per-size recompile-storm hazard (one compile
+  per shape is JAX's contract; O(N) graph growth per shape is not).
+
+jax is imported lazily and pinned to CPU (``JAX_PLATFORMS``): auditing
+is structural, runs in CI without an accelerator, and must never touch
+a possibly-wedged device tunnel.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .findings import Finding
+
+#: Default engine set audited by the CLI. The Pallas engines can be added
+#: with --engines (they trace through pallas_call on CPU), but the two
+#: here are the correctness oracle and the TPU throughput path — the pair
+#: the constant-time story is really about.
+DEFAULT_ENGINES = ("jnp", "bitslice")
+
+#: primitive -> which invar positions are *index* operands.
+_INDEXED = {
+    "gather": lambda n: (1,),
+    "dynamic_slice": lambda n: range(1, n),
+    "dynamic_update_slice": lambda n: range(2, n),
+    "scatter": lambda n: (1,),
+    "scatter-add": lambda n: (1,),
+    "scatter-mul": lambda n: (1,),
+    "scatter-min": lambda n: (1,),
+    "scatter-max": lambda n: (1,),
+    "take": lambda n: (1,),
+}
+
+_CALLBACKS = ("pure_callback", "io_callback", "debug_callback", "callback")
+
+#: Sub-jaxpr invar mapping is positional for these primitives (cond's
+#: branches take invars[1:]); anything else gets the conservative
+#: any-tainted-in -> all-tainted-in treatment.
+_POSITIONAL = ("pjit", "closed_call", "core_call", "scan", "xla_call",
+               "custom_jvp_call", "custom_vjp_call", "remat", "checkpoint")
+
+
+class _EntryAudit:
+    """Taint walk + structural checks over one traced entry point."""
+
+    def __init__(self, entry_name: str):
+        self.entry = entry_name
+        self.findings: list[Finding] = []
+        self._flagged: set[tuple[str, str]] = set()
+        self.eqn_count = 0
+
+    # -- findings ----------------------------------------------------------
+    def _add(self, rule: str, severity: str, prim: str, message: str):
+        if (rule, prim) in self._flagged:
+            return  # one finding per (rule, primitive) per entry
+        self._flagged.add((rule, prim))
+        self.findings.append(Finding(
+            rule, severity, f"{self.entry}: {message}",
+            path="<jaxpr>", anchor=f"{self.entry}:{prim}", layer="jaxpr"))
+
+    def _where(self, eqn) -> str:
+        try:
+            from jax._src import source_info_util
+            fr = source_info_util.user_frame(eqn.source_info)
+            if fr is not None:
+                parts = fr.file_name.replace(os.sep, "/").rsplit("/", 3)
+                return f" at {'/'.join(parts[-2:])}:{fr.start_line}"
+        except Exception:
+            pass
+        return ""
+
+    # -- the walk ----------------------------------------------------------
+    def walk(self, closed, in_taint: list[bool]) -> list[bool]:
+        """Walk ``closed`` (a ClosedJaxpr) with per-invar taint; returns
+        per-outvar taint. Constvars are untainted (closed-over tables)."""
+        import jax
+
+        jaxpr = closed.jaxpr
+        taint: dict[int, bool] = {}
+
+        def get(v) -> bool:
+            return (False if isinstance(v, jax.core.Literal)
+                    else taint.get(id(v), False))
+
+        def put(v, t: bool) -> None:
+            if not isinstance(v, jax.core.Literal):
+                taint[id(v)] = t
+
+        for v, t in zip(jaxpr.invars, in_taint):
+            put(v, t)
+        for v in jaxpr.constvars:
+            put(v, False)
+
+        for eqn in jaxpr.eqns:
+            self.eqn_count += 1
+            prim = eqn.primitive.name
+            ins = [get(v) for v in eqn.invars]
+            any_in = any(ins)
+
+            idx_of = _INDEXED.get(prim)
+            if idx_of is not None:
+                if any(ins[i] for i in idx_of(len(eqn.invars))):
+                    self._add(
+                        "constant-time", "error", prim,
+                        f"data-dependent `{prim}` indexed by a "
+                        f"secret-tainted value{self._where(eqn)} — a "
+                        "memory-address timing channel (the T-table "
+                        "hazard); use a circuit/bitsliced formulation")
+            elif prim == "device_put":
+                if any_in:
+                    self._add(
+                        "kernel-transfer", "error", prim,
+                        f"argument-derived `device_put` inside the traced "
+                        f"kernel{self._where(eqn)} — a host<->device "
+                        "transfer that serializes the parallel phase "
+                        "(constant table staging is exempt)")
+            elif any(prim.startswith(cb) for cb in _CALLBACKS):
+                self._add(
+                    "kernel-transfer", "error", prim,
+                    f"host callback `{prim}` inside the traced "
+                    f"kernel{self._where(eqn)}")
+
+            for v in eqn.outvars:
+                aval = getattr(v, "aval", None)
+                dt = getattr(aval, "dtype", None)
+                if (dt is not None and dt.kind in "iuf"
+                        and dt.itemsize > 4):
+                    self._add(
+                        "dtype-widening", "warning", str(dt),
+                        f"`{prim}` produces {dt}{self._where(eqn)} — "
+                        "widening past 32 bits doubles HBM traffic for a "
+                        "cipher defined on u8/u32 (check for x64 "
+                        "promotion)")
+
+            out_taint = self._sub_jaxprs(eqn, ins)
+            if out_taint is None:
+                out_taint = [any_in] * len(eqn.outvars)
+            for v, t in zip(eqn.outvars, out_taint):
+                put(v, t)
+
+        return [get(v) for v in jaxpr.outvars]
+
+    def _sub_jaxprs(self, eqn, ins: list[bool]):
+        """Recurse into any sub-jaxpr params; returns eqn out-taint when
+        derivable, else None (caller applies the conservative rule)."""
+        prim = eqn.primitive.name
+        subs = []
+        for val in eqn.params.values():
+            if hasattr(val, "jaxpr") and hasattr(val, "consts"):
+                subs.append(val)  # ClosedJaxpr
+            elif isinstance(val, (list, tuple)):
+                subs.extend(v for v in val
+                            if hasattr(v, "jaxpr") and hasattr(v, "consts"))
+        if not subs:
+            return None
+        if prim == "scan" and len(subs) == 1:
+            return self._scan_fixpoint(eqn, subs[0], ins)
+        results = []
+        for sub in subs:
+            n = len(sub.jaxpr.invars)
+            if prim in _POSITIONAL and len(ins) == n:
+                sub_in = list(ins)
+            elif prim == "cond" and len(ins) == n + 1:
+                sub_in = list(ins[1:])
+            else:
+                sub_in = [any(ins)] * n
+            results.append(self.walk(sub, sub_in))
+        out = results[0]
+        if (len(subs) == 1 and prim in _POSITIONAL + ("cond",)
+                and len(out) == len(eqn.outvars)):
+            return out
+        flat_any = any(t for r in results for t in r) or any(ins)
+        return [flat_any] * len(eqn.outvars)
+
+    def _scan_fixpoint(self, eqn, sub, ins: list[bool]):
+        """Taint a scan body to FIXPOINT on the carry: a secret that
+        enters the loop state only after iteration 1 (carry-out feeding
+        carry-in) must still taint lookups indexed by the carry — a
+        single positional walk would audit the body under the *initial*
+        carry's taint only and miss exactly the secret-evolves-the-state
+        shape RC4's PRGA has. The loop monotonically adds taint to the
+        carry slots, so it terminates in <= num_carry + 1 walks; the
+        body's eqn count is booked once (re-walks rewind the counter —
+        the shape-unroll comparison must not depend on taint iterations).
+        """
+        num_consts = eqn.params.get("num_consts", 0)
+        num_carry = eqn.params.get("num_carry", 0)
+        n = len(sub.jaxpr.invars)
+        sub_in = list(ins) if len(ins) == n else [any(ins)] * n
+        while True:
+            count_before = self.eqn_count
+            out = self.walk(sub, sub_in)  # body outvars = carry + ys
+            changed = False
+            for i in range(min(num_carry, len(out))):
+                j = num_consts + i
+                if j < len(sub_in) and out[i] and not sub_in[j]:
+                    sub_in[j] = True
+                    changed = True
+            if not changed:
+                return (out if len(out) == len(eqn.outvars)
+                        else [any(out) or any(ins)] * len(eqn.outvars))
+            self.eqn_count = count_before
+
+
+def _flat_secret_mask(args, secret_positions) -> list[bool]:
+    """Per-flat-invar secret mask from per-argument secret positions."""
+    import jax
+
+    mask: list[bool] = []
+    for i, a in enumerate(args):
+        leaves = jax.tree_util.tree_leaves(a)
+        mask.extend([i in secret_positions] * len(leaves))
+    return mask
+
+
+def _entries(engines):
+    """(name, fn, args_builder(nblocks), secret_arg_positions) for every
+    audited public entry point. ``nblocks`` parameterizes the batch dim so
+    the shape-specialization check can trace at two sizes."""
+    import numpy as np
+
+    from ..models import aes, arc4, rc4
+    from ..ops import bitslice
+
+    NR, RK_WORDS = 10, 44  # AES-128
+
+    def w(n):
+        return np.zeros((n, 4), np.uint32)
+
+    def rk(_n):
+        return np.zeros(RK_WORDS, np.uint32)
+
+    def iv(_n):
+        return np.zeros(4, np.uint32)
+
+    out = []
+    for eng in engines:
+        out += [
+            (f"aes-ecb-enc[{eng}]",
+             lambda ww, kk, e=eng: aes.ecb_encrypt_words(ww, kk, NR, e),
+             (w, rk), {0, 1}),
+            (f"aes-ecb-dec[{eng}]",
+             lambda ww, kk, e=eng: aes.ecb_decrypt_words(ww, kk, NR, e),
+             (w, rk), {0, 1}),
+            (f"aes-ctr[{eng}]",
+             lambda ww, cc, kk, e=eng: aes.ctr_crypt_words(ww, cc, kk,
+                                                           NR, e),
+             (w, iv, rk), {0, 2}),  # the counter/nonce is public
+            (f"aes-cbc-dec[{eng}]",
+             lambda ww, vv, kk, e=eng: aes.cbc_decrypt_words(ww, vv, kk,
+                                                             NR, e),
+             (w, iv, rk), {0, 2}),
+            (f"aes-cfb-dec[{eng}]",
+             lambda ww, vv, kk, e=eng: aes.cfb128_decrypt_words(ww, vv, kk,
+                                                                NR, e),
+             (w, iv, rk), {0, 2}),
+        ]
+    # The chained encrypt modes run the fused T-table scan body regardless
+    # of engine (models/aes.py registration note) — audited once.
+    out += [
+        ("aes-cbc-enc[scan]",
+         lambda ww, vv, kk: aes.cbc_encrypt_words(ww, vv, kk, NR),
+         (w, iv, rk), {0, 2}),
+        ("aes-cfb-enc[scan]",
+         lambda ww, vv, kk: aes.cfb128_encrypt_words(ww, vv, kk, NR),
+         (w, iv, rk), {0, 2}),
+        # RC4: prep is the sequential phase (its PRGA is state-indexed by
+        # definition — the audit documents it, the baseline reasons it);
+        # crypt is the data-parallel XOR phase and MUST come out clean —
+        # that cleanliness is the paper's phase-split story.
+        ("rc4-prep[scan]",
+         lambda st: arc4.keystream_scan(st, 128),
+         (lambda n: (np.uint32(0), np.uint32(0),
+                     np.zeros(256, np.uint32)),), {0}),
+        ("rc4-crypt[xor]",
+         arc4.crypt,
+         (lambda n: np.zeros(16 * n, np.uint8),
+          lambda n: np.zeros(16 * n, np.uint8)), {0, 1}),
+        ("rc4-fused[scan]",
+         rc4._fused_scan,
+         (lambda n: (np.uint32(0), np.uint32(0),
+                     np.zeros(256, np.uint32)),
+          lambda n: np.zeros(16 * n, np.uint32)), {0, 1}),
+        # The bitsliced kernels audited directly (not only through the
+        # mode dispatchers): the acceptance bar for the whole layer.
+        ("bitslice-enc[kernel]",
+         lambda ww, kk: bitslice.encrypt_words(ww, kk, NR),
+         (w, rk), {0, 1}),
+        ("bitslice-dec[kernel]",
+         lambda ww, kk: bitslice.decrypt_words(ww, kk, NR),
+         (w, rk), {0, 1}),
+    ]
+    return out
+
+
+#: The two batch sizes the shape-specialization check compares. Multiples
+#: of 32 blocks: the bitsliced lane packing and the scan unroll factors
+#: both divide them, so a remainder-handling eqn can't alias as "the
+#: graph grew with N".
+_N_BASE, _N_ALT = 32, 64
+
+
+def audit(engines=DEFAULT_ENGINES) -> list[Finding]:
+    """Trace and audit every entry; returns the combined findings.
+
+    An entry that fails to trace is itself a finding (``audit-error``):
+    the auditor going blind on an entry point must fail CI, not pass it.
+    """
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from ..utils.platform import pin_cpu_if_requested
+
+    pin_cpu_if_requested()
+    import jax
+
+    findings: list[Finding] = []
+    for name, fn, builders, secrets in _entries(tuple(engines)):
+        try:
+            args = tuple(b(_N_BASE) for b in builders)
+            closed = jax.make_jaxpr(fn)(*args)
+            au = _EntryAudit(name)
+            au.walk(closed, _flat_secret_mask(args, secrets))
+            findings.extend(au.findings)
+
+            alt = _EntryAudit(name)
+            alt_args = tuple(b(_N_ALT) for b in builders)
+            alt.walk(jax.make_jaxpr(fn)(*alt_args),
+                     _flat_secret_mask(alt_args, secrets))
+            if alt.eqn_count != au.eqn_count:
+                findings.append(Finding(
+                    "shape-unroll", "error",
+                    f"{name}: traced graph size depends on the batch dim "
+                    f"({au.eqn_count} eqns at N={_N_BASE} vs "
+                    f"{alt.eqn_count} at N={_N_ALT}) — Python-level "
+                    "unrolling over data; every size recompiles an O(N) "
+                    "graph (recompile storm)",
+                    path="<jaxpr>", anchor=f"{name}:shape", layer="jaxpr"))
+        except Exception as e:  # noqa: BLE001 - any trace failure is data
+            findings.append(Finding(
+                "audit-error", "error",
+                f"{name}: entry failed to trace "
+                f"({type(e).__name__}: {str(e)[:200]}) — the auditor is "
+                "blind on this entry; fix the entry or the audit registry",
+                path="<jaxpr>", anchor=f"{name}:trace", layer="jaxpr"))
+    return findings
+
+
+def audit_fn(name: str, fn, args, secret_positions) -> list[Finding]:
+    """Audit one callable directly (tests / ad-hoc use): trace ``fn`` at
+    ``args`` with ``secret_positions`` (argument indices) tainted."""
+    import jax
+
+    closed = jax.make_jaxpr(fn)(*args)
+    au = _EntryAudit(name)
+    au.walk(closed, _flat_secret_mask(args, set(secret_positions)))
+    return au.findings

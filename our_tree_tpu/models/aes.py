@@ -195,7 +195,18 @@ def _engine_compile_ok(eng: str, rank_key: str) -> bool:
             _COMPILE_OK[eng] = False
             return False
         try:
-            jax.block_until_ready(lowered.compile()(*args))
+            # The probe's compile+execute is a real device dispatch and
+            # rides the shared watchdog like every other one (otlint
+            # dispatch-watchdog): disarmed when OT_DISPATCH_DEADLINE is
+            # unset, and a wedged first-contact compile otherwise becomes
+            # a DispatchTimeout (caught below — the engine is skipped
+            # process-locally, same as any other probe failure).
+            from ..resilience import watchdog as _watchdog
+
+            with _watchdog.deadline(
+                    _watchdog.default_deadline_s(),
+                    what=f"engine compile probe {eng}:{label}"):
+                jax.block_until_ready(lowered.compile()(*args))
         except Exception as e:
             print(f"# engine {eng}:{label}: lowered but failed to "
                   f"compile/execute ({type(e).__name__}); skipping for "

@@ -13,8 +13,23 @@ Flags:
 * ``--check``       exit nonzero on schema violations or orphaned spans
                     (the CI gate: a healthy traced sweep must produce a
                     clean, fully-closed stream).
+* ``--expected-orphans NAMES``  comma list of span NAMES whose orphans
+                    are expected (a FAULTED run's check gate: a
+                    dispatch_hang rehearsal SIGKILLs a child inside
+                    unit/row/timed-call, and those three orphans are the
+                    scenario working as designed). Each listed name
+                    licenses exactly ONE orphan — repeat a name to allow
+                    more — so both an orphan with an unlisted name AND a
+                    second orphan reusing a listed one (two killed
+                    children where the rehearsal kills one) fail
+                    ``--check``.
 * ``--trace-json P``  also write the Chrome/Perfetto export to P.
 * ``--top N``       size of the slowest-span table (default 10).
+
+When any span carries an ``engine`` attr (the repo-root bench's probe
+and measure spans do), the report adds a per-engine device-time table —
+the trace-side answer to "which engine did this run actually spend its
+device time in" that the probe's stderr GB/s lines only hint at.
 
 ``<run-dir>`` is ``$OT_TRACE_DIR/<run-id>``; passing ``$OT_TRACE_DIR``
 itself picks the newest run inside it (and says so).
@@ -62,20 +77,27 @@ def _unit_of(run: export.Run, sp: export.SpanRec):
     return sp.attrs.get("unit") or run.ancestor_attr(sp, "unit")
 
 
+def _nested_in_named_span(run: export.Run, sp: export.SpanRec,
+                          names: tuple) -> bool:
+    """Whether a span named in ``names`` encloses ``sp`` — only the
+    outermost span of a chain may count toward a time sum."""
+    seen = set()
+    cur = run.spans.get(sp.parent) if sp.parent else None
+    while cur is not None and cur.id not in seen:
+        if cur.name in names:
+            return True
+        seen.add(cur.id)
+        cur = run.spans.get(cur.parent) if cur.parent else None
+    return False
+
+
 def _nested_in_device_span(run: export.Run, sp: export.SpanRec) -> bool:
     """Whether another device-seam span encloses ``sp``. The e2e timing
     path opens a "barrier" span INSIDE its "timed-call" span (the timed
     region is `block_until_ready(run(...))`), so summing both would
     book the same wall time twice — only the outermost device span of a
     chain counts toward a unit's device_s."""
-    seen = set()
-    cur = run.spans.get(sp.parent) if sp.parent else None
-    while cur is not None and cur.id not in seen:
-        if cur.name in DEVICE_SPANS:
-            return True
-        seen.add(cur.id)
-        cur = run.spans.get(cur.parent) if cur.parent else None
-    return False
+    return _nested_in_named_span(run, sp, DEVICE_SPANS)
 
 
 def _table(rows: list[list[str]], header: list[str], out) -> None:
@@ -87,7 +109,8 @@ def _table(rows: list[list[str]], header: list[str], out) -> None:
                   + "\n")
 
 
-def render(run: export.Run, top: int = 10, out=sys.stdout) -> None:
+def render(run: export.Run, top: int = 10, out=sys.stdout,
+           expected_orphans: dict | None = None) -> None:
     run_id = next((h.get("run", "?") for h in run.procs.values()), "?")
     run_end = run.t1 if run.t1 is not None else 0
     orphans = sorted(run.orphans(), key=lambda s: (s.ts, s.id))
@@ -184,6 +207,32 @@ def render(run: export.Run, top: int = 10, out=sys.stdout) -> None:
         _table(table, ["unit", "attempts", "wall_s", "device_s",
                        "rows f/r", "failures", "outcome"], out)
 
+    # -- per-engine device time --------------------------------------------
+    # Attribution rides the `engine` attr (the repo-root bench stamps it
+    # on probe/measure spans; harness spans inherit it via ancestors).
+    # Closed spans only, outermost-of-chain only — same double-counting
+    # rules as the per-unit device_s column.
+    engine_spans = DEVICE_SPANS + ("measure",)
+    eng_time: dict[str, int] = {}
+    eng_count: dict[str, int] = {}
+    for sp in run.spans.values():
+        if sp.name not in engine_spans or sp.orphan:
+            continue
+        eng = sp.attrs.get("engine") or run.ancestor_attr(sp, "engine")
+        if eng is None:
+            continue
+        if _nested_in_named_span(run, sp, engine_spans):
+            continue
+        eng = str(eng)
+        eng_time[eng] = eng_time.get(eng, 0) + sp.dur_us(run_end)
+        eng_count[eng] = eng_count.get(eng, 0) + 1
+    if eng_time:
+        out.write("\nper-engine device time:\n")
+        _table([[eng, str(eng_count[eng]), _s(eng_time[eng])]
+                for eng in sorted(eng_time,
+                                  key=lambda e: (-eng_time[e], e))],
+               ["engine", "spans", "device_s"], out)
+
     # -- faults: injected vs observed --------------------------------------
     injected: dict[str, int] = {}
     for p in run.points("fault-injected"):
@@ -229,10 +278,15 @@ def render(run: export.Run, top: int = 10, out=sys.stdout) -> None:
     if orphans:
         out.write(f"\norphaned spans ({len(orphans)} — begin with no end: "
                   "the process was killed or died mid-span):\n")
+        budget = dict(expected_orphans or {})
         for sp in orphans:
+            tag = ""
+            if budget.get(sp.name, 0) > 0:
+                budget[sp.name] -= 1
+                tag = " (expected)"
             out.write(f"  {sp.name} (unit={_unit_of(run, sp) or '-'}, "
                       f"pid {sp.pid}) open {_s(sp.dur_us(run_end))}s "
-                      "until end of run — closed by kill\n")
+                      f"until end of run — closed by kill{tag}\n")
 
 
 def main(argv=None) -> int:
@@ -242,6 +296,14 @@ def main(argv=None) -> int:
                                     "$OT_TRACE_DIR: newest run inside)")
     ap.add_argument("--check", action="store_true",
                     help="exit 2 on schema violations or orphaned spans")
+    ap.add_argument("--expected-orphans", default="", metavar="NAMES",
+                    help="comma list of span names whose orphans are "
+                         "EXPECTED (faulted-run gating: a dispatch_hang "
+                         "rehearsal's SIGKILLed child leaves exactly its "
+                         "open spans orphaned). Each listed name licenses "
+                         "ONE orphan (repeat a name to allow more); an "
+                         "unlisted-name orphan or an extra orphan past a "
+                         "name's budget still fails --check")
     ap.add_argument("--trace-json", default=None, metavar="PATH",
                     help="also write the Chrome/Perfetto trace.json")
     ap.add_argument("--top", type=int, default=10,
@@ -254,15 +316,33 @@ def main(argv=None) -> int:
     if not run.procs:
         print(f"no trace-*.jsonl files under {run_dir}", file=sys.stderr)
         return 1
-    render(run, top=args.top)
+    expected: dict[str, int] = {}
+    for tok in args.expected_orphans.split(","):
+        tok = tok.strip()
+        if tok:
+            expected[tok] = expected.get(tok, 0) + 1
+    render(run, top=args.top, expected_orphans=expected)
     if args.trace_json:
         path = export.write_chrome_trace(run, args.trace_json)
         print(f"# perfetto export: {path} "
               f"({len(run.spans)} spans) — open at https://ui.perfetto.dev",
               file=sys.stderr)
-    if args.check and (run.violations or run.orphans()):
+    # Per-name BUDGET, not a name allowlist: each listed name licenses
+    # one orphan, so two killed children in a rehearsal that kills one
+    # cannot hide behind the same three span names.
+    budget = dict(expected)
+    unexpected = []
+    for s in run.orphans():
+        if budget.get(s.name, 0) > 0:
+            budget[s.name] -= 1
+        else:
+            unexpected.append(s)
+    if args.check and (run.violations or unexpected):
+        n_ok = len(run.orphans()) - len(unexpected)
         print(f"CHECK FAILED: {len(run.violations)} schema violation(s), "
-              f"{len(run.orphans())} orphaned span(s)", file=sys.stderr)
+              f"{len(unexpected)} unexpected orphaned span(s)"
+              + (f" ({n_ok} expected orphan(s) allowed)" if n_ok else ""),
+              file=sys.stderr)
         return 2
     return 0
 

@@ -17,12 +17,11 @@ import contextlib
 import ctypes
 import os
 import pathlib
-import subprocess
 
 import numpy as np
 
 from ..obs import trace as _trace
-from ..resilience import faults, policy
+from ..resilience import faults, isolate, policy
 
 _CSRC = pathlib.Path(__file__).parent / "csrc"
 _LIB_PATH = _CSRC / "libotcrypt.so"
@@ -88,14 +87,20 @@ def _build() -> None:
             # retry path: `OT_FAULTS=build_fail:1` fails exactly the
             # first make attempt (docs/RESILIENCE.md).
             faults.check("build_fail", "native runtime make")
-            proc = subprocess.run(
-                ["make", "-C", str(_CSRC), "libotcrypt.so"],  # bindings need
-                capture_output=True, text=True,  # only the lib, not ot_bench
-            )
-            if proc.returncode != 0:
+            # Through the shared child runner (otlint subprocess-isolate):
+            # a compiler wedged on a dead NFS mount used to hang this
+            # build — and the whole importing sweep — forever; run_child
+            # gives the make a wall deadline and SIGKILLs its whole
+            # process group on expiry. The target is only the lib, not
+            # ot_bench (the bindings need nothing else).
+            r = isolate.run_child(
+                ["make", "-C", str(_CSRC), "libotcrypt.so"],
+                timeout_s=float(os.environ.get("OT_BUILD_DEADLINE", 600)),
+                name="native-build-make")
+            if not r.ok:
                 raise RuntimeError(
-                    f"native runtime build failed:\n{proc.stdout}\n"
-                    f"{proc.stderr}"
+                    f"native runtime build failed ({r.kind}):\n{r.out}\n"
+                    f"{r.err}"
                 )
 
         # Two attempts: a transiently-failing make (ENOSPC blip, a racing

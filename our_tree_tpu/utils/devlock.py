@@ -163,16 +163,22 @@ def is_held(p: str | None = None) -> bool:
 
 def wait(budget_s: float, p: str | None = None, poll_s: float = 15.0,
          on_wait=None) -> float:
-    """Block while the marker is held, up to budget_s; returns time waited."""
+    """Block while the marker is held, up to budget_s; returns time waited.
+
+    The budget is a DURATION, so it runs on the monotonic clock (otlint
+    wallclock rule): an NTP step mid-wait must not stretch or collapse
+    the budget. Marker *staleness* (is_held) stays on the wall clock —
+    that compares against file mtimes, which are epoch time.
+    """
     p = p or path()
-    t0 = time.time()
+    t0 = time.monotonic()
     announced = False
-    while is_held(p) and time.time() - t0 < budget_s:
+    while is_held(p) and time.monotonic() - t0 < budget_s:
         if not announced and on_wait is not None:
             on_wait(p)
             announced = True
         time.sleep(poll_s)
-    return time.time() - t0
+    return time.monotonic() - t0
 
 
 def acquire(p: str | None = None) -> bool:

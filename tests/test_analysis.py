@@ -1,0 +1,353 @@
+"""The otlint static-analysis subsystem (our_tree_tpu/analysis/).
+
+Three layers of coverage (docs/ANALYSIS.md):
+
+* AST rules on fixture modules with seeded violations — every rule must
+  flag its planted violation and stay quiet on the compliant twin.
+* The jaxpr auditor's constant-time regression: a PLANTED secret-indexed
+  table lookup must be detected, the bitsliced kernels and the RC4 XOR
+  phase must audit clean (the acceptance bar for the whole layer), and
+  taint must not false-positive on constant-index permutations.
+* The baseline round-trip: findings suppress by fingerprint, reasons are
+  mandatory, stale entries are reported, and the committed repo baseline
+  keeps `python -m our_tree_tpu.analysis --fail-on-new` green.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import textwrap
+
+import numpy as np
+import pytest
+
+from our_tree_tpu.analysis import astrules, baseline, driver, jaxpr_audit
+from our_tree_tpu.analysis.findings import Finding
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _lint(tmp_path, src: str, name: str = "fixture.py"):
+    p = tmp_path / name
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(src))
+    return astrules.lint_paths([str(p)], str(tmp_path))
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ---------------------------------------------------------------------------
+# Layer 1: one seeded violation (and one compliant twin) per rule.
+# ---------------------------------------------------------------------------
+
+
+def test_subprocess_rule_flags_bare_spawns(tmp_path):
+    fs = _lint(tmp_path, """
+        import subprocess
+        import os
+
+        def boom():
+            os.fork()
+            subprocess.run(["ls"])  # the import already flagged
+    """)
+    assert _rules(fs) == ["subprocess-isolate"]
+    assert len([f for f in fs if f.rule == "subprocess-isolate"]) == 2
+
+
+def test_subprocess_rule_exempts_the_isolate_chokepoint(tmp_path):
+    fs = _lint(tmp_path, """
+        import subprocess
+    """, name="resilience/isolate.py")
+    assert fs == []
+
+
+def test_dispatch_rule_flags_unguarded_and_passes_guarded(tmp_path):
+    fs = _lint(tmp_path, """
+        import jax
+        from our_tree_tpu.resilience import watchdog
+
+        def bad(x):
+            return jax.block_until_ready(x)
+
+        def also_bad(x):
+            return jax.device_put(x)
+
+        def good(x):
+            with watchdog.deadline(30, what="guarded dispatch"):
+                return jax.block_until_ready(x)
+    """)
+    flagged = [f for f in fs if f.rule == "dispatch-watchdog"]
+    assert len(flagged) == 2
+    assert all("watchdog" in f.message for f in flagged)
+
+
+def test_degrade_rule_flags_handrolled_lines_and_bad_kinds(tmp_path):
+    fs = _lint(tmp_path, """
+        from our_tree_tpu.resilience import degrade
+
+        def bad_report():
+            print("# degraded: tpu->cpu")  # not fed by the ledger
+
+        def bad_kind():
+            degrade.degrade("went sideways somehow", "why")
+
+        def good_report():
+            print("# degraded: " + ",".join(degrade.events()))
+
+        def good_kind():
+            degrade.degrade("tpu->cpu", "why")
+            degrade.degrade("quarantined:ecb:65536", "why")
+            degrade.degrade("dispatch-timeout", "why")
+    """)
+    flagged = [f for f in fs if f.rule == "degrade-chokepoint"]
+    assert len(flagged) == 2
+
+
+def test_wallclock_rule_flags_time_time_outside_obs(tmp_path):
+    fs = _lint(tmp_path, """
+        import time
+
+        def bad():
+            return time.time()
+
+        def good():
+            return time.monotonic() + time.perf_counter()
+    """)
+    assert _rules(fs) == ["wallclock"]
+    assert len(fs) == 1
+    # obs/ owns the epoch clock: the same source under obs/ is clean.
+    assert _lint(tmp_path, "import time\nx = time.time_ns()\n",
+                 name="obs/clock.py") == []
+
+
+def test_trace_attrs_rule_flags_unserializable_literals(tmp_path):
+    fs = _lint(tmp_path, """
+        from our_tree_tpu.obs import trace
+
+        def bad():
+            trace.point("x", blob=b"raw-bytes")
+            trace.counter("c", 1, tags={"a", "b"})
+
+        def good():
+            trace.point("x", unit="ecb:65536", n=3, ok=True, f=1.5)
+            with trace.span("s", mode="ctr"):
+                pass
+    """)
+    flagged = [f for f in fs if f.rule == "trace-attrs"]
+    assert len(flagged) == 2
+    assert any("bytes" in f.message for f in flagged)
+    assert any("set" in f.message for f in flagged)
+
+
+def test_fault_points_rule_checks_the_live_registry(tmp_path):
+    fs = _lint(tmp_path, """
+        from our_tree_tpu.resilience import faults, watchdog
+
+        def bad():
+            faults.check("dispach_hang")  # typo'd point never fires
+
+        def good():
+            faults.check("dispatch_fail")
+            faults.fire("init_hang")
+            watchdog.injected_hang("dispatch_hang")
+    """)
+    flagged = [f for f in fs if f.rule == "fault-points"]
+    assert len(flagged) == 1
+    assert "dispach_hang" in flagged[0].message
+
+
+def test_fingerprints_survive_line_moves(tmp_path):
+    """The baseline's matching contract: moving a violation down the
+    file (new code above it) must not change its fingerprint."""
+    a = _lint(tmp_path, "import time\nx = time.time()\n", name="a.py")
+    b = _lint(tmp_path, "import time\n\n\ny = 1\nx = time.time()\n",
+              name="a.py")
+    assert a[0].fingerprint == b[0].fingerprint
+
+
+# ---------------------------------------------------------------------------
+# Layer 2: the constant-time regression + the clean-kernel acceptance bar.
+# ---------------------------------------------------------------------------
+
+
+def test_planted_secret_indexed_gather_is_detected():
+    """The regression the rule exists for: a T-table-style lookup indexed
+    by key-derived bytes must flag."""
+    import jax.numpy as jnp
+
+    table = np.arange(256, dtype=np.uint32)
+
+    def leaky(key, data):
+        t = jnp.asarray(table)
+        return t[(data ^ key) & 0xFF]  # secret-indexed gather
+
+    fs = jaxpr_audit.audit_fn(
+        "planted", leaky,
+        (np.zeros(64, np.uint32), np.zeros(64, np.uint32)), {0})
+    assert [f.rule for f in fs] == ["constant-time"]
+    assert "gather" in fs[0].message
+
+
+def test_constant_index_permutation_does_not_false_positive():
+    """Bitslice's ShiftRows is x[SR_PERM] with STATIC indices — the taint
+    must not smear from the gathered operand onto the index."""
+    import jax.numpy as jnp
+
+    perm = np.array([2, 0, 3, 1], dtype=np.int32)
+
+    def shuffled(secret):
+        return secret[jnp.asarray(perm)]
+
+    assert jaxpr_audit.audit_fn(
+        "perm", shuffled, (np.zeros((4, 8), np.uint32),), {0}) == []
+
+
+def test_scan_carry_taint_reaches_fixpoint():
+    """A secret that enters the loop STATE only after iteration 1 —
+    carry-out feeding carry-in — must still taint a carry-indexed
+    lookup. A single walk of the scan body under the initial carry's
+    taint (a public literal) would miss exactly this shape; the
+    auditor iterates the body to fixpoint on the carry."""
+    import jax
+    import jax.numpy as jnp
+
+    table = np.arange(256, dtype=np.uint32)
+
+    def leaky_via_carry(secret_xs):
+        t = jnp.asarray(table)
+
+        def step(c, x):
+            return (c + x) & 0xFF, t[c]  # c is secret from iteration 1 on
+
+        return jax.lax.scan(step, jnp.uint32(0), secret_xs)
+
+    fs = jaxpr_audit.audit_fn(
+        "carry-leak", leaky_via_carry, (np.zeros(64, np.uint32),), {0})
+    assert "constant-time" in [f.rule for f in fs], \
+        [f.render() for f in fs]
+    # And the fixpoint must not over-taint a PUBLIC carry: the same scan
+    # over public xs with a secret used only elementwise stays clean.
+
+    def clean_scan(secret, public_xs):
+        def step(c, x):
+            return c + x, x ^ secret[0]
+
+        return jax.lax.scan(step, jnp.uint32(0),
+                            jnp.asarray(public_xs))
+
+    assert jaxpr_audit.audit_fn(
+        "carry-clean", clean_scan,
+        (np.zeros(4, np.uint32), np.zeros(64, np.uint32)), {0}) == []
+
+
+def test_bitsliced_kernels_audit_clean():
+    """THE acceptance bar: the TPU production circuit has no secret-
+    indexed lookups, no argument-derived transfers, no widening, for
+    both directions."""
+    from our_tree_tpu.ops import bitslice
+
+    for name, fn in (("enc", bitslice.encrypt_words),
+                     ("dec", bitslice.decrypt_words)):
+        fs = jaxpr_audit.audit_fn(
+            f"bitslice-{name}", lambda w, rk, f=fn: f(w, rk, 10),
+            (np.zeros((32, 4), np.uint32), np.zeros(44, np.uint32)), {0, 1})
+        assert fs == [], [f.render() for f in fs]
+
+
+def test_rc4_xor_phase_audits_clean_and_prep_flags():
+    """The paper's phase split, as a security property: the sequential
+    PRGA is state-indexed by definition (flags — baselined with that
+    reason), while the data-parallel XOR phase the TPU scales must be
+    constant-time clean."""
+    from our_tree_tpu.models import arc4
+
+    clean = jaxpr_audit.audit_fn(
+        "rc4-crypt", arc4.crypt,
+        (np.zeros(512, np.uint8), np.zeros(512, np.uint8)), {0, 1})
+    assert clean == []
+    prep = jaxpr_audit.audit_fn(
+        "rc4-prep",
+        lambda st: arc4.keystream_scan(st, 64),
+        ((np.uint32(0), np.uint32(0), np.zeros(256, np.uint32)),), {0})
+    assert "constant-time" in [f.rule for f in prep]
+
+
+def test_dtype_widening_is_flagged():
+    import jax
+    import jax.numpy as jnp
+
+    def widens_int(x):
+        # x64 is disabled suite-wide, so the widening must be forced —
+        # exactly the accidental-promotion shape the rule watches for.
+        with jax.experimental.enable_x64():
+            return x.astype(jnp.int64)
+
+    fs = jaxpr_audit.audit_fn("widen", widens_int,
+                              (np.zeros(8, np.uint32),), {0})
+    assert "dtype-widening" in [f.rule for f in fs]
+
+
+def test_public_entries_carry_no_new_jaxpr_findings():
+    """The audited entry set against the COMMITTED baseline: bitslice
+    entries clean, jnp/rc4 findings exactly the baselined ones, no
+    audit-error (an entry the auditor can't trace would blind it)."""
+    fs = jaxpr_audit.audit(("jnp", "bitslice"))
+    assert not [f for f in fs if f.rule == "audit-error"], \
+        [f.render() for f in fs]
+    assert not [f for f in fs if "[bitslice]" in f.anchor
+                or "bitslice-" in f.anchor], [f.render() for f in fs]
+    base = baseline.load(str(ROOT / "analysis" / "baseline.json"))
+    baseline.apply(fs, base)
+    assert [f.render() for f in fs if not f.baselined] == []
+
+
+# ---------------------------------------------------------------------------
+# Baseline round-trip + the CLI gate.
+# ---------------------------------------------------------------------------
+
+
+def test_baseline_round_trip_and_staleness(tmp_path):
+    f1 = Finding("wallclock", "warning", "m1", "a.py", 3, anchor="x = 1")
+    f2 = Finding("wallclock", "warning", "m2", "b.py", 7, anchor="y = 2")
+    path = tmp_path / "base.json"
+    baseline.write(str(path), [f1, f2])
+    # Reasonless (TODO) entries must not load — justification is the deal.
+    with pytest.raises(baseline.BaselineError):
+        baseline.load(str(path))
+    data = json.loads(path.read_text())
+    for e in data["findings"]:
+        e["reason"] = "a real reason"
+    path.write_text(json.dumps(data))
+    loaded = baseline.load(str(path))
+    assert set(loaded) == {f1.fingerprint, f2.fingerprint}
+    # Round trip: both suppress; with f2 fixed, its entry reports stale.
+    fs = [Finding("wallclock", "warning", "m1", "a.py", 3, anchor="x = 1")]
+    stale = baseline.apply(fs, loaded)
+    assert fs[0].baselined and fs[0].baseline_reason == "a real reason"
+    assert stale == sorted([f2.fingerprint])
+    # Rewrite preserves the human-written reason by fingerprint.
+    baseline.write(str(path), fs, loaded)
+    assert baseline.load(str(path))[f1.fingerprint]["reason"] \
+        == "a real reason"
+
+
+def test_cli_runs_clean_against_committed_baseline():
+    """The acceptance criterion: `python -m our_tree_tpu.analysis
+    --baseline analysis/baseline.json --fail-on-new` exits 0 on this
+    tree — and the AST layer alone finds nothing new either (fast
+    path, no jax tracing)."""
+    rc = driver.main(["--baseline", str(ROOT / "analysis" / "baseline.json"),
+                      "--fail-on-new", "--no-jaxpr"])
+    assert rc == 0
+
+
+def test_cli_fails_on_new_findings(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import subprocess\n")
+    rc = driver.main([str(bad), "--no-jaxpr", "--fail-on-new"])
+    assert rc == 1
+    # Without the gate flag the same run reports but exits 0.
+    assert driver.main([str(bad), "--no-jaxpr"]) == 0

@@ -253,6 +253,13 @@ def test_report_golden_on_synthetic_run(tmp_path):
     assert out.getvalue() == GOLDEN
     # --check semantics: orphans present -> nonzero.
     assert report.main([str(d), "--check"]) == 2
+    # The expected-orphan allowlist (the FAULTED-run gate): naming exactly
+    # the killed child's open spans passes, naming only some still fails —
+    # an unexpected orphan can never hide behind the allowlist.
+    assert report.main([str(d), "--check",
+                        "--expected-orphans", "unit,row,timed-call"]) == 0
+    assert report.main([str(d), "--check",
+                        "--expected-orphans", "unit,row"]) == 2
     # The Perfetto export loads as Trace Event Format and carries the
     # kill evidence.
     path = tmp_path / "trace.json"
@@ -265,6 +272,66 @@ def test_report_golden_on_synthetic_run(tmp_path):
     assert {e["name"] for e in killed} == {"unit", "row", "timed-call"}
     assert any(e["ph"] == "i" and e["name"] == "fault-injected"
                for e in evs)
+
+
+def test_report_expected_orphans_budget_is_per_name(tmp_path):
+    """Each listed name licenses ONE orphan: two killed children's `unit`
+    orphans cannot both hide behind a single `unit` entry — the gate for
+    a rehearsal that kills one child must go red when two die."""
+    d = tmp_path / "two"
+    d.mkdir()
+    recs = [
+        {"kind": "ot-trace", "v": 1, "run": "r", "pid": 1, "proc": "dddd0000",
+         "argv": "x", "start_us": 1000000},
+        {"ev": "b", "id": "dddd0000.1", "parent": None, "name": "unit",
+         "ts": 1000000, "tid": 0, "attrs": {"unit": "a"}},
+        {"ev": "b", "id": "dddd0000.2", "parent": None, "name": "unit",
+         "ts": 1100000, "tid": 0, "attrs": {"unit": "b"}},
+    ]
+    (d / "trace-1-dddd0000.jsonl").write_text(
+        "".join(json.dumps(r, separators=(",", ":")) + "\n" for r in recs))
+    assert report.main([str(d), "--check",
+                        "--expected-orphans", "unit"]) == 2
+    assert report.main([str(d), "--check",
+                        "--expected-orphans", "unit,unit"]) == 0
+
+
+def test_report_per_engine_device_time_table(tmp_path):
+    """Spans carrying the `engine` attr (the root bench's probe/measure
+    spans) aggregate into a per-engine device-time table; nested
+    device-seam spans inherit the engine via the ancestor chain without
+    double-counting, and an engine-less run renders no table (the
+    golden test pins that absence)."""
+    d = tmp_path / "eng"
+    d.mkdir()
+    recs = [
+        {"kind": "ot-trace", "v": 1, "run": "r", "pid": 1, "proc": "cccc0000",
+         "argv": "bench", "start_us": 1000000},
+        # Two probe measures on one engine, one on another; a barrier
+        # nested INSIDE a measure must not double its time.
+        {"ev": "b", "id": "cccc0000.1", "parent": None, "name": "measure",
+         "ts": 1000000, "tid": 0, "attrs": {"engine": "pallas-gt", "mib": 4}},
+        {"ev": "b", "id": "cccc0000.2", "parent": "cccc0000.1",
+         "name": "barrier", "ts": 1100000, "tid": 0},
+        {"ev": "e", "id": "cccc0000.2", "ts": 1400000, "status": "ok"},
+        {"ev": "e", "id": "cccc0000.1", "ts": 2000000, "status": "ok"},
+        {"ev": "b", "id": "cccc0000.3", "parent": None, "name": "measure",
+         "ts": 2000000, "tid": 0, "attrs": {"engine": "pallas-gt", "mib": 4}},
+        {"ev": "e", "id": "cccc0000.3", "ts": 2500000, "status": "ok"},
+        {"ev": "b", "id": "cccc0000.4", "parent": None, "name": "measure",
+         "ts": 2500000, "tid": 0, "attrs": {"engine": "bitslice", "mib": 4}},
+        {"ev": "e", "id": "cccc0000.4", "ts": 2600000, "status": "ok"},
+    ]
+    (d / "trace-1-cccc0000.jsonl").write_text(
+        "".join(json.dumps(r, separators=(",", ":")) + "\n" for r in recs))
+    out = io.StringIO()
+    report.render(export.load_run(str(d)), out=out)
+    text = out.getvalue()
+    assert "per-engine device time:" in text
+    lines = [l.strip() for l in text.splitlines()]
+    i = lines.index("engine     spans  device_s")
+    assert lines[i + 1] == "pallas-gt  2      1.500"  # 1.0s + 0.5s, no double
+    assert lines[i + 2] == "bitslice   1      0.100"
 
 
 def test_report_check_flags_schema_violations(tmp_path):

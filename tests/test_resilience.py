@@ -452,16 +452,11 @@ def test_native_build_retries_past_injected_failure(tmp_path, monkeypatch):
     monkeypatch.setattr(native, "_LIB_PATH", tmp_path / "libotcrypt.so")
     (tmp_path / "x.c").write_text("int x;\n")  # staleness: lib missing
 
-    def fake_make(argv, capture_output, text):
+    def fake_make(argv, *a, **kw):
         calls.append(argv)
+        return native.isolate.ChildResult("ok", 0, "", "", 0.0)
 
-        class P:
-            returncode = 0
-            stdout = stderr = ""
-
-        return P()
-
-    monkeypatch.setattr(native.subprocess, "run", fake_make)
+    monkeypatch.setattr(native.isolate, "run_child", fake_make)
     monkeypatch.setenv("OT_FAULTS", "build_fail:1")
     faults.reset()
     native._build()
@@ -475,15 +470,10 @@ def test_native_build_deterministic_failure_raises(tmp_path, monkeypatch):
     monkeypatch.setattr(native, "_LIB_PATH", tmp_path / "libotcrypt.so")
     (tmp_path / "x.c").write_text("int x;\n")
 
-    def fake_make(argv, capture_output, text):
-        class P:
-            returncode = 2
-            stdout = ""
-            stderr = "cc: error"
+    def fake_make(argv, *a, **kw):
+        return native.isolate.ChildResult("crash", 2, "", "cc: error", 0.0)
 
-        return P()
-
-    monkeypatch.setattr(native.subprocess, "run", fake_make)
+    monkeypatch.setattr(native.isolate, "run_child", fake_make)
     with pytest.raises(policy.PolicyExhausted) as ei:
         native._build()
     assert "cc: error" in str(ei.value.last)
@@ -525,7 +515,7 @@ os.close(fd)
         def fail_make(*a, **kw):  # must never run: holder's build wins
             raise AssertionError("make ran despite a concurrent build")
 
-        monkeypatch.setattr(native.subprocess, "run", fail_make)
+        monkeypatch.setattr(native.isolate, "run_child", fail_make)
         import time
         t0 = time.perf_counter()
         native._build()  # blocks on the flock, then sees the fresh lib
