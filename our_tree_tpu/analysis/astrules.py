@@ -38,7 +38,7 @@ try:
     from ..resilience.faults import KNOWN_POINTS
 except Exception:  # pragma: no cover - only on a broken tree
     KNOWN_POINTS = ("init_hang", "dispatch_fail", "build_fail", "lock_busy",
-                    "dispatch_hang", "unit_crash")
+                    "dispatch_hang", "unit_crash", "serve_dispatch")
 
 
 @dataclass
@@ -264,10 +264,11 @@ def _check_wallclock(ctx: FileContext):
 
 
 # ---------------------------------------------------------------------------
-# trace-attrs: span/point/counter/gauge attrs statically JSON-serializable
+# trace-attrs: span/detached_span/point/counter/gauge attrs statically
+# JSON-serializable
 # ---------------------------------------------------------------------------
 
-_TRACE_METHODS = ("span", "point", "counter", "gauge")
+_TRACE_METHODS = ("span", "detached_span", "point", "counter", "gauge")
 _TRACE_RECEIVERS = ("trace", "_trace", "trace_mod", "obstrace",
                     "tr", "t", "tt", "m")
 
@@ -366,7 +367,7 @@ RULES: tuple[Rule, ...] = (
          "clocks; epoch time is the tracer's and mtime comparisons'.",
          _check_wallclock),
     Rule("trace-attrs", "error",
-         "span/point/counter/gauge attrs must be statically "
+         "span/detached_span/point/counter/gauge attrs must be statically "
          "JSON-serializable (no bytes/set/lambda/complex literals).",
          _check_trace_attrs),
     Rule("fault-points", "error",

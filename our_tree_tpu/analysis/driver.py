@@ -64,9 +64,11 @@ def main(argv=None) -> int:
                     help="skip layer 2 (the jaxpr auditor) — the AST layer "
                          "then runs without jax in sight")
     ap.add_argument("--engines", default=None,
-                    help="comma list of engines for the jaxpr audit "
-                         "(default: jnp,bitslice; pallas engines trace "
-                         "too but add wall time)")
+                    help="comma list of engines for the jaxpr audit, or "
+                         "'all' (the default): jnp,bitslice plus every "
+                         "pallas engine the running jax can trace — "
+                         "untraceable pallas engines are skipped with a "
+                         "stderr note, not reported as audit errors")
     ap.add_argument("--format", default="text", choices=("text", "json"))
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule catalog and exit")
@@ -85,7 +87,8 @@ def main(argv=None) -> int:
               "bits.")
         print("shape-unroll (error): [jaxpr] traced graph size must not "
               "depend on the batch dim.")
-        print(f"default audited engines: {', '.join(DEFAULT_ENGINES)}")
+        print(f"default audited engines: {', '.join(DEFAULT_ENGINES)} "
+              "+ the pallas engines when the running jax can trace them")
         return 0
 
     root = _repo_root()
@@ -97,8 +100,9 @@ def main(argv=None) -> int:
     if not args.no_jaxpr:
         from . import jaxpr_audit
 
-        engines = (tuple(e for e in args.engines.split(",") if e)
-                   if args.engines else jaxpr_audit.DEFAULT_ENGINES)
+        engines = "all"
+        if args.engines and args.engines != "all":
+            engines = tuple(e for e in args.engines.split(",") if e)
         findings += jaxpr_audit.audit(engines)
 
     stale: list[str] = []

@@ -38,11 +38,59 @@ import os
 
 from .findings import Finding
 
-#: Default engine set audited by the CLI. The Pallas engines can be added
-#: with --engines (they trace through pallas_call on CPU), but the two
-#: here are the correctness oracle and the TPU throughput path — the pair
-#: the constant-time story is really about.
+#: The two engines every audit covers: the correctness oracle and the
+#: TPU throughput circuit — the pair the constant-time story is really
+#: about.
 DEFAULT_ENGINES = ("jnp", "bitslice")
+
+#: The Pallas kernel engines (models/aes.py registration order). Audited
+#: by default too — via ``resolve_engines`` — wherever the running jax
+#: can trace ``pallas_call`` at all (the PR-4 follow-up: "audit the
+#: Pallas engines by default"); on a runtime that cannot (older jax
+#: without the vma-carrying ShapeDtypeStruct), they are SKIPPED with a
+#: stderr note rather than reported as audit-errors: the blindness is a
+#: property of the host's jax, not of the entry points, and a baseline
+#: entry for it would go stale the moment the runtime is upgraded.
+PALLAS_ENGINES = ("pallas", "pallas-gt", "pallas-gt-bp", "pallas-dense",
+                  "pallas-dense-bp")
+
+_PALLAS_TRACEABLE: bool | None = None
+
+
+def pallas_traceable() -> bool:
+    """Can this runtime trace the Pallas engines? Probed once, by
+    tracing (never executing) the smallest kernel entry."""
+    global _PALLAS_TRACEABLE
+    if _PALLAS_TRACEABLE is None:
+        try:
+            import jax
+            import numpy as np
+
+            from ..models import aes
+
+            w = np.zeros((32, 4), np.uint32)
+            rk = np.zeros(44, np.uint32)
+            jax.make_jaxpr(
+                lambda ww, kk: aes.ecb_encrypt_words(ww, kk, 10,
+                                                     "pallas"))(w, rk)
+            _PALLAS_TRACEABLE = True
+        except Exception as e:  # noqa: BLE001 - the probe IS the question
+            import sys
+
+            print(f"# jaxpr audit: pallas engines not traceable under "
+                  f"this jax ({type(e).__name__}: {str(e)[:120]}); "
+                  f"auditing without them", file=sys.stderr)
+            _PALLAS_TRACEABLE = False
+    return _PALLAS_TRACEABLE
+
+
+def resolve_engines(spec) -> tuple:
+    """``"all"`` -> DEFAULT_ENGINES + the Pallas engines the runtime can
+    trace; any other iterable passes through unchanged."""
+    if spec == "all":
+        return DEFAULT_ENGINES + (PALLAS_ENGINES if pallas_traceable()
+                                  else ())
+    return tuple(spec)
 
 #: primitive -> which invar positions are *index* operands.
 _INDEXED = {
@@ -272,6 +320,15 @@ def _entries(engines):
              lambda ww, cc, kk, e=eng: aes.ctr_crypt_words(ww, cc, kk,
                                                            NR, e),
              (w, iv, rk), {0, 2}),  # the counter/nonce is public
+            # The serve dispatch seam: CTR with per-block explicit
+            # counters (many requests' streams concatenated —
+            # serve/batcher.py). Its shape-unroll cleanliness at two
+            # batch sizes is the bucket ladder's zero-recompile
+            # contract, auditable without running a server.
+            (f"aes-ctr-scattered[{eng}]",
+             lambda ww, cc, kk, e=eng: aes.ctr_crypt_words_scattered(
+                 ww, cc, kk, NR, e),
+             (w, w, rk), {0, 2}),  # counters derive from public nonces
             (f"aes-cbc-dec[{eng}]",
              lambda ww, vv, kk, e=eng: aes.cbc_decrypt_words(ww, vv, kk,
                                                              NR, e),
@@ -338,6 +395,7 @@ def audit(engines=DEFAULT_ENGINES) -> list[Finding]:
     pin_cpu_if_requested()
     import jax
 
+    engines = resolve_engines(engines)
     findings: list[Finding] = []
     for name, fn, builders, secrets in _entries(tuple(engines)):
         try:

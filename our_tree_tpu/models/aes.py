@@ -431,6 +431,36 @@ def ctr_crypt_words(words, ctr_be_words, rk, nr, engine="jnp"):
                                 _engine_knobs_key(engine))
 
 
+@functools.partial(jax.jit, static_argnums=(3, 4, 5))
+def _ctr_crypt_words_scattered_jit(words, ctr_le_words, rk, nr, engine,
+                                   knobs):
+    del knobs
+    ks = CORES[engine][0](_as_block_words(ctr_le_words), rk, nr)
+    return (words.reshape(-1) ^ ks.reshape(-1)).reshape(words.shape)
+
+
+def ctr_crypt_words_scattered(words, ctr_le_words, rk, nr, engine="jnp"):
+    """CTR where every block's counter is given EXPLICITLY, not derived
+    from one base: (N, 4) u32 LE counter words (or a flat (4N,) stream)
+    alongside the (N, 4)/(4N,) data words.
+
+    This is the serving seam (serve/batcher.py): a batch coalesces many
+    independent requests under one key, and each request's counter stream
+    starts at its OWN nonce — there is no single ``ctr_be + i`` law across
+    the concatenation, so the fused single-base kernels don't apply. CTR
+    is ECB over the counter stream XOR the data, so the dispatch is one
+    batched engine call over the scattered counters (every engine,
+    including Pallas, through its ECB core) — same shape contract as
+    ``ecb_encrypt_words``, keystream never materialised separately from
+    the XOR under jit. Callers build the per-request counter blocks with
+    ``utils.packing.np_ctr_le_blocks`` (host) or ``ctr_le_blocks``
+    (traced); padding blocks may carry any counter value (their output is
+    discarded by construction).
+    """
+    return _ctr_crypt_words_scattered_jit(words, ctr_le_words, rk, nr,
+                                          engine, _engine_knobs_key(engine))
+
+
 @functools.partial(jax.jit, static_argnums=(3,))
 def cbc_encrypt_words(words, iv_words, rk, nr):
     w2 = _as_block_words(words)

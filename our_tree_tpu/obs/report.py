@@ -212,7 +212,7 @@ def render(run: export.Run, top: int = 10, out=sys.stdout,
     # on probe/measure spans; harness spans inherit it via ancestors).
     # Closed spans only, outermost-of-chain only — same double-counting
     # rules as the per-unit device_s column.
-    engine_spans = DEVICE_SPANS + ("measure",)
+    engine_spans = DEVICE_SPANS + ("measure", "batch-dispatched")
     eng_time: dict[str, int] = {}
     eng_count: dict[str, int] = {}
     for sp in run.spans.values():

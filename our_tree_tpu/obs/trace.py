@@ -47,6 +47,11 @@ that is comparable across the processes of a run; span ids are
 ``<proc-token>.<seq>`` and globally unique within a run (the 8-hex
 process token absorbs pid reuse).
 
+Long runs can cap their disk footprint with ``OT_TRACE_MAX_MB`` (see
+``_max_bytes``): the event file rotates into ``-s<k>`` segments and the
+oldest segments are deleted, keeping the process under the cap at the
+cost of the evicted history — the soak-run tradeoff.
+
 Stdlib-only, no intra-package imports (bare-loadable by the jax-free
 sweep parents and the repo-root bench.py). Bare loaders must register
 this module under ``our_tree_tpu.obs.trace`` in ``sys.modules`` (see
@@ -145,6 +150,90 @@ def run_dir() -> str | None:
     return os.path.join(os.environ["OT_TRACE_DIR"], ensure_run())
 
 
+def _max_bytes() -> int:
+    """The per-process trace-size cap (``OT_TRACE_MAX_MB``), in bytes.
+
+    0 / unset = unbounded (the default: short runs and CI gates want the
+    complete stream). When set, the process's event file rotates into
+    fixed-size segments and the OLDEST segments are deleted so this
+    process never keeps more than the cap on disk — the week-long soak
+    knob (ROADMAP PR-3 follow-up). Bounded necessarily means lossy:
+    spans whose begin fell in a deleted segment surface in ``obs.report``
+    as end-without-begin violations, so soak monitoring should read the
+    self-contained events (counters/points/gauges); ``--check`` gating
+    belongs to uncapped runs.
+    """
+    try:
+        mb = float(os.environ.get("OT_TRACE_MAX_MB", 0) or 0)
+    except ValueError:
+        return 0
+    return max(int(mb * (1 << 20)), 0)
+
+
+def _segment_path(state: dict) -> str:
+    n = state["seg"]
+    suffix = f"-s{n}" if n else ""
+    return os.path.join(
+        state["dir"],
+        f"trace-{state['pid']}-{state['proc']}{suffix}.jsonl")
+
+
+def _open_segment_locked(state: dict) -> None:
+    """Open the current segment file and write its header; caller holds
+    ``_LOCK``. Every segment is a self-describing trace file (same
+    header schema — ``obs.export`` globs them all); ``seg`` rides along
+    so a stitched report can say which slices survive. ``state`` is
+    only mutated on full success (a handle is never leaked and a
+    failure leaves the previous segment, if any, still live)."""
+    path = _segment_path(state)
+    fh = open(path, "a", encoding="utf-8")
+    try:
+        header = {"kind": KIND, "v": VERSION, "run": state["run"],
+                  "pid": state["pid"], "proc": state["proc"],
+                  "argv": " ".join(sys.argv[:6])[:300],
+                  "start_us": _now_us()}
+        if state["seg"]:
+            header["seg"] = state["seg"]
+        fh.write(json.dumps(header, separators=(",", ":"),
+                            default=repr) + "\n")
+        fh.flush()
+    except OSError:
+        try:
+            fh.close()
+        except OSError:
+            pass
+        raise
+    state["fh"], state["path"] = fh, path
+
+
+def _rotate_locked(state: dict) -> None:
+    """Open the next segment, then retire the full one, then drop the
+    oldest beyond the cap. Caller holds ``_LOCK``. Best-effort in that
+    order on purpose: a failed OPEN (ENOSPC mid-soak — exactly when the
+    cap matters) keeps the current handle live and retries on a later
+    write, instead of stranding a closed handle that would silently end
+    tracing for the rest of the process."""
+    old_fh, old_path = state["fh"], state["path"]
+    state["seg"] += 1
+    try:
+        _open_segment_locked(state)
+    except OSError:
+        state["seg"] -= 1  # still on the old segment; retry next write
+        return
+    try:
+        old_fh.close()
+    except OSError:
+        pass
+    state["segments"].append(old_path)
+    # cap/4 per segment -> keep the active one + 3 closed: total <= cap.
+    keep = max(int(state["cap_bytes"] // state["seg_bytes"]) - 1, 1)
+    while len(state["segments"]) > keep:
+        try:
+            os.unlink(state["segments"].pop(0))
+        except OSError:
+            break
+
+
 def _state() -> dict | None:
     """Open this process's event file (header included) on first use.
 
@@ -168,19 +257,14 @@ def _state() -> dict | None:
         try:
             d = run_dir()
             os.makedirs(d, exist_ok=True)
-            proc = uuid.uuid4().hex[:8]
-            path = os.path.join(d, f"trace-{os.getpid()}-{proc}.jsonl")
-            fh = open(path, "a", encoding="utf-8")
-            header = {"kind": KIND, "v": VERSION,
-                      "run": os.environ["OT_TRACE_RUN"],
-                      "pid": os.getpid(), "proc": proc,
-                      "argv": " ".join(sys.argv[:6])[:300],
-                      "start_us": _now_us()}
-            fh.write(json.dumps(header, separators=(",", ":"),
-                                default=repr) + "\n")
-            fh.flush()
-            _STATE = {"run": header["run"], "dir": d, "fh": fh,
-                      "proc": proc, "seq": 0, "path": path}
+            cap = _max_bytes()
+            state = {"run": os.environ["OT_TRACE_RUN"], "dir": d,
+                     "proc": uuid.uuid4().hex[:8], "pid": os.getpid(),
+                     "seq": 0, "seg": 0, "segments": [],
+                     "cap_bytes": cap,
+                     "seg_bytes": max(cap // 4, 4096) if cap else 0}
+            _open_segment_locked(state)
+            _STATE = state
             return _STATE
         except OSError:
             _DROPPED += 1
@@ -221,6 +305,9 @@ def _write(rec: dict) -> None:
         with _LOCK:
             state["fh"].write(line + "\n")
             state["fh"].flush()
+            if (state["seg_bytes"]
+                    and state["fh"].tell() >= state["seg_bytes"]):
+                _rotate_locked(state)
     except (OSError, ValueError):
         # ValueError covers a racing reopen/close ("I/O operation on
         # closed file"): the never-raises contract holds over losing
@@ -239,8 +326,9 @@ class Span:
 
 
 class _SpanCM:
-    def __init__(self, name: str, attrs: dict):
+    def __init__(self, name: str, attrs: dict, detached: bool = False):
         self._name, self._attrs = name, attrs
+        self._detached = detached
         self._span: Span | None = None
 
     def __enter__(self) -> Span | None:
@@ -260,19 +348,22 @@ class _SpanCM:
         if self._attrs:
             rec["attrs"] = self._attrs
         _write(rec)
-        stack.append(sid)
+        if not self._detached:
+            stack.append(sid)
         self._span = Span(sid, self._name)
         return self._span
 
     def __exit__(self, exc_type, exc, tb):
         if self._span is None:
             return False
-        stack = _stack()
-        if stack and stack[-1] == self._span.id:
-            stack.pop()
+        if not self._detached:
+            stack = _stack()
+            if stack and stack[-1] == self._span.id:
+                stack.pop()
         status = "ok" if exc_type is None else f"error:{exc_type.__name__}"
         _write({"ev": "e", "id": self._span.id, "ts": _now_us(),
                 "status": status})
+        self._span = None  # idempotent: a second exit writes nothing
         return False
 
 
@@ -296,6 +387,27 @@ def span(name: str, **attrs):
     if not enabled():
         return _NULL
     return _SpanCM(name, attrs)
+
+
+def detached_span(name: str, **attrs):
+    """A span that never joins the per-thread nesting stack.
+
+    The serve path's lifecycle spans (``request-queued`` from admission
+    to batch formation, ``batch-dispatched`` around an engine call whose
+    begin and end may straddle other work) OVERLAP freely on one thread;
+    pushing them through the LIFO stack would corrupt parentage for
+    every span opened in between. A detached span reads its parent from
+    the live stack at begin and contributes nothing to it; enter/exit
+    the returned context manager explicitly (``cm.__enter__()`` at the
+    start of the lifecycle, ``cm.__exit__(exc_type, None, None)`` at the
+    end — exit is idempotent). A detached span deliberately never
+    exited is an ORPHAN: the serve dispatch loop abandons the span of a
+    batch killed by the watchdog on purpose, so a hung dispatch leaves
+    the same closed-by-kill evidence a SIGKILLed child does.
+    """
+    if not enabled():
+        return _NULL
+    return _SpanCM(name, attrs, detached=True)
 
 
 def current_span_id() -> str | None:

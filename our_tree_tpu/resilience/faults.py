@@ -49,6 +49,16 @@ point              wired into
                    interrupt or the ``--isolate`` supervisor to SIGKILL.
 ``unit_crash``     sweep-unit execution (``harness.bench``): the unit
                    dies as if the process had crashed mid-row.
+``serve_dispatch`` the serve batch-dispatch seam (``serve/server.py``):
+                   the batch's engine call raises as if the dispatch had
+                   failed — the affected requests get per-request error
+                   responses while the server keeps serving (the seam
+                   also consults ``dispatch_fail``/``dispatch_hang``, so
+                   the generic dispatch faults reach the online path
+                   too; the serve-level seams skip warmup dispatches —
+                   priming is not traffic — though an engine's own
+                   internal seam, e.g. the Pallas launch seam, still
+                   sees warmup like any first dispatch).
 =================  ========================================================
 
 Determinism contract: firings consume counts in call order within ONE
@@ -73,7 +83,7 @@ import sys
 #: The names wired into real seams. Parsing accepts others (forward
 #: compat, tests), but warns — see module docstring.
 KNOWN_POINTS = ("init_hang", "dispatch_fail", "build_fail", "lock_busy",
-                "dispatch_hang", "unit_crash")
+                "dispatch_hang", "unit_crash", "serve_dispatch")
 
 #: Sentinel count for a bare (uncounted) token: armed forever.
 ALWAYS = -1

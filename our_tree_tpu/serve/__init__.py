@@ -1,0 +1,41 @@
+"""ot-serve: the online request path over the offline engines.
+
+Everything below this package batches by construction — a sweep hands the
+engines device-shaped arrays. Serving has to MAKE those arrays out of
+many small, independent, differently-sized requests arriving whenever
+they like, without recompiling and without letting one bad batch take
+the process down. The design is the paper's phase split run in reverse
+(SURVEY.md §2): instead of splitting one large buffer into independent
+chunks for parallel workers, coalesce many independent requests into one
+device-shaped dispatch — the same throughput lever the multicore-AES
+literature pulls with threads and the GPU-AES line pulls with kernel
+batching.
+
+Modules (docs/SERVING.md has the full architecture):
+
+* ``queue``    — admission control + backpressure: bounded depth,
+  per-request deadline (``resilience.policy.Budget``), shed-on-overload
+  stamped through the ``degrade()`` ledger.
+* ``batcher``  — shape-bucketed continuous batching: requests coalesce
+  per (tenant, key) into power-of-two block buckets from a fixed ladder,
+  so steady-state serving replays compiled programs (the shape-unroll /
+  recompile-storm hazard ``analysis.jaxpr_audit`` flags, solved at the
+  batching layer).
+* ``keycache`` — multi-tenant LRU of expanded key schedules keyed by key
+  digest: rekeying per request costs a lookup, not a key expansion.
+* ``server``   — the dispatch loop: watchdog-guarded scattered-CTR engine
+  calls through the ``models.aes`` seams, per-request / per-batch obs
+  spans, RetryPolicy on transient dispatch failure, per-request error
+  responses when a batch dies (the server stays up).
+* ``loadgen``  — closed-loop load generator with mixed request sizes.
+* ``bench``    — ``python -m our_tree_tpu.serve.bench``: drives the
+  server, reports p50/p95/p99 latency, goodput GB/s, batch occupancy,
+  asserts zero post-warmup recompiles, writes a ``SERVE_r*.json``.
+
+Layering: ``queue`` is stdlib+numpy+resilience+obs only (admission
+logic runs without a backend in sight); the device boundary lives
+entirely in ``server``/``keycache`` (and ``batcher``'s packing
+helpers), which is why a queue overload test never compiles anything.
+"""
+
+from .queue import Request, RequestQueue, Response, ServeError  # noqa: F401

@@ -1,0 +1,155 @@
+"""Shape-bucketed continuous batching: requests -> fixed-shape dispatches.
+
+The recompile hazard is the serving twin of the shape-unroll hazard the
+jaxpr auditor flags offline: jax compiles per shape, so a service that
+dispatches each request at its natural size compiles O(distinct sizes)
+programs and spends its latency budget in the compiler. The answer is a
+FIXED BUCKET LADDER — power-of-two block counts between a floor and a
+ceiling — and padding every batch up to its rung: after one warmup pass
+over the ladder, steady-state serving replays compiled programs only
+(``serve.bench`` asserts exactly that, via the backend-compile counter).
+
+Batches coalesce per (tenant, key digest): the scattered-CTR dispatch
+(``models.aes.ctr_crypt_words_scattered``) carries one round-key
+schedule per call, while each request keeps its OWN counter stream —
+request segments are concatenated with their per-block counters
+materialised host-side (``utils.packing.np_ctr_le_blocks``), so the
+batch needs no common counter base, only a common key. Padding blocks
+reuse the tail counter region with zero payload; their keystream is
+computed and discarded (the occupancy column in ``serve.bench`` prices
+exactly this waste).
+
+jax-free on purpose: forming a batch is numpy bookkeeping; the device
+boundary is the server's.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..utils import packing
+from .queue import Request
+
+#: Default ladder bounds, in 16-byte blocks. Floor 32: the bitsliced
+#: engines pack 32 blocks per lane group, so smaller rungs only add
+#: compile cache entries below the packing grain. Ceiling 4096 (64 KiB):
+#: big enough that one request rarely spans batches, small enough that a
+#: padded miss wastes at most one rung.
+DEFAULT_MIN_BLOCKS = 32
+DEFAULT_MAX_BLOCKS = 4096
+
+
+def bucket_ladder(min_blocks: int = DEFAULT_MIN_BLOCKS,
+                  max_blocks: int = DEFAULT_MAX_BLOCKS) -> tuple[int, ...]:
+    """The fixed rung set: powers of two from min to max inclusive."""
+    if min_blocks < 1 or max_blocks < min_blocks:
+        raise ValueError(f"bad ladder bounds [{min_blocks}, {max_blocks}]")
+    rungs = []
+    r = 1
+    while r < min_blocks:
+        r *= 2
+    while r < max_blocks:
+        rungs.append(r)
+        r *= 2
+    rungs.append(max_blocks)  # ceiling always present, pow2 or not
+    return tuple(rungs)
+
+
+def bucket_for(nblocks: int, rungs: tuple[int, ...]) -> int:
+    """Smallest rung >= nblocks (nblocks must fit the ladder)."""
+    for r in rungs:
+        if nblocks <= r:
+            return r
+    raise ValueError(f"{nblocks} blocks exceeds ladder ceiling {rungs[-1]}")
+
+
+@dataclass
+class Batch:
+    """One formed dispatch: same tenant+key, padded to a ladder rung."""
+
+    tenant: str
+    digest: str                  #: key digest (keycache identity)
+    key: bytes
+    bucket: int                  #: padded block count (the rung)
+    requests: list[Request]
+    blocks: int                  #: real (unpadded) block count
+    words: np.ndarray | None = field(default=None, repr=False)
+    ctr_words: np.ndarray | None = field(default=None, repr=False)
+
+    @property
+    def label(self) -> str:
+        return f"{self.tenant}/{self.digest[:8]}:{self.bucket}"
+
+    @property
+    def occupancy(self) -> float:
+        return self.blocks / self.bucket
+
+    def materialise(self) -> None:
+        """Build the flat u32 dispatch arrays (payload words + per-block
+        LE counter words). Flat (4N,) on purpose: the dense jit-boundary
+        layout every models entry point shares (models/aes.py:
+        _as_block_words)."""
+        words = np.zeros(4 * self.bucket, dtype=np.uint32)
+        ctr = np.zeros((self.bucket, 4), dtype=np.uint32)
+        off = 0
+        for req in self.requests:
+            n = req.nblocks
+            words[4 * off:4 * (off + n)] = packing.np_bytes_to_words(
+                req.payload)
+            ctr[off:off + n] = packing.np_ctr_le_blocks(
+                req.nonce, np.arange(n, dtype=np.uint32))
+            off += n
+        self.words = words
+        self.ctr_words = ctr.reshape(-1)
+
+    def split_output(self, out_words: np.ndarray) -> list[np.ndarray]:
+        """Per-request output bytes from the batch's output words."""
+        flat = np.asarray(out_words, dtype=np.uint32).reshape(-1)
+        outs = []
+        off = 0
+        for req in self.requests:
+            n = req.nblocks
+            outs.append(packing.np_words_to_bytes(
+                flat[4 * off:4 * (off + n)].reshape(-1, 4)).reshape(-1))
+            off += n
+        return outs
+
+
+def form_batches(requests: list[Request],
+                 rungs: tuple[int, ...],
+                 key_digest) -> list[Batch]:
+    """Greedy coalescing: group by (tenant, key digest) in arrival
+    order, fill each batch up to the ladder ceiling, pad to the smallest
+    rung that holds what was packed. Returns batches in first-arrival
+    order of their groups; array materialisation is deferred to the
+    caller (the server times it under its ``batch-formed`` span).
+    """
+    ceiling = rungs[-1]
+    groups: dict[tuple[str, str], list[Request]] = {}
+    order: list[tuple[str, str]] = []
+    for req in requests:
+        k = (req.tenant, key_digest(req.key))
+        if k not in groups:
+            groups[k] = []
+            order.append(k)
+        groups[k].append(req)
+    batches: list[Batch] = []
+    for tenant, digest in order:
+        pending = groups[(tenant, digest)]
+        cur: list[Request] = []
+        cur_blocks = 0
+        for req in pending:
+            if cur and cur_blocks + req.nblocks > ceiling:
+                batches.append(Batch(tenant, digest, cur[0].key,
+                                     bucket_for(cur_blocks, rungs),
+                                     cur, cur_blocks))
+                cur, cur_blocks = [], 0
+            cur.append(req)
+            cur_blocks += req.nblocks
+        if cur:
+            batches.append(Batch(tenant, digest, cur[0].key,
+                                 bucket_for(cur_blocks, rungs),
+                                 cur, cur_blocks))
+    return batches

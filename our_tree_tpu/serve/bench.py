@@ -1,0 +1,200 @@
+"""``python -m our_tree_tpu.serve.bench`` — the serving benchmark.
+
+Closed-loop loadgen against an in-process Server: mixed request sizes,
+multi-tenant keys, p50/p95/p99 latency, goodput GB/s, batch-occupancy
+histogram — and the zero-recompile CONTRACT: after the ladder warmup,
+steady-state serving must trigger no backend compile at all (the
+``server.compile_count`` monitor; the run exits 1 if it does, unless
+``--allow-recompiles`` says a recompile is expected, e.g. an exotic key
+size outside the warmed set).
+
+Output convention follows the repo-root bench: human-readable ``#``
+lines, then ONE parseable JSON line last on stdout (the CI contract),
+plus a ``SERVE_r*.json`` artifact alongside the driver's
+``BENCH_r*.json`` (``--artifact`` overrides the path; otherwise the
+next free index at the repo root).
+
+Fault rehearsals (docs/SERVING.md, the CI ``serve`` job):
+
+* ``OT_FAULTS=dispatch_fail:1 ... --retries 1`` — the armed batch dies,
+  its requests get ``dispatch-failed`` responses, the run completes rc 0
+  (server-stays-up IS the contract; the artifact records the errors).
+* ``OT_FAULTS=dispatch_hang:1 ... --dispatch-deadline 3`` — the armed
+  batch wedges; the watchdog kills it at the deadline, its requests get
+  ``deadline`` errors, the abandoned ``batch-dispatched`` span is the
+  run's ONLY orphan (``obs.report --check --expected-orphans
+  batch-dispatched``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import glob
+import json
+import os
+import re
+import sys
+
+from ..obs import trace
+from ..resilience import degrade, watchdog
+from . import loadgen
+from .server import Server, ServerConfig
+
+
+def _repo_root() -> str:
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.dirname(pkg)
+
+
+def _next_artifact(root: str) -> str:
+    """The next free ``SERVE_r<NN>.json`` at the repo root."""
+    taken = [0]
+    for p in glob.glob(os.path.join(root, "SERVE_r*.json")):
+        m = re.match(r"SERVE_r(\d+)\.json$", os.path.basename(p))
+        if m:
+            taken.append(int(m.group(1)))
+    return os.path.join(root, f"SERVE_r{max(taken) + 1:02d}.json")
+
+
+async def _drive(args, probes):
+    cfg = ServerConfig(
+        engine=args.engine,
+        min_bucket_blocks=args.bucket_min,
+        max_bucket_blocks=args.bucket_max,
+        max_depth=args.queue_depth,
+        request_deadline_s=args.deadline,
+        dispatch_deadline_s=args.dispatch_deadline,
+        retries=args.retries)
+    server = Server(cfg)
+    await server.start()
+    report = await loadgen.run(
+        server, args.requests, concurrency=args.concurrency,
+        sizes=args.sizes, tenants=args.tenants,
+        keys_per_tenant=args.keys_per_tenant, seed=args.seed,
+        verify_every=args.verify_every, probes=probes)
+    await server.stop()
+    return server, report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m our_tree_tpu.serve.bench",
+        description="closed-loop serving benchmark (docs/SERVING.md)")
+    ap.add_argument("--requests", type=int, default=500)
+    ap.add_argument("--concurrency", type=int, default=32)
+    ap.add_argument("--mixed-sizes", action="store_true",
+                    help=f"request sizes drawn from {loadgen.MIXED_SIZES} "
+                         "(the ladder-exercising menu)")
+    ap.add_argument("--size-bytes", type=int, default=4096,
+                    help="fixed request size when --mixed-sizes is off")
+    ap.add_argument("--tenants", type=int, default=4)
+    ap.add_argument("--keys-per-tenant", type=int, default=2)
+    ap.add_argument("--engine", default="auto")
+    ap.add_argument("--bucket-min", type=int, default=32, metavar="BLOCKS")
+    ap.add_argument("--bucket-max", type=int, default=4096, metavar="BLOCKS")
+    ap.add_argument("--queue-depth", type=int, default=1024)
+    ap.add_argument("--deadline", type=float, default=30.0,
+                    help="per-request residency deadline, seconds")
+    ap.add_argument("--dispatch-deadline", type=float,
+                    default=watchdog.default_deadline_s() or 10.0,
+                    help="watchdog deadline per engine call, seconds "
+                         "(default: OT_DISPATCH_DEADLINE, else 10)")
+    ap.add_argument("--retries", type=int, default=2,
+                    help="dispatch attempts per batch (1 = no retry)")
+    ap.add_argument("--verify-every", type=int, default=8,
+                    help="every Nth request replays a pinned probe and "
+                         "checks bit-exactness (0 = off)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--artifact", default=None, metavar="PATH",
+                    help="artifact path (default: next SERVE_r*.json at "
+                         "the repo root)")
+    ap.add_argument("--allow-recompiles", action="store_true",
+                    help="do not fail on post-warmup backend compiles")
+    args = ap.parse_args(argv)
+    args.sizes = (loadgen.MIXED_SIZES if args.mixed_sizes
+                  else (args.size_bytes,))
+
+    trace.ensure_run()
+    # Reference ciphertexts BEFORE the server's warmup marker: the
+    # byte-exact models path compiles per probe size, and those compiles
+    # belong to the harness, not to steady-state serving.
+    probes = (loadgen.make_probes(args.sizes, args.seed)
+              if args.verify_every else [])
+    server, report = asyncio.run(_drive(args, probes))
+    stats = server.stats()
+
+    print(f"# serve: engine={stats['engine']} ladder={stats['rungs']} "
+          f"concurrency={args.concurrency} tenants={args.tenants}")
+    print(f"# requests={report.requests} ok={report.ok} "
+          f"errors={report.errors or '{}'} verified={report.verified} "
+          f"mismatches={report.mismatches}")
+    print(f"# latency ms: p50={report.p50_ms} p95={report.p95_ms} "
+          f"p99={report.p99_ms}  goodput={report.goodput_gbps:.4f} GB/s "
+          f"wall={report.wall_s:.3f}s")
+    print(f"# batches={stats['batches']} "
+          f"failed={stats['batches_failed']} "
+          f"timed_out={stats['batches_timed_out']} "
+          f"compiles: warmup={stats['compiles']['warmup']} "
+          f"steady={stats['compiles']['steady']}")
+    for bucket, h in stats["occupancy"].items():
+        print(f"#   bucket {bucket:>5}: {h['batches']} batch(es), "
+              f"mean occupancy {h['mean_occupancy']:.2%}")
+
+    artifact = {
+        "config": {
+            "requests": args.requests, "concurrency": args.concurrency,
+            "sizes": list(args.sizes), "tenants": args.tenants,
+            "keys_per_tenant": args.keys_per_tenant,
+            "engine": stats["engine"], "rungs": stats["rungs"],
+            "retries": args.retries,
+            "dispatch_deadline_s": args.dispatch_deadline,
+            "seed": args.seed,
+        },
+        "load": report.to_json(),
+        "batches": {k: stats[k] for k in
+                    ("batches", "batches_failed", "batches_timed_out")},
+        "occupancy": stats["occupancy"],
+        "queue": stats["queue"],
+        "keycache": stats["keycache"],
+        "compiles": stats["compiles"],
+        "degraded": degrade.events(),
+    }
+    if trace.enabled():
+        artifact["obs"] = trace.metrics_snapshot()
+    path = args.artifact or _next_artifact(_repo_root())
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(artifact, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    print(f"# artifact: {path}", file=sys.stderr)
+
+    line = {"unit": "serve", "engine": stats["engine"],
+            "requests": report.requests, "ok": report.ok,
+            "errors": dict(sorted(report.errors.items())),
+            "p50_ms": report.p50_ms, "p95_ms": report.p95_ms,
+            "p99_ms": report.p99_ms,
+            "goodput_gbps": round(report.goodput_gbps, 4),
+            "batches": stats["batches"],
+            "recompiles": stats["compiles"]["steady"],
+            "mismatches": report.mismatches}
+    if degrade.events():
+        line["degraded"] = degrade.events()
+    if trace.enabled():
+        line["obs"] = trace.metrics_snapshot()
+    print(json.dumps(line))
+
+    rc = 0
+    if report.mismatches:
+        print(f"# FAIL: {report.mismatches} probe response(s) mismatched "
+              "the byte-exact reference", file=sys.stderr)
+        rc = 1
+    if stats["compiles"]["steady"] and not args.allow_recompiles:
+        print(f"# FAIL: {stats['compiles']['steady']} post-warmup backend "
+              "compile(s) — the bucket ladder's zero-recompile contract "
+              "is broken (--allow-recompiles to waive)", file=sys.stderr)
+        rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

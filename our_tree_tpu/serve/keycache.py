@@ -1,0 +1,88 @@
+"""Multi-tenant LRU cache of expanded AES key schedules.
+
+Key expansion is host-side, sequential, and per-key
+(``ops.keyschedule.expand_key_enc`` — the reference expands on host even
+for its GPU backend), plus one device staging of the 44-60 round-key
+words. Per-request that cost dwarfs a small request's crypt time; a
+service where every request names its key must make rekeying a LOOKUP.
+
+Entries are keyed by (tenant, key digest). Tenant isolation is
+structural, twice over:
+
+* **capacity** — each tenant gets its own LRU of ``per_tenant`` entries,
+  so one tenant churning through keys can never evict another tenant's
+  hot schedules (the noisy-neighbour failure of a shared LRU);
+* **identity** — the same key bytes under two tenants are two entries;
+  cache state never flows across tenants, so the cache cannot become a
+  cross-tenant oracle for "has someone else used this key".
+
+The digest (truncated SHA-256) is the cache identity and the only
+key-derived value that escapes into labels/traces — raw key bytes stay
+inside the entry.
+
+Single-event-loop discipline like the rest of serve/ (no lock); hits,
+misses and evictions are counted both locally (``stats()``) and into
+the obs counters.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from collections import OrderedDict
+
+import jax.numpy as jnp
+
+from ..obs import trace
+from ..ops.keyschedule import expand_key_enc
+
+
+def key_digest(key: bytes) -> str:
+    """The cache/trace identity of a key: truncated SHA-256 hex."""
+    return hashlib.sha256(bytes(key)).hexdigest()[:16]
+
+
+class KeyCache:
+    """tenant -> (digest -> (nr, staged round keys)) with per-tenant LRU."""
+
+    def __init__(self, per_tenant: int = 8):
+        if per_tenant < 1:
+            raise ValueError("per_tenant must be >= 1")
+        self.per_tenant = int(per_tenant)
+        self._tenants: dict[str, OrderedDict] = {}
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def get(self, tenant: str, key: bytes):
+        """(digest, nr, device round keys) for ``key`` under ``tenant``,
+        expanding and staging on miss, evicting the tenant's least
+        recently used entry past capacity."""
+        digest = key_digest(key)
+        lru = self._tenants.setdefault(tenant, OrderedDict())
+        entry = lru.get(digest)
+        if entry is not None:
+            lru.move_to_end(digest)
+            self.hits += 1
+            trace.counter("keycache_hit", tenant=tenant)
+            return (digest, *entry)
+        self.misses += 1
+        trace.counter("keycache_miss", tenant=tenant)
+        nr, rk = expand_key_enc(bytes(key))
+        entry = (nr, jnp.asarray(rk))
+        lru[digest] = entry
+        if len(lru) > self.per_tenant:
+            lru.popitem(last=False)
+            self.evictions += 1
+            trace.counter("keycache_evict", tenant=tenant)
+        return (digest, *entry)
+
+    def holds(self, tenant: str, key: bytes) -> bool:
+        """Whether the entry is cached (no LRU touch — test/introspection
+        only; production reads go through ``get``)."""
+        return key_digest(key) in self._tenants.get(tenant, {})
+
+    def stats(self) -> dict:
+        return {"hits": self.hits, "misses": self.misses,
+                "evictions": self.evictions,
+                "tenants": len(self._tenants),
+                "entries": sum(len(v) for v in self._tenants.values())}

@@ -1,0 +1,172 @@
+"""Closed-loop load generator for the serve path.
+
+``concurrency`` client coroutines each run a closed loop: draw a
+(tenant, key, size) from a seeded RNG, submit, await the response,
+repeat — so offered load adapts to service rate (the standard
+closed-loop model; there is no coordinated-omission window because a
+client never has more than one request outstanding).
+
+Correctness rides along without polluting the compile counter: a fixed
+set of PROBE requests — one per request size, keys/nonces/payloads
+pinned by the seed — is precomputed against the byte-exact models API
+(``AES.crypt_ctr``, the parity-oracle path) BEFORE the server's warmup
+marker, and every ``verify_every``-th request replays a probe and
+checks the returned bytes. Random requests exercise breadth; probes pin
+bit-exactness; neither adds a post-warmup compile (probes reuse served
+shapes, references are precomputed).
+
+Latency percentiles use the nearest-rank method on the full sample (no
+binning error at the tail); goodput counts only OK-response payload
+bytes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..models.aes import AES
+
+#: The mixed-size menu (bytes): 1 block to the default bucket ceiling.
+#: Mixed sizes are the point — a single size would never exercise the
+#: ladder's coalesce-and-pad behaviour.
+MIXED_SIZES = (16, 64, 256, 1024, 4096, 16384, 65536)
+
+
+def percentile(sorted_vals: list[float], p: float) -> float:
+    """Nearest-rank percentile (sorted input; 0 < p <= 100)."""
+    if not sorted_vals:
+        return 0.0
+    rank = max(int(np.ceil(p / 100.0 * len(sorted_vals))), 1)
+    return sorted_vals[rank - 1]
+
+
+@dataclass
+class Probe:
+    tenant: str
+    key: bytes
+    nonce: bytes
+    payload: np.ndarray
+    expected: np.ndarray
+
+
+@dataclass
+class LoadReport:
+    requests: int = 0
+    ok: int = 0
+    errors: dict = field(default_factory=dict)  #: error code -> count
+    verified: int = 0
+    mismatches: int = 0
+    wall_s: float = 0.0
+    goodput_gbps: float = 0.0
+    p50_ms: float = 0.0
+    p95_ms: float = 0.0
+    p99_ms: float = 0.0
+    latencies_ms: list = field(default_factory=list, repr=False)
+
+    def finish(self, wall_s: float, ok_bytes: int) -> None:
+        self.wall_s = wall_s
+        self.goodput_gbps = (ok_bytes / 1e9 / wall_s) if wall_s > 0 else 0.0
+        lat = sorted(self.latencies_ms)
+        self.p50_ms = round(percentile(lat, 50), 3)
+        self.p95_ms = round(percentile(lat, 95), 3)
+        self.p99_ms = round(percentile(lat, 99), 3)
+
+    def to_json(self) -> dict:
+        return {
+            "requests": self.requests, "ok": self.ok,
+            "errors": dict(sorted(self.errors.items())),
+            "verified": self.verified, "mismatches": self.mismatches,
+            "wall_s": round(self.wall_s, 3),
+            "goodput_gbps": round(self.goodput_gbps, 4),
+            "p50_ms": self.p50_ms, "p95_ms": self.p95_ms,
+            "p99_ms": self.p99_ms,
+        }
+
+
+def make_probes(sizes, seed: int) -> list[Probe]:
+    """One pinned request per size with its reference ciphertext.
+
+    Runs the byte-exact models CTR path once per size — call BEFORE the
+    server's warmup/compile marker, so reference compiles never count
+    against steady-state serving."""
+    rng = np.random.default_rng(seed ^ 0x9E3779B9)
+    probes = []
+    for size in sizes:
+        key = rng.integers(0, 256, 16, dtype=np.uint8).tobytes()
+        nonce = rng.integers(0, 256, 16, dtype=np.uint8).tobytes()
+        payload = rng.integers(0, 256, size, dtype=np.uint8)
+        ref = AES(key, engine="jnp")
+        expected, _, _, _ = ref.crypt_ctr(
+            0, np.frombuffer(nonce, np.uint8),
+            np.zeros(16, np.uint8), payload)
+        probes.append(Probe("probe", key, nonce, payload,
+                            np.asarray(expected)))
+    return probes
+
+
+async def run(server, n_requests: int, concurrency: int = 32,
+              sizes=MIXED_SIZES, tenants: int = 4, keys_per_tenant: int = 2,
+              seed: int = 0, verify_every: int = 8,
+              deadline_s: float | None = None,
+              probes: list[Probe] | None = None,
+              clock=time.monotonic) -> LoadReport:
+    """Drive ``server`` with ``n_requests`` total across ``concurrency``
+    closed-loop clients; returns the aggregated LoadReport."""
+    sizes = tuple(sizes)
+    if probes is None:
+        probes = make_probes(sizes, seed)
+    by_size = {p.payload.size: p for p in probes}
+    keys = {}
+    key_rng = np.random.default_rng(seed)
+    for t in range(tenants):
+        for k in range(keys_per_tenant):
+            keys[(t, k)] = key_rng.integers(0, 256, 16,
+                                            dtype=np.uint8).tobytes()
+    report = LoadReport()
+    counter = {"next": 0, "ok_bytes": 0}
+
+    async def client(cid: int):
+        rng = np.random.default_rng((seed << 8) ^ cid)
+        while True:
+            i = counter["next"]
+            if i >= n_requests:
+                return
+            counter["next"] = i + 1
+            size = int(rng.choice(sizes))
+            probe = by_size.get(size) if (verify_every
+                                          and i % verify_every == 0) else None
+            if probe is not None:
+                tenant, key = probe.tenant, probe.key
+                nonce, payload = probe.nonce, probe.payload
+            else:
+                tenant = f"t{int(rng.integers(tenants))}"
+                key = keys[(int(tenant[1:]),
+                            int(rng.integers(keys_per_tenant)))]
+                nonce = rng.integers(0, 256, 16, dtype=np.uint8).tobytes()
+                payload = rng.integers(0, 256, size, dtype=np.uint8)
+            t0 = clock()
+            resp = await server.submit(tenant, key, nonce, payload,
+                                       deadline_s=deadline_s)
+            dt_ms = (clock() - t0) * 1e3
+            report.requests += 1
+            report.latencies_ms.append(dt_ms)
+            if resp.ok:
+                report.ok += 1
+                counter["ok_bytes"] += int(payload.size)
+                if probe is not None:
+                    report.verified += 1
+                    if not np.array_equal(np.asarray(resp.payload),
+                                          probe.expected):
+                        report.mismatches += 1
+            else:
+                report.errors[resp.error] = (
+                    report.errors.get(resp.error, 0) + 1)
+
+    t_start = clock()
+    await asyncio.gather(*(client(c) for c in range(concurrency)))
+    report.finish(clock() - t_start, counter["ok_bytes"])
+    return report

@@ -1,0 +1,232 @@
+"""Admission control and backpressure for the serve path.
+
+The queue is where overload policy lives, and the policy is explicit:
+
+* **Bounded depth.** A queue that grows without bound converts overload
+  into unbounded latency for everyone; past ``max_depth`` new requests
+  are SHED with an immediate ``"shed"`` error response. The first shed
+  of a process is stamped through the shared degradation chokepoint
+  (``resilience.degrade``, kind ``accept->shed``) so an overloaded run
+  can never masquerade as a healthy one in its artifacts — same
+  contract as every other demotion in the repo.
+* **Per-request deadline.** Every accepted request carries a
+  ``resilience.policy.Budget``; a request whose budget is exhausted by
+  the time the batcher drains it gets a ``"deadline"`` error instead of
+  occupying device time it can no longer use (and the same error when
+  the batch it rode died at the dispatch deadline).
+* **Admission checks up front.** CTR over 16-byte blocks: payloads must
+  be a nonzero multiple of 16 bytes and fit the largest bucket rung;
+  nonces are exactly 16 bytes. Malformed requests are refused at submit
+  (``"bad-request"`` / ``"too-large"``), not discovered mid-batch.
+
+Every accepted request opens a DETACHED ``request-queued`` obs span
+(begin at admission, end at drain) — queue residency is the latency
+component the batcher's spans cannot see. Detached because request
+lifetimes overlap arbitrarily on the one event-loop thread
+(``obs.trace.detached_span``).
+
+asyncio + stdlib + resilience/obs only — no jax: admission logic is
+testable without a backend in sight.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..obs import trace
+from ..resilience import degrade
+from ..resilience.policy import Budget
+
+#: Response error codes (the closed set clients dispatch on).
+ERR_SHED = "shed"              #: queue full — back off and retry
+ERR_TOO_LARGE = "too-large"    #: payload exceeds the largest bucket
+ERR_BAD_REQUEST = "bad-request"  #: malformed payload/nonce
+ERR_DEADLINE = "deadline"      #: budget exhausted (queued or dispatching)
+ERR_DISPATCH = "dispatch-failed"  #: the batch died after retries
+ERR_SHUTDOWN = "shutdown"      #: server stopped with the request queued
+
+
+class ServeError(RuntimeError):
+    """A request-path failure with a machine-readable ``code``."""
+
+    def __init__(self, code: str, message: str = ""):
+        self.code = code
+        super().__init__(message or code)
+
+
+@dataclass
+class Response:
+    """What a request resolves to: payload bytes or a coded error."""
+
+    ok: bool
+    payload: np.ndarray | None = None  #: (len,) u8, encrypt/decrypt output
+    error: str | None = None           #: one of the ERR_* codes
+    detail: str = ""
+    queued_s: float = 0.0              #: admission -> drain residency
+    batch: str | None = None           #: label of the batch that served it
+
+
+@dataclass
+class Request:
+    """One accepted in-flight request (queue/batcher/server currency)."""
+
+    id: int
+    tenant: str
+    key: bytes
+    nonce: bytes                 #: 16 big-endian counter bytes
+    payload: np.ndarray          #: (16*nblocks,) u8
+    future: asyncio.Future
+    budget: Budget | None = None
+    t_submit: float = 0.0
+    _span_cm: object | None = field(default=None, repr=False)
+
+    @property
+    def nblocks(self) -> int:
+        return self.payload.size // 16
+
+    def resolve(self, resp: Response) -> None:
+        if not self.future.done():
+            self.future.set_result(resp)
+
+    def fail(self, code: str, detail: str = "",
+             batch: str | None = None) -> None:
+        self.resolve(Response(ok=False, error=code, detail=detail,
+                              batch=batch))
+
+
+class RequestQueue:
+    """Bounded FIFO of accepted requests with an asyncio wakeup.
+
+    Single-event-loop discipline (the server's): ``submit`` is called
+    from request coroutines, ``drain`` from the batcher loop, all on one
+    thread — no lock, by design, like the rest of the asyncio path.
+    """
+
+    def __init__(self, max_depth: int = 1024,
+                 max_request_blocks: int = 4096,
+                 default_deadline_s: float = 30.0,
+                 clock=time.monotonic):
+        self.max_depth = int(max_depth)
+        self.max_request_blocks = int(max_request_blocks)
+        self.default_deadline_s = float(default_deadline_s)
+        self._clock = clock
+        self._pending: list[Request] = []
+        self._event = asyncio.Event()
+        self._ids = itertools.count()
+        self.accepted = 0
+        self.shed = 0
+        self.refused = 0
+        self.expired = 0
+
+    def depth(self) -> int:
+        return len(self._pending)
+
+    # -- admission ---------------------------------------------------------
+    def submit(self, tenant: str, key: bytes, nonce: bytes, payload,
+               deadline_s: float | None = None) -> asyncio.Future:
+        """Admit one request; always returns a future (already resolved
+        with a coded error Response when admission refuses it — callers
+        get one uniform await, not two failure channels)."""
+        fut = asyncio.get_running_loop().create_future()
+        data = np.asarray(payload, dtype=np.uint8).reshape(-1)
+        code = None
+        if data.size == 0 or data.size % 16:
+            code, why = ERR_BAD_REQUEST, "payload must be a nonzero multiple of 16 bytes"
+        elif len(bytes(key)) not in (16, 24, 32):
+            # Refused HERE, not discovered at key expansion inside the
+            # batcher loop — admission owns malformed requests.
+            code, why = ERR_BAD_REQUEST, (
+                f"key must be 16/24/32 bytes, got {len(bytes(key))}")
+        elif len(bytes(nonce)) != 16:
+            code, why = ERR_BAD_REQUEST, "nonce must be 16 bytes"
+        elif data.size // 16 > self.max_request_blocks:
+            code, why = ERR_TOO_LARGE, (
+                f"{data.size // 16} blocks > bucket ceiling "
+                f"{self.max_request_blocks}")
+        elif len(self._pending) >= self.max_depth:
+            code, why = ERR_SHED, f"queue depth {self.max_depth} reached"
+            self.shed += 1
+            trace.counter("serve_shed", tenant=tenant)
+            # First shed = the process entered overload shedding: a
+            # demotion of the accept path, recorded like every other
+            # demotion (duplicate kinds collapse in the ledger).
+            degrade.degrade(
+                "accept->shed",
+                f"serve queue overloaded (depth {self.max_depth}); "
+                f"shedding new requests")
+        if code is not None:
+            if code != ERR_SHED:
+                self.refused += 1
+            fut.set_result(Response(ok=False, error=code, detail=why))
+            return fut
+        deadline = (self.default_deadline_s if deadline_s is None
+                    else float(deadline_s))
+        req = Request(
+            id=next(self._ids), tenant=tenant, key=bytes(key),
+            nonce=bytes(nonce), payload=data, future=fut,
+            budget=Budget(deadline, clock=self._clock) if deadline > 0
+            else None,
+            t_submit=self._clock())
+        cm = trace.detached_span("request-queued", req=req.id,
+                                 tenant=tenant, blocks=req.nblocks)
+        cm.__enter__()
+        req._span_cm = cm
+        self._pending.append(req)
+        self.accepted += 1
+        trace.counter("serve_requests", tenant=tenant)
+        self._event.set()
+        return fut
+
+    # -- the batcher side --------------------------------------------------
+    async def wait(self) -> None:
+        """Block until at least one request MAY be pending (spurious
+        wakeups fine — drain() returning [] is the check)."""
+        await self._event.wait()
+        self._event.clear()
+
+    def kick(self) -> None:
+        """Wake a waiting drain loop (shutdown path)."""
+        self._event.set()
+
+    def drain(self) -> list[Request]:
+        """Take everything pending: closes each request's queued span and
+        fails the ones whose deadline budget is already spent — they can
+        no longer use the device time a batch would give them."""
+        taken, self._pending = self._pending, []
+        live = []
+        for req in taken:
+            queued_s = self._clock() - req.t_submit
+            if req.budget is not None and req.budget.exhausted():
+                self.expired += 1
+                trace.counter("serve_deadline_expired", tenant=req.tenant)
+                if req._span_cm is not None:
+                    req._span_cm.__exit__(TimeoutError, None, None)
+                req.resolve(Response(
+                    ok=False, error=ERR_DEADLINE,
+                    detail=f"spent {req.budget.spent():.3f}s queued",
+                    queued_s=queued_s))
+                continue
+            if req._span_cm is not None:
+                req._span_cm.__exit__(None, None, None)
+            live.append(req)
+        return live
+
+    def flush(self, code: str = ERR_SHUTDOWN) -> int:
+        """Fail everything still queued (server shutdown): every span
+        closes — a clean stop leaves no orphans."""
+        taken, self._pending = self._pending, []
+        for req in taken:
+            if req._span_cm is not None:
+                req._span_cm.__exit__(RuntimeError, None, None)
+            req.fail(code, "server stopped before dispatch")
+        return len(taken)
+
+    def stats(self) -> dict:
+        return {"accepted": self.accepted, "shed": self.shed,
+                "refused": self.refused, "expired": self.expired,
+                "depth": self.depth()}

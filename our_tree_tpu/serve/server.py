@@ -1,0 +1,298 @@
+"""The serve dispatch loop: queue -> shape buckets -> guarded engine calls.
+
+One asyncio loop on the main thread owns the whole path. Request
+coroutines ``submit`` into the bounded queue; the batcher loop drains,
+coalesces per (tenant, key) into ladder rungs (``batcher``), and
+dispatches each batch synchronously through the scattered-CTR seam
+(``models.aes.ctr_crypt_words_scattered`` under the engine
+``resolve_engine`` picked at start). Synchronous on purpose: one device
+serializes dispatches anyway, and keeping the engine call on the MAIN
+thread is what lets the watchdog's SIGALRM interrupt a wedged dispatch
+(resilience/watchdog.py's GIL-releasing contract).
+
+Failure containment, per batch:
+
+* transient dispatch failures retry through the shared ``RetryPolicy``
+  (``serve-dispatch``; every failed attempt is a ``retry_failures``
+  trace counter like every other policy in the repo);
+* a batch that still fails resolves EVERY rider with a per-request
+  ``dispatch-failed`` error — the server keeps serving;
+* a batch killed by the watchdog (``DispatchTimeout``) resolves its
+  riders with ``deadline`` errors and deliberately ABANDONS its
+  ``batch-dispatched`` span: the dispatch never ended, so the orphaned
+  begin is the honest evidence — the same closed-by-kill shape a
+  SIGKILLed sweep child leaves, and what the CI gate pins with
+  ``obs.report --check --expected-orphans batch-dispatched``.
+
+The fault seam (``serve_dispatch``, plus the generic ``dispatch_fail`` /
+``dispatch_hang``) sits inside the guard; the SERVE-LEVEL seams are
+exempt during warmup — warmup is not traffic, and a counted CI shot
+should land on a served batch, not on the ladder priming. Deeper engine
+seams keep their own semantics: on a Pallas engine the launch seam
+(``ops/pallas_aes.py:_dispatch_seam``) fires for priming dispatches
+like any other first device contact, so there an armed generic fault
+can fail ``start()`` loudly — a server that cannot prime its ladder
+cannot serve, and masking that would be worse. The CPU CI rehearsals
+run the jnp engine, where the serve seams are the only ones.
+
+Obs spans: ``request-queued`` (queue.py, admission->drain),
+``batch-formed`` (array packing), ``batch-dispatched`` (the engine
+call, ``engine`` attr for the report's per-engine table).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+
+import jax
+import numpy as np
+
+from ..models import aes
+from ..obs import trace
+from ..resilience import faults, watchdog
+from ..resilience.policy import RetryPolicy
+from . import batcher
+from .keycache import KeyCache, key_digest
+from .queue import ERR_DEADLINE, ERR_DISPATCH, RequestQueue
+
+#: The jax monitoring event that fires once per REAL backend compile and
+#: never on an executable-cache hit — the zero-recompile assertion's
+#: ground truth (``serve.bench --requests N --mixed-sizes`` must hold it
+#: flat after warmup).
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_COMPILES = 0
+_MONITOR_ON = False
+
+
+def _on_event(name: str, *args, **kw) -> None:
+    global _COMPILES
+    if name == _COMPILE_EVENT:
+        _COMPILES += 1
+
+
+def compile_count() -> int:
+    """Backend compiles observed in this process since the first call
+    (callers difference two snapshots; the absolute value is unanchored)."""
+    global _MONITOR_ON
+    if not _MONITOR_ON:
+        jax.monitoring.register_event_duration_secs_listener(_on_event)
+        _MONITOR_ON = True
+    return _COMPILES
+
+
+@dataclass
+class ServerConfig:
+    engine: str = "auto"
+    min_bucket_blocks: int = batcher.DEFAULT_MIN_BLOCKS
+    max_bucket_blocks: int = batcher.DEFAULT_MAX_BLOCKS
+    max_depth: int = 1024
+    #: per-request residency deadline (queue admission -> response)
+    request_deadline_s: float = 30.0
+    #: watchdog deadline around each engine call; None = the global
+    #: OT_DISPATCH_DEADLINE default (0/unset disarms, like every seam)
+    dispatch_deadline_s: float | None = None
+    #: RetryPolicy attempts per batch (1 = no retry)
+    retries: int = 2
+    keycache_per_tenant: int = 8
+    #: key lengths (bits) warmed per rung — a key size outside this set
+    #: still works, it just pays its first-contact compile online
+    warmup_key_bits: tuple = (128,)
+
+
+class Server:
+    """The online crypto service over the offline engines."""
+
+    def __init__(self, config: ServerConfig | None = None):
+        self.config = config or ServerConfig()
+        c = self.config
+        self.rungs = batcher.bucket_ladder(c.min_bucket_blocks,
+                                           c.max_bucket_blocks)
+        self.queue = RequestQueue(max_depth=c.max_depth,
+                                  max_request_blocks=self.rungs[-1],
+                                  default_deadline_s=c.request_deadline_s)
+        self.keycache = KeyCache(per_tenant=c.keycache_per_tenant)
+        self.engine: str | None = None  # resolved at start
+        self._deadline_s = (watchdog.default_deadline_s()
+                            if c.dispatch_deadline_s is None
+                            else max(float(c.dispatch_deadline_s), 0.0))
+        self._policy = RetryPolicy(
+            attempts=max(int(c.retries), 1), base_delay_s=0.0,
+            retry_on=(RuntimeError,), name="serve-dispatch")
+        self._task: asyncio.Task | None = None
+        self._running = False
+        self.batches = 0
+        self.batches_failed = 0
+        self.batches_timed_out = 0
+        #: bucket -> {"batches", "blocks"} running totals (O(#rungs)
+        #: memory — a week-long soak must not grow per-batch state)
+        self._occupancy: dict[int, dict] = {}
+        self.warmup_compiles = 0
+        self._compiles_at_ready = 0
+
+    # -- lifecycle ---------------------------------------------------------
+    async def start(self) -> None:
+        """Resolve the engine, warm the ladder, start the batcher loop."""
+        before = compile_count()
+        self.engine = aes.resolve_engine(self.config.engine)
+        with trace.span("serve-warmup", engine=self.engine,
+                        rungs=len(self.rungs)):
+            for bits in self.config.warmup_key_bits:
+                _, nr, rk = self.keycache.get("_warmup",
+                                              b"\x00" * (bits // 8))
+                for rung in self.rungs:
+                    words = np.zeros(4 * rung, dtype=np.uint32)
+                    self._engine_call(words, words, rk, nr,
+                                      f"warmup:{rung}", warmup=True)
+        self._compiles_at_ready = compile_count()
+        self.warmup_compiles = self._compiles_at_ready - before
+        trace.gauge("serve_warmup_compiles", self.warmup_compiles,
+                    engine=self.engine)
+        self._running = True
+        self._task = asyncio.ensure_future(self._loop())
+
+    async def stop(self) -> None:
+        self._running = False
+        self.queue.kick()
+        if self._task is not None:
+            await self._task
+            self._task = None
+        self.queue.flush()
+
+    def steady_compiles(self) -> int:
+        """Backend compiles since warmup finished — the number the bucket
+        ladder exists to hold at zero."""
+        return compile_count() - self._compiles_at_ready
+
+    # -- request side ------------------------------------------------------
+    async def submit(self, tenant: str, key: bytes, nonce: bytes, payload,
+                     deadline_s: float | None = None):
+        """Admit one CTR crypt request and await its Response."""
+        return await self.queue.submit(tenant, key, nonce, payload,
+                                       deadline_s)
+
+    # -- the batcher loop --------------------------------------------------
+    async def _loop(self) -> None:
+        while self._running:
+            await self.queue.wait()
+            while True:
+                requests = self.queue.drain()
+                if not requests:
+                    break
+                for b in batcher.form_batches(requests, self.rungs,
+                                              key_digest):
+                    self._run_batch(b)
+                    # Yield between batches: resolved clients get to
+                    # resubmit, so the next drain coalesces their
+                    # follow-ups (the "continuous" in continuous
+                    # batching under a closed loop).
+                    await asyncio.sleep(0)
+
+    def _run_batch(self, b: batcher.Batch) -> None:
+        """One batch, contained: NO exception may escape — an escape
+        would kill the batcher task and wedge every future request, so
+        anything unexpected resolves the riders with errors and the
+        loop lives on."""
+        try:
+            with trace.span("batch-formed", batch=b.label, bucket=b.bucket,
+                            blocks=b.blocks, requests=len(b.requests)):
+                _, nr, rk = self.keycache.get(b.tenant, b.key)
+                b.materialise()
+        except Exception as e:  # noqa: BLE001 - containment (docstring)
+            self.batches_failed += 1
+            trace.counter("serve_batch_failed", batch=b.label)
+            for req in b.requests:
+                req.fail(ERR_DISPATCH, f"{type(e).__name__}: {e}",
+                         batch=b.label)
+            return
+        self.batches += 1
+        occ = self._occupancy.setdefault(b.bucket,
+                                         {"batches": 0, "blocks": 0})
+        occ["batches"] += 1
+        occ["blocks"] += b.blocks
+        cm = trace.detached_span(
+            "batch-dispatched", batch=b.label, bucket=b.bucket,
+            blocks=b.blocks, requests=len(b.requests), engine=self.engine)
+        cm.__enter__()
+        try:
+            out = self._policy.run(lambda att: self._engine_call(
+                b.words, b.ctr_words, rk, nr, b.label))
+        except watchdog.DispatchTimeout as e:
+            # The dispatch never completed: the span is ABANDONED, not
+            # closed — its orphaned begin is the kill evidence
+            # (module docstring; the CI gate's --expected-orphans).
+            self.batches_timed_out += 1
+            trace.counter("serve_batch_deadline", batch=b.label)
+            for req in b.requests:
+                req.fail(ERR_DEADLINE, str(e), batch=b.label)
+            return
+        except Exception as e:  # noqa: BLE001 - containment (docstring)
+            cm.__exit__(type(e), e, None)
+            self.batches_failed += 1
+            trace.counter("serve_batch_failed", batch=b.label)
+            for req in b.requests:
+                req.fail(ERR_DISPATCH, f"{type(e).__name__}: {e}",
+                         batch=b.label)
+            return
+        cm.__exit__(None, None, None)
+        from .queue import Response  # cycle-free: queue never imports us
+
+        try:
+            for req, data in zip(b.requests, b.split_output(out)):
+                req.resolve(Response(ok=True, payload=data, batch=b.label))
+        except Exception as e:  # noqa: BLE001 - containment (docstring)
+            # E.g. a wrongly-shaped engine result breaking split_output:
+            # riders not yet resolved get errors (fail() no-ops on the
+            # already-resolved ones) and the loop lives on.
+            self.batches_failed += 1
+            trace.counter("serve_batch_failed", batch=b.label)
+            for req in b.requests:
+                req.fail(ERR_DISPATCH, f"{type(e).__name__}: {e}",
+                         batch=b.label)
+
+    # -- the guarded engine call ------------------------------------------
+    def _engine_call(self, words, ctr_words, rk, nr, label,
+                     warmup: bool = False):
+        """One scattered-CTR dispatch under the watchdog. The
+        serve-level fault seams fire only for traffic (warmup primes
+        compiles, it is not a servable batch — a counted CI shot should
+        land on requests); engine-internal seams, where an engine has
+        them, see warmup like any first dispatch (module docstring).
+        Warmup also swaps the SERVING deadline for the global opt-in one
+        (OT_DISPATCH_DEADLINE): a first-contact compile legitimately
+        dwarfs a steady-state dispatch, and killing the ladder priming
+        at the per-batch latency budget would wedge every cold start."""
+        deadline_s = (watchdog.default_deadline_s() if warmup
+                      else self._deadline_s)
+        with watchdog.deadline(deadline_s,
+                               what=f"serve dispatch {label}"):
+            if not warmup:
+                faults.check("serve_dispatch", label)
+                faults.check("dispatch_fail", label)
+                watchdog.injected_hang("dispatch_hang", label)
+            out = aes.ctr_crypt_words_scattered(
+                words, ctr_words, rk, nr, self.engine)
+            jax.block_until_ready(out)
+        return np.asarray(out)
+
+    # -- introspection -----------------------------------------------------
+    def occupancy_histogram(self) -> dict:
+        """bucket rung -> {batches, mean occupancy} (the padding price)."""
+        return {str(bucket): {
+            "batches": h["batches"],
+            "mean_occupancy": round(h["blocks"] / (h["batches"] * bucket), 4)}
+            for bucket, h in sorted(self._occupancy.items())}
+
+    def stats(self) -> dict:
+        return {
+            "engine": self.engine,
+            "rungs": list(self.rungs),
+            "batches": self.batches,
+            "batches_failed": self.batches_failed,
+            "batches_timed_out": self.batches_timed_out,
+            "occupancy": self.occupancy_histogram(),
+            "queue": self.queue.stats(),
+            "keycache": self.keycache.stats(),
+            "compiles": {"warmup": self.warmup_compiles,
+                         "steady": self.steady_compiles()},
+        }

@@ -55,6 +55,37 @@ def byteswap32(w: jnp.ndarray) -> jnp.ndarray:
     )
 
 
+def np_ctr_le_blocks(nonce_counter: np.ndarray | bytes,
+                     idx: np.ndarray) -> np.ndarray:
+    """Counter blocks ``nonce + idx[k]`` as the (N, 4) u32 LE words the
+    cipher consumes — the host-side twin of ``models.aes.ctr_le_blocks``
+    (tests pin the two against each other across multi-word carries).
+
+    The serve batcher materialises each request's counter stream with
+    this before concatenating requests into one scattered-CTR dispatch
+    (``models.aes.ctr_crypt_words_scattered``); building counters on host
+    keeps the device call a pure fixed-shape engine dispatch.
+
+    ``nonce_counter``: the 16 big-endian counter bytes (the resume-state
+    convention of ``AES.crypt_ctr``); ``idx``: (N,) block offsets < 2^32.
+    """
+    b = np.frombuffer(bytes(nonce_counter), dtype=np.uint8)
+    if b.size != 16:
+        raise ValueError("nonce_counter must be 16 bytes")
+    ctr_be = np_bytes_to_words(b).byteswap()  # (4,) big-endian words
+    idx = np.asarray(idx, dtype=np.uint32)
+    with np.errstate(over="ignore"):  # 128-bit ripple: word wrap intended
+        s3 = (ctr_be[3] + idx).astype(np.uint32)
+        c3 = (s3 < idx).astype(np.uint32)
+        s2 = (ctr_be[2] + c3).astype(np.uint32)
+        c2 = c3 & (s2 == 0)
+        s1 = (ctr_be[1] + c2).astype(np.uint32)
+        c1 = c2 & (s1 == 0)
+        s0 = (ctr_be[0] + c1).astype(np.uint32)
+    be = np.stack([s0, s1, s2, s3], axis=-1)
+    return be.byteswap()  # LE words of the counter byte stream
+
+
 def hex_to_bytes(s: str) -> np.ndarray:
     return np.frombuffer(bytes.fromhex(s), dtype=np.uint8)
 

@@ -516,3 +516,107 @@ def test_root_bench_report_stamps_obs_snapshot(tmp_path, monkeypatch, capsys):
         assert line["obs"]["spans"] >= 1
     finally:
         trace.reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# Detached spans (the serve path's overlapping lifecycles) and the
+# OT_TRACE_MAX_MB soak-run rotation.
+# ---------------------------------------------------------------------------
+
+
+def test_detached_spans_overlap_without_stack_corruption(traced):
+    """Two detached spans closed in FIFO (non-LIFO) order must not
+    disturb the parentage of regular spans opened in between, and a
+    deliberately unexited one is an orphan."""
+    cm_a = trace.detached_span("request-queued", req=0)
+    sp_a = cm_a.__enter__()
+    cm_b = trace.detached_span("request-queued", req=1)
+    cm_b.__enter__()
+    with trace.span("batch-formed") as formed:
+        assert trace.current_span_id() == formed.id
+    cm_a.__exit__(None, None, None)   # FIFO: a before b
+    cm_a.__exit__(None, None, None)   # idempotent: second exit is a no-op
+    cm_b.__exit__(TimeoutError, None, None)
+    with trace.span("outer") as outer:
+        # Detached begins while a regular span is live adopt it as parent.
+        cm_c = trace.detached_span("batch-dispatched")
+        sp_c = cm_c.__enter__()
+        assert trace.current_span_id() == outer.id  # stack untouched
+    run = export.load_run(str(traced))
+    assert not run.violations
+    a, c = run.spans[sp_a.id], run.spans[sp_c.id]
+    assert a.status == "ok" and a.parent is None
+    assert c.orphan and c.parent == outer.id  # cm_c never exited
+    assert [s.name for s in run.orphans()] == ["batch-dispatched"]
+    statuses = {s.attrs.get("req"): s.status for s in run.spans.values()
+                if s.name == "request-queued"}
+    assert statuses == {0: "ok", 1: "error:TimeoutError"}
+
+
+def test_trace_rotation_caps_disk(tmp_path, monkeypatch):
+    """With OT_TRACE_MAX_MB set, the event file rotates into segments
+    and the oldest are deleted: total size stays under the cap, every
+    surviving segment is a valid self-describing trace file, and the
+    newest events survive."""
+    monkeypatch.setenv("OT_TRACE_DIR", str(tmp_path / "tr"))
+    monkeypatch.setenv("OT_TRACE_RUN", "t-rot")
+    cap_mb = 0.05  # 50 KiB cap -> ~12 KiB segments
+    monkeypatch.setenv("OT_TRACE_MAX_MB", str(cap_mb))
+    trace.reset_for_tests()
+    try:
+        n = 2000
+        for i in range(n):
+            trace.point("soak", i=i, pad="x" * 80)
+    finally:
+        files = sorted((tmp_path / "tr" / "t-rot").glob("trace-*.jsonl"))
+        trace.reset_for_tests()
+        monkeypatch.delenv("OT_TRACE_MAX_MB")
+    assert len(files) > 1  # it rotated
+    total = sum(f.stat().st_size for f in files)
+    assert total <= cap_mb * (1 << 20) * 1.1  # capped (one event of slack)
+    last_seen = -1
+    for f in files:
+        recs = [json.loads(l) for l in f.read_text().splitlines()]
+        assert recs[0]["kind"] == "ot-trace" and recs[0]["v"] == 1
+        pts = [r for r in recs[1:] if r.get("ev") == "p"]
+        assert pts, f"segment {f.name} carries no events"
+        last_seen = max(last_seen, max(r["attrs"]["i"] for r in pts))
+    assert last_seen == n - 1  # the newest history survives
+    # Early history was evicted: that is the documented soak tradeoff.
+    earliest = min(
+        json.loads(f.read_text().splitlines()[1])["attrs"]["i"]
+        for f in files if len(f.read_text().splitlines()) > 1)
+    assert earliest > 0
+
+
+def test_trace_rotation_survives_failed_segment_open(tmp_path, monkeypatch):
+    """ENOSPC mid-soak (a failed new-segment open) must leave the
+    CURRENT handle live — events keep flowing to the full segment and
+    rotation retries later — rather than stranding a closed handle that
+    silently ends tracing for the process."""
+    monkeypatch.setenv("OT_TRACE_DIR", str(tmp_path / "tr"))
+    monkeypatch.setenv("OT_TRACE_RUN", "t-rotfail")
+    monkeypatch.setenv("OT_TRACE_MAX_MB", "0.01")
+    trace.reset_for_tests()
+    try:
+        trace.point("first")  # opens segment 0
+        def refuse(state):
+            raise OSError(28, "No space left on device")
+        monkeypatch.setattr(trace, "_open_segment_locked", refuse)
+        for i in range(200):  # crosses the segment threshold repeatedly
+            trace.point("soak", i=i, pad="x" * 100)
+        dropped_mid = trace.metrics_snapshot().get("dropped", 0)
+        monkeypatch.undo()  # restore the real opener ("space freed")
+        monkeypatch.setenv("OT_TRACE_DIR", str(tmp_path / "tr"))
+        monkeypatch.setenv("OT_TRACE_RUN", "t-rotfail")
+        monkeypatch.setenv("OT_TRACE_MAX_MB", "0.01")
+        trace.point("after", tag="recovered")
+    finally:
+        files = sorted((tmp_path / "tr" / "t-rotfail").glob("trace-*.jsonl"))
+        trace.reset_for_tests()
+    assert dropped_mid == 0  # nothing lost while rotation was refused
+    recs = [json.loads(l) for f in files for l in f.read_text().splitlines()]
+    pts = [r for r in recs if r.get("ev") == "p"]
+    assert sum(1 for r in pts if r["name"] == "soak") == 200
+    assert any(r["name"] == "after" for r in pts)  # rotation resumed
+    assert len(files) >= 2  # and did eventually rotate

@@ -1,0 +1,469 @@
+"""ot-serve (our_tree_tpu/serve): the online request path.
+
+Covers the bucket ladder geometry, host/traced counter parity, the
+scattered-CTR models seam, keycache LRU + tenant isolation, queue
+admission/shed/deadline semantics, end-to-end bit-exactness against the
+byte-exact models API, the ZERO-RECOMPILE contract after warmup, the
+fault matrix at the serve seam (dispatch_fail retried / exhausted,
+serve_dispatch, dispatch_hang under the watchdog with the orphaned
+batch span gating obs.report), and the bench CLI artifact.
+"""
+
+import asyncio
+import io
+import json
+import pathlib
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from our_tree_tpu.models import aes
+from our_tree_tpu.models.aes import AES
+from our_tree_tpu.obs import export, report, trace
+from our_tree_tpu.ops.keyschedule import expand_key_enc
+from our_tree_tpu.resilience import degrade, faults
+from our_tree_tpu.serve import batcher, keycache, loadgen
+from our_tree_tpu.serve import bench as serve_bench
+from our_tree_tpu.serve import queue as otq
+from our_tree_tpu.serve.server import Server, ServerConfig, compile_count
+from our_tree_tpu.utils import packing
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+#: Small ladder for fast tests: 4 rungs, ceiling 256 blocks (4 KiB).
+LADDER = dict(min_bucket_blocks=32, max_bucket_blocks=256)
+
+
+@pytest.fixture(autouse=True)
+def _clean_process_state(monkeypatch):
+    """The serve path writes the process-global fault registry and
+    degrade ledger; isolate every test on both sides."""
+    monkeypatch.delenv("OT_FAULTS", raising=False)
+    monkeypatch.delenv("OT_DISPATCH_DEADLINE", raising=False)
+    faults.reset()
+    degrade.clear()
+    yield
+    monkeypatch.delenv("OT_FAULTS", raising=False)
+    faults.reset()
+    degrade.clear()
+
+
+@pytest.fixture
+def traced(tmp_path, monkeypatch):
+    monkeypatch.setenv("OT_TRACE_DIR", str(tmp_path / "tr"))
+    monkeypatch.setenv("OT_TRACE_RUN", "t-serve")
+    monkeypatch.delenv("OT_TRACE_PARENT", raising=False)
+    trace.reset_for_tests()
+    yield tmp_path / "tr" / "t-serve"
+    trace.reset_for_tests()
+
+
+def _ref_ctr(key: bytes, nonce: bytes, payload: np.ndarray) -> np.ndarray:
+    out, _, _, _ = AES(key, engine="jnp").crypt_ctr(
+        0, np.frombuffer(nonce, np.uint8), np.zeros(16, np.uint8), payload)
+    return np.asarray(out)
+
+
+# ---------------------------------------------------------------------------
+# Ladder + counters + the scattered-CTR models seam.
+# ---------------------------------------------------------------------------
+
+
+def test_bucket_ladder_and_bucket_for():
+    rungs = batcher.bucket_ladder(32, 4096)
+    assert rungs == (32, 64, 128, 256, 512, 1024, 2048, 4096)
+    assert batcher.bucket_for(1, rungs) == 32
+    assert batcher.bucket_for(33, rungs) == 64
+    assert batcher.bucket_for(4096, rungs) == 4096
+    with pytest.raises(ValueError):
+        batcher.bucket_for(4097, rungs)
+    # Non-pow2 ceiling is kept as the top rung.
+    assert batcher.bucket_ladder(32, 96) == (32, 64, 96)
+    with pytest.raises(ValueError):
+        batcher.bucket_ladder(0, 64)
+
+
+@pytest.mark.parametrize("nonce_int", [
+    0, 5, (1 << 32) - 2, (1 << 64) - 1, (1 << 128) - 3])
+def test_np_ctr_le_blocks_matches_traced(nonce_int):
+    """The host counter materialiser is the traced one, bit for bit,
+    across multi-word carries."""
+    nonce = nonce_int.to_bytes(16, "big")
+    idx = np.arange(9, dtype=np.uint32)
+    host = packing.np_ctr_le_blocks(nonce, idx)
+    ctr_be = jnp.asarray(packing.np_bytes_to_words(
+        np.frombuffer(nonce, np.uint8)).byteswap())
+    dev = np.asarray(aes.ctr_le_blocks(ctr_be, jnp.asarray(idx)))
+    assert np.array_equal(host, dev)
+
+
+def test_scattered_ctr_matches_base_and_segments():
+    """One scattered dispatch over two concatenated counter streams ==
+    two independent base-counter CTR calls (the batching identity)."""
+    rng = np.random.default_rng(7)
+    key = bytes(range(16))
+    nr, rk = expand_key_enc(key)
+    rk = jnp.asarray(rk)
+    n1, n2 = 5, 11
+    data = rng.integers(0, 256, 16 * (n1 + n2), dtype=np.uint8)
+    nonces = [rng.integers(0, 256, 16, dtype=np.uint8).tobytes()
+              for _ in range(2)]
+    w = jnp.asarray(packing.np_bytes_to_words(data))
+    ctr = np.concatenate([
+        packing.np_ctr_le_blocks(nonces[0], np.arange(n1, dtype=np.uint32)),
+        packing.np_ctr_le_blocks(nonces[1], np.arange(n2, dtype=np.uint32)),
+    ]).reshape(-1)
+    got = np.asarray(aes.ctr_crypt_words_scattered(
+        w, jnp.asarray(ctr), rk, nr, "jnp"))
+    got_bytes = packing.np_words_to_bytes(got.reshape(-1, 4)).reshape(-1)
+    want = np.concatenate([
+        _ref_ctr(key, nonces[0], data[:16 * n1]),
+        _ref_ctr(key, nonces[1], data[16 * n1:]),
+    ])
+    assert np.array_equal(got_bytes, want)
+
+
+# ---------------------------------------------------------------------------
+# Key cache.
+# ---------------------------------------------------------------------------
+
+
+def test_keycache_hit_miss_lru_eviction():
+    kc = keycache.KeyCache(per_tenant=2)
+    k1, k2, k3 = (bytes([i]) * 16 for i in (1, 2, 3))
+    d1, nr, rk = kc.get("t", k1)
+    assert nr == 10 and np.array_equal(np.asarray(rk), expand_key_enc(k1)[1])
+    assert kc.get("t", k1)[0] == d1 and kc.stats()["hits"] == 1
+    kc.get("t", k2)
+    kc.get("t", k1)          # touch k1: k2 becomes LRU
+    kc.get("t", k3)          # evicts k2
+    assert kc.holds("t", k1) and kc.holds("t", k3)
+    assert not kc.holds("t", k2)
+    s = kc.stats()
+    assert s["evictions"] == 1 and s["misses"] == 3 and s["entries"] == 2
+
+
+def test_keycache_tenant_isolation():
+    kc = keycache.KeyCache(per_tenant=1)
+    shared = b"\x42" * 16
+    kc.get("alice", shared)
+    kc.get("bob", shared)
+    assert kc.stats()["misses"] == 2  # same key, two tenants, two entries
+    # A tenant churning keys never evicts the other tenant's entry.
+    for i in range(5):
+        kc.get("bob", bytes([i]) * 16)
+    assert kc.holds("alice", shared)
+    assert kc.stats()["tenants"] == 2
+
+
+# ---------------------------------------------------------------------------
+# Queue admission / backpressure.
+# ---------------------------------------------------------------------------
+
+
+def test_queue_admission_refusals():
+    async def main():
+        q = otq.RequestQueue(max_depth=4, max_request_blocks=8)
+        r1 = await q.submit("t", b"k" * 16, b"n" * 16, np.zeros(15, np.uint8))
+        r2 = await q.submit("t", b"k" * 16, b"n" * 8,
+                            np.zeros(16, np.uint8))
+        r3 = await q.submit("t", b"k" * 16, b"n" * 16,
+                            np.zeros(16 * 9, np.uint8))
+        # A malformed KEY is refused at admission too — discovering it
+        # at expansion inside the batcher loop would kill the loop.
+        r4 = await q.submit("t", b"k" * 15, b"n" * 16,
+                            np.zeros(16, np.uint8))
+        assert (r1.error, r2.error, r3.error, r4.error) == (
+            otq.ERR_BAD_REQUEST, otq.ERR_BAD_REQUEST, otq.ERR_TOO_LARGE,
+            otq.ERR_BAD_REQUEST)
+        assert q.stats()["refused"] == 4 and q.depth() == 0
+
+    asyncio.run(main())
+
+
+def test_queue_shed_stamps_degrade_ledger():
+    async def main():
+        q = otq.RequestQueue(max_depth=2)
+        futs = [q.submit("t", b"k" * 16, b"n" * 16,
+                         np.zeros(16, np.uint8)) for _ in range(4)]
+        shed = [await f for f in futs[2:]]
+        assert all(r.error == otq.ERR_SHED for r in shed)
+        assert q.stats()["shed"] == 2 and q.depth() == 2
+        assert "accept->shed" in degrade.events()  # overload is stamped
+        q.flush()
+
+    asyncio.run(main())
+
+
+def test_queue_deadline_expires_at_drain():
+    async def main():
+        clock = {"t": 0.0}
+        q = otq.RequestQueue(max_depth=8, default_deadline_s=1.0,
+                             clock=lambda: clock["t"])
+        f1 = q.submit("t", b"k" * 16, b"n" * 16, np.zeros(16, np.uint8))
+        f2 = q.submit("t", b"k" * 16, b"n" * 16, np.zeros(16, np.uint8),
+                      deadline_s=10.0)
+        clock["t"] = 2.0  # past f1's budget, inside f2's
+        live = q.drain()
+        assert [r.id for r in live] == [1]
+        r1 = await f1
+        assert r1.error == otq.ERR_DEADLINE and q.stats()["expired"] == 1
+        live[0].fail(otq.ERR_SHUTDOWN)
+
+    asyncio.run(main())
+
+
+def test_form_batches_groups_and_packs():
+    def req(rid, tenant, key, nblocks):
+        return otq.Request(id=rid, tenant=tenant, key=key, nonce=b"\0" * 16,
+                           payload=np.zeros(16 * nblocks, np.uint8),
+                           future=None)
+
+    ka, kb = b"a" * 16, b"b" * 16
+    rungs = batcher.bucket_ladder(32, 128)
+    reqs = [req(0, "t0", ka, 10), req(1, "t1", ka, 4), req(2, "t0", ka, 30),
+            req(3, "t0", kb, 100), req(4, "t0", ka, 120)]
+    batches = batcher.form_batches(reqs, rungs, keycache.key_digest)
+    # t0/ka: 10+30 fits 64; +120 would pass the 128 ceiling -> second
+    # batch. t1/ka and t0/kb are their own groups (tenant AND key).
+    got = [(b.tenant, b.key, b.bucket, b.blocks, [r.id for r in b.requests])
+           for b in batches]
+    assert got == [
+        ("t0", ka, 64, 40, [0, 2]),
+        ("t0", ka, 128, 120, [4]),
+        ("t1", ka, 32, 4, [1]),
+        ("t0", kb, 128, 100, [3]),
+    ]
+    b0 = batches[0]
+    b0.materialise()
+    assert b0.words.shape == (4 * 64,) and b0.ctr_words.shape == (4 * 64,)
+    assert b0.occupancy == 40 / 64
+
+
+# ---------------------------------------------------------------------------
+# Server end-to-end.
+# ---------------------------------------------------------------------------
+
+
+def _run_server(config, fn):
+    async def main():
+        server = Server(config)
+        await server.start()
+        try:
+            return server, await fn(server)
+        finally:
+            await server.stop()
+
+    return asyncio.run(main())
+
+
+def test_server_end_to_end_bit_exact():
+    rng = np.random.default_rng(11)
+    cases = []
+    for tenant in ("t0", "t1"):
+        for size in (16, 48, 1024, 4096):
+            key = rng.integers(0, 256, 16, dtype=np.uint8).tobytes()
+            nonce = rng.integers(0, 256, 16, dtype=np.uint8).tobytes()
+            payload = rng.integers(0, 256, size, dtype=np.uint8)
+            cases.append((tenant, key, nonce, payload,
+                          _ref_ctr(key, nonce, payload)))
+
+    async def drive(server):
+        return await asyncio.gather(*(
+            server.submit(t, k, n, p) for t, k, n, p, _ in cases))
+
+    server, resps = _run_server(ServerConfig(**LADDER), drive)
+    for (t, k, n, p, want), resp in zip(cases, resps):
+        assert resp.ok, resp
+        assert np.array_equal(np.asarray(resp.payload), want)
+    assert server.batches >= 1
+    assert server.queue.stats()["accepted"] == len(cases)
+
+
+def test_server_zero_recompiles_after_warmup():
+    """The acceptance contract: a mixed-size request stream after warmup
+    triggers no backend compile — the bucket ladder absorbs every shape."""
+    sizes = (16, 64, 512, 2048, 4096, 1024, 16, 4096)
+    rng = np.random.default_rng(3)
+
+    async def drive(server):
+        baseline = compile_count()
+        for round_ in range(3):
+            resps = await asyncio.gather(*(
+                server.submit(f"t{i % 3}",
+                              rng.integers(0, 256, 16,
+                                           dtype=np.uint8).tobytes(),
+                              rng.integers(0, 256, 16,
+                                           dtype=np.uint8).tobytes(),
+                              rng.integers(0, 256, s, dtype=np.uint8))
+                for i, s in enumerate(sizes)))
+            assert all(r.ok for r in resps)
+        assert compile_count() == baseline
+        assert server.steady_compiles() == 0
+
+    server, _ = _run_server(ServerConfig(**LADDER), drive)
+    assert server.stats()["compiles"]["steady"] == 0
+
+
+def _submit_n(server, n, size=256, tenant="t0", seed=5):
+    rng = np.random.default_rng(seed)
+    key = rng.integers(0, 256, 16, dtype=np.uint8).tobytes()
+
+    async def one(i):
+        nonce = rng.integers(0, 256, 16, dtype=np.uint8).tobytes()
+        payload = rng.integers(0, 256, size, dtype=np.uint8)
+        return await server.submit(tenant, key, nonce, payload)
+
+    return [one(i) for i in range(n)]
+
+
+def test_dispatch_fail_absorbed_by_retry(monkeypatch):
+    monkeypatch.setenv("OT_FAULTS", "dispatch_fail:1")
+    faults.reset()
+
+    async def drive(server):
+        return await asyncio.gather(*_submit_n(server, 4))
+
+    server, resps = _run_server(ServerConfig(retries=2, **LADDER), drive)
+    assert all(r.ok for r in resps)  # one failed attempt, retried
+    assert server.batches_failed == 0
+
+
+@pytest.mark.parametrize("point", ["dispatch_fail", "serve_dispatch"])
+def test_dispatch_fault_exhausted_fails_batch_server_survives(
+        monkeypatch, point):
+    monkeypatch.setenv("OT_FAULTS", f"{point}:1")
+    faults.reset()
+
+    async def drive(server):
+        # Sequential submits: the armed batch dies with per-request
+        # errors; everything after keeps serving.
+        first = await asyncio.gather(*_submit_n(server, 3))
+        later = await asyncio.gather(*_submit_n(server, 3, seed=6))
+        return first, later
+
+    server, (first, later) = _run_server(
+        ServerConfig(retries=1, **LADDER), drive)
+    assert all(r.error == otq.ERR_DISPATCH for r in first)
+    assert all(r.ok for r in later)
+    assert server.batches_failed == 1
+
+
+def test_unexpected_batch_exception_contained(monkeypatch):
+    """An exception NOT in the retry/timeout taxonomy (e.g. a bug in
+    batch formation) must resolve the riders with errors and leave the
+    batcher loop alive — an escape would wedge every future request."""
+
+    async def drive(server):
+        real_get = server.keycache.get
+        calls = {"n": 0}
+
+        def exploding_get(tenant, key):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise ValueError("synthetic formation bug")
+            return real_get(tenant, key)
+
+        monkeypatch.setattr(server.keycache, "get", exploding_get)
+        first = await asyncio.gather(*_submit_n(server, 2))
+        later = await asyncio.gather(*_submit_n(server, 2, seed=6))
+        return first, later
+
+    server, (first, later) = _run_server(ServerConfig(**LADDER), drive)
+    assert all(r.error == otq.ERR_DISPATCH for r in first)
+    assert "ValueError" in first[0].detail
+    assert all(r.ok for r in later)  # the loop survived
+    assert server.batches_failed == 1
+
+
+def test_dispatch_hang_deadline_orphan_and_report_gate(
+        monkeypatch, traced):
+    """The PR acceptance: a hung batch is killed by the watchdog at the
+    dispatch deadline, its requests fail with deadline errors, the
+    server keeps serving, and the trace's ONLY orphan is the abandoned
+    batch-dispatched span — which obs.report --check accepts exactly
+    when --expected-orphans licenses it."""
+    monkeypatch.setenv("OT_FAULTS", "dispatch_hang:1")
+    monkeypatch.setenv("OT_HANG_S", "30")
+    faults.reset()
+
+    async def drive(server):
+        first = await asyncio.gather(*_submit_n(server, 2))
+        later = await asyncio.gather(*_submit_n(server, 2, seed=6))
+        return first, later
+
+    server, (first, later) = _run_server(
+        ServerConfig(retries=1, dispatch_deadline_s=1.0, **LADDER), drive)
+    assert all(r.error == otq.ERR_DEADLINE for r in first)
+    assert all(r.ok for r in later)
+    assert server.batches_timed_out == 1
+    assert "dispatch-timeout" in degrade.events()
+
+    run = export.load_run(str(traced))
+    orphans = run.orphans()
+    assert [s.name for s in orphans] == ["batch-dispatched"]
+    assert not run.violations
+    assert report.main([str(traced), "--check"]) == 2
+    assert report.main([str(traced), "--check",
+                        "--expected-orphans", "batch-dispatched"]) == 0
+    buf = io.StringIO()
+    report.render(run, expected_orphans={"batch-dispatched": 1}, out=buf)
+    assert "closed by kill (expected)" in buf.getvalue()
+
+
+def test_server_traced_healthy_run_closes_every_span(traced):
+    async def drive(server):
+        return await asyncio.gather(*_submit_n(server, 6))
+
+    _run_server(ServerConfig(**LADDER), drive)
+    run = export.load_run(str(traced))
+    assert not run.violations and not run.orphans()
+    names = {s.name for s in run.spans.values()}
+    assert {"serve-warmup", "request-queued", "batch-formed",
+            "batch-dispatched"} <= names
+    # Dispatch spans carry the engine attr for the report's per-engine
+    # device-time table.
+    eng = {s.attrs.get("engine") for s in run.spans.values()
+           if s.name == "batch-dispatched"}
+    assert eng == {"jnp"}
+
+
+# ---------------------------------------------------------------------------
+# Loadgen + bench CLI.
+# ---------------------------------------------------------------------------
+
+
+def test_percentile_nearest_rank():
+    vals = sorted(float(v) for v in range(1, 101))
+    assert loadgen.percentile(vals, 50) == 50.0
+    assert loadgen.percentile(vals, 99) == 99.0
+    assert loadgen.percentile([7.0], 99) == 7.0
+    assert loadgen.percentile([], 50) == 0.0
+
+
+def test_bench_cli_writes_artifact_and_asserts(tmp_path, capsys):
+    art = tmp_path / "serve.json"
+    rc = serve_bench.main([
+        "--requests", "40", "--concurrency", "6", "--mixed-sizes",
+        "--bucket-max", "4096", "--seed", "1",
+        "--artifact", str(art)])
+    assert rc == 0
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert line["unit"] == "serve" and line["requests"] == 40
+    assert line["ok"] == 40 and line["recompiles"] == 0
+    assert line["p50_ms"] > 0 and line["p99_ms"] >= line["p50_ms"]
+    doc = json.loads(art.read_text())
+    assert doc["compiles"]["steady"] == 0
+    assert doc["load"]["mismatches"] == 0 and doc["load"]["verified"] > 0
+    assert doc["occupancy"]  # the histogram exists per bucket
+    assert doc["keycache"]["hits"] > 0
+
+
+def test_bench_next_artifact_indexing(tmp_path):
+    (tmp_path / "SERVE_r03.json").write_text("{}")
+    assert serve_bench._next_artifact(str(tmp_path)).endswith(
+        "SERVE_r04.json")
+    assert serve_bench._next_artifact(str(tmp_path / "empty")).endswith(
+        "SERVE_r01.json")
