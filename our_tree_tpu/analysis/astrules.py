@@ -322,7 +322,8 @@ def _check_trace_attrs(ctx: FileContext):
 # fault-points: OT_FAULTS seam names drawn from faults.KNOWN_POINTS
 # ---------------------------------------------------------------------------
 
-_FAULT_METHODS = ("fire", "check", "consume", "remaining", "injected_hang")
+_FAULT_METHODS = ("fire", "check", "check_lane", "scoped", "consume",
+                  "remaining", "injected_hang")
 
 
 def _check_fault_points(ctx: FileContext):
@@ -349,6 +350,37 @@ def _check_fault_points(ctx: FileContext):
                 "CI vacuously green — register it in faults.py first")
 
 
+# ---------------------------------------------------------------------------
+# serve-lane-seam: device dispatch in serve/ only through serve/lanes.py
+# ---------------------------------------------------------------------------
+
+#: Call-name tails that put bytes on (or read them back from) a device.
+#: In serve/, every one of them belongs to the lane seam: a dispatch
+#: outside it has no watchdog deadline of its own lane, no health
+#: accounting, no failover — a fault there degrades the SERVICE, not a
+#: lane, which is exactly the failure mode lanes exist to contain.
+_SERVE_DISPATCH_TAILS = ("ctr_crypt_words_scattered", "block_until_ready",
+                         "device_put")
+
+
+def _check_serve_lane(ctx: FileContext):
+    if not ctx.in_dir("serve", "our_tree_tpu/serve"):
+        return
+    if ctx.is_file("serve/lanes.py"):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _dotted(node.func)
+        tail = name.rsplit(".", 1)[-1]
+        if tail in _SERVE_DISPATCH_TAILS:
+            yield node, (
+                f"`{name}()` dispatches to a device from serve/ outside "
+                "the lane seam: route the call through serve/lanes.py "
+                "(Lane.engine_call) so it gets the lane's watchdog "
+                "deadline, health accounting, and bit-exact failover")
+
+
 RULES: tuple[Rule, ...] = (
     Rule("subprocess-isolate", "error",
          "Child processes only via resilience.isolate.run_child — no bare "
@@ -371,9 +403,15 @@ RULES: tuple[Rule, ...] = (
          "JSON-serializable (no bytes/set/lambda/complex literals).",
          _check_trace_attrs),
     Rule("fault-points", "error",
-         "String literals passed to faults.fire/check/consume/remaining "
-         "and watchdog.injected_hang must be registered KNOWN_POINTS.",
+         "String literals passed to faults.fire/check/check_lane/scoped/"
+         "consume/remaining and watchdog.injected_hang must be registered "
+         "KNOWN_POINTS.",
          _check_fault_points),
+    Rule("serve-lane-seam", "error",
+         "Device dispatch in serve/ (scattered-CTR calls, "
+         "block_until_ready, device_put) only inside serve/lanes.py — "
+         "the lane seam owns deadlines, health, and failover.",
+         _check_serve_lane),
 )
 
 

@@ -751,7 +751,9 @@ def main(argv=None) -> int:
                      "failure rows to clear")
         cleared = journal_mod.clear_failures(journal_path, args.unquarantine)
         for unit, n in sorted(cleared.items()):
-            trace_mod.point("quarantine-release", unit=unit, cleared=n)
+            if n:  # a release point for a unit never quarantined would
+                # pollute every trace audit that reconstructs releases
+                trace_mod.point("quarantine-release", unit=unit, cleared=n)
             print(f"# unquarantine: {unit}: cleared {n} failure row(s)"
                   + ("" if n else " — none were on file"),
                   file=sys.stderr, flush=True)

@@ -19,6 +19,7 @@ from __future__ import annotations
 import glob
 import json
 import os
+import re
 
 from . import trace as _trace
 
@@ -106,10 +107,28 @@ class Run:
         return None
 
 
+def _segment_order(path: str):
+    """Sort key putting a process's rotated segments in WRITE order.
+
+    A rotating writer (``OT_TRACE_MAX_MB``) names segments
+    ``trace-<pid>-<proc>.jsonl`` then ``trace-<pid>-<proc>-s1.jsonl``,
+    ``-s2``, ... — and plain ``sorted()`` puts ``-s1`` BEFORE the bare
+    first segment (``-`` < ``.``), which would feed span ends to the
+    parser before their begins and misreport a healthy rotated run as
+    full of violations. Key: (base name, segment number)."""
+    name = os.path.basename(path)
+    m = re.fullmatch(r"(trace-\d+-[0-9a-f]+)(?:-s(\d+))?\.jsonl", name)
+    if m:
+        return (m.group(1), int(m.group(2) or 0))
+    return (name, 0)
+
+
 def load_run(run_dir: str) -> Run:
-    """Parse every ``trace-*.jsonl`` under ``run_dir`` into a ``Run``."""
+    """Parse every ``trace-*.jsonl`` under ``run_dir`` into a ``Run``
+    (a process's rotated segments in write order — ``_segment_order``)."""
     run = Run()
-    for path in sorted(glob.glob(os.path.join(run_dir, "trace-*.jsonl"))):
+    for path in sorted(glob.glob(os.path.join(run_dir, "trace-*.jsonl")),
+                       key=_segment_order):
         fname = os.path.basename(path)
         pid, proc = -1, "?"
         with open(path, "r", encoding="utf-8") as fh:

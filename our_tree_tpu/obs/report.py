@@ -29,7 +29,11 @@ Flags:
 When any span carries an ``engine`` attr (the repo-root bench's probe
 and measure spans do), the report adds a per-engine device-time table —
 the trace-side answer to "which engine did this run actually spend its
-device time in" that the probe's stderr GB/s lines only hint at.
+device time in" that the probe's stderr GB/s lines only hint at. A
+serve run's ``lane-dispatch``/``lane-probe`` spans (which carry a
+``lane`` attr) additionally get a per-LANE table — dispatches, canary
+probes, device time, and kills per fault domain, with an orphaned lane
+span counted as the kill it is (docs/SERVING.md).
 
 ``<run-dir>`` is ``$OT_TRACE_DIR/<run-id>``; passing ``$OT_TRACE_DIR``
 itself picks the newest run inside it (and says so).
@@ -212,7 +216,8 @@ def render(run: export.Run, top: int = 10, out=sys.stdout,
     # on probe/measure spans; harness spans inherit it via ancestors).
     # Closed spans only, outermost-of-chain only — same double-counting
     # rules as the per-unit device_s column.
-    engine_spans = DEVICE_SPANS + ("measure", "batch-dispatched")
+    engine_spans = DEVICE_SPANS + ("measure", "batch-dispatched",
+                                   "lane-dispatch", "lane-probe")
     eng_time: dict[str, int] = {}
     eng_count: dict[str, int] = {}
     for sp in run.spans.values():
@@ -232,6 +237,40 @@ def render(run: export.Run, top: int = 10, out=sys.stdout,
                 for eng in sorted(eng_time,
                                   key=lambda e: (-eng_time[e], e))],
                ["engine", "spans", "device_s"], out)
+
+    # -- per-lane device time (serve) --------------------------------------
+    # The serve path's fault-domain breakdown: `lane-dispatch` /
+    # `lane-probe` spans carry a `lane` attr (serve/lanes.py). Closed
+    # spans sum into device_s; an ORPHANED lane span is a kill (a hung
+    # dispatch the watchdog ended) and is counted, not timed.
+    lane_time: dict[str, int] = {}
+    lane_count: dict[str, int] = {}
+    lane_probes: dict[str, int] = {}
+    lane_kills: dict[str, int] = {}
+    for sp in run.spans.values():
+        if sp.name not in ("lane-dispatch", "lane-probe"):
+            continue
+        lane = sp.attrs.get("lane")
+        if lane is None:
+            continue
+        key = str(lane)
+        if sp.orphan:
+            lane_kills[key] = lane_kills.get(key, 0) + 1
+            continue
+        if sp.name == "lane-probe":
+            lane_probes[key] = lane_probes.get(key, 0) + 1
+        else:
+            lane_count[key] = lane_count.get(key, 0) + 1
+        lane_time[key] = lane_time.get(key, 0) + sp.dur_us(run_end)
+    lane_keys = sorted(set(lane_time) | set(lane_kills),
+                       key=lambda k: (len(k), k))
+    if lane_keys:
+        out.write("\nper-lane device time (serve):\n")
+        _table([[k, str(lane_count.get(k, 0)),
+                 str(lane_probes.get(k, 0)), _s(lane_time.get(k, 0)),
+                 str(lane_kills.get(k, 0))]
+                for k in lane_keys],
+               ["lane", "dispatches", "probes", "device_s", "killed"], out)
 
     # -- faults: injected vs observed --------------------------------------
     injected: dict[str, int] = {}

@@ -157,11 +157,14 @@ def _max_bytes() -> int:
     complete stream). When set, the process's event file rotates into
     fixed-size segments and the OLDEST segments are deleted so this
     process never keeps more than the cap on disk — the week-long soak
-    knob (ROADMAP PR-3 follow-up). Bounded necessarily means lossy:
-    spans whose begin fell in a deleted segment surface in ``obs.report``
-    as end-without-begin violations, so soak monitoring should read the
-    self-contained events (counters/points/gauges); ``--check`` gating
-    belongs to uncapped runs.
+    knob (ROADMAP PR-3 follow-up). A rotated run whose segments all
+    SURVIVE reconstructs completely (``obs.export`` stitches segments in
+    write order), so ``--check`` gating works under a cap as long as the
+    run fits it — the serve CI lane-kill drive gates exactly that way.
+    Past the cap, bounded necessarily means lossy: spans whose begin
+    fell in a DELETED segment surface in ``obs.report`` as
+    end-without-begin violations, so soak monitoring beyond the cap
+    should read the self-contained events (counters/points/gauges).
     """
     try:
         mb = float(os.environ.get("OT_TRACE_MAX_MB", 0) or 0)

@@ -9,19 +9,31 @@ production paths pay a single dict lookup when the variable is unset.
 
 Grammar::
 
-    OT_FAULTS=init_hang:2,dispatch_fail:1,build_fail,dispatch_hang:1@2
+    OT_FAULTS=init_hang:2,dispatch_fail:1,build_fail,dispatch_hang:1@2,
+              lane_hang:1@lane=3
 
-Comma-separated tokens, each ``<point>[:<count>[@<skip>]]``. A counted
-token arms the point for exactly ``count`` firings (the first ``count``
-calls to ``fire(point)`` return True, every later call False); a bare
-token arms it forever. ``@<skip>`` defers a counted point past its
-first ``skip`` calls (``dispatch_hang:1@2`` skips two dispatches, then
-hangs the third) — the deterministic way to land a fault MID-unit
-(e.g. on the second worker row) instead of always on the first call;
-an in-process affordance: the ``--isolate`` supervisor's metering hands
-children plain ``:1`` shots. Whitespace around tokens is tolerated;
-unknown point names are accepted but warned about on stderr (a typo
-that silently never fires would make a CI fault job vacuously green).
+Comma-separated tokens, each ``<point>[:<count>[@<qualifier>]]``. A
+counted token arms the point for exactly ``count`` firings (the first
+``count`` calls to ``fire(point)`` return True, every later call
+False); a bare token arms it forever. The ``@`` qualifier is one of:
+
+* ``@<skip>`` — defer a counted point past its first ``skip`` calls
+  (``dispatch_hang:1@2`` skips two dispatches, then hangs the third):
+  the deterministic way to land a fault MID-unit (e.g. on the second
+  worker row) instead of always on the first call; an in-process
+  affordance: the ``--isolate`` supervisor's metering hands children
+  plain ``:1`` shots.
+* ``@lane=<i>`` — scope the point to serve dispatch lane ``i``
+  (``lane_hang:1@lane=3`` hangs lane 3's next dispatch and no other
+  lane's): the registry key becomes ``<point>@lane=<i>`` and only a
+  seam asking for that exact lane (``scoped(point, i)`` /
+  ``check_lane``) can consume the shot — how the chaos matrix kills
+  one fault domain and asserts the other seven kept serving
+  (serve/lanes.py, docs/SERVING.md).
+
+Whitespace around tokens is tolerated; unknown point names are
+accepted but warned about on stderr (a typo that silently never fires
+would make a CI fault job vacuously green).
 
 Registered injection points (the fault matrix, docs/RESILIENCE.md):
 
@@ -59,6 +71,18 @@ point              wired into
                    priming is not traffic — though an engine's own
                    internal seam, e.g. the Pallas launch seam, still
                    sees warmup like any first dispatch).
+``lane_fail``      the per-lane dispatch seam (``serve/lanes.py``): the
+                   lane's engine call raises as if that DEVICE had
+                   failed. Usually lane-scoped (``lane_fail:1@lane=2``);
+                   the unscoped form hits whichever lane dispatches
+                   next. The lane pool retries on-lane, then fails the
+                   lane over (health state machine) and re-dispatches
+                   the batch bit-exactly on a healthy lane.
+``lane_hang``      the wedged-device variant of ``lane_fail``: the
+                   lane's dispatch blocks "forever" in a GIL-releasing
+                   sleep for the lane watchdog to interrupt — the lane
+                   is quarantined and its in-flight batch re-dispatched
+                   on a healthy lane before any request is answered.
 =================  ========================================================
 
 Determinism contract: firings consume counts in call order within ONE
@@ -83,7 +107,8 @@ import sys
 #: The names wired into real seams. Parsing accepts others (forward
 #: compat, tests), but warns — see module docstring.
 KNOWN_POINTS = ("init_hang", "dispatch_fail", "build_fail", "lock_busy",
-                "dispatch_hang", "unit_crash", "serve_dispatch")
+                "dispatch_hang", "unit_crash", "serve_dispatch",
+                "lane_fail", "lane_hang")
 
 #: Sentinel count for a bare (uncounted) token: armed forever.
 ALWAYS = -1
@@ -132,6 +157,25 @@ class InjectedFault(RuntimeError):
     """
 
 
+def scoped(point: str, lane) -> str:
+    """The registry key of a lane-scoped point — what the ``@lane=<i>``
+    grammar arms and what a per-lane seam must ask ``fire`` for
+    (serve/lanes.py passes ``scoped("lane_hang", self.idx)``)."""
+    return f"{point}@lane={int(lane)}"
+
+
+def _normalize_lane(name: str, tok: str) -> str | None:
+    """Canonicalize a ``<point>@lane=<i>`` name (bare-token form), or
+    None when the lane qualifier is malformed."""
+    base, _, qual = name.partition("@")
+    if not qual.startswith("lane="):
+        return None
+    try:
+        return scoped(base.strip(), int(qual[5:].strip()))
+    except ValueError:
+        return None
+
+
 def _parse(spec: str) -> tuple[dict[str, int], dict[str, int]]:
     reg: dict[str, int] = {}
     skips: dict[str, int] = {}
@@ -142,11 +186,16 @@ def _parse(spec: str) -> tuple[dict[str, int], dict[str, int]]:
         name, sep, count = tok.partition(":")
         name = name.strip()
         if sep:
-            count, at, skip = count.partition("@")
+            count, at, qual = count.partition("@")
+            qual = qual.strip()
             try:
                 n = int(count.strip())
-                if at:  # last token's skip wins (skips don't accumulate)
-                    skips[name] = max(int(skip.strip()), 0)
+                if at and qual.startswith("lane="):
+                    # Lane-scoped shot: the lane rides in the registry
+                    # key, so two lanes' shots count independently.
+                    name = scoped(name, int(qual[5:].strip()))
+                elif at:  # last token's skip wins (skips don't accumulate)
+                    skips[name] = max(int(qual), 0)
             except ValueError:
                 print(f"# OT_FAULTS: malformed token {tok!r} ignored",
                       file=sys.stderr)
@@ -155,7 +204,14 @@ def _parse(spec: str) -> tuple[dict[str, int], dict[str, int]]:
                 continue  # zero-count = disarmed, silently fine
         else:
             n = ALWAYS
-        if name not in KNOWN_POINTS:
+            if "@" in name:
+                canon = _normalize_lane(name, tok)
+                if canon is None:
+                    print(f"# OT_FAULTS: malformed token {tok!r} ignored",
+                          file=sys.stderr)
+                    continue
+                name = canon
+        if name.split("@", 1)[0] not in KNOWN_POINTS:
             print(f"# OT_FAULTS: unknown injection point {name!r} "
                   f"(known: {', '.join(KNOWN_POINTS)}) — armed anyway",
                   file=sys.stderr)
@@ -227,6 +283,17 @@ def check(point: str, detail: str = "") -> None:
     """Raise InjectedFault iff `point` fires — the common seam shape."""
     if fire(point):
         raise InjectedFault(f"injected fault: {point}"
+                            + (f" ({detail})" if detail else ""))
+
+
+def check_lane(point: str, lane, detail: str = "") -> None:
+    """Raise InjectedFault iff the lane-scoped OR the plain form of
+    `point` fires — the per-lane seam shape (serve/lanes.py): a token
+    ``lane_fail:1@lane=2`` hits lane 2 and no other; a plain
+    ``lane_fail:1`` hits whichever lane asks first. Short-circuits so
+    one dispatch consumes at most one shot."""
+    if fire(scoped(point, lane)) or fire(point):
+        raise InjectedFault(f"injected fault: {scoped(point, lane)}"
                             + (f" ({detail})" if detail else ""))
 
 

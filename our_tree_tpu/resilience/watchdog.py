@@ -257,7 +257,7 @@ def hangs_injected() -> int:
     return _INJECTED_HANGS
 
 
-def injected_hang(point: str, detail: str = "", budget=None) -> None:
+def injected_hang(point: str, detail: str = "", budget=None) -> bool:
     """Simulate a wedged dispatch when the ``point`` fault is armed.
 
     Fires one shot at ``point`` (``dispatch_hang``); when it fires,
@@ -267,15 +267,21 @@ def injected_hang(point: str, detail: str = "", budget=None) -> None:
     debits the hang's cost from it WITHOUT sleeping — the same
     no-wall-clock rehearsal bench.py's ``_burn`` gives init_hang.
     No-op while the point is unarmed: one dict lookup.
+
+    Returns whether the hang fired, so a seam consulting BOTH a
+    lane-scoped and a plain form of the same point (serve/lanes.py) can
+    short-circuit — one dispatch consumes at most one shot, the same
+    contract as ``faults.check_lane``.
     """
     if not _sibling("faults").fire(point):
-        return
+        return False
     global _INJECTED_HANGS
     _INJECTED_HANGS += 1
     hang_s = float(os.environ.get("OT_HANG_S", 24 * 3600))
     if budget is not None:
         budget.debit(hang_s)
-        return
+        return True
     print(f"# OT_FAULTS: {point} sleeping {hang_s:.0f}s"
           + (f" ({detail})" if detail else ""), file=sys.stderr, flush=True)
     time.sleep(hang_s)
+    return True

@@ -23,19 +23,28 @@ Modules (docs/SERVING.md has the full architecture):
   batching layer).
 * ``keycache`` — multi-tenant LRU of expanded key schedules keyed by key
   digest: rekeying per request costs a lookup, not a key expansion.
-* ``server``   — the dispatch loop: watchdog-guarded scattered-CTR engine
-  calls through the ``models.aes`` seams, per-request / per-batch obs
-  spans, RetryPolicy on transient dispatch failure, per-request error
-  responses when a batch dies (the server stays up).
+* ``lanes``    — the fault domains: one dispatch lane per visible
+  device, each with its own watchdog deadline, RetryPolicy, health
+  state machine (healthy/suspect/quarantined/probation), bit-exact
+  cross-lane failover, canary probation, and journal-persisted
+  quarantine. The ONLY device contact in the package (otlint's
+  ``serve-lane-seam`` rule).
+* ``server``   — the dispatch loop: drain -> form -> place on the lane
+  pool; per-request / per-batch / per-lane obs spans; per-request error
+  responses only when EVERY lane failed (the server stays up);
+  graceful drain on shutdown (zero lost requests).
 * ``loadgen``  — closed-loop load generator with mixed request sizes.
 * ``bench``    — ``python -m our_tree_tpu.serve.bench``: drives the
   server, reports p50/p95/p99 latency, goodput GB/s, batch occupancy,
-  asserts zero post-warmup recompiles, writes a ``SERVE_r*.json``.
+  per-lane dispatch/health breakdown, asserts zero post-warmup
+  recompiles AND zero lost requests, writes a ``SERVE_r*.json``; also
+  the serve-side quarantine release (``--unquarantine lane:<i>``).
 
 Layering: ``queue`` is stdlib+numpy+resilience+obs only (admission
 logic runs without a backend in sight); the device boundary lives
-entirely in ``server``/``keycache`` (and ``batcher``'s packing
-helpers), which is why a queue overload test never compiles anything.
+entirely in ``lanes`` — ``server`` orchestrates, ``batcher``/
+``keycache`` stay host-side — which is why a queue overload test never
+compiles anything.
 """
 
 from .queue import Request, RequestQueue, Response, ServeError  # noqa: F401

@@ -2,11 +2,18 @@
 
 Closed-loop loadgen against an in-process Server: mixed request sizes,
 multi-tenant keys, p50/p95/p99 latency, goodput GB/s, batch-occupancy
-histogram — and the zero-recompile CONTRACT: after the ladder warmup,
-steady-state serving must trigger no backend compile at all (the
-``server.compile_count`` monitor; the run exits 1 if it does, unless
-``--allow-recompiles`` says a recompile is expected, e.g. an exotic key
-size outside the warmed set).
+histogram, per-LANE dispatch/goodput breakdown with the health
+transition log — and two hard contracts the run exits 1 on:
+
+* **zero recompiles**: after the ladder warmup (every lane x rung),
+  steady-state serving must trigger no backend compile at all (the
+  ``server.compile_count`` monitor; ``--allow-recompiles`` waives it,
+  e.g. an exotic key size outside the warmed set);
+* **zero lost requests**: every ACCEPTED request must be answered —
+  payload or coded error — even across a faulted run
+  (``queue.stats()["lost"]``, counted at the one resolution seam).
+  A server that drops work silently is broken in a way error counts
+  cannot show.
 
 Output convention follows the repo-root bench: human-readable ``#``
 lines, then ONE parseable JSON line last on stdout (the CI contract),
@@ -16,14 +23,27 @@ next free index at the repo root).
 
 Fault rehearsals (docs/SERVING.md, the CI ``serve`` job):
 
-* ``OT_FAULTS=dispatch_fail:1 ... --retries 1`` — the armed batch dies,
-  its requests get ``dispatch-failed`` responses, the run completes rc 0
+* ``OT_FAULTS=dispatch_fail:1 ... --retries 1 --lanes 1`` — the armed
+  batch dies with no failover target, its requests get
+  ``dispatch-failed`` responses, the run completes rc 0
   (server-stays-up IS the contract; the artifact records the errors).
-* ``OT_FAULTS=dispatch_hang:1 ... --dispatch-deadline 3`` — the armed
-  batch wedges; the watchdog kills it at the deadline, its requests get
-  ``deadline`` errors, the abandoned ``batch-dispatched`` span is the
+* ``OT_FAULTS=dispatch_hang:1 ... --lanes 1 --dispatch-deadline 3`` —
+  the armed batch wedges; the watchdog kills it at the deadline, the
+  lane is quarantined (then canary-released), its requests get
+  ``deadline`` errors, and the abandoned ``lane-dispatch`` span is the
   run's ONLY orphan (``obs.report --check --expected-orphans
-  batch-dispatched``).
+  lane-dispatch``).
+* ``XLA_FLAGS=--xla_force_host_platform_device_count=8
+  OT_FAULTS=lane_hang:1@lane=3 ... --lanes 8`` — the LANE-KILL drive:
+  lane 3 wedges mid-batch, is quarantined, and its batch re-dispatches
+  bit-exactly on a healthy lane — ZERO request errors, zero lost,
+  exactly one quarantine event, lanes 0-2,4-7 keep serving.
+
+``--unquarantine lane:<i>`` (with ``--journal``) is the serve-side
+release edit: it drops the named lanes' failure rows from the journal —
+the SAME ``resilience.journal.clear_failures`` edit behind
+``harness.bench --unquarantine``, so operators have one quarantine
+model — and exits without serving.
 """
 
 from __future__ import annotations
@@ -38,6 +58,7 @@ import sys
 
 from ..obs import trace
 from ..resilience import degrade, watchdog
+from ..resilience import journal as journal_mod
 from . import loadgen
 from .server import Server, ServerConfig
 
@@ -65,7 +86,10 @@ async def _drive(args, probes):
         max_depth=args.queue_depth,
         request_deadline_s=args.deadline,
         dispatch_deadline_s=args.dispatch_deadline,
-        retries=args.retries)
+        retries=args.retries,
+        lanes=args.lanes,
+        probe_every=args.probe_every,
+        journal=args.journal)
     server = Server(cfg)
     await server.start()
     report = await loadgen.run(
@@ -75,6 +99,18 @@ async def _drive(args, probes):
         verify_every=args.verify_every, probes=probes)
     await server.stop()
     return server, report
+
+
+def _lane_summary(stats: dict, wall_s: float) -> dict:
+    """The artifact's ``lanes`` section: pool aggregates plus per-lane
+    goodput (dispatched bytes over the run's wall — the placement
+    evidence the ISSUE's "batches placed across >= 2 lanes" gate
+    reads)."""
+    pool = dict(stats["lanes"])
+    for row in pool.get("per_lane", []):
+        row["goodput_gbps"] = (round(row["bytes"] / 1e9 / wall_s, 4)
+                               if wall_s > 0 else 0.0)
+    return pool
 
 
 def main(argv=None) -> int:
@@ -98,10 +134,27 @@ def main(argv=None) -> int:
                     help="per-request residency deadline, seconds")
     ap.add_argument("--dispatch-deadline", type=float,
                     default=watchdog.default_deadline_s() or 10.0,
-                    help="watchdog deadline per engine call, seconds "
+                    help="watchdog deadline per lane engine call, seconds "
                          "(default: OT_DISPATCH_DEADLINE, else 10)")
     ap.add_argument("--retries", type=int, default=2,
-                    help="dispatch attempts per batch (1 = no retry)")
+                    help="dispatch attempts per batch PER LANE "
+                         "(1 = no on-lane retry; cross-lane failover "
+                         "happens regardless)")
+    ap.add_argument("--lanes", type=int, default=None, metavar="N",
+                    help="dispatch lanes (default: one per visible "
+                         "device; N may exceed the device count for "
+                         "single-device rehearsal)")
+    ap.add_argument("--probe-every", type=int, default=8, metavar="BATCHES",
+                    help="canary-probe quarantined lanes every N batches")
+    ap.add_argument("--journal", default=None, metavar="PATH",
+                    help="serve journal (lane quarantine persistence; "
+                         "docs/RESILIENCE.md)")
+    ap.add_argument("--unquarantine", action="append", default=None,
+                    metavar="LANE",
+                    help="release the named lane (e.g. lane:3) by "
+                         "dropping its failure rows from --journal "
+                         "(repeatable), then exit — the same "
+                         "clear_failures edit harness.bench uses")
     ap.add_argument("--verify-every", type=int, default=8,
                     help="every Nth request replays a pinned probe and "
                          "checks bit-exactness (0 = off)")
@@ -115,6 +168,21 @@ def main(argv=None) -> int:
     args.sizes = (loadgen.MIXED_SIZES if args.mixed_sizes
                   else (args.size_bytes,))
 
+    if args.unquarantine:
+        if not args.journal:
+            ap.error("--unquarantine requires --journal "
+                     "(the ledger being edited)")
+        trace.ensure_run()
+        cleared = journal_mod.clear_failures(args.journal,
+                                             args.unquarantine)
+        for unit, n in sorted(cleared.items()):
+            if n:  # a release point for a unit never quarantined would
+                # pollute every trace audit that reconstructs releases
+                trace.point("quarantine-release", unit=unit, cleared=n)
+            print(f"# unquarantine: {unit}: cleared {n} failure row(s)"
+                  + ("" if n else " (none recorded)"))
+        return 0
+
     trace.ensure_run()
     # Reference ciphertexts BEFORE the server's warmup marker: the
     # byte-exact models path compiles per probe size, and those compiles
@@ -123,20 +191,31 @@ def main(argv=None) -> int:
               if args.verify_every else [])
     server, report = asyncio.run(_drive(args, probes))
     stats = server.stats()
+    lanes = _lane_summary(stats, report.wall_s)
+    lost = stats["queue"]["lost"]
 
     print(f"# serve: engine={stats['engine']} ladder={stats['rungs']} "
-          f"concurrency={args.concurrency} tenants={args.tenants}")
+          f"lanes={lanes['count']} concurrency={args.concurrency} "
+          f"tenants={args.tenants}")
     print(f"# requests={report.requests} ok={report.ok} "
-          f"errors={report.errors or '{}'} verified={report.verified} "
-          f"mismatches={report.mismatches}")
+          f"errors={report.errors or '{}'} lost={lost} "
+          f"verified={report.verified} mismatches={report.mismatches}")
     print(f"# latency ms: p50={report.p50_ms} p95={report.p95_ms} "
           f"p99={report.p99_ms}  goodput={report.goodput_gbps:.4f} GB/s "
           f"wall={report.wall_s:.3f}s")
     print(f"# batches={stats['batches']} "
           f"failed={stats['batches_failed']} "
           f"timed_out={stats['batches_timed_out']} "
+          f"redispatches={lanes['redispatches']} "
+          f"quarantines={lanes['quarantine_events']} "
           f"compiles: warmup={stats['compiles']['warmup']} "
           f"steady={stats['compiles']['steady']}")
+    for row in lanes["per_lane"]:
+        tr = "".join(f" [{t['prev']}->{t['to']}:{t['why']}]"
+                     for t in row["transitions"])
+        print(f"#   lane {row['lane']} ({row['device']}): "
+              f"{row['dispatches']} dispatch(es), {row['blocks']} blocks, "
+              f"{row['goodput_gbps']:.4f} GB/s, state={row['state']}{tr}")
     for bucket, h in stats["occupancy"].items():
         print(f"#   bucket {bucket:>5}: {h['batches']} batch(es), "
               f"mean occupancy {h['mean_occupancy']:.2%}")
@@ -149,11 +228,13 @@ def main(argv=None) -> int:
             "engine": stats["engine"], "rungs": stats["rungs"],
             "retries": args.retries,
             "dispatch_deadline_s": args.dispatch_deadline,
+            "lanes": lanes["count"], "probe_every": args.probe_every,
             "seed": args.seed,
         },
         "load": report.to_json(),
         "batches": {k: stats[k] for k in
                     ("batches", "batches_failed", "batches_timed_out")},
+        "lanes": lanes,
         "occupancy": stats["occupancy"],
         "queue": stats["queue"],
         "keycache": stats["keycache"],
@@ -171,10 +252,15 @@ def main(argv=None) -> int:
     line = {"unit": "serve", "engine": stats["engine"],
             "requests": report.requests, "ok": report.ok,
             "errors": dict(sorted(report.errors.items())),
+            "lost": lost,
             "p50_ms": report.p50_ms, "p95_ms": report.p95_ms,
             "p99_ms": report.p99_ms,
             "goodput_gbps": round(report.goodput_gbps, 4),
             "batches": stats["batches"],
+            "lanes": lanes["count"],
+            "lanes_used": lanes["placed_across"],
+            "redispatches": lanes["redispatches"],
+            "quarantines": lanes["quarantine_events"],
             "recompiles": stats["compiles"]["steady"],
             "mismatches": report.mismatches}
     if degrade.events():
@@ -187,6 +273,11 @@ def main(argv=None) -> int:
     if report.mismatches:
         print(f"# FAIL: {report.mismatches} probe response(s) mismatched "
               "the byte-exact reference", file=sys.stderr)
+        rc = 1
+    if lost:
+        print(f"# FAIL: {lost} request(s) LOST — accepted but answered "
+              "neither payload nor error (the drain/failover contract "
+              "is broken)", file=sys.stderr)
         rc = 1
     if stats["compiles"]["steady"] and not args.allow_recompiles:
         print(f"# FAIL: {stats['compiles']['steady']} post-warmup backend "

@@ -2,9 +2,12 @@
 
 Key expansion is host-side, sequential, and per-key
 (``ops.keyschedule.expand_key_enc`` — the reference expands on host even
-for its GPU backend), plus one device staging of the 44-60 round-key
-words. Per-request that cost dwarfs a small request's crypt time; a
-service where every request names its key must make rekeying a LOOKUP.
+for its GPU backend). Per-request that cost dwarfs a small request's
+crypt time; a service where every request names its key must make
+rekeying a LOOKUP. Entries hold the HOST (numpy) schedule: device
+staging belongs to the dispatch lane (``serve/lanes.py`` commits the
+44-60 round-key words onto its own device per call — the words are tiny
+and committed inputs are what route a dispatch to the lane's device).
 
 Entries are keyed by (tenant, key digest). Tenant isolation is
 structural, twice over:
@@ -30,7 +33,7 @@ from __future__ import annotations
 import hashlib
 from collections import OrderedDict
 
-import jax.numpy as jnp
+import numpy as np
 
 from ..obs import trace
 from ..ops.keyschedule import expand_key_enc
@@ -42,7 +45,7 @@ def key_digest(key: bytes) -> str:
 
 
 class KeyCache:
-    """tenant -> (digest -> (nr, staged round keys)) with per-tenant LRU."""
+    """tenant -> (digest -> (nr, host round keys)) with per-tenant LRU."""
 
     def __init__(self, per_tenant: int = 8):
         if per_tenant < 1:
@@ -54,8 +57,8 @@ class KeyCache:
         self.evictions = 0
 
     def get(self, tenant: str, key: bytes):
-        """(digest, nr, device round keys) for ``key`` under ``tenant``,
-        expanding and staging on miss, evicting the tenant's least
+        """(digest, nr, host round-key words) for ``key`` under
+        ``tenant``, expanding on miss, evicting the tenant's least
         recently used entry past capacity."""
         digest = key_digest(key)
         lru = self._tenants.setdefault(tenant, OrderedDict())
@@ -68,7 +71,7 @@ class KeyCache:
         self.misses += 1
         trace.counter("keycache_miss", tenant=tenant)
         nr, rk = expand_key_enc(bytes(key))
-        entry = (nr, jnp.asarray(rk))
+        entry = (nr, np.asarray(rk, dtype=np.uint32))
         lru[digest] = entry
         if len(lru) > self.per_tenant:
             lru.popitem(last=False)

@@ -1,0 +1,425 @@
+"""Per-device dispatch lanes: the serve path's fault domains.
+
+The paper's one original idea — split the work into independent
+contiguous chunks and run them in parallel (``aes-modes/test.c:33-35``)
+— applied at the DEVICE level: every visible device gets one dispatch
+lane, and a lane is an isolated fault domain. A wedged or dying chip
+degrades its lane (watchdog kill, quarantine, canary probation), never
+the service: the lane's in-flight batch is re-dispatched **bit-exactly**
+on a healthy lane before any per-request error is answered — CTR with
+explicit per-block counters makes replay side-effect-free, so a batch
+is a pure function of (words, counters, key) and can run anywhere,
+twice, with identical bytes.
+
+This module is the ONLY place in ``serve/`` that touches a device
+(otlint's ``serve-lane-seam`` rule enforces it): ``Lane.engine_call``
+stages the batch arrays onto the lane's device and runs the
+scattered-CTR seam under the lane's own watchdog deadline, with the
+per-lane fault points (``lane_fail:<n>@lane=<i>``,
+``lane_hang:<n>@lane=<i>``) alongside the generic dispatch seams.
+
+Health state machine (every transition is a ``lane-state`` trace point;
+quarantine also stamps ``degraded:["quarantined:lane:<i>"]`` through
+the shared ``resilience.degrade()`` chokepoint and appends a failure
+row to the serve journal — the SAME record ``resilience.journal`` uses
+for sweep units, so ``clear_failures`` / ``--unquarantine`` is one
+release model across harness and serve)::
+
+    healthy ──failure──> suspect ──failure──> quarantined
+       ^                    │ clean batch        │  canary ok
+       │<───"recovered"─────┘                    v
+       │                                     probation
+       │<──"released" (probation served)────────┘
+                         (a probation failure goes straight back
+                          to quarantined; a TIMEOUT quarantines
+                          from any state — a hang is never transient)
+
+Placement is least-loaded (cumulative blocks dispatched) across
+placeable lanes (healthy/suspect/probation, warmed only); a quarantined
+lane is periodically probed with a warmup-shaped CANARY batch whose
+expected output was pinned at warmup, and released into probation on a
+bit-exact response. When NO placeable lane remains, quarantined lanes
+are canary-probed as a last resort before the batch is failed — a
+single-lane server therefore self-heals after a transient hang instead
+of bricking.
+
+Dispatch stays synchronous on the main thread on purpose: that is the
+watchdog's SIGALRM contract (resilience/watchdog.py), and containment —
+not overlap — is this layer's job. Overlapped per-lane dispatch rides
+on top of this seam (ROADMAP: fast serving arc).
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import numpy as np
+
+from ..models import aes
+from ..obs import trace
+from ..resilience import degrade, faults, watchdog
+from ..resilience.policy import RetryPolicy
+
+#: Health states. RELEASED appears in transition logs (the moment a
+#: lane finishes probation) and immediately rests as HEALTHY.
+HEALTHY = "healthy"
+SUSPECT = "suspect"
+QUARANTINED = "quarantined"
+PROBATION = "probation"
+RELEASED = "released"
+
+#: States that may receive traffic.
+PLACEABLE = (HEALTHY, SUSPECT, PROBATION)
+
+
+def lane_unit(idx: int) -> str:
+    """The lane's name in the shared quarantine ledger (journal failure
+    rows, ``quarantine``/``quarantine-release`` trace points, degrade
+    kinds) — the serve twin of a sweep unit name."""
+    return f"lane:{idx}"
+
+
+class LanesExhausted(RuntimeError):
+    """Every placeable lane failed this batch (including last-resort
+    canary rescues). ``causes`` is [(lane_idx, exc), ...] in attempt
+    order; ``timed_out`` reflects the LAST cause — the error code the
+    riders see matches what finally stopped the batch."""
+
+    def __init__(self, label: str, causes: list):
+        self.causes = causes
+        last = causes[-1][1] if causes else None
+        self.timed_out = isinstance(last, watchdog.DispatchTimeout)
+        names = ",".join(f"lane{i}:{type(e).__name__}" for i, e in causes)
+        super().__init__(
+            f"batch {label}: no lane could serve it ({names or 'no lanes'})")
+
+
+class Lane:
+    """One dispatch lane: a device, a health state, and the one guarded
+    engine-call seam. The pool owns placement and failover; the lane
+    owns its device contact and its state transitions."""
+
+    def __init__(self, idx: int, device, engine: str, deadline_s: float,
+                 retries: int, clock=time.monotonic):
+        self.idx = idx
+        self.device = device
+        self.engine = engine
+        self.deadline_s = deadline_s
+        self.state = HEALTHY
+        self.warmed = False
+        self.policy = RetryPolicy(
+            attempts=max(int(retries), 1), base_delay_s=0.0,
+            retry_on=(RuntimeError,), name=f"lane{idx}-dispatch")
+        self.dispatches = 0
+        self.blocks = 0
+        self.failures = 0
+        self.timeouts = 0
+        self.redispatches_in = 0
+        self.canaries = 0
+        self.probation_left = 0
+        self.transitions: list[dict] = []
+        self._clock = clock
+        self._t0 = clock()
+
+    # -- state machine -----------------------------------------------------
+    def _to(self, new: str, why: str) -> None:
+        old = self.state
+        if old == new:
+            return
+        self.state = new
+        self.transitions.append({
+            "prev": old, "to": new, "why": why,
+            "t_s": round(self._clock() - self._t0, 3)})
+        trace.point("lane-state", lane=self.idx, prev=old, to=new, why=why)
+
+    def _quarantine(self, why: str, journal) -> None:
+        came_from = self.state
+        self._to(QUARANTINED, why)
+        if came_from == QUARANTINED:
+            return  # already there (e.g. a failed canary): one event
+        trace.point("quarantine", unit=lane_unit(self.idx), lane=self.idx,
+                    reason=why)
+        degrade.degrade(f"quarantined:{lane_unit(self.idx)}",
+                        f"lane {self.idx} ({self.device}): {why}")
+        if journal is not None:
+            journal.record_failure(lane_unit(self.idx), why)
+
+    def adopt_journal_quarantine(self, fails: int) -> None:
+        """Start quarantined from recorded journal failure rows (the
+        resume path — no NEW failure row is appended; the evidence is
+        already on file). The lane still gets warmed so a canary can
+        release it once it proves healthy."""
+        self._to(QUARANTINED, f"journal:{fails}")
+        trace.point("quarantine", unit=lane_unit(self.idx), lane=self.idx,
+                    reason=f"journal:{fails}")
+        degrade.degrade(f"quarantined:{lane_unit(self.idx)}",
+                        f"lane {self.idx}: {fails} failure row(s) on the "
+                        f"serve journal (release: canary probe or "
+                        f"serve.bench --unquarantine {lane_unit(self.idx)})")
+
+    def note_success(self, blocks: int, redispatch: bool,
+                     probation_batches: int) -> None:
+        self.dispatches += 1
+        self.blocks += int(blocks)
+        if redispatch:
+            self.redispatches_in += 1
+        if self.state == SUSPECT:
+            self._to(HEALTHY, "recovered")
+        elif self.state == PROBATION:
+            self.probation_left -= 1
+            if self.probation_left <= 0:
+                self._to(RELEASED, f"probation-served:{probation_batches}")
+                trace.point("quarantine-release", unit=lane_unit(self.idx),
+                            lane=self.idx)
+                self._to(HEALTHY, "released")
+
+    def note_failure(self, exc: BaseException, journal) -> None:
+        self.failures += 1
+        if self.state == HEALTHY:
+            self._to(SUSPECT, type(exc).__name__)
+        else:  # a suspect or probation lane gets no second failure
+            self._quarantine(type(exc).__name__, journal)
+
+    def note_timeout(self, exc: BaseException, journal) -> None:
+        # A hang is never transient: a device that wedged once cannot be
+        # trusted with another batch's latency budget until a canary
+        # proves it — straight to quarantined from any state.
+        self.timeouts += 1
+        self._quarantine("dispatch-timeout", journal)
+
+    # -- the ONE device-dispatch seam in serve/ ----------------------------
+    def engine_call(self, words, ctr_words, rk, nr: int, label: str,
+                    warmup: bool = False):
+        """One scattered-CTR dispatch on THIS lane's device, under this
+        lane's watchdog deadline. Inputs are staged (committed) onto the
+        lane's device so jit routes the compiled program there; the
+        fault seams fire only for traffic (warmup primes compiles, it is
+        not a servable batch). Warmup runs under the global opt-in
+        deadline (a first-contact compile legitimately dwarfs a
+        steady-state dispatch) — EXCEPT on a quarantined lane, which
+        already proved it cannot be trusted with an unbounded wait."""
+        deadline_s = (self.deadline_s
+                      if (not warmup or self.state == QUARANTINED)
+                      else watchdog.default_deadline_s())
+        with watchdog.deadline(deadline_s,
+                               what=f"lane {self.idx} dispatch {label}"):
+            if not warmup:
+                faults.check("serve_dispatch", label)
+                faults.check("dispatch_fail", label)
+                faults.check_lane("lane_fail", self.idx, label)
+                watchdog.injected_hang("dispatch_hang", label)
+                # Scoped shot first, plain pool only if it did not fire:
+                # one dispatch consumes at most one lane_hang shot (the
+                # check_lane contract).
+                if not watchdog.injected_hang(
+                        faults.scoped("lane_hang", self.idx), label):
+                    watchdog.injected_hang("lane_hang", label)
+            w, c, r = words, ctr_words, rk
+            if self.device is not None:
+                w = jax.device_put(w, self.device)
+                c = jax.device_put(c, self.device)
+                r = jax.device_put(r, self.device)
+            out = aes.ctr_crypt_words_scattered(w, c, r, nr, self.engine)
+            jax.block_until_ready(out)
+        return np.asarray(out)
+
+    def stats(self) -> dict:
+        return {
+            "lane": self.idx, "device": str(self.device),
+            "state": self.state, "warmed": self.warmed,
+            "dispatches": self.dispatches, "blocks": self.blocks,
+            "bytes": self.blocks * 16, "failures": self.failures,
+            "timeouts": self.timeouts,
+            "redispatches_in": self.redispatches_in,
+            "canaries": self.canaries,
+            "transitions": list(self.transitions),
+        }
+
+
+class LanePool:
+    """The lane set plus placement, failover, and canary probing.
+
+    ``lanes=None`` gives one lane per visible device; an explicit count
+    may exceed the device count (lanes then share devices round-robin —
+    the single-device rehearsal mode tests and CPU CI use)."""
+
+    def __init__(self, engine: str, deadline_s: float = 0.0,
+                 retries: int = 2, lanes: int | None = None,
+                 probe_every: int = 8, probation_batches: int = 2,
+                 journal=None, clock=time.monotonic):
+        devices = list(jax.devices())
+        n = len(devices) if lanes is None else max(int(lanes), 1)
+        self.engine = engine
+        self.lanes = [Lane(i, devices[i % len(devices)], engine,
+                           deadline_s, retries, clock)
+                      for i in range(n)]
+        self.journal = journal
+        self.probe_every = max(int(probe_every), 1)
+        self.probation_batches = max(int(probation_batches), 1)
+        self.redispatches = 0
+        self._since_probe = 0
+        self._canary = None  # (words, ctr_words, rk, nr, expected, bucket)
+
+    # -- journal resume ----------------------------------------------------
+    def adopt_journal_quarantines(self) -> list[int]:
+        """Quarantine lanes with failure rows on the serve journal (any
+        recorded row: serve only journals quarantine-grade events).
+        Returns the adopted lane indices."""
+        if self.journal is None:
+            return []
+        adopted = []
+        for lane in self.lanes:
+            fails = self.journal.fail_count(lane_unit(lane.idx))
+            if fails > 0:
+                lane.adopt_journal_quarantine(fails)
+                adopted.append(lane.idx)
+        return adopted
+
+    # -- placement ---------------------------------------------------------
+    def placeable(self, exclude=()) -> list[Lane]:
+        return [l for l in self.lanes
+                if l.idx not in exclude and l.warmed
+                and l.state in PLACEABLE]
+
+    def place(self, exclude=()) -> Lane | None:
+        """Least-loaded placeable lane (cumulative blocks; index breaks
+        ties so placement is deterministic for a given history)."""
+        cands = self.placeable(exclude)
+        if not cands:
+            return None
+        return min(cands, key=lambda l: (l.blocks, l.idx))
+
+    # -- the canary --------------------------------------------------------
+    def set_canary(self, words, ctr_words, rk, nr: int, expected,
+                   bucket: int) -> None:
+        """Pin the warmup-shaped probe batch and its expected output
+        (captured from the first lane to warm; every other lane's warmup
+        output was compared against it — cross-lane bit-exactness is a
+        startup invariant, not a hope)."""
+        self._canary = (words, ctr_words, rk, nr,
+                        np.asarray(expected), int(bucket))
+
+    def probe_lane(self, lane: Lane) -> bool:
+        """One canary dispatch on a quarantined lane: a bit-exact
+        response releases it into probation; a failure, timeout, or
+        mismatched payload leaves it quarantined. A hung canary abandons
+        its ``lane-probe`` span — the same orphan-as-kill-evidence
+        convention as a hung traffic dispatch."""
+        if (self._canary is None or not lane.warmed
+                or lane.state != QUARANTINED):
+            return False
+        words, ctr_words, rk, nr, expected, bucket = self._canary
+        lane.canaries += 1
+        cm = trace.detached_span("lane-probe", lane=lane.idx,
+                                 bucket=bucket, engine=self.engine)
+        cm.__enter__()
+        try:
+            out = lane.engine_call(words, ctr_words, rk, nr,
+                                   f"canary:lane{lane.idx}")
+        except watchdog.DispatchTimeout:
+            trace.counter("serve_canary_failed", lane=lane.idx)
+            return False  # span deliberately abandoned: the kill evidence
+        except Exception as e:  # noqa: BLE001 - a sick lane may raise anything
+            cm.__exit__(type(e), e, None)
+            trace.counter("serve_canary_failed", lane=lane.idx)
+            return False
+        cm.__exit__(None, None, None)
+        if not np.array_equal(out, expected):
+            trace.counter("serve_canary_mismatch", lane=lane.idx)
+            return False
+        lane.probation_left = self.probation_batches
+        lane._to(PROBATION, "canary-ok")
+        trace.point("lane-probe-ok", lane=lane.idx,
+                    unit=lane_unit(lane.idx))
+        return True
+
+    def maybe_probe(self) -> None:
+        """Periodic canary pass: every ``probe_every`` batches, probe
+        every warmed quarantined lane once. Called by the server between
+        batches so a probe never delays the batch that triggered it."""
+        self._since_probe += 1
+        if self._since_probe < self.probe_every:
+            return
+        self._since_probe = 0
+        for lane in self.lanes:
+            if lane.state == QUARANTINED and lane.warmed:
+                self.probe_lane(lane)
+
+    # -- dispatch with failover --------------------------------------------
+    def dispatch(self, words, ctr_words, rk, nr: int, label: str,
+                 bucket: int, blocks: int, requests: int):
+        """Place and run one batch, failing over across lanes until it
+        succeeds or every lane has been tried. Returns (output words,
+        lane, redispatches). Raises LanesExhausted when no lane could
+        serve it — only then may the caller answer per-request errors
+        (re-dispatch-before-error is the failover contract)."""
+        causes: list = []
+        tried: set[int] = set()
+        while True:
+            lane = self.place(exclude=tried)
+            if lane is None:
+                lane = self._rescue(tried)
+            if lane is None:
+                raise LanesExhausted(label, causes)
+            cm = trace.detached_span(
+                "lane-dispatch", lane=lane.idx, batch=label, bucket=bucket,
+                blocks=blocks, requests=requests, engine=self.engine,
+                redispatch=bool(tried))
+            cm.__enter__()
+            try:
+                out = lane.policy.run(
+                    lambda att: lane.engine_call(words, ctr_words, rk, nr,
+                                                 label))
+            except watchdog.DispatchTimeout as e:
+                # The dispatch never ended: the span is ABANDONED, not
+                # closed — its orphaned begin is the kill evidence
+                # (obs.report --check --expected-orphans lane-dispatch).
+                trace.counter("serve_lane_timeout", lane=lane.idx)
+                lane.note_timeout(e, self.journal)
+                causes.append((lane.idx, e))
+                tried.add(lane.idx)
+                continue
+            except Exception as e:  # noqa: BLE001 - failover, then contain
+                cm.__exit__(type(e), e, None)
+                trace.counter("serve_lane_failed", lane=lane.idx)
+                lane.note_failure(e, self.journal)
+                causes.append((lane.idx, e))
+                tried.add(lane.idx)
+                continue
+            cm.__exit__(None, None, None)
+            if tried:
+                self.redispatches += 1
+                trace.counter("serve_redispatch", lane=lane.idx,
+                              after=len(tried))
+            lane.note_success(blocks, redispatch=bool(tried),
+                              probation_batches=self.probation_batches)
+            return out, lane, len(tried)
+
+    def _rescue(self, tried: set) -> Lane | None:
+        """Last-resort probe when no placeable lane remains: canary the
+        quarantined lanes now rather than fail the batch — a single-lane
+        server recovering from a transient hang re-proves its lane here
+        instead of answering errors forever."""
+        for lane in self.lanes:
+            if lane.idx in tried or lane.state != QUARANTINED:
+                continue
+            if self.probe_lane(lane):
+                return lane
+        return None
+
+    # -- introspection -----------------------------------------------------
+    def quarantine_events(self) -> int:
+        return sum(1 for l in self.lanes
+                   for t in l.transitions if t["to"] == QUARANTINED)
+
+    def stats(self) -> dict:
+        return {
+            "count": len(self.lanes),
+            "placed_across": sum(1 for l in self.lanes if l.dispatches),
+            "redispatches": self.redispatches,
+            "quarantine_events": self.quarantine_events(),
+            "states": {s: sum(1 for l in self.lanes if l.state == s)
+                       for s in sorted({l.state for l in self.lanes})},
+            "per_lane": [l.stats() for l in self.lanes],
+        }

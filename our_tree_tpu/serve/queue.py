@@ -84,6 +84,7 @@ class Request:
     budget: Budget | None = None
     t_submit: float = 0.0
     _span_cm: object | None = field(default=None, repr=False)
+    _queue: object | None = field(default=None, repr=False)
 
     @property
     def nblocks(self) -> int:
@@ -92,6 +93,13 @@ class Request:
     def resolve(self, resp: Response) -> None:
         if not self.future.done():
             self.future.set_result(resp)
+            # The lost-request ledger: every ACCEPTED request must be
+            # answered exactly once (payload or coded error) — counted
+            # at the one resolution seam, so `accepted - answered` is
+            # the number of requests the server silently dropped
+            # (serve.bench exits 1 when it is ever nonzero).
+            if self._queue is not None:
+                self._queue.answered += 1
 
     def fail(self, code: str, detail: str = "",
              batch: str | None = None) -> None:
@@ -118,7 +126,9 @@ class RequestQueue:
         self._pending: list[Request] = []
         self._event = asyncio.Event()
         self._ids = itertools.count()
+        self.closed = False
         self.accepted = 0
+        self.answered = 0
         self.shed = 0
         self.refused = 0
         self.expired = 0
@@ -135,7 +145,11 @@ class RequestQueue:
         fut = asyncio.get_running_loop().create_future()
         data = np.asarray(payload, dtype=np.uint8).reshape(-1)
         code = None
-        if data.size == 0 or data.size % 16:
+        if self.closed:
+            # Placement stopped (graceful drain in progress): refuse up
+            # front so the drain set is frozen the moment stop() begins.
+            code, why = ERR_SHUTDOWN, "server is draining"
+        elif data.size == 0 or data.size % 16:
             code, why = ERR_BAD_REQUEST, "payload must be a nonzero multiple of 16 bytes"
         elif len(bytes(key)) not in (16, 24, 32):
             # Refused HERE, not discovered at key expansion inside the
@@ -171,7 +185,7 @@ class RequestQueue:
             nonce=bytes(nonce), payload=data, future=fut,
             budget=Budget(deadline, clock=self._clock) if deadline > 0
             else None,
-            t_submit=self._clock())
+            t_submit=self._clock(), _queue=self)
         cm = trace.detached_span("request-queued", req=req.id,
                                  tenant=tenant, blocks=req.nblocks)
         cm.__enter__()
@@ -192,6 +206,12 @@ class RequestQueue:
     def kick(self) -> None:
         """Wake a waiting drain loop (shutdown path)."""
         self._event.set()
+
+    def close(self) -> None:
+        """Stop admission (new submits answer ``shutdown`` immediately).
+        Already-accepted requests are untouched — the server's drain
+        pass dispatches them before the loop exits."""
+        self.closed = True
 
     def drain(self) -> list[Request]:
         """Take everything pending: closes each request's queued span and
@@ -227,6 +247,7 @@ class RequestQueue:
         return len(taken)
 
     def stats(self) -> dict:
-        return {"accepted": self.accepted, "shed": self.shed,
-                "refused": self.refused, "expired": self.expired,
-                "depth": self.depth()}
+        return {"accepted": self.accepted, "answered": self.answered,
+                "lost": self.accepted - self.answered,
+                "shed": self.shed, "refused": self.refused,
+                "expired": self.expired, "depth": self.depth()}

@@ -1,65 +1,83 @@
-"""The serve dispatch loop: queue -> shape buckets -> guarded engine calls.
+"""The serve dispatch loop: queue -> shape buckets -> dispatch lanes.
 
 One asyncio loop on the main thread owns the whole path. Request
 coroutines ``submit`` into the bounded queue; the batcher loop drains,
-coalesces per (tenant, key) into ladder rungs (``batcher``), and
-dispatches each batch synchronously through the scattered-CTR seam
-(``models.aes.ctr_crypt_words_scattered`` under the engine
-``resolve_engine`` picked at start). Synchronous on purpose: one device
-serializes dispatches anyway, and keeping the engine call on the MAIN
-thread is what lets the watchdog's SIGALRM interrupt a wedged dispatch
+coalesces per (tenant, key) into ladder rungs (``batcher``), and places
+each batch on a dispatch LANE — one per visible device
+(``serve/lanes.py``), least-loaded across healthy lanes. Dispatch stays
+synchronous on the main thread on purpose: that is what lets each
+lane's watchdog SIGALRM interrupt a wedged device call
 (resilience/watchdog.py's GIL-releasing contract).
 
-Failure containment, per batch:
+Failure containment, per batch (docs/SERVING.md has the sequence
+diagram):
 
-* transient dispatch failures retry through the shared ``RetryPolicy``
-  (``serve-dispatch``; every failed attempt is a ``retry_failures``
-  trace counter like every other policy in the repo);
-* a batch that still fails resolves EVERY rider with a per-request
-  ``dispatch-failed`` error — the server keeps serving;
-* a batch killed by the watchdog (``DispatchTimeout``) resolves its
-  riders with ``deadline`` errors and deliberately ABANDONS its
-  ``batch-dispatched`` span: the dispatch never ended, so the orphaned
-  begin is the honest evidence — the same closed-by-kill shape a
-  SIGKILLed sweep child leaves, and what the CI gate pins with
-  ``obs.report --check --expected-orphans batch-dispatched``.
+* transient dispatch failures retry through the lane's ``RetryPolicy``
+  (``lane<i>-dispatch``) ON the same lane;
+* a lane that still fails (or hangs past its watchdog deadline) is
+  degraded through the health state machine — suspect, then
+  quarantined; a TIMEOUT quarantines immediately — and the batch is
+  **re-dispatched bit-exactly on a healthy lane** (CTR with explicit
+  per-block counters is side-effect-free replay) BEFORE any rider sees
+  an error;
+* only when every lane has been tried (``LanesExhausted``) does the
+  batch answer per-request errors (``deadline`` if the last cause was a
+  hang, else ``dispatch-failed``) — and the server keeps serving;
+* a hung dispatch deliberately ABANDONS its ``lane-dispatch`` span: the
+  orphaned begin is the kill evidence (``obs.report --check
+  --expected-orphans lane-dispatch``), same convention as a SIGKILLed
+  sweep child;
+* quarantined lanes are periodically canary-probed between batches and
+  released into probation on a bit-exact response; quarantine is
+  persisted to the serve journal with the SAME failure rows the sweep
+  journal uses, so ``serve.bench --unquarantine lane:<i>`` is the same
+  release edit as ``harness.bench --unquarantine``.
 
-The fault seam (``serve_dispatch``, plus the generic ``dispatch_fail`` /
-``dispatch_hang``) sits inside the guard; the SERVE-LEVEL seams are
-exempt during warmup — warmup is not traffic, and a counted CI shot
-should land on a served batch, not on the ladder priming. Deeper engine
-seams keep their own semantics: on a Pallas engine the launch seam
-(``ops/pallas_aes.py:_dispatch_seam``) fires for priming dispatches
-like any other first device contact, so there an armed generic fault
-can fail ``start()`` loudly — a server that cannot prime its ladder
-cannot serve, and masking that would be worse. The CPU CI rehearsals
-run the jnp engine, where the serve seams are the only ones.
+Shutdown DRAINS instead of dropping: ``stop()`` first closes admission
+(new submits answer ``shutdown`` immediately), then lets the batcher
+loop dispatch everything already accepted, then flushes (normally
+nothing) — a clean stop answers every accepted request and leaves no
+orphaned span. ``queue.stats()["lost"]`` (accepted minus answered) is
+the invariant ``serve.bench`` gates on: it must be 0 even across a
+faulted run.
+
+The fault seams (``serve_dispatch``, generic ``dispatch_fail`` /
+``dispatch_hang``, per-lane ``lane_fail``/``lane_hang`` with
+``@lane=<i>`` scoping) all sit inside the lane's guarded engine call;
+serve-level seams are exempt during warmup — warmup is not traffic, and
+a counted CI shot should land on a served batch, not on the ladder
+priming. Deeper engine seams keep their own semantics (on a Pallas
+engine the launch seam fires for priming dispatches like any other
+first device contact).
 
 Obs spans: ``request-queued`` (queue.py, admission->drain),
-``batch-formed`` (array packing), ``batch-dispatched`` (the engine
-call, ``engine`` attr for the report's per-engine table).
+``batch-formed`` (array packing), ``lane-dispatch`` (the engine call,
+``lane`` + ``engine`` attrs for the report's per-lane and per-engine
+tables), ``lane-probe`` (canary), ``serve-warmup`` / ``lane-warmup``.
 """
 
 from __future__ import annotations
 
 import asyncio
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 import jax
 import numpy as np
 
 from ..models import aes
 from ..obs import trace
-from ..resilience import faults, watchdog
-from ..resilience.policy import RetryPolicy
-from . import batcher
+from ..resilience import journal as journal_mod
+from ..resilience import watchdog
+from ..utils import packing
+from . import batcher, lanes
 from .keycache import KeyCache, key_digest
 from .queue import ERR_DEADLINE, ERR_DISPATCH, RequestQueue
 
 #: The jax monitoring event that fires once per REAL backend compile and
 #: never on an executable-cache hit — the zero-recompile assertion's
 #: ground truth (``serve.bench --requests N --mixed-sizes`` must hold it
-#: flat after warmup).
+#: flat after warmup). With multiple lanes the same program compiles
+#: once per DEVICE, which is why warmup walks every lane x rung.
 _COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
 _COMPILES = 0
 _MONITOR_ON = False
@@ -89,15 +107,28 @@ class ServerConfig:
     max_depth: int = 1024
     #: per-request residency deadline (queue admission -> response)
     request_deadline_s: float = 30.0
-    #: watchdog deadline around each engine call; None = the global
-    #: OT_DISPATCH_DEADLINE default (0/unset disarms, like every seam)
+    #: watchdog deadline around each lane's engine call; None = the
+    #: global OT_DISPATCH_DEADLINE default (0/unset disarms, like every
+    #: seam)
     dispatch_deadline_s: float | None = None
-    #: RetryPolicy attempts per batch (1 = no retry)
+    #: RetryPolicy attempts per batch PER LANE (1 = no on-lane retry;
+    #: failover across lanes happens regardless)
     retries: int = 2
     keycache_per_tenant: int = 8
     #: key lengths (bits) warmed per rung — a key size outside this set
     #: still works, it just pays its first-contact compile online
     warmup_key_bits: tuple = (128,)
+    #: dispatch lanes: None = one per visible device; an explicit count
+    #: may exceed the device count (lanes share devices round-robin —
+    #: the single-device rehearsal mode)
+    lanes: int | None = None
+    #: canary-probe quarantined lanes every N batches
+    probe_every: int = 8
+    #: clean batches a released lane serves before leaving probation
+    probation_batches: int = 2
+    #: serve journal path (lane quarantine persistence + the
+    #: --unquarantine release edit); None = in-memory health only
+    journal: str | None = None
 
 
 class Server:
@@ -112,13 +143,12 @@ class Server:
                                   max_request_blocks=self.rungs[-1],
                                   default_deadline_s=c.request_deadline_s)
         self.keycache = KeyCache(per_tenant=c.keycache_per_tenant)
-        self.engine: str | None = None  # resolved at start
+        self.engine: str | None = None   # resolved at start
+        self.pool: lanes.LanePool | None = None  # built at start
         self._deadline_s = (watchdog.default_deadline_s()
                             if c.dispatch_deadline_s is None
                             else max(float(c.dispatch_deadline_s), 0.0))
-        self._policy = RetryPolicy(
-            attempts=max(int(c.retries), 1), base_delay_s=0.0,
-            retry_on=(RuntimeError,), name="serve-dispatch")
+        self._journal = None
         self._task: asyncio.Task | None = None
         self._running = False
         self.batches = 0
@@ -132,36 +162,131 @@ class Server:
 
     # -- lifecycle ---------------------------------------------------------
     async def start(self) -> None:
-        """Resolve the engine, warm the ladder, start the batcher loop."""
+        """Resolve the engine, build the lane pool, adopt journal
+        quarantines, warm every lane x rung, start the batcher loop."""
+        c = self.config
         before = compile_count()
-        self.engine = aes.resolve_engine(self.config.engine)
-        with trace.span("serve-warmup", engine=self.engine,
-                        rungs=len(self.rungs)):
-            for bits in self.config.warmup_key_bits:
-                _, nr, rk = self.keycache.get("_warmup",
-                                              b"\x00" * (bits // 8))
-                for rung in self.rungs:
-                    words = np.zeros(4 * rung, dtype=np.uint32)
-                    self._engine_call(words, words, rk, nr,
-                                      f"warmup:{rung}", warmup=True)
+        self.engine = aes.resolve_engine(c.engine)
+        if c.journal:
+            self._journal = journal_mod.SweepJournal(
+                c.journal, {"kind": "serve-lanes",
+                            "lanes": c.lanes, "engine": c.engine})
+        self.pool = lanes.LanePool(
+            engine=self.engine, deadline_s=self._deadline_s,
+            retries=c.retries, lanes=c.lanes, probe_every=c.probe_every,
+            probation_batches=c.probation_batches, journal=self._journal)
+        self.pool.adopt_journal_quarantines()
+        self._warmup()
+        if not any(l.warmed for l in self.pool.lanes):
+            # Per-lane containment must not mask a TOTAL boot failure:
+            # one dead lane among several degrades that lane, but a
+            # server that could not prime a single lane cannot serve —
+            # fail start() loudly (the pre-lane contract) instead of
+            # answering dispatch-failed forever.
+            raise RuntimeError(
+                f"serve warmup failed on all {len(self.pool.lanes)} "
+                f"lane(s) — no lane can dispatch (engine {self.engine})")
         self._compiles_at_ready = compile_count()
         self.warmup_compiles = self._compiles_at_ready - before
         trace.gauge("serve_warmup_compiles", self.warmup_compiles,
-                    engine=self.engine)
+                    engine=self.engine, lanes=len(self.pool.lanes))
         self._running = True
         self._task = asyncio.ensure_future(self._loop())
 
+    def _warmup(self) -> None:
+        """Prime every lane's compile cache over the full ladder. The
+        smallest rung doubles as the CANARY batch: its input is pinned
+        (zero key, zero payload, zero-nonce counters), the first lane's
+        output becomes the canary expectation, and every other lane's
+        warmup output is compared against it — cross-lane bit-exactness
+        is checked at startup, not assumed. A lane whose warmup fails,
+        hangs, or mismatches starts quarantined and UNWARMED (it cannot
+        be canary-released; ``--unquarantine`` + restart is its path
+        back)."""
+        c = self.config
+        canary_rung = self.rungs[0]
+        canary_words = np.zeros(4 * canary_rung, dtype=np.uint32)
+        canary_ctr = packing.np_ctr_le_blocks(
+            b"\x00" * 16,
+            np.arange(canary_rung, dtype=np.uint32)).reshape(-1)
+        canary_expected = None
+        # Trusted lanes warm FIRST: the first lane to warm pins the
+        # canary expectation every other lane is compared against, and
+        # a lane that starts quarantined (journal-adopted — possibly for
+        # producing wrong bytes) must never be the oracle. With healthy
+        # lanes ahead of it, a corrupt quarantined lane fails its own
+        # warmup comparison instead, stays UNWARMED, and can never be
+        # canary-released against its own output.
+        order = sorted(self.pool.lanes,
+                       key=lambda l: (l.state == lanes.QUARANTINED, l.idx))
+        with trace.span("serve-warmup", engine=self.engine,
+                        rungs=len(self.rungs), lanes=len(self.pool.lanes)):
+            for lane in order:
+                with trace.span("lane-warmup", lane=lane.idx,
+                                engine=self.engine):
+                    try:
+                        mismatch = False
+                        for bits in c.warmup_key_bits:
+                            _, nr, rk = self.keycache.get(
+                                "_warmup", b"\x00" * (bits // 8))
+                            for rung in self.rungs:
+                                if (rung == canary_rung
+                                        and bits == c.warmup_key_bits[0]):
+                                    out = lane.engine_call(
+                                        canary_words, canary_ctr, rk, nr,
+                                        f"warmup:{rung}", warmup=True)
+                                    if canary_expected is None:
+                                        canary_expected = out
+                                        self.pool.set_canary(
+                                            canary_words, canary_ctr, rk,
+                                            nr, out, canary_rung)
+                                    elif not np.array_equal(
+                                            out, canary_expected):
+                                        mismatch = True
+                                        break
+                                else:
+                                    words = np.zeros(4 * rung,
+                                                     dtype=np.uint32)
+                                    lane.engine_call(words, words, rk, nr,
+                                                     f"warmup:{rung}",
+                                                     warmup=True)
+                            if mismatch:
+                                break
+                        if mismatch:
+                            lane._quarantine("warmup-mismatch",
+                                             self._journal)
+                        else:
+                            lane.warmed = True
+                    except Exception as e:  # noqa: BLE001 - contain per lane
+                        # Includes DispatchTimeout: a lane dead at boot
+                        # degrades THAT lane, not start().
+                        lane._quarantine(
+                            f"warmup-failed:{type(e).__name__}",
+                            self._journal)
+
     async def stop(self) -> None:
+        """Graceful drain: stop placement (admission closes), let the
+        batcher loop finish everything already accepted, then close.
+        A clean stop answers every accepted request — zero lost, zero
+        orphaned spans."""
+        self.queue.close()
         self._running = False
         self.queue.kick()
         if self._task is not None:
             await self._task
             self._task = None
-        self.queue.flush()
+        dropped = self.queue.flush()
+        if dropped:
+            trace.counter("serve_drain_dropped", n=dropped)
+        trace.point("serve-drained",
+                    answered=self.queue.answered,
+                    lost=self.queue.accepted - self.queue.answered)
+        if self._journal is not None:
+            self._journal.close()
 
     def steady_compiles(self) -> int:
         """Backend compiles since warmup finished — the number the bucket
-        ladder exists to hold at zero."""
+        ladder (walked per lane) exists to hold at zero."""
         return compile_count() - self._compiles_at_ready
 
     # -- request side ------------------------------------------------------
@@ -173,7 +298,7 @@ class Server:
 
     # -- the batcher loop --------------------------------------------------
     async def _loop(self) -> None:
-        while self._running:
+        while True:
             await self.queue.wait()
             while True:
                 requests = self.queue.drain()
@@ -182,17 +307,27 @@ class Server:
                 for b in batcher.form_batches(requests, self.rungs,
                                               key_digest):
                     self._run_batch(b)
+                    self.pool.maybe_probe()
                     # Yield between batches: resolved clients get to
                     # resubmit, so the next drain coalesces their
                     # follow-ups (the "continuous" in continuous
                     # batching under a closed loop).
                     await asyncio.sleep(0)
+            if not self._running:
+                # stop() closed admission BEFORE clearing _running, so
+                # the drain that just emptied was the complete final
+                # set: everything accepted has been dispatched (the
+                # drain-on-shutdown contract), and exiting here is what
+                # makes it true.
+                return
 
     def _run_batch(self, b: batcher.Batch) -> None:
         """One batch, contained: NO exception may escape — an escape
         would kill the batcher task and wedge every future request, so
         anything unexpected resolves the riders with errors and the
         loop lives on."""
+        from .queue import Response  # cycle-free: queue never imports us
+
         try:
             with trace.span("batch-formed", batch=b.label, bucket=b.bucket,
                             blocks=b.blocks, requests=len(b.requests)):
@@ -210,33 +345,33 @@ class Server:
                                          {"batches": 0, "blocks": 0})
         occ["batches"] += 1
         occ["blocks"] += b.blocks
-        cm = trace.detached_span(
-            "batch-dispatched", batch=b.label, bucket=b.bucket,
-            blocks=b.blocks, requests=len(b.requests), engine=self.engine)
-        cm.__enter__()
         try:
-            out = self._policy.run(lambda att: self._engine_call(
-                b.words, b.ctr_words, rk, nr, b.label))
-        except watchdog.DispatchTimeout as e:
-            # The dispatch never completed: the span is ABANDONED, not
-            # closed — its orphaned begin is the kill evidence
-            # (module docstring; the CI gate's --expected-orphans).
-            self.batches_timed_out += 1
-            trace.counter("serve_batch_deadline", batch=b.label)
+            out, _lane, _redispatched = self.pool.dispatch(
+                b.words, b.ctr_words, rk, nr, b.label,
+                bucket=b.bucket, blocks=b.blocks,
+                requests=len(b.requests))
+        except lanes.LanesExhausted as e:
+            # Failover already ran: every lane was tried (and each
+            # miss degraded its lane's health). Only now do the riders
+            # see errors — coded by what finally stopped the batch.
+            if e.timed_out:
+                self.batches_timed_out += 1
+                trace.counter("serve_batch_deadline", batch=b.label)
+                code = ERR_DEADLINE
+            else:
+                self.batches_failed += 1
+                trace.counter("serve_batch_failed", batch=b.label)
+                code = ERR_DISPATCH
             for req in b.requests:
-                req.fail(ERR_DEADLINE, str(e), batch=b.label)
+                req.fail(code, str(e), batch=b.label)
             return
         except Exception as e:  # noqa: BLE001 - containment (docstring)
-            cm.__exit__(type(e), e, None)
             self.batches_failed += 1
             trace.counter("serve_batch_failed", batch=b.label)
             for req in b.requests:
                 req.fail(ERR_DISPATCH, f"{type(e).__name__}: {e}",
                          batch=b.label)
             return
-        cm.__exit__(None, None, None)
-        from .queue import Response  # cycle-free: queue never imports us
-
         try:
             for req, data in zip(b.requests, b.split_output(out)):
                 req.resolve(Response(ok=True, payload=data, batch=b.label))
@@ -249,31 +384,6 @@ class Server:
             for req in b.requests:
                 req.fail(ERR_DISPATCH, f"{type(e).__name__}: {e}",
                          batch=b.label)
-
-    # -- the guarded engine call ------------------------------------------
-    def _engine_call(self, words, ctr_words, rk, nr, label,
-                     warmup: bool = False):
-        """One scattered-CTR dispatch under the watchdog. The
-        serve-level fault seams fire only for traffic (warmup primes
-        compiles, it is not a servable batch — a counted CI shot should
-        land on requests); engine-internal seams, where an engine has
-        them, see warmup like any first dispatch (module docstring).
-        Warmup also swaps the SERVING deadline for the global opt-in one
-        (OT_DISPATCH_DEADLINE): a first-contact compile legitimately
-        dwarfs a steady-state dispatch, and killing the ladder priming
-        at the per-batch latency budget would wedge every cold start."""
-        deadline_s = (watchdog.default_deadline_s() if warmup
-                      else self._deadline_s)
-        with watchdog.deadline(deadline_s,
-                               what=f"serve dispatch {label}"):
-            if not warmup:
-                faults.check("serve_dispatch", label)
-                faults.check("dispatch_fail", label)
-                watchdog.injected_hang("dispatch_hang", label)
-            out = aes.ctr_crypt_words_scattered(
-                words, ctr_words, rk, nr, self.engine)
-            jax.block_until_ready(out)
-        return np.asarray(out)
 
     # -- introspection -----------------------------------------------------
     def occupancy_histogram(self) -> dict:
@@ -293,6 +403,8 @@ class Server:
             "occupancy": self.occupancy_histogram(),
             "queue": self.queue.stats(),
             "keycache": self.keycache.stats(),
+            "lanes": (self.pool.stats() if self.pool is not None
+                      else {"count": 0}),
             "compiles": {"warmup": self.warmup_compiles,
                          "steady": self.steady_compiles()},
         }

@@ -159,6 +159,51 @@ def test_fault_points_rule_checks_the_live_registry(tmp_path):
     assert "dispach_hang" in flagged[0].message
 
 
+def test_serve_lane_seam_rule(tmp_path):
+    """Device dispatch in serve/ only through serve/lanes.py: a raw
+    scattered-CTR call (or block_until_ready/device_put) anywhere else
+    under serve/ flags; the same code inside lanes.py is the seam."""
+    src = """
+        import jax
+        from our_tree_tpu.models import aes
+
+        def dispatch(words, ctr, rk, nr):
+            out = aes.ctr_crypt_words_scattered(words, ctr, rk, nr, "jnp")
+            jax.block_until_ready(out)
+            return out
+    """
+    fs = _lint(tmp_path, src, name="our_tree_tpu/serve/server.py")
+    flagged = [f for f in fs if f.rule == "serve-lane-seam"]
+    assert len(flagged) == 2  # the scattered call AND the barrier
+    assert "serve/lanes.py" in flagged[0].message
+    # The seam file itself is the allowed caller...
+    fs = _lint(tmp_path, src, name="our_tree_tpu/serve/lanes.py")
+    assert "serve-lane-seam" not in _rules(fs)
+    # ...and the rule only scopes serve/ (harness dispatch has its own
+    # watchdog rule).
+    fs = _lint(tmp_path, src, name="our_tree_tpu/harness/foo.py")
+    assert "serve-lane-seam" not in _rules(fs)
+
+
+def test_fault_points_rule_covers_lane_helpers(tmp_path):
+    """check_lane/scoped literals are validated against KNOWN_POINTS
+    like every other fault-method literal — and the registered lane
+    points pass."""
+    fs = _lint(tmp_path, """
+        from our_tree_tpu.resilience import faults
+
+        def bad(i):
+            faults.check_lane("lane_fial", i)  # typo'd point never fires
+
+        def good(i):
+            faults.check_lane("lane_fail", i)
+            faults.fire(faults.scoped("lane_hang", i))
+    """)
+    flagged = [f for f in fs if f.rule == "fault-points"]
+    assert len(flagged) == 1
+    assert "lane_fial" in flagged[0].message
+
+
 def test_fingerprints_survive_line_moves(tmp_path):
     """The baseline's matching contract: moving a violation down the
     file (new code above it) must not change its fingerprint."""

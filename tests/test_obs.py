@@ -589,6 +589,46 @@ def test_trace_rotation_caps_disk(tmp_path, monkeypatch):
     assert earliest > 0
 
 
+def test_rotated_run_reconstructs_spans_across_segments(
+        tmp_path, monkeypatch):
+    """A span whose begin landed in segment 0 and whose end landed in a
+    later segment must reconstruct as ONE closed span: export stitches
+    segments in WRITE order (plain sorted() puts ``-s1.jsonl`` before
+    the bare first segment, which used to feed ends to the parser
+    before their begins and misreport a rotated run as violation-ridden
+    — the quarantine-event-survives-rotation contract of the serve
+    lane-kill CI drive rests on this)."""
+    monkeypatch.setenv("OT_TRACE_DIR", str(tmp_path / "tr"))
+    monkeypatch.setenv("OT_TRACE_RUN", "t-seg")
+    monkeypatch.setenv("OT_TRACE_MAX_MB", "0.02")  # ~5 KiB segments
+    trace.reset_for_tests()
+    try:
+        cm = trace.detached_span("long-lived", tag="spans-the-rotation")
+        cm.__enter__()
+        trace.point("quarantine", unit="lane:3", reason="rehearsal")
+        for i in range(40):  # push past one segment threshold, not four
+            trace.point("filler", i=i, pad="x" * 100)
+        cm.__exit__(None, None, None)
+    finally:
+        run_dir = tmp_path / "tr" / "t-seg"
+        files = sorted(run_dir.glob("trace-*.jsonl"))
+        trace.reset_for_tests()
+    assert len(files) >= 2  # it rotated
+    # Plain lexicographic order is WRONG order for these files — the
+    # regression this test pins: -s1 sorts before the bare segment.
+    assert [f.name for f in files] != \
+        [f.name for f in sorted(files, key=export._segment_order)]
+    # And load_run still reconstructs: no violations, the cross-segment
+    # span is closed, and the quarantine point survives.
+    run = export.load_run(str(run_dir))
+    assert not run.violations
+    assert not run.orphans()
+    long = [s for s in run.spans.values() if s.name == "long-lived"]
+    assert len(long) == 1 and long[0].end_ts is not None
+    assert [p["attrs"]["unit"] for p in run.points("quarantine")] \
+        == ["lane:3"]
+
+
 def test_trace_rotation_survives_failed_segment_open(tmp_path, monkeypatch):
     """ENOSPC mid-soak (a failed new-segment open) must leave the
     CURRENT handle live — events keep flowing to the full segment and

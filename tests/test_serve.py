@@ -3,10 +3,19 @@
 Covers the bucket ladder geometry, host/traced counter parity, the
 scattered-CTR models seam, keycache LRU + tenant isolation, queue
 admission/shed/deadline semantics, end-to-end bit-exactness against the
-byte-exact models API, the ZERO-RECOMPILE contract after warmup, the
-fault matrix at the serve seam (dispatch_fail retried / exhausted,
-serve_dispatch, dispatch_hang under the watchdog with the orphaned
-batch span gating obs.report), and the bench CLI artifact.
+byte-exact models API, the ZERO-RECOMPILE contract after warmup (per
+lane x rung), the fault matrix at the lane seam (dispatch_fail retried
+on-lane / exhausted, serve_dispatch, dispatch_hang under the watchdog
+with the orphaned lane span gating obs.report, lane-scoped
+lane_fail/lane_hang), the lane pool itself (health state machine,
+bit-exact failover against the NIST CTR KAT, canary release, journal
+quarantine persistence + --unquarantine, drain-on-shutdown), and the
+bench CLI artifact.
+
+conftest forces 8 virtual CPU devices, so a default-config Server here
+builds EIGHT lanes — the containment tests that need a batch to die
+pass ``lanes=1`` explicitly (no failover target), and the failover
+tests use small explicit lane counts.
 """
 
 import asyncio
@@ -24,7 +33,8 @@ from our_tree_tpu.models.aes import AES
 from our_tree_tpu.obs import export, report, trace
 from our_tree_tpu.ops.keyschedule import expand_key_enc
 from our_tree_tpu.resilience import degrade, faults
-from our_tree_tpu.serve import batcher, keycache, loadgen
+from our_tree_tpu.resilience import journal as journal_mod
+from our_tree_tpu.serve import batcher, keycache, lanes, loadgen
 from our_tree_tpu.serve import bench as serve_bench
 from our_tree_tpu.serve import queue as otq
 from our_tree_tpu.serve.server import Server, ServerConfig, compile_count
@@ -34,6 +44,23 @@ ROOT = pathlib.Path(__file__).resolve().parent.parent
 
 #: Small ladder for fast tests: 4 rungs, ceiling 256 blocks (4 KiB).
 LADDER = dict(min_bucket_blocks=32, max_bucket_blocks=256)
+#: Single-lane server: no failover target — the containment rehearsals.
+LANE1 = dict(lanes=1, **LADDER)
+
+#: NIST SP800-38A F.5.1 CTR-AES128 (the same KAT test_modes pins): the
+#: failover test's bit-exactness oracle.
+NIST_KEY = bytes.fromhex("2b7e151628aed2a6abf7158809cf4f3c")
+NIST_CTR0 = bytes.fromhex("f0f1f2f3f4f5f6f7f8f9fafbfcfdfeff")
+NIST_PT = bytes.fromhex(
+    "6bc1bee22e409f96e93d7e117393172a"
+    "ae2d8a571e03ac9c9eb76fac45af8e51"
+    "30c81c46a35ce411e5fbc1191a0a52ef"
+    "f69f2445df4f9b17ad2b417be66c3710")
+NIST_CT = bytes.fromhex(
+    "874d6191b620e3261bef6864990db6ce"
+    "9806f66b7970fdff8617187bb9fffdff"
+    "5ae4df3edbd5d35e5b4f09020db03eab"
+    "1e031dda2fbe03d1792170a0f3009cee")
 
 
 @pytest.fixture(autouse=True)
@@ -327,8 +354,10 @@ def test_dispatch_fail_absorbed_by_retry(monkeypatch):
         return await asyncio.gather(*_submit_n(server, 4))
 
     server, resps = _run_server(ServerConfig(retries=2, **LADDER), drive)
-    assert all(r.ok for r in resps)  # one failed attempt, retried
+    assert all(r.ok for r in resps)  # one failed attempt, retried ON-lane
     assert server.batches_failed == 0
+    # Absorbed by the lane's RetryPolicy, not by failover: no redispatch.
+    assert server.pool.redispatches == 0
 
 
 @pytest.mark.parametrize("point", ["dispatch_fail", "serve_dispatch"])
@@ -345,10 +374,16 @@ def test_dispatch_fault_exhausted_fails_batch_server_survives(
         return first, later
 
     server, (first, later) = _run_server(
-        ServerConfig(retries=1, **LADDER), drive)
+        ServerConfig(retries=1, **LANE1), drive)
     assert all(r.error == otq.ERR_DISPATCH for r in first)
     assert all(r.ok for r in later)
     assert server.batches_failed == 1
+    # One failure leaves the only lane SUSPECT (still placeable: the
+    # later batch served on it and recovered it to healthy).
+    lane = server.pool.lanes[0]
+    assert lane.state == lanes.HEALTHY
+    assert [t["to"] for t in lane.transitions] == [
+        lanes.SUSPECT, lanes.HEALTHY]
 
 
 def test_unexpected_batch_exception_contained(monkeypatch):
@@ -380,11 +415,13 @@ def test_unexpected_batch_exception_contained(monkeypatch):
 
 def test_dispatch_hang_deadline_orphan_and_report_gate(
         monkeypatch, traced):
-    """The PR acceptance: a hung batch is killed by the watchdog at the
-    dispatch deadline, its requests fail with deadline errors, the
-    server keeps serving, and the trace's ONLY orphan is the abandoned
-    batch-dispatched span — which obs.report --check accepts exactly
-    when --expected-orphans licenses it."""
+    """The single-lane hang rehearsal: a hung batch is killed by the
+    watchdog at the dispatch deadline, its requests fail with deadline
+    errors, the ONLY lane is quarantined, the next batch's last-resort
+    canary probe releases it (self-healing — the server keeps serving),
+    and the trace's ONLY orphan is the abandoned lane-dispatch span —
+    which obs.report --check accepts exactly when --expected-orphans
+    licenses it."""
     monkeypatch.setenv("OT_FAULTS", "dispatch_hang:1")
     monkeypatch.setenv("OT_HANG_S", "30")
     faults.reset()
@@ -395,22 +432,31 @@ def test_dispatch_hang_deadline_orphan_and_report_gate(
         return first, later
 
     server, (first, later) = _run_server(
-        ServerConfig(retries=1, dispatch_deadline_s=1.0, **LADDER), drive)
+        ServerConfig(retries=1, dispatch_deadline_s=1.0, **LANE1), drive)
     assert all(r.error == otq.ERR_DEADLINE for r in first)
     assert all(r.ok for r in later)
     assert server.batches_timed_out == 1
     assert "dispatch-timeout" in degrade.events()
+    assert "quarantined:lane:0" in degrade.events()
+    # The hang quarantined the lane; the rescue canary released it into
+    # probation and the later batches walked it back to healthy.
+    lane = server.pool.lanes[0]
+    assert [t["to"] for t in lane.transitions][:2] == [
+        lanes.QUARANTINED, lanes.PROBATION]
+    assert lane.canaries >= 1
 
     run = export.load_run(str(traced))
     orphans = run.orphans()
-    assert [s.name for s in orphans] == ["batch-dispatched"]
+    assert [s.name for s in orphans] == ["lane-dispatch"]
     assert not run.violations
     assert report.main([str(traced), "--check"]) == 2
     assert report.main([str(traced), "--check",
-                        "--expected-orphans", "batch-dispatched"]) == 0
+                        "--expected-orphans", "lane-dispatch"]) == 0
     buf = io.StringIO()
-    report.render(run, expected_orphans={"batch-dispatched": 1}, out=buf)
+    report.render(run, expected_orphans={"lane-dispatch": 1}, out=buf)
     assert "closed by kill (expected)" in buf.getvalue()
+    # The per-lane table renders the kill and the canary dispatches.
+    assert "per-lane device time (serve):" in buf.getvalue()
 
 
 def test_server_traced_healthy_run_closes_every_span(traced):
@@ -421,13 +467,354 @@ def test_server_traced_healthy_run_closes_every_span(traced):
     run = export.load_run(str(traced))
     assert not run.violations and not run.orphans()
     names = {s.name for s in run.spans.values()}
-    assert {"serve-warmup", "request-queued", "batch-formed",
-            "batch-dispatched"} <= names
-    # Dispatch spans carry the engine attr for the report's per-engine
-    # device-time table.
-    eng = {s.attrs.get("engine") for s in run.spans.values()
-           if s.name == "batch-dispatched"}
-    assert eng == {"jnp"}
+    assert {"serve-warmup", "lane-warmup", "request-queued",
+            "batch-formed", "lane-dispatch"} <= names
+    # Dispatch spans carry the engine AND lane attrs for the report's
+    # per-engine / per-lane device-time tables.
+    disp = [s for s in run.spans.values() if s.name == "lane-dispatch"]
+    assert {s.attrs.get("engine") for s in disp} == {"jnp"}
+    assert all(s.attrs.get("lane") is not None for s in disp)
+
+
+# ---------------------------------------------------------------------------
+# Lanes: fault grammar, health machine, failover, drain, journal.
+# ---------------------------------------------------------------------------
+
+
+def test_lane_fault_grammar(monkeypatch):
+    """``@lane=<i>`` scopes a point to one lane's registry key; counted
+    and bare forms both work; the plain point stays independent."""
+    monkeypatch.setenv("OT_FAULTS",
+                       "lane_fail:2@lane=3,lane_hang@lane=1,lane_fail:1")
+    faults.reset()
+    assert faults.remaining(faults.scoped("lane_fail", 3)) == 2
+    assert faults.remaining(faults.scoped("lane_hang", 1)) == faults.ALWAYS
+    assert faults.remaining("lane_fail") == 1
+    assert faults.remaining(faults.scoped("lane_fail", 0)) == 0
+    # check_lane consumes the scoped shot first, then the plain pool.
+    with pytest.raises(faults.InjectedFault):
+        faults.check_lane("lane_fail", 3)
+    assert faults.remaining(faults.scoped("lane_fail", 3)) == 1
+    assert faults.remaining("lane_fail") == 1  # untouched: scoped fired
+    with pytest.raises(faults.InjectedFault):
+        faults.check_lane("lane_fail", 0)  # no scoped shot: plain pool
+    assert faults.remaining("lane_fail") == 0
+    # Malformed lane qualifiers are ignored, not armed.
+    monkeypatch.setenv("OT_FAULTS", "lane_fail:1@lane=x,lane_hang@lane=")
+    faults.reset()
+    assert not faults.armed()
+
+
+def test_lane_health_state_machine(monkeypatch):
+    """The full cycle on one lane: healthy -> suspect -> quarantined ->
+    (canary) probation -> released -> healthy, with the transition log,
+    the degrade stamp, and the quarantine-release point; a probation
+    failure goes straight back to quarantined; a timeout quarantines
+    from healthy directly."""
+    pool = lanes.LanePool(engine="jnp", lanes=2, probation_batches=2)
+    lane = pool.lanes[0]
+    lane.warmed = True
+
+    lane.note_failure(RuntimeError("x"), None)
+    assert lane.state == lanes.SUSPECT
+    lane.note_failure(RuntimeError("y"), None)
+    assert lane.state == lanes.QUARANTINED
+    assert "quarantined:lane:0" in degrade.events()
+    assert pool.place() is None or pool.place().idx == 1  # lane 0 unplaceable
+
+    # Canary release: pin a trivial canary and make the lane return it.
+    expected = np.arange(8, dtype=np.uint32)
+    pool.set_canary(expected, expected, expected, 10, expected, 32)
+    monkeypatch.setattr(lanes.Lane, "engine_call",
+                        lambda self, *a, **k: np.arange(8, dtype=np.uint32))
+    assert pool.probe_lane(lane)
+    assert lane.state == lanes.PROBATION and lane.probation_left == 2
+    lane.note_success(32, redispatch=False, probation_batches=2)
+    assert lane.state == lanes.PROBATION
+    lane.note_success(32, redispatch=False, probation_batches=2)
+    assert lane.state == lanes.HEALTHY
+    seq = [t["to"] for t in lane.transitions]
+    assert seq == [lanes.SUSPECT, lanes.QUARANTINED, lanes.PROBATION,
+                   lanes.RELEASED, lanes.HEALTHY]
+
+    # Probation gives no second chance; a timeout skips suspect entirely.
+    lane2 = pool.lanes[1]
+    lane2.warmed = True
+    assert pool.probe_lane(lane2) is False  # only quarantined lanes probe
+    assert lane2.state == lanes.HEALTHY
+    lane2.note_timeout(TimeoutError("hang"), None)
+    assert lane2.state == lanes.QUARANTINED
+    assert pool.probe_lane(lane2)
+    lane2.note_failure(RuntimeError("again"), None)
+    assert lane2.state == lanes.QUARANTINED
+    assert pool.quarantine_events() == 3  # lane0 once, lane2 twice
+
+
+def test_lane_canary_mismatch_keeps_quarantine(monkeypatch):
+    pool = lanes.LanePool(engine="jnp", lanes=1)
+    lane = pool.lanes[0]
+    lane.warmed = True
+    lane.note_timeout(TimeoutError("hang"), None)
+    expected = np.arange(8, dtype=np.uint32)
+    pool.set_canary(expected, expected, expected, 10, expected, 32)
+    monkeypatch.setattr(lanes.Lane, "engine_call",
+                        lambda self, *a, **k: np.zeros(8, dtype=np.uint32))
+    assert not pool.probe_lane(lane)  # wrong bytes: stays quarantined
+    assert lane.state == lanes.QUARANTINED
+
+
+def test_lane_hang_failover_bit_exact_nist_kat(monkeypatch, traced):
+    """The ISSUE acceptance scenario, in-process: kill lane 0 mid-batch
+    with ``lane_hang:1@lane=0`` and assert every request in that batch
+    is still answered — bit-exact against the NIST SP800-38A CTR KAT —
+    via re-dispatch on the healthy lane, with ZERO request errors, zero
+    lost requests, exactly one lane quarantined, and the hung dispatch's
+    abandoned span as the trace's only orphan."""
+    monkeypatch.setenv("OT_FAULTS", "lane_hang:1@lane=0")
+    monkeypatch.setenv("OT_HANG_S", "30")
+    faults.reset()
+
+    async def drive(server):
+        # The KAT rides the very first batch — the one lane 0 hangs on.
+        kat = server.submit("kat", NIST_KEY, NIST_CTR0,
+                            np.frombuffer(NIST_PT, np.uint8))
+        first = await asyncio.gather(kat, *_submit_n(server, 2))
+        later = await asyncio.gather(*_submit_n(server, 4, seed=6))
+        return first, later
+
+    server, (first, later) = _run_server(
+        ServerConfig(retries=1, dispatch_deadline_s=1.0, lanes=2,
+                     **LADDER), drive)
+    assert all(r.ok for r in first + later)  # ZERO request errors
+    assert np.array_equal(np.asarray(first[0].payload),
+                          np.frombuffer(NIST_CT, np.uint8))
+    q = server.queue.stats()
+    assert q["lost"] == 0 and q["answered"] == q["accepted"]
+    assert server.pool.redispatches >= 1
+    assert server.pool.quarantine_events() == 1
+    assert server.pool.lanes[0].timeouts == 1
+    assert "quarantined:lane:0" in degrade.events()
+    assert server.batches_timed_out == 0  # failover, not batch death
+    assert server.steady_compiles() == 0  # replay hit lane 1's warm cache
+
+    run = export.load_run(str(traced))
+    assert [s.name for s in run.orphans()] == ["lane-dispatch"]
+    assert report.main([str(traced), "--check",
+                        "--expected-orphans", "lane-dispatch"]) == 0
+    # The redispatched batch's span says so.
+    redisp = [s for s in run.spans.values()
+              if s.name == "lane-dispatch" and s.attrs.get("redispatch")]
+    assert len(redisp) == 1 and redisp[0].attrs["lane"] == 1
+
+
+def test_lane_fail_scoped_targets_one_lane(monkeypatch):
+    """``lane_fail:1@lane=0`` degrades lane 0 to suspect and fails the
+    batch over; the other lane and later traffic (including lane 0's
+    recovery) never see an error."""
+    monkeypatch.setenv("OT_FAULTS", "lane_fail:1@lane=0")
+    faults.reset()
+
+    async def drive(server):
+        first = await asyncio.gather(*_submit_n(server, 3))
+        later = await asyncio.gather(*_submit_n(server, 3, seed=6))
+        return first, later
+
+    server, (first, later) = _run_server(
+        ServerConfig(retries=1, lanes=2, **LADDER), drive)
+    assert all(r.ok for r in first + later)
+    assert server.pool.redispatches == 1
+    lane0 = server.pool.lanes[0]
+    assert [t["to"] for t in lane0.transitions] == [
+        lanes.SUSPECT, lanes.HEALTHY]  # failed over, then recovered
+    assert server.pool.quarantine_events() == 0
+
+
+def test_drain_on_shutdown_answers_everything(traced):
+    """stop() drains: every request accepted before stop is served
+    (payload, not a shutdown error), submits after stop are refused
+    immediately, nothing is lost, and a clean drain leaves no orphaned
+    span."""
+
+    async def main():
+        server = Server(ServerConfig(**LANE1))
+        await server.start()
+        rng = np.random.default_rng(9)
+        key = rng.integers(0, 256, 16, dtype=np.uint8).tobytes()
+        tasks = [asyncio.ensure_future(server.submit(
+            "t0", key, rng.integers(0, 256, 16, dtype=np.uint8).tobytes(),
+            rng.integers(0, 256, 256, dtype=np.uint8))) for _ in range(6)]
+        await asyncio.sleep(0)  # let the submits enqueue
+        await server.stop()
+        resps = await asyncio.gather(*tasks)
+        after = await server.submit(
+            "t0", key, b"n" * 16, np.zeros(16, np.uint8))
+        return server, resps, after
+
+    server, resps, after = asyncio.run(main())
+    assert all(r.ok for r in resps)  # drained, not dropped
+    assert after.error == otq.ERR_SHUTDOWN
+    q = server.queue.stats()
+    assert q["lost"] == 0 and q["answered"] == q["accepted"] == 6
+    run = export.load_run(str(traced))
+    assert not run.orphans() and not run.violations
+    assert run.points("serve-drained")
+
+
+def test_lane_journal_quarantine_persists_and_releases(
+        monkeypatch, tmp_path):
+    """Lane quarantine rides the SAME journal records as sweep units: a
+    hang in run 1 leaves a failure row; run 2 starts the lane
+    quarantined from that row (no canary release: probe cadence never
+    reached); ``serve.bench --unquarantine lane:0`` clears it the same
+    way ``harness.bench --unquarantine`` clears a sweep unit; run 3
+    starts healthy."""
+    jpath = str(tmp_path / "serve_journal.jsonl")
+    cfg = dict(retries=1, dispatch_deadline_s=1.0, lanes=2,
+               probe_every=10_000, journal=jpath, **LADDER)
+
+    monkeypatch.setenv("OT_FAULTS", "lane_hang:1@lane=0")
+    monkeypatch.setenv("OT_HANG_S", "30")
+    faults.reset()
+
+    async def drive(server):
+        return await asyncio.gather(*_submit_n(server, 3))
+
+    server, resps = _run_server(ServerConfig(**cfg), drive)
+    assert all(r.ok for r in resps)
+    assert server.pool.lanes[0].state == lanes.QUARANTINED
+    recs = [json.loads(l) for l in open(jpath)][1:]
+    assert recs == [{"unit": "lane:0", "failed": True,
+                     "reason": "dispatch-timeout"}]
+
+    # Run 2 (no faults): the journal row quarantines lane 0 at start.
+    monkeypatch.delenv("OT_FAULTS")
+    faults.reset()
+    degrade.clear()
+    server2, resps2 = _run_server(ServerConfig(**cfg), drive)
+    assert all(r.ok for r in resps2)
+    lane0 = server2.pool.lanes[0]
+    assert lane0.transitions[0]["to"] == lanes.QUARANTINED
+    assert lane0.transitions[0]["why"] == "journal:1"
+    assert lane0.dispatches == 0  # all traffic went to lane 1
+    assert "quarantined:lane:0" in degrade.events()
+
+    # The serve-side release edit (the harness --unquarantine twin).
+    assert serve_bench.main(["--journal", jpath,
+                             "--unquarantine", "lane:0"]) == 0
+    j = journal_mod.SweepJournal(jpath, {"kind": "serve-lanes",
+                                         "lanes": 2, "engine": "auto"})
+    assert j.fail_count("lane:0") == 0
+    j.close()
+
+    degrade.clear()
+    server3, resps3 = _run_server(ServerConfig(**cfg), drive)
+    assert all(r.ok for r in resps3)
+    assert server3.pool.lanes[0].state == lanes.HEALTHY
+    assert not server3.pool.lanes[0].transitions
+
+
+def test_start_fails_loudly_when_no_lane_warms(monkeypatch):
+    """Per-lane warmup containment must not mask a TOTAL boot failure:
+    with every lane unable to prime, start() raises instead of
+    answering dispatch-failed forever."""
+    def broken(self, *a, **k):
+        raise RuntimeError("engine cannot prime")
+
+    monkeypatch.setattr(lanes.Lane, "engine_call", broken)
+
+    async def main():
+        await Server(ServerConfig(lanes=2, **LADDER)).start()
+
+    with pytest.raises(RuntimeError, match="all 2 lane"):
+        asyncio.run(main())
+    assert "quarantined:lane:0" in degrade.events()
+    assert "quarantined:lane:1" in degrade.events()
+
+
+def test_lane_hang_scoped_shot_short_circuits_plain_pool(monkeypatch):
+    """One dispatch consumes at most ONE lane_hang shot (the check_lane
+    contract at the injected-hang seam): a firing scoped shot must not
+    also drain the plain pool meant for another lane."""
+    monkeypatch.setenv("OT_FAULTS", "lane_hang:1@lane=0,lane_hang:1")
+    monkeypatch.setenv("OT_HANG_S", "0")  # fire without wall time
+    faults.reset()
+    lane = lanes.LanePool(engine="jnp", lanes=1).lanes[0]
+    nr, rk = expand_key_enc(b"\x00" * 16)
+    words = np.zeros(4 * 32, dtype=np.uint32)
+    lane.engine_call(words, words, np.asarray(rk, np.uint32), nr, "t")
+    assert faults.remaining(faults.scoped("lane_hang", 0)) == 0
+    assert faults.remaining("lane_hang") == 1  # plain pool untouched
+    # The next dispatch draws from the plain pool.
+    lane.engine_call(words, words, np.asarray(rk, np.uint32), nr, "t2")
+    assert faults.remaining("lane_hang") == 0
+
+
+def test_unquarantine_zero_cleared_emits_no_release_point(
+        tmp_path, traced, capsys):
+    """Releasing a lane that was never quarantined clears nothing and
+    must NOT write a quarantine-release trace point — audits that
+    reconstruct releases would see a phantom event."""
+    jpath = str(tmp_path / "j.jsonl")
+    j = journal_mod.SweepJournal(jpath, {"kind": "serve-lanes"})
+    j.close()
+    assert serve_bench.main(["--journal", jpath,
+                             "--unquarantine", "lane:5"]) == 0
+    assert "cleared 0 failure row(s) (none recorded)" \
+        in capsys.readouterr().out
+    run = export.load_run(str(traced))
+    assert not run.points("quarantine-release")
+
+
+def test_journal_quarantined_lane_never_pins_the_canary(
+        monkeypatch, tmp_path):
+    """A lane that starts quarantined from the journal — possibly for
+    producing wrong bytes — must not become the cross-lane canary
+    oracle: trusted lanes warm first, so a CORRUPT quarantined lane
+    fails its own warmup comparison, stays unwarmed, and can never be
+    canary-released against its own output."""
+    jpath = str(tmp_path / "j.jsonl")
+    j = journal_mod.SweepJournal(jpath, {"kind": "serve-lanes",
+                                         "lanes": 2, "engine": "auto"})
+    j.record_failure("lane:0", "warmup-mismatch")
+    j.close()
+
+    real = lanes.Lane.engine_call
+
+    def corrupt_on_lane0(self, words, ctr_words, rk, nr, label,
+                         warmup=False):
+        out = real(self, words, ctr_words, rk, nr, label, warmup=warmup)
+        return out ^ np.uint32(1) if self.idx == 0 else out
+
+    monkeypatch.setattr(lanes.Lane, "engine_call", corrupt_on_lane0)
+
+    async def drive(server):
+        return await asyncio.gather(*_submit_n(server, 3))
+
+    server, resps = _run_server(
+        ServerConfig(lanes=2, journal=jpath, **LADDER), drive)
+    assert all(r.ok for r in resps)  # lane 1 pinned the canary and serves
+    lane0, lane1 = server.pool.lanes
+    assert lane1.warmed and lane1.state == lanes.HEALTHY
+    assert lane0.state == lanes.QUARANTINED
+    assert not lane0.warmed  # mismatched its warmup: never probeable
+
+
+def test_batches_place_across_lanes_when_healthy():
+    """Least-loaded placement spreads distinct-key batches across lanes
+    (the ISSUE's healthy-run acceptance: >= 2 lanes used, zero
+    post-warmup recompiles)."""
+
+    async def drive(server):
+        resps = []
+        for seed in (5, 6, 7, 8):
+            resps += await asyncio.gather(
+                *_submit_n(server, 2, seed=seed, tenant=f"t{seed}"))
+        return resps
+
+    server, resps = _run_server(ServerConfig(lanes=4, **LADDER), drive)
+    assert all(r.ok for r in resps)
+    assert server.pool.stats()["placed_across"] >= 2
+    assert server.steady_compiles() == 0
 
 
 # ---------------------------------------------------------------------------
@@ -447,18 +834,30 @@ def test_bench_cli_writes_artifact_and_asserts(tmp_path, capsys):
     art = tmp_path / "serve.json"
     rc = serve_bench.main([
         "--requests", "40", "--concurrency", "6", "--mixed-sizes",
-        "--bucket-max", "4096", "--seed", "1",
+        "--bucket-max", "4096", "--seed", "1", "--lanes", "2",
         "--artifact", str(art)])
     assert rc == 0
     line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert line["unit"] == "serve" and line["requests"] == 40
     assert line["ok"] == 40 and line["recompiles"] == 0
+    assert line["lost"] == 0 and line["quarantines"] == 0
+    assert line["lanes"] == 2 and line["lanes_used"] >= 1
     assert line["p50_ms"] > 0 and line["p99_ms"] >= line["p50_ms"]
     doc = json.loads(art.read_text())
     assert doc["compiles"]["steady"] == 0
     assert doc["load"]["mismatches"] == 0 and doc["load"]["verified"] > 0
     assert doc["occupancy"]  # the histogram exists per bucket
     assert doc["keycache"]["hits"] > 0
+    assert doc["queue"]["lost"] == 0
+    # The per-lane schema: dispatch counts, goodput, transition log.
+    assert doc["lanes"]["count"] == 2
+    assert len(doc["lanes"]["per_lane"]) == 2
+    row = doc["lanes"]["per_lane"][0]
+    assert {"lane", "device", "state", "dispatches", "blocks", "bytes",
+            "goodput_gbps", "failures", "timeouts", "canaries",
+            "transitions"} <= set(row)
+    assert sum(r["dispatches"] for r in doc["lanes"]["per_lane"]) \
+        == doc["batches"]["batches"]
 
 
 def test_bench_next_artifact_indexing(tmp_path):
