@@ -359,8 +359,15 @@ def _check_fault_points(ctx: FileContext):
 #: outside it has no watchdog deadline of its own lane, no health
 #: accounting, no failover — a fault there degrades the SERVICE, not a
 #: lane, which is exactly the failure mode lanes exist to contain.
-_SERVE_DISPATCH_TAILS = ("ctr_crypt_words_scattered", "block_until_ready",
-                         "device_put")
+#: ``ctr_crypt_words_scattered_multikey`` is the multi-key twin (K
+#: stacked schedules, one call) and ``ctr_scattered_words`` the native
+#: host-tier dispatch behind it — the host tier has no device but it IS
+#: a dispatch (watchdog, health, failover all still apply), so it may
+#: not bypass the seam either.
+_SERVE_DISPATCH_TAILS = ("ctr_crypt_words_scattered",
+                         "ctr_crypt_words_scattered_multikey",
+                         "ctr_scattered_words", "ctr_requests_words",
+                         "block_until_ready", "device_put")
 
 
 def _check_serve_lane(ctx: FileContext):
@@ -408,9 +415,10 @@ RULES: tuple[Rule, ...] = (
          "KNOWN_POINTS.",
          _check_fault_points),
     Rule("serve-lane-seam", "error",
-         "Device dispatch in serve/ (scattered-CTR calls, "
-         "block_until_ready, device_put) only inside serve/lanes.py — "
-         "the lane seam owns deadlines, health, and failover.",
+         "Dispatch in serve/ (scattered-CTR calls incl. the multi-key "
+         "seam, the native host tier, block_until_ready, device_put) "
+         "only inside serve/lanes.py — the lane seam owns deadlines, "
+         "health, and failover.",
          _check_serve_lane),
 )
 

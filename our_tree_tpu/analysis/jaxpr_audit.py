@@ -307,6 +307,12 @@ def _entries(engines):
     def iv(_n):
         return np.zeros(4, np.uint32)
 
+    def rk_stack(_n):  # the fixed-K stacked schedules (serve key slots)
+        return np.zeros((4, RK_WORDS), np.uint32)
+
+    def slots(n):  # per-block key-slot indices — PUBLIC batch layout
+        return np.zeros(n, np.uint32)
+
     out = []
     for eng in engines:
         out += [
@@ -329,6 +335,18 @@ def _entries(engines):
              lambda ww, cc, kk, e=eng: aes.ctr_crypt_words_scattered(
                  ww, cc, kk, NR, e),
              (w, w, rk), {0, 2}),  # counters derive from public nonces
+            # The MULTI-KEY serve seam: K stacked schedules + a
+            # per-block key-slot vector (serve/batcher.py's rung-packer
+            # dispatch shape). The slot vector is PUBLIC — batch layout,
+            # never key/payload bytes — so the schedule gather it feeds
+            # (rks[key_slots] / the masked-select reconstruction) must
+            # audit untainted: a constant-time finding HERE would mean
+            # key-dependent addressing leaked into the shared dispatch.
+            (f"aes-ctr-scattered-multikey[{eng}]",
+             lambda ww, cc, ks, sl, e=eng:
+                 aes.ctr_crypt_words_scattered_multikey(ww, cc, ks, sl,
+                                                        NR, e),
+             (w, w, rk_stack, slots), {0, 2}),  # slot vector public
             (f"aes-cbc-dec[{eng}]",
              lambda ww, vv, kk, e=eng: aes.cbc_decrypt_words(ww, vv, kk,
                                                              NR, e),

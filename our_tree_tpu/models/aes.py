@@ -69,14 +69,32 @@ CTR_FUSED: dict[str, object] = {}
 #: named "pallas-…" without being kernel-backed).
 PALLAS_BACKED: set[str] = set()
 
+#: Multi-key scattered-CTR cores: (words2, ctr2, rks, key_slots, nr) ->
+#: words2, where rks is a (K, 4*(nr+1)) stack of expanded schedules and
+#: key_slots a (N,) PUBLIC per-block slot-index vector — one device call
+#: carrying K tenants' keys (the serve rung-packer's dispatch shape).
+#: Engines without an entry fall back to the generic bitsliced
+#: per-block-key-planes circuit inside the jit (still one call, still
+#: shape-closed); see ctr_crypt_words_scattered_multikey.
+MULTIKEY_CTR: dict[str, object] = {}
+
+#: The host-tier engine name: the native C runtime (AESNI where the CPU
+#: has it) dispatched directly from the serve seam — no jit, no XLA, no
+#: compile cache. Deliberately NOT in CORES: it has no traced core, so
+#: the mode dispatchers and the jaxpr auditor never see it; only the
+#: scattered-CTR serve entry points accept it (resolve_serve_engine).
+NATIVE_ENGINE = "native"
+
 
 def register_core(name: str, encrypt_fn, decrypt_fn, ctr_fused_fn=None,
-                  pallas_backed: bool = False) -> None:
+                  pallas_backed: bool = False, multikey_fn=None) -> None:
     CORES[name] = (encrypt_fn, decrypt_fn)
     if ctr_fused_fn is not None:
         CTR_FUSED[name] = ctr_fused_fn
     if pallas_backed:
         PALLAS_BACKED.add(name)
+    if multikey_fn is not None:
+        MULTIKEY_CTR[name] = multikey_fn
 
 
 #: engine -> whether its encrypt core compiled+ran on this process's device
@@ -292,6 +310,58 @@ def resolve_engine(name: str | None = "auto") -> str:
     return name
 
 
+_NATIVE_OK: bool | None = None
+
+
+def native_runtime_available() -> bool:
+    """Can the native C runtime load (building it on first use)? Memoized:
+    a failed build is reported once and the resolver falls back."""
+    global _NATIVE_OK
+    if _NATIVE_OK is None:
+        try:
+            from ..runtime import native as _native
+
+            _native.load()
+            _NATIVE_OK = True
+        except Exception as e:  # noqa: BLE001 - the probe IS the question
+            import sys
+
+            print(f"# native runtime unavailable "
+                  f"({type(e).__name__}: {str(e)[:160]})", file=sys.stderr)
+            _NATIVE_OK = False
+    return _NATIVE_OK
+
+
+def resolve_serve_engine(name: str | None = "auto") -> str:
+    """Engine resolution for the SERVE dispatch path (the scattered-CTR
+    seam): the ranked-engine ladder plus the host tier.
+
+    On an accelerator, "auto" is exactly ``resolve_engine`` — the
+    persisted hardware ranking, pallas-dense-bp on a measured TPU, with
+    the compile-probe demotion chain. On CPU, "auto" prefers the native
+    C runtime (``NATIVE_ENGINE``): hardware AES-NI through one ctypes
+    call per batch beats the XLA T-table oracle by orders of magnitude,
+    and serving is the one path where that gap is the headline number
+    (SERVE_r01 vs BENCH_r05, docs/PERF.md). A native build failure
+    demotes to "jnp" through the shared degrade chokepoint. An explicit
+    ``"native"`` raises loudly when the runtime cannot load — an
+    operator who pinned the tier should not silently serve on the
+    oracle engine.
+    """
+    if name == NATIVE_ENGINE:
+        if not native_runtime_available():
+            raise RuntimeError(
+                "engine 'native' requested but the native C runtime "
+                "failed to load/build (see stderr for the build error)")
+        return NATIVE_ENGINE
+    if name in (None, "auto") and jax.default_backend() == "cpu":
+        if native_runtime_available():
+            return NATIVE_ENGINE
+        _note_engine_demotion([NATIVE_ENGINE], "jnp")
+        return "jnp"
+    return resolve_engine(name)
+
+
 # ---------------------------------------------------------------------------
 # Jitted functional cores (word-level). Shapes: words (N, 4) uint32.
 # ---------------------------------------------------------------------------
@@ -456,9 +526,118 @@ def ctr_crypt_words_scattered(words, ctr_le_words, rk, nr, engine="jnp"):
     ``utils.packing.np_ctr_le_blocks`` (host) or ``ctr_le_blocks``
     (traced); padding blocks may carry any counter value (their output is
     discarded by construction).
+
+    ``engine="native"`` dispatches the whole call on the host tier
+    instead: one threaded ECB over the counter bytes through the native
+    C runtime (AESNI where the CPU has it) plus a vectorised XOR — no
+    jit, no compile cache, numpy in and numpy out. That is the serve
+    path's CPU fallback rung in the engine ladder
+    (``resolve_serve_engine``; docs/SERVING.md has the tier table).
     """
+    if engine == NATIVE_ENGINE:
+        from ..runtime import native as _native
+
+        w = np.asarray(words)
+        ctx = _native.aes_ctx_from_schedule(
+            int(nr), np.asarray(rk, dtype=np.uint32))
+        out = _native.ctr_scattered_words(
+            [ctx], w.reshape(-1),
+            np.asarray(ctr_le_words, dtype=np.uint32).reshape(-1))
+        return out.reshape(w.shape)
     return _ctr_crypt_words_scattered_jit(words, ctr_le_words, rk, nr,
                                           engine, _engine_knobs_key(engine))
+
+
+def _multikey_jnp(w2, c2, rks, key_slots, nr):
+    """T-table multi-key core: gather each block's schedule by its PUBLIC
+    slot index and vmap the oracle core over blocks. The per-round
+    T-table gathers stay the documented jnp timing-channel tradeoff
+    (baselined, like every jnp entry); the key-index gather itself is
+    public-indexed and audits clean."""
+    rkb = rks[key_slots]  # (N, 4*(nr+1)) — public gather
+    ks = jax.vmap(lambda c, r: block.encrypt_words(c, r, nr))(c2, rkb)
+    return w2 ^ ks
+
+
+def _multikey_bitslice(w2, c2, rks, key_slots, nr):
+    """Bitsliced multi-key core: the same public schedule gather feeding
+    genuine per-block key planes (ops/bitslice.py:multikey_planes) — the
+    round circuit is key-oblivious, so K keys cost one extra to_planes
+    pass over the gathered schedules, not a new formulation."""
+    from ..ops import bitslice as _bs
+
+    ks = _bs.encrypt_words_multikey(c2, rks[key_slots], nr)
+    return w2 ^ ks
+
+
+MULTIKEY_CTR["jnp"] = _multikey_jnp
+MULTIKEY_CTR["bitslice"] = _multikey_bitslice
+
+
+@functools.partial(jax.jit, static_argnums=(4, 5, 6))
+def _ctr_scattered_multikey_jit(words, ctr_le_words, rks, key_slots, nr,
+                                engine, knobs):
+    del knobs
+    w2 = _as_block_words(words)
+    c2 = _as_block_words(ctr_le_words)
+    fn = MULTIKEY_CTR.get(engine, _multikey_bitslice)
+    return fn(w2, c2, rks, key_slots.astype(jnp.uint32),
+              nr).reshape(words.shape)
+
+
+def ctr_crypt_words_scattered_multikey(words, ctr_le_words, rks, key_slots,
+                                       nr, engine="jnp", *,
+                                       native_ctxs=None, native_threads=0,
+                                       native_runs=None):
+    """Scattered CTR where one device call carries K independent keys.
+
+    The multi-key serve seam: ``rks`` is a (K, 4*(nr+1)) u32 stack of
+    expanded schedules (unused slots hold the all-zero schedule so the
+    batch shape is closed over K — the ladder's fixed key dimension) and
+    ``key_slots`` a (N,) u32 vector mapping each block to its slot. The
+    slot vector is PUBLIC — it derives from batch layout, never from key
+    or payload bytes — which is exactly what the
+    ``aes-ctr-scattered-multikey[*]`` audit entries pin: the schedule
+    gather it feeds must stay untainted (analysis/jaxpr_audit.py).
+
+    Engines with a dedicated multi-key core (MULTIKEY_CTR: the Pallas
+    masked-select kernel, the bitsliced per-block-plane circuit, the
+    vmapped T-table oracle) dispatch it; anything else falls back to the
+    bitsliced circuit inside the same jit. ``engine="native"`` runs the
+    host tier: per-slot threaded ECB runs over the contiguous key
+    segments plus one XOR (``runtime.native.ctr_scattered_words``);
+    ``native_ctxs`` lets a caller (the serve key cache) hand in
+    pre-built contexts so steady-state dispatch does no key setup at
+    all, and ``native_threads`` overrides the size-based thread default.
+    ``native_runs`` — the batch's request layout,
+    ``[(slot, start_block, nblocks, nonce16), ...]`` — switches the
+    host tier to the per-request C CTR fast path
+    (``runtime.native.ctr_requests_words``): counters are generated
+    inside C per request instead of being materialised as an (N, 4)
+    array, bit-exact with the array path (``ctr_le_words`` may then be
+    None). Jax engines ignore it — their seam is the traced array pair.
+    """
+    if engine == NATIVE_ENGINE:
+        from ..runtime import native as _native
+
+        w = np.asarray(words)
+        ctxs = native_ctxs
+        if ctxs is None:
+            ctxs = [_native.aes_ctx_from_schedule(
+                        int(nr), np.asarray(r, dtype=np.uint32))
+                    for r in np.asarray(rks)]
+        if native_runs is not None:
+            out = _native.ctr_requests_words(
+                ctxs, w.reshape(-1), native_runs, nthreads=native_threads)
+            return out.reshape(w.shape)
+        out = _native.ctr_scattered_words(
+            ctxs, w.reshape(-1),
+            np.asarray(ctr_le_words, dtype=np.uint32).reshape(-1),
+            np.asarray(key_slots), nthreads=native_threads)
+        return out.reshape(w.shape)
+    return _ctr_scattered_multikey_jit(words, ctr_le_words, rks, key_slots,
+                                       nr, engine,
+                                       _engine_knobs_key(engine))
 
 
 @functools.partial(jax.jit, static_argnums=(3,))
@@ -609,7 +788,11 @@ def _words_np(b: np.ndarray) -> np.ndarray:
 
 
 def _bytes_np(w) -> np.ndarray:
-    return packing.np_words_to_bytes(np.asarray(w, dtype=np.uint32).reshape(-1, 4)).reshape(-1)
+    b = packing.np_words_to_bytes(
+        np.asarray(w, dtype=np.uint32).reshape(-1, 4)).reshape(-1)
+    # jax-backed arrays view as READ-ONLY; the context API has always
+    # returned bytes the caller may mutate in place.
+    return b if b.flags.writeable else np.array(b)
 
 
 def _inc_counter_bytes(ctr: np.ndarray, k: int = 1) -> np.ndarray:
@@ -806,13 +989,20 @@ from ..ops import bitslice as _bitslice  # noqa: E402
 from ..ops import pallas_aes as _pallas_aes  # noqa: E402
 
 register_core("bitslice", _bitslice.encrypt_words, _bitslice.decrypt_words)
+# Every Pallas engine NAME gets a multi-key seam, but all of them route to
+# the DENSE multi-key kernel (with the engine's S-box formulation): the
+# masked-select key reconstruction is layout-independent and the dense
+# boundary is the one without the sublane-padding tax, so there is exactly
+# one multi-key kernel to tune/audit rather than one per boundary layout.
 register_core("pallas", _pallas_aes.encrypt_words, _pallas_aes.decrypt_words,
               ctr_fused_fn=_pallas_aes.ctr_crypt_words_gen,
-              pallas_backed=True)
+              pallas_backed=True,
+              multikey_fn=_pallas_aes.ctr_scattered_multikey_dense)
 register_core("pallas-gt", _pallas_aes.encrypt_words_gt,
               _pallas_aes.decrypt_words_gt,
               ctr_fused_fn=_pallas_aes.ctr_crypt_words_gt,
-              pallas_backed=True)
+              pallas_backed=True,
+              multikey_fn=_pallas_aes.ctr_scattered_multikey_dense)
 # Same kernel structure as pallas-gt with the Boyar–Peralta S-box circuit
 # pinned per-call (~25% less round arithmetic; decrypt shares pallas-gt's
 # tower path — there is no comparably small inverse circuit). A separate
@@ -821,7 +1011,8 @@ register_core("pallas-gt", _pallas_aes.encrypt_words_gt,
 register_core("pallas-gt-bp", _pallas_aes.encrypt_words_gt_bp,
               _pallas_aes.decrypt_words_gt,
               ctr_fused_fn=_pallas_aes.ctr_crypt_words_gt_bp,
-              pallas_backed=True)
+              pallas_backed=True,
+              multikey_fn=_pallas_aes.ctr_scattered_multikey_dense_bp)
 # The dense (128, W) boundary: pallas-gt's in-kernel ladder without the
 # grouped layout's 2x sublane-padding tax on HBM streams / VMEM tiles —
 # and without its halved buffer ceiling (the 1 GiB headline path). Its own
@@ -830,8 +1021,10 @@ register_core("pallas-gt-bp", _pallas_aes.encrypt_words_gt_bp,
 register_core("pallas-dense", _pallas_aes.encrypt_words_dense,
               _pallas_aes.decrypt_words_dense,
               ctr_fused_fn=_pallas_aes.ctr_crypt_words_dense,
-              pallas_backed=True)
+              pallas_backed=True,
+              multikey_fn=_pallas_aes.ctr_scattered_multikey_dense)
 register_core("pallas-dense-bp", _pallas_aes.encrypt_words_dense_bp,
               _pallas_aes.decrypt_words_dense,
               ctr_fused_fn=_pallas_aes.ctr_crypt_words_dense_bp,
-              pallas_backed=True)
+              pallas_backed=True,
+              multikey_fn=_pallas_aes.ctr_scattered_multikey_dense_bp)

@@ -981,6 +981,22 @@ def key_planes(rk: jnp.ndarray, nr: int) -> jnp.ndarray:
     return (bits * jnp.uint32(0xFFFFFFFF))[..., None]
 
 
+def multikey_planes(rk_blocks: jnp.ndarray, nr: int) -> jnp.ndarray:
+    """Per-BLOCK round keys -> (nr+1, 8, 16, W) genuine key bit planes.
+
+    ``rk_blocks``: (N, 4*(nr+1)) u32, row i = block i's expanded schedule
+    (N % 32 == 0). Where ``key_planes`` broadcasts ONE key as full-lane
+    masks, here every block may carry a different key, so round r's key
+    planes are real data planes: ``to_planes`` of the (N, 4) round-r words.
+    The round circuit is key-oblivious (AddRoundKey is the only key
+    contact, and XOR broadcasts identically over (16, 1) masks and
+    (16, W) planes), which is what makes the multi-key batch a pure
+    layout change rather than a new cipher formulation.
+    """
+    r = rk_blocks.astype(jnp.uint32).reshape(rk_blocks.shape[0], nr + 1, 4)
+    return jnp.stack([to_planes(r[:, i, :]) for i in range(nr + 1)])
+
+
 # ---------------------------------------------------------------------------
 # Rounds. Shared by the XLA path (scan over rounds) and the Pallas kernel
 # (unrolled/fori inside the tile body) — see ops/pallas_aes.py.
@@ -1076,4 +1092,25 @@ def decrypt_words(words: jnp.ndarray, rk_dec: jnp.ndarray, nr: int) -> jnp.ndarr
     """Bitsliced batch decrypt with the InvMixColumns-folded schedule."""
     padded, n = _pad32(words)
     out = _crypt_planes(to_planes(padded), key_planes(rk_dec, nr), nr, decrypt_round)
+    return from_planes(out)[:n]
+
+
+def encrypt_words_multikey(words: jnp.ndarray, rk_blocks: jnp.ndarray,
+                           nr: int) -> jnp.ndarray:
+    """Bitsliced batch encrypt where block i uses its OWN schedule.
+
+    ``rk_blocks``: (N, 4*(nr+1)) u32 per-block round keys (the caller
+    gathers them from a (K, 4*(nr+1)) stack with a PUBLIC key-index
+    vector — models/aes.py:ctr_crypt_words_scattered_multikey). Same
+    contract as encrypt_words otherwise; padding blocks get the
+    all-zero schedule (their output is discarded by the caller).
+    """
+    padded, n = _pad32(words)
+    pad = padded.shape[0] - rk_blocks.shape[0]
+    if pad:
+        rk_blocks = jnp.concatenate(
+            [rk_blocks,
+             jnp.zeros((pad, rk_blocks.shape[1]), rk_blocks.dtype)], axis=0)
+    out = _crypt_planes(to_planes(padded), multikey_planes(rk_blocks, nr),
+                        nr, encrypt_round)
     return from_planes(out)[:n]

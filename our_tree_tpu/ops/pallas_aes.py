@@ -310,7 +310,12 @@ def _out_struct(x: jnp.ndarray) -> jax.ShapeDtypeStruct:
         vma = jax.typeof(x).vma
     except Exception:
         vma = None
-    return jax.ShapeDtypeStruct(x.shape, x.dtype, vma=vma)
+    if vma is None:
+        return jax.ShapeDtypeStruct(x.shape, x.dtype)
+    try:
+        return jax.ShapeDtypeStruct(x.shape, x.dtype, vma=vma)
+    except TypeError:  # this jax predates the vma kwarg
+        return jax.ShapeDtypeStruct(x.shape, x.dtype)
 
 
 def interpret_mode() -> bool:
@@ -363,17 +368,22 @@ def _crypt_planes_pallas(x, kp, *, nr, decrypt, tile, layout="planes",
     )(kp, x)
 
 
-def _lane_pad_and_tile(n: int) -> tuple[int, int]:
+def _lane_pad_and_tile(n: int, cap: int | None = None) -> tuple[int, int]:
     """(pad_blocks, tile) for an n-block batch.
 
     Pad to whole 32-block lanes first, THEN pick the tile: choosing the
     tile from the unpadded count can double the padded work for sizes
     just under the tile span. This way padding never exceeds 31 blocks
     plus tile alignment on the lane axis. Shared by every pallas entry
-    point so the padding invariant cannot drift between them.
+    point so the padding invariant cannot drift between them. ``cap``
+    bounds the tile below the tuned knob for kernels whose VMEM
+    footprint grows past the data tiles (the multi-key entry carries a
+    full (nr+1, 8, 16, tile) effective-key-plane tensor).
     """
     w_lanes = (n + 31) // 32
     tile = min(tile_for_blocks(n), w_lanes)
+    if cap is not None:
+        tile = min(tile, cap)
     pad = 32 * ((w_lanes + tile - 1) // tile * tile) - n
     return pad, tile
 
@@ -709,3 +719,140 @@ def ctr_crypt_words_gen(words: jnp.ndarray, ctr_be_words: jnp.ndarray,
     serves both directions; sharded callers pre-offset ``ctr_be_words`` to
     their shard's first block (parallel/dist.py)."""
     return _ctr_gen_words(words, ctr_be_words, rk, nr, layout="planes")
+
+
+# ---------------------------------------------------------------------------
+# Multi-key scattered CTR: one kernel launch, K independent schedules.
+#
+# The serve batcher coalesces many tenants' requests into one rung-shaped
+# dispatch; with one schedule per launch every distinct key fragments the
+# batch (the pre-multikey coalescing restriction). This kernel carries K
+# expanded schedules at once — the batched-kernel lever of "GPU Accelerated
+# AES Algorithm" (PAPERS.md) applied across KEYS, not just blocks. Per-block
+# key selection happens INSIDE the kernel by masked select, not by gather
+# (Mosaic has no vector gather, and the bitsliced layout mixes blocks of
+# different keys within one 32-block lane word anyway):
+#
+#   * kp_all:   (K, nr+1, 8, 16, 1) full-lane key-plane masks, one set per
+#               schedule slot (zero schedules in unused slots) — tiny,
+#               broadcast to every grid step.
+#   * masks:    (K, W) u32 lane masks; bit t of masks[k, l] says block
+#               32*l + t uses slot k. Built OUTSIDE the kernel from the
+#               PUBLIC per-block key-index vector (slot_lane_masks) — no
+#               secret-indexed addressing anywhere.
+#   * kp_eff[r] = OR_k(kp_all[k, r] & masks[k]): the per-block round-key
+#               planes, reconstructed with K AND/OR sweeps — ~K*(nr+1)*128
+#               vector ops amortised over 32*tile blocks, small next to the
+#               ~120-op/round S-box circuit itself.
+#
+# Data rides the dense (128, W) boundary and is never bit-transposed at
+# all (XOR commutes with the transposition, as in the single-key fused
+# kernels): only the counter tile unpacks to planes and only the
+# synthesised keystream packs back.
+# ---------------------------------------------------------------------------
+
+
+def slot_lane_masks(key_slots: jnp.ndarray, k: int) -> jnp.ndarray:
+    """(N,) u32 per-block key-slot indices (N % 32 == 0) -> (K, W) u32
+    lane masks: bit t of [k, l] == (key_slots[32*l + t] == k). Pure
+    compare/shift arithmetic on the PUBLIC slot vector — the kernel-safe
+    replacement for a per-block schedule gather."""
+    s = key_slots.astype(jnp.uint32).reshape(-1, 32)        # (W, 32)
+    ks = jnp.arange(k, dtype=jnp.uint32)[:, None, None]
+    eq = (s[None] == ks).astype(jnp.uint32)                 # (K, W, 32)
+    shifts = jnp.arange(32, dtype=jnp.uint32)[None, None, :]
+    return jnp.sum(eq << shifts, axis=-1, dtype=jnp.uint32)
+
+
+def _ctr_scat_mk_kernel(kp_ref, mask_ref, ctr_ref, data_ref, out_ref, *,
+                        nr: int, interpret: bool, sbox: str | None,
+                        mc: str = "perm"):
+    kp_all = kp_ref[...]          # (K, nr+1, 8, 16, 1)
+    masks = mask_ref[...]         # (K, tile)
+    kp_eff = None
+    for k in range(kp_all.shape[0]):
+        term = kp_all[k] & masks[k][None, None, None, :]
+        kp_eff = term if kp_eff is None else kp_eff | term
+    round_fn = functools.partial(bitslice.encrypt_round, sbox=sbox)
+    ctr_planes = bitslice.planes_from_dense(ctr_ref[...])
+    p = _run_rounds(ctr_planes ^ kp_eff[0], kp_eff, nr, round_fn,
+                    interpret, mc)
+    ks = round_fn(p, kp_eff[nr], True, perm=_perm_stack)
+    out_ref[...] = data_ref[...] ^ bitslice.dense_from_planes(ks)
+
+
+#: Tile cap for the multi-key kernel: kp_eff is a real (nr+1, 8, 16, tile)
+#: VMEM tensor (~4 MiB at tile 512, nr 14), not a broadcast — capped so it
+#: plus three data tiles stays well inside the ~16 MiB of VMEM under the
+#: default tuned tile of 1024.
+_MK_TILE_CAP = 512
+
+
+@functools.partial(jax.jit, static_argnames=("nr", "tile", "sbox", "mc"))
+def _ctr_scat_mk_pallas(ctr_d, data_d, kp_all, masks, *, nr, tile,
+                        sbox=None, mc="perm"):
+    w = ctr_d.shape[-1]
+    k = kp_all.shape[0]
+    interpret = _interpret()
+    kernel = functools.partial(_ctr_scat_mk_kernel, nr=nr,
+                               interpret=interpret, sbox=sbox, mc=mc)
+    spec = pl.BlockSpec((128, tile), lambda i: (0, i))
+    return pl.pallas_call(
+        kernel,
+        grid=(w // tile,),
+        in_specs=[
+            pl.BlockSpec((k, nr + 1, 8, 16, 1),
+                         lambda i: (0, 0, 0, 0, 0)),
+            pl.BlockSpec((k, tile), lambda i: (0, i)),
+            spec,
+            spec,
+        ],
+        out_specs=spec,
+        out_shape=_out_struct(data_d),
+        interpret=interpret,
+    )(kp_all, masks, ctr_d, data_d)
+
+
+def _ctr_scattered_multikey(words, ctr_le, rks, key_slots, nr, sbox=None):
+    n = words.shape[0]
+    if n == 0:
+        return words
+    _dispatch_seam("pallas multikey scattered-CTR dispatch (dense)")
+    pad, tile = _lane_pad_and_tile(n, cap=_MK_TILE_CAP)
+    if pad:
+        zeros = jnp.zeros((pad, 4), words.dtype)
+        words = jnp.concatenate([words, zeros], axis=0)
+        ctr_le = jnp.concatenate([ctr_le, zeros], axis=0)
+        key_slots = jnp.concatenate(
+            [key_slots, jnp.zeros((pad,), key_slots.dtype)], axis=0)
+    x = bitslice.dense_words(words)
+    c = _match_vma(bitslice.dense_words(ctr_le), x)
+    kp_all = _match_vma(
+        jax.vmap(lambda r: bitslice.key_planes(r, nr))(rks), x)
+    masks = _match_vma(slot_lane_masks(key_slots, rks.shape[0]), x)
+    out = _ctr_scat_mk_pallas(c, x, kp_all, masks, nr=nr, tile=tile,
+                              sbox=sbox, mc=MC_LOWERING)
+    return bitslice.undense_words(out)[:n]
+
+
+def ctr_scattered_multikey_dense(words: jnp.ndarray, ctr_le: jnp.ndarray,
+                                 rks: jnp.ndarray, key_slots: jnp.ndarray,
+                                 nr: int) -> jnp.ndarray:
+    """Multi-key scattered CTR on the dense boundary (tower S-box).
+
+    ``words``/``ctr_le``: (N, 4) u32; ``rks``: (K, 4*(nr+1)) stacked
+    expanded schedules; ``key_slots``: (N,) u32 PUBLIC per-block slot
+    indices. Registered as the MULTIKEY_CTR entry of every tower-S-box
+    Pallas engine (models/aes.py): the dense layout is the one with no
+    sublane-padding tax, so every engine NAME's multi-key seam routes
+    here rather than duplicating the kernel per boundary layout."""
+    return _ctr_scattered_multikey(words, ctr_le, rks, key_slots, nr)
+
+
+def ctr_scattered_multikey_dense_bp(words: jnp.ndarray, ctr_le: jnp.ndarray,
+                                    rks: jnp.ndarray, key_slots: jnp.ndarray,
+                                    nr: int) -> jnp.ndarray:
+    """ctr_scattered_multikey_dense with the Boyar–Peralta S-box pinned
+    per-call — the multi-key entry of the *-bp engines."""
+    return _ctr_scattered_multikey(words, ctr_le, rks, key_slots, nr,
+                                   sbox="bp")

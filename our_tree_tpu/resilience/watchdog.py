@@ -164,6 +164,84 @@ def dump_stacks(what: str, seconds: float) -> str | None:
         return None
 
 
+class _Scheduler:
+    """ONE persistent daemon thread multiplexing every armed deadline.
+
+    The original design spawned (and joined) a monitor thread per
+    guarded block — correct, but thread spawn is ~0.5 ms on the
+    sandboxed hosts the serve path now runs hot on, and the serve lane
+    arms a deadline around EVERY dispatch: at fast-engine batch rates
+    the spawn alone would eat the latency budget (docs/PERF.md, the
+    serve-vs-offline gap). Arming is now a dict insert + condvar notify
+    on a long-lived worker; disarming is a pop. The worker sleeps until
+    the earliest armed expiry, hands the entry's callback (stack dump +
+    SIGALRM delivery — unchanged semantics) to a short-lived fire
+    thread, and goes back to sleep; with nothing armed it parks on the
+    condvar. An entry popped by
+    ``disarm`` before the worker reaches it never fires — the same
+    stand-down race the per-thread Event gave (completion exactly at
+    the edge may still see the signal; the handler is only installed
+    while the block runs, exactly as before).
+    """
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._entries: dict = {}  # id -> (monotonic expiry, fire())
+        self._seq = 0
+        self._thread = None
+
+    def arm(self, seconds: float, fire) -> int:
+        with self._cv:
+            self._seq += 1
+            eid = self._seq
+            self._entries[eid] = (time.monotonic() + seconds, fire)
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, daemon=True, name="ot-watchdog")
+                self._thread.start()
+            self._cv.notify()
+        return eid
+
+    def disarm(self, eid: int) -> None:
+        with self._cv:
+            self._entries.pop(eid, None)
+            self._cv.notify()
+
+    def _run(self):
+        while True:
+            with self._cv:
+                if not self._entries:
+                    self._cv.wait()
+                    continue
+                now = time.monotonic()
+                nxt = min(t for t, _ in self._entries.values())
+                if nxt > now:
+                    self._cv.wait(nxt - now)
+                    continue
+                due = [eid for eid, (t, _) in self._entries.items()
+                       if t <= now]
+                fires = [self._entries.pop(eid)[1] for eid in due]
+            # Each expiry fires on its OWN short-lived thread: fire()
+            # does I/O (dump_stacks), and a dump wedged on a full pipe
+            # or hung filesystem must only disable ITS deadline, not
+            # every armed guard in the process. Spawn cost lands on the
+            # rare expiry path; arming stays a dict insert.
+            for fire in fires:
+                threading.Thread(target=self._fire_one, args=(fire,),
+                                 daemon=True,
+                                 name="ot-watchdog-fire").start()
+
+    @staticmethod
+    def _fire_one(fire):
+        try:
+            fire()
+        except Exception:  # noqa: BLE001 - never kill the fire thread's
+            pass           # siblings or leak into threading excepthook
+
+
+_SCHEDULER = _Scheduler()
+
+
 @contextlib.contextmanager
 def deadline(seconds: float | None, what: str = "device dispatch",
              degrade_kind: str = "dispatch-timeout"):
@@ -177,7 +255,10 @@ def deadline(seconds: float | None, what: str = "device dispatch",
     no-SIGALRM degradation). Nesting: the guard saves and restores the
     previous SIGALRM disposition, so it composes with bench.py's stage
     alarm as long as the scopes nest properly — but prefer ONE deadline
-    per region; the innermost armed one wins the signal.
+    per region; the innermost armed one wins the signal. Monitoring
+    rides the process-wide ``_Scheduler`` worker — arming costs a dict
+    insert, not a thread spawn (the serve fast path arms one per
+    dispatch).
     """
     if not seconds or seconds <= 0:
         yield
@@ -189,22 +270,28 @@ def deadline(seconds: float | None, what: str = "device dispatch",
                and hasattr(signal, "SIGALRM"))
     fired: dict = {}
     done = threading.Event()
+    # Serialises the kill decision against handler restore: the signal
+    # may only be sent while our handler is still installed. The dump
+    # stays OUTSIDE the gate — it is the slow I/O, and the completing
+    # main thread must not wait out a wedged filesystem in its finally.
+    gate = threading.Lock()
 
-    def monitor():
-        if done.wait(seconds):
-            return
+    def fire():
         if done.is_set():  # completed exactly at the edge: stand down
             return
         fired["report"] = dump_stacks(what, seconds)
-        if on_main and not done.is_set():
-            # Deliver to the Python-level handler (which runs in the
-            # main thread) — this is what interrupts a GIL-releasing
-            # blocking call.
-            try:
-                signal.pthread_kill(threading.main_thread().ident,
-                                    signal.SIGALRM)
-            except (OSError, RuntimeError):
-                pass
+        with gate:
+            if done.is_set():
+                return
+            if on_main:
+                # Deliver to the Python-level handler (which runs in
+                # the main thread) — this is what interrupts a
+                # GIL-releasing blocking call.
+                try:
+                    signal.pthread_kill(threading.main_thread().ident,
+                                        signal.SIGALRM)
+                except (OSError, RuntimeError):
+                    pass
 
     def _record_and_build():
         # The degrade stamp rides the RAISE, not the monitor: a block
@@ -229,9 +316,7 @@ def deadline(seconds: float | None, what: str = "device dispatch",
             raise _record_and_build()
 
         old = signal.signal(signal.SIGALRM, handler)
-    t = threading.Thread(target=monitor, daemon=True,
-                         name=f"ot-watchdog:{what}")
-    t.start()
+    eid = _SCHEDULER.arm(seconds, fire)
     try:
         yield
         # A hang the guard could NOT interrupt (off-main, GIL-held) that
@@ -240,10 +325,22 @@ def deadline(seconds: float | None, what: str = "device dispatch",
         if "report" in fired and not on_main:
             raise _record_and_build()
     finally:
-        done.set()
-        t.join(timeout=2.0)
-        if old is not None:
-            signal.signal(signal.SIGALRM, old)
+        try:
+            done.set()
+            _SCHEDULER.disarm(eid)
+            # Wait out a fire() already past its done check: once the
+            # gate is free, any in-flight kill has been SENT (pending
+            # on our still-installed handler — the documented
+            # completed-at-the-edge raise) and any later fire stands
+            # down inside the gate. Without this, pthread_kill could
+            # land AFTER the restore below — on SIG_DFL for the
+            # outermost guard, which terminates the process on a
+            # dispatch that actually succeeded.
+            with gate:
+                pass
+        finally:
+            if old is not None:
+                signal.signal(signal.SIGALRM, old)
 
 
 #: Injected hangs fired so far in this process. Callers that must tell

@@ -159,6 +159,164 @@ def aesni_available() -> bool:
     return bool(load().ot_aesni_available())
 
 
+#: Blocks per ECB thread before another thread pays for itself: 16 K
+#: blocks = 256 KiB. Measured on the 2-core CI sandbox — a pthread spawn
+#: costs ~0.3 ms against ~1 GB/s of AESNI, so splitting finer than this
+#: LOSES throughput at the serve ladder's rungs (docs/PERF.md).
+_THREAD_BLOCKS = 16384
+
+
+def _default_threads(nblocks: int) -> int:
+    """Size-based ECB thread count: one per ``_THREAD_BLOCKS`` chunk,
+    capped at the core count, never below one — the reference's
+    ``length/num_threads`` split with the threshold measured where spawn
+    cost stops dominating (ctr_scattered_words docstring)."""
+    return max(1, min(os.cpu_count() or 1, nblocks // _THREAD_BLOCKS))
+
+
+def aes_ctx_from_schedule(nr: int, rk_words: np.ndarray) -> AesCtx:
+    """An AesCtx primed directly from an EXPANDED schedule, no setkey.
+
+    ``rk_words``: (4*(nr+1),) u32 little-endian round-key words (the
+    ``ops.keyschedule.expand_key_enc`` layout). The C context stores the
+    schedule as raw byte blocks (ot_crypt.h: ``rk[15][16]``) and the LE
+    word packing is exactly that byte stream, so a memmove IS the key
+    setup — which is what lets the serve key cache hand the native tier
+    its HOST schedules without retaining raw key bytes
+    (tests/test_native.py pins this against ot_aes_setkey).
+    """
+    load()  # ensure the library (and its table init path) exists
+    nr = int(nr)
+    if not 0 < nr <= 14:
+        # rk is a fixed rk[15][16] C field — an oversized nr would
+        # memmove past the ctypes buffer, not fail cleanly.
+        raise ValueError(f"nr={nr} out of range for the C context "
+                         f"(AES-128/192/256 = 10/12/14 rounds)")
+    ctx = AesCtx()
+    ctx.nr = nr
+    b = np.ascontiguousarray(rk_words, dtype="<u4").view(np.uint8)
+    if b.size != 16 * (nr + 1):
+        raise ValueError(
+            f"schedule has {b.size} bytes, expected {16 * (nr + 1)}")
+    ctypes.memmove(ctx.rk, b.ctypes.data, b.size)
+    return ctx
+
+
+def ctr_scattered_words(ctxs, words: np.ndarray, ctr_words: np.ndarray,
+                        key_slots: np.ndarray | None = None,
+                        nthreads: int = 0) -> np.ndarray:
+    """Scattered CTR on the native runtime: out = ECB(counters) ^ data.
+
+    The host twin of ``models.aes.ctr_crypt_words_scattered_multikey`` —
+    the serve dispatch's CPU fallback tier. ``words``/``ctr_words``: flat
+    (4N,) u32 LE arrays (the serve boundary layout); ``ctxs``: one AesCtx
+    per key slot; ``key_slots``: (N,) per-block slot indices (None = all
+    slot 0). Blocks of one slot arrive as contiguous runs (the batcher
+    packs per key group), so the dispatch is one threaded ECB call per
+    run over the counter bytes plus one vectorised XOR — AESNI hardware
+    rate with zero per-block Python.
+
+    ``nthreads`` 0 picks a size-based default: one thread per 256 KiB
+    chunk (capped at the core count) — the reference's
+    ``length/num_threads`` chunk split (aes-modes/test.c:33-35), with a
+    threshold measured where spawn cost stops dominating: on the 2-core
+    CI sandbox a pthread spawn costs ~0.3 ms against ~1 GB/s AESNI, so
+    threading below ~16 K blocks per thread LOSES throughput (the
+    pre-tuned default threaded at 2048 blocks and ran 5x slower than
+    single-threaded at the serve ladder's rungs).
+    """
+    lib = load()
+    words = np.ascontiguousarray(words, dtype=np.uint32).reshape(-1)
+    ctr_b = np.ascontiguousarray(
+        ctr_words, dtype="<u4").reshape(-1).view(np.uint8)
+    n = words.size // 4
+    if ctr_b.size != 16 * n:
+        # The C calls get explicit lengths the ndpointers cannot check:
+        # a mismatched counter array would be a silent out-of-bounds
+        # heap access, not an exception.
+        raise ValueError(f"ctr_words holds {ctr_b.size // 16} blocks "
+                         f"for a {n}-block batch")
+    ks = np.empty_like(ctr_b)
+    if key_slots is None:
+        runs = [(0, 0, n)]
+    else:
+        key_slots = np.asarray(key_slots).reshape(-1)
+        if key_slots.size != n:
+            raise ValueError(f"key_slots has {key_slots.size} entries "
+                             f"for a {n}-block batch")
+        if not key_slots.any():  # single-slot batch: one run, no scan
+            runs = [(0, 0, n)]
+        else:
+            edges = np.flatnonzero(np.diff(key_slots)) + 1
+            bounds = np.concatenate(([0], edges, [n]))
+            runs = [(int(key_slots[int(a)]), int(a), int(b))
+                    for a, b in zip(bounds[:-1], bounds[1:])]
+    for slot, start, stop in runs:
+        nb = stop - start
+        if nb <= 0:
+            continue
+        t = nthreads or _default_threads(nb)
+        lib.ot_aes_ecb(ctypes.byref(ctxs[slot]), 1,
+                       ctr_b[16 * start:16 * stop],
+                       ks[16 * start:16 * stop], nb, t)
+    # XOR in place into the keystream buffer: the serve path calls this
+    # per batch, and a third N-word temporary is pure memory traffic.
+    ks_w = ks.view("<u4")
+    np.bitwise_xor(ks_w, words, out=ks_w)
+    return ks_w
+
+
+def ctr_requests_words(ctxs, words: np.ndarray, runs,
+                       nthreads: int = 0) -> np.ndarray:
+    """Per-REQUEST CTR on the native runtime: counters stay in C.
+
+    The zero-counter-array fast path of the serve native tier:
+    ``runs`` is the batch's request layout —
+    ``[(slot, start_block, nblocks, nonce16), ...]`` — and each request
+    is one ``ot_aes_ctr`` call (counter ripple, ECB, and XOR all inside
+    C, per-chunk offsets for its threads). Against
+    ``ctr_scattered_words`` this drops the materialised (N, 4) counter
+    array, the separate keystream buffer, and the numpy XOR pass — at
+    the big ladder rungs those passes cost more than the cipher
+    (docs/PERF.md). Bit-exact with the counter-array path by the shared
+    128-bit big-endian ripple (``ctr_add`` / ``np_ctr_le_blocks``;
+    tests pin the two and the NIST KAT). Blocks no run covers (rung
+    padding) are ZEROED — the buffer comes from ``np.empty`` and heap
+    garbage (potentially another allocation's freed secrets) must not
+    sit in a buffer callers may hold views over; full coverage (the
+    common case) pays nothing.
+    """
+    lib = load()
+    words = np.ascontiguousarray(words, dtype=np.uint32).reshape(-1)
+    data = words.view(np.uint8)
+    out = np.empty_like(data)
+    n = words.size // 4
+    nonce = np.empty(16, dtype=np.uint8)
+    for slot, start, nb, nonce_bytes in runs:
+        if nb <= 0:
+            continue
+        # The C call gets an explicit length the ndpointer cannot
+        # check: a run past the buffer would be a silent out-of-bounds
+        # heap write (adjacent to key material), not an exception.
+        if start < 0 or start + nb > n:
+            raise ValueError(
+                f"run ({start}, {nb}) exceeds the {n}-block buffer")
+        if not 0 <= slot < len(ctxs):
+            raise ValueError(f"run slot {slot} outside {len(ctxs)} ctxs")
+        t = nthreads or _default_threads(nb)
+        nonce[:] = np.frombuffer(bytes(nonce_bytes), dtype=np.uint8)
+        lib.ot_aes_ctr(ctypes.byref(ctxs[slot]), nonce,
+                       data[16 * start:16 * (start + nb)],
+                       out[16 * start:16 * (start + nb)], 16 * nb, t)
+    pos = 0  # zero every uncovered byte (runs are disjoint)
+    for start, nb in sorted((s, n) for _, s, n, _ in runs if n > 0):
+        if start > pos:
+            out[16 * pos:16 * start] = 0
+        pos = max(pos, start + nb)
+    out[16 * pos:] = 0
+    return out.view("<u4")
+
+
 # ---------------------------------------------------------------------------
 # Pythonic wrappers (mirror the TPU-side API shapes).
 # ---------------------------------------------------------------------------

@@ -9,18 +9,28 @@ ceiling — and padding every batch up to its rung: after one warmup pass
 over the ladder, steady-state serving replays compiled programs only
 (``serve.bench`` asserts exactly that, via the backend-compile counter).
 
-Batches coalesce per (tenant, key digest): the scattered-CTR dispatch
-(``models.aes.ctr_crypt_words_scattered``) carries one round-key
-schedule per call, while each request keeps its OWN counter stream —
-request segments are concatenated with their per-block counters
-materialised host-side (``utils.packing.np_ctr_le_blocks``), so the
-batch needs no common counter base, only a common key. Padding blocks
-reuse the tail counter region with zero payload; their keystream is
-computed and discarded (the occupancy column in ``serve.bench`` prices
-exactly this waste).
+Coalescing is a RUNG-PACKER over key groups: requests first group by
+(tenant, key digest) in arrival order — each group becomes one key SLOT
+carrying its own schedule — and up to ``key_slots`` groups pack into one
+batch, filled to the ladder ceiling. The dispatch seam
+(``models.aes.ctr_crypt_words_scattered_multikey``) carries the K
+stacked schedules plus a per-block slot-index vector, so one device call
+serves many tenants' keys; each request still keeps its OWN counter
+stream, materialised host-side (``utils.packing.np_ctr_le_blocks``).
+Before the multi-key seam, every distinct (tenant, key) forced its own
+batch — many tenants with small requests meant many mostly-padding
+dispatches; the packer turns that fragmentation into full rungs (the
+``coalesce_efficiency`` stat in ``serve.bench`` prices exactly this).
+The slot dimension K is FIXED per server (unused slots carry the
+all-zero schedule), so shapes stay closed and the zero-recompile
+contract holds unchanged. Groups of different key LENGTHS never share a
+batch: the round count ``nr`` is a static compile argument.
+
+Padding blocks ride slot 0 with zero counters and zero payload; their
+keystream is computed and discarded.
 
 jax-free on purpose: forming a batch is numpy bookkeeping; the device
-boundary is the server's.
+boundary is the lane's (``serve/lanes.py``).
 """
 
 from __future__ import annotations
@@ -29,6 +39,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from ..ops.keyschedule import ROUNDS
 from ..utils import packing
 from .queue import Request
 
@@ -39,6 +50,25 @@ from .queue import Request
 #: padded miss wastes at most one rung.
 DEFAULT_MIN_BLOCKS = 32
 DEFAULT_MAX_BLOCKS = 4096
+
+#: Default key slots per dispatch (the fixed K dimension). 8 covers the
+#: many-tenants-few-requests drain shape without inflating the stacked
+#: schedule (8 x 60 words) or the Pallas kernel's masked-select sweep.
+DEFAULT_KEY_SLOTS = 8
+
+
+#: Shared block-offset vector for counter materialisation, grown on
+#: demand: one request's counters are ``nonce + _block_idx(n)`` and the
+#: arange itself is the same for every request — allocating it per
+#: request showed up on the serve fast path's profile.
+_ARANGE = np.arange(DEFAULT_MAX_BLOCKS, dtype=np.uint32)
+
+
+def _block_idx(n: int) -> np.ndarray:
+    global _ARANGE
+    if n > _ARANGE.size:
+        _ARANGE = np.arange(n, dtype=np.uint32)
+    return _ARANGE[:n]
 
 
 def bucket_ladder(min_blocks: int = DEFAULT_MIN_BLOCKS,
@@ -66,66 +96,166 @@ def bucket_for(nblocks: int, rungs: tuple[int, ...]) -> int:
 
 
 @dataclass
-class Batch:
-    """One formed dispatch: same tenant+key, padded to a ladder rung."""
+class Slot:
+    """One key group inside a batch: a (tenant, key) and its riders."""
 
     tenant: str
     digest: str                  #: key digest (keycache identity)
     key: bytes
-    bucket: int                  #: padded block count (the rung)
     requests: list[Request]
-    blocks: int                  #: real (unpadded) block count
-    words: np.ndarray | None = field(default=None, repr=False)
-    ctr_words: np.ndarray | None = field(default=None, repr=False)
+    blocks: int                  #: payload blocks in this slot
 
     @property
     def label(self) -> str:
-        return f"{self.tenant}/{self.digest[:8]}:{self.bucket}"
+        return f"{self.tenant}/{self.digest[:8]}"
+
+
+@dataclass
+class Batch:
+    """One formed dispatch: up to K key slots, padded to a ladder rung."""
+
+    slots: list[Slot]
+    bucket: int                  #: padded block count (the rung)
+    blocks: int                  #: real (unpadded) payload block count
+    nr: int                      #: round count (uniform across slots)
+    key_slots: int               #: the fixed K dimension
+    words: np.ndarray | None = field(default=None, repr=False)
+    ctr_words: np.ndarray | None = field(default=None, repr=False)
+    slot_index: np.ndarray | None = field(default=None, repr=False)
+    #: request layout [(slot, start_block, nblocks, nonce16)] — the
+    #: native tier's per-request C CTR path consumes this instead of
+    #: the materialised counter array (models.aes ``native_runs``)
+    runs: list | None = field(default=None, repr=False)
+
+    @property
+    def label(self) -> str:
+        first = self.slots[0].label if self.slots else "?"
+        return f"{first}+{len(self.slots) - 1}k:{self.bucket}"
+
+    @property
+    def requests(self) -> list[Request]:
+        return [r for s in self.slots for r in s.requests]
+
+    @property
+    def keys(self) -> list[tuple[str, bytes]]:
+        """Slot-ordered (tenant, key) pairs — the keycache.stacked input."""
+        return [(s.tenant, s.key) for s in self.slots]
 
     @property
     def occupancy(self) -> float:
         return self.blocks / self.bucket
 
-    def materialise(self) -> None:
-        """Build the flat u32 dispatch arrays (payload words + per-block
-        LE counter words). Flat (4N,) on purpose: the dense jit-boundary
-        layout every models entry point shares (models/aes.py:
-        _as_block_words)."""
-        words = np.zeros(4 * self.bucket, dtype=np.uint32)
-        ctr = np.zeros((self.bucket, 4), dtype=np.uint32)
+    def materialise(self, counters: bool = True) -> None:
+        """Build the flat u32 dispatch arrays: payload words, per-block
+        LE counter words, the per-block slot-index vector, and the
+        request-layout ``runs``. Flat (4N,) words on purpose: the dense
+        jit-boundary layout every models entry point shares
+        (models/aes.py:_as_block_words). Padding blocks stay at slot 0 /
+        zero counters / zero payload — their keystream is discarded by
+        split_output's offsets.
+
+        ``counters=False`` (the native-tier server) skips the counter
+        array and the slot vector entirely: the host tier consumes
+        ``runs`` — per-request (slot, start, nblocks, nonce) — and
+        generates counters inside C, so materialising an (N, 4) array
+        it would never read is a pure memory-bandwidth tax at exactly
+        the rungs where bandwidth is the budget.
+
+        Assembly is allocation-lean — it sits between every payload
+        byte and the engine: requests pack contiguously, so padding
+        exists only as a TAIL and only the tail is zeroed (a full
+        ``np.zeros`` re-touched every cache line before the copy
+        overwrote it); a single request exactly filling its rung skips
+        the payload copy entirely (the request's own bytes viewed as
+        words ARE the dispatch array — reads only downstream)."""
+        runs = []
         off = 0
-        for req in self.requests:
-            n = req.nblocks
-            words[4 * off:4 * (off + n)] = packing.np_bytes_to_words(
-                req.payload)
-            ctr[off:off + n] = packing.np_ctr_le_blocks(
-                req.nonce, np.arange(n, dtype=np.uint32))
-            off += n
+        for si, slot in enumerate(self.slots):
+            for req in slot.requests:
+                runs.append((si, off, req.nblocks, req.nonce))
+                off += req.nblocks
+        self.runs = runs
+        reqs = self.requests
+        if len(reqs) == 1 and reqs[0].nblocks == self.bucket:
+            req = reqs[0]
+            self.words = packing.np_bytes_to_words(
+                np.ascontiguousarray(req.payload, dtype=np.uint8))
+            if counters:
+                ctr = np.empty((self.bucket, 4), dtype=np.uint32)
+                packing.np_ctr_le_blocks(req.nonce,
+                                         _block_idx(self.bucket), out=ctr)
+                self.ctr_words = ctr.reshape(-1)
+                self.slot_index = np.zeros(self.bucket, dtype=np.uint32)
+            return
+        words = np.empty(4 * self.bucket, dtype=np.uint32)
+        ctr = (np.empty((self.bucket, 4), dtype=np.uint32)
+               if counters else None)
+        slot_index = (np.zeros(self.bucket, dtype=np.uint32)
+                      if counters else None)
+        off = 0
+        for si, slot in enumerate(self.slots):
+            for req in slot.requests:
+                n = req.nblocks
+                words[4 * off:4 * (off + n)] = packing.np_bytes_to_words(
+                    req.payload)
+                if counters:
+                    packing.np_ctr_le_blocks(req.nonce, _block_idx(n),
+                                             out=ctr[off:off + n])
+                    slot_index[off:off + n] = si
+                off += n
+        if off < self.bucket:  # the padding tail (zero contract above)
+            words[4 * off:] = 0
+            if counters:
+                ctr[off:] = 0
         self.words = words
-        self.ctr_words = ctr.reshape(-1)
+        if counters:
+            self.ctr_words = ctr.reshape(-1)
+            self.slot_index = slot_index
 
     def split_output(self, out_words: np.ndarray) -> list[np.ndarray]:
-        """Per-request output bytes from the batch's output words."""
+        """Per-request output bytes (slot order, then request order —
+        the ``requests`` property's order) from the batch's output.
+
+        A request spanning the ENTIRE dispatch buffer (the big-payload
+        fast path: one request exactly filling its rung) gets a
+        zero-copy view when the buffer is writable (the native tier's
+        numpy output) — it holds nothing but the request's own bytes.
+        Every other case COPIES: a partial view's ``.base`` would pin
+        the whole per-dispatch buffer alive and hand each tenant a
+        window over the other slots' output (and, on the native runs
+        path, the rung-padding region) — the cross-tenant boundary the
+        key cache is built to preserve — and a jax-backed buffer views
+        as READ-ONLY where response payloads have always been
+        caller-mutable."""
         flat = np.asarray(out_words, dtype=np.uint32).reshape(-1)
         outs = []
         off = 0
         for req in self.requests:
             n = req.nblocks
-            outs.append(packing.np_words_to_bytes(
-                flat[4 * off:4 * (off + n)].reshape(-1, 4)).reshape(-1))
+            w = flat[4 * off:4 * (off + n)]
+            if 4 * n != flat.size or not flat.flags.writeable:
+                w = w.copy()
+            outs.append(packing.np_words_to_bytes(w))
             off += n
         return outs
 
 
 def form_batches(requests: list[Request],
                  rungs: tuple[int, ...],
-                 key_digest) -> list[Batch]:
-    """Greedy coalescing: group by (tenant, key digest) in arrival
-    order, fill each batch up to the ladder ceiling, pad to the smallest
-    rung that holds what was packed. Returns batches in first-arrival
-    order of their groups; array materialisation is deferred to the
-    caller (the server times it under its ``batch-formed`` span).
+                 key_digest,
+                 key_slots: int = DEFAULT_KEY_SLOTS) -> list[Batch]:
+    """The rung-packer: group by (tenant, key digest) in arrival order,
+    then pack up to ``key_slots`` groups per batch, filling to the
+    ladder ceiling and padding to the smallest rung that holds what was
+    packed. A batch is flushed when it runs out of block capacity, when
+    an unstarted group finds all K slots taken, or when the next group's
+    key length (round count) differs — ``nr`` is a static compile
+    argument and may not vary inside one dispatch. Array
+    materialisation is deferred to the caller (the server times it
+    under its ``batch-formed`` span).
     """
+    if key_slots < 1:
+        raise ValueError("key_slots must be >= 1")
     ceiling = rungs[-1]
     groups: dict[tuple[str, str], list[Request]] = {}
     order: list[tuple[str, str]] = []
@@ -135,21 +265,37 @@ def form_batches(requests: list[Request],
             groups[k] = []
             order.append(k)
         groups[k].append(req)
+
     batches: list[Batch] = []
+    cur_slots: list[Slot] = []
+    cur_blocks = 0
+    cur_nr = None
+
+    def flush():
+        nonlocal cur_slots, cur_blocks, cur_nr
+        if cur_slots:
+            batches.append(Batch(cur_slots, bucket_for(cur_blocks, rungs),
+                                 cur_blocks, cur_nr, key_slots))
+        cur_slots, cur_blocks, cur_nr = [], 0, None
+
     for tenant, digest in order:
         pending = groups[(tenant, digest)]
-        cur: list[Request] = []
-        cur_blocks = 0
+        nr = ROUNDS[len(pending[0].key) * 8]
+        if cur_nr is not None and nr != cur_nr:
+            flush()
+        if len(cur_slots) >= key_slots:
+            flush()
+        slot = None
         for req in pending:
-            if cur and cur_blocks + req.nblocks > ceiling:
-                batches.append(Batch(tenant, digest, cur[0].key,
-                                     bucket_for(cur_blocks, rungs),
-                                     cur, cur_blocks))
-                cur, cur_blocks = [], 0
-            cur.append(req)
+            if cur_slots and cur_blocks + req.nblocks > ceiling:
+                flush()
+                slot = None
+            if slot is None:
+                slot = Slot(tenant, digest, req.key, [], 0)
+                cur_slots.append(slot)
+                cur_nr = nr
+            slot.requests.append(req)
+            slot.blocks += req.nblocks
             cur_blocks += req.nblocks
-        if cur:
-            batches.append(Batch(tenant, digest, cur[0].key,
-                                 bucket_for(cur_blocks, rungs),
-                                 cur, cur_blocks))
+    flush()
     return batches

@@ -59,7 +59,7 @@ import sys
 from ..obs import trace
 from ..resilience import degrade, watchdog
 from ..resilience import journal as journal_mod
-from . import loadgen
+from . import batcher, loadgen
 from .server import Server, ServerConfig
 
 
@@ -83,6 +83,8 @@ async def _drive(args, probes):
         engine=args.engine,
         min_bucket_blocks=args.bucket_min,
         max_bucket_blocks=args.bucket_max,
+        key_slots=args.key_slots,
+        native_threads=args.native_threads,
         max_depth=args.queue_depth,
         request_deadline_s=args.deadline,
         dispatch_deadline_s=args.dispatch_deadline,
@@ -126,7 +128,24 @@ def main(argv=None) -> int:
                     help="fixed request size when --mixed-sizes is off")
     ap.add_argument("--tenants", type=int, default=4)
     ap.add_argument("--keys-per-tenant", type=int, default=2)
-    ap.add_argument("--engine", default="auto")
+    ap.add_argument("--tenant-heavy", action="store_true",
+                    help="multi-tenant-heavy mix: many tenants, one key "
+                         "each, small sizes "
+                         f"{loadgen.TENANT_HEAVY_SIZES} — full rungs can "
+                         "only come from multi-key packing (the "
+                         "coalesce_efficiency rehearsal)")
+    ap.add_argument("--engine", default="auto",
+                    help="serve engine tier: auto (ranked jax ladder on "
+                         "an accelerator, native AESNI host tier on "
+                         "CPU), native, or any registered jax engine "
+                         "name (docs/SERVING.md tier table)")
+    ap.add_argument("--key-slots", type=int, default=None, metavar="K",
+                    help="key slots per dispatch (the fixed K "
+                         "dimension; default "
+                         f"{batcher.DEFAULT_KEY_SLOTS})")
+    ap.add_argument("--native-threads", type=int, default=0,
+                    help="native-tier ECB threads per slot run "
+                         "(0 = size-based default)")
     ap.add_argument("--bucket-min", type=int, default=32, metavar="BLOCKS")
     ap.add_argument("--bucket-max", type=int, default=4096, metavar="BLOCKS")
     ap.add_argument("--queue-depth", type=int, default=1024)
@@ -164,9 +183,23 @@ def main(argv=None) -> int:
                          "the repo root)")
     ap.add_argument("--allow-recompiles", action="store_true",
                     help="do not fail on post-warmup backend compiles")
+    ap.add_argument("--min-coalesce", type=float, default=None,
+                    metavar="FRAC",
+                    help="fail (exit 1) if coalesce_efficiency — payload "
+                         "blocks over dispatched blocks, rung padding "
+                         "included — ends below FRAC (the CI multi-key "
+                         "drive gates 0.5: a rung-packer regression "
+                         "re-fragmenting tenants shows up here first)")
     args = ap.parse_args(argv)
-    args.sizes = (loadgen.MIXED_SIZES if args.mixed_sizes
-                  else (args.size_bytes,))
+    if args.tenant_heavy:
+        args.sizes = loadgen.TENANT_HEAVY_SIZES
+        args.tenants = max(args.tenants, 24)
+        args.keys_per_tenant = 1
+    else:
+        args.sizes = (loadgen.MIXED_SIZES if args.mixed_sizes
+                      else (args.size_bytes,))
+    if args.key_slots is None:
+        args.key_slots = batcher.DEFAULT_KEY_SLOTS
 
     if args.unquarantine:
         if not args.journal:
@@ -203,6 +236,7 @@ def main(argv=None) -> int:
     print(f"# latency ms: p50={report.p50_ms} p95={report.p95_ms} "
           f"p99={report.p99_ms}  goodput={report.goodput_gbps:.4f} GB/s "
           f"wall={report.wall_s:.3f}s")
+    coal = stats["coalesce"]
     print(f"# batches={stats['batches']} "
           f"failed={stats['batches_failed']} "
           f"timed_out={stats['batches_timed_out']} "
@@ -210,6 +244,11 @@ def main(argv=None) -> int:
           f"quarantines={lanes['quarantine_events']} "
           f"compiles: warmup={stats['compiles']['warmup']} "
           f"steady={stats['compiles']['steady']}")
+    print(f"# coalesce: efficiency={coal['efficiency']:.4f} "
+          f"({coal['payload_blocks']}/{coal['dispatched_blocks']} blocks) "
+          f"slot_fill={coal['slot_fill']:.4f} "
+          f"({coal['slots_used']}/{stats['batches']}x{coal['key_slots']} "
+          f"slots)")
     for row in lanes["per_lane"]:
         tr = "".join(f" [{t['prev']}->{t['to']}:{t['why']}]"
                      for t in row["transitions"])
@@ -226,12 +265,15 @@ def main(argv=None) -> int:
             "sizes": list(args.sizes), "tenants": args.tenants,
             "keys_per_tenant": args.keys_per_tenant,
             "engine": stats["engine"], "rungs": stats["rungs"],
+            "key_slots": args.key_slots,
+            "tenant_heavy": bool(args.tenant_heavy),
             "retries": args.retries,
             "dispatch_deadline_s": args.dispatch_deadline,
             "lanes": lanes["count"], "probe_every": args.probe_every,
             "seed": args.seed,
         },
         "load": report.to_json(),
+        "coalesce": coal,
         "batches": {k: stats[k] for k in
                     ("batches", "batches_failed", "batches_timed_out")},
         "lanes": lanes,
@@ -256,6 +298,7 @@ def main(argv=None) -> int:
             "p50_ms": report.p50_ms, "p95_ms": report.p95_ms,
             "p99_ms": report.p99_ms,
             "goodput_gbps": round(report.goodput_gbps, 4),
+            "coalesce_efficiency": coal["efficiency"],
             "batches": stats["batches"],
             "lanes": lanes["count"],
             "lanes_used": lanes["placed_across"],
@@ -283,6 +326,13 @@ def main(argv=None) -> int:
         print(f"# FAIL: {stats['compiles']['steady']} post-warmup backend "
               "compile(s) — the bucket ladder's zero-recompile contract "
               "is broken (--allow-recompiles to waive)", file=sys.stderr)
+        rc = 1
+    if (args.min_coalesce is not None
+            and coal["efficiency"] < args.min_coalesce):
+        print(f"# FAIL: coalesce_efficiency {coal['efficiency']:.4f} < "
+              f"{args.min_coalesce} — the rung-packer is fragmenting "
+              "(key groups not sharing batches, or padding dominating)",
+              file=sys.stderr)
         rc = 1
     return rc
 

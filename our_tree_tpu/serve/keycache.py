@@ -23,6 +23,27 @@ The digest (truncated SHA-256) is the cache identity and the only
 key-derived value that escapes into labels/traces — raw key bytes stay
 inside the entry.
 
+The multi-key dispatch seam consumes schedules as a STACKED view
+(``stacked()``): one (K, 4*(nr+1)) array holding every slot's schedule
+(zero rows in unused slots), plus — for the native host tier — the
+pre-built C contexts. Stacks are memoized per (slot digest set, K) in
+their own small LRU, so a steady-state traffic mix re-forming the same
+batches does NO per-batch schedule work at all: no expansion, no row
+copies, no native key setup — one OrderedDict hit (the digest identity
+makes the memo safe across per-tenant evictions: digest -> schedule is
+a pure function).
+
+Accepted tradeoff, stated plainly: the stacked memo RETAINS expanded
+schedules (and lazily-built native contexts) past a per-tenant LRU
+eviction, until ``stacked_capacity`` churn pushes the stack out.
+Per-tenant eviction is CAPACITY management, not key revocation — it
+fires on cache pressure while the tenant may still be sending traffic
+under that key, and purging stacks on it would re-pay full stack
+assembly every few batches for any tenant with more live keys than
+``per_tenant`` (the exact steady-state cost the memo exists to
+delete; tests pin eviction-survival). There is no revocation API;
+key-material lifetime in this process is bounded by BOTH LRUs.
+
 Single-event-loop discipline like the rest of serve/ (no lock); hits,
 misses and evictions are counted both locally (``stats()``) and into
 the obs counters.
@@ -44,17 +65,51 @@ def key_digest(key: bytes) -> str:
     return hashlib.sha256(bytes(key)).hexdigest()[:16]
 
 
+class StackedSchedules:
+    """An immutable K-slot schedule stack: the multi-key dispatch view.
+
+    ``rks``: (K, 4*(nr+1)) u32, row i = slot i's expanded schedule
+    (all-zero rows pad unused slots so the dispatch shape is closed over
+    K). ``native_ctxs()`` lazily builds — and then retains — the native
+    C contexts for the host engine tier, one memmove per slot
+    (``runtime.native.aes_ctx_from_schedule``): lazy because jax-engine
+    servers never need them, retained because the stack itself is
+    memoized, so steady state pays zero key setup either way.
+    """
+
+    __slots__ = ("nr", "rks", "digests", "_native_ctxs")
+
+    def __init__(self, nr: int, rks: np.ndarray, digests: tuple):
+        self.nr = int(nr)
+        self.rks = rks
+        self.digests = digests
+        self._native_ctxs = None
+
+    def native_ctxs(self):
+        if self._native_ctxs is None:
+            from ..runtime import native
+
+            self._native_ctxs = tuple(
+                native.aes_ctx_from_schedule(self.nr, row)
+                for row in self.rks)
+        return self._native_ctxs
+
+
 class KeyCache:
     """tenant -> (digest -> (nr, host round keys)) with per-tenant LRU."""
 
-    def __init__(self, per_tenant: int = 8):
+    def __init__(self, per_tenant: int = 8, stacked_capacity: int = 64):
         if per_tenant < 1:
             raise ValueError("per_tenant must be >= 1")
         self.per_tenant = int(per_tenant)
         self._tenants: dict[str, OrderedDict] = {}
+        self._stacked: OrderedDict = OrderedDict()
+        self.stacked_capacity = max(int(stacked_capacity), 1)
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        self.stacked_hits = 0
+        self.stacked_misses = 0
 
     def get(self, tenant: str, key: bytes):
         """(digest, nr, host round-key words) for ``key`` under
@@ -79,6 +134,43 @@ class KeyCache:
             trace.counter("keycache_evict", tenant=tenant)
         return (digest, *entry)
 
+    def stacked(self, slots: list, key_slots: int) -> StackedSchedules:
+        """The memoized (K, 4*(nr+1)) stack for ``slots`` (slot-ordered
+        (tenant, key) pairs — ``Batch.keys``). Every slot still passes
+        through ``get`` (LRU touch + hit accounting + expansion on a
+        genuinely new key), but the stack ASSEMBLY — row copies, and the
+        native contexts behind ``native_ctxs()`` — is memoized per
+        (digest tuple, K), so re-forming a familiar batch shape does no
+        schedule work. Mixed key lengths are refused: ``nr`` is a static
+        compile argument of the dispatch (the batcher never packs them
+        together; this is the seam's own guard)."""
+        if not slots or len(slots) > key_slots:
+            raise ValueError(
+                f"{len(slots)} slot(s) for a {key_slots}-slot stack")
+        entries = [self.get(t, k) for t, k in slots]
+        nrs = {e[1] for e in entries}
+        if len(nrs) > 1:
+            raise ValueError(f"mixed key lengths in one stack: nr={nrs}")
+        digests = tuple((t, e[0]) for (t, _k), e in zip(slots, entries))
+        memo_key = (digests, int(key_slots))
+        hit = self._stacked.get(memo_key)
+        if hit is not None:
+            self._stacked.move_to_end(memo_key)
+            self.stacked_hits += 1
+            trace.counter("keycache_stacked_hit")
+            return hit
+        self.stacked_misses += 1
+        trace.counter("keycache_stacked_miss")
+        nr = entries[0][1]
+        rks = np.zeros((int(key_slots), 4 * (nr + 1)), dtype=np.uint32)
+        for i, (_d, _nr, rk) in enumerate(entries):
+            rks[i] = rk
+        sched = StackedSchedules(nr, rks, digests)
+        self._stacked[memo_key] = sched
+        if len(self._stacked) > self.stacked_capacity:
+            self._stacked.popitem(last=False)
+        return sched
+
     def holds(self, tenant: str, key: bytes) -> bool:
         """Whether the entry is cached (no LRU touch — test/introspection
         only; production reads go through ``get``)."""
@@ -87,5 +179,8 @@ class KeyCache:
     def stats(self) -> dict:
         return {"hits": self.hits, "misses": self.misses,
                 "evictions": self.evictions,
+                "stacked_hits": self.stacked_hits,
+                "stacked_misses": self.stacked_misses,
+                "stacked_entries": len(self._stacked),
                 "tenants": len(self._tenants),
                 "entries": sum(len(v) for v in self._tenants.values())}

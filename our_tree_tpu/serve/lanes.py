@@ -101,10 +101,11 @@ class Lane:
     owns its device contact and its state transitions."""
 
     def __init__(self, idx: int, device, engine: str, deadline_s: float,
-                 retries: int, clock=time.monotonic):
+                 retries: int, clock=time.monotonic, native_threads: int = 0):
         self.idx = idx
         self.device = device
         self.engine = engine
+        self.native_threads = int(native_threads)
         self.deadline_s = deadline_s
         self.state = HEALTHY
         self.warmed = False
@@ -189,16 +190,24 @@ class Lane:
         self._quarantine("dispatch-timeout", journal)
 
     # -- the ONE device-dispatch seam in serve/ ----------------------------
-    def engine_call(self, words, ctr_words, rk, nr: int, label: str,
-                    warmup: bool = False):
-        """One scattered-CTR dispatch on THIS lane's device, under this
-        lane's watchdog deadline. Inputs are staged (committed) onto the
-        lane's device so jit routes the compiled program there; the
-        fault seams fire only for traffic (warmup primes compiles, it is
-        not a servable batch). Warmup runs under the global opt-in
-        deadline (a first-contact compile legitimately dwarfs a
-        steady-state dispatch) — EXCEPT on a quarantined lane, which
-        already proved it cannot be trusted with an unbounded wait."""
+    def engine_call(self, words, ctr_words, sched, key_slots, label: str,
+                    warmup: bool = False, runs=None):
+        """One MULTI-KEY scattered-CTR dispatch on THIS lane's device,
+        under this lane's watchdog deadline. ``sched`` is the keycache's
+        StackedSchedules view (K expanded schedules, zero rows in unused
+        slots) and ``key_slots`` the per-block slot-index vector — the
+        fixed-K dispatch shape that keeps the ladder's compile cache
+        closed (serve/batcher.py). Inputs are staged (committed) onto
+        the lane's device so jit routes the compiled program there; on
+        the NATIVE host tier there is no device and no jit — the call
+        runs the C runtime with the stack's pre-built contexts, still
+        inside this lane's watchdog/fault seams, so health accounting
+        and failover are engine-independent. The fault seams fire only
+        for traffic (warmup primes compiles, it is not a servable
+        batch). Warmup runs under the global opt-in deadline (a
+        first-contact compile legitimately dwarfs a steady-state
+        dispatch) — EXCEPT on a quarantined lane, which already proved
+        it cannot be trusted with an unbounded wait."""
         deadline_s = (self.deadline_s
                       if (not warmup or self.state == QUARANTINED)
                       else watchdog.default_deadline_s())
@@ -215,12 +224,25 @@ class Lane:
                 if not watchdog.injected_hang(
                         faults.scoped("lane_hang", self.idx), label):
                     watchdog.injected_hang("lane_hang", label)
-            w, c, r = words, ctr_words, rk
+            if self.engine == aes.NATIVE_ENGINE:
+                # ``runs`` (the batch's request layout) flips the host
+                # tier to the per-request C CTR fast path: counters are
+                # generated inside C, no (N, 4) array ever exists —
+                # warmup/canary calls pass explicit arrays instead
+                # (runs=None) and take the scattered counter path.
+                return np.asarray(aes.ctr_crypt_words_scattered_multikey(
+                    words, ctr_words, sched.rks, key_slots, sched.nr,
+                    self.engine, native_ctxs=sched.native_ctxs(),
+                    native_threads=self.native_threads,
+                    native_runs=runs))
+            w, c, r, s = words, ctr_words, sched.rks, key_slots
             if self.device is not None:
                 w = jax.device_put(w, self.device)
                 c = jax.device_put(c, self.device)
                 r = jax.device_put(r, self.device)
-            out = aes.ctr_crypt_words_scattered(w, c, r, nr, self.engine)
+                s = jax.device_put(s, self.device)
+            out = aes.ctr_crypt_words_scattered_multikey(
+                w, c, r, s, sched.nr, self.engine)
             jax.block_until_ready(out)
         return np.asarray(out)
 
@@ -247,19 +269,25 @@ class LanePool:
     def __init__(self, engine: str, deadline_s: float = 0.0,
                  retries: int = 2, lanes: int | None = None,
                  probe_every: int = 8, probation_batches: int = 2,
-                 journal=None, clock=time.monotonic):
-        devices = list(jax.devices())
+                 journal=None, clock=time.monotonic,
+                 native_threads: int = 0):
+        # The native host tier has no jax devices to fan over: lanes
+        # still exist (health machine, watchdog, failover rehearsals)
+        # but share the host; device staging is skipped in engine_call.
+        devices = (list(jax.devices())
+                   if engine != aes.NATIVE_ENGINE else [None])
         n = len(devices) if lanes is None else max(int(lanes), 1)
         self.engine = engine
         self.lanes = [Lane(i, devices[i % len(devices)], engine,
-                           deadline_s, retries, clock)
+                           deadline_s, retries, clock,
+                           native_threads=native_threads)
                       for i in range(n)]
         self.journal = journal
         self.probe_every = max(int(probe_every), 1)
         self.probation_batches = max(int(probation_batches), 1)
         self.redispatches = 0
         self._since_probe = 0
-        self._canary = None  # (words, ctr_words, rk, nr, expected, bucket)
+        self._canary = None  # (words, ctr, sched, key_slots, expected, rung)
 
     # -- journal resume ----------------------------------------------------
     def adopt_journal_quarantines(self) -> list[int]:
@@ -291,13 +319,15 @@ class LanePool:
         return min(cands, key=lambda l: (l.blocks, l.idx))
 
     # -- the canary --------------------------------------------------------
-    def set_canary(self, words, ctr_words, rk, nr: int, expected,
+    def set_canary(self, words, ctr_words, sched, key_slots, expected,
                    bucket: int) -> None:
         """Pin the warmup-shaped probe batch and its expected output
         (captured from the first lane to warm; every other lane's warmup
         output was compared against it — cross-lane bit-exactness is a
-        startup invariant, not a hope)."""
-        self._canary = (words, ctr_words, rk, nr,
+        startup invariant, not a hope). ``sched``/``key_slots`` are the
+        multi-key dispatch pair (StackedSchedules + per-block slot
+        vector), so the canary replays the EXACT traffic shape."""
+        self._canary = (words, ctr_words, sched, key_slots,
                         np.asarray(expected), int(bucket))
 
     def probe_lane(self, lane: Lane) -> bool:
@@ -309,13 +339,13 @@ class LanePool:
         if (self._canary is None or not lane.warmed
                 or lane.state != QUARANTINED):
             return False
-        words, ctr_words, rk, nr, expected, bucket = self._canary
+        words, ctr_words, sched, key_slots, expected, bucket = self._canary
         lane.canaries += 1
         cm = trace.detached_span("lane-probe", lane=lane.idx,
                                  bucket=bucket, engine=self.engine)
         cm.__enter__()
         try:
-            out = lane.engine_call(words, ctr_words, rk, nr,
+            out = lane.engine_call(words, ctr_words, sched, key_slots,
                                    f"canary:lane{lane.idx}")
         except watchdog.DispatchTimeout:
             trace.counter("serve_canary_failed", lane=lane.idx)
@@ -347,12 +377,14 @@ class LanePool:
                 self.probe_lane(lane)
 
     # -- dispatch with failover --------------------------------------------
-    def dispatch(self, words, ctr_words, rk, nr: int, label: str,
-                 bucket: int, blocks: int, requests: int):
+    def dispatch(self, words, ctr_words, sched, key_slots, label: str,
+                 bucket: int, blocks: int, requests: int, runs=None):
         """Place and run one batch, failing over across lanes until it
-        succeeds or every lane has been tried. Returns (output words,
-        lane, redispatches). Raises LanesExhausted when no lane could
-        serve it — only then may the caller answer per-request errors
+        succeeds or every lane has been tried. ``sched``/``key_slots``
+        are the multi-key pair (keycache.StackedSchedules + per-block
+        slot vector). Returns (output words, lane, redispatches).
+        Raises LanesExhausted when no lane could serve it — only then
+        may the caller answer per-request errors
         (re-dispatch-before-error is the failover contract)."""
         causes: list = []
         tried: set[int] = set()
@@ -369,8 +401,9 @@ class LanePool:
             cm.__enter__()
             try:
                 out = lane.policy.run(
-                    lambda att: lane.engine_call(words, ctr_words, rk, nr,
-                                                 label))
+                    lambda att: lane.engine_call(words, ctr_words, sched,
+                                                 key_slots, label,
+                                                 runs=runs))
             except watchdog.DispatchTimeout as e:
                 # The dispatch never ended: the span is ABANDONED, not
                 # closed — its orphaned begin is the kill evidence

@@ -2,12 +2,17 @@
 
 One asyncio loop on the main thread owns the whole path. Request
 coroutines ``submit`` into the bounded queue; the batcher loop drains,
-coalesces per (tenant, key) into ladder rungs (``batcher``), and places
-each batch on a dispatch LANE — one per visible device
-(``serve/lanes.py``), least-loaded across healthy lanes. Dispatch stays
-synchronous on the main thread on purpose: that is what lets each
-lane's watchdog SIGALRM interrupt a wedged device call
-(resilience/watchdog.py's GIL-releasing contract).
+rung-packs up to K key groups per batch (``batcher`` — the multi-key
+coalescer: one dispatch carries many tenants' keys via the stacked
+schedules + per-block slot vector), and places each batch on a dispatch
+LANE — one per visible device (``serve/lanes.py``), least-loaded across
+healthy lanes. The engine comes from ``aes.resolve_serve_engine``: the
+ranked jax-engine ladder (pallas-dense-bp on a measured TPU) plus the
+native AESNI host tier, which "auto" prefers on CPU — the fast-path
+tiering docs/SERVING.md tabulates. Dispatch stays synchronous on the
+main thread on purpose: that is what lets each lane's watchdog SIGALRM
+interrupt a wedged device call (resilience/watchdog.py's GIL-releasing
+contract).
 
 Failure containment, per batch (docs/SERVING.md has the sequence
 diagram):
@@ -101,9 +106,19 @@ def compile_count() -> int:
 
 @dataclass
 class ServerConfig:
+    #: resolved through ``aes.resolve_serve_engine``: the ranked-engine
+    #: ladder (pallas-dense-bp on a measured TPU) plus the host tier —
+    #: "auto" on CPU serves on the native AESNI runtime, "native" pins
+    #: it (and refuses to start if it cannot build), any CORES name
+    #: pins that jax engine (docs/SERVING.md has the tier table)
     engine: str = "auto"
     min_bucket_blocks: int = batcher.DEFAULT_MIN_BLOCKS
     max_bucket_blocks: int = batcher.DEFAULT_MAX_BLOCKS
+    #: the fixed K dimension: key slots per dispatch (unused slots carry
+    #: the all-zero schedule so shapes stay closed — zero-recompile)
+    key_slots: int = batcher.DEFAULT_KEY_SLOTS
+    #: native-tier ECB threads per slot run (0 = size-based default)
+    native_threads: int = 0
     max_depth: int = 1024
     #: per-request residency deadline (queue admission -> response)
     request_deadline_s: float = 30.0
@@ -157,6 +172,12 @@ class Server:
         #: bucket -> {"batches", "blocks"} running totals (O(#rungs)
         #: memory — a week-long soak must not grow per-batch state)
         self._occupancy: dict[int, dict] = {}
+        #: rung-packer accounting: payload vs dispatched (rung) blocks
+        #: and key-slot fill — the ``coalesce_efficiency`` stat
+        self._payload_blocks = 0
+        self._dispatched_blocks = 0
+        self._slots_used = 0
+        self._slot_capacity = 0
         self.warmup_compiles = 0
         self._compiles_at_ready = 0
 
@@ -166,7 +187,7 @@ class Server:
         quarantines, warm every lane x rung, start the batcher loop."""
         c = self.config
         before = compile_count()
-        self.engine = aes.resolve_engine(c.engine)
+        self.engine = aes.resolve_serve_engine(c.engine)
         if c.journal:
             self._journal = journal_mod.SweepJournal(
                 c.journal, {"kind": "serve-lanes",
@@ -174,7 +195,8 @@ class Server:
         self.pool = lanes.LanePool(
             engine=self.engine, deadline_s=self._deadline_s,
             retries=c.retries, lanes=c.lanes, probe_every=c.probe_every,
-            probation_batches=c.probation_batches, journal=self._journal)
+            probation_batches=c.probation_batches, journal=self._journal,
+            native_threads=c.native_threads)
         self.pool.adopt_journal_quarantines()
         self._warmup()
         if not any(l.warmed for l in self.pool.lanes):
@@ -210,6 +232,12 @@ class Server:
             b"\x00" * 16,
             np.arange(canary_rung, dtype=np.uint32)).reshape(-1)
         canary_expected = None
+        # One all-zero slot vector per rung: warmup compiles the EXACT
+        # traffic signature — (words, counters, (K, 4*(nr+1)) stack,
+        # (rung,) slot vector) — so a steady-state batch is always a
+        # cache hit regardless of how many slots it actually fills.
+        slot_vecs = {rung: np.zeros(rung, dtype=np.uint32)
+                     for rung in self.rungs}
         # Trusted lanes warm FIRST: the first lane to warm pins the
         # canary expectation every other lane is compared against, and
         # a lane that starts quarantined (journal-adopted — possibly for
@@ -227,19 +255,22 @@ class Server:
                     try:
                         mismatch = False
                         for bits in c.warmup_key_bits:
-                            _, nr, rk = self.keycache.get(
-                                "_warmup", b"\x00" * (bits // 8))
+                            sched = self.keycache.stacked(
+                                [("_warmup", b"\x00" * (bits // 8))],
+                                c.key_slots)
                             for rung in self.rungs:
                                 if (rung == canary_rung
                                         and bits == c.warmup_key_bits[0]):
                                     out = lane.engine_call(
-                                        canary_words, canary_ctr, rk, nr,
+                                        canary_words, canary_ctr, sched,
+                                        slot_vecs[canary_rung],
                                         f"warmup:{rung}", warmup=True)
                                     if canary_expected is None:
                                         canary_expected = out
                                         self.pool.set_canary(
-                                            canary_words, canary_ctr, rk,
-                                            nr, out, canary_rung)
+                                            canary_words, canary_ctr,
+                                            sched, slot_vecs[canary_rung],
+                                            out, canary_rung)
                                     elif not np.array_equal(
                                             out, canary_expected):
                                         mismatch = True
@@ -247,7 +278,8 @@ class Server:
                                 else:
                                     words = np.zeros(4 * rung,
                                                      dtype=np.uint32)
-                                    lane.engine_call(words, words, rk, nr,
+                                    lane.engine_call(words, words, sched,
+                                                     slot_vecs[rung],
                                                      f"warmup:{rung}",
                                                      warmup=True)
                             if mismatch:
@@ -305,7 +337,8 @@ class Server:
                 if not requests:
                     break
                 for b in batcher.form_batches(requests, self.rungs,
-                                              key_digest):
+                                              key_digest,
+                                              self.config.key_slots):
                     self._run_batch(b)
                     self.pool.maybe_probe()
                     # Yield between batches: resolved clients get to
@@ -330,9 +363,14 @@ class Server:
 
         try:
             with trace.span("batch-formed", batch=b.label, bucket=b.bucket,
-                            blocks=b.blocks, requests=len(b.requests)):
-                _, nr, rk = self.keycache.get(b.tenant, b.key)
-                b.materialise()
+                            blocks=b.blocks, slots=len(b.slots),
+                            requests=len(b.requests)):
+                sched = self.keycache.stacked(b.keys, b.key_slots)
+                # The native tier generates counters inside C per
+                # request (the batch's ``runs`` layout) — materialising
+                # the (N, 4) counter array it would never read is pure
+                # memory-bandwidth tax at the big rungs.
+                b.materialise(counters=self.engine != aes.NATIVE_ENGINE)
         except Exception as e:  # noqa: BLE001 - containment (docstring)
             self.batches_failed += 1
             trace.counter("serve_batch_failed", batch=b.label)
@@ -340,16 +378,11 @@ class Server:
                 req.fail(ERR_DISPATCH, f"{type(e).__name__}: {e}",
                          batch=b.label)
             return
-        self.batches += 1
-        occ = self._occupancy.setdefault(b.bucket,
-                                         {"batches": 0, "blocks": 0})
-        occ["batches"] += 1
-        occ["blocks"] += b.blocks
         try:
             out, _lane, _redispatched = self.pool.dispatch(
-                b.words, b.ctr_words, rk, nr, b.label,
+                b.words, b.ctr_words, sched, b.slot_index, b.label,
                 bucket=b.bucket, blocks=b.blocks,
-                requests=len(b.requests))
+                requests=len(b.requests), runs=b.runs)
         except lanes.LanesExhausted as e:
             # Failover already ran: every lane was tried (and each
             # miss degraded its lane's health). Only now do the riders
@@ -372,6 +405,19 @@ class Server:
                 req.fail(ERR_DISPATCH, f"{type(e).__name__}: {e}",
                          batch=b.label)
             return
+        # Dispatch succeeded: only now does the batch enter the
+        # coalesce/occupancy accounting — a batch that exhausted every
+        # lane served nothing, and counting it would let a failure-heavy
+        # run pass the CI-gated coalesce_efficiency on phantom traffic.
+        self.batches += 1
+        occ = self._occupancy.setdefault(b.bucket,
+                                         {"batches": 0, "blocks": 0})
+        occ["batches"] += 1
+        occ["blocks"] += b.blocks
+        self._payload_blocks += b.blocks
+        self._dispatched_blocks += b.bucket
+        self._slots_used += len(b.slots)
+        self._slot_capacity += b.key_slots
         try:
             for req, data in zip(b.requests, b.split_output(out)):
                 req.resolve(Response(ok=True, payload=data, batch=b.label))
@@ -393,10 +439,29 @@ class Server:
             "mean_occupancy": round(h["blocks"] / (h["batches"] * bucket), 4)}
             for bucket, h in sorted(self._occupancy.items())}
 
+    def coalesce_stats(self) -> dict:
+        """The rung-packer's efficiency: payload blocks over DISPATCHED
+        blocks (rung padding priced in; empty key slots priced by
+        ``slot_fill``). Fragmentation regressions — many tenants forced
+        into many mostly-padding batches — show up here first
+        (``serve.bench`` prints and gates it)."""
+        return {
+            "payload_blocks": self._payload_blocks,
+            "dispatched_blocks": self._dispatched_blocks,
+            "efficiency": (round(self._payload_blocks
+                                 / self._dispatched_blocks, 4)
+                           if self._dispatched_blocks else 0.0),
+            "key_slots": self.config.key_slots,
+            "slots_used": self._slots_used,
+            "slot_fill": (round(self._slots_used / self._slot_capacity, 4)
+                          if self._slot_capacity else 0.0),
+        }
+
     def stats(self) -> dict:
         return {
             "engine": self.engine,
             "rungs": list(self.rungs),
+            "coalesce": self.coalesce_stats(),
             "batches": self.batches,
             "batches_failed": self.batches_failed,
             "batches_timed_out": self.batches_timed_out,

@@ -25,9 +25,17 @@ def np_bytes_to_words(b: np.ndarray) -> np.ndarray:
 
 
 def np_words_to_bytes(w: np.ndarray) -> np.ndarray:
-    """uint32 words -> little-endian uint8 bytes."""
-    w = np.ascontiguousarray(w)
-    return w.astype("<u4").view(np.uint8).reshape(w.shape[:-1] + (w.shape[-1] * 4,))
+    """uint32 words -> little-endian uint8 bytes.
+
+    A zero-copy VIEW whenever the input is already contiguous
+    little-endian u32 (the serve output path splits batch results with
+    this per request — the old unconditional ``astype`` copy was a full
+    extra pass over every payload byte). The view inherits the input's
+    writability: jax-backed arrays come through READ-ONLY — callers
+    that mutate (or must not alias the input) copy at their boundary
+    (``models.aes._bytes_np``, ``serve.batcher.Batch.split_output``)."""
+    w = np.ascontiguousarray(w, dtype="<u4")
+    return w.view(np.uint8).reshape(w.shape[:-1] + (w.shape[-1] * 4,))
 
 
 def jnp_bytes_to_words(b: jnp.ndarray) -> jnp.ndarray:
@@ -56,7 +64,8 @@ def byteswap32(w: jnp.ndarray) -> jnp.ndarray:
 
 
 def np_ctr_le_blocks(nonce_counter: np.ndarray | bytes,
-                     idx: np.ndarray) -> np.ndarray:
+                     idx: np.ndarray,
+                     out: np.ndarray | None = None) -> np.ndarray:
     """Counter blocks ``nonce + idx[k]`` as the (N, 4) u32 LE words the
     cipher consumes — the host-side twin of ``models.aes.ctr_le_blocks``
     (tests pin the two against each other across multi-word carries).
@@ -64,7 +73,12 @@ def np_ctr_le_blocks(nonce_counter: np.ndarray | bytes,
     The serve batcher materialises each request's counter stream with
     this before concatenating requests into one scattered-CTR dispatch
     (``models.aes.ctr_crypt_words_scattered``); building counters on host
-    keeps the device call a pure fixed-shape engine dispatch.
+    keeps the device call a pure fixed-shape engine dispatch. It runs
+    once per request on the serve fast path, so the common case — the
+    low counter word never wraps inside one request — takes a
+    carry-free lane: the three upper words are broadcast scalars and
+    only the low word is per-block work. ``out`` lets the batcher write
+    straight into its batch array (no (N, 4) temporary).
 
     ``nonce_counter``: the 16 big-endian counter bytes (the resume-state
     convention of ``AES.crypt_ctr``); ``idx``: (N,) block offsets < 2^32.
@@ -73,17 +87,33 @@ def np_ctr_le_blocks(nonce_counter: np.ndarray | bytes,
     if b.size != 16:
         raise ValueError("nonce_counter must be 16 bytes")
     ctr_be = np_bytes_to_words(b).byteswap()  # (4,) big-endian words
+    ctr_le = ctr_be.byteswap()                # the same words, LE view
     idx = np.asarray(idx, dtype=np.uint32)
+    if out is None:
+        out = np.empty((idx.size, 4), dtype=np.uint32)
     with np.errstate(over="ignore"):  # 128-bit ripple: word wrap intended
         s3 = (ctr_be[3] + idx).astype(np.uint32)
-        c3 = (s3 < idx).astype(np.uint32)
-        s2 = (ctr_be[2] + c3).astype(np.uint32)
-        c2 = c3 & (s2 == 0)
-        s1 = (ctr_be[1] + c2).astype(np.uint32)
-        c1 = c2 & (s1 == 0)
-        s0 = (ctr_be[0] + c1).astype(np.uint32)
-    be = np.stack([s0, s1, s2, s3], axis=-1)
-    return be.byteswap()  # LE words of the counter byte stream
+        wrapped = s3 < idx
+        if wrapped.any():
+            out[:, 3] = s3.byteswap()
+            c3 = wrapped.astype(np.uint32)
+            s2 = (ctr_be[2] + c3).astype(np.uint32)
+            c2 = c3 & (s2 == 0)
+            s1 = (ctr_be[1] + c2).astype(np.uint32)
+            c1 = c2 & (s1 == 0)
+            s0 = (ctr_be[0] + c1).astype(np.uint32)
+            out[:, 2] = s2.byteswap()
+            out[:, 1] = s1.byteswap()
+            out[:, 0] = s0.byteswap()
+        else:  # no low-word wrap anywhere: upper words are constants
+            # One contiguous broadcast pass, then overwrite the low
+            # column — three separate strided constant-column writes
+            # each re-touch every cache line of the array (write
+            # allocate), which at large rungs cost more than the ECB
+            # keystream itself.
+            out[:] = ctr_le
+            out[:, 3] = s3.byteswap()
+    return out
 
 
 def hex_to_bytes(s: str) -> np.ndarray:

@@ -185,6 +185,32 @@ def test_serve_lane_seam_rule(tmp_path):
     assert "serve-lane-seam" not in _rules(fs)
 
 
+def test_serve_lane_seam_rule_covers_multikey_and_native(tmp_path):
+    """The multi-key entry point and the native host-tier dispatch are
+    lane-seam dispatches too: reachable from serve/ ONLY through
+    Lane.engine_call — a batcher 'optimisation' calling either directly
+    would dodge the watchdog, health accounting, and failover."""
+    violating = """
+        from our_tree_tpu.models import aes
+        from our_tree_tpu.runtime import native
+
+        def fast_path(words, ctr, rks, slots, nr, ctxs):
+            out = aes.ctr_crypt_words_scattered_multikey(
+                words, ctr, rks, slots, nr, "jnp")
+            return native.ctr_scattered_words(ctxs, out, ctr, slots)
+    """
+    fs = _lint(tmp_path, violating, name="our_tree_tpu/serve/batcher.py")
+    flagged = [f for f in fs if f.rule == "serve-lane-seam"]
+    assert len(flagged) == 2  # the multikey call AND the native tier
+    assert any("ctr_crypt_words_scattered_multikey" in f.message
+               for f in flagged)
+    assert any("ctr_scattered_words" in f.message for f in flagged)
+    # The compliant twin: the same calls inside the seam file are the
+    # seam (Lane.engine_call's body is exactly this shape).
+    fs = _lint(tmp_path, violating, name="our_tree_tpu/serve/lanes.py")
+    assert "serve-lane-seam" not in _rules(fs)
+
+
 def test_fault_points_rule_covers_lane_helpers(tmp_path):
     """check_lane/scoped literals are validated against KNOWN_POINTS
     like every other fault-method literal — and the registered lane

@@ -146,3 +146,43 @@ def test_pallas_ctr_gen_matches_materialised():
     )
     np.testing.assert_array_equal(got_gen, want)
     np.testing.assert_array_equal(got_mat, want)
+
+
+def test_pallas_multikey_scattered_ctr_parity():
+    """The multi-key masked-select kernel (ops/pallas_aes.py:
+    ctr_scattered_multikey_dense[_bp]) vs the jnp multi-key core (itself
+    NIST-KAT-pinned in test_serve): K=3 interleaved tenants, n=34 so the
+    lane-pad path runs (one 32-block lane group + 2 padded), every block's
+    keystream reconstructed through slot_lane_masks + the kp_eff OR-select
+    — a bit-ordering slip in the mask build or the masked select would
+    corrupt exactly the cross-tenant boundary the serve path rides on."""
+    from our_tree_tpu.ops import pallas_aes
+    from our_tree_tpu.utils import packing
+
+    rng = np.random.default_rng(41)
+    keys = [bytes([i]) * 16 for i in (1, 2, 3)]
+    slots = np.asarray((([0, 1, 0, 2, 2, 0, 1, 0, 2, 1, 0] * 3) + [2]),
+                       dtype=np.uint32)  # 34 blocks, arbitrary interleave
+    n = slots.size
+    nr = None
+    rks = []
+    for k in keys:
+        nr, rk = expand_key_enc(k)
+        rks.append(np.asarray(rk, np.uint32))
+    rks = np.stack(rks)
+    ctr = np.empty((n, 4), np.uint32)
+    for s in range(len(keys)):
+        mine = np.flatnonzero(slots == s)
+        ctr[mine] = packing.np_ctr_le_blocks(
+            bytes([s]) * 16, np.arange(mine.size, dtype=np.uint32))
+    words = packing.np_bytes_to_words(
+        rng.integers(0, 256, 16 * n, dtype=np.uint8))
+    want = np.asarray(aes_mod.ctr_crypt_words_scattered_multikey(
+        words, ctr.reshape(-1), rks, slots, nr, "jnp"))
+    w2 = jnp.asarray(words.reshape(-1, 4))
+    c2 = jnp.asarray(ctr)
+    for fn in (pallas_aes.ctr_scattered_multikey_dense,
+               pallas_aes.ctr_scattered_multikey_dense_bp):
+        got = np.asarray(fn(w2, c2, jnp.asarray(rks), jnp.asarray(slots),
+                            nr))
+        np.testing.assert_array_equal(got.reshape(-1), want.reshape(-1))

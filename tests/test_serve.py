@@ -152,6 +152,215 @@ def test_scattered_ctr_matches_base_and_segments():
     assert np.array_equal(got_bytes, want)
 
 
+def _multikey_case(keys, slot_of_block, seed=13):
+    """Build one interleaved multi-key dispatch + its per-key reference.
+
+    ``slot_of_block``: per-block key-slot indices (arbitrary interleave —
+    the seam's contract is any PUBLIC slot vector, not just the
+    batcher's contiguous runs). Returns (words, ctr, rks, slots, want
+    bytes) with the expectation assembled block-by-block from single-key
+    CTR over each key's own counter stream."""
+    rng = np.random.default_rng(seed)
+    slot_of_block = np.asarray(slot_of_block, dtype=np.uint32)
+    n = slot_of_block.size
+    data = rng.integers(0, 256, 16 * n, dtype=np.uint8)
+    nonces = [rng.integers(0, 256, 16, dtype=np.uint8).tobytes()
+              for _ in keys]
+    nr = None
+    rks = []
+    for k in keys:
+        nr, rk = expand_key_enc(k)
+        rks.append(np.asarray(rk, np.uint32))
+    rks = np.stack(rks)
+    ctr = np.empty((n, 4), np.uint32)
+    want = np.empty(16 * n, np.uint8)
+    for s, key in enumerate(keys):
+        mine = np.flatnonzero(slot_of_block == s)
+        ctr[mine] = packing.np_ctr_le_blocks(
+            nonces[s], np.arange(mine.size, dtype=np.uint32))
+        ref = _ref_ctr(key, nonces[s],
+                       data.reshape(n, 16)[mine].reshape(-1))
+        want.reshape(n, 16)[mine] = ref.reshape(-1, 16)
+    words = packing.np_bytes_to_words(data)
+    return words, ctr.reshape(-1), rks, slot_of_block, nr, want
+
+
+@pytest.mark.parametrize("engine", ["jnp", "bitslice", "native"])
+def test_multikey_scattered_ctr_interleaved_parity(engine):
+    """K=3 interleaved tenants through ONE multi-key dispatch == each
+    key's own single-key CTR, block for block — on the jax engines AND
+    the native C runtime (the host tier twin)."""
+    if engine == "native" and not aes.native_runtime_available():
+        pytest.skip("native runtime unavailable")
+    keys = [bytes([i]) * 16 for i in (1, 2, 3)]
+    slots = [0, 1, 0, 2, 2, 0, 1, 0, 2, 1, 0]  # arbitrary interleave
+    words, ctr, rks, sv, nr, want = _multikey_case(keys, slots)
+    got = np.asarray(aes.ctr_crypt_words_scattered_multikey(
+        words, ctr, rks, sv, nr, engine))
+    got_bytes = packing.np_words_to_bytes(got.reshape(-1, 4)).reshape(-1)
+    assert np.array_equal(got_bytes, want)
+
+
+@pytest.mark.parametrize("engine", ["jnp", "bitslice", "native"])
+def test_multikey_scattered_ctr_nist_kat(engine):
+    """The NIST SP800-38A CTR-AES128 KAT survives riding slot 1 of a
+    K=4 stack (slots 2-3 empty, all-zero schedules) with another
+    tenant's blocks interleaved around it — the multi-key seam may not
+    perturb a single stream's bytes."""
+    if engine == "native" and not aes.native_runtime_available():
+        pytest.skip("native runtime unavailable")
+    other = bytes(range(16))
+    nr, rk_n = expand_key_enc(NIST_KEY)
+    _, rk_o = expand_key_enc(other)
+    rks = np.stack([np.asarray(rk_o, np.uint32),
+                    np.asarray(rk_n, np.uint32),
+                    np.zeros_like(rk_n, dtype=np.uint32),
+                    np.zeros_like(rk_n, dtype=np.uint32)])
+    # 4 NIST blocks on slot 1, 3 other-tenant blocks on slot 0.
+    sv = np.array([1, 0, 1, 1, 0, 1, 0], dtype=np.uint32)
+    rng = np.random.default_rng(29)
+    other_nonce = rng.integers(0, 256, 16, dtype=np.uint8).tobytes()
+    data = np.zeros((7, 16), np.uint8)
+    data[sv == 1] = np.frombuffer(NIST_PT, np.uint8).reshape(4, 16)
+    other_pt = rng.integers(0, 256, 16 * 3, dtype=np.uint8)
+    data[sv == 0] = other_pt.reshape(3, 16)
+    ctr = np.empty((7, 4), np.uint32)
+    ctr[sv == 1] = packing.np_ctr_le_blocks(
+        NIST_CTR0, np.arange(4, dtype=np.uint32))
+    ctr[sv == 0] = packing.np_ctr_le_blocks(
+        other_nonce, np.arange(3, dtype=np.uint32))
+    got = np.asarray(aes.ctr_crypt_words_scattered_multikey(
+        packing.np_bytes_to_words(data.reshape(-1)), ctr.reshape(-1),
+        rks, sv, nr, engine))
+    got_b = packing.np_words_to_bytes(got.reshape(-1, 4)).reshape(7, 16)
+    assert got_b[sv == 1].tobytes() == NIST_CT
+    assert np.array_equal(got_b[sv == 0].reshape(-1),
+                          _ref_ctr(other, other_nonce, other_pt))
+
+
+def test_native_runs_path_matches_counter_array_path():
+    """The native tier's per-request C CTR fast path (counters
+    generated inside C from each request's nonce — ``native_runs``) is
+    bit-exact with the materialised-counter-array path and the jax
+    engines, including across a 128-bit counter wrap."""
+    if not aes.native_runtime_available():
+        pytest.skip("native runtime unavailable")
+    from our_tree_tpu.runtime import native
+
+    rng = np.random.default_rng(3)
+    keys = [bytes([i]) * 16 for i in (5, 6)]
+    rks = []
+    for k in keys:
+        nr, rk = expand_key_enc(k)
+        rks.append(np.asarray(rk, np.uint32))
+    rks = np.stack(rks)
+    ctxs = [native.aes_ctx_from_schedule(nr, r) for r in rks]
+    # Slot 0: two requests (the second's counters restart at ITS nonce);
+    # slot 1: one request. First nonce 2^128-3: wraps inside the run.
+    nonces = [((1 << 128) - 3).to_bytes(16, "big"),
+              rng.bytes(16), rng.bytes(16)]
+    runs = [(0, 0, 7, nonces[0]), (0, 7, 3, nonces[1]),
+            (1, 10, 5, nonces[2])]
+    n = 15
+    words = packing.np_bytes_to_words(
+        rng.integers(0, 256, 16 * n, dtype=np.uint8))
+    ctr = np.empty((n, 4), np.uint32)
+    for _s, start, nb, nc in runs:
+        packing.np_ctr_le_blocks(nc, np.arange(nb, dtype=np.uint32),
+                                 out=ctr[start:start + nb])
+    sv = np.zeros(n, np.uint32)
+    sv[10:] = 1
+    via_array = np.asarray(aes.ctr_crypt_words_scattered_multikey(
+        words, ctr.reshape(-1), rks, sv, nr, "native", native_ctxs=ctxs))
+    via_runs = np.asarray(aes.ctr_crypt_words_scattered_multikey(
+        words, None, rks, None, nr, "native", native_ctxs=ctxs,
+        native_runs=runs))
+    via_jnp = np.asarray(aes.ctr_crypt_words_scattered_multikey(
+        words, ctr.reshape(-1), rks, sv, nr, "jnp"))
+    assert np.array_equal(via_array.reshape(-1), via_runs.reshape(-1))
+    assert np.array_equal(via_array.reshape(-1), via_jnp.reshape(-1))
+
+
+def test_native_runs_path_zeroes_uncovered_blocks():
+    """Bytes no run covers (rung padding, and any interior gap) come
+    back ZERO, not heap garbage: the output buffer is np.empty and a
+    caller holding a view over it must never see another allocation's
+    freed memory. Covered ranges are untouched by the zeroing."""
+    if not aes.native_runtime_available():
+        pytest.skip("native runtime unavailable")
+    from our_tree_tpu.runtime import native
+
+    rng = np.random.default_rng(9)
+    key = bytes(range(16))
+    nr, rk = expand_key_enc(key)
+    ctx = native.aes_ctx_from_schedule(nr, np.asarray(rk, np.uint32))
+    n = 12
+    words = packing.np_bytes_to_words(
+        rng.integers(0, 256, 16 * n, dtype=np.uint8))
+    nonce = rng.bytes(16)
+    # Covered: blocks [0, 3) and [5, 8); gaps: [3, 5) interior, [8, 12) tail.
+    runs = [(0, 0, 3, nonce), (0, 5, 3, nonce)]
+    out = np.asarray(native.ctr_requests_words([ctx], words, runs),
+                     np.uint32).reshape(n, 4)
+    assert out[0:3].any() and out[5:8].any()  # covered: keystream'd
+    assert not out[3:5].any(), "interior gap must be zeroed"
+    assert not out[8:].any(), "tail padding must be zeroed"
+    # Covered ranges equal the jnp scattered seam block for block.
+    idx = np.arange(3, dtype=np.uint32)
+    ctr = packing.np_ctr_le_blocks(nonce, idx)
+    for lo in (0, 5):
+        want = np.asarray(aes.ctr_crypt_words_scattered(
+            words.reshape(n, 4)[lo:lo + 3].reshape(-1), ctr.reshape(-1),
+            np.asarray(rk, np.uint32), nr, "jnp"))
+        assert np.array_equal(out[lo:lo + 3].reshape(-1),
+                              want.reshape(-1))
+
+
+def test_native_rejects_out_of_bounds_runs():
+    """Run layouts the buffer cannot hold are REFUSED before the C
+    call: the ndpointer carries no length, so a bad (start, nb) would
+    be a silent out-of-bounds heap write next to key material — the
+    same clean-failure standard aes_ctx_from_schedule applies to nr."""
+    if not aes.native_runtime_available():
+        pytest.skip("native runtime unavailable")
+    from our_tree_tpu.runtime import native
+
+    nr, rk = expand_key_enc(bytes(16))
+    ctx = native.aes_ctx_from_schedule(nr, np.asarray(rk, np.uint32))
+    words = np.zeros(4 * 8, np.uint32)  # 8 blocks
+    nonce = bytes(16)
+    with pytest.raises(ValueError, match="exceeds"):
+        native.ctr_requests_words([ctx], words, [(0, 6, 5, nonce)])
+    with pytest.raises(ValueError, match="exceeds"):
+        native.ctr_requests_words([ctx], words, [(0, -2, 4, nonce)])
+    with pytest.raises(ValueError, match="ctxs"):
+        native.ctr_requests_words([ctx], words, [(1, 0, 4, nonce)])
+    with pytest.raises(ValueError, match="blocks"):
+        native.ctr_scattered_words([ctx], words, np.zeros(4 * 7, np.uint32))
+    with pytest.raises(ValueError, match="entries"):
+        native.ctr_scattered_words([ctx], words,
+                                   np.zeros(4 * 8, np.uint32),
+                                   key_slots=np.zeros(7, np.uint32))
+
+
+def test_native_ctx_from_schedule_matches_setkey():
+    """The memmove key setup (aes_ctx_from_schedule) is ot_aes_setkey,
+    bit for bit, across key lengths — the serve key cache hands the
+    native tier HOST schedules, never raw key bytes."""
+    if not aes.native_runtime_available():
+        pytest.skip("native runtime unavailable")
+    from our_tree_tpu.runtime import native
+
+    rng = np.random.default_rng(41)
+    for nbytes in (16, 24, 32):
+        key = rng.integers(0, 256, nbytes, dtype=np.uint8).tobytes()
+        nr, rk = expand_key_enc(key)
+        ctx = native.aes_ctx_from_schedule(nr, np.asarray(rk, np.uint32))
+        ref = native.NativeAES(key)
+        assert ctx.nr == ref.ctx.nr
+        assert bytes(ctx.rk) == bytes(ref.ctx.rk)
+
+
 # ---------------------------------------------------------------------------
 # Key cache.
 # ---------------------------------------------------------------------------
@@ -183,6 +392,45 @@ def test_keycache_tenant_isolation():
         kc.get("bob", bytes([i]) * 16)
     assert kc.holds("alice", shared)
     assert kc.stats()["tenants"] == 2
+
+
+def test_keycache_stacked_memoized_per_digest_set():
+    """Steady-state batch formation does NO key-schedule work: the
+    stacked view is memoized per (digest set, K) — the second identical
+    batch shape gets the SAME object (row copies and native contexts
+    included), and the memo survives per-tenant LRU eviction because
+    digest -> schedule is a pure function."""
+    kc = keycache.KeyCache(per_tenant=1)
+    slots = [("t0", b"\x01" * 16), ("t1", b"\x02" * 16)]
+    s1 = kc.stacked(slots, 4)
+    assert s1.rks.shape == (4, 44) and s1.nr == 10
+    assert np.array_equal(s1.rks[0], expand_key_enc(b"\x01" * 16)[1])
+    assert not s1.rks[2].any() and not s1.rks[3].any()  # empty slots
+    s2 = kc.stacked(slots, 4)
+    assert s2 is s1  # the memo hit: zero assembly work
+    assert kc.stats()["stacked_hits"] == 1
+    # A different K is a different dispatch shape -> its own entry.
+    assert kc.stacked(slots, 2) is not s1
+    # Per-tenant eviction (capacity 1) must not corrupt the memo.
+    kc.get("t0", b"\x03" * 16)  # evicts t0's 0x01 entry
+    assert kc.stacked(slots, 4) is s1
+    # Guards: empty, over-K, and mixed key lengths are refused.
+    with pytest.raises(ValueError):
+        kc.stacked([], 4)
+    with pytest.raises(ValueError):
+        kc.stacked(slots, 1)
+    with pytest.raises(ValueError):
+        kc.stacked([("t0", b"\x01" * 16), ("t1", b"\x02" * 32)], 4)
+
+
+def test_keycache_stacked_lru_bounded():
+    kc = keycache.KeyCache(per_tenant=8, stacked_capacity=2)
+    mk = lambda i: [("t", bytes([i]) * 16)]  # noqa: E731
+    a, b = kc.stacked(mk(1), 2), kc.stacked(mk(2), 2)
+    kc.stacked(mk(3), 2)  # evicts the (1,) stack
+    assert kc.stacked(mk(2), 2) is b
+    assert kc.stacked(mk(1), 2) is not a
+    assert kc.stats()["stacked_entries"] == 2
 
 
 # ---------------------------------------------------------------------------
@@ -253,20 +501,111 @@ def test_form_batches_groups_and_packs():
     reqs = [req(0, "t0", ka, 10), req(1, "t1", ka, 4), req(2, "t0", ka, 30),
             req(3, "t0", kb, 100), req(4, "t0", ka, 120)]
     batches = batcher.form_batches(reqs, rungs, keycache.key_digest)
-    # t0/ka: 10+30 fits 64; +120 would pass the 128 ceiling -> second
-    # batch. t1/ka and t0/kb are their own groups (tenant AND key).
-    got = [(b.tenant, b.key, b.bucket, b.blocks, [r.id for r in b.requests])
+    # The rung-packer walks key groups in arrival order — (t0,ka),
+    # (t1,ka), (t0,kb) — packing up to K groups per batch and flushing
+    # at the 128-block ceiling: 10+30 fits; +120 would pass the ceiling
+    # -> flush; the 120 then SHARES its batch with t1/ka's 4 (the
+    # multi-key coalesce the old per-(tenant,key) batcher refused);
+    # t0/kb's 100 no longer fits 124+100 -> flush again.
+    got = [([(s.tenant, s.key, [r.id for r in s.requests])
+             for s in b.slots], b.bucket, b.blocks)
            for b in batches]
     assert got == [
-        ("t0", ka, 64, 40, [0, 2]),
-        ("t0", ka, 128, 120, [4]),
-        ("t1", ka, 32, 4, [1]),
-        ("t0", kb, 128, 100, [3]),
+        ([("t0", ka, [0, 2])], 64, 40),
+        ([("t0", ka, [4]), ("t1", ka, [1])], 128, 124),
+        ([("t0", kb, [3])], 128, 100),
     ]
     b0 = batches[0]
     b0.materialise()
     assert b0.words.shape == (4 * 64,) and b0.ctr_words.shape == (4 * 64,)
+    assert b0.slot_index.shape == (64,)
     assert b0.occupancy == 40 / 64
+    # The shared batch's slot vector maps each block to its key slot —
+    # 120 blocks of slot 0, 4 of slot 1, ceiling padding back on slot 0.
+    b1 = batches[1]
+    b1.materialise()
+    assert list(np.unique(b1.slot_index[:120])) == [0]
+    assert list(np.unique(b1.slot_index[120:124])) == [1]
+    assert list(np.unique(b1.slot_index[124:])) == [0]
+
+
+def test_form_batches_key_slots_one_restores_per_key_batches():
+    """K=1 degenerates to the pre-multikey coalescer: one key group per
+    batch, never shared."""
+    def req(rid, tenant, key, nblocks):
+        return otq.Request(id=rid, tenant=tenant, key=key, nonce=b"\0" * 16,
+                           payload=np.zeros(16 * nblocks, np.uint8),
+                           future=None)
+
+    rungs = batcher.bucket_ladder(32, 128)
+    reqs = [req(0, "t0", b"a" * 16, 8), req(1, "t1", b"b" * 16, 8),
+            req(2, "t2", b"c" * 16, 8)]
+    batches = batcher.form_batches(reqs, rungs, keycache.key_digest,
+                                   key_slots=1)
+    assert [len(b.slots) for b in batches] == [1, 1, 1]
+    # And the K-slot cap itself flushes: 3 groups, 2 slots -> 2 batches.
+    batches = batcher.form_batches(reqs, rungs, keycache.key_digest,
+                                   key_slots=2)
+    assert [len(b.slots) for b in batches] == [2, 1]
+
+
+def test_form_batches_never_mixes_key_lengths():
+    """nr is a static compile argument: a 128-bit and a 256-bit key
+    group may not share one dispatch, whatever K allows."""
+    def req(rid, tenant, key, nblocks):
+        return otq.Request(id=rid, tenant=tenant, key=key, nonce=b"\0" * 16,
+                           payload=np.zeros(16 * nblocks, np.uint8),
+                           future=None)
+
+    rungs = batcher.bucket_ladder(32, 128)
+    reqs = [req(0, "t0", b"a" * 16, 8), req(1, "t1", b"b" * 32, 8),
+            req(2, "t2", b"c" * 16, 8)]
+    batches = batcher.form_batches(reqs, rungs, keycache.key_digest)
+    assert [(b.nr, [s.tenant for s in b.slots]) for b in batches] == [
+        (10, ["t0"]), (14, ["t1"]), (10, ["t2"])]
+
+
+def test_split_output_isolates_tenants_and_keeps_fast_path_view():
+    """A shared batch's per-request outputs are PRIVATE copies — no
+    view whose ``.base`` windows the other slots' bytes (or the native
+    tier's rung padding) may leave the batcher — while the big-payload
+    fast path (one request exactly filling its rung) keeps the
+    zero-copy view the perf work bought."""
+    def req(rid, tenant, key, nblocks):
+        return otq.Request(id=rid, tenant=tenant, key=key, nonce=b"\0" * 16,
+                           payload=np.zeros(16 * nblocks, np.uint8),
+                           future=None)
+
+    rungs = batcher.bucket_ladder(32, 128)
+    shared, = batcher.form_batches(
+        [req(0, "t0", b"a" * 16, 10), req(1, "t1", b"b" * 16, 4)],
+        rungs, keycache.key_digest)
+    assert len(shared.requests) == 2
+    out = np.arange(4 * shared.bucket, dtype=np.uint32)
+    parts = shared.split_output(out)
+    for p in parts:
+        assert p.base is None or p.base.nbytes == p.nbytes, \
+            "partial split must not expose the shared dispatch buffer"
+        assert p.flags.writeable
+    assert np.array_equal(parts[0],
+                          packing.np_words_to_bytes(out[:40]))
+    assert np.array_equal(parts[1],
+                          packing.np_words_to_bytes(out[40:56]))
+    full, = batcher.form_batches([req(2, "t0", b"a" * 16, 64)],
+                                 rungs, keycache.key_digest)
+    view, = full.split_output(out[:4 * 64])
+    root = view
+    while root.base is not None:
+        root = root.base
+    assert root is out or root.nbytes == view.nbytes, \
+        "full-rung single request should stay zero-copy"
+    # A READ-ONLY dispatch buffer (jax-backed engine output) must still
+    # yield a writable payload — the response contract has always been
+    # caller-mutable bytes.
+    ro = out[:4 * 64].copy()
+    ro.setflags(write=False)
+    payload, = full.split_output(ro)
+    assert payload.flags.writeable
 
 
 # ---------------------------------------------------------------------------
@@ -332,6 +671,49 @@ def test_server_zero_recompiles_after_warmup():
 
     server, _ = _run_server(ServerConfig(**LADDER), drive)
     assert server.stats()["compiles"]["steady"] == 0
+
+
+def test_server_mixed_key_soak_zero_recompiles_and_coalesces():
+    """The multi-key acceptance soak, on a JAX engine (the path with a
+    compile cache to defend): 12 tenants with their own keys, small
+    requests, three rounds — every response bit-exact, ZERO post-warmup
+    compiles (the fixed-K stack + slot vector change VALUES per batch,
+    never shapes), and the rung-packer actually shares batches across
+    keys (slots per batch > 1 — the coalesce the old per-(tenant,key)
+    batcher could not do)."""
+    rng = np.random.default_rng(17)
+    tenants = [(f"t{i}", rng.integers(0, 256, 16, dtype=np.uint8).tobytes())
+               for i in range(12)]
+    # References precomputed BEFORE the server exists: a reference
+    # compile inside the drive would read as a phantom steady-state
+    # compile (the loadgen probe convention).
+    rounds = []
+    for _round in range(3):
+        cases = []
+        for tenant, key in tenants:
+            nonce = rng.integers(0, 256, 16, dtype=np.uint8).tobytes()
+            payload = rng.integers(0, 256, 16 * int(rng.integers(1, 9)),
+                                   dtype=np.uint8)
+            cases.append((tenant, key, nonce, payload,
+                          _ref_ctr(key, nonce, payload)))
+        rounds.append(cases)
+
+    async def drive(server):
+        for cases in rounds:
+            resps = await asyncio.gather(*(
+                server.submit(t, k, n, p) for t, k, n, p, _ in cases))
+            for (_t, _k, _n, _p, want), resp in zip(cases, resps):
+                assert resp.ok, resp
+                assert np.array_equal(np.asarray(resp.payload), want)
+        assert server.steady_compiles() == 0
+
+    server, _ = _run_server(
+        ServerConfig(engine="jnp", lanes=1, **LADDER), drive)
+    coal = server.coalesce_stats()
+    assert server.stats()["compiles"]["steady"] == 0
+    assert coal["slots_used"] > server.batches  # batches shared across keys
+    assert coal["efficiency"] > 0
+    assert server.keycache.stats()["stacked_misses"] <= coal["slots_used"]
 
 
 def _submit_n(server, n, size=256, tenant="t0", seed=5):
@@ -463,16 +845,18 @@ def test_server_traced_healthy_run_closes_every_span(traced):
     async def drive(server):
         return await asyncio.gather(*_submit_n(server, 6))
 
-    _run_server(ServerConfig(**LADDER), drive)
+    server, _ = _run_server(ServerConfig(**LADDER), drive)
     run = export.load_run(str(traced))
     assert not run.violations and not run.orphans()
     names = {s.name for s in run.spans.values()}
     assert {"serve-warmup", "lane-warmup", "request-queued",
             "batch-formed", "lane-dispatch"} <= names
     # Dispatch spans carry the engine AND lane attrs for the report's
-    # per-engine / per-lane device-time tables.
+    # per-engine / per-lane device-time tables ("auto" on this CPU
+    # container resolves to the native host tier — the attr must carry
+    # whatever actually served).
     disp = [s for s in run.spans.values() if s.name == "lane-dispatch"]
-    assert {s.attrs.get("engine") for s in disp} == {"jnp"}
+    assert {s.attrs.get("engine") for s in disp} == {server.engine}
     assert all(s.attrs.get("lane") is not None for s in disp)
 
 
@@ -739,13 +1123,14 @@ def test_lane_hang_scoped_shot_short_circuits_plain_pool(monkeypatch):
     monkeypatch.setenv("OT_HANG_S", "0")  # fire without wall time
     faults.reset()
     lane = lanes.LanePool(engine="jnp", lanes=1).lanes[0]
-    nr, rk = expand_key_enc(b"\x00" * 16)
+    sched = keycache.KeyCache().stacked([("t", b"\x00" * 16)], 1)
     words = np.zeros(4 * 32, dtype=np.uint32)
-    lane.engine_call(words, words, np.asarray(rk, np.uint32), nr, "t")
+    slots = np.zeros(32, dtype=np.uint32)
+    lane.engine_call(words, words, sched, slots, "t")
     assert faults.remaining(faults.scoped("lane_hang", 0)) == 0
     assert faults.remaining("lane_hang") == 1  # plain pool untouched
     # The next dispatch draws from the plain pool.
-    lane.engine_call(words, words, np.asarray(rk, np.uint32), nr, "t2")
+    lane.engine_call(words, words, sched, slots, "t2")
     assert faults.remaining("lane_hang") == 0
 
 
@@ -780,9 +1165,8 @@ def test_journal_quarantined_lane_never_pins_the_canary(
 
     real = lanes.Lane.engine_call
 
-    def corrupt_on_lane0(self, words, ctr_words, rk, nr, label,
-                         warmup=False):
-        out = real(self, words, ctr_words, rk, nr, label, warmup=warmup)
+    def corrupt_on_lane0(self, *args, **kwargs):
+        out = real(self, *args, **kwargs)
         return out ^ np.uint32(1) if self.idx == 0 else out
 
     monkeypatch.setattr(lanes.Lane, "engine_call", corrupt_on_lane0)
