@@ -150,6 +150,13 @@ _DISPATCH_ATTRS = ("block_until_ready", "device_put")
 #: Seam files where the raw call IS the guarded chokepoint (the barrier
 #: carries the fault + injected-hang seam itself).
 _DISPATCH_SEAM_FILES = ("harness/backends.py",)
+#: The lane-executor module: the one place serve/ may run device work
+#: off the main thread. Its worker invokes the submitted unit — and the
+#: main-thread SIGALRM delivery cannot reach a worker thread, so the
+#: invocation is legal ONLY inside the thread-kill-hook guard that gives
+#: the watchdog its off-main kill path (fail the future, abandon the
+#: worker).
+_EXECUTOR_FILES = ("serve/dispatch.py",)
 
 
 def _is_guard_cm(expr: ast.AST) -> bool:
@@ -163,9 +170,43 @@ def _is_guard_cm(expr: ast.AST) -> bool:
     return (tail == "deadline" or "alarm" in tail or "deadline" in tail)
 
 
+def _is_kill_hook_cm(expr: ast.AST) -> bool:
+    """A `with` context expression registering the worker thread's
+    watchdog kill path (``watchdog.thread_kill_hook(...)``)."""
+    if not isinstance(expr, ast.Call):
+        return False
+    tail = _dotted(expr.func).rsplit(".", 1)[-1]
+    return "kill_hook" in tail
+
+
 def _check_dispatch(ctx: FileContext):
     if ctx.is_file(*_DISPATCH_SEAM_FILES):
         return
+    if ctx.is_file(*_EXECUTOR_FILES):
+        # The worker seam: a device call runs off the main thread here,
+        # where SIGALRM delivery cannot interrupt it — the submitted
+        # unit may only be invoked under the thread-kill-hook guard
+        # (the expiry path that fails the dispatch future and abandons
+        # the wedged worker). An unguarded unit() is a hang with no
+        # kill path and no evidence.
+        def visit_exec(node, hooked):
+            if isinstance(node, ast.With):
+                if any(_is_kill_hook_cm(item.context_expr)
+                       for item in node.items):
+                    hooked = True
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "unit" and not hooked):
+                yield node, (
+                    "worker-thread `unit()` invocation outside the "
+                    "`watchdog.thread_kill_hook` guard: a deadline armed "
+                    "inside the unit would expire with no delivery path "
+                    "— the waiter blocks forever and the hang leaves no "
+                    "kill evidence")
+            for child in ast.iter_child_nodes(node):
+                yield from visit_exec(child, hooked)
+
+        yield from visit_exec(ctx.tree, False)
 
     def visit(node, guarded):
         if isinstance(node, ast.With):
@@ -373,13 +414,29 @@ _SERVE_DISPATCH_TAILS = ("ctr_crypt_words_scattered",
 def _check_serve_lane(ctx: FileContext):
     if not ctx.in_dir("serve", "our_tree_tpu/serve"):
         return
-    if ctx.is_file("serve/lanes.py"):
-        return
+    in_seam = ctx.is_file("serve/lanes.py")
+    in_executor = ctx.is_file(*_EXECUTOR_FILES)
     for node in ast.walk(ctx.tree):
         if not isinstance(node, ast.Call):
             continue
         name = _dotted(node.func)
         tail = name.rsplit(".", 1)[-1]
+        # Worker threads in serve/ exist ONLY inside the lane executor:
+        # a thread spawned anywhere else carries device work (or work
+        # that fans into it) outside the guarded entry point — no
+        # thread-kill hook, no abandoned-worker accounting, no deadline
+        # delivery. This applies to lanes.py too: the seam file owns
+        # the DEVICE contact, the executor owns the THREADS.
+        if tail == "Thread" and not in_executor:
+            yield node, (
+                f"`{name}()` spawns a worker thread in serve/ outside "
+                "the lane executor (serve/dispatch.py): off-main device "
+                "work is legal only on an executor worker, whose "
+                "thread-kill hook gives the watchdog a delivery path "
+                "(fail the future, abandon the worker)")
+            continue
+        if in_seam:
+            continue
         if tail in _SERVE_DISPATCH_TAILS:
             yield node, (
                 f"`{name}()` dispatches to a device from serve/ outside "
@@ -395,7 +452,9 @@ RULES: tuple[Rule, ...] = (
          _check_subprocess),
     Rule("dispatch-watchdog", "error",
          "Raw jax device dispatch (block_until_ready / device_put) only "
-         "inside a watchdog.deadline guard or the harness barrier seam.",
+         "inside a watchdog.deadline guard or the harness barrier seam; "
+         "the lane executor's worker may invoke its unit only under the "
+         "watchdog.thread_kill_hook guard (the off-main delivery path).",
          _check_dispatch),
     Rule("degrade-chokepoint", "error",
          "Demotions only through resilience.degrade(): no private-ledger "
@@ -418,7 +477,8 @@ RULES: tuple[Rule, ...] = (
          "Dispatch in serve/ (scattered-CTR calls incl. the multi-key "
          "seam, the native host tier, block_until_ready, device_put) "
          "only inside serve/lanes.py — the lane seam owns deadlines, "
-         "health, and failover.",
+         "health, and failover; worker threads in serve/ exist only "
+         "inside the lane executor (serve/dispatch.py).",
          _check_serve_lane),
 )
 

@@ -32,8 +32,11 @@ the trace-side answer to "which engine did this run actually spend its
 device time in" that the probe's stderr GB/s lines only hint at. A
 serve run's ``lane-dispatch``/``lane-probe`` spans (which carry a
 ``lane`` attr) additionally get a per-LANE table — dispatches, canary
-probes, device time, and kills per fault domain, with an orphaned lane
-span counted as the kill it is (docs/SERVING.md).
+probes, device time, busy-fraction (per-lane occupancy: device time
+over run wall), and kills per fault domain, with an orphaned lane span
+counted as the kill it is (docs/SERVING.md) — plus a ``serve overlap``
+line reconstructing the ``serve_inflight`` gauge (the measured max
+dispatch concurrency) against a peak-concurrent-lane-spans sweep.
 
 ``<run-dir>`` is ``$OT_TRACE_DIR/<run-id>``; passing ``$OT_TRACE_DIR``
 itself picks the newest run inside it (and says so).
@@ -268,9 +271,41 @@ def render(run: export.Run, top: int = 10, out=sys.stdout,
         out.write("\nper-lane device time (serve):\n")
         _table([[k, str(lane_count.get(k, 0)),
                  str(lane_probes.get(k, 0)), _s(lane_time.get(k, 0)),
+                 (f"{lane_time.get(k, 0) / wall:.0%}" if wall else "-"),
                  str(lane_kills.get(k, 0))]
                 for k in lane_keys],
-               ["lane", "dispatches", "probes", "device_s", "killed"], out)
+               ["lane", "dispatches", "probes", "device_s", "busy",
+                "killed"], out)
+
+    # -- serve overlap: the in-flight gauge, reconstructed -----------------
+    # The lane pool emits a `serve_inflight` gauge event on every
+    # TRAFFIC-dispatch lane window (serve/lanes.py:_inflight — canary
+    # probes are excluded: they bypass the server's in-flight cap, so
+    # counting them would let a serialized control run read as
+    # overlapped); its max over the run is the measured dispatch
+    # concurrency — the number the overlapped lane executors exist to
+    # push past 1, and the one `serve.bench --min-inflight` gates. The
+    # lane-SPAN sweep is the independent cross-check over the SAME
+    # population (lane-dispatch spans only): peak simultaneous open
+    # spans, orphans counted in flight until the end of the run (a
+    # wedged dispatch WAS occupying its lane while it hung).
+    inflight = [e for e in run.events
+                if e["ev"] == "g" and e["name"] == "serve_inflight"]
+    if inflight:
+        peak_gauge = int(max(e.get("value", 0) for e in inflight))
+        edges: list[tuple[int, int]] = []
+        for sp in run.spans.values():
+            if sp.name != "lane-dispatch":
+                continue
+            edges.append((sp.ts, 1))
+            edges.append((run_end if sp.end_ts is None else sp.end_ts, -1))
+        live = peak_spans = 0
+        for _, d in sorted(edges):
+            live += d
+            peak_spans = max(peak_spans, live)
+        out.write(f"\nserve overlap: max in-flight {peak_gauge} "
+                  f"(gauge, {len(inflight)} samples), peak concurrent "
+                  f"lane spans {peak_spans}\n")
 
     # -- faults: injected vs observed --------------------------------------
     injected: dict[str, int] = {}

@@ -48,6 +48,30 @@ from ..ops.pallas_aes import interpret_mode as _pallas_interpret
 AXIS = "shards"
 
 
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` with a version-compat fallback.
+
+    ``jax.shard_map`` became a top-level API (with ``check_vma``) only
+    in newer jax; older runtimes (this CPU container ships 0.4.x) carry
+    the same transform as ``jax.experimental.shard_map.shard_map`` with
+    the check spelled ``check_rep`` (the replication checker that
+    predates the varying-manual-axes rename). Every sharded kernel here
+    routes through this one shim so the module runs on both: new jax
+    takes the top-level path untouched; old jax maps ``check_vma`` onto
+    ``check_rep``. The ``_vma_drop_bug`` probe composes with either —
+    it classifies by error MESSAGE, and an old-jax checker that cannot
+    handle a traced body (e.g. pallas_call, which the experimental
+    checker has no replication rule for) reads as "check unusable
+    here", disabling it exactly like the probed interpreter bug.
+    """
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _sm
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=check_vma)
+
+
 @functools.lru_cache(None)
 def _vma_drop_bug() -> bool:
     """Probe (once per process) for the pallas-INTERPRETER vma drop.
@@ -82,7 +106,7 @@ def _vma_drop_bug() -> bool:
             "un-jitted wrapper and pass the result as a static argument."
         )
     probe_axis = "_vma_probe"
-    f = jax.shard_map(
+    f = shard_map(
         functools.partial(_ecb_shard_body, nr=10, encrypt=True,
                           engine="pallas"),
         mesh=Mesh(np.asarray(jax.devices()[:1]), (probe_axis,)),
@@ -94,7 +118,13 @@ def _vma_drop_bug() -> bool:
         f(jnp.zeros((32, 4), jnp.uint32), jnp.zeros((11, 4), jnp.uint32))
         return False
     except Exception as e:  # noqa: BLE001 — classified by message below
-        return "varying manual axes" in str(e)
+        # Two documented "the checker, not the kernel, is broken" shapes:
+        # the 0.9.0 interpreter vma drop, and old jax's experimental
+        # check_rep having no replication rule for pallas_call at all
+        # (the compat shim maps check_vma onto it). Anything else keeps
+        # the check ON so the real path fails loudly.
+        return ("varying manual axes" in str(e)
+                or "No replication rule" in str(e))
 
 
 def _shard_check_vma(engine: str) -> bool:
@@ -201,7 +231,7 @@ def _ctr_sharded_jit(words, ctr_be, rk, *, nr, mesh, axis, engine="jnp",
     # trace time (models/aes.py:_engine_knobs_key — ADVICE r4 #1 applies
     # to the sharded paths too).
     del knobs
-    f = jax.shard_map(
+    f = shard_map(
         functools.partial(_ctr_shard_body, nr=nr, axis=axis, engine=engine),
         mesh=mesh,
         in_specs=(P(axis), P(), P()),
@@ -247,7 +277,7 @@ def _ecb_shard_body(words, rk, nr, encrypt, engine="jnp"):
 def _ecb_sharded_jit(words, rk, *, nr, encrypt, mesh, axis, engine="jnp",
                      check_vma=True, knobs=None):
     del knobs  # compile-cache key only (see _ctr_sharded_jit)
-    f = jax.shard_map(
+    f = shard_map(
         functools.partial(_ecb_shard_body, nr=nr, encrypt=encrypt, engine=engine),
         mesh=mesh,
         in_specs=(P(axis), P()),
@@ -275,7 +305,7 @@ def ecb_crypt_sharded(words, rk, nr, mesh: Mesh, encrypt: bool = True,
 
 @functools.partial(jax.jit, static_argnames=("mesh", "axis"))
 def _xor_sharded_jit(data, ks, *, mesh, axis):
-    f = jax.shard_map(
+    f = shard_map(
         jnp.bitwise_xor, mesh=mesh, in_specs=(P(axis), P(axis)), out_specs=P(axis)
     )
     return f(data, ks)
@@ -301,7 +331,7 @@ def gather_for_verification(x, mesh: Mesh, axis: str = AXIS):
     """Optional all_gather so a host can bit-compare the full output — the
     lone collective, used only by tests (SURVEY.md §2: verification gather)."""
     padded, n = _pad_blocks(x, mesh.devices.size)
-    f = jax.shard_map(
+    f = shard_map(
         lambda s: jax.lax.all_gather(s, axis, tiled=True),
         mesh=mesh, in_specs=P(axis), out_specs=P(),
         check_vma=False,  # all_gather output is replicated; not inferred
@@ -350,7 +380,7 @@ def block_cyclic_to_contiguous(x, mesh: Mesh, axis: str = AXIS):
         out = jnp.swapaxes(recv, 0, 1).reshape((n // S,) + local.shape[1:])
         return out
 
-    f = jax.shard_map(body, mesh=mesh, in_specs=P(axis), out_specs=P(axis))
+    f = shard_map(body, mesh=mesh, in_specs=P(axis), out_specs=P(axis))
     return f(x)
 
 
@@ -404,7 +434,7 @@ def _chained_dec_sharded_jit(words, iv, rk, *, nr, mesh, axis, engine, mode,
         prev = _halo_prev_stream(words, iv, axis, mesh.shape[axis])
         return combine(words, prev, rk, nr, engine)
 
-    f = jax.shard_map(
+    f = shard_map(
         body, mesh=mesh, in_specs=(P(axis), P(), P()), out_specs=P(axis),
         # same pallas-interpreter vma drop as _ctr_sharded_jit: the halo
         # decrypt routes the per-shard bulk through CORES[engine], so a
@@ -441,7 +471,7 @@ def _chained_dec_sharded(words, iv_words, rk, nr, mesh, axis, engine, mode):
 def _cbc_batch_sharded_jit(words, ivs, rk, *, nr, mesh, axis, engine,
                            check_vma, knobs):
     del knobs  # compile-cache key only (models/aes.py:_engine_knobs_key)
-    f = jax.shard_map(
+    f = shard_map(
         lambda w, iv, k: cbc_encrypt_words_batch(w, iv, k, nr, engine),
         mesh=mesh, in_specs=(P(axis), P(axis), P()),
         out_specs=(P(axis), P(axis)),
@@ -478,7 +508,7 @@ def _arc4_batch_sharded_jit(xs, ys, ms, *, length, mesh, axis):
         (nx, ny, nm), ks = keystream_scan_batch((x, y, m), length)
         return nx, ny, nm, ks
 
-    f = jax.shard_map(
+    f = shard_map(
         body, mesh=mesh, in_specs=(P(axis), P(axis), P(axis)),
         out_specs=(P(axis), P(axis), P(axis), P(axis)),
     )

@@ -241,6 +241,39 @@ class _Scheduler:
 
 _SCHEDULER = _Scheduler()
 
+#: thread ident -> kill hook (``thread_kill_hook``). A deadline armed on
+#: a thread with a registered hook delivers its expiry BY CALLING the
+#: hook with the built ``DispatchTimeout`` instead of the main-thread
+#: SIGALRM raise — the worker-thread watchdog contract (serve's lane
+#: executors): the waiter holding the unit's future is unblocked at the
+#: deadline while the wedged thread itself is abandoned as evidence.
+_THREAD_KILLS: dict[int, object] = {}
+
+
+@contextlib.contextmanager
+def thread_kill_hook(hook):
+    """Register ``hook(exc)`` as THIS thread's watchdog kill path.
+
+    While registered, any ``deadline`` armed on this thread that expires
+    calls ``hook(DispatchTimeout(...))`` from the expiry thread (after
+    the stack dump and the degrade stamp) — the off-main twin of the
+    SIGALRM delivery. The hook must be quick and must not raise into the
+    guarded call's thread (it runs on the watchdog's fire thread):
+    serve's lane executor uses it to fail the dispatch future and
+    abandon the wedged worker. Nests: the previous hook is restored on
+    exit (the innermost registration owns deadlines armed inside it).
+    """
+    ident = threading.get_ident()
+    prev = _THREAD_KILLS.get(ident)
+    _THREAD_KILLS[ident] = hook
+    try:
+        yield
+    finally:
+        if prev is None:
+            _THREAD_KILLS.pop(ident, None)
+        else:
+            _THREAD_KILLS[ident] = prev
+
 
 @contextlib.contextmanager
 def deadline(seconds: float | None, what: str = "device dispatch",
@@ -268,6 +301,11 @@ def deadline(seconds: float | None, what: str = "device dispatch",
         t.point("watchdog-arm", what=what, seconds=seconds)
     on_main = (threading.current_thread() is threading.main_thread()
                and hasattr(signal, "SIGALRM"))
+    # Captured at ARM time: the hook registered for the arming thread
+    # (serve's lane-executor worker), if any — the expiry delivery path
+    # when SIGALRM-to-main cannot reach the guarded call.
+    kill_hook = (None if on_main
+                 else _THREAD_KILLS.get(threading.get_ident()))
     fired: dict = {}
     done = threading.Event()
     # Serialises the kill decision against handler restore: the signal
@@ -291,6 +329,16 @@ def deadline(seconds: float | None, what: str = "device dispatch",
                     signal.pthread_kill(threading.main_thread().ident,
                                         signal.SIGALRM)
                 except (OSError, RuntimeError):
+                    pass
+            elif kill_hook is not None:
+                # Worker-thread delivery: the wedged call cannot be
+                # interrupted, but its WAITER can be unblocked — hand
+                # the built timeout (degrade stamp + trace point ride
+                # it, same as the raise path) to the registered hook.
+                fired["delivered"] = True
+                try:
+                    kill_hook(_record_and_build())
+                except Exception:  # noqa: BLE001 - the hook is not ours
                     pass
 
     def _record_and_build():
@@ -321,8 +369,14 @@ def deadline(seconds: float | None, what: str = "device dispatch",
         yield
         # A hang the guard could NOT interrupt (off-main, GIL-held) that
         # nevertheless returned after expiry: surface the miss rather
-        # than silently continuing past an expired deadline.
+        # than silently continuing past an expired deadline. When the
+        # kill hook already DELIVERED the built timeout (the lane
+        # executor failed the dispatch future at the deadline), the
+        # degrade stamp and trace point are already on record — the
+        # late-waking worker re-raises without stamping twice.
         if "report" in fired and not on_main:
+            if fired.get("delivered"):
+                raise DispatchTimeout(what, seconds, fired.get("report"))
             raise _record_and_build()
     finally:
         try:

@@ -91,14 +91,16 @@ async def _drive(args, probes):
         retries=args.retries,
         lanes=args.lanes,
         probe_every=args.probe_every,
-        journal=args.journal)
+        journal=args.journal,
+        max_inflight=args.max_inflight)
     server = Server(cfg)
     await server.start()
     report = await loadgen.run(
         server, args.requests, concurrency=args.concurrency,
         sizes=args.sizes, tenants=args.tenants,
         keys_per_tenant=args.keys_per_tenant, seed=args.seed,
-        verify_every=args.verify_every, probes=probes)
+        verify_every=args.verify_every, probes=probes,
+        arrival_rate=args.arrival_rate)
     await server.stop()
     return server, report
 
@@ -107,11 +109,15 @@ def _lane_summary(stats: dict, wall_s: float) -> dict:
     """The artifact's ``lanes`` section: pool aggregates plus per-lane
     goodput (dispatched bytes over the run's wall — the placement
     evidence the ISSUE's "batches placed across >= 2 lanes" gate
-    reads)."""
+    reads) and busy-fraction (in-flight wall time over run wall — the
+    overlap evidence: fractions summing well past 1.0 across lanes is
+    what "dispatches actually overlapped" looks like per device)."""
     pool = dict(stats["lanes"])
     for row in pool.get("per_lane", []):
         row["goodput_gbps"] = (round(row["bytes"] / 1e9 / wall_s, 4)
                                if wall_s > 0 else 0.0)
+        row["busy_fraction"] = (round(row["busy_s"] / wall_s, 4)
+                                if wall_s > 0 else 0.0)
     return pool
 
 
@@ -121,6 +127,24 @@ def main(argv=None) -> int:
         description="closed-loop serving benchmark (docs/SERVING.md)")
     ap.add_argument("--requests", type=int, default=500)
     ap.add_argument("--concurrency", type=int, default=32)
+    ap.add_argument("--arrival-rate", type=float, default=None,
+                    metavar="REQ_PER_S",
+                    help="open-loop mode: submit requests at this fixed "
+                         "rate regardless of service rate (outstanding "
+                         "unbounded; --concurrency is ignored). Closed "
+                         "loop with few clients self-throttles to the "
+                         "service rate and cannot expose overlap gains — "
+                         "this is the saturation run's offered-load knob")
+    ap.add_argument("--max-inflight", type=int, default=None, metavar="N",
+                    help="dispatches in flight at once across the lane "
+                         "pool (default: one per lane — full overlap; "
+                         "1 = the serialized pre-overlap control run)")
+    ap.add_argument("--min-inflight", type=int, default=None, metavar="N",
+                    help="fail (exit 1) if the measured max in-flight "
+                         "concurrency ends below N — the overlap gate: "
+                         "a multi-lane run whose dispatches never "
+                         "overlapped (max_inflight 1) is serialized "
+                         "serving wearing lanes")
     ap.add_argument("--mixed-sizes", action="store_true",
                     help=f"request sizes drawn from {loadgen.MIXED_SIZES} "
                          "(the ladder-exercising menu)")
@@ -227,9 +251,17 @@ def main(argv=None) -> int:
     lanes = _lane_summary(stats, report.wall_s)
     lost = stats["queue"]["lost"]
 
+    overlap = stats["overlap"]
+    loop_desc = (f"open-loop {args.arrival_rate:g}/s"
+                 if args.arrival_rate else
+                 f"concurrency={args.concurrency}")
     print(f"# serve: engine={stats['engine']} ladder={stats['rungs']} "
-          f"lanes={lanes['count']} concurrency={args.concurrency} "
+          f"lanes={lanes['count']} {loop_desc} "
           f"tenants={args.tenants}")
+    print(f"# overlap: max_inflight={overlap['max_inflight']} "
+          f"(limit {overlap['inflight_limit']}) lane busy-fractions "
+          + " ".join(f"{row['busy_fraction']:.2f}"
+                     for row in lanes["per_lane"]))
     print(f"# requests={report.requests} ok={report.ok} "
           f"errors={report.errors or '{}'} lost={lost} "
           f"verified={report.verified} mismatches={report.mismatches}")
@@ -270,9 +302,12 @@ def main(argv=None) -> int:
             "retries": args.retries,
             "dispatch_deadline_s": args.dispatch_deadline,
             "lanes": lanes["count"], "probe_every": args.probe_every,
+            "max_inflight": args.max_inflight,
+            "arrival_rate": args.arrival_rate,
             "seed": args.seed,
         },
         "load": report.to_json(),
+        "overlap": overlap,
         "coalesce": coal,
         "batches": {k: stats[k] for k in
                     ("batches", "batches_failed", "batches_timed_out")},
@@ -299,6 +334,8 @@ def main(argv=None) -> int:
             "p99_ms": report.p99_ms,
             "goodput_gbps": round(report.goodput_gbps, 4),
             "coalesce_efficiency": coal["efficiency"],
+            "max_inflight": overlap["max_inflight"],
+            "inflight_limit": overlap["inflight_limit"],
             "batches": stats["batches"],
             "lanes": lanes["count"],
             "lanes_used": lanes["placed_across"],
@@ -333,6 +370,14 @@ def main(argv=None) -> int:
               f"{args.min_coalesce} — the rung-packer is fragmenting "
               "(key groups not sharing batches, or padding dominating)",
               file=sys.stderr)
+        rc = 1
+    if (args.min_inflight is not None
+            and overlap["max_inflight"] < args.min_inflight):
+        print(f"# FAIL: max in-flight concurrency "
+              f"{overlap['max_inflight']} < {args.min_inflight} — "
+              "dispatches never overlapped: a multi-lane run serialized "
+              "behind one dispatch at a time (the pre-overlap behaviour "
+              "the lane executors exist to end)", file=sys.stderr)
         rc = 1
     return rc
 

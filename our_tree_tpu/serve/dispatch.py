@@ -1,0 +1,176 @@
+"""Per-lane dispatch executors: the worker seam under overlapped serving.
+
+The paper's whole decomposition — independent chunks crypted
+concurrently (``aes-modes/test.c:33-35``) — only pays at the lane level
+if more than one lane can be *in flight* at once. PR 6's lanes were
+fault domains with main-thread dispatch (one device busy at a time, the
+watchdog's SIGALRM contract); this module is the throughput half: each
+lane owns ONE worker thread, the batcher loop submits engine calls and
+keeps forming batches while up to ``--max-inflight`` dispatches run
+concurrently, and completions feed back into the asyncio loop as
+futures.
+
+The watchdog contract moves with the dispatch. On the main thread a
+deadline expiry delivers ``DispatchTimeout`` via SIGALRM; a worker
+thread cannot be signalled that way (CPython runs signal handlers on
+the main thread only), and a genuinely wedged device call cannot be
+interrupted in-process at all. So the kill path here is **fail the
+future, abandon the thread**: the executor registers a per-thread kill
+hook (``watchdog.thread_kill_hook``) around every unit it runs, and
+when that unit's ``watchdog.deadline`` — armed inside
+``Lane.engine_call`` exactly as on the main thread, multiplexed by the
+watchdog's per-entry-thread scheduler — expires, the expiry thread
+dumps all stacks, stamps the degrade ledger, fails the unit's future
+with ``DispatchTimeout`` (the asyncio waiter proceeds to failover
+immediately), and this executor marks its worker ABANDONED. The wedged
+thread is left behind as kill evidence (its ``lane-dispatch`` span
+stays orphaned — the same convention as a SIGKILLed sweep child); a
+fresh worker is spawned lazily on the lane's next use (the canary probe
+that would release the lane needs a live thread). If the abandoned
+thread ever wakes, it notices its generation is stale, discards its
+result, and exits — it never races the replacement for the lane's
+device.
+
+otlint enforces the seam shape (``serve-lane-seam`` /
+``dispatch-watchdog``, docs/ANALYSIS.md): worker threads in ``serve/``
+exist only here, and the executor's unit invocation (``unit()``) is
+legal only inside the ``watchdog.thread_kill_hook`` guard — a worker
+dispatch with no kill path is a hang with no evidence.
+
+Stdlib-only: the device contact stays in ``serve/lanes.py``
+(``Lane.engine_call``); this module only runs callables on a guarded
+thread.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import queue as _queue
+import threading
+
+from ..resilience import watchdog
+
+
+def _resolve(fut: concurrent.futures.Future, result=None, exc=None) -> None:
+    """Settle ``fut`` from whichever side got there first: the worker
+    completing or the watchdog kill path failing it. The loser's write
+    is discarded (the future's internal lock arbitrates)."""
+    try:
+        if exc is not None:
+            fut.set_exception(exc)
+        else:
+            fut.set_result(result)
+    except concurrent.futures.InvalidStateError:
+        pass  # already settled by the other side
+
+
+class LaneExecutor:
+    """One worker thread running one lane's engine calls in FIFO order.
+
+    ``submit(unit)`` returns a ``concurrent.futures.Future`` the asyncio
+    side awaits via ``asyncio.wrap_future``. The worker is spawned
+    lazily and replaced after a kill (``abandoned`` counts the wedged
+    threads left behind). ``close()`` ends an idle worker; a wedged one
+    is already abandoned and exits on wake via its stale generation.
+    """
+
+    def __init__(self, name: str):
+        self._name = name
+        self._lock = threading.Lock()
+        self._gen = 0
+        self._q: _queue.SimpleQueue | None = None
+        self._thread: threading.Thread | None = None
+        self.abandoned = 0
+
+    def submit(self, unit) -> concurrent.futures.Future:
+        """Queue one callable for the worker; spawns/replaces the worker
+        if none is live (first use, post-kill, or post-close)."""
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._gen += 1
+                self._q = _queue.SimpleQueue()
+                self._thread = threading.Thread(
+                    target=self._run, args=(self._gen, self._q),
+                    daemon=True, name=self._name)
+                self._thread.start()
+            self._q.put((fut, unit))
+        return fut
+
+    def close(self) -> None:
+        """Stop the current worker after its queued work (idempotent).
+        An abandoned (wedged) worker needs no stop — it exits on wake."""
+        with self._lock:
+            if self._q is not None and self._thread is not None \
+                    and self._thread.is_alive():
+                self._q.put(None)
+            self._thread = None
+            self._q = None
+
+    # -- the worker ---------------------------------------------------------
+    def _run(self, gen: int, q: _queue.SimpleQueue) -> None:
+        while True:
+            item = q.get()
+            if item is None:
+                return  # close(): drained and dismissed
+            fut, unit = item
+            if not fut.set_running_or_notify_cancel():
+                continue
+            # The kill path: when a watchdog.deadline armed INSIDE this
+            # unit (Lane.engine_call) expires, the expiry thread calls
+            # the hook — fail the future, mark this worker abandoned —
+            # instead of the main-thread SIGALRM delivery.
+            def kill(exc, fut=fut):
+                self._abandon(gen)
+                _resolve(fut, exc=exc)
+
+            with watchdog.thread_kill_hook(kill):
+                try:
+                    result = unit()
+                except BaseException as e:  # noqa: BLE001 - future carries it
+                    _resolve(fut, exc=e)
+                else:
+                    _resolve(fut, result=result)
+            with self._lock:
+                stale = self._gen != gen
+            if stale:
+                # Retired mid-call (the kill path fired, or close() +
+                # submit replaced this worker) but the call returned
+                # after all: a fresh worker owns the lane now — fail
+                # anything still queued HERE (nobody else will ever
+                # read this queue) and leave, never double-serving.
+                self._fail_pending(q, "worker retired")
+                return
+
+    def _fail_pending(self, q: _queue.SimpleQueue | None, why: str) -> None:
+        """Fail every (fut, unit) still queued on a retired queue: the
+        units never ran, so their deadlines never armed and no watchdog
+        will ever unblock their waiters — a stranded future would block
+        forever. close() sentinels are skipped."""
+        while q is not None:
+            try:
+                item = q.get_nowait()
+            except _queue.Empty:
+                return
+            if item is None:
+                continue  # a close() sentinel
+            _resolve(item[0], exc=RuntimeError(
+                f"{self._name}: {why} before this unit ran"))
+
+    def _abandon(self, gen: int) -> None:
+        """Retire generation ``gen``'s worker (watchdog kill path): the
+        next submit spawns a replacement; the wedged thread's eventual
+        wake sees the stale generation, fails anything still queued on
+        its retired queue, and exits. Units queued behind the wedged one
+        are also failed here (the wedged thread may never wake). Today
+        the lane pool holds one batch per lane so the queue depth is 1,
+        but the executor's FIFO contract must not depend on that distant
+        discipline."""
+        with self._lock:
+            if self._gen != gen:
+                return
+            self._gen += 1
+            self._thread = None
+            q, self._q = self._q, None
+            self.abandoned += 1
+        self._fail_pending(q, "worker abandoned (watchdog kill)")

@@ -43,14 +43,31 @@ are canary-probed as a last resort before the batch is failed — a
 single-lane server therefore self-heals after a transient hang instead
 of bricking.
 
-Dispatch stays synchronous on the main thread on purpose: that is the
-watchdog's SIGALRM contract (resilience/watchdog.py), and containment —
-not overlap — is this layer's job. Overlapped per-lane dispatch rides
-on top of this seam (ROADMAP: fast serving arc).
+Dispatch is OVERLAPPED: each lane owns a worker-thread executor
+(``serve/dispatch.py``), ``LanePool.dispatch`` is an awaitable that
+submits the guarded engine call to the placed lane's worker and yields
+the event loop until the lane completes — so the batcher keeps forming
+and placing batches while up to ``--max-inflight`` dispatches are in
+flight across lanes (the paper's ``length/num_threads`` decomposition
+finally applied ACROSS devices, not just within one). The watchdog
+contract moved with it: a deadline armed on a worker thread delivers
+its expiry through ``watchdog.thread_kill_hook`` — fail the dispatch
+future, abandon the wedged worker — instead of the main-thread SIGALRM
+raise, so failover still begins AT the deadline while the hung thread
+is left as kill evidence. Every lane-seam property holds under
+overlap: placement counts in-flight work (a lane with a batch in
+flight is at capacity — one batch per lane, a device serializes its
+own work anyway), failover re-dispatches bit-exactly before any rider
+errors, a hung dispatch still abandons its ``lane-dispatch`` span, and
+graceful drain awaits every in-flight batch. The synchronous
+``probe_lane`` (main-thread SIGALRM path) remains for rehearsals and
+single-shot tools.
 """
 
 from __future__ import annotations
 
+import asyncio
+import collections
 import time
 
 import jax
@@ -60,6 +77,7 @@ from ..models import aes
 from ..obs import trace
 from ..resilience import degrade, faults, watchdog
 from ..resilience.policy import RetryPolicy
+from .dispatch import LaneExecutor
 
 #: Health states. RELEASED appears in transition logs (the moment a
 #: lane finishes probation) and immediately rests as HEALTHY.
@@ -71,6 +89,13 @@ RELEASED = "released"
 
 #: States that may receive traffic.
 PLACEABLE = (HEALTHY, SUSPECT, PROBATION)
+
+
+#: The pinned canary batch (set_canary): inputs, the expected bit-exact
+#: output, and the rung it was shaped at — named fields, so the probe
+#: helpers read .expected/.bucket instead of magic tuple indices.
+_Canary = collections.namedtuple(
+    "_Canary", "words ctr_words sched key_slots expected bucket")
 
 
 def lane_unit(idx: int) -> str:
@@ -120,8 +145,25 @@ class Lane:
         self.canaries = 0
         self.probation_left = 0
         self.transitions: list[dict] = []
+        #: overlap state: batches currently in flight on this lane
+        #: (capacity is ONE — a device serializes its own work, so a
+        #: busy lane is simply not placeable) and cumulative busy wall
+        #: time (the bench's per-lane busy-fraction numerator).
+        self.inflight = 0
+        self.busy_us = 0
+        self.executor: LaneExecutor | None = None
         self._clock = clock
         self._t0 = clock()
+
+    def run_async(self, unit) -> asyncio.Future:
+        """Submit ``unit`` (a zero-arg callable wrapping this lane's
+        guarded ``engine_call``) to the lane's worker executor; returns
+        an awaitable future. The executor is created on first use and
+        replaced automatically after a watchdog kill abandoned its
+        worker (serve/dispatch.py)."""
+        if self.executor is None:
+            self.executor = LaneExecutor(f"ot-lane{self.idx}")
+        return asyncio.wrap_future(self.executor.submit(unit))
 
     # -- state machine -----------------------------------------------------
     def _to(self, new: str, why: str) -> None:
@@ -255,6 +297,9 @@ class Lane:
             "timeouts": self.timeouts,
             "redispatches_in": self.redispatches_in,
             "canaries": self.canaries,
+            "busy_s": round(self.busy_us / 1e6, 6),
+            "abandoned_workers": (self.executor.abandoned
+                                  if self.executor is not None else 0),
             "transitions": list(self.transitions),
         }
 
@@ -288,6 +333,49 @@ class LanePool:
         self.redispatches = 0
         self._since_probe = 0
         self._canary = None  # (words, ctr, sched, key_slots, expected, rung)
+        #: pulsed (replaced) on every completion/state change so an
+        #: awaiting dispatch re-evaluates placement; see _wait_change.
+        self._change = asyncio.Event()
+        #: lanes OCCUPIED right now (dispatch or probe windows) and the
+        #: run's high-water mark — the measured overlap. Counted around
+        #: the actual lane.run_async window, NOT around batch tasks: a
+        #: task parked waiting for a busy lane is queued work, not an
+        #: in-flight dispatch, and the `--min-inflight` gate must not be
+        #: satisfiable by queuing alone (`--lanes 1 --max-inflight 4`
+        #: serializes on the single lane and must measure 1).
+        self.inflight_now = 0
+        self.max_inflight_seen = 0
+
+    def close(self) -> None:
+        """Stop every lane's idle worker (abandoned/wedged ones need no
+        stop — they exit on wake via their stale generation)."""
+        for lane in self.lanes:
+            if lane.executor is not None:
+                lane.executor.close()
+
+    # -- overlap accounting ------------------------------------------------
+    def _inflight(self, d: int) -> None:
+        """The in-flight ledger + `serve_inflight` gauge: one event per
+        TRAFFIC-dispatch lane window, so `obs.report` can reconstruct
+        the overlap a run actually achieved (the "serve overlap" line)
+        and `serve.bench --min-inflight` gates the high-water mark.
+        Canary probes occupy lanes but are excluded — they bypass the
+        server's in-flight semaphore, and the measured number must stay
+        comparable to the configured `max_inflight` limit (a serialized
+        control run with one probe must still measure 1)."""
+        self.inflight_now += d
+        if self.inflight_now > self.max_inflight_seen:
+            self.max_inflight_seen = self.inflight_now
+        trace.gauge("serve_inflight", self.inflight_now)
+
+    # -- overlap wakeups ---------------------------------------------------
+    def _notify_change(self) -> None:
+        """Wake every dispatch waiting for a lane: swap in a fresh event
+        and set the old one. Waiters capture ``self._change`` BEFORE
+        re-checking placement (see ``dispatch``), so a pulse landing
+        between their check and their await cannot be missed."""
+        ev, self._change = self._change, asyncio.Event()
+        ev.set()
 
     # -- journal resume ----------------------------------------------------
     def adopt_journal_quarantines(self) -> list[int]:
@@ -311,9 +399,15 @@ class LanePool:
                 and l.state in PLACEABLE]
 
     def place(self, exclude=()) -> Lane | None:
-        """Least-loaded placeable lane (cumulative blocks; index breaks
-        ties so placement is deterministic for a given history)."""
-        cands = self.placeable(exclude)
+        """Least-loaded IDLE placeable lane (cumulative blocks; index
+        breaks ties so placement is deterministic for a given history).
+        In-flight work counts against placement: a lane with a batch in
+        flight is at capacity — one batch per lane, since a device
+        serializes its own dispatches and queuing a second batch behind
+        a possibly-wedging one would only couple their fates. A caller
+        finding no idle lane but a busy placeable one waits for a
+        completion pulse instead of failing (``dispatch``)."""
+        cands = [l for l in self.placeable(exclude) if not l.inflight]
         if not cands:
             return None
         return min(cands, key=lambda l: (l.blocks, l.idx))
@@ -327,35 +421,40 @@ class LanePool:
         startup invariant, not a hope). ``sched``/``key_slots`` are the
         multi-key dispatch pair (StackedSchedules + per-block slot
         vector), so the canary replays the EXACT traffic shape."""
-        self._canary = (words, ctr_words, sched, key_slots,
-                        np.asarray(expected), int(bucket))
+        self._canary = _Canary(words, ctr_words, sched, key_slots,
+                               np.asarray(expected), int(bucket))
 
-    def probe_lane(self, lane: Lane) -> bool:
-        """One canary dispatch on a quarantined lane: a bit-exact
-        response releases it into probation; a failure, timeout, or
-        mismatched payload leaves it quarantined. A hung canary abandons
-        its ``lane-probe`` span — the same orphan-as-kill-evidence
-        convention as a hung traffic dispatch."""
+    def _probe_open(self, lane: Lane):
+        """Probe preconditions + the ``lane-probe`` span, or None when
+        the lane is not probeable (not quarantined, unwarmed, busy, or
+        no canary pinned)."""
         if (self._canary is None or not lane.warmed
-                or lane.state != QUARANTINED):
-            return False
-        words, ctr_words, sched, key_slots, expected, bucket = self._canary
+                or lane.state != QUARANTINED or lane.inflight):
+            return None
         lane.canaries += 1
         cm = trace.detached_span("lane-probe", lane=lane.idx,
-                                 bucket=bucket, engine=self.engine)
+                                 bucket=self._canary.bucket,
+                                 engine=self.engine)
         cm.__enter__()
-        try:
-            out = lane.engine_call(words, ctr_words, sched, key_slots,
-                                   f"canary:lane{lane.idx}")
-        except watchdog.DispatchTimeout:
-            trace.counter("serve_canary_failed", lane=lane.idx)
-            return False  # span deliberately abandoned: the kill evidence
-        except Exception as e:  # noqa: BLE001 - a sick lane may raise anything
-            cm.__exit__(type(e), e, None)
+        return cm
+
+    def _probe_settle(self, lane: Lane, cm, c: _Canary,
+                      out=None, exc=None) -> bool:
+        """Close the probe span and judge the canary: bit-exact output
+        releases the lane into probation; a failure, timeout (span
+        deliberately abandoned — the same orphan-as-kill-evidence
+        convention as a hung traffic dispatch), or mismatched payload
+        leaves it quarantined. ``c`` is the canary CAPTURED at probe
+        start: the engine call may take seconds, and a set_canary
+        landing mid-probe must not judge the old inputs' output against
+        the new expectation."""
+        if exc is not None:
+            if not isinstance(exc, watchdog.DispatchTimeout):
+                cm.__exit__(type(exc), exc, None)
             trace.counter("serve_canary_failed", lane=lane.idx)
             return False
         cm.__exit__(None, None, None)
-        if not np.array_equal(out, expected):
+        if not np.array_equal(out, c.expected):
             trace.counter("serve_canary_mismatch", lane=lane.idx)
             return False
         lane.probation_left = self.probation_batches
@@ -364,34 +463,124 @@ class LanePool:
                     unit=lane_unit(lane.idx))
         return True
 
-    def maybe_probe(self) -> None:
-        """Periodic canary pass: every ``probe_every`` batches, probe
-        every warmed quarantined lane once. Called by the server between
-        batches so a probe never delays the batch that triggered it."""
+    def probe_lane(self, lane: Lane) -> bool:
+        """One canary dispatch on a quarantined lane, synchronously on
+        the calling thread (the main-thread SIGALRM watchdog path —
+        rehearsals and single-shot tools; the server's overlapped loop
+        uses ``probe_lane_async``)."""
+        cm = self._probe_open(lane)
+        if cm is None:
+            return False
+        c = self._canary
+        try:
+            out = lane.engine_call(c.words, c.ctr_words, c.sched, c.key_slots,
+                                   f"canary:lane{lane.idx}")
+        except Exception as e:  # noqa: BLE001 - a sick lane may raise anything
+            return self._probe_settle(lane, cm, c, exc=e)
+        return self._probe_settle(lane, cm, c, out=out)
+
+    async def probe_lane_async(self, lane: Lane) -> bool:
+        """``probe_lane`` through the lane's worker executor: the event
+        loop keeps serving other lanes while the canary runs (a probe of
+        a genuinely dead lane costs its watchdog deadline — that wait
+        must not stall in-flight traffic). A hung canary's wedged worker
+        is abandoned exactly like a hung dispatch's."""
+        cm = self._probe_open(lane)
+        if cm is None:
+            return False
+        c = self._canary
+        # The probe occupies the LANE (placement skips it, busy time
+        # accrues) but does NOT count into the in-flight dispatch
+        # metric: probes run outside the server's `max_inflight`
+        # semaphore (a rescue probe fires while its dispatch coroutine
+        # already holds a slot — acquiring again would deadlock a
+        # --max-inflight 1 server), so counting them could report
+        # measured overlap above the configured limit in a run that
+        # never overlapped a single BATCH.
+        lane.inflight += 1
+        t0 = lane._clock()
+        try:
+            out = await lane.run_async(
+                lambda: lane.engine_call(c.words, c.ctr_words, c.sched,
+                                         c.key_slots,
+                                         f"canary:lane{lane.idx}"))
+        except Exception as e:  # noqa: BLE001 - a sick lane may raise anything
+            return self._probe_settle(lane, cm, c, exc=e)
+        finally:
+            lane.inflight -= 1
+            lane.busy_us += int((lane._clock() - t0) * 1e6)
+            self._notify_change()
+        return self._probe_settle(lane, cm, c, out=out)
+
+    def probe_due(self) -> bool:
+        """Advance the per-placed-batch probe counter; True when a
+        canary pass is due AND a probeable (warmed, quarantined) lane
+        exists. Synchronous and cheap — the server checks this inline
+        per batch and only spawns a ``probe_pass`` task when it fires,
+        instead of paying a task allocation per batch for a no-op."""
         self._since_probe += 1
         if self._since_probe < self.probe_every:
-            return
+            return False
         self._since_probe = 0
+        return any(l.state == QUARANTINED and l.warmed
+                   for l in self.lanes)
+
+    async def probe_pass(self) -> None:
+        """One canary pass over the warmed quarantined lanes, through
+        the lane executors — run as its own task so in-flight
+        dispatches keep completing (and new batches keep forming) while
+        a canary waits out a dead lane's deadline."""
         for lane in self.lanes:
             if lane.state == QUARANTINED and lane.warmed:
-                self.probe_lane(lane)
+                await self.probe_lane_async(lane)
 
     # -- dispatch with failover --------------------------------------------
-    def dispatch(self, words, ctr_words, sched, key_slots, label: str,
-                 bucket: int, blocks: int, requests: int, runs=None):
+    async def dispatch(self, words, ctr_words, sched, key_slots, label: str,
+                       bucket: int, blocks: int, requests: int, runs=None):
         """Place and run one batch, failing over across lanes until it
         succeeds or every lane has been tried. ``sched``/``key_slots``
         are the multi-key pair (keycache.StackedSchedules + per-block
         slot vector). Returns (output words, lane, redispatches).
         Raises LanesExhausted when no lane could serve it — only then
         may the caller answer per-request errors
-        (re-dispatch-before-error is the failover contract)."""
+        (re-dispatch-before-error is the failover contract).
+
+        Awaitable, for overlap: the guarded engine call (with its
+        on-lane RetryPolicy) runs on the placed lane's worker executor,
+        so many dispatch coroutines proceed concurrently — up to the
+        server's in-flight cap, one per lane. When every not-yet-tried
+        placeable lane is BUSY the coroutine waits for a completion
+        pulse and re-places (failover-before-error still holds: busy
+        healthy lanes are future failover targets, not exhaustion);
+        only when no placeable lane exists at all does the last-resort
+        canary rescue run, and only when that too fails does
+        LanesExhausted surface."""
         causes: list = []
         tried: set[int] = set()
         while True:
+            # Capture the pulse BEFORE placing: a completion landing
+            # between a failed placement and the await still wakes us.
+            change = self._change
             lane = self.place(exclude=tried)
             if lane is None:
-                lane = self._rescue(tried)
+                if self.placeable(tried):
+                    await change.wait()  # busy lanes exist: one frees up
+                    continue
+                lane = await self._rescue(tried)
+                if lane is None and any(
+                        l.state == QUARANTINED and l.inflight
+                        and l.idx not in tried for l in self.lanes):
+                    # Another coroutine's canary is IN FLIGHT on a
+                    # quarantined lane this batch has not tried: its
+                    # success is this batch's failover target, so wait
+                    # for the probe's completion pulse and re-place
+                    # instead of answering errors — the re-dispatch-
+                    # before-error contract holds across CONCURRENT
+                    # rescues too (a probe that fails leaves no
+                    # in-flight quarantined lane, and the next pass
+                    # probes or exhausts honestly).
+                    await change.wait()
+                    continue
             if lane is None:
                 raise LanesExhausted(label, causes)
             cm = trace.detached_span(
@@ -399,15 +588,20 @@ class LanePool:
                 blocks=blocks, requests=requests, engine=self.engine,
                 redispatch=bool(tried))
             cm.__enter__()
+            lane.inflight += 1
+            self._inflight(+1)
+            t0 = lane._clock()
             try:
-                out = lane.policy.run(
-                    lambda att: lane.engine_call(words, ctr_words, sched,
-                                                 key_slots, label,
-                                                 runs=runs))
+                out = await lane.run_async(
+                    lambda: lane.policy.run(
+                        lambda att: lane.engine_call(words, ctr_words,
+                                                     sched, key_slots,
+                                                     label, runs=runs)))
             except watchdog.DispatchTimeout as e:
                 # The dispatch never ended: the span is ABANDONED, not
                 # closed — its orphaned begin is the kill evidence
-                # (obs.report --check --expected-orphans lane-dispatch).
+                # (obs.report --check --expected-orphans lane-dispatch);
+                # the wedged worker thread was abandoned with it.
                 trace.counter("serve_lane_timeout", lane=lane.idx)
                 lane.note_timeout(e, self.journal)
                 causes.append((lane.idx, e))
@@ -420,6 +614,11 @@ class LanePool:
                 causes.append((lane.idx, e))
                 tried.add(lane.idx)
                 continue
+            finally:
+                lane.inflight -= 1
+                self._inflight(-1)
+                lane.busy_us += int((lane._clock() - t0) * 1e6)
+                self._notify_change()
             cm.__exit__(None, None, None)
             if tried:
                 self.redispatches += 1
@@ -429,7 +628,7 @@ class LanePool:
                               probation_batches=self.probation_batches)
             return out, lane, len(tried)
 
-    def _rescue(self, tried: set) -> Lane | None:
+    async def _rescue(self, tried: set) -> Lane | None:
         """Last-resort probe when no placeable lane remains: canary the
         quarantined lanes now rather than fail the batch — a single-lane
         server recovering from a transient hang re-proves its lane here
@@ -437,7 +636,7 @@ class LanePool:
         for lane in self.lanes:
             if lane.idx in tried or lane.state != QUARANTINED:
                 continue
-            if self.probe_lane(lane):
+            if await self.probe_lane_async(lane):
                 return lane
         return None
 
@@ -452,6 +651,9 @@ class LanePool:
             "placed_across": sum(1 for l in self.lanes if l.dispatches),
             "redispatches": self.redispatches,
             "quarantine_events": self.quarantine_events(),
+            "abandoned_workers": sum(
+                l.executor.abandoned for l in self.lanes
+                if l.executor is not None),
             "states": {s: sum(1 for l in self.lanes if l.state == s)
                        for s in sorted({l.state for l in self.lanes})},
             "per_lane": [l.stats() for l in self.lanes],

@@ -1,10 +1,22 @@
-"""Closed-loop load generator for the serve path.
+"""Closed- and open-loop load generators for the serve path.
 
-``concurrency`` client coroutines each run a closed loop: draw a
-(tenant, key, size) from a seeded RNG, submit, await the response,
-repeat — so offered load adapts to service rate (the standard
-closed-loop model; there is no coordinated-omission window because a
-client never has more than one request outstanding).
+Closed loop (the default): ``concurrency`` client coroutines each run a
+closed loop — draw a (tenant, key, size) from a seeded RNG, submit,
+await the response, repeat — so offered load adapts to service rate
+(the standard closed-loop model; there is no coordinated-omission
+window because a client never has more than one request outstanding).
+
+Open loop (``arrival_rate=R``): requests ARRIVE at a fixed rate of R/s
+regardless of how fast the server answers — one submission every 1/R
+seconds, outstanding requests unbounded. This is the mode that can
+actually expose overlap gains: a closed loop with few clients throttles
+itself to the service rate (a single-dispatch server and an overlapped
+one both stay "busy"), while a fixed offered load above one lane's
+capacity piles work into the queue and only multi-lane in-flight
+dispatch can drain it — the saturation run's offered-load knob
+(docs/SERVING.md). Latency is measured from each request's SCHEDULED
+arrival time, so generator lag counts as queueing delay instead of
+being coordinated-omission-masked.
 
 Correctness rides along without polluting the compile counter: a fixed
 set of PROBE requests — one per request size, keys/nonces/payloads
@@ -120,9 +132,16 @@ async def run(server, n_requests: int, concurrency: int = 32,
               seed: int = 0, verify_every: int = 8,
               deadline_s: float | None = None,
               probes: list[Probe] | None = None,
+              arrival_rate: float | None = None,
               clock=time.monotonic) -> LoadReport:
-    """Drive ``server`` with ``n_requests`` total across ``concurrency``
-    closed-loop clients; returns the aggregated LoadReport."""
+    """Drive ``server`` with ``n_requests`` total; returns the
+    aggregated LoadReport.
+
+    ``arrival_rate=None`` (default): ``concurrency`` closed-loop
+    clients. ``arrival_rate=R``: open loop — one request submitted every
+    ``1/R`` seconds with no outstanding-request bound (``concurrency``
+    is ignored; the offered load, not the service rate, sets the pace).
+    """
     sizes = tuple(sizes)
     if probes is None:
         probes = make_probes(sizes, seed)
@@ -145,6 +164,35 @@ async def run(server, n_requests: int, concurrency: int = 32,
     payloads = {s: pool_rng.integers(0, 256, s, dtype=np.uint8)
                 for s in sizes}
 
+    def pick(i: int, rng):
+        """Request ``i``'s (tenant, key, nonce, payload, probe) — shared
+        by both loop models so a run's request mix depends only on the
+        seed and the request index order, not on the loop shape."""
+        size = int(rng.choice(sizes))
+        probe = by_size.get(size) if (verify_every
+                                      and i % verify_every == 0) else None
+        if probe is not None:
+            return (probe.tenant, probe.key, probe.nonce,
+                    probe.payload, probe)
+        tenant = f"t{int(rng.integers(tenants))}"
+        key = keys[(int(tenant[1:]), int(rng.integers(keys_per_tenant)))]
+        nonce = rng.integers(0, 256, 16, dtype=np.uint8).tobytes()
+        return tenant, key, nonce, payloads[size], None
+
+    def account(resp, payload, probe, dt_ms: float):
+        report.requests += 1
+        report.latencies_ms.append(dt_ms)
+        if resp.ok:
+            report.ok += 1
+            counter["ok_bytes"] += int(payload.size)
+            if probe is not None:
+                report.verified += 1
+                if not np.array_equal(np.asarray(resp.payload),
+                                      probe.expected):
+                    report.mismatches += 1
+        else:
+            report.errors[resp.error] = report.errors.get(resp.error, 0) + 1
+
     async def client(cid: int):
         rng = np.random.default_rng((seed << 8) ^ cid)
         while True:
@@ -152,37 +200,38 @@ async def run(server, n_requests: int, concurrency: int = 32,
             if i >= n_requests:
                 return
             counter["next"] = i + 1
-            size = int(rng.choice(sizes))
-            probe = by_size.get(size) if (verify_every
-                                          and i % verify_every == 0) else None
-            if probe is not None:
-                tenant, key = probe.tenant, probe.key
-                nonce, payload = probe.nonce, probe.payload
-            else:
-                tenant = f"t{int(rng.integers(tenants))}"
-                key = keys[(int(tenant[1:]),
-                            int(rng.integers(keys_per_tenant)))]
-                nonce = rng.integers(0, 256, 16, dtype=np.uint8).tobytes()
-                payload = payloads[size]
+            tenant, key, nonce, payload, probe = pick(i, rng)
             t0 = clock()
             resp = await server.submit(tenant, key, nonce, payload,
                                        deadline_s=deadline_s)
-            dt_ms = (clock() - t0) * 1e3
-            report.requests += 1
-            report.latencies_ms.append(dt_ms)
-            if resp.ok:
-                report.ok += 1
-                counter["ok_bytes"] += int(payload.size)
-                if probe is not None:
-                    report.verified += 1
-                    if not np.array_equal(np.asarray(resp.payload),
-                                          probe.expected):
-                        report.mismatches += 1
-            else:
-                report.errors[resp.error] = (
-                    report.errors.get(resp.error, 0) + 1)
+            account(resp, payload, probe, (clock() - t0) * 1e3)
+
+    async def open_request(i: int, scheduled: float, rng):
+        tenant, key, nonce, payload, probe = pick(i, rng)
+        resp = await server.submit(tenant, key, nonce, payload,
+                                   deadline_s=deadline_s)
+        # Latency from the SCHEDULED arrival: a generator that fell
+        # behind a saturated server charges the lag as queueing delay
+        # (the open-loop, coordinated-omission-free accounting).
+        account(resp, payload, probe, (clock() - scheduled) * 1e3)
+
+    async def open_loop(t_start: float):
+        interval = 1.0 / arrival_rate
+        rng = np.random.default_rng(seed << 8)
+        pending = []
+        for i in range(n_requests):
+            scheduled = t_start + i * interval
+            delay = scheduled - clock()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            pending.append(asyncio.ensure_future(
+                open_request(i, scheduled, rng)))
+        await asyncio.gather(*pending)
 
     t_start = clock()
-    await asyncio.gather(*(client(c) for c in range(concurrency)))
+    if arrival_rate is not None and arrival_rate > 0:
+        await open_loop(t_start)
+    else:
+        await asyncio.gather(*(client(c) for c in range(concurrency)))
     report.finish(clock() - t_start, counter["ok_bytes"])
     return report

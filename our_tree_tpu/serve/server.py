@@ -1,18 +1,27 @@
-"""The serve dispatch loop: queue -> shape buckets -> dispatch lanes.
+"""The serve dispatch loop: queue -> shape buckets -> in-flight lanes.
 
-One asyncio loop on the main thread owns the whole path. Request
-coroutines ``submit`` into the bounded queue; the batcher loop drains,
-rung-packs up to K key groups per batch (``batcher`` — the multi-key
-coalescer: one dispatch carries many tenants' keys via the stacked
-schedules + per-block slot vector), and places each batch on a dispatch
-LANE — one per visible device (``serve/lanes.py``), least-loaded across
-healthy lanes. The engine comes from ``aes.resolve_serve_engine``: the
-ranked jax-engine ladder (pallas-dense-bp on a measured TPU) plus the
-native AESNI host tier, which "auto" prefers on CPU — the fast-path
-tiering docs/SERVING.md tabulates. Dispatch stays synchronous on the
-main thread on purpose: that is what lets each lane's watchdog SIGALRM
-interrupt a wedged device call (resilience/watchdog.py's GIL-releasing
-contract).
+One asyncio loop on the main thread owns admission and batch formation;
+dispatch is OVERLAPPED. Request coroutines ``submit`` into the bounded
+queue; the batcher loop drains, rung-packs up to K key groups per batch
+(``batcher`` — the multi-key coalescer: one dispatch carries many
+tenants' keys via the stacked schedules + per-block slot vector), and
+SUBMITS each batch as its own dispatch task: the loop keeps forming and
+placing batches while up to ``max_inflight`` dispatches (default: one
+per lane) are in flight across the lane pool, and per-lane completions
+feed replies back into the loop as each batch's task resolves its
+riders. That is the paper's ``length/num_threads`` decomposition at the
+lane level — host batch formation, placement, and reply assembly
+overlap device work, so aggregate goodput finally scales with lanes
+instead of serializing behind one dispatch at a time. The engine comes
+from ``aes.resolve_serve_engine``: the ranked jax-engine ladder
+(pallas-dense-bp on a measured TPU) plus the native AESNI host tier,
+which "auto" prefers on CPU — the fast-path tiering docs/SERVING.md
+tabulates. Each dispatch runs on its lane's worker executor
+(``serve/dispatch.py``) with the watchdog deadline armed on the worker
+— expiry delivers through the thread-kill hook (fail the future,
+abandon the wedged worker) instead of the old main-thread SIGALRM
+raise, so a hang still surfaces AT the deadline while healthy lanes
+keep streaming.
 
 Failure containment, per batch (docs/SERVING.md has the sequence
 diagram):
@@ -38,9 +47,10 @@ diagram):
   journal uses, so ``serve.bench --unquarantine lane:<i>`` is the same
   release edit as ``harness.bench --unquarantine``.
 
-Shutdown DRAINS instead of dropping: ``stop()`` first closes admission
-(new submits answer ``shutdown`` immediately), then lets the batcher
-loop dispatch everything already accepted, then flushes (normally
+Shutdown DRAINS instead of dropping — including under overlap:
+``stop()`` first closes admission (new submits answer ``shutdown``
+immediately), then lets the batcher loop dispatch everything already
+accepted AND await every in-flight batch task, then flushes (normally
 nothing) — a clean stop answers every accepted request and leaves no
 orphaned span. ``queue.stats()["lost"]`` (accepted minus answered) is
 the invariant ``serve.bench`` gates on: it must be 0 even across a
@@ -144,6 +154,12 @@ class ServerConfig:
     #: serve journal path (lane quarantine persistence + the
     #: --unquarantine release edit); None = in-memory health only
     journal: str | None = None
+    #: dispatches allowed in flight at once across the lane pool.
+    #: None = one per lane (full overlap — the default); 1 restores the
+    #: pre-overlap serialize-behind-one-dispatch behaviour (the bench
+    #: control run); values above the lane count are clamped by
+    #: placement itself (a lane holds one batch at a time)
+    max_inflight: int | None = None
 
 
 class Server:
@@ -166,6 +182,14 @@ class Server:
         self._journal = None
         self._task: asyncio.Task | None = None
         self._running = False
+        #: overlap state: the in-flight cap (resolved at start) and the
+        #: live task set (dispatch + probe tasks; drain awaits it). The
+        #: MEASURED concurrency lives in the pool (`max_inflight_seen`:
+        #: lane-occupancy windows, not task counts — queued-behind-a-
+        #: busy-lane work must not satisfy the `--min-inflight` gate).
+        self.inflight_limit = 0
+        self._sem: asyncio.Semaphore | None = None
+        self._tasks: set = set()
         self.batches = 0
         self.batches_failed = 0
         self.batches_timed_out = 0
@@ -212,6 +236,10 @@ class Server:
         self.warmup_compiles = self._compiles_at_ready - before
         trace.gauge("serve_warmup_compiles", self.warmup_compiles,
                     engine=self.engine, lanes=len(self.pool.lanes))
+        self.inflight_limit = (len(self.pool.lanes)
+                               if c.max_inflight is None
+                               else max(int(c.max_inflight), 1))
+        self._sem = asyncio.Semaphore(self.inflight_limit)
         self._running = True
         self._task = asyncio.ensure_future(self._loop())
 
@@ -312,9 +340,20 @@ class Server:
             trace.counter("serve_drain_dropped", n=dropped)
         trace.point("serve-drained",
                     answered=self.queue.answered,
-                    lost=self.queue.accepted - self.queue.answered)
+                    lost=self.queue.accepted - self.queue.answered,
+                    max_inflight=self.max_inflight_seen)
+        if self.pool is not None:
+            self.pool.close()  # idle workers dismissed; wedged ones are
+            #                    already abandoned (stale generation)
         if self._journal is not None:
             self._journal.close()
+
+    @property
+    def max_inflight_seen(self) -> int:
+        """The run's measured dispatch concurrency: the pool's
+        lane-occupancy high-water mark (serve/lanes.py:_inflight) — NOT
+        a count of spawned batch tasks, which queuing alone can inflate."""
+        return self.pool.max_inflight_seen if self.pool is not None else 0
 
     def steady_compiles(self) -> int:
         """Backend compiles since warmup finished — the number the bucket
@@ -339,8 +378,25 @@ class Server:
                 for b in batcher.form_batches(requests, self.rungs,
                                               key_digest,
                                               self.config.key_slots):
-                    self._run_batch(b)
-                    self.pool.maybe_probe()
+                    # Submit: take an in-flight slot (backpressure — the
+                    # queue's bounded depth holds while every slot is
+                    # busy), spawn the batch's dispatch task, and keep
+                    # forming. Completion resolves the riders inside the
+                    # task; the loop never waits for device work.
+                    await self._sem.acquire()
+                    self._spawn(self._run_batch(b))
+                    # The periodic canary pass runs as its OWN task: a
+                    # probe of a genuinely dead lane costs its watchdog
+                    # deadline, and awaiting that inline would stall
+                    # every new batch behind it (re-probe concurrency is
+                    # safe: _probe_open skips busy/non-quarantined
+                    # lanes). The due-check stays inline — cheap and
+                    # synchronous — so the common no-op case costs no
+                    # task. Drain awaits probe tasks like dispatches,
+                    # so a probe in flight at shutdown still closes its
+                    # span.
+                    if self.pool.probe_due():
+                        self._spawn(self.pool.probe_pass())
                     # Yield between batches: resolved clients get to
                     # resubmit, so the next drain coalesces their
                     # follow-ups (the "continuous" in continuous
@@ -349,18 +405,40 @@ class Server:
             if not self._running:
                 # stop() closed admission BEFORE clearing _running, so
                 # the drain that just emptied was the complete final
-                # set: everything accepted has been dispatched (the
-                # drain-on-shutdown contract), and exiting here is what
-                # makes it true.
+                # set. Everything accepted has been SUBMITTED; await the
+                # in-flight tasks so everything is also ANSWERED — the
+                # drain-under-overlap contract (`lost` stays 0 with N
+                # batches in flight at shutdown). return_exceptions:
+                # a probe task must never take the drain down with it.
+                if self._tasks:
+                    await asyncio.gather(*list(self._tasks),
+                                         return_exceptions=True)
                 return
 
-    def _run_batch(self, b: batcher.Batch) -> None:
-        """One batch, contained: NO exception may escape — an escape
-        would kill the batcher task and wedge every future request, so
-        anything unexpected resolves the riders with errors and the
-        loop lives on."""
-        from .queue import Response  # cycle-free: queue never imports us
+    def _spawn(self, coro) -> None:
+        """Run ``coro`` as a tracked background task: the drain gathers
+        every tracked task before the loop exits."""
+        task = asyncio.ensure_future(coro)
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
 
+    async def _run_batch(self, b: batcher.Batch) -> None:
+        """One batch's dispatch task: form arrays, dispatch (awaiting
+        the lane executor), resolve riders. Contained: NO exception may
+        escape — an escape would kill this task silently and lose its
+        riders, so anything unexpected resolves them with errors; the
+        in-flight slot is returned in every outcome."""
+        try:
+            sched = self._form_batch(b)
+            if sched is not None:
+                await self._dispatch_batch(b, sched)
+        finally:
+            self._sem.release()
+
+    def _form_batch(self, b: batcher.Batch):
+        """Array materialisation + schedule stacking; returns the
+        stacked schedules, or None after answering the riders when
+        formation itself failed."""
         try:
             with trace.span("batch-formed", batch=b.label, bucket=b.bucket,
                             blocks=b.blocks, slots=len(b.slots),
@@ -371,15 +449,20 @@ class Server:
                 # the (N, 4) counter array it would never read is pure
                 # memory-bandwidth tax at the big rungs.
                 b.materialise(counters=self.engine != aes.NATIVE_ENGINE)
+                return sched
         except Exception as e:  # noqa: BLE001 - containment (docstring)
             self.batches_failed += 1
             trace.counter("serve_batch_failed", batch=b.label)
             for req in b.requests:
                 req.fail(ERR_DISPATCH, f"{type(e).__name__}: {e}",
                          batch=b.label)
-            return
+            return None
+
+    async def _dispatch_batch(self, b: batcher.Batch, sched) -> None:
+        from .queue import Response  # cycle-free: queue never imports us
+
         try:
-            out, _lane, _redispatched = self.pool.dispatch(
+            out, _lane, _redispatched = await self.pool.dispatch(
                 b.words, b.ctr_words, sched, b.slot_index, b.label,
                 bucket=b.bucket, blocks=b.blocks,
                 requests=len(b.requests), runs=b.runs)
@@ -462,6 +545,10 @@ class Server:
             "engine": self.engine,
             "rungs": list(self.rungs),
             "coalesce": self.coalesce_stats(),
+            "overlap": {
+                "inflight_limit": self.inflight_limit,
+                "max_inflight": self.max_inflight_seen,
+            },
             "batches": self.batches,
             "batches_failed": self.batches_failed,
             "batches_timed_out": self.batches_timed_out,

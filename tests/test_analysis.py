@@ -211,6 +211,71 @@ def test_serve_lane_seam_rule_covers_multikey_and_native(tmp_path):
     assert "serve-lane-seam" not in _rules(fs)
 
 
+def test_serve_lane_seam_rule_flags_threads_outside_executor(tmp_path):
+    """Worker threads in serve/ exist only inside the lane executor
+    (serve/dispatch.py): a thread spawned anywhere else — the lane seam
+    file included — carries work past the thread-kill-hook guard that
+    gives the watchdog its off-main delivery path."""
+    src = """
+        import threading
+
+        def spawn(work):
+            t = threading.Thread(target=work, daemon=True)
+            t.start()
+            return t
+    """
+    fs = _lint(tmp_path, src, name="our_tree_tpu/serve/batcher.py")
+    flagged = [f for f in fs if f.rule == "serve-lane-seam"]
+    assert len(flagged) == 1
+    assert "serve/dispatch.py" in flagged[0].message
+    # The lane seam file owns DEVICE contact, not threads: it flags too.
+    fs = _lint(tmp_path, src, name="our_tree_tpu/serve/lanes.py")
+    assert "serve-lane-seam" in _rules(fs)
+    # The executor module is the one allowed spawner...
+    fs = _lint(tmp_path, src, name="our_tree_tpu/serve/dispatch.py")
+    assert "serve-lane-seam" not in _rules(fs)
+    # ...and the rule only scopes serve/.
+    fs = _lint(tmp_path, src, name="our_tree_tpu/harness/foo.py")
+    assert "serve-lane-seam" not in _rules(fs)
+
+
+def test_dispatch_watchdog_rule_guards_executor_unit(tmp_path):
+    """The executor worker's `unit()` invocation is legal only inside
+    the `watchdog.thread_kill_hook` guard: a deadline armed inside an
+    unguarded unit would expire with no delivery path (SIGALRM cannot
+    reach a worker thread) — the waiter blocks forever."""
+    violating = """
+        def _run(q):
+            while True:
+                fut, unit = q.get()
+                result = unit()
+                fut.set_result(result)
+    """
+    fs = _lint(tmp_path, violating, name="our_tree_tpu/serve/dispatch.py")
+    flagged = [f for f in fs if f.rule == "dispatch-watchdog"]
+    assert len(flagged) == 1
+    assert "thread_kill_hook" in flagged[0].message
+    compliant = """
+        from our_tree_tpu.resilience import watchdog
+
+        def _run(q):
+            while True:
+                fut, unit = q.get()
+
+                def kill(exc, fut=fut):
+                    fut.set_exception(exc)
+
+                with watchdog.thread_kill_hook(kill):
+                    fut.set_result(unit())
+    """
+    fs = _lint(tmp_path, compliant, name="our_tree_tpu/serve/dispatch.py")
+    assert "dispatch-watchdog" not in _rules(fs)
+    # Outside the executor module a bare `unit()` is just a function
+    # call — not this rule's business.
+    fs = _lint(tmp_path, violating, name="our_tree_tpu/serve/other.py")
+    assert "dispatch-watchdog" not in _rules(fs)
+
+
 def test_fault_points_rule_covers_lane_helpers(tmp_path):
     """check_lane/scoped literals are validated against KNOWN_POINTS
     like every other fault-method literal — and the registered lane

@@ -22,6 +22,7 @@ import asyncio
 import io
 import json
 import pathlib
+import threading
 
 import numpy as np
 import pytest
@@ -32,11 +33,12 @@ from our_tree_tpu.models import aes
 from our_tree_tpu.models.aes import AES
 from our_tree_tpu.obs import export, report, trace
 from our_tree_tpu.ops.keyschedule import expand_key_enc
-from our_tree_tpu.resilience import degrade, faults
+from our_tree_tpu.resilience import degrade, faults, watchdog
 from our_tree_tpu.resilience import journal as journal_mod
 from our_tree_tpu.serve import batcher, keycache, lanes, loadgen
 from our_tree_tpu.serve import bench as serve_bench
 from our_tree_tpu.serve import queue as otq
+from our_tree_tpu.serve.dispatch import LaneExecutor
 from our_tree_tpu.serve.server import Server, ServerConfig, compile_count
 from our_tree_tpu.utils import packing
 
@@ -1238,10 +1240,15 @@ def test_bench_cli_writes_artifact_and_asserts(tmp_path, capsys):
     assert len(doc["lanes"]["per_lane"]) == 2
     row = doc["lanes"]["per_lane"][0]
     assert {"lane", "device", "state", "dispatches", "blocks", "bytes",
-            "goodput_gbps", "failures", "timeouts", "canaries",
-            "transitions"} <= set(row)
+            "goodput_gbps", "busy_fraction", "failures", "timeouts",
+            "canaries", "transitions"} <= set(row)
     assert sum(r["dispatches"] for r in doc["lanes"]["per_lane"]) \
         == doc["batches"]["batches"]
+    # The overlap schema: measured concurrency in artifact AND line.
+    assert doc["overlap"]["inflight_limit"] == 2
+    assert doc["overlap"]["max_inflight"] >= 1
+    assert line["max_inflight"] == doc["overlap"]["max_inflight"]
+    assert line["inflight_limit"] == 2
 
 
 def test_bench_next_artifact_indexing(tmp_path):
@@ -1250,3 +1257,223 @@ def test_bench_next_artifact_indexing(tmp_path):
         "SERVE_r04.json")
     assert serve_bench._next_artifact(str(tmp_path / "empty")).endswith(
         "SERVE_r01.json")
+
+
+# ---------------------------------------------------------------------------
+# Overlapped dispatch: the lane executor, in-flight concurrency, drain
+# and failover under overlap, the open-loop loadgen.
+# ---------------------------------------------------------------------------
+
+
+def test_lane_executor_runs_units_and_replaces_killed_worker():
+    """The worker seam's lifecycle: units run FIFO on one thread; a
+    wedged unit's watchdog expiry fails the future AT the deadline (the
+    thread-kill-hook delivery), the worker is abandoned, and the next
+    submit is served by a fresh worker while the wedged one — on waking
+    — discards its late result and exits."""
+    ex = LaneExecutor("t-exec")
+    assert ex.submit(lambda: 42).result(5) == 42
+    assert ex.submit(lambda: 43).result(5) == 43
+    assert ex.abandoned == 0
+
+    release = threading.Event()
+
+    def wedged():
+        with watchdog.deadline(0.2, what="wedged unit"):
+            release.wait(10)  # a GIL-releasing stand-in for a dead call
+        return "late"
+
+    fut = ex.submit(wedged)
+    # A unit QUEUED behind the wedged one: its deadline never arms (it
+    # never runs), so the abandon path must fail its future rather than
+    # strand its waiter forever.
+    queued = ex.submit(lambda: "never")
+    with pytest.raises(watchdog.DispatchTimeout):
+        fut.result(5)  # failed at ~the deadline, not at the 10s wait
+    with pytest.raises(RuntimeError, match="abandoned"):
+        queued.result(5)
+    assert ex.abandoned == 1
+    # A fresh worker serves the lane while the old one is still wedged.
+    assert ex.submit(lambda: 7).result(5) == 7
+    release.set()  # the abandoned worker wakes, sees its stale
+    #                generation, and exits without double-serving
+    assert ex.submit(lambda: 8).result(5) == 8
+    assert ex.abandoned == 1  # the wake did not retire the NEW worker
+    ex.close()
+
+
+def test_overlap_achieves_concurrency_and_inflight_one_serializes():
+    """The tentpole in one assertion pair: a multi-lane server overlaps
+    dispatches (measured max in-flight >= 2 — the ISSUE's acceptance
+    number), and ``max_inflight=1`` restores the serialized pre-overlap
+    behaviour (the bench control run)."""
+
+    async def drive(server):
+        return await asyncio.gather(*_submit_n(server, 8, size=4096))
+
+    server, resps = _run_server(ServerConfig(lanes=4, **LADDER), drive)
+    assert all(r.ok for r in resps)
+    assert server.inflight_limit == 4  # default: one per lane
+    assert server.max_inflight_seen >= 2
+    q = server.queue.stats()
+    assert q["lost"] == 0 and q["answered"] == q["accepted"]
+    assert server.steady_compiles() == 0  # overlap adds no compiles
+
+    server, resps = _run_server(
+        ServerConfig(lanes=4, max_inflight=1, **LADDER), drive)
+    assert all(r.ok for r in resps)
+    assert server.inflight_limit == 1
+    assert server.max_inflight_seen == 1  # the control: serialized
+
+    # Queuing is NOT overlap: a single lane under a deep task cap
+    # serializes on the lane, and the measured number must say so —
+    # the --min-inflight gate counts lane-occupancy windows, not
+    # spawned batch tasks parked behind a busy lane.
+    server, resps = _run_server(
+        ServerConfig(lanes=1, max_inflight=4, **LADDER), drive)
+    assert all(r.ok for r in resps)
+    assert server.inflight_limit == 4
+    assert server.max_inflight_seen == 1  # queued tasks don't count
+
+
+def test_drain_under_overlap_answers_everything(traced):
+    """Shutdown with N batches in flight: stop() lets the final drain
+    SUBMIT everything accepted, then awaits every in-flight dispatch
+    task — all answered, zero lost, no orphaned span, and the drain
+    itself ran overlapped (the in-flight high-water mark proves the
+    batches were concurrent when the server came down)."""
+
+    async def main():
+        server = Server(ServerConfig(lanes=4, **LADDER))
+        await server.start()
+        tasks = [asyncio.ensure_future(c)
+                 for c in _submit_n(server, 8, size=4096)]
+        await asyncio.sleep(0)  # enqueue only: stop() races the batches
+        await server.stop()
+        return server, await asyncio.gather(*tasks)
+
+    server, resps = asyncio.run(main())
+    assert all(r.ok for r in resps)  # drained, not dropped
+    q = server.queue.stats()
+    assert q["lost"] == 0 and q["answered"] == q["accepted"] == 8
+    assert server.max_inflight_seen >= 2  # the drain overlapped
+    run = export.load_run(str(traced))
+    assert not run.orphans() and not run.violations
+    drained = run.points("serve-drained")
+    assert drained and drained[0]["attrs"]["lost"] == 0
+    assert drained[0]["attrs"]["max_inflight"] >= 2
+
+
+def test_failover_under_overlap_bit_exact_nist_kat(monkeypatch, traced):
+    """``lane_hang:1@lane=0`` while the other lanes are BUSY: the hung
+    batch (carrying the NIST CTR KAT) re-dispatches bit-exactly on a
+    healthy lane, and the healthy lanes' in-flight batches complete
+    WITHOUT stalling behind the hang — every one of their spans closes
+    before the redispatch even begins (the redispatch can only start
+    after the 1s watchdog deadline; serialized dispatch would have
+    parked them all behind it)."""
+    monkeypatch.setenv("OT_FAULTS", "lane_hang:1@lane=0")
+    monkeypatch.setenv("OT_HANG_S", "30")
+    faults.reset()
+
+    async def drive(server):
+        # The KAT is submitted FIRST: arrival order makes it the first
+        # batch formed, and least-loaded placement puts the first batch
+        # on lane 0 — the lane the scoped hang is armed on. The six
+        # 256-block riders (full rungs of their own) keep lanes 1-2
+        # busy while lane 0 wedges.
+        kat = server.submit("kat", NIST_KEY, NIST_CTR0,
+                            np.frombuffer(NIST_PT, np.uint8))
+        return await asyncio.gather(
+            kat, *_submit_n(server, 6, size=4096, seed=7))
+
+    server, resps = _run_server(
+        ServerConfig(retries=1, dispatch_deadline_s=1.0, lanes=3,
+                     **LADDER), drive)
+    assert all(r.ok for r in resps)  # ZERO request errors
+    assert np.array_equal(np.asarray(resps[0].payload),
+                          np.frombuffer(NIST_CT, np.uint8))
+    q = server.queue.stats()
+    assert q["lost"] == 0 and q["answered"] == q["accepted"]
+    assert server.pool.redispatches >= 1
+    assert server.pool.quarantine_events() == 1
+    assert server.pool.lanes[0].timeouts == 1
+    assert server.max_inflight_seen >= 2  # lanes 1-2 worked the hang out
+    assert server.steady_compiles() == 0
+
+    run = export.load_run(str(traced))
+    assert [s.name for s in run.orphans()] == ["lane-dispatch"]
+    assert run.orphans()[0].attrs["lane"] == 0
+    assert report.main([str(traced), "--check",
+                        "--expected-orphans", "lane-dispatch"]) == 0
+    redisp = [s for s in run.spans.values()
+              if s.name == "lane-dispatch" and s.attrs.get("redispatch")
+              and not s.orphan]
+    assert len(redisp) == 1 and redisp[0].attrs["lane"] in (1, 2)
+    assert redisp[0].attrs["bucket"] == 32  # the KAT batch, replayed
+    healthy = [s for s in run.spans.values()
+               if s.name == "lane-dispatch" and not s.orphan
+               and not s.attrs.get("redispatch")]
+    assert len(healthy) == 6
+    # The non-stall proof: every healthy batch CLOSED before the
+    # redispatch (gated on the 1s deadline) could even begin.
+    assert max(s.end_ts for s in healthy) < redisp[0].ts
+
+
+def test_open_loop_loadgen_fixed_arrival_rate():
+    """Open-loop mode: requests arrive at the offered rate regardless
+    of service rate — the run takes at least (n-1)/rate of wall,
+    every arrival is accounted, and probes still verify bit-exactness
+    (``concurrency`` is ignored; outstanding requests are unbounded)."""
+
+    async def drive(server):
+        return await loadgen.run(
+            server, 12, concurrency=1, sizes=(256,), tenants=2,
+            keys_per_tenant=1, seed=3, verify_every=4,
+            arrival_rate=200.0)
+
+    server, rep = _run_server(ServerConfig(**LADDER), drive)
+    assert rep.requests == 12 and rep.ok == 12 and rep.errors == {}
+    assert rep.wall_s >= 11 / 200.0  # paced by the offered load
+    assert rep.verified >= 1 and rep.mismatches == 0
+    q = server.queue.stats()
+    assert q["lost"] == 0 and q["answered"] == q["accepted"] == 12
+
+
+def test_concurrent_rescue_waits_for_inflight_probe(monkeypatch):
+    """Two batches hit a pool whose ONLY lane is quarantined: coroutine
+    A's last-resort rescue probes it; coroutine B — finding the probe
+    already in flight — must WAIT for its completion pulse and then be
+    served, not answer LanesExhausted errors while the lane is in the
+    middle of proving itself healthy (re-dispatch-before-error across
+    CONCURRENT rescues)."""
+    import time as _time
+
+    out_ok = np.ones(4, np.uint32)
+
+    def fake_call(self, w, c, s, k, label, warmup=False, runs=None):
+        _time.sleep(0.1)  # on the worker thread: a slow-but-healthy lane
+        return out_ok
+
+    monkeypatch.setattr(lanes.Lane, "engine_call", fake_call)
+
+    async def main():
+        pool = lanes.LanePool(engine="jnp", deadline_s=0.0, retries=1,
+                              lanes=1, probe_every=10_000)
+        lane = pool.lanes[0]
+        lane.warmed = True
+        lane._to(lanes.QUARANTINED, "test")
+        z = np.zeros(4, np.uint32)
+        pool.set_canary(z, z, None, z, out_ok, 32)
+        a = asyncio.ensure_future(pool.dispatch(
+            z, z, None, z, "A", bucket=32, blocks=1, requests=1))
+        await asyncio.sleep(0.02)  # A is inside its rescue probe
+        assert lane.inflight == 1 and lane.state == lanes.QUARANTINED
+        b = asyncio.ensure_future(pool.dispatch(
+            z, z, None, z, "B", bucket=32, blocks=1, requests=1))
+        return await a, await b, pool, lane
+
+    (ra, _, _), (rb, _, _), pool, lane = asyncio.run(main())
+    assert np.array_equal(ra, out_ok) and np.array_equal(rb, out_ok)
+    assert lane.state in (lanes.PROBATION, lanes.HEALTHY)
+    assert lane.canaries == 1  # ONE probe served both rescues

@@ -98,6 +98,77 @@ def test_off_main_thread_degrades_to_dump_and_post_hoc_raise():
     assert "dispatch-timeout" in degrade.events()
 
 
+def test_thread_kill_hook_delivers_at_the_deadline():
+    """The worker-thread watchdog contract (serve's lane executors): a
+    deadline armed on a thread with a registered kill hook delivers its
+    expiry BY CALLING the hook with the built DispatchTimeout — at the
+    deadline, unblocking whoever waits on the worker — and the
+    late-waking worker still gets the post-hoc raise WITHOUT stamping
+    the degrade ledger a second time."""
+    delivered = threading.Event()
+    got = {}
+
+    def hook(exc):
+        got["exc"] = exc
+        delivered.set()
+
+    result = {}
+
+    def work():
+        try:
+            with watchdog.thread_kill_hook(hook):
+                with watchdog.deadline(0.2, what="worker dispatch"):
+                    time.sleep(0.8)  # wedged well past the deadline
+            result["raised"] = False
+        except watchdog.DispatchTimeout:
+            result["raised"] = True
+
+    t0 = time.monotonic()
+    t = threading.Thread(target=work)
+    t.start()
+    # The WAITER is unblocked at ~the deadline, not at the sleep's end.
+    assert delivered.wait(5)
+    assert time.monotonic() - t0 < 0.7
+    assert isinstance(got["exc"], watchdog.DispatchTimeout)
+    t.join(10)
+    assert result["raised"]  # the late wake still surfaces the miss
+    # ONE demotion: delivery stamped the ledger; the post-hoc raise in
+    # the woken worker must not stamp it again.
+    assert degrade.events().count("dispatch-timeout") == 1
+
+
+def test_thread_kill_hook_scopes_to_its_thread_and_nests():
+    """A hook registered on one thread never receives another thread's
+    expiry, and nested registrations restore the outer hook on exit."""
+    calls = []
+
+    def outer(exc):
+        calls.append("outer")
+
+    def inner(exc):
+        calls.append("inner")
+
+    def work():
+        with watchdog.thread_kill_hook(outer):
+            try:
+                with watchdog.thread_kill_hook(inner):
+                    with watchdog.deadline(0.2, what="inner guard"):
+                        time.sleep(0.4)
+            except watchdog.DispatchTimeout:
+                pass  # the late wake's post-hoc raise (expected)
+            # restored: the next expiry goes to the OUTER hook
+            try:
+                with watchdog.deadline(0.2, what="outer guard"):
+                    time.sleep(0.4)
+            except watchdog.DispatchTimeout:
+                pass
+
+    t = threading.Thread(target=work)
+    t.start()
+    t.join(10)
+    assert calls == ["inner", "outer"]
+
+
 def test_injected_hang_unarmed_is_noop():
     t0 = time.perf_counter()
     watchdog.injected_hang("dispatch_hang")
