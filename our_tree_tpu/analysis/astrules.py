@@ -38,7 +38,17 @@ try:
     from ..resilience.faults import KNOWN_POINTS
 except Exception:  # pragma: no cover - only on a broken tree
     KNOWN_POINTS = ("init_hang", "dispatch_fail", "build_fail", "lock_busy",
-                    "dispatch_hang", "unit_crash", "serve_dispatch")
+                    "dispatch_hang", "unit_crash", "serve_dispatch",
+                    "lane_fail", "lane_hang", "dispatch_slow")
+
+# The live metrics label-key allowlist (obs/metrics.py, also
+# stdlib-only) — same live-registry-with-frozen-fallback pattern.
+try:
+    from ..obs.metrics import ALLOWED_LABEL_KEYS
+except Exception:  # pragma: no cover - only on a broken tree
+    ALLOWED_LABEL_KEYS = ("lane", "rung", "engine", "outcome", "bucket",
+                          "code", "state", "slots", "point", "kind",
+                          "mode")
 
 
 @dataclass
@@ -364,7 +374,7 @@ def _check_trace_attrs(ctx: FileContext):
 # ---------------------------------------------------------------------------
 
 _FAULT_METHODS = ("fire", "check", "check_lane", "scoped", "consume",
-                  "remaining", "injected_hang")
+                  "remaining", "injected_hang", "injected_slow")
 
 
 def _check_fault_points(ctx: FileContext):
@@ -389,6 +399,85 @@ def _check_fault_points(ctx: FileContext):
                 f"faults.KNOWN_POINTS {tuple(KNOWN_POINTS)}: an "
                 "unregistered seam silently never fires, making fault "
                 "CI vacuously green — register it in faults.py first")
+
+
+# ---------------------------------------------------------------------------
+# metrics-labels: registry labels from the fixed allowlist, values
+# statically low-cardinality
+# ---------------------------------------------------------------------------
+
+_METRIC_METHODS = ("counter", "gauge", "gauge_max", "observe")
+#: Keyword args that are the metric's VALUE, not labels.
+_METRIC_VALUE_KWARGS = ("n", "value")
+#: Identifier fragments that statically smell like unbounded
+#: cardinality: a label value built from any of these turns the
+#: process-global registry into a per-request/per-tenant memory leak
+#: (and, for tenant/digest, leaks tenant identity into the /metrics
+#: surface). Matched against "_"-split identifier parts, so `lane.idx`
+#: passes while `req.id` and `tenant_digest` flag.
+_HIGH_CARDINALITY_PARTS = frozenset(
+    ("tenant", "digest", "nonce", "uuid", "id", "ids", "req", "request",
+     "label", "token", "payload"))
+
+
+def _high_cardinality_reason(node: ast.AST) -> str | None:
+    """Why a label-value expression is provably high-cardinality, or
+    None. Constants always pass (a literal is one value); f-strings
+    always flag (string-assembly is the request-id idiom); otherwise
+    every identifier mentioned is screened against the deny fragments."""
+    if isinstance(node, ast.Constant):
+        return None
+    if isinstance(node, ast.JoinedStr):
+        return "f-string label value (per-call string assembly)"
+    for n in ast.walk(node):
+        for name in (getattr(n, "id", ""), getattr(n, "attr", "")):
+            if not name:
+                continue
+            parts = name.lower().split("_")
+            hit = _HIGH_CARDINALITY_PARTS.intersection(parts)
+            if hit:
+                return f"derived from `{name}` ({sorted(hit)[0]})"
+    return None
+
+
+def _check_metrics_labels(ctx: FileContext):
+    if ctx.is_file("obs/metrics.py"):
+        return  # the registry's own internals
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if not (isinstance(func, ast.Attribute)
+                and func.attr in _METRIC_METHODS):
+            continue
+        recv = _dotted(func.value)
+        if "metrics" not in recv:
+            continue
+        for kw in node.keywords:
+            if kw.arg is None:
+                yield node, (
+                    "metrics call with a **splat: label keys must be "
+                    "statically visible so the allowlist check means "
+                    "something — spell the labels out")
+                continue
+            if kw.arg in _METRIC_VALUE_KWARGS:
+                continue
+            if kw.arg not in ALLOWED_LABEL_KEYS:
+                yield node, (
+                    f"metrics label key `{kw.arg}` is not in "
+                    f"obs.metrics.ALLOWED_LABEL_KEYS "
+                    f"{tuple(ALLOWED_LABEL_KEYS)}: labels multiply "
+                    "series in a process-global registry — extend the "
+                    "allowlist deliberately or drop the label")
+                continue
+            why = _high_cardinality_reason(kw.value)
+            if why:
+                yield node, (
+                    f"metrics label `{kw.arg}` value looks "
+                    f"high-cardinality: {why}. Request ids and tenant "
+                    "digests as label values grow the registry without "
+                    "bound (and leak identity onto /metrics) — label "
+                    "with closed enums, count identity-free")
 
 
 # ---------------------------------------------------------------------------
@@ -473,6 +562,12 @@ RULES: tuple[Rule, ...] = (
          "consume/remaining and watchdog.injected_hang must be registered "
          "KNOWN_POINTS.",
          _check_fault_points),
+    Rule("metrics-labels", "error",
+         "obs.metrics label keys must come from ALLOWED_LABEL_KEYS and "
+         "label values must be statically low-cardinality (no request "
+         "ids, tenant digests, or f-strings) — the registry must never "
+         "become an unbounded-cardinality memory leak.",
+         _check_metrics_labels),
     Rule("serve-lane-seam", "error",
          "Dispatch in serve/ (scattered-CTR calls incl. the multi-key "
          "seam, the native host tier, block_until_ready, device_put) "

@@ -18,14 +18,30 @@ per worker; this package gives every run the same per-phase attribution:
   attempt. Stdlib-only and bare-loadable like ``resilience/degrade.py``
   (registered in ``sys.modules`` under its canonical dotted name so the
   counters stay one-per-process across bare and package contexts).
-* ``export`` — run-dir parsing (schema validation, begin/end span
-  pairing, orphan detection — an orphaned span IS the evidence of a
-  SIGKILLed child) and the Chrome/Perfetto ``trace.json`` exporter.
+* ``metrics`` — the LIVE half of the telemetry plane: a process-global
+  registry of exact O(1) counters / gauges / log2-bucket histograms
+  with small closed label tuples, flushed as periodic
+  ``metrics-<pid>.jsonl`` snapshots into the trace run dir and rendered
+  as Prometheus text by the serve status endpoint. Exact even when span
+  tracing is head-sampled (``OT_TRACE_SAMPLE`` — the saturation-run
+  knob: steady-state spans mostly vanish, abnormal outcomes
+  force-sample, the registry counts everything). Also the repo's one
+  percentile implementation (exact nearest-rank + interpolated from
+  log2 buckets).
+* ``slo`` — SLO regression gates: compare a serve run against a
+  committed ``SERVE_r*.json`` baseline with per-metric tolerances
+  (count metrics tolerate nothing); ``serve.bench --slo`` runs it
+  in-process, CI gates against ``SERVE_r04_control.json``.
+* ``export`` — run-dir parsing (schema validation for spans AND metrics
+  snapshots, begin/end span pairing, orphan detection — an orphaned
+  span IS the evidence of a SIGKILLed child) and the Chrome/Perfetto
+  ``trace.json`` exporter (snapshot gauges become counter tracks).
 * ``report`` — ``python -m our_tree_tpu.obs.report <run-dir>``: per-unit
   wall/device time, retries, faults injected vs. observed,
-  degradations, quarantines, and the slowest-span table; ``--check``
-  fails on schema violations or orphaned spans (the CI gate);
-  ``--trace-json`` writes the Perfetto export.
+  degradations, quarantines, the slowest-span table, and the metrics
+  table (counter totals, gauge last-values, histogram percentiles);
+  ``--check`` fails on schema violations or orphaned spans (the CI
+  gate); ``--trace-json`` writes the Perfetto export.
 
 The instrumented seams, the event schema, and the Perfetto how-to are
 documented in docs/OBSERVABILITY.md.

@@ -10,6 +10,17 @@ a half-written file from a killed child must not hide the rest of the
 run). ``write_chrome_trace`` emits the Trace Event Format JSON that
 both ``chrome://tracing`` and https://ui.perfetto.dev open directly.
 
+The run dir may also carry ``metrics-*.jsonl`` files — the registry
+flusher's periodic cumulative snapshots (``obs/metrics.py``). They are
+parsed alongside the trace files with the same violations-not-raised
+discipline (``--check`` gates their schema exactly like span events):
+``Run.snapshots`` keeps the time series (each annotated with its pid),
+``Run.metrics_totals()`` folds the LAST snapshot per process into final
+counter totals / gauge last-values / merged histograms — what the
+report's metrics table renders — and the Perfetto export emits every
+snapshot gauge (``serve_inflight``, queue depth, ...) as a counter
+track, so the registry's view rides the same timeline as the spans.
+
 Stdlib-only, no intra-package imports (the report CLI and tests load it
 without jax in sight).
 """
@@ -31,6 +42,12 @@ _REQUIRED = {
     "g": ("name", "ts", "value"),
     "p": ("name", "ts"),
 }
+
+#: Required fields per metrics snapshot line, and the shape of each
+#: series entry ([name, {labels}, value-or-hist]) — obs/metrics.py's
+#: ``_snapshot_rec`` schema, gated by --check like span events.
+_SNAP_SECTIONS = ("counters", "gauges", "hists")
+METRICS_KIND = "ot-metrics"
 
 
 class SpanRec:
@@ -70,6 +87,12 @@ class Run:
         self.spans: dict[str, SpanRec] = {}
         self.events: list[dict] = []
         self.procs: dict[int, dict] = {}
+        #: proc token -> metrics-file header, and the snapshot time
+        #: series (cumulative; each annotated with "pid" and "proc" —
+        #: the token is the aggregation key, like the trace side, so
+        #: pid reuse across a long run cannot merge two processes).
+        self.metric_procs: dict[str, dict] = {}
+        self.snapshots: list[dict] = []
         self.violations: list[tuple[str, int, str]] = []
         self.t0: int | None = None
         self.t1: int | None = None
@@ -106,6 +129,48 @@ class Run:
             cur = self.spans.get(cur.parent) if cur.parent else None
         return None
 
+    def metrics_totals(self) -> dict:
+        """Final registry totals across the run's processes: the LAST
+        snapshot per pid (snapshots are cumulative), counters and
+        histogram buckets SUMMED across pids, gauges last-write by
+        snapshot timestamp. Keys are ``name`` / ``name{k=v,...}`` flat
+        series names (obs.metrics.flat_name layout); hist values are
+        {"buckets", "count", "sum"}."""
+        last: dict[str, dict] = {}
+        for snap in self.snapshots:
+            # Keyed by the PROC TOKEN, not the pid: snapshots are
+            # cumulative PER PROCESS, and a reused pid late in a soak
+            # would otherwise silently replace (and so drop) the dead
+            # process's final totals — the same reuse hazard the trace
+            # file names absorb with their 8-hex token.
+            proc = snap.get("proc", str(snap.get("pid", -1)))
+            if proc not in last or snap.get("ts", 0) >= last[proc].get(
+                    "ts", 0):
+                last[proc] = snap
+        counters: dict[str, float] = {}
+        gauges: dict[str, tuple] = {}
+        hists: dict[str, dict] = {}
+        for _proc, snap in sorted(last.items()):
+            ts = snap.get("ts", 0)
+            for name, labels, v in snap.get("counters", []):
+                key = _flat(name, labels)
+                counters[key] = counters.get(key, 0) + v
+            for name, labels, v in snap.get("gauges", []):
+                key = _flat(name, labels)
+                if key not in gauges or ts >= gauges[key][0]:
+                    gauges[key] = (ts, v)
+            for name, labels, h in snap.get("hists", []):
+                key = _flat(name, labels)
+                agg = hists.setdefault(
+                    key, {"buckets": {}, "count": 0, "sum": 0.0})
+                for b, c in h.get("buckets", {}).items():
+                    agg["buckets"][b] = agg["buckets"].get(b, 0) + c
+                agg["count"] += h.get("count", 0)
+                agg["sum"] += h.get("sum", 0.0)
+        return {"counters": counters,
+                "gauges": {k: v for k, (_, v) in gauges.items()},
+                "hists": hists}
+
 
 def _segment_order(path: str):
     """Sort key putting a process's rotated segments in WRITE order.
@@ -123,10 +188,86 @@ def _segment_order(path: str):
     return (name, 0)
 
 
+def _flat(name, labels) -> str:
+    """The flat series key (obs.metrics.flat_name layout, duplicated
+    here because this module stays import-free of its siblings)."""
+    if not labels:
+        return str(name)
+    inner = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+    return f"{name}{{{inner}}}"
+
+
+def _valid_series(entry, hist: bool) -> bool:
+    """One snapshot series entry: [name, {labels}, number-or-hist]."""
+    if not (isinstance(entry, (list, tuple)) and len(entry) == 3):
+        return False
+    name, labels, v = entry
+    if not isinstance(name, str) or not isinstance(labels, dict):
+        return False
+    if hist:
+        return (isinstance(v, dict)
+                and isinstance(v.get("buckets"), dict)
+                and isinstance(v.get("count"), int))
+    return isinstance(v, (int, float))
+
+
+def _load_metrics_file(run: Run, path: str) -> None:
+    """Parse one ``metrics-*.jsonl`` snapshot file into ``run`` with the
+    same violations-not-raised discipline as the trace files."""
+    fname = os.path.basename(path)
+    pid, proc = -1, "?"
+    with open(path, "r", encoding="utf-8") as fh:
+        for lineno, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                run.violations.append((fname, lineno, "unparseable"))
+                continue
+            if lineno == 1:
+                if rec.get("kind") != METRICS_KIND or rec.get("v") != 1:
+                    run.violations.append(
+                        (fname, 1, "bad or missing metrics header"))
+                    break
+                pid = rec.get("pid", -1)
+                proc = str(rec.get("proc", pid))
+                run.metric_procs[proc] = rec
+                run._see(rec.get("start_us"))
+                continue
+            if not isinstance(rec.get("ts"), int):
+                run.violations.append(
+                    (fname, lineno, "snapshot missing ts"))
+                continue
+            bad = [s for s in _SNAP_SECTIONS
+                   if not isinstance(rec.get(s), list)]
+            if bad:
+                run.violations.append(
+                    (fname, lineno, f"snapshot missing {bad}"))
+                continue
+            malformed = (
+                [e for s in ("counters", "gauges")
+                 for e in rec[s] if not _valid_series(e, hist=False)]
+                + [e for e in rec["hists"]
+                   if not _valid_series(e, hist=True)])
+            if malformed:
+                run.violations.append(
+                    (fname, lineno,
+                     f"malformed series entry {malformed[0]!r}"))
+                continue
+            run._see(rec["ts"])
+            rec["pid"], rec["proc"] = pid, proc
+            run.snapshots.append(rec)
+
+
 def load_run(run_dir: str) -> Run:
-    """Parse every ``trace-*.jsonl`` under ``run_dir`` into a ``Run``
+    """Parse every ``trace-*.jsonl`` (and ``metrics-*.jsonl``) under
+    ``run_dir`` into a ``Run``
     (a process's rotated segments in write order — ``_segment_order``)."""
     run = Run()
+    for path in sorted(glob.glob(os.path.join(run_dir, "metrics-*.jsonl"))):
+        _load_metrics_file(run, path)
     for path in sorted(glob.glob(os.path.join(run_dir, "trace-*.jsonl")),
                        key=_segment_order):
         fname = os.path.basename(path)
@@ -229,6 +370,16 @@ def to_chrome_trace(run: Run) -> dict:
             out.append({"ph": "C", "name": e["name"], "pid": e["pid"],
                         "ts": e["ts"] - t0,
                         "args": {"value": e.get("value", 0)}})
+    # Registry snapshot gauges as counter tracks ("metrics:" prefixed so
+    # the flusher's 2 s samples sit beside, not inside, the per-event
+    # trace tracks): serve_inflight and serve_queue_depth become visible
+    # ON the span timeline — queue pressure lined up against the
+    # dispatches that caused it, at any OT_TRACE_SAMPLE rate.
+    for snap in sorted(run.snapshots, key=lambda s: s["ts"]):
+        for name, labels, v in snap.get("gauges", []):
+            out.append({"ph": "C", "name": f"metrics:{_flat(name, labels)}",
+                        "pid": snap.get("pid", -1), "ts": snap["ts"] - t0,
+                        "args": {"value": v}})
     return {"traceEvents": out, "displayTimeUnit": "ms"}
 
 

@@ -1,0 +1,534 @@
+"""The live metrics plane: a process-global, lock-cheap registry.
+
+The tracer (``obs/trace.py``) answers *what happened, span by span* —
+and pays one flushed JSONL write per event for it. At the serve rates
+the TPU saturation run targets (~4-9k requests/s at 4 MiB) that price
+is both an overhead hazard on the hot path and, once spans are SAMPLED
+(``OT_TRACE_SAMPLE``), a completeness hazard: a sampled stream cannot
+answer "how many requests, exactly". This module is the other half of
+the telemetry plane:
+
+* **Counters** — monotonic totals (``counter(name, n, **labels)``).
+* **Gauges** — last-write values (``gauge``), plus a high-water variant
+  (``gauge_max``) for peaks like queue depth.
+* **Histograms** — fixed log2 buckets (``observe``): value ``v`` lands
+  in bucket ``b`` where ``2^(b-1) <= v < 2^b``, so a latency or size
+  distribution is ~40 small ints however long the run. Percentiles are
+  interpolated from the buckets (``percentile_from_buckets``).
+
+Every hot-path operation is one dict update under one lock — **no
+I/O** — so the registry stays EXACT while span tracing samples: the
+counters are the ground truth the sampled trace is reconciled against.
+Labels are small closed tuples (lane, rung, engine, outcome, ...):
+``ALLOWED_LABEL_KEYS`` is the contract otlint's ``metrics-labels`` rule
+enforces statically — no request ids, no tenant digests — and
+``_MAX_SERIES`` bounds the per-name series count at runtime, so the
+registry can never become an unbounded-cardinality memory leak.
+
+Durability is a single daemon FLUSHER thread: when tracing is enabled
+(``OT_TRACE_DIR``) it appends cumulative snapshots of the whole
+registry to ``metrics-<pid>-<tok>.jsonl`` in the same run directory the
+trace files use, every ``OT_METRICS_FLUSH_S`` seconds (default 2) and
+once at exit — the LAST snapshot is the final totals, the series of
+snapshots is the time axis ``obs.export`` turns into Perfetto counter
+tracks. ``obs.report`` renders the table; ``serve/status.py`` renders
+the same registry as Prometheus text for ``/metrics``.
+
+Same constitution as the tracer: **never raises** (a full disk or a
+bad label degrades to a dropped update, counted in ``dropped``),
+stdlib-only, no intra-package imports (the trace module is loaded
+lazily under its canonical name for the run-dir layout), and
+``reset_for_tests()`` for process-global state hygiene.
+
+This module is also the repo's ONE percentile implementation
+(``percentile_exact`` from full samples — ``serve/loadgen.py``
+delegates here — and ``percentile_from_buckets`` for registry
+histograms, used by ``obs.report`` and ``serve.bench``).
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import math
+import os
+import sys
+import threading
+import time
+import uuid
+
+KIND = "ot-metrics"
+VERSION = 1
+
+#: The closed label-key vocabulary. otlint's ``metrics-labels`` rule
+#: checks every ``metrics.*(**labels)`` call site against this tuple —
+#: a label key outside it, or a statically high-cardinality label VALUE
+#: (request ids, tenant digests, f-strings), is a lint error: labels
+#: multiply series, and series live forever in a process-global dict.
+ALLOWED_LABEL_KEYS = ("lane", "rung", "engine", "outcome", "bucket",
+                      "code", "state", "slots", "point", "kind", "mode")
+
+#: Runtime backstop for the same hazard the lint rule prevents
+#: statically: at most this many distinct label sets per metric name —
+#: updates beyond it are dropped (and counted), never stored.
+_MAX_SERIES = 64
+
+_LOCK = threading.Lock()
+#: (name, ((k, v), ...)) -> total / last value / _Hist.
+_COUNTS: dict[tuple, float] = {}
+_GAUGES: dict[tuple, float] = {}
+_HISTS: dict[tuple, "_Hist"] = {}
+#: name -> live series count (the _MAX_SERIES ledger).
+_SERIES: dict[str, int] = {}
+_DROPPED = 0
+
+#: Lazily-opened snapshot file state {"run","fh","path"}; None until the
+#: first flush. Mirrors trace._STATE (reopens on a run-id change).
+_SINK: dict | None = None
+_FLUSHER: threading.Thread | None = None
+_ATEXIT_REGISTERED = False
+
+
+class _Hist:
+    """One log2-bucket histogram series: bucket exponent -> count, plus
+    exact count/sum so means and Prometheus ``_sum``/``_count`` stay
+    bucket-error-free."""
+
+    __slots__ = ("buckets", "count", "sum")
+
+    def __init__(self):
+        self.buckets: dict[int, int] = {}
+        self.count = 0
+        self.sum = 0.0
+
+
+def _trace():
+    """our_tree_tpu.obs.trace under its canonical dotted name, lazily
+    (the run-dir layout — run id, directory — is the tracer's; metrics
+    files live beside the trace files). None when unloadable: the
+    registry keeps counting in memory either way."""
+    canonical = "our_tree_tpu.obs.trace"
+    mod = sys.modules.get(canonical)
+    if mod is None:
+        try:
+            import importlib.util
+            spec = importlib.util.spec_from_file_location(
+                canonical, os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)), "trace.py"))
+            mod = importlib.util.module_from_spec(spec)
+            sys.modules[canonical] = mod
+            spec.loader.exec_module(mod)
+        except Exception:
+            sys.modules.pop(canonical, None)
+            return None
+    return mod
+
+
+def enabled() -> bool:
+    """Snapshot flushing is on iff tracing is (``OT_TRACE_DIR``): the
+    registry itself always counts — in-memory dict updates are the
+    whole hot-path cost either way."""
+    return bool(os.environ.get("OT_TRACE_DIR"))
+
+
+def flush_interval_s() -> float:
+    try:
+        return max(
+            float(os.environ.get("OT_METRICS_FLUSH_S", 2.0) or 2.0), 0.05)
+    except ValueError:
+        return 2.0
+
+
+def _key(name: str, labels: dict) -> tuple | None:
+    """The series key, or None when the series budget for ``name`` is
+    spent (caller drops). Caller holds no lock; the budget check runs
+    under _LOCK inside the mutators."""
+    if not labels:
+        return (name, ())
+    return (name, tuple(sorted(labels.items())))
+
+
+def _admit_locked(store: dict, key: tuple) -> bool:
+    """Series-cardinality backstop; caller holds _LOCK."""
+    if key in store:
+        return True
+    name = key[0]
+    n = _SERIES.get(name, 0)
+    if n >= _MAX_SERIES:
+        return False
+    _SERIES[name] = n + 1
+    return True
+
+
+def counter(name: str, n: float = 1, **labels) -> None:
+    """Add ``n`` to the named counter series. O(1), no I/O, exact."""
+    global _DROPPED
+    try:
+        key = _key(name, labels)
+        with _LOCK:
+            if not _admit_locked(_COUNTS, key):
+                _DROPPED += 1
+                return
+            _COUNTS[key] = _COUNTS.get(key, 0) + n
+    except Exception:  # noqa: BLE001 - never-raises contract
+        _DROPPED += 1
+
+
+def gauge(name: str, value: float, **labels) -> None:
+    """Set the named gauge series (last write wins)."""
+    global _DROPPED
+    try:
+        key = _key(name, labels)
+        with _LOCK:
+            if not _admit_locked(_GAUGES, key):
+                _DROPPED += 1
+                return
+            _GAUGES[key] = value
+    except Exception:  # noqa: BLE001 - never-raises contract
+        _DROPPED += 1
+
+
+def gauge_max(name: str, value: float, **labels) -> None:
+    """Raise the named gauge to ``value`` if higher (high-water marks:
+    queue depth peaks, in-flight peaks)."""
+    global _DROPPED
+    try:
+        key = _key(name, labels)
+        with _LOCK:
+            if not _admit_locked(_GAUGES, key):
+                _DROPPED += 1
+                return
+            if value > _GAUGES.get(key, float("-inf")):
+                _GAUGES[key] = value
+    except Exception:  # noqa: BLE001 - never-raises contract
+        _DROPPED += 1
+
+
+def bucket_of(value: float) -> int:
+    """The log2 bucket exponent of ``value``: bucket ``b >= 1`` spans
+    ``[2^(b-1), 2^b)`` (``int(value).bit_length()``); bucket 0 holds
+    everything below 1, non-positive values included."""
+    v = int(value)
+    return v.bit_length() if v >= 1 else 0
+
+
+def observe(name: str, value: float, **labels) -> None:
+    """Record one histogram observation in fixed log2 buckets."""
+    global _DROPPED
+    try:
+        b = bucket_of(value)
+        key = _key(name, labels)
+        with _LOCK:
+            if not _admit_locked(_HISTS, key):
+                _DROPPED += 1
+                return
+            h = _HISTS.get(key)
+            if h is None:
+                h = _HISTS[key] = _Hist()
+            h.buckets[b] = h.buckets.get(b, 0) + 1
+            h.count += 1
+            h.sum += float(value)
+    except Exception:  # noqa: BLE001 - never-raises contract
+        _DROPPED += 1
+
+
+# ---------------------------------------------------------------------------
+# Percentiles: the repo's one implementation (satellite: bench + report
+# used to each carry their own).
+# ---------------------------------------------------------------------------
+
+
+def percentile_exact(sorted_vals, p: float) -> float:
+    """Nearest-rank percentile over a full SORTED sample (0 < p <= 100).
+
+    The exact method ``serve/loadgen.py`` always used (no binning error
+    at the tail); it now lives here so the bench and the report cannot
+    drift apart."""
+    n = len(sorted_vals)
+    if not n:
+        return 0.0
+    rank = max(math.ceil(p / 100.0 * n), 1)
+    return sorted_vals[min(rank, n) - 1]
+
+
+def percentile_from_buckets(buckets: dict, p: float) -> float:
+    """Percentile interpolated from a log2-bucket histogram.
+
+    ``buckets`` maps bucket exponent -> count (``bucket_of`` layout; str
+    keys from a JSON snapshot are accepted). Linear interpolation inside
+    the covering bucket ``[2^(b-1), 2^b)`` — the standard Prometheus
+    histogram_quantile estimate, with log2 buckets bounding the relative
+    error at 2x worst-case (the price of O(1) hot-path observation)."""
+    items = sorted((int(b), int(c)) for b, c in buckets.items() if c)
+    total = sum(c for _, c in items)
+    if not total:
+        return 0.0
+    rank = max(math.ceil(p / 100.0 * total), 1)
+    seen = 0
+    for b, c in items:
+        if seen + c >= rank:
+            lo = 0.0 if b == 0 else float(1 << (b - 1))
+            hi = 1.0 if b == 0 else float(1 << b)
+            return lo + (hi - lo) * (rank - seen) / c
+        seen += c
+    return float(1 << items[-1][0])  # unreachable (rank <= total)
+
+
+def merge_buckets(hists) -> dict:
+    """Sum bucket dicts (e.g. one histogram name across label sets or
+    processes) into one {exponent: count} dict."""
+    out: dict[int, int] = {}
+    for b in hists:
+        for k, v in b.items():
+            k = int(k)
+            out[k] = out.get(k, 0) + int(v)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Snapshots.
+# ---------------------------------------------------------------------------
+
+
+def _label_str(labels: tuple) -> str:
+    return ",".join(f"{k}={v}" for k, v in labels)
+
+
+def flat_name(name: str, labels: tuple) -> str:
+    """``name{k=v,...}`` — the human-facing series key used in artifact
+    JSON and the report table."""
+    return f"{name}{{{_label_str(labels)}}}" if labels else name
+
+
+def snapshot() -> dict:
+    """The registry as one JSON-clean dict (flat series keys): what the
+    serve bench stamps into SERVE_r*.json and what /healthz consumers
+    see. Histograms carry buckets + count + sum; percentile rendering
+    is the reader's (``percentile_from_buckets``)."""
+    with _LOCK:
+        counts = {flat_name(n, l): v for (n, l), v in _COUNTS.items()}
+        gauges = {flat_name(n, l): v for (n, l), v in _GAUGES.items()}
+        hists = {flat_name(n, l): {
+            "buckets": {str(b): c for b, c in sorted(h.buckets.items())},
+            "count": h.count, "sum": round(h.sum, 3)}
+            for (n, l), h in _HISTS.items()}
+    out: dict = {"counters": dict(sorted(counts.items())),
+                 "gauges": dict(sorted(gauges.items())),
+                 "hists": dict(sorted(hists.items()))}
+    if _DROPPED:
+        out["dropped"] = _DROPPED
+    return out
+
+
+def _snapshot_rec(ts_us: int) -> dict:
+    """One structured snapshot line for the metrics JSONL (lists of
+    [name, {labels}, value] — the schema ``obs.export`` validates)."""
+    with _LOCK:
+        counters = [[n, dict(l), v] for (n, l), v in sorted(_COUNTS.items())]
+        gauges = [[n, dict(l), v] for (n, l), v in sorted(_GAUGES.items())]
+        hists = [[n, dict(l),
+                  {"buckets": {str(b): c
+                               for b, c in sorted(h.buckets.items())},
+                   "count": h.count, "sum": round(h.sum, 3)}]
+                 for (n, l), h in sorted(_HISTS.items())]
+    rec = {"ts": ts_us, "counters": counters, "gauges": gauges,
+           "hists": hists}
+    if _DROPPED:
+        rec["dropped"] = _DROPPED
+    return rec
+
+
+def _sink() -> dict | None:
+    """Open (or reopen after a run-id change) the per-process metrics
+    snapshot file, header line included. None while disabled or
+    unwritable — the registry keeps counting regardless."""
+    global _SINK, _DROPPED
+    t = _trace()
+    if t is None or not enabled():
+        return None
+    run = t.ensure_run()
+    if _SINK is not None and _SINK["run"] == run:
+        return _SINK
+    _close_sink()
+    try:
+        d = t.run_dir()
+        os.makedirs(d, exist_ok=True)
+        tok = uuid.uuid4().hex[:8]
+        path = os.path.join(d, f"metrics-{os.getpid()}-{tok}.jsonl")
+        fh = open(path, "a", encoding="utf-8")
+        header = {"kind": KIND, "v": VERSION, "run": run,
+                  "pid": os.getpid(), "proc": tok,
+                  "interval_s": flush_interval_s(),
+                  "start_us": time.time_ns() // 1000}
+        fh.write(json.dumps(header, separators=(",", ":")) + "\n")
+        fh.flush()
+        _SINK = {"run": run, "fh": fh, "path": path}
+        return _SINK
+    except OSError:
+        _DROPPED += 1
+        return None
+
+
+def _close_sink() -> None:
+    global _SINK
+    if _SINK is not None:
+        try:
+            _SINK["fh"].close()
+        except OSError:
+            pass
+        _SINK = None
+
+
+def flush_now() -> bool:
+    """Append one cumulative snapshot line (True on success). Callers
+    with a natural end-of-run (serve stop, bench exit) flush explicitly
+    so the final totals are on disk even if atexit never runs."""
+    global _DROPPED
+    try:
+        sink = _sink()
+        if sink is None:
+            return False
+        rec = _snapshot_rec(time.time_ns() // 1000)
+        sink["fh"].write(json.dumps(rec, separators=(",", ":")) + "\n")
+        sink["fh"].flush()
+        return True
+    except Exception:  # noqa: BLE001 - never-raises contract
+        _DROPPED += 1
+        return False
+
+
+def _flusher_loop() -> None:
+    while True:
+        time.sleep(flush_interval_s())
+        if enabled() and (_COUNTS or _GAUGES or _HISTS):
+            flush_now()
+
+
+def ensure_flusher() -> None:
+    """Start the single daemon flusher thread (idempotent, cheap to call
+    from hot-path modules' setup). Also registers the atexit final
+    flush, so even a run that ends between intervals leaves its last —
+    exact — totals on disk."""
+    global _FLUSHER, _ATEXIT_REGISTERED
+    if not _ATEXIT_REGISTERED:
+        _ATEXIT_REGISTERED = True
+        atexit.register(lambda: (enabled()
+                                 and (_COUNTS or _GAUGES or _HISTS)
+                                 and flush_now()))
+    if _FLUSHER is None or not _FLUSHER.is_alive():
+        _FLUSHER = threading.Thread(target=_flusher_loop, daemon=True,
+                                    name="ot-metrics-flush")
+        _FLUSHER.start()
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text rendering (the /metrics endpoint's body).
+# ---------------------------------------------------------------------------
+
+
+def _prom_name(name: str) -> str:
+    return "".join(c if c.isalnum() or c == "_" else "_" for c in name)
+
+
+def _prom_num(v: float) -> str:
+    """Full-precision sample rendering. ``%g`` would quantize to 6
+    significant digits — a byte counter in the hundreds of MB could
+    grow by thousands between scrapes while rendering the identical
+    string, making scrape-side ``rate()`` read 0 and breaking the
+    registry's exactness promise exactly where operators consume it."""
+    if isinstance(v, bool):
+        return str(int(v))
+    if isinstance(v, int):
+        return str(v)
+    if isinstance(v, float) and v.is_integer() and abs(v) < 2 ** 63:
+        return str(int(v))
+    return repr(float(v))
+
+
+def _prom_labels(labels, extra: str = "") -> str:
+    parts = [f'{_prom_name(str(k))}="{v}"' for k, v in labels]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def render_prometheus() -> str:
+    """The registry in Prometheus exposition text format (v0.0.4).
+
+    Counters render as ``<name>_total``, gauges raw, histograms as
+    cumulative ``_bucket{le=...}`` series over the log2 bounds plus
+    ``_sum``/``_count`` — directly scrapeable, no client library."""
+    lines: list[str] = []
+    with _LOCK:
+        counts = sorted(_COUNTS.items())
+        gauges = sorted(_GAUGES.items())
+        hists = sorted((k, {"buckets": dict(h.buckets),
+                            "count": h.count, "sum": h.sum})
+                       for k, h in _HISTS.items())
+    seen: set[str] = set()
+    for (name, labels), v in counts:
+        pn = _prom_name(name) + "_total"
+        if pn not in seen:
+            seen.add(pn)
+            lines.append(f"# TYPE {pn} counter")
+        lines.append(f"{pn}{_prom_labels(labels)} {_prom_num(v)}")
+    for (name, labels), v in gauges:
+        pn = _prom_name(name)
+        if pn not in seen:
+            seen.add(pn)
+            lines.append(f"# TYPE {pn} gauge")
+        lines.append(f"{pn}{_prom_labels(labels)} {_prom_num(v)}")
+    for (name, labels), h in hists:
+        pn = _prom_name(name)
+        if pn not in seen:
+            seen.add(pn)
+            lines.append(f"# TYPE {pn} histogram")
+        cum = 0
+        for b, c in sorted(h["buckets"].items()):
+            cum += c
+            le = 'le="%d"' % (1 << b if b else 1)
+            lines.append(f"{pn}_bucket{_prom_labels(labels, le)} {cum}")
+        inf = _prom_labels(labels, 'le="+Inf"')
+        lines.append(f"{pn}_bucket{inf} {h['count']}")
+        sum_s = _prom_num(h['sum'])
+        lines.append(f"{pn}_sum{_prom_labels(labels)} {sum_s}")
+        lines.append(f"{pn}_count{_prom_labels(labels)} {h['count']}")
+    if _DROPPED:
+        lines.append("# TYPE ot_metrics_dropped_total counter")
+        lines.append(f"ot_metrics_dropped_total {_DROPPED}")
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# Introspection helpers + test hygiene.
+# ---------------------------------------------------------------------------
+
+
+def counter_total(name: str) -> float:
+    """Sum of one counter name across all its label sets."""
+    with _LOCK:
+        return sum(v for (n, _), v in _COUNTS.items() if n == name)
+
+
+def hist_merged(name: str) -> dict:
+    """One histogram name's buckets merged across label sets."""
+    with _LOCK:
+        parts = [dict(h.buckets) for (n, _), h in _HISTS.items()
+                 if n == name]
+    return merge_buckets(parts)
+
+
+def dropped() -> int:
+    return _DROPPED
+
+
+def reset_for_tests() -> None:
+    """Clear every series and close the snapshot sink (tests only)."""
+    global _DROPPED
+    _close_sink()
+    with _LOCK:
+        _COUNTS.clear()
+        _GAUGES.clear()
+        _HISTS.clear()
+        _SERIES.clear()
+    _DROPPED = 0

@@ -38,6 +38,12 @@ counted as the kill it is (docs/SERVING.md) — plus a ``serve overlap``
 line reconstructing the ``serve_inflight`` gauge (the measured max
 dispatch concurrency) against a peak-concurrent-lane-spans sweep.
 
+When the run dir carries metrics snapshots (``metrics-*.jsonl``, the
+``obs/metrics.py`` flusher), the report also renders the METRICS table:
+final counter totals and gauge last-values across processes, and
+histogram p50/p95/p99 per label set interpolated from the log2 buckets
+— the exact view that stays complete when span tracing is sampled.
+
 ``<run-dir>`` is ``$OT_TRACE_DIR/<run-id>``; passing ``$OT_TRACE_DIR``
 itself picks the newest run inside it (and says so).
 """
@@ -50,6 +56,7 @@ import os
 import sys
 
 from . import export
+from . import metrics as _metrics
 
 #: Span names that count as device-seam time in the per-unit table
 #: (the tracer's analogue of the AES-multicore paper's per-phase,
@@ -306,6 +313,38 @@ def render(run: export.Run, top: int = 10, out=sys.stdout,
         out.write(f"\nserve overlap: max in-flight {peak_gauge} "
                   f"(gauge, {len(inflight)} samples), peak concurrent "
                   f"lane spans {peak_spans}\n")
+
+    # -- the metrics registry (final snapshot totals) ----------------------
+    # The flusher's cumulative snapshots (obs/metrics.py): counters
+    # summed across processes, gauges last-write, histogram percentiles
+    # interpolated from the log2 buckets. This table stays EXACT when
+    # span tracing is sampled — it is the reconciliation surface for a
+    # sampled run ("did we really serve N requests?").
+    if run.snapshots:
+        totals = run.metrics_totals()
+        out.write(f"\nmetrics ({len(run.snapshots)} snapshot(s) from "
+                  f"{len(run.metric_procs)} process(es)):\n")
+        if totals["counters"]:
+            _table([[k, f"{v:g}"]
+                    for k, v in sorted(totals["counters"].items())],
+                   ["counter", "total"], out)
+        if totals["gauges"]:
+            _table([[k, f"{v:g}"]
+                    for k, v in sorted(totals["gauges"].items())],
+                   ["gauge", "last"], out)
+        if totals["hists"]:
+            rows = []
+            for k, h in sorted(totals["hists"].items()):
+                b = h["buckets"]
+                rows.append([
+                    k, str(h["count"]),
+                    f"{_metrics.percentile_from_buckets(b, 50):.0f}",
+                    f"{_metrics.percentile_from_buckets(b, 95):.0f}",
+                    f"{_metrics.percentile_from_buckets(b, 99):.0f}",
+                    (f"{h['sum'] / h['count']:.0f}" if h["count"] else "-"),
+                ])
+            _table(rows, ["histogram", "count", "p50", "p95", "p99",
+                          "mean"], out)
 
     # -- faults: injected vs observed --------------------------------------
     injected: dict[str, int] = {}

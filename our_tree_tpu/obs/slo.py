@@ -1,0 +1,195 @@
+"""SLO regression gates: compare a serve run against a committed baseline.
+
+A committed ``SERVE_r*.json`` is a *promise* — p50/p95/p99, goodput,
+zero errors, zero lost, zero recompiles on a known host class — and
+until now nothing enforced it: a PR could halve serve goodput and every
+CI gate would stay green as long as correctness held. This module is
+the enforcement seam: ``compare(baseline, candidate, tolerances)``
+checks the candidate run's metrics against the baseline artifact with
+per-metric tolerances and names every violation, and
+``serve.bench --slo <baseline.json>`` runs it in-process after a drive
+(exit 1 on any regression — the CI gate against
+``SERVE_r04_control.json``).
+
+Metric classes, because regressions come in two shapes:
+
+* **Bounded-ratio metrics** (latency percentiles up, goodput down):
+  compared RELATIVELY — candidate latency may exceed baseline by at
+  most ``1 + tol``, goodput may fall below by at most ``1 - tol``.
+  Defaults are deliberately loose enough for same-host noise; CI
+  running on a different host class passes wider ``--slo-tolerance``
+  values (cross-host wall-clock is not a promise, order-of-magnitude
+  sanity is).
+* **Count metrics** (error total, lost, recompiles, probe mismatches):
+  compared ABSOLUTELY — the candidate may not exceed the baseline
+  count at all, tolerance ignored. A baseline with 0 errors means 0,
+  on any host: these are the metrics whose regression is a bug, not
+  noise.
+
+Baselines and candidates are both the SERVE artifact schema (the
+``load``/``queue``/``compiles`` sections) — ``extract`` also accepts
+the one-line bench JSON, so ``python -m our_tree_tpu.obs.slo
+baseline.json candidate.json`` gates recorded artifacts offline (the
+red/green rehearsal harness) with the same code path the bench uses
+live.
+
+Stdlib-only: the gate must run in CI steps that never import jax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+#: Relative tolerances for the bounded-ratio metrics: how much WORSE
+#: the candidate may be. Latency: candidate <= baseline * (1 + tol);
+#: goodput: candidate >= baseline * (1 - tol). Chosen for same-host
+#: rerun noise (the CPU container's serve numbers wobble ~10-15% at
+#: p99); cross-host CI overrides with wider values per metric.
+DEFAULT_TOLERANCES = {
+    "p50_ms": 0.50,
+    "p95_ms": 0.50,
+    "p99_ms": 0.75,
+    "goodput_gbps": 0.25,
+}
+
+#: Lower-is-better vs higher-is-better among the ratio metrics.
+_HIGHER_IS_BETTER = ("goodput_gbps",)
+
+#: Zero-noise count metrics: candidate must not exceed baseline, ever.
+COUNT_METRICS = ("errors_total", "lost", "recompiles", "mismatches")
+
+
+def extract(doc: dict) -> dict:
+    """Normalise a SERVE artifact (or the one-line bench JSON) into the
+    flat metric dict ``compare`` consumes."""
+    load = doc.get("load", doc)  # artifact nests under "load"; the
+    #                              bench line is already flat
+    out = {
+        "p50_ms": float(load.get("p50_ms", 0.0)),
+        "p95_ms": float(load.get("p95_ms", 0.0)),
+        "p99_ms": float(load.get("p99_ms", 0.0)),
+        "goodput_gbps": float(load.get("goodput_gbps", 0.0)),
+        "errors_total": float(sum((load.get("errors") or {}).values())),
+        "mismatches": float(load.get("mismatches", 0)),
+        "requests": float(load.get("requests", 0)),
+    }
+    if "queue" in doc:
+        out["lost"] = float(doc["queue"].get("lost", 0))
+    else:
+        out["lost"] = float(load.get("lost", 0))
+    if "compiles" in doc:
+        out["recompiles"] = float(doc["compiles"].get("steady", 0))
+    else:
+        out["recompiles"] = float(load.get("recompiles", 0))
+    return out
+
+
+def parse_tolerances(spec: str | None) -> dict:
+    """``p95_ms=2.0,goodput_gbps=0.5`` -> overrides merged over the
+    defaults. Unknown metric names are rejected (a typo'd override that
+    silently kept the default would gate the wrong thing)."""
+    tol = dict(DEFAULT_TOLERANCES)
+    for tok in (spec or "").split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        name, sep, val = tok.partition("=")
+        name = name.strip()
+        if not sep or name not in DEFAULT_TOLERANCES:
+            raise ValueError(
+                f"bad --slo-tolerance token {tok!r} "
+                f"(known: {', '.join(sorted(DEFAULT_TOLERANCES))})")
+        tol[name] = max(float(val), 0.0)
+    return tol
+
+
+def compare(baseline: dict, candidate: dict,
+            tolerances: dict | None = None) -> list[str]:
+    """Every SLO the candidate violates, as human-readable one-liners
+    (empty list = the gate is green). ``baseline``/``candidate`` are
+    ``extract`` outputs (call it first on raw artifacts)."""
+    tol = dict(DEFAULT_TOLERANCES)
+    tol.update(tolerances or {})
+    failures: list[str] = []
+    for name, t in sorted(tol.items()):
+        base = baseline.get(name, 0.0)
+        cand = candidate.get(name, 0.0)
+        if base <= 0:
+            continue  # nothing promised (e.g. a zero-latency stub row)
+        if name in _HIGHER_IS_BETTER:
+            floor = base * (1.0 - t)
+            if cand < floor:
+                failures.append(
+                    f"{name}: {cand:g} < {floor:g} "
+                    f"(baseline {base:g}, tolerance -{t:.0%})")
+        else:
+            ceil = base * (1.0 + t)
+            if cand > ceil:
+                failures.append(
+                    f"{name}: {cand:g} > {ceil:g} "
+                    f"(baseline {base:g}, tolerance +{t:.0%})")
+    for name in COUNT_METRICS:
+        base = baseline.get(name, 0.0)
+        cand = candidate.get(name, 0.0)
+        if cand > base:
+            failures.append(
+                f"{name}: {cand:g} > baseline {base:g} "
+                "(count metric: no tolerance)")
+    return failures
+
+
+def render(baseline: dict, candidate: dict, failures: list[str],
+           out=None, prefix: str = "# slo") -> None:
+    """The per-metric gate table, pass or fail, repo-`#`-line style."""
+    out = out if out is not None else sys.stdout  # bound at CALL time
+    for name in sorted(set(DEFAULT_TOLERANCES) | set(COUNT_METRICS)):
+        base = baseline.get(name, 0.0)
+        cand = candidate.get(name, 0.0)
+        bad = any(f.startswith(name + ":") for f in failures)
+        out.write(f"{prefix}: {name:<14} baseline={base:<10g} "
+                  f"run={cand:<10g} {'FAIL' if bad else 'ok'}\n")
+    for f in failures:
+        out.write(f"{prefix}: REGRESSION {f}\n")
+
+
+def gate(baseline_path: str, candidate_doc: dict,
+         tolerance_spec: str | None = None, out=None) -> int:
+    """Load the baseline artifact, compare, render, return the exit
+    code (0 green / 1 regression) — the ``serve.bench --slo`` body."""
+    out = out if out is not None else sys.stdout
+    with open(baseline_path, encoding="utf-8") as fh:
+        baseline = extract(json.load(fh))
+    candidate = extract(candidate_doc)
+    failures = compare(baseline, candidate,
+                       parse_tolerances(tolerance_spec))
+    render(baseline, candidate, failures, out=out)
+    if failures:
+        out.write(f"# slo: GATE FAILED against {baseline_path}: "
+                  f"{len(failures)} regression(s)\n")
+        return 1
+    out.write(f"# slo: gate passed against {baseline_path}\n")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m our_tree_tpu.obs.slo",
+        description="SLO regression gate between two SERVE_r*.json "
+                    "artifacts (docs/OBSERVABILITY.md)")
+    ap.add_argument("baseline", help="the committed promise")
+    ap.add_argument("candidate", help="the run under test (artifact or "
+                                      "bench JSON line file)")
+    ap.add_argument("--tolerance", default=None, metavar="SPEC",
+                    help="per-metric overrides, e.g. "
+                         "'p95_ms=2.0,goodput_gbps=0.5' (fractions of "
+                         "the baseline value)")
+    args = ap.parse_args(argv)
+    with open(args.candidate, encoding="utf-8") as fh:
+        cand = json.load(fh)
+    return gate(args.baseline, cand, args.tolerance)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -50,7 +50,14 @@ process token absorbs pid reuse).
 Long runs can cap their disk footprint with ``OT_TRACE_MAX_MB`` (see
 ``_max_bytes``): the event file rotates into ``-s<k>`` segments and the
 oldest segments are deleted, keeping the process under the cap at the
-cost of the evicted history — the soak-run tradeoff.
+cost of the evicted history — the soak-run tradeoff. High-rate serving
+additionally HEAD-SAMPLES its per-request lifecycle spans
+(``OT_TRACE_SAMPLE`` + ``sample()``/``maybe_span()``): the decision is
+made once per request at admission, an unsampled span costs two clock
+reads and no I/O, and abnormal outcomes force-materialise their spans
+retroactively so incident evidence — including the orphan-as-kill
+convention — survives any rate. The exact companion totals live in the
+sibling ``obs/metrics.py`` registry.
 
 Stdlib-only, no intra-package imports (bare-loadable by the jax-free
 sweep parents and the repo-root bench.py). Bare loaders must register
@@ -63,6 +70,7 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import sys
 import threading
 import time
@@ -90,6 +98,45 @@ _STATE: dict | None = None
 def enabled() -> bool:
     """Tracing is on iff ``OT_TRACE_DIR`` is set (the one switch)."""
     return bool(os.environ.get("OT_TRACE_DIR"))
+
+
+#: (raw env string, parsed rate) — one float parse per distinct value.
+_SAMPLE_CACHE: tuple[str, float] = ("", 1.0)
+
+
+def sample_rate() -> float:
+    """The head-sampling rate (``OT_TRACE_SAMPLE``), clamped to [0, 1].
+
+    Unset / 1 = every request's spans are traced (the pre-sampling
+    behaviour, and the right default for rehearsals and CI gates that
+    reconstruct complete runs). Below 1, per-REQUEST lifecycle spans are
+    emitted for the sampled fraction only — the saturation-run knob:
+    steady-state traffic pays near-zero trace cost while the metrics
+    registry (``obs/metrics.py``) stays exact and abnormal outcomes are
+    force-sampled (``maybe_span``). Sampling is decided per request at
+    admission, never per span, so one request's spans appear or vanish
+    together."""
+    global _SAMPLE_CACHE
+    raw = os.environ.get("OT_TRACE_SAMPLE", "")
+    cached_raw, cached = _SAMPLE_CACHE
+    if raw == cached_raw:
+        return cached
+    try:
+        rate = min(max(float(raw), 0.0), 1.0) if raw else 1.0
+    except ValueError:
+        rate = 1.0
+    _SAMPLE_CACHE = (raw, rate)
+    return rate
+
+
+def sample() -> bool:
+    """One head-sampling coin flip (the admission-time decision)."""
+    rate = sample_rate()
+    if rate >= 1.0:
+        return True
+    if rate <= 0.0:
+        return False
+    return random.random() < rate
 
 
 def _now_us() -> int:
@@ -369,6 +416,89 @@ class _SpanCM:
         self._span = None  # idempotent: a second exit writes nothing
         return False
 
+    def force(self):
+        """No-op on an eager span (it is already on disk) — the shared
+        surface with ``_DeferredSpanCM`` so force-sampling call sites
+        need no branch."""
+        return self._span
+
+
+class _DeferredSpanCM:
+    """An UNSAMPLED detached span: begin is captured, not written.
+
+    ``__enter__`` records the would-be begin (timestamp + parent) and
+    writes NOTHING — the sampled-out steady-state path costs two clock
+    reads and no I/O. The span materialises retroactively — begin
+    written late with the ORIGINAL timestamp — only when the outcome
+    turns out to matter:
+
+    * ``__exit__`` with an exception writes begin + error end (a failed
+      request/batch keeps full span evidence even when unsampled);
+    * ``force()`` writes the begin and leaves the span OPEN — the
+      force-sampling hook for the abandon-on-hang convention: a
+      watchdog-killed dispatch of an unsampled batch still leaves its
+      orphaned begin as kill evidence (``--expected-orphans``);
+    * ``__exit__`` clean with no prior ``force()`` writes nothing at
+      all — the sampled-out happy path.
+
+    This is what "abnormal outcomes are force-sampled" means
+    mechanically: head sampling decides the happy path's cost, the
+    failure paths decide for themselves, and the evidence contract of
+    ``obs.report --check`` survives any sample rate.
+    """
+
+    __slots__ = ("_name", "_attrs", "_ts", "_parent", "_span", "_done")
+
+    def __init__(self, name: str, attrs: dict):
+        self._name, self._attrs = name, attrs
+        self._ts: int | None = None
+        self._parent = None
+        self._span: Span | None = None
+        self._done = False
+
+    def __enter__(self):
+        self._ts = _now_us()
+        stack = getattr(_TLS, "stack", None)
+        self._parent = (stack[-1] if stack
+                        else os.environ.get("OT_TRACE_PARENT") or None)
+        return None  # like a disabled span: no live Span handle
+
+    def force(self) -> Span | None:
+        """Materialise the begin event (original timestamp) if it is not
+        on disk yet; idempotent. Returns the Span, or None when the
+        begin could not be written."""
+        global _SPANS_STARTED
+        if self._span is not None or self._done or self._ts is None:
+            return self._span
+        st = _state()
+        if st is None:
+            return None
+        with _LOCK:
+            st["seq"] += 1
+            sid = f"{st['proc']}.{st['seq']}"
+        _SPANS_STARTED += 1
+        rec = {"ev": "b", "id": sid, "parent": self._parent,
+               "name": self._name, "ts": self._ts, "tid": _tid()}
+        if self._attrs:
+            rec["attrs"] = self._attrs
+        _write(rec)
+        self._span = Span(sid, self._name)
+        return self._span
+
+    def __exit__(self, exc_type, exc, tb):
+        if self._done:
+            return False
+        if exc_type is not None:
+            self.force()
+        if self._span is not None:
+            status = ("ok" if exc_type is None
+                      else f"error:{exc_type.__name__}")
+            _write({"ev": "e", "id": self._span.id, "ts": _now_us(),
+                    "status": status})
+        self._done = True
+        self._span = None
+        return False
+
 
 class _NullCM:
     __slots__ = ()
@@ -378,6 +508,9 @@ class _NullCM:
 
     def __exit__(self, *exc):
         return False
+
+    def force(self):
+        return None
 
 
 _NULL = _NullCM()
@@ -411,6 +544,27 @@ def detached_span(name: str, **attrs):
     if not enabled():
         return _NULL
     return _SpanCM(name, attrs, detached=True)
+
+
+def maybe_span(sampled: bool, name: str, **attrs):
+    """A detached span gated by the request's head-sampling decision.
+
+    ``sampled=True`` (or rate 1, the default) is exactly
+    ``detached_span``. ``sampled=False`` returns a deferred span that
+    writes nothing on the happy path but still materialises — begin at
+    the ORIGINAL timestamp — when the region fails (``__exit__`` with an
+    exception) or when a call site force-samples it (``force()``: the
+    hang/abandon path, where the orphaned begin IS the evidence). The
+    serve path threads one admission-time ``trace.sample()`` decision
+    through request -> batch -> dispatch so a batch's spans are emitted
+    iff it carries a sampled rider, with abnormal outcomes (deadline,
+    dispatch failure, watchdog kill, redispatch) always on disk.
+    """
+    if not enabled():
+        return _NULL
+    if sampled:
+        return _SpanCM(name, attrs, detached=True)
+    return _DeferredSpanCM(name, attrs)
 
 
 def current_span_id() -> str | None:

@@ -83,6 +83,14 @@ point              wired into
                    sleep for the lane watchdog to interrupt — the lane
                    is quarantined and its in-flight batch re-dispatched
                    on a healthy lane before any request is answered.
+``dispatch_slow``  the injected LATENCY regression (``injected_slow``,
+                   wired into the serve lane seam): each firing sleeps
+                   ``OT_SLOW_S`` (default 0.05 s) WITHOUT failing — the
+                   dispatch completes, just late. A bare token slows
+                   every dispatch: the deterministic way to turn the
+                   ``serve.bench --slo`` regression gate red in CI
+                   (docs/OBSERVABILITY.md) — no error counters move,
+                   only the latency/goodput SLOs.
 =================  ========================================================
 
 Determinism contract: firings consume counts in call order within ONE
@@ -103,12 +111,13 @@ from __future__ import annotations
 
 import os
 import sys
+import time
 
 #: The names wired into real seams. Parsing accepts others (forward
 #: compat, tests), but warns — see module docstring.
 KNOWN_POINTS = ("init_hang", "dispatch_fail", "build_fail", "lock_busy",
                 "dispatch_hang", "unit_crash", "serve_dispatch",
-                "lane_fail", "lane_hang")
+                "lane_fail", "lane_hang", "dispatch_slow")
 
 #: Sentinel count for a bare (uncounted) token: armed forever.
 ALWAYS = -1
@@ -295,6 +304,25 @@ def check_lane(point: str, lane, detail: str = "") -> None:
     if fire(scoped(point, lane)) or fire(point):
         raise InjectedFault(f"injected fault: {scoped(point, lane)}"
                             + (f" ({detail})" if detail else ""))
+
+
+def injected_slow(point: str, detail: str = "") -> bool:
+    """Simulate a LATENCY regression when ``point`` (``dispatch_slow``)
+    is armed: sleep ``OT_SLOW_S`` seconds (default 0.05) and return —
+    the call still succeeds, it is just slow. The ``fire`` docstring's
+    never-sleeps contract is about ``fire`` itself: the sleep is this
+    injection point simulating its fault's cost, exactly like
+    ``watchdog.injected_hang`` burning a deadline. A bare token slows
+    every dispatch — the SLO-gate red rehearsal
+    (``serve.bench --slo``); returns whether it fired."""
+    if not fire(point):
+        return False
+    try:
+        slow_s = max(float(os.environ.get("OT_SLOW_S", 0.05)), 0.0)
+    except ValueError:
+        slow_s = 0.05
+    time.sleep(slow_s)
+    return True
 
 
 def consume(point: str) -> bool:

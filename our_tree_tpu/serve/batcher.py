@@ -39,6 +39,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from ..obs import metrics
 from ..ops.keyschedule import ROUNDS
 from ..utils import packing
 from .queue import Request
@@ -135,6 +136,13 @@ class Batch:
     @property
     def requests(self) -> list[Request]:
         return [r for s in self.slots for r in s.requests]
+
+    @property
+    def sampled(self) -> bool:
+        """Whether this batch carries at least one head-sampled rider:
+        the batch's ``batch-formed``/``lane-dispatch`` spans are emitted
+        iff it does (abnormal outcomes force-sample regardless)."""
+        return any(r.sampled for s in self.slots for r in s.requests)
 
     @property
     def keys(self) -> list[tuple[str, bytes]]:
@@ -274,8 +282,17 @@ def form_batches(requests: list[Request],
     def flush():
         nonlocal cur_slots, cur_blocks, cur_nr
         if cur_slots:
-            batches.append(Batch(cur_slots, bucket_for(cur_blocks, rungs),
+            bucket = bucket_for(cur_blocks, rungs)
+            batches.append(Batch(cur_slots, bucket,
                                  cur_blocks, cur_nr, key_slots))
+            # The rung-packer's live distributions (obs/metrics.py):
+            # payload blocks per formed batch, labeled by its rung (the
+            # per-rung occupancy the SERVE artifact histograms post-hoc,
+            # now continuously on /metrics), and key slots packed per
+            # batch (the coalesce shape — fragmentation regressions show
+            # up as this histogram collapsing toward 1).
+            metrics.observe("serve_batch_blocks", cur_blocks, rung=bucket)
+            metrics.observe("serve_batch_slots", len(cur_slots))
         cur_slots, cur_blocks, cur_nr = [], 0, None
 
     for tenant, digest in order:

@@ -56,7 +56,7 @@ import os
 import re
 import sys
 
-from ..obs import trace
+from ..obs import metrics, slo, trace
 from ..resilience import degrade, watchdog
 from ..resilience import journal as journal_mod
 from . import batcher, loadgen
@@ -92,7 +92,8 @@ async def _drive(args, probes):
         lanes=args.lanes,
         probe_every=args.probe_every,
         journal=args.journal,
-        max_inflight=args.max_inflight)
+        max_inflight=args.max_inflight,
+        status_port=args.status_port)
     server = Server(cfg)
     await server.start()
     report = await loadgen.run(
@@ -198,6 +199,24 @@ def main(argv=None) -> int:
                          "dropping its failure rows from --journal "
                          "(repeatable), then exit — the same "
                          "clear_failures edit harness.bench uses")
+    ap.add_argument("--status-port", type=int, default=None, metavar="PORT",
+                    help="serve the operator status endpoint on "
+                         "127.0.0.1:PORT for the duration of the drive: "
+                         "/metrics (Prometheus text from the obs.metrics "
+                         "registry) and /healthz (lane health, queue, "
+                         "in-flight, keycache as JSON) — the live view "
+                         "the CI mid-drive curl gates on (0 = ephemeral)")
+    ap.add_argument("--slo", default=None, metavar="BASELINE.json",
+                    help="after the drive, gate this run's p50/p95/p99, "
+                         "goodput, error/lost/recompile counts against "
+                         "the committed SERVE_r*.json baseline with "
+                         "per-metric tolerances (obs/slo.py) and exit 1 "
+                         "on any regression — the SLO gate CI runs "
+                         "against SERVE_r04_control.json")
+    ap.add_argument("--slo-tolerance", default=None, metavar="SPEC",
+                    help="per-metric tolerance overrides for --slo, "
+                         "e.g. 'p95_ms=2.0,goodput_gbps=0.5' (fractions "
+                         "of the baseline; counts are never tolerated)")
     ap.add_argument("--verify-every", type=int, default=8,
                     help="every Nth request replays a pinned probe and "
                          "checks bit-exactness (0 = off)")
@@ -290,6 +309,18 @@ def main(argv=None) -> int:
     for bucket, h in stats["occupancy"].items():
         print(f"#   bucket {bucket:>5}: {h['batches']} batch(es), "
               f"mean occupancy {h['mean_occupancy']:.2%}")
+    # The registry view (obs/metrics.py): exact whatever OT_TRACE_SAMPLE
+    # says — dispatch-latency percentiles interpolated from the log2
+    # buckets, admission pressure, keycache totals.
+    disp = metrics.hist_merged("serve_dispatch_us")
+    if disp:
+        print("# metrics: dispatch_us "
+              f"p50={metrics.percentile_from_buckets(disp, 50):.0f} "
+              f"p95={metrics.percentile_from_buckets(disp, 95):.0f} "
+              f"p99={metrics.percentile_from_buckets(disp, 99):.0f} "
+              f"({sum(disp.values())} obs)  "
+              f"queue_depth_peak={stats['queue'].get('depth_peak', 0)}  "
+              f"requests={metrics.counter_total('serve_requests'):.0f}")
 
     artifact = {
         "config": {
@@ -317,14 +348,36 @@ def main(argv=None) -> int:
         "keycache": stats["keycache"],
         "compiles": stats["compiles"],
         "degraded": degrade.events(),
+        # The full registry snapshot: exact counters/gauges + log2
+        # histogram buckets per label set — present traced or not (the
+        # registry always counts; only the JSONL flusher needs
+        # OT_TRACE_DIR), which is what lets the A/B overhead harness
+        # prove counter totals byte-identical across sample rates.
+        "metrics": metrics.snapshot(),
     }
     if trace.enabled():
         artifact["obs"] = trace.metrics_snapshot()
+        artifact["trace_sample"] = trace.sample_rate()
     path = args.artifact or _next_artifact(_repo_root())
     with open(path, "w", encoding="utf-8") as fh:
         json.dump(artifact, fh, indent=2, sort_keys=True)
         fh.write("\n")
     print(f"# artifact: {path}", file=sys.stderr)
+
+    # The SLO regression gate (obs/slo.py) runs BEFORE the JSON line so
+    # the one-parseable-line-last stdout contract holds: this run vs the
+    # committed baseline artifact. Count metrics (errors, lost,
+    # recompiles, mismatches) tolerate nothing; latency/goodput compare
+    # within per-metric tolerances (--slo-tolerance for cross-host CI
+    # bands). A regression fails the bench like a correctness violation
+    # does — SERVE_r* numbers can no longer silently rot.
+    slo_rc = 0
+    if args.slo:
+        try:
+            slo_rc = slo.gate(args.slo, artifact, args.slo_tolerance)
+        except (OSError, ValueError, KeyError) as e:
+            print(f"# slo: gate unusable: {e}", file=sys.stderr)
+            slo_rc = 1
 
     line = {"unit": "serve", "engine": stats["engine"],
             "requests": report.requests, "ok": report.ok,
@@ -343,6 +396,8 @@ def main(argv=None) -> int:
             "quarantines": lanes["quarantine_events"],
             "recompiles": stats["compiles"]["steady"],
             "mismatches": report.mismatches}
+    if args.slo:
+        line["slo"] = "fail" if slo_rc else "pass"
     if degrade.events():
         line["degraded"] = degrade.events()
     if trace.enabled():
@@ -378,6 +433,10 @@ def main(argv=None) -> int:
               "dispatches never overlapped: a multi-lane run serialized "
               "behind one dispatch at a time (the pre-overlap behaviour "
               "the lane executors exist to end)", file=sys.stderr)
+        rc = 1
+    if slo_rc:
+        print(f"# FAIL: SLO regression against {args.slo} "
+              "(see the # slo table above)", file=sys.stderr)
         rc = 1
     return rc
 

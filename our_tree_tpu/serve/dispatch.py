@@ -47,7 +47,9 @@ from __future__ import annotations
 import concurrent.futures
 import queue as _queue
 import threading
+import time
 
+from ..obs import metrics
 from ..resilience import watchdog
 
 
@@ -74,8 +76,9 @@ class LaneExecutor:
     is already abandoned and exits on wake via its stale generation.
     """
 
-    def __init__(self, name: str):
+    def __init__(self, name: str, lane: int | None = None):
         self._name = name
+        self._lane = lane
         self._lock = threading.Lock()
         self._gen = 0
         self._q: _queue.SimpleQueue | None = None
@@ -94,7 +97,7 @@ class LaneExecutor:
                     target=self._run, args=(self._gen, self._q),
                     daemon=True, name=self._name)
                 self._thread.start()
-            self._q.put((fut, unit))
+            self._q.put((fut, unit, time.monotonic()))
         return fut
 
     def close(self) -> None:
@@ -113,9 +116,16 @@ class LaneExecutor:
             item = q.get()
             if item is None:
                 return  # close(): drained and dismissed
-            fut, unit = item
+            fut, unit, t_submit = item
             if not fut.set_running_or_notify_cancel():
                 continue
+            # Executor-queue residency: how long a unit waited for its
+            # worker. With the pool's one-batch-per-lane discipline this
+            # is ~0; growth here means submits are racing the lane's
+            # own completion (obs/metrics.py, /metrics).
+            metrics.observe("serve_worker_wait_us",
+                            (time.monotonic() - t_submit) * 1e6,
+                            lane=self._lane)
             # The kill path: when a watchdog.deadline armed INSIDE this
             # unit (Lane.engine_call) expires, the expiry thread calls
             # the hook — fail the future, mark this worker abandoned —

@@ -56,7 +56,7 @@ from collections import OrderedDict
 
 import numpy as np
 
-from ..obs import trace
+from ..obs import metrics, trace
 from ..ops.keyschedule import expand_key_enc
 
 
@@ -121,9 +121,11 @@ class KeyCache:
         if entry is not None:
             lru.move_to_end(digest)
             self.hits += 1
+            metrics.counter("keycache", outcome="hit")
             trace.counter("keycache_hit", tenant=tenant)
             return (digest, *entry)
         self.misses += 1
+        metrics.counter("keycache", outcome="miss")
         trace.counter("keycache_miss", tenant=tenant)
         nr, rk = expand_key_enc(bytes(key))
         entry = (nr, np.asarray(rk, dtype=np.uint32))
@@ -131,6 +133,7 @@ class KeyCache:
         if len(lru) > self.per_tenant:
             lru.popitem(last=False)
             self.evictions += 1
+            metrics.counter("keycache", outcome="evict")
             trace.counter("keycache_evict", tenant=tenant)
         return (digest, *entry)
 
@@ -157,9 +160,11 @@ class KeyCache:
         if hit is not None:
             self._stacked.move_to_end(memo_key)
             self.stacked_hits += 1
+            metrics.counter("keycache_stacked", outcome="hit")
             trace.counter("keycache_stacked_hit")
             return hit
         self.stacked_misses += 1
+        metrics.counter("keycache_stacked", outcome="miss")
         trace.counter("keycache_stacked_miss")
         nr = entries[0][1]
         rks = np.zeros((int(key_slots), 4 * (nr + 1)), dtype=np.uint32)

@@ -74,7 +74,7 @@ import jax
 import numpy as np
 
 from ..models import aes
-from ..obs import trace
+from ..obs import metrics, trace
 from ..resilience import degrade, faults, watchdog
 from ..resilience.policy import RetryPolicy
 from .dispatch import LaneExecutor
@@ -162,7 +162,8 @@ class Lane:
         replaced automatically after a watchdog kill abandoned its
         worker (serve/dispatch.py)."""
         if self.executor is None:
-            self.executor = LaneExecutor(f"ot-lane{self.idx}")
+            self.executor = LaneExecutor(f"ot-lane{self.idx}",
+                                         lane=self.idx)
         return asyncio.wrap_future(self.executor.submit(unit))
 
     # -- state machine -----------------------------------------------------
@@ -174,6 +175,9 @@ class Lane:
         self.transitions.append({
             "prev": old, "to": new, "why": why,
             "t_s": round(self._clock() - self._t0, 3)})
+        metrics.counter("serve_lane_transitions", lane=self.idx, state=new)
+        metrics.gauge("serve_lane_placeable",
+                      1 if new in PLACEABLE else 0, lane=self.idx)
         trace.point("lane-state", lane=self.idx, prev=old, to=new, why=why)
 
     def _quarantine(self, why: str, journal) -> None:
@@ -266,6 +270,10 @@ class Lane:
                 if not watchdog.injected_hang(
                         faults.scoped("lane_hang", self.idx), label):
                     watchdog.injected_hang("lane_hang", label)
+                # The injected LATENCY regression (no failure, just a
+                # slower dispatch): the knob the SLO gate rehearsal
+                # (`serve.bench --slo`, docs/RESILIENCE.md) turns red.
+                faults.injected_slow("dispatch_slow", label)
             if self.engine == aes.NATIVE_ENGINE:
                 # ``runs`` (the batch's request layout) flips the host
                 # tier to the per-request C CTR fast path: counters are
@@ -362,10 +370,16 @@ class LanePool:
         Canary probes occupy lanes but are excluded — they bypass the
         server's in-flight semaphore, and the measured number must stay
         comparable to the configured `max_inflight` limit (a serialized
-        control run with one probe must still measure 1)."""
+        control run with one probe must still measure 1). Mirrored into
+        the metrics registry (exact + on /metrics + snapshotted for the
+        Perfetto counter track) — the trace gauge stays because the
+        report's per-window overlap reconstruction needs every edge,
+        and it is per-BATCH, not per-request, so sampling leaves it."""
         self.inflight_now += d
         if self.inflight_now > self.max_inflight_seen:
             self.max_inflight_seen = self.inflight_now
+            metrics.gauge_max("serve_inflight_peak", self.inflight_now)
+        metrics.gauge("serve_inflight", self.inflight_now)
         trace.gauge("serve_inflight", self.inflight_now)
 
     # -- overlap wakeups ---------------------------------------------------
@@ -451,12 +465,17 @@ class LanePool:
         if exc is not None:
             if not isinstance(exc, watchdog.DispatchTimeout):
                 cm.__exit__(type(exc), exc, None)
+            metrics.counter("serve_canary", lane=lane.idx,
+                            outcome="failed")
             trace.counter("serve_canary_failed", lane=lane.idx)
             return False
         cm.__exit__(None, None, None)
         if not np.array_equal(out, c.expected):
+            metrics.counter("serve_canary", lane=lane.idx,
+                            outcome="mismatch")
             trace.counter("serve_canary_mismatch", lane=lane.idx)
             return False
+        metrics.counter("serve_canary", lane=lane.idx, outcome="ok")
         lane.probation_left = self.probation_batches
         lane._to(PROBATION, "canary-ok")
         trace.point("lane-probe-ok", lane=lane.idx,
@@ -536,7 +555,8 @@ class LanePool:
 
     # -- dispatch with failover --------------------------------------------
     async def dispatch(self, words, ctr_words, sched, key_slots, label: str,
-                       bucket: int, blocks: int, requests: int, runs=None):
+                       bucket: int, blocks: int, requests: int, runs=None,
+                       sampled: bool = True):
         """Place and run one batch, failing over across lanes until it
         succeeds or every lane has been tried. ``sched``/``key_slots``
         are the multi-key pair (keycache.StackedSchedules + per-block
@@ -583,7 +603,14 @@ class LanePool:
                     continue
             if lane is None:
                 raise LanesExhausted(label, causes)
-            cm = trace.detached_span(
+            # A REDISPATCH is an incident: force-sample it even when no
+            # rider was head-sampled, so failover evidence is complete
+            # at any OT_TRACE_SAMPLE rate. A first attempt of an
+            # unsampled batch opens a DEFERRED span — written only if
+            # the outcome turns abnormal (error exit or the force()
+            # below), free when it completes clean.
+            cm = trace.maybe_span(
+                sampled or bool(tried),
                 "lane-dispatch", lane=lane.idx, batch=label, bucket=bucket,
                 blocks=blocks, requests=requests, engine=self.engine,
                 redispatch=bool(tried))
@@ -591,6 +618,7 @@ class LanePool:
             lane.inflight += 1
             self._inflight(+1)
             t0 = lane._clock()
+            outcome = "ok"
             try:
                 out = await lane.run_async(
                     lambda: lane.policy.run(
@@ -602,6 +630,11 @@ class LanePool:
                 # closed — its orphaned begin is the kill evidence
                 # (obs.report --check --expected-orphans lane-dispatch);
                 # the wedged worker thread was abandoned with it.
+                # force() materialises the begin for an unsampled batch:
+                # a hang keeps its orphan at any sample rate.
+                cm.force()
+                outcome = "timeout"
+                metrics.counter("serve_lane_timeout", lane=lane.idx)
                 trace.counter("serve_lane_timeout", lane=lane.idx)
                 lane.note_timeout(e, self.journal)
                 causes.append((lane.idx, e))
@@ -609,6 +642,8 @@ class LanePool:
                 continue
             except Exception as e:  # noqa: BLE001 - failover, then contain
                 cm.__exit__(type(e), e, None)
+                outcome = "failed"
+                metrics.counter("serve_lane_failed", lane=lane.idx)
                 trace.counter("serve_lane_failed", lane=lane.idx)
                 lane.note_failure(e, self.journal)
                 causes.append((lane.idx, e))
@@ -617,11 +652,24 @@ class LanePool:
             finally:
                 lane.inflight -= 1
                 self._inflight(-1)
-                lane.busy_us += int((lane._clock() - t0) * 1e6)
+                dt_us = int((lane._clock() - t0) * 1e6)
+                lane.busy_us += dt_us
+                # The dispatch seam's live distributions: per-lane
+                # latency (log2 buckets, labeled by lane/engine/outcome)
+                # and cumulative busy time — the continuous per-lane
+                # stage-occupancy breakdown (PAPERS.md, the pipelined-
+                # AES stage analysis) the post-hoc report tables only
+                # showed after the run ended.
+                metrics.observe("serve_dispatch_us", dt_us,
+                                lane=lane.idx, engine=self.engine,
+                                outcome=outcome)
+                metrics.counter("serve_lane_busy_us", dt_us,
+                                lane=lane.idx)
                 self._notify_change()
             cm.__exit__(None, None, None)
             if tried:
                 self.redispatches += 1
+                metrics.counter("serve_redispatch", lane=lane.idx)
                 trace.counter("serve_redispatch", lane=lane.idx,
                               after=len(tried))
             lane.note_success(blocks, redispatch=bool(tried),

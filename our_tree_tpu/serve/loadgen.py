@@ -41,6 +41,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from ..models.aes import AES
+from ..obs import metrics as obs_metrics
 
 #: The mixed-size menu (bytes): 1 block to the default bucket ceiling.
 #: Mixed sizes are the point — a single size would never exercise the
@@ -56,11 +57,11 @@ TENANT_HEAVY_SIZES = (16, 64, 256, 1024)
 
 
 def percentile(sorted_vals: list[float], p: float) -> float:
-    """Nearest-rank percentile (sorted input; 0 < p <= 100)."""
-    if not sorted_vals:
-        return 0.0
-    rank = max(int(np.ceil(p / 100.0 * len(sorted_vals))), 1)
-    return sorted_vals[rank - 1]
+    """Nearest-rank percentile (sorted input; 0 < p <= 100) — delegates
+    to the repo's ONE implementation (``obs.metrics.percentile_exact``;
+    the report's histogram percentiles interpolate from log2 buckets
+    via the sibling ``percentile_from_buckets``)."""
+    return obs_metrics.percentile_exact(sorted_vals, p)
 
 
 @dataclass
@@ -182,9 +183,18 @@ async def run(server, n_requests: int, concurrency: int = 32,
     def account(resp, payload, probe, dt_ms: float):
         report.requests += 1
         report.latencies_ms.append(dt_ms)
+        # Per-request client-side outcome + end-to-end latency into the
+        # metrics registry: the error CODES are a closed set
+        # (queue.ERR_*), so `outcome` stays low-cardinality — exact
+        # totals per outcome at any OT_TRACE_SAMPLE rate.
+        obs_metrics.counter("loadgen_requests",
+                            outcome=(resp.error or "ok"))
+        obs_metrics.observe("loadgen_latency_us", dt_ms * 1e3,
+                            outcome=(resp.error or "ok"))
         if resp.ok:
             report.ok += 1
             counter["ok_bytes"] += int(payload.size)
+            obs_metrics.counter("loadgen_ok_bytes", int(payload.size))
             if probe is not None:
                 report.verified += 1
                 if not np.array_equal(np.asarray(resp.payload),

@@ -25,6 +25,18 @@ component the batcher's spans cannot see. Detached because request
 lifetimes overlap arbitrarily on the one event-loop thread
 (``obs.trace.detached_span``).
 
+Admission is also where the run's HEAD-SAMPLING decision is made
+(``OT_TRACE_SAMPLE``, docs/OBSERVABILITY.md): each accepted request
+draws ``trace.sample()`` once and carries the bit (``Request.sampled``)
+through batch formation to dispatch, so one request's spans appear or
+vanish together. An unsampled request's ``request-queued`` span is
+DEFERRED (``trace.maybe_span``): nothing is written on the happy path,
+but a deadline expiry at drain still materialises the span with an
+error end — abnormal outcomes are force-sampled. The metrics registry
+(``obs/metrics.py``) counts every request, shed, refusal, and expiry
+EXACTLY regardless of the sample rate, and tracks queue depth plus its
+high-water mark as gauges — the /metrics view of admission pressure.
+
 asyncio + stdlib + resilience/obs only — no jax: admission logic is
 testable without a backend in sight.
 """
@@ -38,7 +50,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-from ..obs import trace
+from ..obs import metrics, trace
 from ..resilience import degrade
 from ..resilience.policy import Budget
 
@@ -83,6 +95,10 @@ class Request:
     future: asyncio.Future
     budget: Budget | None = None
     t_submit: float = 0.0
+    #: the admission-time head-sampling decision (OT_TRACE_SAMPLE):
+    #: every span this request rides is emitted iff this bit is set
+    #: (or the outcome force-samples it)
+    sampled: bool = True
     _span_cm: object | None = field(default=None, repr=False)
     _queue: object | None = field(default=None, repr=False)
 
@@ -132,6 +148,7 @@ class RequestQueue:
         self.shed = 0
         self.refused = 0
         self.expired = 0
+        self.depth_peak = 0
 
     def depth(self) -> int:
         return len(self._pending)
@@ -165,6 +182,7 @@ class RequestQueue:
         elif len(self._pending) >= self.max_depth:
             code, why = ERR_SHED, f"queue depth {self.max_depth} reached"
             self.shed += 1
+            metrics.counter("serve_shed")
             trace.counter("serve_shed", tenant=tenant)
             # First shed = the process entered overload shedding: a
             # demotion of the accept path, recorded like every other
@@ -176,6 +194,7 @@ class RequestQueue:
         if code is not None:
             if code != ERR_SHED:
                 self.refused += 1
+                metrics.counter("serve_refused", code=code)
             fut.set_result(Response(ok=False, error=code, detail=why))
             return fut
         deadline = (self.default_deadline_s if deadline_s is None
@@ -185,14 +204,24 @@ class RequestQueue:
             nonce=bytes(nonce), payload=data, future=fut,
             budget=Budget(deadline, clock=self._clock) if deadline > 0
             else None,
-            t_submit=self._clock(), _queue=self)
-        cm = trace.detached_span("request-queued", req=req.id,
-                                 tenant=tenant, blocks=req.nblocks)
+            t_submit=self._clock(), _queue=self,
+            sampled=trace.sample())
+        cm = trace.maybe_span(req.sampled, "request-queued", req=req.id,
+                              tenant=tenant, blocks=req.nblocks)
         cm.__enter__()
         req._span_cm = cm
         self._pending.append(req)
         self.accepted += 1
-        trace.counter("serve_requests", tenant=tenant)
+        # Registry, not trace: the per-request counter is the hot path
+        # the sampled trace can no longer count exactly — and queue
+        # depth (+ its high-water) is the /metrics admission gauge.
+        metrics.counter("serve_requests")
+        metrics.counter("serve_payload_blocks", req.nblocks)
+        depth = len(self._pending)
+        if depth > self.depth_peak:
+            self.depth_peak = depth
+            metrics.gauge_max("serve_queue_depth_peak", depth)
+        metrics.gauge("serve_queue_depth", depth)
         self._event.set()
         return fut
 
@@ -218,11 +247,16 @@ class RequestQueue:
         fails the ones whose deadline budget is already spent — they can
         no longer use the device time a batch would give them."""
         taken, self._pending = self._pending, []
+        if taken:
+            metrics.gauge("serve_queue_depth", 0)
+            metrics.observe("serve_drain_requests", len(taken))
         live = []
         for req in taken:
             queued_s = self._clock() - req.t_submit
+            metrics.observe("serve_queued_us", queued_s * 1e6)
             if req.budget is not None and req.budget.exhausted():
                 self.expired += 1
+                metrics.counter("serve_deadline_expired")
                 trace.counter("serve_deadline_expired", tenant=req.tenant)
                 if req._span_cm is not None:
                     req._span_cm.__exit__(TimeoutError, None, None)
@@ -250,4 +284,5 @@ class RequestQueue:
         return {"accepted": self.accepted, "answered": self.answered,
                 "lost": self.accepted - self.answered,
                 "shed": self.shed, "refused": self.refused,
-                "expired": self.expired, "depth": self.depth()}
+                "expired": self.expired, "depth": self.depth(),
+                "depth_peak": self.depth_peak}

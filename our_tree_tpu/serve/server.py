@@ -80,13 +80,14 @@ import jax
 import numpy as np
 
 from ..models import aes
-from ..obs import trace
+from ..obs import metrics, trace
 from ..resilience import journal as journal_mod
 from ..resilience import watchdog
 from ..utils import packing
 from . import batcher, lanes
 from .keycache import KeyCache, key_digest
 from .queue import ERR_DEADLINE, ERR_DISPATCH, RequestQueue
+from .status import StatusServer
 
 #: The jax monitoring event that fires once per REAL backend compile and
 #: never on an executable-cache hit — the zero-recompile assertion's
@@ -160,6 +161,11 @@ class ServerConfig:
     #: control run); values above the lane count are clamped by
     #: placement itself (a lane holds one batch at a time)
     max_inflight: int | None = None
+    #: operator status endpoint (serve/status.py): /metrics (Prometheus
+    #: text from the obs.metrics registry) + /healthz (lane health,
+    #: queue depth, in-flight, keycache — live JSON). None = off;
+    #: 0 = an ephemeral port (tests read server.status.port)
+    status_port: int | None = None
 
 
 class Server:
@@ -182,6 +188,7 @@ class Server:
         self._journal = None
         self._task: asyncio.Task | None = None
         self._running = False
+        self.status: StatusServer | None = None
         #: overlap state: the in-flight cap (resolved at start) and the
         #: live task set (dispatch + probe tasks; drain awaits it). The
         #: MEASURED concurrency lives in the pool (`max_inflight_seen`:
@@ -240,6 +247,14 @@ class Server:
                                if c.max_inflight is None
                                else max(int(c.max_inflight), 1))
         self._sem = asyncio.Semaphore(self.inflight_limit)
+        # The metrics flusher: periodic registry snapshots into the
+        # trace run dir (no-op while OT_TRACE_DIR is unset — the
+        # registry still counts in memory for /metrics and the bench
+        # artifact either way).
+        metrics.ensure_flusher()
+        if c.status_port is not None:
+            self.status = StatusServer(self, c.status_port)
+            await self.status.start()
         self._running = True
         self._task = asyncio.ensure_future(self._loop())
 
@@ -342,11 +357,17 @@ class Server:
                     answered=self.queue.answered,
                     lost=self.queue.accepted - self.queue.answered,
                     max_inflight=self.max_inflight_seen)
+        if self.status is not None:
+            await self.status.stop()
+            self.status = None
         if self.pool is not None:
             self.pool.close()  # idle workers dismissed; wedged ones are
             #                    already abandoned (stale generation)
         if self._journal is not None:
             self._journal.close()
+        # Final exact totals on disk even if the process never reaches
+        # atexit (e.g. an embedding test harness).
+        metrics.flush_now()
 
     @property
     def max_inflight_seen(self) -> int:
@@ -440,9 +461,13 @@ class Server:
         stacked schedules, or None after answering the riders when
         formation itself failed."""
         try:
-            with trace.span("batch-formed", batch=b.label, bucket=b.bucket,
-                            blocks=b.blocks, slots=len(b.slots),
-                            requests=len(b.requests)):
+            # Emitted iff the batch carries a sampled rider; a formation
+            # FAILURE still materialises the span (error end) whatever
+            # the sample said — incident evidence is never sampled out.
+            with trace.maybe_span(b.sampled, "batch-formed", batch=b.label,
+                                  bucket=b.bucket, blocks=b.blocks,
+                                  slots=len(b.slots),
+                                  requests=len(b.requests)):
                 sched = self.keycache.stacked(b.keys, b.key_slots)
                 # The native tier generates counters inside C per
                 # request (the batch's ``runs`` layout) — materialising
@@ -452,6 +477,7 @@ class Server:
                 return sched
         except Exception as e:  # noqa: BLE001 - containment (docstring)
             self.batches_failed += 1
+            metrics.counter("serve_batches", outcome="form-failed")
             trace.counter("serve_batch_failed", batch=b.label)
             for req in b.requests:
                 req.fail(ERR_DISPATCH, f"{type(e).__name__}: {e}",
@@ -465,17 +491,20 @@ class Server:
             out, _lane, _redispatched = await self.pool.dispatch(
                 b.words, b.ctr_words, sched, b.slot_index, b.label,
                 bucket=b.bucket, blocks=b.blocks,
-                requests=len(b.requests), runs=b.runs)
+                requests=len(b.requests), runs=b.runs,
+                sampled=b.sampled)
         except lanes.LanesExhausted as e:
             # Failover already ran: every lane was tried (and each
             # miss degraded its lane's health). Only now do the riders
             # see errors — coded by what finally stopped the batch.
             if e.timed_out:
                 self.batches_timed_out += 1
+                metrics.counter("serve_batches", outcome="deadline")
                 trace.counter("serve_batch_deadline", batch=b.label)
                 code = ERR_DEADLINE
             else:
                 self.batches_failed += 1
+                metrics.counter("serve_batches", outcome="failed")
                 trace.counter("serve_batch_failed", batch=b.label)
                 code = ERR_DISPATCH
             for req in b.requests:
@@ -483,6 +512,7 @@ class Server:
             return
         except Exception as e:  # noqa: BLE001 - containment (docstring)
             self.batches_failed += 1
+            metrics.counter("serve_batches", outcome="failed")
             trace.counter("serve_batch_failed", batch=b.label)
             for req in b.requests:
                 req.fail(ERR_DISPATCH, f"{type(e).__name__}: {e}",
@@ -493,6 +523,8 @@ class Server:
         # lane served nothing, and counting it would let a failure-heavy
         # run pass the CI-gated coalesce_efficiency on phantom traffic.
         self.batches += 1
+        metrics.counter("serve_batches", outcome="ok")
+        metrics.counter("serve_served_bytes", b.blocks * 16)
         occ = self._occupancy.setdefault(b.bucket,
                                          {"batches": 0, "blocks": 0})
         occ["batches"] += 1
@@ -509,6 +541,7 @@ class Server:
             # riders not yet resolved get errors (fail() no-ops on the
             # already-resolved ones) and the loop lives on.
             self.batches_failed += 1
+            metrics.counter("serve_batches", outcome="split-failed")
             trace.counter("serve_batch_failed", batch=b.label)
             for req in b.requests:
                 req.fail(ERR_DISPATCH, f"{type(e).__name__}: {e}",

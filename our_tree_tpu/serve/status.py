@@ -1,0 +1,152 @@
+"""The operator status endpoint: ``/metrics`` + ``/healthz`` over HTTP.
+
+ot-serve's post-hoc story (``obs.report`` over a finished run dir) goes
+blind exactly when an operator needs eyes: DURING the run. This module
+is the live view — a deliberately tiny HTTP/1.1 responder on the
+server's own asyncio loop (``asyncio.start_server``, stdlib only, no
+web framework), so it shares fate with the service it describes: if the
+event loop is wedged, ``/healthz`` times out, which is itself the
+signal.
+
+* ``GET /metrics`` — the ``obs.metrics`` registry rendered as
+  Prometheus exposition text (counters exact at any ``OT_TRACE_SAMPLE``
+  rate, log2-bucket histograms with cumulative ``le`` bounds), plus the
+  live admission/in-flight gauges re-sampled at scrape time. Point any
+  Prometheus scraper — or ``curl`` — at it.
+* ``GET /healthz`` — one JSON object: per-lane health states (the
+  serve/lanes.py state machine), queue depth + shed/lost ledger,
+  in-flight count vs limit, keycache stats, compile counts. ``status``
+  is ``"ok"`` while at least one warmed placeable lane exists,
+  ``"draining"`` once admission closed, else ``"degraded"`` — a load
+  balancer's readiness answer in one field.
+
+Reads only: the endpoint never mutates server state, and a handler
+failure answers 500 to that one connection — it can never take the
+dispatch loop down (every handler error is contained). Binds 127.0.0.1
+by default (an operator/scrape port, not a tenant surface); ``port=0``
+binds an ephemeral port published as ``.port`` (tests, multi-instance
+hosts). Enabled via ``ServerConfig.status_port`` /
+``serve.bench --status-port`` (docs/SERVING.md).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+from ..obs import metrics
+from ..resilience import degrade
+
+
+class StatusServer:
+    """The /metrics + /healthz responder riding the serve event loop."""
+
+    def __init__(self, server, port: int, host: str = "127.0.0.1"):
+        self._server = server
+        self._host = host
+        self._port = int(port)
+        self._srv: asyncio.AbstractServer | None = None
+        self.port: int | None = None  #: the BOUND port (port=0 resolves)
+        self.requests = 0
+
+    async def start(self) -> None:
+        self._srv = await asyncio.start_server(
+            self._handle, self._host, self._port)
+        self.port = self._srv.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._srv is not None:
+            self._srv.close()
+            await self._srv.wait_closed()
+            self._srv = None
+
+    # -- the two documents -------------------------------------------------
+    def healthz(self) -> dict:
+        """The live health JSON (also the /healthz body)."""
+        s = self._server
+        pool = s.pool
+        lanes_doc: dict = {"count": 0, "states": {}, "per_lane": []}
+        placeable = 0
+        if pool is not None:
+            placeable = len(pool.placeable())
+            lanes_doc = {
+                "count": len(pool.lanes),
+                "placeable": placeable,
+                "states": {str(l.idx): l.state for l in pool.lanes},
+                "inflight": pool.inflight_now,
+                "max_inflight_seen": pool.max_inflight_seen,
+                "redispatches": pool.redispatches,
+                "quarantine_events": pool.quarantine_events(),
+            }
+        if s.queue.closed:
+            status = "draining"
+        elif placeable > 0:
+            status = "ok"
+        else:
+            status = "degraded"
+        return {
+            "status": status,
+            "engine": s.engine,
+            "lanes": lanes_doc,
+            "queue": s.queue.stats(),
+            "inflight_limit": s.inflight_limit,
+            "batches": {"ok": s.batches, "failed": s.batches_failed,
+                        "timed_out": s.batches_timed_out},
+            "keycache": s.keycache.stats(),
+            "compiles": {"warmup": s.warmup_compiles,
+                         "steady": s.steady_compiles()},
+            "degraded": degrade.events(),
+        }
+
+    def metrics_text(self) -> str:
+        """The /metrics body: the registry plus scrape-time liveness
+        gauges (queue depth and in-flight are refreshed HERE so a
+        scrape between requests still sees current pressure, not the
+        last event's)."""
+        s = self._server
+        metrics.gauge("serve_queue_depth", s.queue.depth())
+        if s.pool is not None:
+            metrics.gauge("serve_inflight", s.pool.inflight_now)
+        return metrics.render_prometheus()
+
+    # -- the responder ------------------------------------------------------
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            line = await asyncio.wait_for(reader.readline(), timeout=5.0)
+            parts = line.decode("latin-1", "replace").split()
+            path = parts[1] if len(parts) >= 2 else "/"
+            # Drain (and ignore) the request headers.
+            while True:
+                h = await asyncio.wait_for(reader.readline(), timeout=5.0)
+                if not h or h in (b"\r\n", b"\n"):
+                    break
+            self.requests += 1
+            if path.split("?")[0] == "/metrics":
+                body = self.metrics_text()
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+                code, reason = 200, "OK"
+            elif path.split("?")[0] == "/healthz":
+                body = json.dumps(self.healthz(), indent=1,
+                                  sort_keys=True) + "\n"
+                ctype = "application/json"
+                code, reason = 200, "OK"
+            else:
+                body = "not found: try /metrics or /healthz\n"
+                ctype = "text/plain"
+                code, reason = 404, "Not Found"
+        except Exception:  # noqa: BLE001 - a bad scrape must not matter
+            body, ctype, code, reason = ("status endpoint error\n",
+                                         "text/plain", 500,
+                                         "Internal Server Error")
+        try:
+            raw = body.encode("utf-8")
+            writer.write(
+                (f"HTTP/1.1 {code} {reason}\r\n"
+                 f"Content-Type: {ctype}\r\n"
+                 f"Content-Length: {len(raw)}\r\n"
+                 "Connection: close\r\n\r\n").encode("latin-1") + raw)
+            await writer.drain()
+            writer.close()
+        except Exception:  # noqa: BLE001 - peer went away mid-reply
+            pass

@@ -295,6 +295,45 @@ def test_fault_points_rule_covers_lane_helpers(tmp_path):
     assert "lane_fial" in flagged[0].message
 
 
+def test_metrics_labels_rule_flags_keys_and_high_cardinality(tmp_path):
+    """The registry-cardinality rule: label keys must come from
+    obs.metrics.ALLOWED_LABEL_KEYS, and label VALUES that are
+    statically high-cardinality (request ids, tenant digests,
+    f-strings, **splats) flag — the process-global registry must never
+    become an unbounded memory leak."""
+    fs = _lint(tmp_path, """
+        from our_tree_tpu.obs import metrics
+
+        def bad(req, tenant, extra):
+            metrics.counter("serve_requests", tenant=tenant)
+            metrics.observe("lat_us", 5, outcome=f"req-{req.kind}")
+            metrics.counter("x", code=req.id)
+            metrics.gauge("g", 1, **extra)
+    """)
+    flagged = [f for f in fs if f.rule == "metrics-labels"]
+    assert len(flagged) == 4
+    msgs = " | ".join(f.message for f in flagged)
+    assert "ALLOWED_LABEL_KEYS" in msgs          # bad key: tenant
+    assert "f-string" in msgs                    # assembled value
+    assert "high-cardinality" in msgs            # req.id value
+    assert "splat" in msgs                       # **extra
+
+
+def test_metrics_labels_rule_passes_compliant_twin(tmp_path):
+    fs = _lint(tmp_path, """
+        from our_tree_tpu.obs import metrics
+
+        def good(lane, rung, engine_name):
+            metrics.counter("serve_redispatch", lane=lane)
+            metrics.observe("serve_dispatch_us", 12, rung=rung,
+                            engine=engine_name, outcome="ok")
+            metrics.gauge("serve_queue_depth", 3)
+            metrics.gauge_max("serve_queue_depth_peak", 3)
+            metrics.counter("serve_refused", code="bad-request")
+    """)
+    assert not [f for f in fs if f.rule == "metrics-labels"]
+
+
 def test_fingerprints_survive_line_moves(tmp_path):
     """The baseline's matching contract: moving a violation down the
     file (new code above it) must not change its fingerprint."""

@@ -1,0 +1,476 @@
+"""The live telemetry plane (our_tree_tpu/obs/metrics.py + friends):
+the registry contract (exact O(1) counters/gauges/log2 histograms,
+label-series bounds, never-raises), the shared percentile math, snapshot
+flushing + export/report integration (--check gates snapshot schema),
+head-based trace sampling with force-sampled abnormal outcomes
+(OT_TRACE_SAMPLE), the serve status endpoint (/metrics + /healthz), and
+the SLO regression gate (obs/slo.py + serve.bench --slo) rehearsed green
+AND red via the injected dispatch_slow latency regression."""
+
+import asyncio
+import io
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from our_tree_tpu.obs import export, metrics, report, slo, trace
+from our_tree_tpu.resilience import degrade, faults
+from our_tree_tpu.serve import bench as serve_bench
+from our_tree_tpu.serve import loadgen
+from our_tree_tpu.serve.server import Server, ServerConfig
+
+LADDER = dict(min_bucket_blocks=32, max_bucket_blocks=256)
+
+
+@pytest.fixture(autouse=True)
+def _clean_process_state(monkeypatch):
+    monkeypatch.delenv("OT_FAULTS", raising=False)
+    monkeypatch.delenv("OT_TRACE_SAMPLE", raising=False)
+    faults.reset()
+    degrade.clear()
+    metrics.reset_for_tests()
+    yield
+    monkeypatch.delenv("OT_FAULTS", raising=False)
+    faults.reset()
+    degrade.clear()
+    metrics.reset_for_tests()
+
+
+@pytest.fixture
+def traced(tmp_path, monkeypatch):
+    monkeypatch.setenv("OT_TRACE_DIR", str(tmp_path / "tr"))
+    monkeypatch.setenv("OT_TRACE_RUN", "t-metrics")
+    monkeypatch.delenv("OT_TRACE_PARENT", raising=False)
+    trace.reset_for_tests()
+    metrics.reset_for_tests()
+    yield tmp_path / "tr" / "t-metrics"
+    trace.reset_for_tests()
+    metrics.reset_for_tests()
+
+
+def _run_server(config, fn):
+    async def main():
+        server = Server(config)
+        await server.start()
+        try:
+            return server, await fn(server)
+        finally:
+            await server.stop()
+
+    return asyncio.run(main())
+
+
+def _submit_n(server, n, size=256, tenant="t0", seed=5):
+    rng = np.random.default_rng(seed)
+    subs = []
+    for _ in range(n):
+        key = rng.integers(0, 256, 16, dtype=np.uint8).tobytes()
+        nonce = rng.integers(0, 256, 16, dtype=np.uint8).tobytes()
+        subs.append(server.submit(
+            tenant, key, nonce, rng.integers(0, 256, size, dtype=np.uint8)))
+    return subs
+
+
+# ---------------------------------------------------------------------------
+# The registry contract.
+# ---------------------------------------------------------------------------
+
+
+def test_registry_counters_gauges_histograms():
+    metrics.counter("c", 2)
+    metrics.counter("c", 3)
+    metrics.counter("c", 1, lane=0)
+    metrics.gauge("g", 5)
+    metrics.gauge("g", 2)
+    metrics.gauge_max("peak", 2)
+    metrics.gauge_max("peak", 7)
+    metrics.gauge_max("peak", 3)
+    for v in (1, 2, 3, 100, 1000):
+        metrics.observe("h", v, lane=1, outcome="ok")
+    snap = metrics.snapshot()
+    assert snap["counters"]["c"] == 5
+    assert snap["counters"]["c{lane=0}"] == 1
+    assert snap["gauges"]["g"] == 2          # last write wins
+    assert snap["gauges"]["peak"] == 7       # high-water holds
+    h = snap["hists"]["h{lane=1,outcome=ok}"]
+    assert h["count"] == 5 and h["sum"] == 1106.0
+    # log2 buckets: 1 -> b1, 2 -> b2, 3 -> b2, 100 -> b7, 1000 -> b10
+    assert h["buckets"] == {"1": 1, "2": 2, "7": 1, "10": 1}
+    assert metrics.counter_total("c") == 6
+    assert metrics.hist_merged("h") == {1: 1, 2: 2, 7: 1, 10: 1}
+
+
+def test_registry_never_raises_and_bounds_cardinality():
+    # An unhashable label value degrades to a dropped update.
+    metrics.counter("bad", outcome=[1, 2])
+    assert metrics.dropped() >= 1
+    assert "bad" not in metrics.snapshot()["counters"]
+    # The per-name series backstop: past _MAX_SERIES label sets, updates
+    # drop instead of growing the registry.
+    for i in range(metrics._MAX_SERIES + 10):
+        metrics.counter("many", lane=i)
+    snap = metrics.snapshot()
+    series = [k for k in snap["counters"] if k.startswith("many{")]
+    assert len(series) == metrics._MAX_SERIES
+    assert snap["dropped"] >= 10
+
+
+def test_percentile_exact_matches_legacy_nearest_rank():
+    vals = sorted(float(v) for v in range(1, 101))
+    assert loadgen.percentile(vals, 50) == 50.0
+    assert loadgen.percentile(vals, 99) == 99.0
+    assert loadgen.percentile([7.0], 99) == 7.0
+    assert loadgen.percentile([], 50) == 0.0
+    # The legacy numpy-ceil nearest-rank, bit for bit.
+    for p in (1, 10, 50, 90, 95, 99, 99.9, 100):
+        rank = max(int(np.ceil(p / 100.0 * len(vals))), 1)
+        assert metrics.percentile_exact(vals, p) == vals[rank - 1]
+
+
+def test_percentile_from_buckets_interpolates():
+    # 100 observations all in bucket 11 ([1024, 2048)).
+    assert 1024 <= metrics.percentile_from_buckets({11: 100}, 50) < 2048
+    assert metrics.percentile_from_buckets({}, 50) == 0.0
+    # Two buckets: p50 must land in the first, p99 in the second.
+    b = {5: 50, 10: 50}
+    assert 16 <= metrics.percentile_from_buckets(b, 50) <= 32
+    assert 512 <= metrics.percentile_from_buckets(b, 99) <= 1024
+    # String keys (the JSON snapshot form) are accepted.
+    assert metrics.percentile_from_buckets({"5": 50, "10": 50}, 50) <= 32
+
+
+def test_bucket_of_boundaries():
+    assert [metrics.bucket_of(v) for v in (0, 0.5, 1, 2, 3, 4, 1023, 1024)] \
+        == [0, 0, 1, 2, 2, 3, 10, 11]
+
+
+# ---------------------------------------------------------------------------
+# Snapshot flushing + export/report integration.
+# ---------------------------------------------------------------------------
+
+
+def test_flush_and_export_roundtrip(traced):
+    metrics.counter("serve_requests", 7)
+    metrics.gauge("serve_queue_depth", 3)
+    metrics.observe("serve_dispatch_us", 500, lane=0, engine="jnp",
+                    outcome="ok")
+    assert metrics.flush_now()
+    metrics.counter("serve_requests", 1)
+    assert metrics.flush_now()  # cumulative: the LAST snapshot wins
+    with trace.span("anchor"):
+        pass
+    run = export.load_run(str(traced))
+    assert not run.violations
+    assert len(run.snapshots) == 2
+    totals = run.metrics_totals()
+    assert totals["counters"]["serve_requests"] == 8
+    assert totals["gauges"]["serve_queue_depth"] == 3
+    h = totals["hists"]["serve_dispatch_us{engine=jnp,lane=0,outcome=ok}"]
+    assert h["count"] == 1
+    # The report renders the metrics table with bucket percentiles.
+    buf = io.StringIO()
+    report.render(run, out=buf)
+    text = buf.getvalue()
+    assert "metrics (2 snapshot(s)" in text
+    assert "serve_requests" in text and "p95" in text
+    # The Perfetto export carries the snapshot gauges as counter tracks.
+    doc = export.to_chrome_trace(run)
+    tracks = {e["name"] for e in doc["traceEvents"] if e["ph"] == "C"}
+    assert "metrics:serve_queue_depth" in tracks
+
+
+def test_check_gates_malformed_snapshot_schema(traced):
+    assert metrics.flush_now() is False or trace.enabled()
+    metrics.counter("x")
+    assert metrics.flush_now()
+    with trace.span("anchor"):
+        pass
+    # Corrupt the snapshot file: a line that is JSON but not a snapshot.
+    path = next(traced.glob("metrics-*.jsonl"))
+    with open(path, "a", encoding="utf-8") as fh:
+        fh.write('{"ts": "not-an-int"}\n')
+        fh.write('{"ts": 5, "counters": [["n", {}, 1]], "gauges": 3, '
+                 '"hists": []}\n')
+        fh.write('{"ts": 5, "counters": [["n", "nolabels", 1]], '
+                 '"gauges": [], "hists": []}\n')
+    run = export.load_run(str(traced))
+    reasons = [why for _, _, why in run.violations]
+    assert any("missing ts" in w for w in reasons)
+    assert any("missing ['gauges']" in w for w in reasons)
+    assert any("malformed series" in w for w in reasons)
+    assert report.main([str(traced), "--check"]) == 2  # schema gate
+
+
+def test_disabled_metrics_still_count_without_files(tmp_path, monkeypatch):
+    monkeypatch.delenv("OT_TRACE_DIR", raising=False)
+    metrics.counter("serve_requests", 3)
+    assert metrics.flush_now() is False  # nowhere to write, no error
+    assert metrics.snapshot()["counters"]["serve_requests"] == 3
+
+
+# ---------------------------------------------------------------------------
+# Head sampling: OT_TRACE_SAMPLE + force-sampled abnormal outcomes.
+# ---------------------------------------------------------------------------
+
+
+def test_sample_rate_parsing(monkeypatch):
+    monkeypatch.delenv("OT_TRACE_SAMPLE", raising=False)
+    assert trace.sample_rate() == 1.0 and trace.sample() is True
+    monkeypatch.setenv("OT_TRACE_SAMPLE", "0")
+    assert trace.sample() is False
+    monkeypatch.setenv("OT_TRACE_SAMPLE", "0.25")
+    assert trace.sample_rate() == 0.25
+    monkeypatch.setenv("OT_TRACE_SAMPLE", "7")
+    assert trace.sample_rate() == 1.0  # clamped
+    monkeypatch.setenv("OT_TRACE_SAMPLE", "junk")
+    assert trace.sample_rate() == 1.0  # unparseable = off
+
+
+def test_maybe_span_defers_and_force_samples(traced):
+    cm = trace.maybe_span(True, "eager")
+    cm.__enter__()
+    cm.__exit__(None, None, None)
+    cm = trace.maybe_span(False, "quiet")
+    cm.__enter__()
+    cm.__exit__(None, None, None)      # clean + unsampled: no events
+    cm = trace.maybe_span(False, "failed", lane=3)
+    cm.__enter__()
+    cm.__exit__(ValueError, None, None)  # error: materialised begin+end
+    cm = trace.maybe_span(False, "hung")
+    cm.__enter__()
+    cm.force()                           # abandon path: orphaned begin
+    run = export.load_run(str(traced))
+    names = {s.name for s in run.spans.values()}
+    assert names == {"eager", "failed", "hung"}
+    assert not run.violations
+    failed = next(s for s in run.spans.values() if s.name == "failed")
+    assert failed.status == "error:ValueError"
+    assert failed.attrs == {"lane": 3}
+    assert [s.name for s in run.orphans()] == ["hung"]
+
+
+def test_sampled_out_serve_run_keeps_counters_exact(traced, monkeypatch):
+    """OT_TRACE_SAMPLE=0: a healthy run emits NO per-request lifecycle
+    spans — and the registry still counts every request exactly."""
+    monkeypatch.setenv("OT_TRACE_SAMPLE", "0")
+
+    async def drive(server):
+        return await asyncio.gather(*_submit_n(server, 6))
+
+    server, resps = _run_server(ServerConfig(lanes=1, **LADDER), drive)
+    assert all(r.ok for r in resps)
+    run = export.load_run(str(traced))
+    names = {s.name for s in run.spans.values()}
+    # Warmup spans stay (not per-request); request/batch/dispatch vanish.
+    assert "serve-warmup" in names and "lane-warmup" in names
+    assert not names & {"request-queued", "batch-formed", "lane-dispatch"}
+    assert not run.violations and not run.orphans()
+    # The exactness contract: registry totals match the real traffic.
+    totals = run.metrics_totals()
+    assert totals["counters"]["serve_requests"] == 6
+    assert totals["counters"]["serve_batches{outcome=ok}"] >= 1
+    assert metrics.counter_total("serve_requests") == 6
+
+
+def test_hang_under_zero_sampling_keeps_incident_evidence(
+        traced, monkeypatch):
+    """The force-sampling contract: with OT_TRACE_SAMPLE=0 a hung
+    dispatch still leaves its orphaned lane-dispatch span, the
+    redispatch on the healthy lane is traced (redispatch=True), and the
+    quarantine point is on disk — obs.report --check reconstructs the
+    incident at any sample rate."""
+    monkeypatch.setenv("OT_TRACE_SAMPLE", "0")
+    monkeypatch.setenv("OT_FAULTS", "lane_hang:1@lane=0")
+    monkeypatch.setenv("OT_HANG_S", "30")
+    faults.reset()
+
+    async def drive(server):
+        return await asyncio.gather(*_submit_n(server, 2))
+
+    server, resps = _run_server(
+        ServerConfig(lanes=2, retries=1, dispatch_deadline_s=1.0,
+                     **LADDER), drive)
+    assert all(r.ok for r in resps)           # failover answered them
+    assert server.pool.redispatches == 1
+    run = export.load_run(str(traced))
+    disp = [s for s in run.spans.values() if s.name == "lane-dispatch"]
+    assert [s.name for s in run.orphans()] == ["lane-dispatch"]
+    closed = [s for s in disp if not s.orphan]
+    assert closed and all(s.attrs.get("redispatch") for s in closed)
+    q = [p["attrs"]["unit"] for p in run.points("quarantine")]
+    assert q == ["lane:0"]
+    assert report.main([str(traced), "--check",
+                        "--expected-orphans", "lane-dispatch"]) == 0
+    # Registry: the timeout and redispatch counted exactly.
+    totals = run.metrics_totals()
+    assert totals["counters"]["serve_lane_timeout{lane=0}"] == 1
+    assert totals["counters"]["serve_redispatch{lane=1}"] == 1
+
+
+# ---------------------------------------------------------------------------
+# The status endpoint.
+# ---------------------------------------------------------------------------
+
+
+def test_status_endpoint_metrics_and_healthz():
+    async def drive(server):
+        port = server.status.port
+        assert port and port > 0
+        subs = asyncio.gather(*_submit_n(server, 4))
+        loop = asyncio.get_running_loop()
+
+        def fetch(path):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+                return r.status, r.headers.get("Content-Type"), \
+                    r.read().decode()
+
+        code, ctype, prom = await loop.run_in_executor(
+            None, fetch, "/metrics")
+        assert code == 200 and ctype.startswith("text/plain")
+        hcode, hctype, hbody = await loop.run_in_executor(
+            None, fetch, "/healthz")
+        assert hcode == 200 and hctype == "application/json"
+        with pytest.raises(urllib.error.HTTPError):
+            await loop.run_in_executor(None, fetch, "/nope")
+        await subs
+        return prom, json.loads(hbody)
+
+    server, (prom, health) = _run_server(
+        ServerConfig(lanes=1, status_port=0, **LADDER), drive)
+    # Prometheus well-formedness: typed families, counters _total,
+    # histogram buckets with cumulative le bounds.
+    assert "# TYPE serve_requests_total counter" in prom
+    assert "# TYPE serve_queue_depth gauge" in prom
+    for line in prom.splitlines():
+        assert line.startswith("#") or " " in line
+    assert health["status"] == "ok"
+    assert health["lanes"]["states"] == {"0": "healthy"}
+    assert health["queue"]["accepted"] >= 0
+    assert health["inflight_limit"] == 1
+    assert "keycache" in health and "compiles" in health
+    assert server.status is None  # stop() closed it
+
+
+def test_healthz_degraded_when_no_placeable_lane():
+    async def drive(server):
+        server.pool.lanes[0]._quarantine("test", None)
+        return server.status.healthz()
+
+    server, health = _run_server(
+        ServerConfig(lanes=1, status_port=0, **LADDER), drive)
+    assert health["status"] == "degraded"
+    assert health["lanes"]["states"] == {"0": "quarantined"}
+
+
+# ---------------------------------------------------------------------------
+# The SLO gate.
+# ---------------------------------------------------------------------------
+
+
+def _base_doc(**over):
+    doc = {"load": {"p50_ms": 10.0, "p95_ms": 20.0, "p99_ms": 30.0,
+                    "goodput_gbps": 1.0, "errors": {}, "mismatches": 0,
+                    "requests": 100},
+           "queue": {"lost": 0}, "compiles": {"steady": 0}}
+    doc["load"].update(over)
+    return doc
+
+
+def test_slo_compare_green_and_red():
+    base = slo.extract(_base_doc())
+    assert slo.compare(base, base) == []
+    # Within tolerance: +20% p95 passes the default 50% band.
+    ok = slo.extract(_base_doc(p95_ms=24.0))
+    assert slo.compare(base, ok) == []
+    # Latency blowout + goodput collapse: both named.
+    bad = slo.extract(_base_doc(p95_ms=200.0, goodput_gbps=0.1))
+    fails = slo.compare(base, bad)
+    assert any(f.startswith("p95_ms") for f in fails)
+    assert any(f.startswith("goodput_gbps") for f in fails)
+    # Count metrics tolerate NOTHING — one error over baseline is red.
+    err = slo.extract(_base_doc(errors={"deadline": 1}))
+    assert any(f.startswith("errors_total")
+               for f in slo.compare(base, err))
+    lost = dict(base, lost=1.0)
+    assert any(f.startswith("lost") for f in slo.compare(base, lost))
+    # Tolerance overrides: widen p95 to 20x and the blowout passes.
+    wide = slo.parse_tolerances("p95_ms=20,goodput_gbps=20")
+    assert not [f for f in slo.compare(base, bad, wide)]
+    with pytest.raises(ValueError):
+        slo.parse_tolerances("nope=1")
+
+
+def test_slo_extract_accepts_bench_line():
+    line = {"p50_ms": 1.0, "p95_ms": 2.0, "p99_ms": 3.0,
+            "goodput_gbps": 0.5, "errors": {"shed": 2}, "lost": 1,
+            "recompiles": 4, "mismatches": 0, "requests": 10}
+    m = slo.extract(line)
+    assert m["errors_total"] == 2 and m["lost"] == 1
+    assert m["recompiles"] == 4 and m["goodput_gbps"] == 0.5
+
+
+def test_slo_gate_cli_green_and_red(tmp_path):
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps(_base_doc()))
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps(_base_doc(p95_ms=21.0)))
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(_base_doc(p95_ms=500.0)))
+    assert slo.main([str(base), str(good)]) == 0
+    assert slo.main([str(base), str(bad)]) == 1
+    assert slo.main([str(base), str(bad), "--tolerance",
+                     "p95_ms=50"]) == 0
+
+
+def test_bench_slo_gate_end_to_end(tmp_path, capsys):
+    """serve.bench --slo: a healthy rerun passes against its own
+    baseline (wide bands — CI noise), and the injected dispatch_slow
+    latency regression turns the SAME gate red (exit 1) while error
+    counters stay at zero — a pure SLO failure, not a correctness one."""
+    art1 = tmp_path / "base.json"
+    rc = serve_bench.main([
+        "--requests", "24", "--concurrency", "6", "--bucket-max", "256",
+        "--seed", "1", "--lanes", "1", "--artifact", str(art1)])
+    assert rc == 0
+    tol = "p50_ms=4,p95_ms=4,p99_ms=4,goodput_gbps=0.8"
+    rc = serve_bench.main([
+        "--requests", "24", "--concurrency", "6", "--bucket-max", "256",
+        "--seed", "1", "--lanes", "1",
+        "--artifact", str(tmp_path / "green.json"),
+        "--slo", str(art1), "--slo-tolerance", tol])
+    assert rc == 0
+    capsys.readouterr()
+    import os
+    os.environ["OT_FAULTS"] = "dispatch_slow"
+    os.environ["OT_SLOW_S"] = "0.2"
+    faults.reset()
+    try:
+        rc = serve_bench.main([
+            "--requests", "24", "--concurrency", "6",
+            "--bucket-max", "256", "--seed", "1", "--lanes", "1",
+            "--artifact", str(tmp_path / "red.json"),
+            "--slo", str(art1), "--slo-tolerance", tol])
+    finally:
+        os.environ.pop("OT_FAULTS", None)
+        os.environ.pop("OT_SLOW_S", None)
+        faults.reset()
+    assert rc == 1
+    out = capsys.readouterr()
+    assert "REGRESSION" in out.out
+    line = json.loads(out.out.strip().splitlines()[-1])
+    assert line["errors"] == {}  # slow, not broken: a pure SLO red
+
+
+def test_injected_slow_fires_and_sleeps(monkeypatch):
+    import time
+    monkeypatch.setenv("OT_FAULTS", "dispatch_slow:2")
+    monkeypatch.setenv("OT_SLOW_S", "0.05")
+    faults.reset()
+    t0 = time.monotonic()
+    assert faults.injected_slow("dispatch_slow") is True
+    assert time.monotonic() - t0 >= 0.05
+    assert faults.injected_slow("dispatch_slow") is True
+    assert faults.injected_slow("dispatch_slow") is False  # pool spent
