@@ -39,7 +39,8 @@ try:
 except Exception:  # pragma: no cover - only on a broken tree
     KNOWN_POINTS = ("init_hang", "dispatch_fail", "build_fail", "lock_busy",
                     "dispatch_hang", "unit_crash", "serve_dispatch",
-                    "lane_fail", "lane_hang", "dispatch_slow")
+                    "lane_fail", "lane_hang", "dispatch_slow",
+                    "backend_fail", "backend_hang")
 
 # The live metrics label-key allowlist (obs/metrics.py, also
 # stdlib-only) — same live-registry-with-frozen-fallback pattern.
@@ -48,7 +49,7 @@ try:
 except Exception:  # pragma: no cover - only on a broken tree
     ALLOWED_LABEL_KEYS = ("lane", "rung", "engine", "outcome", "bucket",
                           "code", "state", "slots", "point", "kind",
-                          "mode")
+                          "mode", "backend", "reason")
 
 
 @dataclass
@@ -373,7 +374,8 @@ def _check_trace_attrs(ctx: FileContext):
 # fault-points: OT_FAULTS seam names drawn from faults.KNOWN_POINTS
 # ---------------------------------------------------------------------------
 
-_FAULT_METHODS = ("fire", "check", "check_lane", "scoped", "consume",
+_FAULT_METHODS = ("fire", "check", "check_lane", "check_backend",
+                  "fire_backend", "scoped", "scoped_backend", "consume",
                   "remaining", "injected_hang", "injected_slow")
 
 
@@ -534,6 +536,66 @@ def _check_serve_lane(ctx: FileContext):
                 "deadline, health accounting, and bit-exact failover")
 
 
+# ---------------------------------------------------------------------------
+# route-backend-seam: backend contact in route/ only through route/proxy.py;
+# the whole routing tier stays device-free
+# ---------------------------------------------------------------------------
+
+#: Call tails that open a socket to (or exchange frames with) a backend.
+#: In route/, every one of them belongs to the proxy seam: a backend
+#: contact outside it has no attempt deadline, no health accounting, no
+#: failover — a fault there degrades the ROUTER, not a backend, which
+#: is exactly the failure mode the seam exists to contain.
+_ROUTE_CONTACT_TAILS = ("open_connection", "create_connection",
+                        "read_frame", "encode_frame")
+#: The seam file plus the harness entry (route/bench.py drives workers
+#: and references engines the way serve/bench.py does — it is the
+#: operator tool, not the routing tier).
+_ROUTE_SEAM_FILES = ("route/proxy.py",)
+_ROUTE_HARNESS_FILES = ("route/bench.py",)
+
+
+def _check_route_seam(ctx: FileContext):
+    if not ctx.in_dir("route", "our_tree_tpu/route"):
+        return
+    harness = ctx.is_file(*_ROUTE_HARNESS_FILES)
+    in_seam = ctx.is_file(*_ROUTE_SEAM_FILES)
+    for node in ast.walk(ctx.tree):
+        # The routing tier is DEVICE-FREE by construction: a jax import
+        # anywhere in route/ (bench included) couples the front-end's
+        # availability to a backend toolchain it exists to abstract
+        # over — the router must start on any box.
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name.split(".")[0] == "jax":
+                    yield node, (
+                        "`import jax` in route/: the routing tier is "
+                        "device-free — engines live behind the backends; "
+                        "a router that needs jax cannot front a mixed or "
+                        "jax-less fleet")
+        elif isinstance(node, ast.ImportFrom):
+            if (node.module or "").split(".")[0] == "jax":
+                yield node, (
+                    "`from jax import ...` in route/: the routing tier "
+                    "is device-free (see route-backend-seam)")
+        if harness or in_seam or not isinstance(node, ast.Call):
+            continue
+        name = _dotted(node.func)
+        tail = name.rsplit(".", 1)[-1]
+        if tail in _ROUTE_CONTACT_TAILS:
+            yield node, (
+                f"`{name}()` contacts a backend from route/ outside "
+                "the proxy seam: route the exchange through "
+                "route/proxy.py (Backend.exchange / poll_healthz) so "
+                "it gets the attempt deadline, health accounting, and "
+                "bit-exact failover")
+        elif tail in _SERVE_DISPATCH_TAILS:
+            yield node, (
+                f"`{name}()` dispatches engine work from route/: the "
+                "router never touches engines — backends do; submit "
+                "through the proxy instead")
+
+
 RULES: tuple[Rule, ...] = (
     Rule("subprocess-isolate", "error",
          "Child processes only via resilience.isolate.run_child — no bare "
@@ -575,6 +637,12 @@ RULES: tuple[Rule, ...] = (
          "health, and failover; worker threads in serve/ exist only "
          "inside the lane executor (serve/dispatch.py).",
          _check_serve_lane),
+    Rule("route-backend-seam", "error",
+         "Backend contact in route/ (socket opens, wire frames) only "
+         "inside route/proxy.py — the proxy seam owns attempt "
+         "deadlines, health, and failover — and the routing tier is "
+         "device-free: no jax import anywhere in route/.",
+         _check_route_seam),
 )
 
 

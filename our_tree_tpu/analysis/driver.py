@@ -36,6 +36,13 @@ def _default_paths(root: str) -> list[str]:
     bench = os.path.join(root, "bench.py")
     if os.path.exists(bench):
         paths.append(bench)
+    # scripts/ rides the default gate too (ROADMAP carry-over): the
+    # operator tools share the repo's seams, so they share its lint —
+    # accepted legacy shapes live in analysis/baseline.json with
+    # reasons, like every other known finding.
+    scripts = os.path.join(root, "scripts")
+    if os.path.isdir(scripts):
+        paths.append(scripts)
     return paths
 
 

@@ -66,7 +66,8 @@ VERSION = 1
 #: (request ids, tenant digests, f-strings), is a lint error: labels
 #: multiply series, and series live forever in a process-global dict.
 ALLOWED_LABEL_KEYS = ("lane", "rung", "engine", "outcome", "bucket",
-                      "code", "state", "slots", "point", "kind", "mode")
+                      "code", "state", "slots", "point", "kind", "mode",
+                      "backend", "reason")
 
 #: Runtime backstop for the same hazard the lint rule prevents
 #: statically: at most this many distinct label sets per metric name —

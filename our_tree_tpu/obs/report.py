@@ -284,6 +284,44 @@ def render(run: export.Run, top: int = 10, out=sys.stdout,
                ["lane", "dispatches", "probes", "device_s", "busy",
                 "killed"], out)
 
+    # -- per-backend dispatch (route) --------------------------------------
+    # The routing tier's fault-domain breakdown, mirroring the per-lane
+    # table one level up: `route-dispatch` / `backend-probe` spans carry
+    # a `backend` attr (route/proxy.py). Closed spans sum into wall_s;
+    # an ORPHANED route-dispatch span is a kill (a hung backend request
+    # the attempt deadline ended) and is counted, not timed.
+    be_time: dict[str, int] = {}
+    be_count: dict[str, int] = {}
+    be_probes: dict[str, int] = {}
+    be_kills: dict[str, int] = {}
+    be_redisp: dict[str, int] = {}
+    for sp in run.spans.values():
+        if sp.name not in ("route-dispatch", "backend-probe"):
+            continue
+        backend = sp.attrs.get("backend")
+        if backend is None:
+            continue
+        key = str(backend)
+        if sp.orphan:
+            be_kills[key] = be_kills.get(key, 0) + 1
+            continue
+        if sp.name == "backend-probe":
+            be_probes[key] = be_probes.get(key, 0) + 1
+        else:
+            be_count[key] = be_count.get(key, 0) + 1
+            if sp.attrs.get("redispatch"):
+                be_redisp[key] = be_redisp.get(key, 0) + 1
+        be_time[key] = be_time.get(key, 0) + sp.dur_us(run_end)
+    be_keys = sorted(set(be_time) | set(be_kills), key=lambda k: (len(k), k))
+    if be_keys:
+        out.write("\nper-backend dispatch (route):\n")
+        _table([[k, str(be_count.get(k, 0)), str(be_probes.get(k, 0)),
+                 str(be_redisp.get(k, 0)), _s(be_time.get(k, 0)),
+                 str(be_kills.get(k, 0))]
+                for k in be_keys],
+               ["backend", "dispatches", "probes", "redispatched",
+                "wall_s", "killed"], out)
+
     # -- serve overlap: the in-flight gauge, reconstructed -----------------
     # The lane pool emits a `serve_inflight` gauge event on every
     # TRAFFIC-dispatch lane window (serve/lanes.py:_inflight — canary

@@ -30,6 +30,12 @@ False); a bare token arms it forever. The ``@`` qualifier is one of:
   ``check_lane``) can consume the shot — how the chaos matrix kills
   one fault domain and asserts the other seven kept serving
   (serve/lanes.py, docs/SERVING.md).
+* ``@backend=<i>`` — the same scoping one fault domain up: the point is
+  scoped to ROUTER backend ``i`` (``backend_hang:1@backend=1`` wedges
+  the router's next request to backend 1 and no other's); the registry
+  key becomes ``<point>@backend=<i>`` and only the router's
+  backend-dispatch seam asking for that backend (``scoped_backend`` /
+  ``check_backend``, route/proxy.py) can consume the shot.
 
 Whitespace around tokens is tolerated; unknown point names are
 accepted but warned about on stderr (a typo that silently never fires
@@ -83,6 +89,22 @@ point              wired into
                    sleep for the lane watchdog to interrupt — the lane
                    is quarantined and its in-flight batch re-dispatched
                    on a healthy lane before any request is answered.
+``backend_fail``   the router's backend-dispatch seam (route/proxy.py):
+                   the framed request to the placed backend raises as if
+                   the BACKEND PROCESS had failed mid-request. Usually
+                   backend-scoped (``backend_fail:1@backend=2``); the
+                   router degrades that backend's health and re-dispatches
+                   the request bit-exactly on the next ring node before
+                   any rider is answered — the lane failover contract
+                   lifted to the per-host fault domain.
+``backend_hang``   the wedged-backend variant of ``backend_fail``: the
+                   router's request to that backend blocks past the
+                   attempt deadline (an awaitable sleep — the router is
+                   an asyncio loop, so the hang must yield, not block);
+                   the per-request ``Budget``/attempt deadline expires,
+                   the ``route-dispatch`` span is deliberately ABANDONED
+                   (orphan-as-kill-evidence, the watchdog convention),
+                   the backend is quarantined and the request re-dispatched.
 ``dispatch_slow``  the injected LATENCY regression (``injected_slow``,
                    wired into the serve lane seam): each firing sleeps
                    ``OT_SLOW_S`` (default 0.05 s) WITHOUT failing — the
@@ -117,7 +139,12 @@ import time
 #: compat, tests), but warns — see module docstring.
 KNOWN_POINTS = ("init_hang", "dispatch_fail", "build_fail", "lock_busy",
                 "dispatch_hang", "unit_crash", "serve_dispatch",
-                "lane_fail", "lane_hang", "dispatch_slow")
+                "lane_fail", "lane_hang", "dispatch_slow",
+                "backend_fail", "backend_hang")
+
+#: Scope names the ``@<scope>=<i>`` qualifier accepts: ``lane`` (serve
+#: dispatch lanes) and ``backend`` (the router's backend index).
+SCOPES = ("lane", "backend")
 
 #: Sentinel count for a bare (uncounted) token: armed forever.
 ALWAYS = -1
@@ -173,16 +200,32 @@ def scoped(point: str, lane) -> str:
     return f"{point}@lane={int(lane)}"
 
 
-def _normalize_lane(name: str, tok: str) -> str | None:
-    """Canonicalize a ``<point>@lane=<i>`` name (bare-token form), or
-    None when the lane qualifier is malformed."""
-    base, _, qual = name.partition("@")
-    if not qual.startswith("lane="):
+def scoped_backend(point: str, backend) -> str:
+    """The backend twin of ``scoped``: the registry key the
+    ``@backend=<i>`` grammar arms and the router's backend-dispatch
+    seam asks ``fire`` for (route/proxy.py) — so the chaos matrix can
+    kill ONE backend's traffic and assert the others kept serving,
+    exactly the lane story one level up."""
+    return f"{point}@backend={int(backend)}"
+
+
+def _scope_key(base: str, qual: str) -> str | None:
+    """Canonical registry key for a ``<scope>=<i>`` qualifier, or None
+    when the scope/index is malformed."""
+    scope, sep, idx = qual.partition("=")
+    if not sep or scope.strip() not in SCOPES:
         return None
     try:
-        return scoped(base.strip(), int(qual[5:].strip()))
+        return f"{base.strip()}@{scope.strip()}={int(idx.strip())}"
     except ValueError:
         return None
+
+
+def _normalize_lane(name: str, tok: str) -> str | None:
+    """Canonicalize a ``<point>@<scope>=<i>`` name (bare-token form), or
+    None when the scope qualifier is malformed."""
+    base, _, qual = name.partition("@")
+    return _scope_key(base, qual)
 
 
 def _parse(spec: str) -> tuple[dict[str, int], dict[str, int]]:
@@ -199,10 +242,14 @@ def _parse(spec: str) -> tuple[dict[str, int], dict[str, int]]:
             qual = qual.strip()
             try:
                 n = int(count.strip())
-                if at and qual.startswith("lane="):
-                    # Lane-scoped shot: the lane rides in the registry
-                    # key, so two lanes' shots count independently.
-                    name = scoped(name, int(qual[5:].strip()))
+                if at and "=" in qual:
+                    # Scoped shot (@lane=/@backend=): the scope rides in
+                    # the registry key, so two lanes' (or two backends')
+                    # shots count independently.
+                    key = _scope_key(name, qual)
+                    if key is None:
+                        raise ValueError(qual)
+                    name = key
                 elif at:  # last token's skip wins (skips don't accumulate)
                     skips[name] = max(int(qual), 0)
             except ValueError:
@@ -304,6 +351,24 @@ def check_lane(point: str, lane, detail: str = "") -> None:
     if fire(scoped(point, lane)) or fire(point):
         raise InjectedFault(f"injected fault: {scoped(point, lane)}"
                             + (f" ({detail})" if detail else ""))
+
+
+def check_backend(point: str, backend, detail: str = "") -> None:
+    """``check_lane`` for the router's per-backend seam: raise
+    InjectedFault iff the backend-scoped OR the plain form of `point`
+    fires. Short-circuits so one routed request consumes at most one
+    shot (the ``check_lane`` contract, one fault domain up)."""
+    if fire(scoped_backend(point, backend)) or fire(point):
+        raise InjectedFault(f"injected fault: {scoped_backend(point, backend)}"
+                            + (f" ({detail})" if detail else ""))
+
+
+def fire_backend(point: str, backend) -> bool:
+    """Consume the backend-scoped OR plain shot of `point`, without
+    raising — for seams whose fault is not an exception (the router's
+    ``backend_hang`` is an awaitable sleep, not a raise). Same
+    short-circuit contract as ``check_backend``."""
+    return fire(scoped_backend(point, backend)) or fire(point)
 
 
 def injected_slow(point: str, detail: str = "") -> bool:

@@ -187,6 +187,120 @@ def _attempt(argv, timeout_s, env, cwd, capture) -> ChildResult:
                        time.monotonic() - t0)
 
 
+class ServiceChild:
+    """A LONG-RUNNING child started by ``spawn_service`` — the third
+    shape of child process next to ``run_child`` (run-to-completion)
+    and ``run_isolated_sweep`` (supervised units): a service that is
+    *meant* to outlive the call, e.g. an ot-serve backend worker behind
+    the router (route/bench.py). The handle owns the lifecycle:
+
+    * ``read_line(deadline_s)`` — one stdout line with a wall deadline
+      (the worker's READY line carries its bound ports); never blocks
+      past the deadline even if the child wedges before printing.
+    * ``stop(term_deadline_s)`` — graceful-then-forceful: SIGTERM to the
+      child's session (the drain signal), wait up to the deadline for a
+      clean exit, SIGKILL the whole group on expiry (the same
+      group-kill ``run_child`` uses — a wedged worker may have jax
+      subprocesses of its own). Returns the exit rc (negative = signal
+      death, POSIX convention).
+
+    The child runs in its own session (``start_new_session``) so the
+    group kill can never reach the caller, and stdout/stderr are piped —
+    the service's output is evidence, read deliberately, not interleaved
+    with the supervisor's.
+    """
+
+    __slots__ = ("name", "proc", "_buf")
+
+    def __init__(self, name: str, proc):
+        self.name = name
+        self.proc = proc
+        self._buf = b""
+
+    @property
+    def pid(self) -> int:
+        return self.proc.pid
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def read_line(self, deadline_s: float) -> str | None:
+        """The next stdout line within ``deadline_s`` wall seconds, or
+        None on deadline/EOF — a select() loop over the pipe, because a
+        blocking readline() on a child that hangs before printing would
+        turn the spawner into the hang it exists to bound."""
+        import select
+
+        fd = self.proc.stdout.fileno()
+        end = time.monotonic() + max(deadline_s, 0.0)
+        while b"\n" not in self._buf:
+            left = end - time.monotonic()
+            if left <= 0:
+                return None
+            ready, _, _ = select.select([fd], [], [], min(left, 0.25))
+            if not ready:
+                continue
+            chunk = os.read(fd, 65536)
+            if not chunk:  # EOF: the child died before its line
+                return None
+            self._buf += chunk
+        line, self._buf = self._buf.split(b"\n", 1)
+        return line.decode("utf-8", "replace")
+
+    def stop(self, term_deadline_s: float = 30.0) -> int:
+        """SIGTERM the session, await a graceful exit, SIGKILL the group
+        past the deadline; reaps and returns the exit rc."""
+        if self.proc.poll() is None:
+            try:
+                os.killpg(self.proc.pid, signal.SIGTERM)
+            except (OSError, AttributeError):
+                try:
+                    self.proc.terminate()
+                except OSError:
+                    pass
+            try:
+                self.proc.wait(timeout=max(term_deadline_s, 0.0))
+            except subprocess.TimeoutExpired:
+                _kill_group(self.proc)
+                self.proc.wait()
+        tr = _trace()
+        if tr is not None:
+            tr.point("service-stopped", label=self.name,
+                     rc=self.proc.returncode)
+        return self.proc.returncode
+
+    def drain_output(self) -> tuple[str, str]:
+        """Whatever stdout/stderr remain after exit (including any
+        buffered ready-line tail) — call only once the child is dead."""
+        out, err = b"", b""
+        try:
+            o, e = self.proc.communicate(timeout=5)
+            out, err = o or b"", e or b""
+        except (ValueError, OSError, subprocess.TimeoutExpired):
+            pass
+        return ((self._buf + out).decode("utf-8", "replace"),
+                err.decode("utf-8", "replace"))
+
+
+def spawn_service(argv, *, env=None, cwd=None, name: str = "") -> ServiceChild:
+    """Start ``argv`` as a long-running service child in its own
+    session, stdout/stderr piped; returns the ``ServiceChild`` handle.
+    The spawn is traced (``service-spawned``) and the trace run id is
+    handed down via ``child_env`` so the service's spans join the
+    caller's merged run — same stitch as ``run_child``."""
+    tr = _trace()
+    cenv = dict(env if env is not None else os.environ)
+    if tr is not None:
+        cenv = tr.child_env(cenv)
+    proc = subprocess.Popen(argv, env=cenv, cwd=cwd, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=False,
+                            start_new_session=True)
+    if tr is not None:
+        tr.point("service-spawned",
+                 label=name or os.path.basename(str(argv[0])), pid=proc.pid)
+    return ServiceChild(name or os.path.basename(str(argv[0])), proc)
+
+
 def run_child(argv, timeout_s: float | None = None, *, env=None, cwd=None,
               capture: bool = True, attempts: int = 1,
               base_delay_s: float = 0.0, name: str = "",

@@ -1,0 +1,51 @@
+"""ot-route: the front-end routing tier over N ot-serve backends.
+
+The serving arc made ONE process fault-tolerant (per-device lanes,
+bit-exact failover, overlap, telemetry); this package is the same
+treatment one fault domain up: lanes are the per-DEVICE fault domain,
+the router's backends are the per-HOST one. The paper's decomposition
+(split the work into independent side-effect-free chunks and run them
+anywhere — CTR with explicit counters) is what makes the lift safe:
+a request is a pure function of (tenant, key, nonce, payload), so a
+failed or hung backend's request replays BIT-EXACTLY on the next ring
+node before any rider is answered, exactly as a lane's batch does.
+
+Modules (docs/SERVING.md has the architecture and cookbook):
+
+* ``ring``   — deterministic consistent-hash ring with virtual nodes:
+  a tenant's key digest maps to the backend whose ``keycache.stacked()``
+  schedules are already warm (KEY AFFINITY — the difference between
+  zero per-request schedule work and a rebuild), members join/leave
+  with minimal placement motion (~K/N keys move), and the clockwise
+  successor order IS the failover replica sequence.
+* ``health`` — per-backend health reusing the LANE state machine
+  (healthy/suspect/quarantined/probation/released; a timeout
+  quarantines from any state), driven by dispatch outcomes plus
+  ``/healthz`` gossip polling, quarantine persisted via the same
+  journal failure rows as lanes and sweep units — ONE quarantine
+  model, one ``--unquarantine`` release edit.
+* ``proxy``  — the Router: consistent-hash placement, per-request
+  ``Budget`` deadlines, bit-exact cross-backend failover
+  (re-dispatch-before-error), canary probation (a pinned request whose
+  expected bytes every backend matched at startup), backpressure
+  propagation (a backend's ``shed`` becomes retry-with-backoff on the
+  replica ring, then shed-at-router through the shared ``degrade()``
+  ledger), and graceful membership changes + drain (``lost == 0``
+  gated, like serve drain). The ONLY module that contacts a backend
+  (otlint's ``route-backend-seam`` rule) — and the whole package is
+  DEVICE-FREE: no jax import (the same rule), so the router runs on
+  any box in front of any backend mix.
+* ``status`` — the router's /metrics + /healthz (the shared
+  ``HttpStatusEndpoint``), with the ring/backend MEMBERSHIP VIEW so
+  operators see placement without reading traces.
+* ``bench``  — ``python -m our_tree_tpu.route.bench``: spawns N
+  ``serve.worker`` backend processes (via the isolate service spawner),
+  drives the router with the serve loadgen, writes ``ROUTE_r*.json``
+  (per-backend dispatch table, quarantine/redispatch ledger, affinity
+  vs random-routing keycache A/B), and gates zero lost / zero
+  recompiles / bit-exact probes — the horizontal-scaling artifact.
+
+Wire format: ``serve/wire.py`` (framed JSON-header + raw payload);
+error vocabulary: ``serve.queue``'s closed ERR_* set — the router adds
+no new failure codes, it only decides WHERE a request goes next.
+"""

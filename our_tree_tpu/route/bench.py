@@ -1,0 +1,512 @@
+"""``python -m our_tree_tpu.route.bench`` — the routing-tier drive.
+
+Spawns N REAL ot-serve backend processes (``serve.worker`` via the
+isolate service spawner — each its own session, SIGTERM-drained,
+group-SIGKILLed past the deadline), routes the serve load generator
+through a ``route.proxy.Router`` over them, and writes the horizontal
+scaling artifact ``ROUTE_r*.json`` next to the SERVE_r* series.
+
+Hard contracts the run exits 1 on (the serve.bench set, one fault
+domain up):
+
+* **zero lost** — at the ROUTER (accepted == answered) and at EVERY
+  backend (each worker's exit line carries its own drain ledger, and a
+  nonzero worker rc is a failed drain);
+* **bit-exact probes** — every ``verify_every``-th request replays a
+  pinned reference THROUGH the router (failover included: a request
+  that re-dispatched mid-probe must still return the same bytes);
+* **zero post-warmup recompiles** — summed across backends from their
+  exit lines (``--allow-recompiles`` waives);
+* optional gates for the fault drives: ``--expect-quarantines N``
+  (exactly N backend quarantine events — the backend-kill CI drive
+  pins 1), ``--expect-releases N``, ``--min-redispatch N``, and
+  ``--require-zero-errors``.
+
+The AFFINITY A/B (``--ab``): the same drive runs twice over FRESH
+backend sets — affinity routing, then seeded-random routing (same
+members, same request sequence, no locality) — and the artifact
+records both arms' aggregate backend keycache hit ratios.
+``--min-affinity-gain`` (default 0 with ``--ab``: strictly greater)
+gates that affinity actually bought cache locality, which is the whole
+reason the ring exists.
+
+Fault drives arm ``OT_FAULTS`` in THIS process only (the router owns
+the ``backend_fail``/``backend_hang`` seams); the spawner strips
+``OT_FAULTS`` from worker environments so a router-level fault spec
+can never double-fire inside a backend's serve seams.
+
+``--unquarantine backend:<name>`` (with ``--journal``) is the shared
+release edit — the same ``resilience.journal.clear_failures`` behind
+``harness.bench --unquarantine`` and ``serve.bench --unquarantine``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import glob
+import json
+import os
+import re
+import sys
+
+from ..obs import metrics, slo, trace
+from ..resilience import degrade, isolate
+from ..resilience import journal as journal_mod
+from ..serve import loadgen, wire
+from .proxy import BackendSpec, Router, RouterConfig
+from .status import RouterStatus
+
+#: How long one worker gets to import jax, build/resolve its engine,
+#: warm every lane x rung, and print its READY line.
+READY_DEADLINE_S = 180.0
+
+
+def _repo_root() -> str:
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.dirname(pkg)
+
+
+def _next_artifact(root: str) -> str:
+    """The next free ``ROUTE_r<NN>.json`` at the repo root."""
+    taken = [0]
+    for p in glob.glob(os.path.join(root, "ROUTE_r*.json")):
+        m = re.match(r"ROUTE_r(\d+)\.json$", os.path.basename(p))
+        if m:
+            taken.append(int(m.group(1)))
+    return os.path.join(root, f"ROUTE_r{max(taken) + 1:02d}.json")
+
+
+def _spawn_backends(args, tag: str):
+    """Spawn N serve.worker processes; returns (handles, specs).
+    Raises after cleaning up if any worker fails to come ready."""
+    env = dict(os.environ)
+    # The router owns this drive's fault points; a backend re-parsing
+    # the same spec would double-fire it inside the serve seams.
+    env.pop("OT_FAULTS", None)
+    handles, specs = [], []
+    try:
+        for i in range(args.backends):
+            name = f"b{i}"
+            argv = [sys.executable, "-m", "our_tree_tpu.serve.worker",
+                    "--port", "0", "--status-port", "0",
+                    "--engine", args.engine,
+                    "--bucket-min", str(args.bucket_min),
+                    "--bucket-max", str(args.bucket_max),
+                    "--queue-depth", str(args.worker_queue_depth),
+                    "--tenant-depth-frac", str(args.tenant_depth_frac),
+                    "--dispatch-deadline", str(args.dispatch_deadline)]
+            if args.worker_lanes is not None:
+                argv += ["--lanes", str(args.worker_lanes)]
+            h = isolate.spawn_service(argv, env=env,
+                                      name=f"{tag}:{name}")
+            handles.append(h)
+        for i, h in enumerate(handles):
+            line = h.read_line(READY_DEADLINE_S)
+            doc = None
+            if line:
+                try:
+                    doc = json.loads(line)
+                except ValueError:
+                    doc = None
+            if not (isinstance(doc, dict)
+                    and doc.get("kind") == "ot-serve-worker"):
+                raise RuntimeError(
+                    f"backend b{i} (pid {h.pid}) never came ready "
+                    f"within {READY_DEADLINE_S:.0f}s "
+                    f"(got {line!r})")
+            specs.append(BackendSpec(
+                name=f"b{i}", host="127.0.0.1", port=int(doc["port"]),
+                status_port=doc.get("status_port")))
+            print(f"# backend b{i}: pid {h.pid} port {doc['port']} "
+                  f"status {doc.get('status_port')} "
+                  f"engine {doc.get('engine')} lanes {doc.get('lanes')}",
+                  file=sys.stderr)
+    except BaseException:
+        for h in handles:
+            h.stop(term_deadline_s=5.0)
+        raise
+    return handles, specs
+
+
+def _teardown(handles) -> tuple[list[dict], int]:
+    """SIGTERM-drain every worker, collect their exit-line docs and the
+    worst rc (a worker that lost work exits nonzero; one SIGKILLed past
+    the drain deadline reports a negative rc)."""
+    docs, worst = [], 0
+    for h in handles:
+        rc = h.stop(term_deadline_s=60.0)
+        out, err = h.drain_output()
+        doc = {}
+        for line in reversed(out.splitlines()):
+            try:
+                cand = json.loads(line)
+            except ValueError:
+                continue
+            if (isinstance(cand, dict)
+                    and cand.get("kind") == "ot-serve-worker-exit"):
+                doc = cand
+                break
+        if rc != 0:
+            tail = err.strip().splitlines()[-3:]
+            print(f"# worker {h.name}: rc={rc}"
+                  + (": " + " | ".join(tail) if tail else ""),
+                  file=sys.stderr)
+        docs.append({"rc": rc, **doc})
+        worst = worst if rc == 0 else (rc if worst == 0 else worst)
+    return docs, worst
+
+
+def _keycache_ratio(exit_docs: list[dict]) -> float:
+    """Aggregate backend keycache hit ratio: hits / (hits + misses)
+    summed across every backend's exit ledger — the affinity A/B's
+    measured quantity (affinity routes a tenant's key to the one
+    backend that already expanded it; random routing re-expands it
+    once per backend it wanders to)."""
+    hits = sum(d.get("keycache", {}).get("hits", 0) for d in exit_docs)
+    misses = sum(d.get("keycache", {}).get("misses", 0) for d in exit_docs)
+    return round(hits / (hits + misses), 4) if hits + misses else 0.0
+
+
+async def _drive(args, specs, affinity: bool, probes):
+    cfg = RouterConfig(
+        deadline_s=args.deadline,
+        attempt_timeout_s=args.attempt_timeout,
+        gossip_every_s=args.gossip_every,
+        probation_batches=args.probation_batches,
+        vnodes=args.vnodes,
+        affinity=affinity,
+        seed=args.seed,
+        journal=args.journal if affinity else None,
+        # Response frames carry up to one full top-rung payload; size
+        # the router's read ceiling to THIS fleet's ladder.
+        max_frame_bytes=max(args.bucket_max * 16 * 2, wire.MAX_PAYLOAD))
+    router = Router(specs, cfg)
+    await router.start()
+    status = None
+    if args.status_port is not None and affinity:
+        status = RouterStatus(router, args.status_port)
+        await status.start()
+        print(f"# router status: 127.0.0.1:{status.port}",
+              file=sys.stderr)
+    report = await loadgen.run(
+        router, args.requests, concurrency=args.concurrency,
+        sizes=args.sizes, tenants=args.tenants,
+        keys_per_tenant=args.keys_per_tenant, seed=args.seed,
+        verify_every=args.verify_every, probes=probes,
+        arrival_rate=args.arrival_rate)
+    # One final gossip pass so the artifact's backend view is current.
+    await router.gossip_once()
+    healthz = {name: b.last_healthz
+               for name, b in router.backends.items()}
+    if status is not None:
+        await status.stop()
+    await router.stop()
+    return router, report, healthz
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m our_tree_tpu.route.bench",
+        description="routing-tier drive over N spawned ot-serve backend "
+                    "processes (docs/SERVING.md)")
+    ap.add_argument("--backends", type=int, default=3, metavar="N")
+    ap.add_argument("--requests", type=int, default=500)
+    ap.add_argument("--concurrency", type=int, default=32)
+    ap.add_argument("--arrival-rate", type=float, default=None,
+                    metavar="REQ_PER_S",
+                    help="open-loop mode (serve.bench semantics)")
+    ap.add_argument("--mixed-sizes", action="store_true")
+    ap.add_argument("--size-bytes", type=int, default=4096)
+    ap.add_argument("--tenants", type=int, default=8)
+    ap.add_argument("--keys-per-tenant", type=int, default=2)
+    ap.add_argument("--engine", default="auto",
+                    help="backend serve engine tier (serve.worker "
+                         "--engine; auto = native AESNI on CPU)")
+    ap.add_argument("--worker-lanes", type=int, default=None, metavar="N")
+    ap.add_argument("--worker-queue-depth", type=int, default=1024)
+    ap.add_argument("--tenant-depth-frac", type=float, default=1.0,
+                    metavar="FRAC")
+    ap.add_argument("--bucket-min", type=int, default=32, metavar="BLOCKS")
+    ap.add_argument("--bucket-max", type=int, default=4096,
+                    metavar="BLOCKS")
+    ap.add_argument("--deadline", type=float, default=30.0,
+                    help="per-request end-to-end Budget, seconds")
+    ap.add_argument("--attempt-timeout", type=float, default=5.0,
+                    metavar="S",
+                    help="wall deadline per backend attempt — the bound "
+                         "that turns a hung backend into failover")
+    ap.add_argument("--dispatch-deadline", type=float, default=10.0,
+                    help="each BACKEND's per-lane watchdog deadline")
+    ap.add_argument("--gossip-every", type=float, default=1.0, metavar="S")
+    ap.add_argument("--probation-batches", type=int, default=2)
+    ap.add_argument("--vnodes", type=int, default=64)
+    ap.add_argument("--no-affinity", action="store_true",
+                    help="random routing only (the control arm alone)")
+    ap.add_argument("--ab", action="store_true",
+                    help="run BOTH arms over fresh backend sets and "
+                         "record the keycache hit-ratio comparison")
+    ap.add_argument("--min-affinity-gain", type=float, default=None,
+                    metavar="FRAC",
+                    help="with --ab: fail unless affinity hit ratio "
+                         "exceeds the random arm's by more than FRAC "
+                         "(default 0: strictly greater)")
+    ap.add_argument("--verify-every", type=int, default=8)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--journal", default=None, metavar="PATH",
+                    help="router journal (backend quarantine "
+                         "persistence; docs/RESILIENCE.md)")
+    ap.add_argument("--unquarantine", action="append", default=None,
+                    metavar="BACKEND",
+                    help="release the named backend (e.g. backend:b1) by "
+                         "dropping its failure rows from --journal, then "
+                         "exit — the same clear_failures edit as "
+                         "harness.bench/serve.bench")
+    ap.add_argument("--status-port", type=int, default=None, metavar="PORT",
+                    help="router /metrics + /healthz (with the "
+                         "ring/backend membership view) for the drive's "
+                         "duration (0 = ephemeral)")
+    ap.add_argument("--slo", default=None, metavar="BASELINE.json",
+                    help="gate this run against a committed "
+                         "ROUTE_r*.json baseline (obs/slo.py)")
+    ap.add_argument("--slo-tolerance", default=None, metavar="SPEC")
+    ap.add_argument("--artifact", default=None, metavar="PATH")
+    ap.add_argument("--allow-recompiles", action="store_true")
+    ap.add_argument("--require-zero-errors", action="store_true",
+                    help="fail on ANY per-request error response (the "
+                         "backend-kill drive's 0-errors gate: failover "
+                         "must absorb the fault)")
+    ap.add_argument("--expect-quarantines", type=int, default=None,
+                    metavar="N",
+                    help="fail unless the run saw exactly N backend "
+                         "quarantine events")
+    ap.add_argument("--expect-releases", type=int, default=None,
+                    metavar="N",
+                    help="fail unless exactly N probation releases "
+                         "completed")
+    ap.add_argument("--min-redispatch", type=int, default=None, metavar="N",
+                    help="fail unless redispatches >= N (the failover "
+                         "actually happened)")
+    args = ap.parse_args(argv)
+    if args.ab and args.no_affinity:
+        ap.error("--ab compares affinity AGAINST random routing; with "
+                 "--no-affinity both arms would be random and the "
+                 "affinity-gain gate could only report a false verdict")
+    args.sizes = (loadgen.MIXED_SIZES if args.mixed_sizes
+                  else (args.size_bytes,))
+
+    if args.unquarantine:
+        if not args.journal:
+            ap.error("--unquarantine requires --journal "
+                     "(the ledger being edited)")
+        trace.ensure_run()
+        cleared = journal_mod.clear_failures(args.journal,
+                                             args.unquarantine)
+        for unit, n in sorted(cleared.items()):
+            if n:
+                trace.point("quarantine-release", unit=unit, cleared=n)
+            print(f"# unquarantine: {unit}: cleared {n} failure row(s)"
+                  + ("" if n else " (none recorded)"))
+        return 0
+
+    trace.ensure_run()
+    probes = (loadgen.make_probes(args.sizes, args.seed)
+              if args.verify_every else [])
+
+    affinity = not args.no_affinity
+    handles, specs = _spawn_backends(args, "route")
+    try:
+        router, report, healthz = asyncio.run(
+            _drive(args, specs, affinity, probes))
+    except BaseException:
+        _teardown(handles)
+        raise
+    exit_docs, worker_rc = _teardown(handles)
+
+    control = None
+    if args.ab:
+        # The control arm: fresh backends (cold keycaches — the A/B is
+        # meaningless over warm ones), same seed, random routing.
+        c_handles, c_specs = _spawn_backends(args, "route-ctl")
+        try:
+            c_router, c_report, _ = asyncio.run(
+                _drive(args, c_specs, False, probes))
+        except BaseException:
+            _teardown(c_handles)
+            raise
+        c_exit_docs, c_rc = _teardown(c_handles)
+        worker_rc = worker_rc or c_rc
+        control = {
+            "load": c_report.to_json(),
+            "router": c_router.stats(),
+            "keycache_hit_ratio": _keycache_ratio(c_exit_docs),
+            "workers": c_exit_docs,
+        }
+
+    rstats = router.stats()
+    lost_router = rstats["lost"]
+    lost_workers = sum(d.get("lost", 0) for d in exit_docs)
+    recompiles = sum(d.get("recompiles", 0) for d in exit_docs)
+    backend_quarantines = sum(d.get("quarantines", 0) for d in exit_docs)
+    kc_ratio = _keycache_ratio(exit_docs)
+    releases = router.release_events()
+
+    print(f"# route: backends={args.backends} affinity={affinity} "
+          f"vnodes={args.vnodes} tenants={args.tenants} "
+          f"attempt_timeout={args.attempt_timeout:g}s "
+          f"gossip={args.gossip_every:g}s")
+    print(f"# requests={report.requests} ok={report.ok} "
+          f"errors={report.errors or '{}'} lost_router={lost_router} "
+          f"lost_workers={lost_workers} verified={report.verified} "
+          f"mismatches={report.mismatches}")
+    print(f"# latency ms: p50={report.p50_ms} p95={report.p95_ms} "
+          f"p99={report.p99_ms}  goodput={report.goodput_gbps:.4f} GB/s "
+          f"wall={report.wall_s:.3f}s")
+    print(f"# failover: redispatches={rstats['redispatches']} "
+          f"quarantines={rstats['quarantine_events']} releases={releases} "
+          f"shed_retries={rstats['shed_retries']} "
+          f"router_sheds={rstats['router_sheds']}")
+    print(f"# affinity: ratio={rstats['affinity']['ratio']:.4f} "
+          f"(hits={rstats['affinity']['hits']} "
+          f"misses={rstats['affinity']['misses']}) "
+          f"backend_keycache_hit_ratio={kc_ratio:.4f}"
+          + (f" vs random={control['keycache_hit_ratio']:.4f}"
+             if control else ""))
+    for name, b in sorted(rstats["backends"].items()):
+        tr = "".join(f" [{t['prev']}->{t['to']}:{t['why']}]"
+                     for t in b["transitions"])
+        print(f"#   backend {name} ({b['addr']}): "
+              f"{b['dispatches']} dispatch(es), {b['bytes']} bytes, "
+              f"state={b['state']}{tr}")
+
+    artifact = {
+        "config": {
+            "backends": args.backends, "requests": args.requests,
+            "concurrency": args.concurrency, "sizes": list(args.sizes),
+            "tenants": args.tenants,
+            "keys_per_tenant": args.keys_per_tenant,
+            "engine": args.engine, "vnodes": args.vnodes,
+            "affinity": affinity, "ab": bool(args.ab),
+            "attempt_timeout_s": args.attempt_timeout,
+            "gossip_every_s": args.gossip_every,
+            "worker_lanes": args.worker_lanes,
+            "seed": args.seed,
+        },
+        "load": report.to_json(),
+        "router": rstats,
+        "queue": {"lost": lost_router + lost_workers,
+                  "lost_router": lost_router,
+                  "lost_workers": lost_workers},
+        "compiles": {"steady": recompiles},
+        "workers": exit_docs,
+        "backend_quarantines_internal": backend_quarantines,
+        "affinity_ab": {
+            "affinity_keycache_hit_ratio": kc_ratio,
+            "random_keycache_hit_ratio": (
+                control["keycache_hit_ratio"] if control else None),
+        },
+        "control": control,
+        "healthz": healthz,
+        "degraded": degrade.events(),
+        "metrics": metrics.snapshot(),
+    }
+    if trace.enabled():
+        artifact["obs"] = trace.metrics_snapshot()
+        artifact["trace_sample"] = trace.sample_rate()
+    path = args.artifact or _next_artifact(_repo_root())
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(artifact, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    print(f"# artifact: {path}", file=sys.stderr)
+
+    slo_rc = 0
+    if args.slo:
+        try:
+            slo_rc = slo.gate(args.slo, artifact, args.slo_tolerance)
+        except (OSError, ValueError, KeyError) as e:
+            print(f"# slo: gate unusable: {e}", file=sys.stderr)
+            slo_rc = 1
+
+    line = {"unit": "route", "backends": args.backends,
+            "affinity": affinity,
+            "requests": report.requests, "ok": report.ok,
+            "errors": dict(sorted(report.errors.items())),
+            "lost": lost_router + lost_workers,
+            "p50_ms": report.p50_ms, "p95_ms": report.p95_ms,
+            "p99_ms": report.p99_ms,
+            "goodput_gbps": round(report.goodput_gbps, 4),
+            "redispatches": rstats["redispatches"],
+            "quarantines": rstats["quarantine_events"],
+            "releases": releases,
+            "recompiles": recompiles,
+            "mismatches": report.mismatches,
+            "affinity_ratio": rstats["affinity"]["ratio"],
+            "keycache_hit_ratio": kc_ratio}
+    if control:
+        line["keycache_hit_ratio_random"] = control["keycache_hit_ratio"]
+    if args.slo:
+        line["slo"] = "fail" if slo_rc else "pass"
+    if degrade.events():
+        line["degraded"] = degrade.events()
+    print(json.dumps(line))
+
+    rc = 0
+    if report.mismatches:
+        print(f"# FAIL: {report.mismatches} probe response(s) mismatched "
+              "the byte-exact reference THROUGH the router",
+              file=sys.stderr)
+        rc = 1
+    if lost_router or lost_workers:
+        print(f"# FAIL: lost requests (router={lost_router}, "
+              f"workers={lost_workers}) — the drain/failover contract is "
+              "broken", file=sys.stderr)
+        rc = 1
+    if worker_rc:
+        print(f"# FAIL: a worker exited rc={worker_rc} (failed drain or "
+              "SIGKILL past the drain deadline)", file=sys.stderr)
+        rc = 1
+    if recompiles and not args.allow_recompiles:
+        print(f"# FAIL: {recompiles} post-warmup backend compile(s) "
+              "across the fleet (--allow-recompiles to waive)",
+              file=sys.stderr)
+        rc = 1
+    if args.require_zero_errors and report.errors:
+        print(f"# FAIL: request errors {report.errors} — failover did "
+              "not absorb the fault", file=sys.stderr)
+        rc = 1
+    if (args.expect_quarantines is not None
+            and rstats["quarantine_events"] != args.expect_quarantines):
+        print(f"# FAIL: {rstats['quarantine_events']} quarantine "
+              f"event(s), expected exactly {args.expect_quarantines}",
+              file=sys.stderr)
+        rc = 1
+    if (args.expect_releases is not None
+            and releases != args.expect_releases):
+        print(f"# FAIL: {releases} probation release(s), expected "
+              f"exactly {args.expect_releases}", file=sys.stderr)
+        rc = 1
+    if (args.min_redispatch is not None
+            and rstats["redispatches"] < args.min_redispatch):
+        print(f"# FAIL: redispatches {rstats['redispatches']} < "
+              f"{args.min_redispatch} — the failover never happened",
+              file=sys.stderr)
+        rc = 1
+    if control is not None:
+        gain = kc_ratio - control["keycache_hit_ratio"]
+        floor = args.min_affinity_gain if args.min_affinity_gain is not None else 0.0
+        if gain <= floor:
+            print(f"# FAIL: affinity keycache hit ratio {kc_ratio:.4f} "
+                  f"not better than random "
+                  f"{control['keycache_hit_ratio']:.4f} by more than "
+                  f"{floor:g} — key affinity bought nothing",
+                  file=sys.stderr)
+            rc = 1
+    if slo_rc:
+        print(f"# FAIL: SLO regression against {args.slo}",
+              file=sys.stderr)
+        rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,716 @@
+"""The Router: consistent-hash placement, bit-exact failover, gossip.
+
+The lane pool's contract, lifted one fault domain (serve/lanes.py is
+the per-DEVICE version of every rule below; this module is the
+per-HOST one):
+
+* **Placement is affinity-first.** A request's ring key is
+  ``ring.affinity_key(tenant, key)``; the ring's clockwise owner is
+  the backend whose keycache already holds that key's expanded
+  schedule — so steady-state routing does ZERO per-request schedule
+  work on the backend, and the A/B in ``route.bench`` (affinity vs
+  seeded-random routing over fresh backend sets) measures exactly that
+  difference as keycache hit ratio.
+* **Failover before error.** A failed, hung, or unreachable backend's
+  request re-dispatches on the next ring node — CTR with explicit
+  counters is side-effect-free replay, so the bytes are identical
+  wherever it runs — and only when EVERY backend has been tried does
+  the rider see an error (coded ``deadline`` if the last cause was a
+  hang, else ``dispatch-failed``: the LanesExhausted convention).
+* **Hangs are bounded and leave evidence.** Every attempt runs under
+  ``min(attempt deadline, the request Budget's remainder)`` via
+  ``asyncio.wait_for``; expiry ABANDONS the ``route-dispatch`` span
+  (the orphaned begin is the kill evidence — ``obs.report --check
+  --expected-orphans route-dispatch``, the watchdog convention) and
+  quarantines the backend: a hang is never transient.
+* **Backpressure propagates, it does not amplify.** A backend's
+  ``shed`` answer is not a failure — the backend is healthy, just
+  full. The router retries the REPLICA ring with exponential backoff
+  (spreading the hot tenant's overflow instead of hammering the home
+  node), and only when every placeable backend shed does it shed at
+  the router — stamped ``route->shed`` through the shared ``degrade()``
+  ledger, so an overloaded fleet can never report a healthy run.
+* **Membership changes are minimal-motion and observable.** join/leave
+  rebalance only the moved arcs (route/ring.py); the router diffs the
+  placement of its recently-seen affinity keys across the change and
+  traces ``ring-rebalance`` with the moved count — the operator's
+  answer to "what did that deploy do to my cache locality".
+* **Release runs through the data path.** Quarantined backends are
+  canary-probed (gossip ``ok`` triggers it; a no-placeable-backend
+  rescue forces it): the pinned canary request — whose expected bytes
+  every backend matched at STARTUP, the cross-backend bit-exactness
+  invariant — must come back bit-exact to earn probation. Probation is
+  served through real traffic, then released. One quarantine ledger:
+  journal failure rows under ``backend:<name>``, released by the same
+  ``clear_failures`` edit as lanes and sweep units.
+
+This module is the ONLY backend contact in the package (otlint's
+``route-backend-seam``): every socket a backend ever sees from the
+router — framed requests, /healthz gossip polls, canaries — is opened
+here, inside the guarded seams with the fault points
+(``backend_fail``/``backend_hang``, ``@backend=<i>`` scoped) that let
+CI kill one fault domain and assert the rest kept serving.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..obs import metrics, trace
+from ..resilience import degrade, faults
+from ..resilience import journal as journal_mod
+from ..resilience.policy import Budget
+from ..serve import wire
+from ..serve.queue import (ERR_DEADLINE, ERR_DISPATCH, ERR_SHED,
+                           ERR_SHUTDOWN, Response)
+from . import ring as ring_mod
+from .health import QUARANTINED, RELEASED, BackendHealth, backend_unit
+
+#: The pinned canary request: zero key, zero nonce, 4 zero blocks —
+#: tiny, ladder-shaped, and identical on every backend (the startup
+#: cross-backend comparison pins its expected bytes; no reference
+#: implementation is needed router-side, keeping the package jax-free).
+CANARY_TENANT = "_canary"
+CANARY_KEY = b"\x00" * 16
+CANARY_NONCE = b"\x00" * 16
+CANARY_PAYLOAD = b"\x00" * 64
+
+
+class BackendsExhausted(RuntimeError):
+    """Every backend failed this request (rescue canaries included).
+    ``causes`` is [(backend_idx, exc), ...] in attempt order;
+    ``timed_out`` reflects the LAST cause — the error code the rider
+    sees matches what finally stopped the request (the LanesExhausted
+    convention, one fault domain up)."""
+
+    def __init__(self, label: str, causes: list):
+        self.causes = causes
+        last = causes[-1][1] if causes else None
+        self.timed_out = isinstance(last, asyncio.TimeoutError)
+        names = ",".join(f"b{i}:{type(e).__name__}" for i, e in causes)
+        super().__init__(
+            f"request {label}: no backend could serve it "
+            f"({names or 'no backends'})")
+
+
+@dataclass
+class BackendSpec:
+    """How to reach one ot-serve backend: the framed request port plus
+    the /healthz status port (both on ``host``). ``name`` is the ring
+    identity — keep it stable across restarts of the same backend slot
+    or its keys re-home."""
+
+    name: str
+    host: str
+    port: int
+    status_port: int | None = None
+
+
+class Backend:
+    """Client-side handle: spec + health + counters + the contact seams."""
+
+    def __init__(self, idx: int, spec: BackendSpec,
+                 probation_batches: int = 2, journal=None,
+                 clock=time.monotonic,
+                 max_frame_bytes: int = wire.MAX_PAYLOAD):
+        self.idx = idx
+        self.spec = spec
+        self.max_frame_bytes = int(max_frame_bytes)
+        self.health = BackendHealth(idx, spec.name,
+                                    probation_batches=probation_batches,
+                                    journal=journal, clock=clock)
+        self.dispatches = 0
+        self.bytes_out = 0
+        self.failures = 0
+        self.timeouts = 0
+        self.redispatches_in = 0
+        self.sheds_seen = 0
+        self.canaries = 0
+        self.last_healthz: dict | None = None
+
+    # -- the framed-request seam -------------------------------------------
+    async def exchange(self, header: dict, payload: bytes,
+                       timeout_s: float):
+        """One framed request/response round trip with a hard wall
+        deadline over the WHOLE exchange (connect included — a backend
+        that stopped accepting is as hung as one that stopped
+        answering). Returns (response header, response payload)."""
+        return await asyncio.wait_for(
+            self._exchange(header, payload), timeout=max(timeout_s, 0.001))
+
+    async def _exchange(self, header: dict, payload: bytes):
+        reader, writer = await asyncio.open_connection(
+            self.spec.host, self.spec.port)
+        try:
+            writer.write(wire.encode_frame(header, payload))
+            await writer.drain()
+            frame = await wire.read_frame(reader, self.max_frame_bytes)
+            if frame is None:
+                raise ConnectionError(
+                    f"backend {self.spec.name} closed mid-exchange")
+            return frame
+        finally:
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001 - peer already gone
+                pass
+
+    # -- the gossip seam ----------------------------------------------------
+    async def poll_healthz(self, timeout_s: float = 2.0) -> dict | None:
+        """GET /healthz off the backend's status port; None when the
+        backend is unreachable, has no status port, or answers junk —
+        gossip treats all three as the same reconnaissance failure."""
+        if not self.spec.status_port:
+            return None
+        try:
+            doc = await asyncio.wait_for(self._get_healthz(),
+                                         timeout=max(timeout_s, 0.001))
+        except Exception:  # noqa: BLE001 - unreachable IS the data point
+            return None
+        self.last_healthz = doc
+        return doc
+
+    async def _get_healthz(self) -> dict | None:
+        reader, writer = await asyncio.open_connection(
+            self.spec.host, self.spec.status_port)
+        try:
+            writer.write(b"GET /healthz HTTP/1.1\r\n"
+                         b"Host: backend\r\nConnection: close\r\n\r\n")
+            await writer.drain()
+            raw = await reader.read(1 << 20)
+        finally:
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001 - peer already gone
+                pass
+        head, _, body = raw.partition(b"\r\n\r\n")
+        if not head.startswith(b"HTTP/1.1 200"):
+            return None
+        doc = json.loads(body)
+        return doc if isinstance(doc, dict) else None
+
+    def stats(self) -> dict:
+        return {
+            "backend": self.idx, "name": self.spec.name,
+            "addr": f"{self.spec.host}:{self.spec.port}",
+            "dispatches": self.dispatches, "bytes": self.bytes_out,
+            "failures": self.failures, "timeouts": self.timeouts,
+            "redispatches_in": self.redispatches_in,
+            "sheds_seen": self.sheds_seen, "canaries": self.canaries,
+            **self.health.stats(),
+        }
+
+
+@dataclass
+class RouterConfig:
+    #: per-request end-to-end Budget (admission -> answer), seconds
+    deadline_s: float = 30.0
+    #: wall deadline per backend ATTEMPT (connect + serve + reply);
+    #: clamped to the request Budget's remainder — the watchdog bound
+    #: that turns a wedged backend into failover instead of a stall
+    attempt_timeout_s: float = 5.0
+    #: /healthz gossip poll period (0 disables polling; dispatch
+    #: outcomes still drive health)
+    gossip_every_s: float = 1.0
+    #: clean answers a released backend serves before leaving probation
+    probation_batches: int = 2
+    #: base backoff before retrying a SHED answer on the next replica
+    #: (exponential per extra shed in the same request)
+    shed_backoff_s: float = 0.02
+    #: virtual nodes per ring member
+    vnodes: int = 64
+    #: affinity routing (the production mode); False = seeded-random
+    #: backend order per request (the A/B control arm)
+    affinity: bool = True
+    #: RNG seed for the random-routing control arm
+    seed: int = 0
+    #: router journal path (backend quarantine persistence, the shared
+    #: --unquarantine edit); None = in-memory health only
+    journal: str | None = None
+    #: recently-seen affinity keys tracked for rebalance-motion
+    #: accounting (bounded; 0 disables tracking)
+    track_keys: int = 4096
+    #: response-frame payload ceiling per backend exchange — size it to
+    #: the fleet's bucket ladder (route.bench derives it from
+    #: --bucket-max); a legitimate response above it would read as a
+    #: backend failure on every replica
+    max_frame_bytes: int = wire.MAX_PAYLOAD
+
+
+class Router:
+    """The front-end routing tier over N ot-serve backends."""
+
+    def __init__(self, specs: list[BackendSpec],
+                 config: RouterConfig | None = None, clock=time.monotonic):
+        self.config = config or RouterConfig()
+        self._clock = clock
+        self.ring = ring_mod.Ring(vnodes=self.config.vnodes)
+        self.backends: dict[str, Backend] = {}
+        self._journal = None
+        self._next_idx = 0
+        self._specs = list(specs)
+        self._rng = np.random.default_rng(self.config.seed)
+        self.accepted = 0
+        self.answered = 0
+        self.routed_ok = 0
+        self.redispatches = 0
+        self.shed_retries = 0
+        self.router_sheds = 0
+        self.affinity_hits = 0
+        self.affinity_misses = 0
+        self.ring_changes = 0
+        self._canary_expected: bytes | None = None
+        self._gossip_task: asyncio.Task | None = None
+        self._draining = False
+        self._inflight = 0
+        self._idle = asyncio.Event()
+        self._idle.set()
+        #: recently-seen affinity keys (insertion-ordered dict as LRU)
+        #: — the rebalance-motion sample on membership changes
+        self._seen_keys: dict[str, None] = {}
+
+    # -- lifecycle ---------------------------------------------------------
+    async def start(self) -> None:
+        """Open the journal, register the initial backends, adopt
+        recorded quarantines, pin the canary across every backend (the
+        cross-backend bit-exactness startup check), start gossip."""
+        c = self.config
+        if c.journal:
+            self._journal = journal_mod.SweepJournal(
+                c.journal, {"kind": "route-backends",
+                            "members": sorted(s.name for s in self._specs)})
+        for spec in self._specs:
+            self._register(spec)
+        if self._journal is not None:
+            for b in self.backends.values():
+                fails = self._journal.fail_count(backend_unit(b.spec.name))
+                if fails > 0:
+                    b.health.adopt_journal_quarantine(fails)
+        await self._pin_canary()
+        if c.gossip_every_s > 0:
+            self._gossip_task = asyncio.ensure_future(self._gossip_loop())
+
+    def _register(self, spec: BackendSpec) -> None:
+        if spec.name in self.backends:
+            raise ValueError(f"backend {spec.name!r} already registered")
+        b = Backend(self._next_idx, spec,
+                    probation_batches=self.config.probation_batches,
+                    journal=self._journal, clock=self._clock,
+                    max_frame_bytes=self.config.max_frame_bytes)
+        self._next_idx += 1
+        self.backends[spec.name] = b
+        self.ring.add(spec.name)
+
+    async def _pin_canary(self) -> None:
+        """Send the pinned canary request to EVERY backend; the first
+        bit-exact-capable answer pins the expectation, every other
+        backend is compared against it — cross-backend bit-exactness is
+        a startup invariant, not a hope (the serve warmup rule, one
+        level up). A backend that fails or mismatches starts
+        quarantined; a router with NO canary-able backend cannot serve
+        and fails start() loudly."""
+        for b in self.backends.values():
+            if b.health.state == QUARANTINED:
+                continue  # journal-adopted: never let it pin the oracle
+            out = await self._canary_once(b)
+            if out is None:
+                b.health.canary_failed("failed")
+            elif self._canary_expected is None:
+                self._canary_expected = out
+                trace.point("route-canary-pinned", backend=b.idx,
+                            n=len(out))
+            elif out != self._canary_expected:
+                b.health.canary_failed("mismatch")
+        if self._canary_expected is None:
+            raise RuntimeError(
+                f"route startup failed: none of the {len(self.backends)} "
+                "backend(s) answered the canary request")
+
+    async def _canary_once(self, b: Backend) -> bytes | None:
+        """One canary exchange on ``b`` (startup pinning and quarantine
+        probing share it); None on any failure or timeout."""
+        b.canaries += 1
+        with trace.detached_span("backend-probe", backend=b.idx) as _:
+            try:
+                header, body = await b.exchange(
+                    {"t": CANARY_TENANT, "k": CANARY_KEY.hex(),
+                     "n": CANARY_NONCE.hex()},
+                    CANARY_PAYLOAD, self.config.attempt_timeout_s)
+            except Exception:  # noqa: BLE001 - a sick backend may do anything
+                metrics.counter("route_canary", backend=b.idx,
+                                outcome="failed")
+                return None
+        if not header.get("ok"):
+            metrics.counter("route_canary", backend=b.idx, outcome="refused")
+            return None
+        metrics.counter("route_canary", backend=b.idx, outcome="ok")
+        return body
+
+    async def stop(self) -> None:
+        """Graceful drain: stop gossip, close admission (new submits
+        answer ``shutdown``), await every in-flight request, close the
+        journal. The ``lost == 0`` gate (accepted == answered) is the
+        serve drain contract at router level — route.bench exits 1 on
+        violation."""
+        self._draining = True
+        if self._gossip_task is not None:
+            self._gossip_task.cancel()
+            try:
+                await self._gossip_task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+            self._gossip_task = None
+        await self._idle.wait()
+        trace.point("route-drained", accepted=self.accepted,
+                    answered=self.answered,
+                    lost=self.accepted - self.answered)
+        if self._journal is not None:
+            self._journal.close()
+            self._journal = None
+
+    # -- membership --------------------------------------------------------
+    def _rebalance_motion(self, action: str, member: str, fn) -> None:
+        """Apply the ring mutation ``fn`` and trace how many of the
+        recently-seen affinity keys changed owner — the minimal-motion
+        evidence (~K/N for one member among N) on the live key sample,
+        not a synthetic one."""
+        keys = list(self._seen_keys)
+        before = self.ring.placement(keys) if keys else {}
+        fn()
+        after = self.ring.placement(keys) if keys else {}
+        moved = ring_mod.moved_keys(before, after)
+        self.ring_changes += 1
+        metrics.counter("route_ring_changes")
+        metrics.counter("route_ring_moved_keys", moved)
+        trace.point("ring-rebalance", action=action, member=member,
+                    moved=moved, tracked=len(keys),
+                    members=len(self.ring))
+
+    async def add_backend(self, spec: BackendSpec) -> None:
+        """Join: register, canary against the PINNED expectation (a new
+        backend must prove bit-exactness before placement trusts it),
+        minimal-motion rebalance."""
+        self._rebalance_motion("join", spec.name,
+                               lambda: self._register(spec))
+        b = self.backends[spec.name]
+        if self._journal is not None:
+            fails = self._journal.fail_count(backend_unit(spec.name))
+            if fails > 0:
+                b.health.adopt_journal_quarantine(fails)
+                return
+        out = await self._canary_once(b)
+        if out is None:
+            b.health.canary_failed("failed")
+        elif self._canary_expected is not None and out != self._canary_expected:
+            b.health.canary_failed("mismatch")
+        elif self._canary_expected is None:
+            self._canary_expected = out
+
+    def remove_backend(self, name: str) -> None:
+        """Leave: drop the member; its arcs return to the clockwise
+        successors (minimal motion), in-flight requests to it finish or
+        fail over like any other outcome."""
+        if name not in self.backends:
+            raise ValueError(f"backend {name!r} not registered")
+        self._rebalance_motion("leave", name,
+                               lambda: self.ring.remove(name))
+        del self.backends[name]
+
+    # -- gossip ------------------------------------------------------------
+    async def _gossip_loop(self) -> None:
+        period = max(self.config.gossip_every_s, 0.05)
+        while True:
+            await asyncio.sleep(period)
+            await self.gossip_once()
+
+    async def gossip_once(self) -> None:
+        """One poll pass: fold every backend's /healthz into its health
+        machine; an ``ok`` answer from a QUARANTINED backend triggers a
+        canary (release still requires the bit-exact data-path answer).
+        Backends with NO status port are skipped entirely — having no
+        reconnaissance channel is a deployment shape, not evidence of
+        unreachability, and suspecting them every period would defeat
+        the two-strike model for the whole fleet."""
+        for b in list(self.backends.values()):
+            if not b.spec.status_port:
+                continue
+            doc = await b.poll_healthz()
+            status = doc.get("status") if isinstance(doc, dict) else None
+            b.health.note_gossip(status if isinstance(status, str) else None)
+            if status == "ok" and b.health.state == QUARANTINED:
+                await self._probe_quarantined(b)
+
+    async def _probe_quarantined(self, b: Backend) -> bool:
+        """Canary a quarantined backend; bit-exact releases it into
+        probation, anything else keeps it quarantined."""
+        out = await self._canary_once(b)
+        if out is not None and out == self._canary_expected:
+            b.health.canary_ok()
+            return True
+        b.health.canary_failed(
+            "mismatch" if out is not None else "failed")
+        return False
+
+    # -- placement ---------------------------------------------------------
+    def _order_for(self, aff: str) -> list[str]:
+        """The request's backend attempt order: the ring's clockwise
+        replica sequence under affinity, a seeded-random permutation in
+        the control arm (same MEMBERS, no locality — the A/B's only
+        difference)."""
+        if self.config.affinity:
+            return self.ring.nodes_for(aff)
+        members = list(self.ring.members())
+        return [members[i] for i in self._rng.permutation(len(members))]
+
+    def _track(self, aff: str) -> None:
+        cap = self.config.track_keys
+        if cap <= 0:
+            return
+        self._seen_keys.pop(aff, None)
+        self._seen_keys[aff] = None
+        while len(self._seen_keys) > cap:
+            self._seen_keys.pop(next(iter(self._seen_keys)))
+
+    # -- the request path --------------------------------------------------
+    async def submit(self, tenant: str, key: bytes, nonce: bytes, payload,
+                     deadline_s: float | None = None) -> Response:
+        """Route one CTR request; always answers (payload or coded
+        error) — the loadgen-compatible submit surface, so the serve
+        load generator drives a router exactly as it drives a server."""
+        if self._draining:
+            return Response(ok=False, error=ERR_SHUTDOWN,
+                            detail="router is draining")
+        self.accepted += 1
+        self._inflight += 1
+        self._idle.clear()
+        try:
+            resp = await self._route(tenant, bytes(key), bytes(nonce),
+                                     payload, deadline_s)
+        except Exception as e:  # noqa: BLE001 - a router must always answer
+            resp = Response(ok=False, error=ERR_DISPATCH,
+                            detail=f"{type(e).__name__}: {e}")
+        finally:
+            self.answered += 1
+            self._inflight -= 1
+            if self._inflight == 0:
+                self._idle.set()
+        return resp
+
+    async def _route(self, tenant: str, key: bytes, nonce: bytes, payload,
+                     deadline_s: float | None) -> Response:
+        c = self.config
+        data = (payload.tobytes() if hasattr(payload, "tobytes")
+                else bytes(payload))
+        aff = ring_mod.affinity_key(tenant, key)
+        self._track(aff)
+        budget = Budget(c.deadline_s if deadline_s is None
+                        else float(deadline_s), clock=self._clock)
+        header = {"t": tenant, "k": key.hex(), "n": nonce.hex(),
+                  "deadline_s": round(budget.total_s, 3) or None}
+        label = aff[-6:]
+        sampled = trace.sample()
+        order = self._order_for(aff)
+        primary = order[0] if order else None
+        causes: list = []
+        tried: set[str] = set()
+        sheds = 0
+        while True:
+            name = self._pick(order, tried)
+            if name is None:
+                b = await self._rescue(order, tried)
+                if b is None:
+                    if sheds and len(causes) == 0:
+                        # Every placeable backend SHED (no failures):
+                        # propagate the backpressure — shed at the
+                        # router, stamped like every other demotion.
+                        self.router_sheds += 1
+                        metrics.counter("route_shed")
+                        degrade.degrade(
+                            "route->shed",
+                            "every placeable backend shed; shedding at "
+                            "the router")
+                        return Response(
+                            ok=False, error=ERR_SHED,
+                            detail="all backends shedding")
+                    e = BackendsExhausted(label, causes)
+                    metrics.counter("route_exhausted")
+                    return Response(
+                        ok=False,
+                        error=(ERR_DEADLINE if e.timed_out or
+                               budget.exhausted() else ERR_DISPATCH),
+                        detail=str(e))
+                name = b.spec.name
+            b = self.backends[name]
+            if budget.exhausted():
+                causes.append((b.idx, asyncio.TimeoutError(
+                    f"request budget {budget.total_s:.3f}s exhausted")))
+                metrics.counter("route_exhausted")
+                return Response(ok=False, error=ERR_DEADLINE,
+                                detail=f"budget spent after "
+                                       f"{len(tried)} attempt(s)")
+            attempt_s = min(c.attempt_timeout_s, budget.remaining())
+            redispatch = bool(tried)
+            # A redispatch is an incident: force-sample it (the serve
+            # rule) — first attempts of unsampled requests ride a
+            # deferred span, free when they complete clean.
+            cm = trace.maybe_span(sampled or redispatch, "route-dispatch",
+                                  backend=b.idx, bucket=len(data) // 16,
+                                  redispatch=redispatch)
+            cm.__enter__()
+            t0 = self._clock()
+            outcome = "ok"
+            try:
+                faults.check_backend("backend_fail", b.idx, label)
+                if faults.fire_backend("backend_hang", b.idx):
+                    # The injected wedged backend: an AWAITABLE sleep
+                    # (the router is an event loop — a blocking sleep
+                    # would hang every rider, not just this one), cut
+                    # down by the attempt deadline exactly like a real
+                    # backend that stopped answering.
+                    trace.point("fault-hang", backend=b.idx)
+                    await asyncio.wait_for(asyncio.sleep(attempt_s + 60.0),
+                                           timeout=attempt_s)
+                rh, body = await b.exchange(header, data, attempt_s)
+            except asyncio.TimeoutError as e:
+                # The exchange never ended: the span is ABANDONED, not
+                # closed — its orphaned begin is the kill evidence
+                # (obs.report --check --expected-orphans route-dispatch).
+                cm.force()
+                outcome = "timeout"
+                b.timeouts += 1
+                metrics.counter("route_backend_timeout", backend=b.idx)
+                trace.counter("route_backend_timeout", backend=b.idx)
+                b.health.note_timeout()
+                causes.append((b.idx, e))
+                tried.add(name)
+                continue
+            except Exception as e:  # noqa: BLE001 - fail over, then contain
+                cm.__exit__(type(e), e, None)
+                outcome = "failed"
+                b.failures += 1
+                metrics.counter("route_backend_failed", backend=b.idx)
+                trace.counter("route_backend_failed", backend=b.idx)
+                b.health.note_failure(e)
+                causes.append((b.idx, e))
+                tried.add(name)
+                continue
+            finally:
+                dt_us = int((self._clock() - t0) * 1e6)
+                metrics.observe("route_dispatch_us", dt_us,
+                                backend=b.idx, outcome=outcome)
+            cm.__exit__(None, None, None)
+            err = rh.get("error")
+            if not rh.get("ok") and err == ERR_SHED:
+                # Backpressure, not failure: the backend is healthy and
+                # full. Back off, then try the next replica; health is
+                # untouched (shedding a request is the queue doing its
+                # job, and suspecting it would turn overload into
+                # flapping).
+                b.sheds_seen += 1
+                sheds += 1
+                self.shed_retries += 1
+                metrics.counter("route_shed_retry", backend=b.idx)
+                trace.counter("route_shed_retry", backend=b.idx)
+                tried.add(name)
+                await asyncio.sleep(
+                    min(c.shed_backoff_s * (2 ** (sheds - 1)),
+                        max(budget.remaining(), 0.0)))
+                continue
+            if not rh.get("ok") and err == ERR_SHUTDOWN:
+                # The backend is draining: non-punitive removal from
+                # placement (gossip will confirm), fail over.
+                b.health.note_gossip("draining")
+                causes.append((b.idx, ConnectionError("backend draining")))
+                tried.add(name)
+                continue
+            # A definitive answer (payload or a request-level error like
+            # bad-request/too-large/deadline): the rider gets it as-is —
+            # re-dispatching a malformed request elsewhere would only
+            # repeat the refusal.
+            b.dispatches += 1
+            b.health.note_success()
+            if redispatch:
+                b.redispatches_in += 1
+                self.redispatches += 1
+                metrics.counter("route_redispatch", backend=b.idx)
+                trace.counter("route_redispatch", backend=b.idx,
+                              after=len(tried))
+            if rh.get("ok"):
+                self.routed_ok += 1
+                b.bytes_out += len(body)
+                if name == primary:
+                    self.affinity_hits += 1
+                    metrics.counter("route_affinity", outcome="hit")
+                else:
+                    self.affinity_misses += 1
+                    metrics.counter("route_affinity", outcome="miss")
+                return Response(ok=True,
+                                payload=np.frombuffer(body, np.uint8),
+                                batch=rh.get("batch"))
+            return Response(ok=False, error=err,
+                            detail=str(rh.get("detail", "")),
+                            batch=rh.get("batch"))
+
+    def _pick(self, order: list[str], tried: set[str]) -> str | None:
+        """The next untried PLACEABLE backend in the request's order
+        (None when none remain — the rescue/exhaustion path)."""
+        for name in order:
+            if name in tried:
+                continue
+            b = self.backends.get(name)
+            if b is not None and b.health.placeable():
+                return name
+        return None
+
+    async def _rescue(self, order: list[str], tried: set[str]):
+        """Last resort when no placeable backend remains: canary the
+        quarantined ones in ring order rather than fail the request — a
+        single-backend deployment recovering from a transient hang
+        re-proves itself here instead of answering errors forever."""
+        for name in order:
+            if name in tried:
+                continue
+            b = self.backends.get(name)
+            if b is None or b.health.state != QUARANTINED:
+                continue
+            if await self._probe_quarantined(b):
+                return b
+        return None
+
+    # -- introspection -----------------------------------------------------
+    def quarantine_events(self) -> int:
+        return sum(1 for b in self.backends.values()
+                   for t in b.health.transitions if t["to"] == QUARANTINED)
+
+    def release_events(self) -> int:
+        return sum(1 for b in self.backends.values()
+                   for t in b.health.transitions if t["to"] == RELEASED)
+
+    def affinity_ratio(self) -> float:
+        total = self.affinity_hits + self.affinity_misses
+        return round(self.affinity_hits / total, 4) if total else 0.0
+
+    def stats(self) -> dict:
+        return {
+            "backends": {name: b.stats()
+                         for name, b in sorted(self.backends.items())},
+            "ring": {"members": list(self.ring.members()),
+                     "vnodes": self.config.vnodes,
+                     "changes": self.ring_changes},
+            "affinity": {"enabled": self.config.affinity,
+                         "hits": self.affinity_hits,
+                         "misses": self.affinity_misses,
+                         "ratio": self.affinity_ratio()},
+            "accepted": self.accepted, "answered": self.answered,
+            "lost": self.accepted - self.answered,
+            "routed_ok": self.routed_ok,
+            "redispatches": self.redispatches,
+            "shed_retries": self.shed_retries,
+            "router_sheds": self.router_sheds,
+            "quarantine_events": self.quarantine_events(),
+        }

@@ -1,0 +1,65 @@
+"""The router's operator endpoint: /metrics + /healthz with the
+ring/backend MEMBERSHIP VIEW.
+
+Same shared HTTP responder as the serve status endpoint
+(``serve.status.HttpStatusEndpoint``) — one operator surface, two
+fault domains — but the router's /healthz answers the questions a
+fleet operator has that no single backend can: who is on the ring,
+which backend owns what share of the tracked keyspace, what state is
+each backend's health machine in, and is the router itself serving,
+degraded (no placeable backend), or draining. Placement is readable
+HERE, without reconstructing it from traces — the membership-view
+satellite of the routing-tier ISSUE.
+
+``status`` field semantics (a load balancer's readiness answer):
+``"ok"`` while at least one placeable backend exists, ``"draining"``
+once ``Router.stop()`` began (admission answers ``shutdown``), else
+``"degraded"`` — the same three-valued contract as the serve
+/healthz, so anything that can health-check a backend can health-check
+the router above it.
+"""
+
+from __future__ import annotations
+
+from ..serve.status import HttpStatusEndpoint
+
+
+class RouterStatus(HttpStatusEndpoint):
+    """/metrics + /healthz for a ``route.proxy.Router``."""
+
+    def __init__(self, router, port: int, host: str = "127.0.0.1"):
+        super().__init__(port, host)
+        self._router = router
+
+    def healthz(self) -> dict:
+        r = self._router
+        placeable = sum(1 for b in r.backends.values()
+                        if b.health.placeable())
+        if r._draining:
+            status = "draining"
+        elif placeable > 0:
+            status = "ok"
+        else:
+            status = "degraded"
+        # The placement view: how the TRACKED (recently routed) keys
+        # distribute over members right now — affinity made visible.
+        # Guarded for the empty ring (every member removed): the scrape
+        # must answer the "degraded" document then, not a 500.
+        keys = list(r._seen_keys) if len(r.ring) else []
+        share: dict[str, int] = {m: 0 for m in r.ring.members()}
+        for k in keys:
+            owner = r.ring.node_for(k)
+            share[owner] = share.get(owner, 0) + 1
+        doc = r.stats()
+        doc.update({
+            "status": status,
+            "placeable": placeable,
+            "ring": {
+                "members": list(r.ring.members()),
+                "vnodes": r.config.vnodes,
+                "changes": r.ring_changes,
+                "tracked_keys": len(keys),
+                "placement": share,
+            },
+        })
+        return doc

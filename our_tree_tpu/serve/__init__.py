@@ -34,6 +34,13 @@ Modules (docs/SERVING.md has the full architecture):
   responses only when EVERY lane failed (the server stays up);
   graceful drain on shutdown (zero lost requests).
 * ``loadgen``  — closed-loop load generator with mixed request sizes.
+* ``wire``     — the framed request/response wire protocol (JSON header
+  line + raw payload) the worker frontend and the ot-route router
+  speak; stdlib-only, bounded on both sides.
+* ``worker``   — ``python -m our_tree_tpu.serve.worker``: one BACKEND
+  process (a whole Server behind a TCP frontend) — the router's unit
+  of horizontal scale; READY line with bound ports, SIGTERM graceful
+  drain, zero-lost exit gate (docs/SERVING.md, ot-route).
 * ``bench``    — ``python -m our_tree_tpu.serve.bench``: drives the
   server, reports p50/p95/p99 latency, goodput GB/s, batch occupancy,
   per-lane dispatch/health breakdown, asserts zero post-warmup

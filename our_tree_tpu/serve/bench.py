@@ -86,6 +86,7 @@ async def _drive(args, probes):
         key_slots=args.key_slots,
         native_threads=args.native_threads,
         max_depth=args.queue_depth,
+        tenant_depth_frac=args.tenant_depth_frac,
         request_deadline_s=args.deadline,
         dispatch_deadline_s=args.dispatch_deadline,
         retries=args.retries,
@@ -174,6 +175,13 @@ def main(argv=None) -> int:
     ap.add_argument("--bucket-min", type=int, default=32, metavar="BLOCKS")
     ap.add_argument("--bucket-max", type=int, default=4096, metavar="BLOCKS")
     ap.add_argument("--queue-depth", type=int, default=1024)
+    ap.add_argument("--tenant-depth-frac", type=float, default=1.0,
+                    metavar="FRAC",
+                    help="one tenant's max share of the queue depth: "
+                         "past FRAC*depth queued requests that tenant "
+                         "sheds itself (serve_shed{reason=tenant}) while "
+                         "other tenants keep being admitted (1.0 = "
+                         "global shed only)")
     ap.add_argument("--deadline", type=float, default=30.0,
                     help="per-request residency deadline, seconds")
     ap.add_argument("--dispatch-deadline", type=float,

@@ -9,6 +9,16 @@ The queue is where overload policy lives, and the policy is explicit:
   (``resilience.degrade``, kind ``accept->shed``) so an overloaded run
   can never masquerade as a healthy one in its artifacts — same
   contract as every other demotion in the repo.
+* **Per-tenant depth share.** Global shed alone lets one heavy tenant
+  fill the queue and starve everyone (every OTHER tenant's submits shed
+  while the heavy one's queued work drains first). With
+  ``tenant_depth_frac < 1`` a tenant may occupy at most that fraction
+  of ``max_depth``; past it, THAT tenant's submits shed
+  (``serve_shed{reason=tenant}``, degrade kind ``tenant->shed``) while
+  the rest of the fleet keeps being admitted — the fairness seam the
+  router's backpressure propagation leans on (a shed answer travels
+  back as retry-with-backoff on the replica ring, so the heavy tenant
+  self-throttles instead of taking the host down).
 * **Per-request deadline.** Every accepted request carries a
   ``resilience.policy.Budget``; a request whose budget is exhausted by
   the time the batcher drains it gets a ``"deadline"`` error instead of
@@ -134,10 +144,22 @@ class RequestQueue:
     def __init__(self, max_depth: int = 1024,
                  max_request_blocks: int = 4096,
                  default_deadline_s: float = 30.0,
+                 tenant_depth_frac: float = 1.0,
                  clock=time.monotonic):
         self.max_depth = int(max_depth)
         self.max_request_blocks = int(max_request_blocks)
         self.default_deadline_s = float(default_deadline_s)
+        #: Per-tenant admission cap, as a fraction of ``max_depth``: one
+        #: tenant may occupy at most ``max(1, int(frac * max_depth))``
+        #: queued slots, so a heavy tenant sheds ITSELF (reason=tenant)
+        #: while everyone else keeps being admitted — before this, shed
+        #: was global only and the heavy tenant starved the rest
+        #: (ROADMAP fairness carry-over). 1.0 disables the cap (a single
+        #: tenant may fill the queue, the pre-cap behaviour).
+        self.tenant_depth_frac = min(max(float(tenant_depth_frac), 0.0), 1.0)
+        self._tenant_cap = max(1, int(self.tenant_depth_frac
+                                      * self.max_depth))
+        self._tenant_pending: dict[str, int] = {}
         self._clock = clock
         self._pending: list[Request] = []
         self._event = asyncio.Event()
@@ -146,6 +168,7 @@ class RequestQueue:
         self.accepted = 0
         self.answered = 0
         self.shed = 0
+        self.shed_tenant = 0
         self.refused = 0
         self.expired = 0
         self.depth_peak = 0
@@ -182,7 +205,7 @@ class RequestQueue:
         elif len(self._pending) >= self.max_depth:
             code, why = ERR_SHED, f"queue depth {self.max_depth} reached"
             self.shed += 1
-            metrics.counter("serve_shed")
+            metrics.counter("serve_shed", reason="depth")
             trace.counter("serve_shed", tenant=tenant)
             # First shed = the process entered overload shedding: a
             # demotion of the accept path, recorded like every other
@@ -191,6 +214,25 @@ class RequestQueue:
                 "accept->shed",
                 f"serve queue overloaded (depth {self.max_depth}); "
                 f"shedding new requests")
+        elif (self.tenant_depth_frac < 1.0
+              and self._tenant_pending.get(tenant, 0) >= self._tenant_cap):
+            # The per-tenant cap: THIS tenant is over its depth share
+            # while the queue as a whole still has room — shed the heavy
+            # tenant's request (it can back off and retry) instead of
+            # letting it crowd every other tenant out of admission.
+            code, why = ERR_SHED, (
+                f"tenant over its queue share ({self._tenant_cap} of "
+                f"{self.max_depth} slots)")
+            self.shed += 1
+            self.shed_tenant += 1
+            metrics.counter("serve_shed", reason="tenant")
+            trace.counter("serve_shed_tenant")
+            degrade.degrade(
+                "tenant->shed",
+                f"a tenant exceeded its queue share "
+                f"({self._tenant_cap}/{self.max_depth} slots, "
+                f"tenant_depth_frac={self.tenant_depth_frac}); "
+                "shedding that tenant's requests only")
         if code is not None:
             if code != ERR_SHED:
                 self.refused += 1
@@ -211,6 +253,7 @@ class RequestQueue:
         cm.__enter__()
         req._span_cm = cm
         self._pending.append(req)
+        self._tenant_pending[tenant] = self._tenant_pending.get(tenant, 0) + 1
         self.accepted += 1
         # Registry, not trace: the per-request counter is the hot path
         # the sampled trace can no longer count exactly — and queue
@@ -242,6 +285,16 @@ class RequestQueue:
         pass dispatches them before the loop exits."""
         self.closed = True
 
+    def _tenant_done(self, req: Request) -> None:
+        """Return the request's per-tenant queue slot (it left _pending);
+        empty tenants are dropped so the dict stays bounded by the LIVE
+        tenant set, not the all-time one."""
+        left = self._tenant_pending.get(req.tenant, 0) - 1
+        if left > 0:
+            self._tenant_pending[req.tenant] = left
+        else:
+            self._tenant_pending.pop(req.tenant, None)
+
     def drain(self) -> list[Request]:
         """Take everything pending: closes each request's queued span and
         fails the ones whose deadline budget is already spent — they can
@@ -252,6 +305,7 @@ class RequestQueue:
             metrics.observe("serve_drain_requests", len(taken))
         live = []
         for req in taken:
+            self._tenant_done(req)
             queued_s = self._clock() - req.t_submit
             metrics.observe("serve_queued_us", queued_s * 1e6)
             if req.budget is not None and req.budget.exhausted():
@@ -275,6 +329,7 @@ class RequestQueue:
         closes — a clean stop leaves no orphans."""
         taken, self._pending = self._pending, []
         for req in taken:
+            self._tenant_done(req)
             if req._span_cm is not None:
                 req._span_cm.__exit__(RuntimeError, None, None)
             req.fail(code, "server stopped before dispatch")
@@ -283,6 +338,7 @@ class RequestQueue:
     def stats(self) -> dict:
         return {"accepted": self.accepted, "answered": self.answered,
                 "lost": self.accepted - self.answered,
-                "shed": self.shed, "refused": self.refused,
+                "shed": self.shed, "shed_tenant": self.shed_tenant,
+                "refused": self.refused,
                 "expired": self.expired, "depth": self.depth(),
                 "depth_peak": self.depth_peak}

@@ -131,6 +131,11 @@ class ServerConfig:
     #: native-tier ECB threads per slot run (0 = size-based default)
     native_threads: int = 0
     max_depth: int = 1024
+    #: one tenant's max share of the queue depth (serve/queue.py): past
+    #: ``frac * max_depth`` queued requests, that tenant sheds ITSELF
+    #: (``serve_shed{reason=tenant}``) while others keep being admitted;
+    #: 1.0 = no per-tenant cap (global shed only)
+    tenant_depth_frac: float = 1.0
     #: per-request residency deadline (queue admission -> response)
     request_deadline_s: float = 30.0
     #: watchdog deadline around each lane's engine call; None = the
@@ -178,7 +183,8 @@ class Server:
                                            c.max_bucket_blocks)
         self.queue = RequestQueue(max_depth=c.max_depth,
                                   max_request_blocks=self.rungs[-1],
-                                  default_deadline_s=c.request_deadline_s)
+                                  default_deadline_s=c.request_deadline_s,
+                                  tenant_depth_frac=c.tenant_depth_frac)
         self.keycache = KeyCache(per_tenant=c.keycache_per_tenant)
         self.engine: str | None = None   # resolved at start
         self.pool: lanes.LanePool | None = None  # built at start

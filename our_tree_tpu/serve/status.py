@@ -38,11 +38,15 @@ from ..obs import metrics
 from ..resilience import degrade
 
 
-class StatusServer:
-    """The /metrics + /healthz responder riding the serve event loop."""
+class HttpStatusEndpoint:
+    """The reusable /metrics + /healthz HTTP responder: subclasses
+    provide ``healthz()`` (the live JSON document) and may override
+    ``metrics_text()`` (default: the shared registry rendered as
+    Prometheus text). ot-serve's ``StatusServer`` and the router's
+    ``RouterStatus`` (route/status.py) are the two instances — one
+    operator surface, two fault domains."""
 
-    def __init__(self, server, port: int, host: str = "127.0.0.1"):
-        self._server = server
+    def __init__(self, port: int, host: str = "127.0.0.1"):
         self._host = host
         self._port = int(port)
         self._srv: asyncio.AbstractServer | None = None
@@ -59,6 +63,67 @@ class StatusServer:
             self._srv.close()
             await self._srv.wait_closed()
             self._srv = None
+
+    # -- the two documents (subclass surface) ------------------------------
+    def healthz(self) -> dict:
+        """The live health JSON (the /healthz body) — subclass duty."""
+        raise NotImplementedError
+
+    def metrics_text(self) -> str:
+        """The /metrics body; subclasses override to re-sample liveness
+        gauges at scrape time before rendering."""
+        return metrics.render_prometheus()
+
+    # -- the responder ------------------------------------------------------
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            line = await asyncio.wait_for(reader.readline(), timeout=5.0)
+            parts = line.decode("latin-1", "replace").split()
+            path = parts[1] if len(parts) >= 2 else "/"
+            # Drain (and ignore) the request headers.
+            while True:
+                h = await asyncio.wait_for(reader.readline(), timeout=5.0)
+                if not h or h in (b"\r\n", b"\n"):
+                    break
+            self.requests += 1
+            if path.split("?")[0] == "/metrics":
+                body = self.metrics_text()
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+                code, reason = 200, "OK"
+            elif path.split("?")[0] == "/healthz":
+                body = json.dumps(self.healthz(), indent=1,
+                                  sort_keys=True) + "\n"
+                ctype = "application/json"
+                code, reason = 200, "OK"
+            else:
+                body = "not found: try /metrics or /healthz\n"
+                ctype = "text/plain"
+                code, reason = 404, "Not Found"
+        except Exception:  # noqa: BLE001 - a bad scrape must not matter
+            body, ctype, code, reason = ("status endpoint error\n",
+                                         "text/plain", 500,
+                                         "Internal Server Error")
+        try:
+            raw = body.encode("utf-8")
+            writer.write(
+                (f"HTTP/1.1 {code} {reason}\r\n"
+                 f"Content-Type: {ctype}\r\n"
+                 f"Content-Length: {len(raw)}\r\n"
+                 "Connection: close\r\n\r\n").encode("latin-1") + raw)
+            await writer.drain()
+            writer.close()
+        except Exception:  # noqa: BLE001 - peer went away mid-reply
+            pass
+
+
+class StatusServer(HttpStatusEndpoint):
+    """The serve-side /metrics + /healthz responder riding the serve
+    event loop."""
+
+    def __init__(self, server, port: int, host: str = "127.0.0.1"):
+        super().__init__(port, host)
+        self._server = server
 
     # -- the two documents -------------------------------------------------
     def healthz(self) -> dict:
@@ -108,45 +173,3 @@ class StatusServer:
         if s.pool is not None:
             metrics.gauge("serve_inflight", s.pool.inflight_now)
         return metrics.render_prometheus()
-
-    # -- the responder ------------------------------------------------------
-    async def _handle(self, reader: asyncio.StreamReader,
-                      writer: asyncio.StreamWriter) -> None:
-        try:
-            line = await asyncio.wait_for(reader.readline(), timeout=5.0)
-            parts = line.decode("latin-1", "replace").split()
-            path = parts[1] if len(parts) >= 2 else "/"
-            # Drain (and ignore) the request headers.
-            while True:
-                h = await asyncio.wait_for(reader.readline(), timeout=5.0)
-                if not h or h in (b"\r\n", b"\n"):
-                    break
-            self.requests += 1
-            if path.split("?")[0] == "/metrics":
-                body = self.metrics_text()
-                ctype = "text/plain; version=0.0.4; charset=utf-8"
-                code, reason = 200, "OK"
-            elif path.split("?")[0] == "/healthz":
-                body = json.dumps(self.healthz(), indent=1,
-                                  sort_keys=True) + "\n"
-                ctype = "application/json"
-                code, reason = 200, "OK"
-            else:
-                body = "not found: try /metrics or /healthz\n"
-                ctype = "text/plain"
-                code, reason = 404, "Not Found"
-        except Exception:  # noqa: BLE001 - a bad scrape must not matter
-            body, ctype, code, reason = ("status endpoint error\n",
-                                         "text/plain", 500,
-                                         "Internal Server Error")
-        try:
-            raw = body.encode("utf-8")
-            writer.write(
-                (f"HTTP/1.1 {code} {reason}\r\n"
-                 f"Content-Type: {ctype}\r\n"
-                 f"Content-Length: {len(raw)}\r\n"
-                 "Connection: close\r\n\r\n").encode("latin-1") + raw)
-            await writer.drain()
-            writer.close()
-        except Exception:  # noqa: BLE001 - peer went away mid-reply
-            pass

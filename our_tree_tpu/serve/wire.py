@@ -1,0 +1,97 @@
+"""The serve wire protocol: framed CTR requests over a byte stream.
+
+One frame = one JSON header line (UTF-8, ``\\n``-terminated) followed by
+``header["len"]`` raw payload bytes. The header carries the small typed
+fields (tenant, hex key/nonce, error codes); the payload rides raw so a
+64 KiB request costs no base64 inflation and no JSON string scanning.
+Both directions use the same shape:
+
+request::
+
+    {"t": "<tenant>", "k": "<key hex>", "n": "<nonce hex>",
+     "len": <payload bytes>, "deadline_s": <float|null>}\\n
+    <len raw bytes>
+
+response::
+
+    {"ok": true, "len": <n>, "batch": "<label|null>"}\\n<n raw bytes>
+    {"ok": false, "len": 0, "error": "<code>", "detail": "..."}\\n
+
+The codes are ``serve.queue``'s closed ERR_* set — the router
+dispatches on them (a ``shed`` retries the replica ring with backoff, a
+``shutdown`` marks the backend draining, everything else answers the
+rider as-is), so the wire adds NO new failure vocabulary.
+
+Used by ``serve/worker.py`` (the backend process's TCP frontend — reads
+requests, feeds ``Server.submit``, writes responses) and by
+``route/proxy.py`` (the router's backend client — the one
+backend-contact seam, otlint's ``route-backend-seam`` rule). Bounded on
+both sides: a header line over ``MAX_HEADER`` bytes or a payload over
+the caller's ``max_len`` is a protocol error, refused before any
+allocation trusts the peer. stdlib + asyncio only — no numpy, no jax:
+the frame layer must be importable by the device-free router.
+"""
+
+from __future__ import annotations
+
+import json
+
+#: Header line ceiling: tenant + hex key/nonce + codes fit in well under
+#: 1 KiB; anything bigger is a corrupt or hostile peer.
+MAX_HEADER = 4096
+
+#: Default payload ceiling (bytes): the largest default bucket rung
+#: (4096 blocks) is 64 KiB; one frame never needs more than a small
+#: multiple of it. Callers with bigger ladders pass their own.
+MAX_PAYLOAD = 1 << 22
+
+
+class WireError(RuntimeError):
+    """A malformed or oversized frame (protocol violation, not a
+    request-level error: the connection is not trustworthy past it)."""
+
+
+def encode_frame(header: dict, payload: bytes = b"") -> bytes:
+    """One frame as bytes; stamps ``len`` from the payload."""
+    h = dict(header)
+    h["len"] = len(payload)
+    return (json.dumps(h, separators=(",", ":")).encode("utf-8")
+            + b"\n" + payload)
+
+
+async def read_frame(reader, max_len: int = MAX_PAYLOAD):
+    """(header dict, payload bytes) from an asyncio StreamReader, or
+    None on clean EOF at a frame boundary. Raises WireError on a torn,
+    oversized, or unparseable frame."""
+    try:
+        line = await reader.readuntil(b"\n")
+    except EOFError:
+        return None
+    except Exception as e:  # IncompleteReadError (mid-line EOF), overflow
+        # asyncio raises IncompleteReadError with .partial on EOF; empty
+        # partial is a clean close between frames.
+        partial = getattr(e, "partial", None)
+        if partial == b"":
+            return None
+        raise WireError(f"torn frame header: {type(e).__name__}") from e
+    if len(line) > MAX_HEADER:
+        raise WireError(f"header line {len(line)} bytes > {MAX_HEADER}")
+    try:
+        header = json.loads(line)
+    except ValueError as e:
+        raise WireError(f"unparseable frame header: {e}") from e
+    if not isinstance(header, dict):
+        raise WireError("frame header is not a JSON object")
+    try:
+        n = int(header.get("len", 0))
+    except (TypeError, ValueError) as e:
+        raise WireError("frame len is not an integer") from e
+    if n < 0 or n > max_len:
+        raise WireError(f"frame payload {n} bytes outside [0, {max_len}]")
+    payload = b""
+    if n:
+        try:
+            payload = await reader.readexactly(n)
+        except Exception as e:
+            raise WireError("torn frame payload") from e
+    return header, payload

@@ -1,0 +1,268 @@
+"""``python -m our_tree_tpu.serve.worker`` — one ot-serve BACKEND process.
+
+The router's unit of horizontal scale (docs/SERVING.md): a whole
+``serve.Server`` — lanes, batcher, keycache, status endpoint — wrapped
+in a TCP request frontend speaking the framed wire protocol
+(``serve/wire.py``), so N of these processes behind ``route/proxy.py``
+are N independent per-HOST fault domains, exactly as N lanes inside one
+process are N per-DEVICE fault domains. The worker adds no policy of
+its own: admission, batching, dispatch, health, and drain are all the
+Server's; this module only moves frames.
+
+Lifecycle contract (what ``route/bench.py``'s spawner and the
+``resilience.isolate.spawn_service`` handle rely on):
+
+* **READY line.** After warmup, ONE JSON line on stdout::
+
+      {"kind": "ot-serve-worker", "port": P, "status_port": S,
+       "engine": "...", "lanes": N, "pid": ...}
+
+  with the BOUND ports (``--port 0`` / ``--status-port 0`` bind
+  ephemerally — how a multi-worker host avoids port coordination).
+* **Graceful drain on SIGTERM/SIGINT.** The request listener closes
+  (new connections refused), in-flight connections finish their framed
+  exchanges — a submit after admission closed answers ``shutdown``,
+  never silence — then ``Server.stop()`` drains every accepted request.
+  While draining, ``/healthz`` answers ``status: "draining"`` (the
+  queue closes first), so a router's gossip sees the backend leave
+  placement BEFORE it disappears.
+* **EXIT line + rc.** One final JSON line
+  (``{"kind": "ot-serve-worker-exit", "lost": L, ...}``) and exit 0
+  iff ``lost == 0`` — the same zero-lost drain gate serve.bench
+  enforces, so a router drive can assert no backend silently dropped
+  work.
+
+Per-connection containment: a wire protocol violation closes THAT
+connection (the peer is not trustworthy past a torn frame); a handler
+error answers a coded error frame when it still can. Neither can take
+the dispatch loop down.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import signal
+import sys
+
+from ..obs import trace
+from ..resilience import watchdog
+from . import batcher, wire
+from .queue import ERR_BAD_REQUEST
+from .server import Server, ServerConfig
+
+
+class RequestFrontend:
+    """The TCP listener that feeds ``Server.submit`` from wire frames.
+
+    Importable for in-process tests (tests/test_route.py runs several
+    Servers + frontends inside one event loop); the module ``main`` is
+    the process entry the router's spawner uses."""
+
+    def __init__(self, server: Server, port: int, host: str = "127.0.0.1"):
+        self._server = server
+        self._host = host
+        self._port = int(port)
+        self._srv: asyncio.AbstractServer | None = None
+        self._conns: set[asyncio.Task] = set()
+        self.port: int | None = None
+        self.connections = 0
+        self.frames = 0
+        self.protocol_errors = 0
+
+    async def start(self) -> None:
+        max_blocks = self._server.rungs[-1]
+        self._max_len = max(max_blocks * 16, wire.MAX_PAYLOAD)
+        self._srv = await asyncio.start_server(
+            self._on_conn, self._host, self._port)
+        self.port = self._srv.sockets[0].getsockname()[1]
+
+    async def stop(self, grace_s: float = 5.0) -> None:
+        """Close the listener, let in-flight connections finish their
+        current exchanges (their submits resolve via the server's still-
+        running batcher loop — a shutdown answer is still an answer),
+        then CANCEL connections still open past ``grace_s``: an idle
+        client parked between frames holds no in-flight request, and a
+        drain that waits on it forever would end in the spawner's group
+        SIGKILL and a false failed-drain verdict."""
+        if self._srv is not None:
+            self._srv.close()
+            await self._srv.wait_closed()
+            self._srv = None
+        if self._conns:
+            _done, pending = await asyncio.wait(
+                list(self._conns), timeout=max(grace_s, 0.0))
+            for task in pending:
+                task.cancel()
+            if pending:
+                await asyncio.gather(*pending, return_exceptions=True)
+
+    def _on_conn(self, reader, writer) -> None:
+        self.connections += 1
+        task = asyncio.ensure_future(self._serve_conn(reader, writer))
+        self._conns.add(task)
+        task.add_done_callback(self._conns.discard)
+
+    async def _serve_conn(self, reader, writer) -> None:
+        """Frames on one connection, sequentially: the wire protocol is
+        strict request/response, so ordering is the framing (the router
+        opens one exchange per in-flight request)."""
+        try:
+            while True:
+                try:
+                    frame = await wire.read_frame(reader, self._max_len)
+                except wire.WireError as e:
+                    self.protocol_errors += 1
+                    try:
+                        writer.write(wire.encode_frame(
+                            {"ok": False, "error": ERR_BAD_REQUEST,
+                             "detail": f"wire: {e}"}))
+                        await writer.drain()
+                    except Exception:  # noqa: BLE001 - peer already gone
+                        pass
+                    return
+                if frame is None:
+                    return  # clean EOF between frames
+                header, payload = frame
+                self.frames += 1
+                await self._answer(writer, header, payload)
+        finally:
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001 - peer already gone
+                pass
+
+    async def _answer(self, writer, header: dict, payload: bytes) -> None:
+        try:
+            key = bytes.fromhex(str(header.get("k", "")))
+            nonce = bytes.fromhex(str(header.get("n", "")))
+        except ValueError:
+            key, nonce = b"", b""
+        try:
+            deadline = header.get("deadline_s")
+            deadline = float(deadline) if deadline is not None else None
+        except (TypeError, ValueError):
+            # A malformed deadline answers a coded error like every
+            # other malformed field — the containment contract says a
+            # bad peer gets a frame, never a dropped connection.
+            writer.write(wire.encode_frame(
+                {"ok": False, "error": ERR_BAD_REQUEST,
+                 "detail": "deadline_s is not a number"}))
+            await writer.drain()
+            return
+        resp = await self._server.submit(
+            str(header.get("t", "")), key, nonce,
+            memoryview(payload), deadline_s=deadline)
+        if resp.ok:
+            out = {"ok": True, "batch": resp.batch}
+            body = resp.payload.tobytes()
+        else:
+            out = {"ok": False, "error": resp.error, "detail": resp.detail,
+                   "batch": resp.batch}
+            body = b""
+        writer.write(wire.encode_frame(out, body))
+        await writer.drain()
+
+
+async def _amain(args) -> int:
+    cfg = ServerConfig(
+        engine=args.engine,
+        min_bucket_blocks=args.bucket_min,
+        max_bucket_blocks=args.bucket_max,
+        key_slots=args.key_slots,
+        native_threads=args.native_threads,
+        max_depth=args.queue_depth,
+        tenant_depth_frac=args.tenant_depth_frac,
+        request_deadline_s=args.deadline,
+        dispatch_deadline_s=args.dispatch_deadline,
+        retries=args.retries,
+        lanes=args.lanes,
+        probe_every=args.probe_every,
+        journal=args.journal,
+        max_inflight=args.max_inflight,
+        status_port=args.status_port)
+    server = Server(cfg)
+    await server.start()
+    frontend = RequestFrontend(server, args.port, host=args.host)
+    await frontend.start()
+    ready = {"kind": "ot-serve-worker", "port": frontend.port,
+             "status_port": (server.status.port
+                             if server.status is not None else None),
+             "engine": server.engine, "lanes": len(server.pool.lanes),
+             "pid": os.getpid()}
+    print(json.dumps(ready), flush=True)
+    trace.point("worker-ready", port=frontend.port, engine=server.engine)
+
+    stop_ev = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        loop.add_signal_handler(sig, stop_ev.set)
+    await stop_ev.wait()
+
+    # Drain order: listener first (no new connections), then admission +
+    # dispatch (server.stop closes the queue BEFORE clearing the run
+    # flag, so /healthz says "draining" for the whole window and any
+    # still-open connection's submit answers `shutdown` immediately).
+    server.queue.close()
+    # Grace below the spawner's 60 s SIGTERM->SIGKILL window: in-flight
+    # exchanges get ample time to answer, an idle held-open connection
+    # cannot convert the drain into a group SIGKILL.
+    await frontend.stop(grace_s=30.0)
+    await server.stop()
+    stats = server.stats()
+    lost = stats["queue"]["lost"]
+    line = {"kind": "ot-serve-worker-exit", "lost": lost,
+            "answered": stats["queue"]["answered"],
+            "accepted": stats["queue"]["accepted"],
+            "batches": stats["batches"],
+            "quarantines": stats["lanes"]["quarantine_events"],
+            "recompiles": stats["compiles"]["steady"],
+            "keycache": stats["keycache"],
+            "frames": frontend.frames,
+            "protocol_errors": frontend.protocol_errors}
+    print(json.dumps(line), flush=True)
+    trace.point("worker-drained", lost=lost, frames=frontend.frames)
+    return 1 if lost else 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m our_tree_tpu.serve.worker",
+        description="one ot-serve backend process behind the router "
+                    "(docs/SERVING.md)")
+    ap.add_argument("--port", type=int, default=0,
+                    help="request port (0 = ephemeral; the bound port "
+                         "rides the READY line)")
+    ap.add_argument("--host", default="127.0.0.1",
+                    help="bind address (default loopback: the router and "
+                         "its backends share a host or a private net)")
+    ap.add_argument("--status-port", type=int, default=0, metavar="PORT",
+                    help="/metrics + /healthz port (0 = ephemeral — the "
+                         "router's gossip reads it from the READY line)")
+    ap.add_argument("--engine", default="auto")
+    ap.add_argument("--lanes", type=int, default=None, metavar="N")
+    ap.add_argument("--bucket-min", type=int, default=32, metavar="BLOCKS")
+    ap.add_argument("--bucket-max", type=int, default=4096, metavar="BLOCKS")
+    ap.add_argument("--key-slots", type=int, default=None, metavar="K")
+    ap.add_argument("--native-threads", type=int, default=0)
+    ap.add_argument("--queue-depth", type=int, default=1024)
+    ap.add_argument("--tenant-depth-frac", type=float, default=1.0,
+                    metavar="FRAC")
+    ap.add_argument("--deadline", type=float, default=30.0)
+    ap.add_argument("--dispatch-deadline", type=float,
+                    default=watchdog.default_deadline_s() or 10.0)
+    ap.add_argument("--retries", type=int, default=2)
+    ap.add_argument("--probe-every", type=int, default=8, metavar="BATCHES")
+    ap.add_argument("--max-inflight", type=int, default=None, metavar="N")
+    ap.add_argument("--journal", default=None, metavar="PATH")
+    args = ap.parse_args(argv)
+    if args.key_slots is None:
+        args.key_slots = batcher.DEFAULT_KEY_SLOTS
+    trace.ensure_run()
+    return asyncio.run(_amain(args))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -42,6 +42,7 @@ def main() -> int:
     import jax
 
     from our_tree_tpu.models.arc4 import ARC4, keystream_scan
+    from our_tree_tpu.resilience import watchdog
 
     assert jax.devices()[0].platform != "cpu", "need the real chip"
     key = bytes(range(1, 17))
@@ -60,8 +61,11 @@ def main() -> int:
                 # Scalar readback = the real completion barrier on the
                 # tunnelled transport (backends.py:block_until_ready:
                 # jax.block_until_ready alone can return early there).
-                jax.block_until_ready(x)
-                np.asarray(x.ravel()[-1:])
+                # Watchdog-guarded (armed via OT_DISPATCH_DEADLINE).
+                with watchdog.deadline(watchdog.default_deadline_s(),
+                                       what="arc4 keystream barrier"):
+                    jax.block_until_ready(x)
+                    np.asarray(x.ravel()[-1:])
                 return x
 
             ref = np.asarray(barrier(run(state)))  # compile

@@ -32,6 +32,7 @@ def child(mib: float, op: str) -> int:
     from our_tree_tpu.models import aes as aes_mod
     from our_tree_tpu.models.aes import AES
     from our_tree_tpu.ops import pallas_aes
+    from our_tree_tpu.resilience import watchdog
     from our_tree_tpu.utils import packing
 
     dev = jax.devices()[0]
@@ -41,10 +42,15 @@ def child(mib: float, op: str) -> int:
     nbytes = int(mib * (1 << 20))
     a = AES(bytes(range(16)))
     host = np.random.default_rng(1337).integers(0, 256, nbytes, dtype=np.uint8)
-    words = jax.device_put(jnp.asarray(packing.np_bytes_to_words(host)))
-    nonce = np.frombuffer(bytes(range(16)), np.uint8)
-    ctr_be = jax.device_put(
-        jnp.asarray(packing.np_bytes_to_words(nonce).byteswap()))
+    # Watchdog-guarded device contact (armed via OT_DISPATCH_DEADLINE;
+    # the repro's caller budget is the backstop either way).
+    with watchdog.deadline(watchdog.default_deadline_s(),
+                           what="bitslice repro staging"):
+        words = jax.device_put(
+            jnp.asarray(packing.np_bytes_to_words(host)))
+        nonce = np.frombuffer(bytes(range(16)), np.uint8)
+        ctr_be = jax.device_put(
+            jnp.asarray(packing.np_bytes_to_words(nonce).byteswap()))
 
     if op == "ctr":
         fn = jax.jit(lambda w: aes_mod.ctr_crypt_words(
@@ -52,12 +58,14 @@ def child(mib: float, op: str) -> int:
     else:
         fn = jax.jit(lambda w: aes_mod.ecb_encrypt_words(
             w, a.rk_enc, a.nr, "bitslice"))
-    t0 = time.perf_counter()
-    out = jax.block_until_ready(fn(words))
-    compile_s = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    out = jax.block_until_ready(fn(words))
-    run_s = time.perf_counter() - t0
+    with watchdog.deadline(watchdog.default_deadline_s(),
+                           what="bitslice repro compile+run"):
+        t0 = time.perf_counter()
+        out = jax.block_until_ready(fn(words))
+        compile_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        out = jax.block_until_ready(fn(words))
+        run_s = time.perf_counter() - t0
     digest = int(np.asarray(out).ravel().view(np.uint32).sum(dtype=np.uint32))
     print(json.dumps({
         "mib": mib, "op": op, "ok": True,

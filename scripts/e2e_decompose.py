@@ -41,6 +41,7 @@ def child(mib: float) -> int:
 
     sys.path.insert(0, REPO)
     from our_tree_tpu.harness.backends import TpuBackend
+    from our_tree_tpu.resilience import watchdog
     from our_tree_tpu.utils import packing
 
     assert jax.devices()[0].platform != "cpu", "need the real chip"
@@ -65,9 +66,12 @@ def child(mib: float) -> int:
     pack_s, words_np = t(lambda: packing.np_bytes_to_words(host))
     r["pack_s"] = round(pack_s, 4)
 
-    # h2d (the tunnel upload; barrier = the backend's completion readback)
-    h2d_s, words = t(lambda: backend.block_until_ready(
-        jax.device_put(jnp.asarray(words_np))))
+    # h2d (the tunnel upload; barrier = the backend's completion
+    # readback) — watchdog-guarded raw staging, armed via
+    # OT_DISPATCH_DEADLINE like every dispatch seam.
+    with watchdog.deadline(watchdog.default_deadline_s(), what="e2e h2d"):
+        h2d_s, words = t(lambda: backend.block_until_ready(
+            jax.device_put(jnp.asarray(words_np))))
     r["h2d_s"] = round(h2d_s, 3)
 
     # kernel: the harness's own chained-difference helper (no third copy

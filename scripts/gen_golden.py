@@ -16,12 +16,14 @@ import argparse
 import ctypes
 import json
 import pathlib
-import subprocess
+import sys
 import tempfile
 
 import numpy as np
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "scripts"))
+from _devlock_loader import load_resilience  # noqa: E402
 
 
 class AesContext(ctypes.Structure):
@@ -45,7 +47,7 @@ class Arc4Context(ctypes.Structure):
 def build_oracle(reference: pathlib.Path) -> ctypes.CDLL:
     tmp = pathlib.Path(tempfile.mkdtemp(prefix="cryptoracle_"))
     so = tmp / "libref.so"
-    subprocess.run(
+    r = load_resilience("isolate").run_child(
         [
             "gcc", "-shared", "-fPIC", "-O2", "-std=gnu99",
             # The reference compiles CFB out and never enables the AES self
@@ -56,8 +58,12 @@ def build_oracle(reference: pathlib.Path) -> ctypes.CDLL:
             str(reference / "arc4.c"),
             "-o", str(so),
         ],
-        check=True,
+        timeout_s=300.0, name="build-ref-oracle",
     )
+    if not r.ok:
+        raise RuntimeError(
+            f"reference oracle build failed ({r.kind}, rc={r.rc}): "
+            f"{r.err.strip()[-2000:]}")
     return ctypes.CDLL(str(so))
 
 

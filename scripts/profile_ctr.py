@@ -25,7 +25,6 @@ profile. The parent stays jax-free and holds the devlock for manual runs
 import argparse
 import json
 import os
-import subprocess
 import sys
 import time
 
@@ -57,6 +56,11 @@ COMPONENTS = [
 ]
 
 
+class _ChildTimeout(Exception):
+    """A component child hit its deadline (the isolate runner SIGKILLed
+    its group) — reported as a TIMEOUT row, never a crash."""
+
+
 def child(component: str) -> int:
     """Measure ONE component and print a JSON line."""
     import numpy as np
@@ -68,6 +72,7 @@ def child(component: str) -> int:
     from our_tree_tpu.models import aes as aes_mod
     from our_tree_tpu.models.aes import AES
     from our_tree_tpu.ops import bitslice, pallas_aes
+    from our_tree_tpu.resilience import watchdog
     from our_tree_tpu.utils import packing
 
     # Profile the PRODUCTION config: stored tuned knobs (tile/MC) applied
@@ -98,11 +103,16 @@ def child(component: str) -> int:
     a = AES(bytes(range(16)))
     host = np.random.default_rng(1337).integers(0, 256, NBYTES, dtype=np.uint8)
     host_words = packing.np_bytes_to_words(host)
-    flat = jax.device_put(jnp.asarray(host_words))          # dense layout
-    words = jax.device_put(jnp.asarray(host_words.reshape(-1, 4)))  # padded
-    nonce = np.frombuffer(bytes(range(16)), np.uint8)
-    ctr_be = jax.device_put(
-        jnp.asarray(packing.np_bytes_to_words(nonce).byteswap()))
+    # Watchdog-guarded staging (armed via OT_DISPATCH_DEADLINE; the
+    # parent's per-component timeout is the backstop either way).
+    with watchdog.deadline(watchdog.default_deadline_s(),
+                           what="profile input staging"):
+        flat = jax.device_put(jnp.asarray(host_words))      # dense layout
+        words = jax.device_put(
+            jnp.asarray(host_words.reshape(-1, 4)))         # padded
+        nonce = np.frombuffer(bytes(range(16)), np.uint8)
+        ctr_be = jax.device_put(
+            jnp.asarray(packing.np_bytes_to_words(nonce).byteswap()))
     n = words.shape[0]
     # The raw _*_planes_pallas helpers are called below with pre-made plane
     # tiles and no padding of their own, so pad the block batch exactly the
@@ -205,13 +215,13 @@ def main() -> int:
     if args.component:
         return child(args.component)
 
-    from _devlock_loader import load_devlock
+    from _devlock_loader import load_devlock, load_resilience
 
     gb = NBYTES / 1e9
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
     devlock = load_devlock()
     failures = successes = 0
-    t_start = time.time()
+    t_start = time.monotonic()
     header_done = False
     with devlock.hold(wait_budget_s=900.0,
                       on_wait=lambda p: print(f"# waiting for {p}",
@@ -220,24 +230,26 @@ def main() -> int:
               f"component, {args.timeout:.0f}s each within a "
               f"{args.budget:.0f}s budget")
         for name, label in COMPONENTS:
-            left = args.budget - (time.time() - t_start)
+            left = args.budget - (time.monotonic() - t_start)
             if left < min(args.timeout, 120.0):
                 print(f"{label:36s}: SKIPPED (budget exhausted, "
                       f"{left:.0f}s left)", flush=True)
                 continue
             try:
-                out = subprocess.run(
+                out = load_resilience("isolate").run_child(
                     [sys.executable, "-u", os.path.abspath(__file__),
                      "--component", name],
-                    timeout=min(args.timeout, left),
-                    capture_output=True, text=True,
+                    timeout_s=min(args.timeout, left),
+                    name=f"profile:{name}",
                 )
-                if out.returncode != 0:
-                    err_lines = (out.stderr or "").strip().splitlines()
+                if out.kind == "timeout":
+                    raise _ChildTimeout
+                if not out.ok:
+                    err_lines = out.err.strip().splitlines()
                     raise RuntimeError(
                         err_lines[-1] if err_lines
-                        else f"rc={out.returncode}, empty stderr")
-                r = json.loads(out.stdout.strip().splitlines()[-1])
+                        else f"rc={out.rc}, empty stderr")
+                r = json.loads(out.out.strip().splitlines()[-1])
                 t = r["sec"]
                 if not header_done:
                     # Provenance once, from the first successful child —
@@ -253,7 +265,7 @@ def main() -> int:
                 print(f"{label:36s}: {t * 1e3:8.2f} ms{rate}{eng}",
                       flush=True)
                 successes += 1
-            except subprocess.TimeoutExpired:
+            except _ChildTimeout:
                 failures += 1
                 print(f"{label:36s}: TIMEOUT ({args.timeout:.0f}s)",
                       flush=True)

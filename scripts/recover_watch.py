@@ -111,16 +111,16 @@ def probe(timeout_s: float) -> tuple[bool, float]:
     """(alive, wall_seconds). Latency is evidence either way: a healthy
     probe completes <30 s; 'wedged at timeout' vs 'failed fast' (e.g. an
     import error) are different diagnoses and the ledger should tell."""
-    t0 = time.time()
+    t0 = time.monotonic()
     try:
         subprocess.run(
             [sys.executable, "-c", _PROBE_SRC],
             timeout=timeout_s, check=True,
             stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
         )
-        return True, time.time() - t0
+        return True, time.monotonic() - t0
     except (subprocess.TimeoutExpired, subprocess.CalledProcessError):
-        return False, time.time() - t0
+        return False, time.monotonic() - t0
 
 
 #: The committed probe ledger (VERDICT r3 missing #2): every probe attempt,
@@ -214,7 +214,7 @@ def main() -> int:
 
     os.makedirs(args.plan_dir, exist_ok=True)
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
-    deadline = time.time() + args.budget_h * 3600
+    deadline = time.monotonic() + args.budget_h * 3600
     steps = plan()
     # The plan's identity, for the journal's config hash: step names,
     # argv (minus the interpreter path — it is host detail, not plan
@@ -270,7 +270,7 @@ def main() -> int:
             log = os.path.join(args.plan_dir, f"{name}.log")
             print(f"# tunnel live -> running {name} (log: {log})",
                   flush=True)
-            t0 = time.time()
+            t0 = time.monotonic()
             # Append: a step retried after a re-wedge must not truncate
             # the previous attempt's partial output — that log is the
             # evidence of what was running when the wedge hit.
@@ -294,7 +294,7 @@ def main() -> int:
                 try:
                     rc = proc.wait(
                         timeout=min(outer,
-                                    max(deadline - time.time(), 60)))
+                                    max(deadline - time.monotonic(), 60)))
                 except subprocess.TimeoutExpired:
                     try:
                         os.killpg(proc.pid, signal.SIGKILL)
@@ -302,10 +302,10 @@ def main() -> int:
                         pass
                     proc.wait()
                     rc = "timeout"
-            print(f"# {name}: rc={rc} in {time.time() - t0:.0f}s",
+            print(f"# {name}: rc={rc} in {time.monotonic() - t0:.0f}s",
                   flush=True)
             ledger("step", name=name, rc=rc,
-                   wall_s=f"{time.time() - t0:.0f}")
+                   wall_s=f"{time.monotonic() - t0:.0f}")
             # Mirror the step log into the repo: the plan-dir lives in
             # /tmp and dies with the container, while the repo is the
             # only thing that survives a round boundary — an
@@ -325,7 +325,7 @@ def main() -> int:
             #            wedge: its log has the story; the plan moves on
 
     abandon = object()
-    while idx < len(steps) and time.time() < deadline:
+    while idx < len(steps) and time.monotonic() < deadline:
         step = steps[idx]
         # Journal resume: a step completed by a previous watcher run (the
         # container died, the watcher was restarted) is skipped here —
@@ -355,7 +355,7 @@ def main() -> int:
         # old loop's semantics.
         rc = repolicy.RetryPolicy(
             attempts=None,
-            budget_s=max(deadline - time.time(), 0.0),
+            budget_s=max(deadline - time.monotonic(), 0.0),
             retry_on=(_Busy, _Wedged, _ReWedged),
             on_exhausted=lambda last: abandon,
             name=f"recover-watch:{step[0]}",

@@ -24,7 +24,6 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import subprocess
 import sys
 import time
 
@@ -45,6 +44,7 @@ def child() -> int:
     from our_tree_tpu.models.aes import AES
     from our_tree_tpu.ops import pallas_aes
     from our_tree_tpu.parallel import dist
+    from our_tree_tpu.resilience import watchdog
     from our_tree_tpu.utils import packing
 
     platform = jax.devices()[0].platform
@@ -61,10 +61,15 @@ def child() -> int:
     a = AES(bytes(range(16)))
     rng = np.random.default_rng(1337)
     host = rng.integers(0, 256, NBYTES, dtype=np.uint8)
-    words = jax.device_put(jnp.asarray(packing.np_bytes_to_words(host)))
-    nonce = np.frombuffer(bytes(range(16)), np.uint8)
-    ctr_be = jax.device_put(jnp.asarray(
-        packing.np_bytes_to_words(nonce).byteswap()))
+    # Watchdog-guarded device contact (armed only when
+    # OT_DISPATCH_DEADLINE is set — the parent already SIGKILLs a hung
+    # child at its 1800 s deadline; the guard is the honest seam shape).
+    with watchdog.deadline(watchdog.default_deadline_s(),
+                           what="smoke input staging"):
+        words = jax.device_put(jnp.asarray(packing.np_bytes_to_words(host)))
+        nonce = np.frombuffer(bytes(range(16)), np.uint8)
+        ctr_be = jax.device_put(jnp.asarray(
+            packing.np_bytes_to_words(nonce).byteswap()))
 
     from our_tree_tpu.models import aes as aes_mod
 
@@ -72,19 +77,23 @@ def child() -> int:
     # serves three checks). ravel() both sides: the pallas entry points
     # return (N, 4) where the flat-stream references return (4N,) — the
     # byte streams are what must agree, not the container shape.
-    want_ecb = np.asarray(jax.block_until_ready(
-        jax.jit(lambda w: aes_mod.ecb_encrypt_words(
-            w, a.rk_enc, a.nr, "jnp"))(words))).ravel()
-    want_dec = np.asarray(jax.block_until_ready(
-        jax.jit(lambda w: aes_mod.ecb_decrypt_words(
-            w, a.rk_dec, a.nr, "jnp"))(words))).ravel()
-    want_ctr = np.asarray(jax.block_until_ready(
-        jax.jit(lambda w: aes_mod.ctr_crypt_words(
-            w, ctr_be, a.rk_enc, a.nr, "jnp"))(words))).ravel()
+    with watchdog.deadline(watchdog.default_deadline_s(),
+                           what="smoke jnp references"):
+        want_ecb = np.asarray(jax.block_until_ready(
+            jax.jit(lambda w: aes_mod.ecb_encrypt_words(
+                w, a.rk_enc, a.nr, "jnp"))(words))).ravel()
+        want_dec = np.asarray(jax.block_until_ready(
+            jax.jit(lambda w: aes_mod.ecb_decrypt_words(
+                w, a.rk_dec, a.nr, "jnp"))(words))).ravel()
+        want_ctr = np.asarray(jax.block_until_ready(
+            jax.jit(lambda w: aes_mod.ctr_crypt_words(
+                w, ctr_be, a.rk_enc, a.nr, "jnp"))(words))).ravel()
 
     def check(name, fn, want):
         t0 = time.perf_counter()
-        got = np.asarray(jax.block_until_ready(jax.jit(fn)(words)))
+        with watchdog.deadline(watchdog.default_deadline_s(),
+                               what=f"smoke kernel {name}"):
+            got = np.asarray(jax.block_until_ready(jax.jit(fn)(words)))
         dt = time.perf_counter() - t0
         ok = bool(np.array_equal(got.ravel(), want))
         print(json.dumps({"config": cfg, "kernel": name, "ok": ok,
@@ -170,7 +179,7 @@ def main() -> int:
     # Single-tenant device coordination (see utils/devlock.py): wait for a
     # prior measurement job, then hold the marker for the matrix.
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    from _devlock_loader import load_devlock
+    from _devlock_loader import load_devlock, load_resilience
 
     devlock = load_devlock()
 
@@ -185,17 +194,17 @@ def main() -> int:
                                OT_PALLAS_MC=mc.strip(), OT_SBOX=sbox.strip())
                     tag = f"tile={tile} mc={mc} sbox={sbox}"
                     print(f"## {tag}", flush=True)
-                    try:
-                        rc = subprocess.run(
-                            [sys.executable, os.path.abspath(__file__),
-                             "--child"],
-                            env=env, timeout=1800,
-                        ).returncode
-                    except subprocess.TimeoutExpired:
-                        # A hung Mosaic compile is a failing config, not a
-                        # reason to abandon the rest of the matrix — the
-                        # survey must finish.
-                        rc = -1
+                    # capture=False: the child's per-kernel JSON lines
+                    # stream live (this is an operator survey, watched as
+                    # it runs). A hung Mosaic compile is a failing config
+                    # ("timeout" kind; the child's GROUP is SIGKILLed),
+                    # not a reason to abandon the rest of the matrix.
+                    r = load_resilience("isolate").run_child(
+                        [sys.executable, os.path.abspath(__file__),
+                         "--child"],
+                        timeout_s=1800, env=env, capture=False,
+                        name=f"smoke:{tag}")
+                    rc = -1 if r.kind == "timeout" else r.rc
                     if rc:
                         failures += 1
                         print(f"## {tag} FAILED rc={rc}", flush=True)

@@ -17,12 +17,11 @@ import argparse
 import json
 import math
 import os
-import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-from _devlock_loader import load_devlock, load_ranking  # noqa: E402
+from _devlock_loader import load_devlock, load_ranking, load_resilience  # noqa: E402
 import tune_tpu  # noqa: E402  (CHILD snippet + default mirrors)
 
 #: A per-size override must beat the flat tile by this factor to be
@@ -67,23 +66,22 @@ def main() -> int:
                 code = tune_tpu.CHILD % {"repo": REPO, "nbytes": nbytes,
                                          "iters": k, "engine": args.engine}
                 tag = f"size={mib:g}MiB tile={tile:<5}"
-                try:
-                    out = subprocess.run(
-                        [sys.executable, "-u", "-c", code], env=env,
-                        timeout=args.timeout,
-                        capture_output=True, text=True, check=True)
-                    r = json.loads(out.stdout.strip().splitlines()[-1])
+                out = load_resilience("isolate").run_child(
+                    [sys.executable, "-u", "-c", code], env=env,
+                    timeout_s=args.timeout, name=f"tile:{tag.strip()}")
+                if out.kind == "timeout":
+                    print(f"{tag} ->  TIMEOUT", flush=True)
+                elif not out.ok:
+                    msg = out.err.strip().splitlines()
+                    print(f"{tag} ->  FAILED "
+                          f"({msg[-1] if msg else 'no stderr'})", flush=True)
+                else:
+                    r = json.loads(out.out.strip().splitlines()[-1])
                     cells.setdefault(mib, {})[tile] = r["gbps"]
                     digests.setdefault(mib, set()).add(r["digest"])
                     platforms.add(r.get("platform", "unknown"))
                     print(f"{tag} ->  {r['gbps']:7.3f} GB/s  "
                           f"digest={r['digest']:#010x}", flush=True)
-                except subprocess.TimeoutExpired:
-                    print(f"{tag} ->  TIMEOUT", flush=True)
-                except subprocess.CalledProcessError as e:
-                    msg = (e.stderr or "").strip().splitlines()
-                    print(f"{tag} ->  FAILED "
-                          f"({msg[-1] if msg else 'no stderr'})", flush=True)
 
     bad = [m for m, d in digests.items() if len(d) > 1]
     if bad:

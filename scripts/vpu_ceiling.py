@@ -114,14 +114,17 @@ def main() -> int:
     import jax.numpy as jnp
 
     from our_tree_tpu.ops.pallas_aes import _interpret
+    from our_tree_tpu.resilience import watchdog
 
     n = NBYTES // 4
     lanes = max(n // 8, TILE)
     lanes -= lanes % TILE
     n = lanes * 8
     interpret = _interpret()
-    x = jax.device_put(
-        jnp.arange(n, dtype=jnp.uint32).reshape(8, lanes))
+    with watchdog.deadline(watchdog.default_deadline_s(),
+                           what="vpu ceiling staging"):
+        x = jax.device_put(
+            jnp.arange(n, dtype=jnp.uint32).reshape(8, lanes))
     dev = jax.devices()[0]
     print(f"# {n * 4 >> 20} MiB u32, shape (8, {lanes}), tile={TILE}, "
           f"device={dev.platform}/{dev.device_kind}, interpret={interpret}")

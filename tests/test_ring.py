@@ -1,0 +1,121 @@
+"""route/ring.py: the consistent-hash ring's three load-bearing
+properties — cross-process determinism (PINNED golden placements: the
+hash is SHA-256 of stable strings, so these values must never change
+without a deliberate ring-version decision), minimal-motion rebalance
+(one join/leave among N members moves ~K/N keys, bounded here), and the
+distinct clockwise replica sequence the router fails over along."""
+
+import numpy as np
+
+from our_tree_tpu.route import ring
+
+MEMBERS = ["b0", "b1", "b2"]
+
+#: Golden placements for Ring(MEMBERS, vnodes=64) — byte-pinned: any
+#: change here is a FLEET-WIDE cache flush and a cross-version placement
+#: split, and must be a deliberate decision, not a refactor side effect.
+GOLDEN = {
+    "t0/deadbeef00000000": "b0",
+    "t1/deadbeef00000001": "b1",
+    "t2/deadbeef00000002": "b2",
+    "t3/deadbeef00000003": "b0",
+    "t4/deadbeef00000004": "b0",
+    "t5/deadbeef00000005": "b0",
+    "t6/deadbeef00000006": "b2",
+    "t7/deadbeef00000007": "b2",
+}
+GOLDEN_HASH_B0_0 = 6206288702425594293
+GOLDEN_HASH_PIN = 7274556349502031570
+
+
+def _keys(n: int) -> list[str]:
+    rng = np.random.default_rng(7)
+    return [f"t{int(rng.integers(64))}/{rng.integers(1 << 62):016x}"
+            for _ in range(n)]
+
+
+def test_placement_is_pinned_across_processes():
+    # The determinism contract: same members => same placement in ANY
+    # process (no per-process hash salt). The goldens were captured
+    # once; a failure here means a router restart would re-home keys.
+    r = ring.Ring(MEMBERS)
+    assert {k: r.node_for(k) for k in GOLDEN} == GOLDEN
+    assert ring.stable_hash("b0#0") == GOLDEN_HASH_B0_0
+    assert ring.stable_hash("pin") == GOLDEN_HASH_PIN
+    assert ring.affinity_key("alice", b"\x00" * 16) == \
+        "alice/374708fff7719dd5"
+
+
+def test_placement_independent_of_join_order():
+    a = ring.Ring(["b0", "b1", "b2"])
+    b = ring.Ring(["b2", "b0", "b1"])
+    for k in _keys(200):
+        assert a.node_for(k) == b.node_for(k)
+
+
+def test_nodes_for_is_distinct_and_covers_members():
+    r = ring.Ring(MEMBERS)
+    for k in _keys(50):
+        seq = r.nodes_for(k)
+        assert sorted(seq) == sorted(MEMBERS)  # distinct, full coverage
+        assert seq[0] == r.node_for(k)         # [0] is the affinity home
+        assert r.nodes_for(k, 2) == seq[:2]    # prefix-stable
+
+
+def test_balance_over_members():
+    r = ring.Ring([f"b{i}" for i in range(4)])
+    keys = _keys(4000)
+    counts = {}
+    for k in keys:
+        counts[r.node_for(k)] = counts.get(r.node_for(k), 0) + 1
+    # 64 vnodes/member: no member should own less than half or more
+    # than double its fair share on a 4k-key sample.
+    for m, c in counts.items():
+        assert 0.5 < c / (len(keys) / 4) < 2.0, counts
+
+
+def test_minimal_motion_on_join_and_leave():
+    keys = _keys(3000)
+    r = ring.Ring(MEMBERS)
+    before = r.placement(keys)
+    r.add("b3")
+    after = r.placement(keys)
+    moved = ring.moved_keys(before, after)
+    # A 4th member should steal ~K/4; allow 2x slack for vnode variance.
+    assert 0 < moved < len(keys) / 2, moved
+    # Every moved key moved TO the joiner — join steals arcs, it never
+    # shuffles keys among the incumbents.
+    for k in keys:
+        if after[k] != before[k]:
+            assert after[k] == "b3"
+    # Leave restores the exact prior placement (remove is add's inverse).
+    r.remove("b3")
+    assert r.placement(keys) == before
+
+
+def test_leave_moves_only_the_leavers_keys():
+    keys = _keys(3000)
+    r = ring.Ring(MEMBERS)
+    before = r.placement(keys)
+    r.remove("b1")
+    after = r.placement(keys)
+    for k in keys:
+        if before[k] != "b1":
+            assert after[k] == before[k]  # survivors keep every key
+        else:
+            assert after[k] != "b1"
+
+
+def test_membership_errors_and_empty_ring():
+    r = ring.Ring(["b0"])
+    try:
+        r.add("b0")
+        assert False, "duplicate join must refuse"
+    except ValueError:
+        pass
+    r.remove("b0")
+    try:
+        r.node_for("k")
+        assert False, "empty ring must refuse placement"
+    except LookupError:
+        pass

@@ -474,6 +474,51 @@ def test_queue_shed_stamps_degrade_ledger():
     asyncio.run(main())
 
 
+def test_queue_tenant_cap_sheds_heavy_tenant_only():
+    async def main():
+        q = otq.RequestQueue(max_depth=10, tenant_depth_frac=0.3)
+        assert q._tenant_cap == 3
+        # The heavy tenant fills its share, then sheds ITSELF...
+        heavy = [q.submit("hog", b"k" * 16, b"n" * 16,
+                          np.zeros(16, np.uint8)) for _ in range(5)]
+        shed = [await f for f in heavy[3:]]
+        assert all(r.error == otq.ERR_SHED for r in shed)
+        # ...while another tenant is still admitted (the starvation the
+        # cap exists to end: global shed alone would let the hog fill
+        # all 10 slots first).
+        ok = q.submit("quiet", b"k" * 16, b"n" * 16,
+                      np.zeros(16, np.uint8))
+        assert not ok.done()
+        st = q.stats()
+        assert st["shed"] == 2 and st["shed_tenant"] == 2
+        assert "tenant->shed" in degrade.events()
+        # The registry distinguishes the reasons exactly.
+        from our_tree_tpu.obs import metrics as obs_metrics
+
+        snap = obs_metrics.snapshot()["counters"]
+        assert snap.get("serve_shed{reason=tenant}", 0) >= 2
+        # Draining the queue returns the tenant's slots: admission again.
+        q.drain()
+        again = q.submit("hog", b"k" * 16, b"n" * 16,
+                         np.zeros(16, np.uint8))
+        assert not again.done()
+        q.flush()
+
+    asyncio.run(main())
+
+
+def test_queue_tenant_cap_off_by_default():
+    async def main():
+        q = otq.RequestQueue(max_depth=4)  # frac 1.0: global shed only
+        futs = [q.submit("hog", b"k" * 16, b"n" * 16,
+                         np.zeros(16, np.uint8)) for _ in range(4)]
+        assert not any(f.done() for f in futs)
+        assert q.stats()["shed_tenant"] == 0
+        q.flush()
+
+    asyncio.run(main())
+
+
 def test_queue_deadline_expires_at_drain():
     async def main():
         clock = {"t": 0.0}
