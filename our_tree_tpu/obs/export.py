@@ -129,6 +129,30 @@ class Run:
             cur = self.spans.get(cur.parent) if cur.parent else None
         return None
 
+    def clock_offsets(self) -> dict[int, int]:
+        """Per-pid clock offsets (µs) estimated from the wire handshake.
+
+        The router traces a ``wire-skew`` point per canary exchange:
+        ``skew_us`` = backend reply timestamp minus the exchange
+        midpoint, ``pid`` = the backend process (from the response
+        frame). The MEDIAN per pid is that process's estimated offset
+        from the router's clock — subtracting it re-aligns the merged
+        timeline (``to_chrome_trace(align=True)``) so a backend with a
+        skewed clock no longer renders its spans displaced from the
+        router spans that caused them. Empty when no handshake points
+        exist (single-process runs need no alignment)."""
+        by_pid: dict[int, list[int]] = {}
+        for p in self.points("wire-skew"):
+            a = p.get("attrs", {})
+            pid, skew = a.get("pid"), a.get("skew_us")
+            if isinstance(pid, int) and isinstance(skew, (int, float)):
+                by_pid.setdefault(pid, []).append(int(skew))
+        out = {}
+        for pid, skews in by_pid.items():
+            skews.sort()
+            out[pid] = skews[len(skews) // 2]
+        return out
+
     def metrics_totals(self) -> dict:
         """Final registry totals across the run's processes: the LAST
         snapshot per pid (snapshots are cumulative), counters and
@@ -180,9 +204,13 @@ def _segment_order(path: str):
     ``-s2``, ... — and plain ``sorted()`` puts ``-s1`` BEFORE the bare
     first segment (``-`` < ``.``), which would feed span ends to the
     parser before their begins and misreport a healthy rotated run as
-    full of violations. Key: (base name, segment number)."""
+    full of violations. Key: (base name, segment number). The metrics
+    snapshot files rotate under the same cap with the same naming, so
+    the same key orders them (cumulative snapshots make order matter
+    less there, but last-per-proc folding still wants write order)."""
     name = os.path.basename(path)
-    m = re.fullmatch(r"(trace-\d+-[0-9a-f]+)(?:-s(\d+))?\.jsonl", name)
+    m = re.fullmatch(
+        r"((?:trace|metrics)-\d+-[0-9a-f]+)(?:-s(\d+))?\.jsonl", name)
     if m:
         return (m.group(1), int(m.group(2) or 0))
     return (name, 0)
@@ -266,7 +294,8 @@ def load_run(run_dir: str) -> Run:
     ``run_dir`` into a ``Run``
     (a process's rotated segments in write order — ``_segment_order``)."""
     run = Run()
-    for path in sorted(glob.glob(os.path.join(run_dir, "metrics-*.jsonl"))):
+    for path in sorted(glob.glob(os.path.join(run_dir, "metrics-*.jsonl")),
+                       key=_segment_order):
         _load_metrics_file(run, path)
     for path in sorted(glob.glob(os.path.join(run_dir, "trace-*.jsonl")),
                        key=_segment_order):
@@ -319,13 +348,18 @@ def load_run(run_dir: str) -> Run:
                             (fname, lineno, f"end without begin {rec['id']}"))
                         continue
                     sp.end_ts, sp.status = rec["ts"], rec["status"]
+                    if rec.get("attrs"):
+                        # End-event attrs (trace.note): measurements
+                        # only known at close — device/host time split —
+                        # merged into the reconstructed span.
+                        sp.attrs = {**sp.attrs, **rec["attrs"]}
                 else:
                     rec["pid"] = pid
                     run.events.append(rec)
     return run
 
 
-def to_chrome_trace(run: Run) -> dict:
+def to_chrome_trace(run: Run, align: bool = True) -> dict:
     """The run as a Trace Event Format object (Perfetto/chrome loadable).
 
     Closed spans become complete ("X") events; orphans become "X" events
@@ -335,9 +369,21 @@ def to_chrome_trace(run: Run) -> dict:
     are instants ("i"), counters cumulative "C" tracks, gauges "C"
     tracks of their raw value. Timestamps are rebased to the run's
     first event so traces open at t=0.
+
+    ``align=True`` (the default) subtracts each process's estimated
+    clock offset (``Run.clock_offsets``, from the wire-skew handshake
+    points) from its timestamps, so a multi-HOST run's spans line up on
+    one causally-consistent timeline — the router's dispatch bar and the
+    backend's queued/dispatch bars nest instead of drifting apart. A
+    run with no handshake points is unchanged.
     """
     t0 = run.t0 or 0
     run_end = run.t1 if run.t1 is not None else t0
+    offsets = run.clock_offsets() if align else {}
+
+    def ts_of(ts: int, pid: int) -> int:
+        return ts - t0 - offsets.get(pid, 0)
+
     out: list[dict] = []
     for pid, hdr in sorted(run.procs.items()):
         out.append({"ph": "M", "name": "process_name", "pid": pid, "tid": 0,
@@ -349,7 +395,7 @@ def to_chrome_trace(run: Run) -> dict:
         elif sp.status != "ok":
             args["status"] = sp.status
         out.append({"ph": "X", "cat": "ot", "name": sp.name, "pid": sp.pid,
-                    "tid": sp.tid, "ts": sp.ts - t0,
+                    "tid": sp.tid, "ts": ts_of(sp.ts, sp.pid),
                     "dur": sp.dur_us(run_end), "args": args})
     # Counter tracks are per-PROCESS in the Trace Event Format, so the
     # cumulative totals must be too — one shared total would show the
@@ -358,17 +404,18 @@ def to_chrome_trace(run: Run) -> dict:
     for e in sorted(run.events, key=lambda e: e["ts"]):
         if e["ev"] == "p":
             out.append({"ph": "i", "cat": "ot", "name": e["name"],
-                        "pid": e["pid"], "tid": 0, "ts": e["ts"] - t0,
+                        "pid": e["pid"], "tid": 0,
+                        "ts": ts_of(e["ts"], e["pid"]),
                         "s": "p", "args": e.get("attrs", {})})
         elif e["ev"] == "c":
             key = (e["pid"], e["name"])
             totals[key] = totals.get(key, 0) + e.get("n", 0)
             out.append({"ph": "C", "name": e["name"], "pid": e["pid"],
-                        "ts": e["ts"] - t0,
+                        "ts": ts_of(e["ts"], e["pid"]),
                         "args": {"value": totals[key]}})
         elif e["ev"] == "g":
             out.append({"ph": "C", "name": e["name"], "pid": e["pid"],
-                        "ts": e["ts"] - t0,
+                        "ts": ts_of(e["ts"], e["pid"]),
                         "args": {"value": e.get("value", 0)}})
     # Registry snapshot gauges as counter tracks ("metrics:" prefixed so
     # the flusher's 2 s samples sit beside, not inside, the per-event
@@ -378,13 +425,18 @@ def to_chrome_trace(run: Run) -> dict:
     for snap in sorted(run.snapshots, key=lambda s: s["ts"]):
         for name, labels, v in snap.get("gauges", []):
             out.append({"ph": "C", "name": f"metrics:{_flat(name, labels)}",
-                        "pid": snap.get("pid", -1), "ts": snap["ts"] - t0,
+                        "pid": snap.get("pid", -1),
+                        "ts": ts_of(snap["ts"], snap.get("pid", -1)),
                         "args": {"value": v}})
-    return {"traceEvents": out, "displayTimeUnit": "ms"}
+    doc = {"traceEvents": out, "displayTimeUnit": "ms"}
+    if offsets:
+        doc["otClockOffsetsUs"] = {str(k): v for k, v in
+                                   sorted(offsets.items())}
+    return doc
 
 
-def write_chrome_trace(run: Run, path: str) -> str:
+def write_chrome_trace(run: Run, path: str, align: bool = True) -> str:
     with open(path, "w", encoding="utf-8") as fh:
-        json.dump(to_chrome_trace(run), fh, separators=(",", ":"),
-                  default=repr)
+        json.dump(to_chrome_trace(run, align=align), fh,
+                  separators=(",", ":"), default=repr)
     return path

@@ -67,12 +67,20 @@ VERSION = 1
 #: multiply series, and series live forever in a process-global dict.
 ALLOWED_LABEL_KEYS = ("lane", "rung", "engine", "outcome", "bucket",
                       "code", "state", "slots", "point", "kind", "mode",
-                      "backend", "reason")
+                      "backend", "reason", "stage")
 
 #: Runtime backstop for the same hazard the lint rule prevents
 #: statically: at most this many distinct label sets per metric name —
 #: updates beyond it are dropped (and counted), never stored.
 _MAX_SERIES = 64
+
+#: The time-attribution waterfall's stage vocabulary, in request-path
+#: order (docs/OBSERVABILITY.md): the router's stages, then the
+#: backend's. The ONE definition — route.bench's completeness gate and
+#: obs.report's fleet table both read it, so they can never disagree
+#: about what a complete waterfall is.
+WATERFALL_STAGES = ("router_queue", "retry", "wire", "backend_queue",
+                    "pack", "worker_wait", "dispatch", "device", "reply")
 
 _LOCK = threading.Lock()
 #: (name, ((k, v), ...)) -> total / last value / _Hist.
@@ -83,9 +91,16 @@ _HISTS: dict[tuple, "_Hist"] = {}
 _SERIES: dict[str, int] = {}
 _DROPPED = 0
 
-#: Lazily-opened snapshot file state {"run","fh","path"}; None until the
-#: first flush. Mirrors trace._STATE (reopens on a run-id change).
+#: Lazily-opened snapshot file state {"run","fh","path",...}; None until
+#: the first flush. Mirrors trace._STATE (reopens on a run-id change),
+#: rotation fields included: under ``OT_TRACE_MAX_MB`` the snapshot file
+#: rotates into ``-s<k>`` segments with the oldest deleted, same as the
+#: trace stream — snapshots are CUMULATIVE, so eviction loses the time
+#: axis's tail but never the totals (the last surviving snapshot is
+#: complete). Evicted bytes are counted (``evicted_bytes``), surfaced in
+#: every later snapshot line and on /metrics — bounded is never silent.
 _SINK: dict | None = None
+_EVICTED_BYTES = 0
 _FLUSHER: threading.Thread | None = None
 _ATEXIT_REGISTERED = False
 
@@ -336,7 +351,79 @@ def _snapshot_rec(ts_us: int) -> dict:
            "hists": hists}
     if _DROPPED:
         rec["dropped"] = _DROPPED
+    if _EVICTED_BYTES:
+        rec["evicted_bytes"] = _EVICTED_BYTES
     return rec
+
+
+def _max_bytes() -> int:
+    """The snapshot-file disk cap: the SAME ``OT_TRACE_MAX_MB`` knob the
+    trace stream rotates under (one soak-run cap for the whole run dir's
+    per-process footprint). 0/unset = unbounded."""
+    try:
+        mb = float(os.environ.get("OT_TRACE_MAX_MB", 0) or 0)
+    except ValueError:
+        return 0
+    return max(int(mb * (1 << 20)), 0)
+
+
+def _segment_path(sink: dict) -> str:
+    suffix = f"-s{sink['seg']}" if sink["seg"] else ""
+    return os.path.join(
+        sink["dir"], f"metrics-{sink['pid']}-{sink['proc']}{suffix}.jsonl")
+
+
+def _open_segment(sink: dict) -> None:
+    """Open the current segment and write its header (every segment is
+    self-describing, SAME proc token — ``obs.export`` aggregates
+    last-snapshot-per-proc across segments). ``sink`` is only mutated on
+    full success."""
+    path = _segment_path(sink)
+    fh = open(path, "a", encoding="utf-8")
+    try:
+        header = {"kind": KIND, "v": VERSION, "run": sink["run"],
+                  "pid": sink["pid"], "proc": sink["proc"],
+                  "interval_s": flush_interval_s(),
+                  "start_us": time.time_ns() // 1000}
+        if sink["seg"]:
+            header["seg"] = sink["seg"]
+        fh.write(json.dumps(header, separators=(",", ":")) + "\n")
+        fh.flush()
+    except OSError:
+        try:
+            fh.close()
+        except OSError:
+            pass
+        raise
+    sink["fh"], sink["path"] = fh, path
+
+
+def _rotate_sink(sink: dict) -> None:
+    """Open-next-then-retire (the trace rotation order: a failed open
+    mid-ENOSPC keeps the live handle and retries later), then evict the
+    oldest segments past the cap, counting every evicted byte."""
+    global _EVICTED_BYTES
+    old_fh, old_path = sink["fh"], sink["path"]
+    sink["seg"] += 1
+    try:
+        _open_segment(sink)
+    except OSError:
+        sink["seg"] -= 1
+        return
+    try:
+        old_fh.close()
+    except OSError:
+        pass
+    sink["segments"].append(old_path)
+    keep = max(int(sink["cap_bytes"] // sink["seg_bytes"]) - 1, 1)
+    while len(sink["segments"]) > keep:
+        victim = sink["segments"].pop(0)
+        try:
+            size = os.path.getsize(victim)
+            os.unlink(victim)
+            _EVICTED_BYTES += size
+        except OSError:
+            break
 
 
 def _sink() -> dict | None:
@@ -354,16 +441,13 @@ def _sink() -> dict | None:
     try:
         d = t.run_dir()
         os.makedirs(d, exist_ok=True)
-        tok = uuid.uuid4().hex[:8]
-        path = os.path.join(d, f"metrics-{os.getpid()}-{tok}.jsonl")
-        fh = open(path, "a", encoding="utf-8")
-        header = {"kind": KIND, "v": VERSION, "run": run,
-                  "pid": os.getpid(), "proc": tok,
-                  "interval_s": flush_interval_s(),
-                  "start_us": time.time_ns() // 1000}
-        fh.write(json.dumps(header, separators=(",", ":")) + "\n")
-        fh.flush()
-        _SINK = {"run": run, "fh": fh, "path": path}
+        cap = _max_bytes()
+        sink = {"run": run, "dir": d, "pid": os.getpid(),
+                "proc": uuid.uuid4().hex[:8], "seg": 0, "segments": [],
+                "cap_bytes": cap,
+                "seg_bytes": max(cap // 4, 4096) if cap else 0}
+        _open_segment(sink)
+        _SINK = sink
         return _SINK
     except OSError:
         _DROPPED += 1
@@ -392,6 +476,8 @@ def flush_now() -> bool:
         rec = _snapshot_rec(time.time_ns() // 1000)
         sink["fh"].write(json.dumps(rec, separators=(",", ":")) + "\n")
         sink["fh"].flush()
+        if sink["seg_bytes"] and sink["fh"].tell() >= sink["seg_bytes"]:
+            _rotate_sink(sink)
         return True
     except Exception:  # noqa: BLE001 - never-raises contract
         _DROPPED += 1
@@ -497,6 +583,9 @@ def render_prometheus() -> str:
     if _DROPPED:
         lines.append("# TYPE ot_metrics_dropped_total counter")
         lines.append(f"ot_metrics_dropped_total {_DROPPED}")
+    if _EVICTED_BYTES:
+        lines.append("# TYPE ot_metrics_evicted_bytes_total counter")
+        lines.append(f"ot_metrics_evicted_bytes_total {_EVICTED_BYTES}")
     return "\n".join(lines) + "\n"
 
 
@@ -519,13 +608,56 @@ def hist_merged(name: str) -> dict:
     return merge_buckets(parts)
 
 
+def hist_by_label(name: str, label_key: str) -> dict:
+    """label value -> merged buckets for one histogram name, grouped by
+    one label key (e.g. ``serve_stage_us`` by ``stage``)."""
+    parts: dict[str, list] = {}
+    with _LOCK:
+        for (n, labels), h in _HISTS.items():
+            if n != name:
+                continue
+            lv = dict(labels).get(label_key)
+            if lv is not None:
+                parts.setdefault(str(lv), []).append(dict(h.buckets))
+    return {k: merge_buckets(v) for k, v in sorted(parts.items())}
+
+
+def stage_percentiles(
+        names=("route_stage_us", "serve_stage_us")) -> dict:
+    """The bench artifacts' ``stages`` section: stage name ->
+    {p50_us, p95_us, p99_us, count} interpolated from this process's
+    stage histograms — the quantity ``obs/slo.py``'s per-stage budget
+    gates compare, so a goodput regression names WHICH stage moved."""
+    merged: dict[str, dict] = {}
+    for name in names:
+        for stage, buckets in hist_by_label(name, "stage").items():
+            agg = merged.setdefault(stage, {})
+            agg["buckets"] = merge_buckets(
+                [agg.get("buckets", {}), buckets])
+    out = {}
+    for stage, agg in sorted(merged.items()):
+        b = agg["buckets"]
+        out[stage] = {
+            "p50_us": round(percentile_from_buckets(b, 50), 1),
+            "p95_us": round(percentile_from_buckets(b, 95), 1),
+            "p99_us": round(percentile_from_buckets(b, 99), 1),
+            "count": sum(b.values()),
+        }
+    return out
+
+
 def dropped() -> int:
     return _DROPPED
 
 
+def evicted_bytes() -> int:
+    """Bytes of snapshot history deleted by the OT_TRACE_MAX_MB cap."""
+    return _EVICTED_BYTES
+
+
 def reset_for_tests() -> None:
     """Clear every series and close the snapshot sink (tests only)."""
-    global _DROPPED
+    global _DROPPED, _EVICTED_BYTES
     _close_sink()
     with _LOCK:
         _COUNTS.clear()
@@ -533,3 +665,4 @@ def reset_for_tests() -> None:
         _HISTS.clear()
         _SERIES.clear()
     _DROPPED = 0
+    _EVICTED_BYTES = 0

@@ -53,6 +53,7 @@ from __future__ import annotations
 import argparse
 import glob
 import os
+import re
 import sys
 
 from . import export
@@ -68,6 +69,35 @@ DEVICE_SPANS = ("timed-call", "barrier", "chained-dispatch")
 #: wins over the in-process view ("unit") when both exist for a unit —
 #: counting both would double every isolated unit's wall time.
 ATTEMPT_SPANS = ("unit-attempt", "unit")
+
+#: The fleet waterfall's stage order — the shared vocabulary
+#: (obs/metrics.py; route/proxy.py _build_ledger and serve/server.py
+#: produce it, route.bench's completeness gate consumes the same tuple).
+WATERFALL_STAGES = _metrics.WATERFALL_STAGES
+
+
+def fleet_join_stats(run: export.Run) -> dict:
+    """Cross-process trace joins: of the run's ``route-request`` spans
+    (the router-side roots, one per sampled request), how many have a
+    child span in ANOTHER process — i.e. the backend's ``request-queued``
+    span actually chained under the router's span id over the wire. The
+    CI route drive gates ``joined/total`` (``--min-join-frac``): a
+    propagation regression shows up as roots with no cross-process
+    children, not as a parse error."""
+    roots = [s for s in run.spans.values() if s.name == "route-request"]
+    children: dict[str, list] = {}
+    for s in run.spans.values():
+        if s.parent:
+            children.setdefault(s.parent, []).append(s)
+    joined = linked = 0
+    for r in roots:
+        kids = children.get(r.id, [])
+        if kids:
+            linked += 1
+        if any(k.proc != r.proc for k in kids):
+            joined += 1
+    return {"roots": len(roots), "linked": linked, "joined": joined,
+            "frac": (joined / len(roots)) if roots else 0.0}
 
 
 def _resolve_run_dir(path: str, say=print) -> str:
@@ -384,6 +414,62 @@ def render(run: export.Run, top: int = 10, out=sys.stdout,
             _table(rows, ["histogram", "count", "p50", "p95", "p99",
                           "mean"], out)
 
+    # -- the fleet waterfall (per-stage time attribution) ------------------
+    # The cross-process answer to "where does a request's latency go":
+    # the router and backends each observe their ledger stages into
+    # `route_stage_us{stage=...}` / `serve_stage_us{stage=...}` (the
+    # registry is the fleet-wide aggregation — the flusher's snapshots
+    # from every process merge here), rendered in request-path order
+    # with percentiles interpolated from the log2 buckets. This is the
+    # table the TPU-saturation gap decomposes on (docs/OBSERVABILITY.md
+    # cookbook): a goodput miss names its stage, not just its total.
+    if run.snapshots:
+        totals_w = run.metrics_totals()
+        stage_hists: dict[str, dict] = {}
+        for key, h in totals_w["hists"].items():
+            m = re.fullmatch(r"(?:route|serve)_stage_us\{stage=(\w+)\}",
+                             key)
+            if m:
+                agg = stage_hists.setdefault(
+                    m.group(1), {"buckets": {}, "count": 0, "sum": 0.0})
+                agg["buckets"] = _metrics.merge_buckets(
+                    [agg["buckets"], h["buckets"]])
+                agg["count"] += h["count"]
+                agg["sum"] += h["sum"]
+        if stage_hists:
+            out.write("\nfleet waterfall (per-stage time attribution, "
+                      "µs):\n")
+            rows = []
+            known = [s for s in WATERFALL_STAGES if s in stage_hists]
+            extra = sorted(set(stage_hists) - set(known))
+            for name in known + extra:
+                h = stage_hists[name]
+                b = h["buckets"]
+                rows.append([
+                    name, str(h["count"]),
+                    f"{_metrics.percentile_from_buckets(b, 50):.0f}",
+                    f"{_metrics.percentile_from_buckets(b, 95):.0f}",
+                    f"{_metrics.percentile_from_buckets(b, 99):.0f}",
+                    (f"{h['sum'] / h['count']:.0f}" if h["count"]
+                     else "-"),
+                ])
+            _table(rows, ["stage", "count", "p50", "p95", "p99", "mean"],
+                   out)
+
+    # -- cross-process joins + clock skew (fleet tracing) ------------------
+    join = fleet_join_stats(run)
+    if join["roots"]:
+        out.write(f"\nfleet join: {join['joined']}/{join['roots']} "
+                  "route-request spans joined by a cross-process backend "
+                  f"span ({join['frac']:.1%}; {join['linked']} with any "
+                  "child)\n")
+    offsets = run.clock_offsets()
+    if offsets:
+        out.write("clock skew (wire handshake): "
+                  + ", ".join(f"pid {pid}: {off:+d}µs"
+                              for pid, off in sorted(offsets.items()))
+                  + "\n")
+
     # -- faults: injected vs observed --------------------------------------
     injected: dict[str, int] = {}
     for p in run.points("fault-injected"):
@@ -456,7 +542,16 @@ def main(argv=None) -> int:
                          "unlisted-name orphan or an extra orphan past a "
                          "name's budget still fails --check")
     ap.add_argument("--trace-json", default=None, metavar="PATH",
-                    help="also write the Chrome/Perfetto trace.json")
+                    help="also write the Chrome/Perfetto trace.json "
+                         "(clock-aligned across processes when wire-skew "
+                         "handshake points exist)")
+    ap.add_argument("--min-join-frac", type=float, default=None,
+                    metavar="FRAC",
+                    help="fail (exit 2) unless at least FRAC of the "
+                         "run's route-request spans are joined by a "
+                         "cross-process backend span — the fleet trace-"
+                         "propagation gate (no-op when the run has no "
+                         "route-request spans)")
     ap.add_argument("--top", type=int, default=10,
                     help="slowest-span table size")
     args = ap.parse_args(argv)
@@ -495,6 +590,15 @@ def main(argv=None) -> int:
               + (f" ({n_ok} expected orphan(s) allowed)" if n_ok else ""),
               file=sys.stderr)
         return 2
+    if args.min_join_frac is not None:
+        join = fleet_join_stats(run)
+        if join["roots"] and join["frac"] < args.min_join_frac:
+            print(f"CHECK FAILED: only {join['joined']}/{join['roots']} "
+                  f"({join['frac']:.1%}) route-request spans joined "
+                  f"across processes (< {args.min_join_frac:.1%}) — "
+                  "cross-process trace propagation regressed",
+                  file=sys.stderr)
+            return 2
     return 0
 
 

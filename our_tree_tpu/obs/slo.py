@@ -52,6 +52,13 @@ DEFAULT_TOLERANCES = {
     "p95_ms": 0.50,
     "p99_ms": 0.75,
     "goodput_gbps": 0.25,
+    #: per-STAGE p95 budget (the waterfall gate): each stage in the
+    #: baseline artifact's "stages" section may grow by at most this
+    #: fraction. Looser than the end-to-end bands on purpose — single
+    #: stages are noisier than their sum — but tight enough that a
+    #: regression names WHICH stage moved instead of only that the
+    #: total did (the fleet-observability ISSUE's point).
+    "stage_p95_us": 1.0,
 }
 
 #: Lower-is-better vs higher-is-better among the ratio metrics.
@@ -83,6 +90,15 @@ def extract(doc: dict) -> dict:
         out["recompiles"] = float(doc["compiles"].get("steady", 0))
     else:
         out["recompiles"] = float(load.get("recompiles", 0))
+    # The per-stage waterfall budgets (artifact "stages" section:
+    # {stage: {p50_us, p95_us, p99_us, count}} — route.bench /
+    # serve.bench schema): p95 per stage is the gated quantity.
+    stages = doc.get("stages")
+    if isinstance(stages, dict):
+        out["stages"] = {
+            str(name): float(v.get("p95_us", 0.0)
+                             if isinstance(v, dict) else v)
+            for name, v in stages.items()}
     return out
 
 
@@ -114,9 +130,11 @@ def compare(baseline: dict, candidate: dict,
     tol.update(tolerances or {})
     failures: list[str] = []
     for name, t in sorted(tol.items()):
+        if name == "stage_p95_us":
+            continue  # the per-stage loop below consumes it
         base = baseline.get(name, 0.0)
         cand = candidate.get(name, 0.0)
-        if base <= 0:
+        if not isinstance(base, (int, float)) or base <= 0:
             continue  # nothing promised (e.g. a zero-latency stub row)
         if name in _HIGHER_IS_BETTER:
             floor = base * (1.0 - t)
@@ -137,6 +155,24 @@ def compare(baseline: dict, candidate: dict,
             failures.append(
                 f"{name}: {cand:g} > baseline {base:g} "
                 "(count metric: no tolerance)")
+    # The per-stage budgets: a regression here NAMES the stage that
+    # moved (wire vs device vs queue), which is the whole reason the
+    # waterfall exists. Stages only the candidate has are new work and
+    # gate nothing; stages only the baseline has went to zero — fine.
+    st = tol.get("stage_p95_us", 0.0)
+    base_stages = baseline.get("stages") or {}
+    cand_stages = candidate.get("stages") or {}
+    for name in sorted(base_stages):
+        base = base_stages.get(name, 0.0)
+        cand = cand_stages.get(name, 0.0)
+        if base <= 0:
+            continue
+        ceil = base * (1.0 + st)
+        if cand > ceil:
+            failures.append(
+                f"stage:{name}: p95 {cand:g}µs > {ceil:g}µs "
+                f"(baseline {base:g}µs, tolerance +{st:.0%}) — "
+                "this stage moved")
     return failures
 
 
@@ -144,12 +180,22 @@ def render(baseline: dict, candidate: dict, failures: list[str],
            out=None, prefix: str = "# slo") -> None:
     """The per-metric gate table, pass or fail, repo-`#`-line style."""
     out = out if out is not None else sys.stdout  # bound at CALL time
-    for name in sorted(set(DEFAULT_TOLERANCES) | set(COUNT_METRICS)):
+    names = sorted((set(DEFAULT_TOLERANCES) | set(COUNT_METRICS))
+                   - {"stage_p95_us"})
+    for name in names:
         base = baseline.get(name, 0.0)
         cand = candidate.get(name, 0.0)
         bad = any(f.startswith(name + ":") for f in failures)
         out.write(f"{prefix}: {name:<14} baseline={base:<10g} "
                   f"run={cand:<10g} {'FAIL' if bad else 'ok'}\n")
+    base_stages = baseline.get("stages") or {}
+    cand_stages = candidate.get("stages") or {}
+    for name in sorted(base_stages):
+        bad = any(f.startswith(f"stage:{name}:") for f in failures)
+        out.write(f"{prefix}: stage:{name:<14} "
+                  f"baseline={base_stages.get(name, 0.0):<10g} "
+                  f"run={cand_stages.get(name, 0.0):<10g} "
+                  f"{'FAIL' if bad else 'ok'}\n")
     for f in failures:
         out.write(f"{prefix}: REGRESSION {f}\n")
 

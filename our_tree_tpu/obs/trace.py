@@ -37,7 +37,7 @@ tables live in docs/OBSERVABILITY.md)::
      "argv":"...","start_us":...}
     {"ev":"b","id":"a1b2c3d4.1","parent":null,"name":"unit","ts":...,
      "tid":0,"attrs":{"unit":"ecb:65536"}}
-    {"ev":"e","id":"a1b2c3d4.1","ts":...,"status":"ok"}
+    {"ev":"e","id":"a1b2c3d4.1","ts":...,"status":"ok","attrs":{...}}
     {"ev":"c","name":"retry_failures","ts":...,"n":1,"attrs":{...}}
     {"ev":"g","name":"hbm_gib","ts":...,"value":1.5,"attrs":{...}}
     {"ev":"p","name":"fault-injected","ts":...,"attrs":{...}}
@@ -85,6 +85,11 @@ _COUNTS: dict[str, float] = {}
 _GAUGES: dict[str, float] = {}
 _SPANS_STARTED = 0
 _DROPPED = 0
+#: Bytes of trace history deleted by segment rotation (OT_TRACE_MAX_MB
+#: eviction). Truncation must be visible, never silent: the counter
+#: rides ``metrics_snapshot`` so a capped soak's artifacts say how much
+#: history the cap cost.
+_EVICTED_BYTES = 0
 
 _LOCK = threading.Lock()
 _TLS = threading.local()
@@ -140,6 +145,16 @@ def sample() -> bool:
 
 
 def _now_us() -> int:
+    return time.time_ns() // 1000
+
+
+def now_us() -> int:
+    """Epoch microseconds — the run's ONE cross-process clock (every
+    trace event's ``ts`` domain). Public for the wire handshake stamps
+    (serve/worker.py reply clocks, route/proxy.py skew estimation):
+    epoch time belongs to the tracer, and call sites that need it take
+    it from here instead of reading the wall clock themselves (the
+    otlint ``wallclock`` rule's contract)."""
     return time.time_ns() // 1000
 
 
@@ -277,9 +292,13 @@ def _rotate_locked(state: dict) -> None:
     state["segments"].append(old_path)
     # cap/4 per segment -> keep the active one + 3 closed: total <= cap.
     keep = max(int(state["cap_bytes"] // state["seg_bytes"]) - 1, 1)
+    global _EVICTED_BYTES
     while len(state["segments"]) > keep:
+        victim = state["segments"].pop(0)
         try:
-            os.unlink(state["segments"].pop(0))
+            size = os.path.getsize(victim)
+            os.unlink(victim)
+            _EVICTED_BYTES += size
         except OSError:
             break
 
@@ -376,9 +395,12 @@ class Span:
 
 
 class _SpanCM:
-    def __init__(self, name: str, attrs: dict, detached: bool = False):
+    def __init__(self, name: str, attrs: dict, detached: bool = False,
+                 parent: str | None = None):
         self._name, self._attrs = name, attrs
         self._detached = detached
+        self._parent_override = parent
+        self._end_attrs: dict | None = None
         self._span: Span | None = None
 
     def __enter__(self) -> Span | None:
@@ -390,8 +412,9 @@ class _SpanCM:
             st["seq"] += 1
             sid = f"{st['proc']}.{st['seq']}"
         stack = _stack()
-        parent = (stack[-1] if stack
-                  else os.environ.get("OT_TRACE_PARENT") or None)
+        parent = (self._parent_override
+                  or (stack[-1] if stack
+                      else os.environ.get("OT_TRACE_PARENT") or None))
         _SPANS_STARTED += 1
         rec = {"ev": "b", "id": sid, "parent": parent, "name": self._name,
                "ts": _now_us(), "tid": _tid()}
@@ -403,6 +426,14 @@ class _SpanCM:
         self._span = Span(sid, self._name)
         return self._span
 
+    def note(self, **attrs) -> None:
+        """Attach attrs to the span's END event — measurements only
+        known at close (device vs host time split, output sizes). The
+        begin event keeps the identity attrs; ``obs.export`` merges the
+        end attrs back into the reconstructed span."""
+        if attrs:
+            self._end_attrs = {**(self._end_attrs or {}), **attrs}
+
     def __exit__(self, exc_type, exc, tb):
         if self._span is None:
             return False
@@ -411,8 +442,11 @@ class _SpanCM:
             if stack and stack[-1] == self._span.id:
                 stack.pop()
         status = "ok" if exc_type is None else f"error:{exc_type.__name__}"
-        _write({"ev": "e", "id": self._span.id, "ts": _now_us(),
-                "status": status})
+        rec = {"ev": "e", "id": self._span.id, "ts": _now_us(),
+               "status": status}
+        if self._end_attrs:
+            rec["attrs"] = self._end_attrs
+        _write(rec)
         self._span = None  # idempotent: a second exit writes nothing
         return False
 
@@ -447,20 +481,24 @@ class _DeferredSpanCM:
     ``obs.report --check`` survives any sample rate.
     """
 
-    __slots__ = ("_name", "_attrs", "_ts", "_parent", "_span", "_done")
+    __slots__ = ("_name", "_attrs", "_ts", "_parent", "_span", "_done",
+                 "_parent_override", "_end_attrs")
 
-    def __init__(self, name: str, attrs: dict):
+    def __init__(self, name: str, attrs: dict, parent: str | None = None):
         self._name, self._attrs = name, attrs
         self._ts: int | None = None
         self._parent = None
+        self._parent_override = parent
+        self._end_attrs: dict | None = None
         self._span: Span | None = None
         self._done = False
 
     def __enter__(self):
         self._ts = _now_us()
         stack = getattr(_TLS, "stack", None)
-        self._parent = (stack[-1] if stack
-                        else os.environ.get("OT_TRACE_PARENT") or None)
+        self._parent = (self._parent_override
+                        or (stack[-1] if stack
+                            else os.environ.get("OT_TRACE_PARENT") or None))
         return None  # like a disabled span: no live Span handle
 
     def force(self) -> Span | None:
@@ -485,6 +523,13 @@ class _DeferredSpanCM:
         self._span = Span(sid, self._name)
         return self._span
 
+    def note(self, **attrs) -> None:
+        """End-event attrs (the ``_SpanCM.note`` surface): kept even on
+        the deferred path so a force-sampled span closes with the same
+        measurements a sampled one would."""
+        if attrs:
+            self._end_attrs = {**(self._end_attrs or {}), **attrs}
+
     def __exit__(self, exc_type, exc, tb):
         if self._done:
             return False
@@ -493,8 +538,11 @@ class _DeferredSpanCM:
         if self._span is not None:
             status = ("ok" if exc_type is None
                       else f"error:{exc_type.__name__}")
-            _write({"ev": "e", "id": self._span.id, "ts": _now_us(),
-                    "status": status})
+            rec = {"ev": "e", "id": self._span.id, "ts": _now_us(),
+                   "status": status}
+            if self._end_attrs:
+                rec["attrs"] = self._end_attrs
+            _write(rec)
         self._done = True
         self._span = None
         return False
@@ -512,6 +560,9 @@ class _NullCM:
     def force(self):
         return None
 
+    def note(self, **attrs):
+        return None
+
 
 _NULL = _NullCM()
 
@@ -525,7 +576,7 @@ def span(name: str, **attrs):
     return _SpanCM(name, attrs)
 
 
-def detached_span(name: str, **attrs):
+def detached_span(name: str, parent: str | None = None, **attrs):
     """A span that never joins the per-thread nesting stack.
 
     The serve path's lifecycle spans (``request-queued`` from admission
@@ -540,13 +591,20 @@ def detached_span(name: str, **attrs):
     exited is an ORPHAN: the serve dispatch loop abandons the span of a
     batch killed by the watchdog on purpose, so a hung dispatch leaves
     the same closed-by-kill evidence a SIGKILLed child does.
+
+    ``parent`` overrides the ambient parent (thread stack /
+    ``OT_TRACE_PARENT``) with an EXPLICIT span id — the cross-process
+    propagation hook: a backend's per-request span carries the ROUTER's
+    span id handed over the wire, so one request's spans chain across
+    the fleet (docs/OBSERVABILITY.md, fleet tracing).
     """
     if not enabled():
         return _NULL
-    return _SpanCM(name, attrs, detached=True)
+    return _SpanCM(name, attrs, detached=True, parent=parent)
 
 
-def maybe_span(sampled: bool, name: str, **attrs):
+def maybe_span(sampled: bool, name: str, parent: str | None = None,
+               **attrs):
     """A detached span gated by the request's head-sampling decision.
 
     ``sampled=True`` (or rate 1, the default) is exactly
@@ -563,8 +621,8 @@ def maybe_span(sampled: bool, name: str, **attrs):
     if not enabled():
         return _NULL
     if sampled:
-        return _SpanCM(name, attrs, detached=True)
-    return _DeferredSpanCM(name, attrs)
+        return _SpanCM(name, attrs, detached=True, parent=parent)
+    return _DeferredSpanCM(name, attrs, parent=parent)
 
 
 def current_span_id() -> str | None:
@@ -622,6 +680,8 @@ def metrics_snapshot() -> dict:
             snap["gauges"] = dict(sorted(_GAUGES.items()))
     if _DROPPED:
         snap["dropped"] = _DROPPED
+    if _EVICTED_BYTES:
+        snap["evicted_bytes"] = _EVICTED_BYTES
     return snap
 
 
@@ -645,7 +705,7 @@ def child_env(env: dict) -> dict:
 def reset_for_tests() -> None:
     """Close the event file and clear every aggregate (tests only — a
     real process's trace is a fact about this process)."""
-    global _SPANS_STARTED, _DROPPED
+    global _SPANS_STARTED, _DROPPED, _EVICTED_BYTES
     _close_state()
     with _LOCK:
         _COUNTS.clear()
@@ -653,4 +713,5 @@ def reset_for_tests() -> None:
         _TIDS.clear()
     _SPANS_STARTED = 0
     _DROPPED = 0
+    _EVICTED_BYTES = 0
     _TLS.stack = []

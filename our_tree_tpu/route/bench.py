@@ -117,7 +117,8 @@ def _spawn_backends(args, tag: str):
                     f"(got {line!r})")
             specs.append(BackendSpec(
                 name=f"b{i}", host="127.0.0.1", port=int(doc["port"]),
-                status_port=doc.get("status_port")))
+                status_port=doc.get("status_port"),
+                pid=doc.get("pid")))
             print(f"# backend b{i}: pid {h.pid} port {doc['port']} "
                   f"status {doc.get('status_port')} "
                   f"engine {doc.get('engine')} lanes {doc.get('lanes')}",
@@ -157,6 +158,61 @@ def _teardown(handles) -> tuple[list[dict], int]:
     return docs, worst
 
 
+#: Every stage a COMPLETE cross-process waterfall carries (router +
+#: backend halves of the per-request ledger) — the shared vocabulary,
+#: so this gate and the report's fleet table can never drift apart.
+WATERFALL_STAGES = metrics.WATERFALL_STAGES
+
+
+def waterfall_stats(ledgers: list, tolerance: float = 0.05) -> dict:
+    """Aggregate the sampled requests' time-attribution ledgers: how
+    many reconstruct a COMPLETE cross-process waterfall (backend half
+    arrived and every stage present), how many of those have a stage
+    sum within ``tolerance`` of the measured end-to-end latency, and
+    per-stage p50/p95/p99 over the complete population (the artifact's
+    ``stages`` section, which the SLO per-stage budgets gate).
+
+    What the sum check can and cannot catch: the ``wire`` and host
+    ``dispatch`` stages are RESIDUALS of the same clock readings that
+    produce ``total_us``, so genuinely unmeasured work folds into them
+    by design (that is what makes the stages exhaustive). The check
+    therefore guards against OVERCOUNTING — a stage double-booked
+    across the wire, clamp saturation when the backend reports more
+    time than the router observed, µs-truncation drift — not against
+    an unmeasured stage, which cannot exist by construction."""
+    complete = [
+        l for l in ledgers
+        if l.get("complete")
+        and all(s in l.get("stages", {}) for s in WATERFALL_STAGES)]
+    sum_ok = 0
+    per_stage: dict[str, list] = {s: [] for s in WATERFALL_STAGES}
+    for l in complete:
+        stages, total = l["stages"], l.get("total_us", 0)
+        if total > 0 and abs(sum(stages.values()) - total) \
+                <= tolerance * total:
+            sum_ok += 1
+        for s in WATERFALL_STAGES:
+            per_stage[s].append(stages[s])
+    stages_out = {}
+    for s, vals in per_stage.items():
+        vals.sort()
+        stages_out[s] = {
+            "p50_us": metrics.percentile_exact(vals, 50),
+            "p95_us": metrics.percentile_exact(vals, 95),
+            "p99_us": metrics.percentile_exact(vals, 99),
+            "count": len(vals),
+        }
+    n, nc = len(ledgers), len(complete)
+    return {
+        "sampled": n,
+        "complete": nc,
+        "complete_frac": round(nc / n, 4) if n else 0.0,
+        "sum_within_tol_frac": round(sum_ok / nc, 4) if nc else 0.0,
+        "tolerance": tolerance,
+        "stages": stages_out,
+    }
+
+
 def _keycache_ratio(exit_docs: list[dict]) -> float:
     """Aggregate backend keycache hit ratio: hits / (hits + misses)
     summed across every backend's exit ledger — the affinity A/B's
@@ -185,9 +241,11 @@ async def _drive(args, specs, affinity: bool, probes):
     await router.start()
     status = None
     if args.status_port is not None and affinity:
-        status = RouterStatus(router, args.status_port)
+        status = RouterStatus(router, args.status_port,
+                              federate=not args.no_federate)
         await status.start()
-        print(f"# router status: 127.0.0.1:{status.port}",
+        print(f"# router status: 127.0.0.1:{status.port} "
+              f"(federated /metrics: {not args.no_federate})",
               file=sys.stderr)
     report = await loadgen.run(
         router, args.requests, concurrency=args.concurrency,
@@ -265,7 +323,25 @@ def main(argv=None) -> int:
     ap.add_argument("--status-port", type=int, default=None, metavar="PORT",
                     help="router /metrics + /healthz (with the "
                          "ring/backend membership view) for the drive's "
-                         "duration (0 = ephemeral)")
+                         "duration (0 = ephemeral). /metrics is the "
+                         "FEDERATED fleet scrape by default: the "
+                         "router's registry plus every backend's, "
+                         "relabeled backend=<name> (docs/SERVING.md)")
+    ap.add_argument("--no-federate", action="store_true",
+                    help="serve only the router's own /metrics (no "
+                         "backend federation)")
+    ap.add_argument("--min-waterfall-complete", type=float, default=None,
+                    metavar="FRAC",
+                    help="fail unless at least FRAC of the sampled "
+                         "requests reconstructed a COMPLETE cross-"
+                         "process waterfall (router + backend ledger "
+                         "halves, every stage present)")
+    ap.add_argument("--min-stage-sum-ok", type=float, default=None,
+                    metavar="FRAC",
+                    help="fail unless at least FRAC of the complete "
+                         "waterfalls have a stage sum within 5%% of the "
+                         "measured end-to-end latency (the attribution "
+                         "consistency gate)")
     ap.add_argument("--slo", default=None, metavar="BASELINE.json",
                     help="gate this run against a committed "
                          "ROUTE_r*.json baseline (obs/slo.py)")
@@ -350,6 +426,7 @@ def main(argv=None) -> int:
     backend_quarantines = sum(d.get("quarantines", 0) for d in exit_docs)
     kc_ratio = _keycache_ratio(exit_docs)
     releases = router.release_events()
+    waterfall = waterfall_stats(report.ledgers)
 
     print(f"# route: backends={args.backends} affinity={affinity} "
           f"vnodes={args.vnodes} tenants={args.tenants} "
@@ -375,9 +452,23 @@ def main(argv=None) -> int:
     for name, b in sorted(rstats["backends"].items()):
         tr = "".join(f" [{t['prev']}->{t['to']}:{t['why']}]"
                      for t in b["transitions"])
+        skew = (f" skew={b['skew_us']:+d}µs"
+                if b.get("skew_us") is not None else "")
         print(f"#   backend {name} ({b['addr']}): "
               f"{b['dispatches']} dispatch(es), {b['bytes']} bytes, "
-              f"state={b['state']}{tr}")
+              f"state={b['state']}{skew}{tr}")
+    if waterfall["sampled"]:
+        print(f"# waterfall: {waterfall['complete']}/"
+              f"{waterfall['sampled']} sampled requests complete "
+              f"({waterfall['complete_frac']:.1%}), stage sum within "
+              f"{waterfall['tolerance']:.0%} of e2e on "
+              f"{waterfall['sum_within_tol_frac']:.1%} of them")
+        for s in WATERFALL_STAGES:
+            st = waterfall["stages"].get(s)
+            if st and st["count"]:
+                print(f"#   stage {s:<13} p50={st['p50_us']:>8.0f}µs "
+                      f"p95={st['p95_us']:>8.0f}µs "
+                      f"p99={st['p99_us']:>8.0f}µs  (n={st['count']})")
 
     artifact = {
         "config": {
@@ -405,6 +496,11 @@ def main(argv=None) -> int:
             "random_keycache_hit_ratio": (
                 control["keycache_hit_ratio"] if control else None),
         },
+        # The cross-process time-attribution waterfall (sampled ledger
+        # population) and its per-stage percentiles — the SLO gate's
+        # "stages" section, so a regression names which stage moved.
+        "waterfall": waterfall,
+        "stages": waterfall["stages"],
         "control": control,
         "healthz": healthz,
         "degraded": degrade.events(),
@@ -441,7 +537,9 @@ def main(argv=None) -> int:
             "recompiles": recompiles,
             "mismatches": report.mismatches,
             "affinity_ratio": rstats["affinity"]["ratio"],
-            "keycache_hit_ratio": kc_ratio}
+            "keycache_hit_ratio": kc_ratio,
+            "waterfall_complete_frac": waterfall["complete_frac"],
+            "waterfall_sum_ok_frac": waterfall["sum_within_tol_frac"]}
     if control:
         line["keycache_hit_ratio_random"] = control["keycache_hit_ratio"]
     if args.slo:
@@ -501,6 +599,23 @@ def main(argv=None) -> int:
                   f"{floor:g} — key affinity bought nothing",
                   file=sys.stderr)
             rc = 1
+    if (args.min_waterfall_complete is not None
+            and waterfall["complete_frac"] < args.min_waterfall_complete):
+        print(f"# FAIL: only {waterfall['complete_frac']:.1%} of sampled "
+              f"requests reconstructed a complete cross-process "
+              f"waterfall (< {args.min_waterfall_complete:.1%}) — the "
+              "ledger propagation broke somewhere on the wire",
+              file=sys.stderr)
+        rc = 1
+    if (args.min_stage_sum_ok is not None
+            and waterfall["sum_within_tol_frac"] < args.min_stage_sum_ok):
+        print(f"# FAIL: stage sums match end-to-end latency on only "
+              f"{waterfall['sum_within_tol_frac']:.1%} of complete "
+              f"waterfalls (< {args.min_stage_sum_ok:.1%}) — a stage "
+              "is being double-counted across the wire (or clamps are "
+              "saturating: the backend reports more time than the "
+              "router observed)", file=sys.stderr)
+        rc = 1
     if slo_rc:
         print(f"# FAIL: SLO regression against {args.slo}",
               file=sys.stderr)

@@ -109,6 +109,9 @@ class BackendSpec:
     host: str
     port: int
     status_port: int | None = None
+    #: the backend's process id when the deployer knows it (the READY
+    #: line carries it) — pre-seeds the clock-skew ledger's pid mapping
+    pid: int | None = None
 
 
 class Backend:
@@ -132,6 +135,13 @@ class Backend:
         self.sheds_seen = 0
         self.canaries = 0
         self.last_healthz: dict | None = None
+        #: the backend's process id, learned from response frames (the
+        #: wire handshake) — keys the clock-skew estimate to the trace
+        #: files that pid wrote
+        self.pid: int | None = spec.pid
+        #: estimated backend-clock minus router-clock offset (µs), from
+        #: canary exchanges: skew = reply ts - exchange midpoint
+        self.skew_us: int | None = None
 
     # -- the framed-request seam -------------------------------------------
     async def exchange(self, header: dict, payload: bytes,
@@ -176,13 +186,48 @@ class Backend:
         return doc
 
     async def _get_healthz(self) -> dict | None:
+        body = await self._get_status("/healthz")
+        if body is None:
+            return None
+        doc = json.loads(body)
+        return doc if isinstance(doc, dict) else None
+
+    async def poll_metrics_text(self, timeout_s: float = 2.0) -> str | None:
+        """GET /metrics off the backend's status port — the federation
+        scrape (route/status.py folds every backend's registry into one
+        fleet /metrics document). None on any failure: a missing
+        backend simply contributes no series, flagged by the federator."""
+        if not self.spec.status_port:
+            return None
+        try:
+            body = await asyncio.wait_for(self._get_status("/metrics"),
+                                          timeout=max(timeout_s, 0.001))
+        except Exception:  # noqa: BLE001 - unreachable IS the data point
+            return None
+        return body.decode("utf-8", "replace") if body is not None else None
+
+    async def _get_status(self, path: str) -> bytes | None:
+        """One HTTP GET against the backend's status port (the gossip
+        and federation scrapes share it); None on a non-200. The
+        response is read to EOF (the endpoint answers Connection:
+        close), NOT with one read() — a /metrics body past one TCP
+        segment would otherwise come back truncated mid-line — with a
+        hard size cap so a misbehaving peer cannot balloon the router."""
         reader, writer = await asyncio.open_connection(
             self.spec.host, self.spec.status_port)
         try:
-            writer.write(b"GET /healthz HTTP/1.1\r\n"
-                         b"Host: backend\r\nConnection: close\r\n\r\n")
+            writer.write(f"GET {path} HTTP/1.1\r\n".encode("latin-1")
+                         + b"Host: backend\r\nConnection: close\r\n\r\n")
             await writer.drain()
-            raw = await reader.read(1 << 20)
+            chunks: list[bytes] = []
+            total = 0
+            while total < (1 << 24):
+                chunk = await reader.read(1 << 16)
+                if not chunk:
+                    break
+                chunks.append(chunk)
+                total += len(chunk)
+            raw = b"".join(chunks)
         finally:
             try:
                 writer.close()
@@ -191,8 +236,7 @@ class Backend:
         head, _, body = raw.partition(b"\r\n\r\n")
         if not head.startswith(b"HTTP/1.1 200"):
             return None
-        doc = json.loads(body)
-        return doc if isinstance(doc, dict) else None
+        return body
 
     def stats(self) -> dict:
         return {
@@ -202,6 +246,7 @@ class Backend:
             "failures": self.failures, "timeouts": self.timeouts,
             "redispatches_in": self.redispatches_in,
             "sheds_seen": self.sheds_seen, "canaries": self.canaries,
+            "pid": self.pid, "skew_us": self.skew_us,
             **self.health.stats(),
         }
 
@@ -333,9 +378,15 @@ class Router:
 
     async def _canary_once(self, b: Backend) -> bytes | None:
         """One canary exchange on ``b`` (startup pinning and quarantine
-        probing share it); None on any failure or timeout."""
+        probing share it); None on any failure or timeout. Doubles as
+        the CLOCK-SKEW handshake: every response frame carries the
+        backend's epoch-µs clock, and the canary's request/response
+        midpoint estimates the offset between the two processes' clocks
+        (traced as ``wire-skew`` — what ``obs.export`` aligns the
+        merged Perfetto timeline with)."""
         b.canaries += 1
         with trace.detached_span("backend-probe", backend=b.idx) as _:
+            t_send = trace.now_us()
             try:
                 header, body = await b.exchange(
                     {"t": CANARY_TENANT, "k": CANARY_KEY.hex(),
@@ -345,11 +396,37 @@ class Router:
                 metrics.counter("route_canary", backend=b.idx,
                                 outcome="failed")
                 return None
+            t_recv = trace.now_us()
+        self._note_handshake(b, header, t_send, t_recv)
         if not header.get("ok"):
             metrics.counter("route_canary", backend=b.idx, outcome="refused")
             return None
         metrics.counter("route_canary", backend=b.idx, outcome="ok")
         return body
+
+    def _note_handshake(self, b: Backend, header: dict,
+                        t_send: int, t_recv: int) -> None:
+        """Fold one response frame's clock stamps into the backend's
+        skew estimate. With both the receive ("tr") and reply ("ts")
+        stamps this is the NTP four-timestamp offset —
+        ``((tr - send) + (ts - recv)) / 2`` — which cancels the
+        backend's processing time; with only "ts" it degrades to the
+        midpoint estimator (biased by half the service time, still
+        bounded by the round trip)."""
+        ts = header.get("ts")
+        if not isinstance(ts, int):
+            return
+        pid = header.get("pid")
+        if isinstance(pid, int):
+            b.pid = pid
+        tr = header.get("tr")
+        if isinstance(tr, int):
+            skew = int(((tr - t_send) + (ts - t_recv)) // 2)
+        else:
+            skew = int(ts - (t_send + t_recv) // 2)
+        b.skew_us = skew
+        trace.point("wire-skew", backend=b.idx, pid=b.pid,
+                    skew_us=skew, rtt_us=int(t_recv - t_send))
 
     async def stop(self) -> None:
         """Graceful drain: stop gossip, close admission (new submits
@@ -503,17 +580,55 @@ class Router:
 
     async def _route(self, tenant: str, key: bytes, nonce: bytes, payload,
                      deadline_s: float | None) -> Response:
-        c = self.config
+        """The per-request wrapper: one head-sampling decision at ROUTER
+        admission governs the whole cross-process chain, and the
+        ``route-request`` span minted here is the chain's ROOT — its id
+        travels over the wire ("ps") so the backend's ``request-queued``
+        span chains under it, which is what lets ``obs.report`` join one
+        request's story across processes."""
         data = (payload.tobytes() if hasattr(payload, "tobytes")
                 else bytes(payload))
+        sampled = trace.sample()
+        cm = trace.maybe_span(sampled, "route-request", tenant=tenant,
+                              blocks=len(data) // 16)
+        span = cm.__enter__()
+        try:
+            resp = await self._route_attempts(
+                tenant, key, nonce, data, deadline_s, sampled,
+                span.id if span is not None else None)
+        except BaseException as e:
+            cm.__exit__(type(e), e, None)
+            raise
+        if resp.ledger is not None:
+            cm.note(total_us=resp.ledger.get("total_us"),
+                    complete=resp.ledger.get("complete"))
+        cm.__exit__(None, None, None)
+        return resp
+
+    async def _route_attempts(self, tenant: str, key: bytes, nonce: bytes,
+                              data: bytes, deadline_s: float | None,
+                              sampled: bool, ps: str | None) -> Response:
+        c = self.config
         aff = ring_mod.affinity_key(tenant, key)
         self._track(aff)
         budget = Budget(c.deadline_s if deadline_s is None
                         else float(deadline_s), clock=self._clock)
         header = {"t": tenant, "k": key.hex(), "n": nonce.hex(),
                   "deadline_s": round(budget.total_s, 3) or None}
+        if sampled:
+            # Propagate the admission decision + span parentage + the
+            # ledger request over the wire (serve/wire.py): the
+            # backend's spans and its per-request time-attribution
+            # ledger join THIS request's story.
+            header["sm"] = True
+            header["lg"] = True
+            if ps:
+                header["ps"] = ps
+        else:
+            header["sm"] = False
         label = aff[-6:]
-        sampled = trace.sample()
+        t_admit = self._clock()
+        t_first: float | None = None
         order = self._order_for(aff)
         primary = order[0] if order else None
         causes: list = []
@@ -559,10 +674,19 @@ class Router:
             # rule) — first attempts of unsampled requests ride a
             # deferred span, free when they complete clean.
             cm = trace.maybe_span(sampled or redispatch, "route-dispatch",
+                                  parent=ps,
                                   backend=b.idx, bucket=len(data) // 16,
                                   redispatch=redispatch)
             cm.__enter__()
             t0 = self._clock()
+            if t_first is None:
+                # Router-queue stage closes at the FIRST attempt:
+                # placement, tracking, and any pre-attempt rescue work
+                # are what this request waited on inside the router.
+                t_first = t0
+                metrics.observe("route_stage_us",
+                                (t_first - t_admit) * 1e6,
+                                stage="router_queue")
             outcome = "ok"
             try:
                 faults.check_backend("backend_fail", b.idx, label)
@@ -603,6 +727,7 @@ class Router:
                 dt_us = int((self._clock() - t0) * 1e6)
                 metrics.observe("route_dispatch_us", dt_us,
                                 backend=b.idx, outcome=outcome)
+            t_att_end = self._clock()
             cm.__exit__(None, None, None)
             err = rh.get("error")
             if not rh.get("ok") and err == ERR_SHED:
@@ -640,6 +765,8 @@ class Router:
                 metrics.counter("route_redispatch", backend=b.idx)
                 trace.counter("route_redispatch", backend=b.idx,
                               after=len(tried))
+            ledger = self._build_ledger(sampled, rh, b.idx, t_admit,
+                                        t_first, t0, t_att_end)
             if rh.get("ok"):
                 self.routed_ok += 1
                 b.bytes_out += len(body)
@@ -651,10 +778,54 @@ class Router:
                     metrics.counter("route_affinity", outcome="miss")
                 return Response(ok=True,
                                 payload=np.frombuffer(body, np.uint8),
-                                batch=rh.get("batch"))
+                                batch=rh.get("batch"), ledger=ledger)
             return Response(ok=False, error=err,
                             detail=str(rh.get("detail", "")),
-                            batch=rh.get("batch"))
+                            batch=rh.get("batch"), ledger=ledger)
+
+    def _build_ledger(self, sampled: bool, rh: dict, backend: int,
+                      t_admit: float, t_first: float,
+                      t0: float, t_att_end: float) -> dict | None:
+        """The request's cross-process time-attribution ledger (µs),
+        assembled at answer time for SAMPLED requests: the router's own
+        stages — ``router_queue`` (admission -> first attempt),
+        ``retry`` (first attempt -> final attempt: failed walls, shed
+        backoffs, rescue probes; 0 on the healthy path), ``wire``
+        (final attempt wall minus the backend's measured residency:
+        connect + frames both ways) — merged with the backend's stages
+        shipped back in the response ("lg": backend_queue, pack,
+        worker_wait, dispatch, device, reply). Stages are contiguous
+        and disjoint by construction, so their sum tracks the router's
+        measured end-to-end latency — ``route.bench`` gates the sum
+        within tolerance and the fleet report renders the waterfall.
+        ``complete`` says whether the backend half actually arrived."""
+        if not sampled:
+            return None
+        att_wall = int((t_att_end - t0) * 1e6)
+        stages = {"router_queue": int((t_first - t_admit) * 1e6),
+                  "retry": int((t0 - t_first) * 1e6)}
+        lg = rh.get("lg")
+        complete = (isinstance(lg, dict)
+                    and isinstance(lg.get("stages"), dict))
+        if complete:
+            backend_total = int(lg.get("total_us", 0))
+            stages["wire"] = max(att_wall - backend_total, 0)
+            for name, v in lg["stages"].items():
+                stages[str(name)] = int(v)
+        else:
+            stages["wire"] = att_wall
+        metrics.observe("route_stage_us", stages["wire"], stage="wire")
+        if stages["retry"]:
+            metrics.observe("route_stage_us", stages["retry"],
+                            stage="retry")
+        # total closes at the exchange end — the boundary the stages
+        # cover. The router's post-answer bookkeeping (span write,
+        # counters) happens after every stage clock stopped; folding it
+        # into total but no stage would charge the ledger a phantom
+        # residue on every small request.
+        return {"stages": stages,
+                "total_us": int((t_att_end - t_admit) * 1e6),
+                "complete": complete, "backend": backend}
 
     def _pick(self, order: list[str], tried: set[str]) -> str | None:
         """The next untried PLACEABLE backend in the request's order

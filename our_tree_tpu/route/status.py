@@ -21,15 +21,91 @@ the router above it.
 
 from __future__ import annotations
 
+import asyncio
+import re
+
 from ..serve.status import HttpStatusEndpoint
+
+#: One Prometheus sample line: name, optional {labels}, value tail.
+_PROM_LINE = re.compile(r"^([A-Za-z_:][A-Za-z0-9_:]*)(\{[^}]*\})?( .*)$")
+
+
+def relabel_prometheus(text: str, **labels) -> str:
+    """Inject ``labels`` into every sample line of a Prometheus text
+    document (comments/TYPE lines pass through) — the federation
+    rewrite: a backend's ``serve_requests_total`` becomes
+    ``serve_requests_total{backend="b1"}`` in the fleet scrape, so N
+    backends' identical series stay distinguishable in one document."""
+    extra = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    out = []
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            out.append(line)
+            continue
+        m = _PROM_LINE.match(line)
+        if m is None:
+            out.append(line)
+            continue
+        name, lab, tail = m.groups()
+        if lab:
+            out.append(f"{name}{{{lab[1:-1]},{extra}}}{tail}")
+        else:
+            out.append(f"{name}{{{extra}}}{tail}")
+    return "\n".join(out) + ("\n" if text.endswith("\n") else "")
 
 
 class RouterStatus(HttpStatusEndpoint):
-    """/metrics + /healthz for a ``route.proxy.Router``."""
+    """/metrics + /healthz for a ``route.proxy.Router``.
 
-    def __init__(self, router, port: int, host: str = "127.0.0.1"):
+    With ``federate=True`` (the default), ``/metrics`` is the FLEET
+    scrape: the router's own registry plus every backend's ``/metrics``
+    — fetched concurrently through the proxy seam
+    (``Backend.poll_metrics_text``, the one backend-contact module) and
+    relabeled with ``backend="<name>"`` so per-backend series stay
+    distinguishable. One scrape target observes the whole per-host
+    fleet; a backend that fails its scrape contributes a
+    ``route_federate_scrape{backend=...,outcome=failed}``-style marker
+    line instead of silently vanishing."""
+
+    def __init__(self, router, port: int, host: str = "127.0.0.1",
+                 federate: bool = True):
         super().__init__(port, host)
         self._router = router
+        self.federate = bool(federate)
+
+    async def metrics_text_async(self) -> str:
+        own = self.metrics_text()
+        if not self.federate:
+            return own
+        backends = [(name, b)
+                    for name, b in sorted(self._router.backends.items())
+                    if b.spec.status_port]
+        texts = await asyncio.gather(
+            *(b.poll_metrics_text() for _, b in backends),
+            return_exceptions=True)
+        parts = [own.rstrip("\n")]
+        up: list[str] = []
+        for (name, _b), text in zip(backends, texts):
+            ok = isinstance(text, str) and bool(text)
+            up.append(f'ot_route_federate_up{{backend="{name}"}} '
+                      f'{1 if ok else 0}')
+            if not ok:
+                continue
+            parts.append(f'# federated from backend="{name}"')
+            # Backend COMMENT lines are dropped: N backends' documents
+            # each carry '# TYPE serve_*' headers, and a strict
+            # Prometheus parser rejects a second TYPE line for a family
+            # (and split, non-contiguous family groups). The federated
+            # series ride untyped — legal, and unambiguous since every
+            # sample line is relabeled backend="<name>".
+            parts.append("\n".join(
+                ln for ln in relabel_prometheus(text, backend=name)
+                .splitlines() if ln and not ln.startswith("#")))
+        # One contiguous family for the liveness markers (the text
+        # format requires a family's samples in one group).
+        parts.append("# TYPE ot_route_federate_up gauge")
+        parts.extend(up)
+        return "\n".join(parts) + "\n"
 
     def healthz(self) -> dict:
         r = self._router

@@ -127,6 +127,10 @@ class Batch:
     #: native tier's per-request C CTR path consumes this instead of
     #: the materialised counter array (models.aes ``native_runs``)
     runs: list | None = field(default=None, repr=False)
+    #: batch-level time-attribution windows (µs), filled by the server
+    #: as the batch moves through pack -> dispatch -> reply — the
+    #: shared stages of every rider's per-request ledger
+    stages: dict = field(default_factory=dict, repr=False)
 
     @property
     def label(self) -> str:

@@ -87,6 +87,8 @@ async def _drive(args, probes):
         native_threads=args.native_threads,
         max_depth=args.queue_depth,
         tenant_depth_frac=args.tenant_depth_frac,
+        low_priority_tenants=tuple(args.low_priority_tenant or ()),
+        priority_depth_frac=args.priority_depth_frac,
         request_deadline_s=args.deadline,
         dispatch_deadline_s=args.dispatch_deadline,
         retries=args.retries,
@@ -182,6 +184,15 @@ def main(argv=None) -> int:
                          "sheds itself (serve_shed{reason=tenant}) while "
                          "other tenants keep being admitted (1.0 = "
                          "global shed only)")
+    ap.add_argument("--low-priority-tenant", action="append", default=None,
+                    metavar="TENANT",
+                    help="mark TENANT low priority: sheds first past "
+                         "--priority-depth-frac of the queue "
+                         "(serve_shed{reason=priority}; repeatable)")
+    ap.add_argument("--priority-depth-frac", type=float, default=0.5,
+                    metavar="FRAC",
+                    help="queue-depth fraction past which low-priority "
+                         "requests shed (1.0 disables the tier split)")
     ap.add_argument("--deadline", type=float, default=30.0,
                     help="per-request residency deadline, seconds")
     ap.add_argument("--dispatch-deadline", type=float,
@@ -234,6 +245,12 @@ def main(argv=None) -> int:
                          "the repo root)")
     ap.add_argument("--allow-recompiles", action="store_true",
                     help="do not fail on post-warmup backend compiles")
+    ap.add_argument("--ceiling-gbps", type=float, default=None,
+                    metavar="GBPS",
+                    help="the device roofline to report utilization "
+                         "against (scripts/vpu_ceiling.py names it for "
+                         "a measured TPU): the artifact's device "
+                         "section records device-time goodput / GBPS")
     ap.add_argument("--min-coalesce", type=float, default=None,
                     metavar="FRAC",
                     help="fail (exit 1) if coalesce_efficiency — payload "
@@ -329,6 +346,36 @@ def main(argv=None) -> int:
               f"({sum(disp.values())} obs)  "
               f"queue_depth_peak={stats['queue'].get('depth_peak', 0)}  "
               f"requests={metrics.counter_total('serve_requests'):.0f}")
+    # Device-time accounting (serve/lanes.py): the block-until-ready
+    # fence / native engine-compute window, summed across lanes and
+    # split out from host busy time — with the served bytes over it as
+    # device-time goodput, reportable against the roofline
+    # (scripts/vpu_ceiling.py) to say how much of the gap to the
+    # offline BENCH_r* number is device vs host/queue/wire.
+    stages = metrics.stage_percentiles()
+    device_s = sum(row.get("device_s", 0.0) for row in lanes["per_lane"])
+    busy_s = sum(row.get("busy_s", 0.0) for row in lanes["per_lane"])
+    served_bytes = metrics.counter_total("serve_served_bytes")
+    device_gbps = (served_bytes / 1e9 / device_s) if device_s > 0 else 0.0
+    device = {
+        "device_s": round(device_s, 6),
+        "busy_s": round(busy_s, 6),
+        "host_s": round(max(busy_s - device_s, 0.0), 6),
+        "device_gbps": round(device_gbps, 4),
+        "ceiling_gbps": args.ceiling_gbps,
+        "utilization": (round(device_gbps / args.ceiling_gbps, 4)
+                        if args.ceiling_gbps else None),
+    }
+    print(f"# device: device_s={device['device_s']:.3f} "
+          f"host_s={device['host_s']:.3f} "
+          f"device_goodput={device_gbps:.4f} GB/s"
+          + (f" utilization={device['utilization']:.1%} of "
+             f"{args.ceiling_gbps:g} GB/s roofline"
+             if args.ceiling_gbps else ""))
+    if stages:
+        print("# stages: " + "  ".join(
+            f"{s}:p95={st['p95_us']:.0f}µs"
+            for s, st in stages.items()))
 
     artifact = {
         "config": {
@@ -355,6 +402,11 @@ def main(argv=None) -> int:
         "queue": stats["queue"],
         "keycache": stats["keycache"],
         "compiles": stats["compiles"],
+        # The time-attribution stages (serve_stage_us{stage=...}, exact
+        # at any sample rate) and the device-time split — the
+        # saturation-run decomposition surface (docs/OBSERVABILITY.md).
+        "stages": stages,
+        "device": device,
         "degraded": degrade.events(),
         # The full registry snapshot: exact counters/gauges + log2
         # histogram buckets per label set — present traced or not (the

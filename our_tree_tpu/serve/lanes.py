@@ -151,6 +151,10 @@ class Lane:
         #: time (the bench's per-lane busy-fraction numerator).
         self.inflight = 0
         self.busy_us = 0
+        #: cumulative DEVICE time (the block-until-ready fence window on
+        #: jax engines; the C engine-compute window on the native tier)
+        #: — the half of busy_us that is compute, not host overhead
+        self.device_us = 0
         self.executor: LaneExecutor | None = None
         self._clock = clock
         self._t0 = clock()
@@ -237,7 +241,8 @@ class Lane:
 
     # -- the ONE device-dispatch seam in serve/ ----------------------------
     def engine_call(self, words, ctr_words, sched, key_slots, label: str,
-                    warmup: bool = False, runs=None):
+                    warmup: bool = False, runs=None,
+                    timing: dict | None = None):
         """One MULTI-KEY scattered-CTR dispatch on THIS lane's device,
         under this lane's watchdog deadline. ``sched`` is the keycache's
         StackedSchedules view (K expanded schedules, zero rows in unused
@@ -280,11 +285,21 @@ class Lane:
                 # generated inside C, no (N, 4) array ever exists —
                 # warmup/canary calls pass explicit arrays instead
                 # (runs=None) and take the scattered counter path.
-                return np.asarray(aes.ctr_crypt_words_scattered_multikey(
+                t_eng = self._clock()
+                out = np.asarray(aes.ctr_crypt_words_scattered_multikey(
                     words, ctr_words, sched.rks, key_slots, sched.nr,
                     self.engine, native_ctxs=sched.native_ctxs(),
                     native_threads=self.native_threads,
                     native_runs=runs))
+                if timing is not None:
+                    # The host tier has no device, but the C engine-
+                    # compute window is the same ledger stage: "time the
+                    # cipher itself took", distinct from staging,
+                    # watchdog, and retry overhead around it.
+                    self.device_us += (d_us := int(
+                        (self._clock() - t_eng) * 1e6))
+                    timing["device_us"] = d_us
+                return out
             w, c, r, s = words, ctr_words, sched.rks, key_slots
             if self.device is not None:
                 w = jax.device_put(w, self.device)
@@ -293,7 +308,20 @@ class Lane:
                 s = jax.device_put(s, self.device)
             out = aes.ctr_crypt_words_scattered_multikey(
                 w, c, r, s, sched.nr, self.engine)
+            # Device-time accounting: jax dispatch is ASYNC — the call
+            # above returns once the program is enqueued (host: cache
+            # lookup + launch), and the block-until-ready fence below is
+            # where device compute is actually waited out. The fence
+            # window is the ledger's "device" stage (an upper bound that
+            # excludes host work by construction; transfer rides it on
+            # committed inputs). jax.profiler hooks can refine it on a
+            # real TPU, but the fence split is engine-independent.
+            t_fence = self._clock()
             jax.block_until_ready(out)
+            if timing is not None:
+                self.device_us += (d_us := int(
+                    (self._clock() - t_fence) * 1e6))
+                timing["device_us"] = d_us
         return np.asarray(out)
 
     def stats(self) -> dict:
@@ -306,6 +334,7 @@ class Lane:
             "redispatches_in": self.redispatches_in,
             "canaries": self.canaries,
             "busy_s": round(self.busy_us / 1e6, 6),
+            "device_s": round(self.device_us / 1e6, 6),
             "abandoned_workers": (self.executor.abandoned
                                   if self.executor is not None else 0),
             "transitions": list(self.transitions),
@@ -556,7 +585,7 @@ class LanePool:
     # -- dispatch with failover --------------------------------------------
     async def dispatch(self, words, ctr_words, sched, key_slots, label: str,
                        bucket: int, blocks: int, requests: int, runs=None,
-                       sampled: bool = True):
+                       sampled: bool = True, timing: dict | None = None):
         """Place and run one batch, failing over across lanes until it
         succeeds or every lane has been tried. ``sched``/``key_slots``
         are the multi-key pair (keycache.StackedSchedules + per-block
@@ -564,6 +593,14 @@ class LanePool:
         Raises LanesExhausted when no lane could serve it — only then
         may the caller answer per-request errors
         (re-dispatch-before-error is the failover contract).
+
+        ``timing``, when a dict, is filled with the batch's
+        time-attribution windows (µs): ``worker_wait_us`` (executor
+        queue residency of the final attempt), ``device_us`` (the
+        block-until-ready fence / native engine-compute window), and
+        ``total_us`` (first placement to success, failover included) —
+        the server folds them into the per-request ledger and the
+        ``serve_stage_us{stage=...}`` histograms.
 
         Awaitable, for overlap: the guarded engine call (with its
         on-lane RetryPolicy) runs on the placed lane's worker executor,
@@ -577,6 +614,7 @@ class LanePool:
         LanesExhausted surface."""
         causes: list = []
         tried: set[int] = set()
+        t_place0 = self.lanes[0]._clock() if self.lanes else 0.0
         while True:
             # Capture the pulse BEFORE placing: a completion landing
             # between a failed placement and the await still wakes us.
@@ -619,12 +657,22 @@ class LanePool:
             self._inflight(+1)
             t0 = lane._clock()
             outcome = "ok"
+            attempt_timing: dict = {}
+
+            def unit(lane=lane, attempt_timing=attempt_timing, t0=t0):
+                # First line ON the worker thread: executor-queue
+                # residency (submit -> unit start) — the ledger's
+                # worker_wait stage, per batch.
+                attempt_timing["worker_wait_us"] = int(
+                    (lane._clock() - t0) * 1e6)
+                return lane.policy.run(
+                    lambda att: lane.engine_call(words, ctr_words,
+                                                 sched, key_slots,
+                                                 label, runs=runs,
+                                                 timing=attempt_timing))
+
             try:
-                out = await lane.run_async(
-                    lambda: lane.policy.run(
-                        lambda att: lane.engine_call(words, ctr_words,
-                                                     sched, key_slots,
-                                                     label, runs=runs)))
+                out = await lane.run_async(unit)
             except watchdog.DispatchTimeout as e:
                 # The dispatch never ended: the span is ABANDONED, not
                 # closed — its orphaned begin is the kill evidence
@@ -666,7 +714,27 @@ class LanePool:
                 metrics.counter("serve_lane_busy_us", dt_us,
                                 lane=lane.idx)
                 self._notify_change()
+            # The dispatch window's host/device split (device-time
+            # accounting): the span's END event carries it — distinct
+            # fields, so a Perfetto/report reader can say how much of a
+            # dispatch bar was compute vs host overhead — and the
+            # stage histograms stay exact at any sample rate.
+            device_us = int(attempt_timing.get("device_us", 0))
+            wait_us = int(attempt_timing.get("worker_wait_us", 0))
+            host_us = max(dt_us - device_us - wait_us, 0)
+            cm.note(device_us=device_us, host_us=host_us,
+                    wait_us=wait_us)
             cm.__exit__(None, None, None)
+            metrics.counter("serve_device_us", device_us, lane=lane.idx)
+            metrics.observe("serve_stage_us", wait_us,
+                            stage="worker_wait")
+            metrics.observe("serve_stage_us", host_us, stage="dispatch")
+            metrics.observe("serve_stage_us", device_us, stage="device")
+            if timing is not None:
+                timing["worker_wait_us"] = wait_us
+                timing["device_us"] = device_us
+                timing["total_us"] = int(
+                    (lane._clock() - t_place0) * 1e6)
             if tried:
                 self.redispatches += 1
                 metrics.counter("serve_redispatch", lane=lane.idx)

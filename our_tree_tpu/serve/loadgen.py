@@ -86,6 +86,10 @@ class LoadReport:
     p95_ms: float = 0.0
     p99_ms: float = 0.0
     latencies_ms: list = field(default_factory=list, repr=False)
+    #: per-request time-attribution ledgers collected off sampled
+    #: responses (Response.ledger — the router attaches them): the
+    #: waterfall population route.bench aggregates and gates on
+    ledgers: list = field(default_factory=list, repr=False)
 
     def finish(self, wall_s: float, ok_bytes: int) -> None:
         self.wall_s = wall_s
@@ -183,6 +187,8 @@ async def run(server, n_requests: int, concurrency: int = 32,
     def account(resp, payload, probe, dt_ms: float):
         report.requests += 1
         report.latencies_ms.append(dt_ms)
+        if getattr(resp, "ledger", None) is not None:
+            report.ledgers.append(resp.ledger)
         # Per-request client-side outcome + end-to-end latency into the
         # metrics registry: the error CODES are a closed set
         # (queue.ERR_*), so `outcome` stays low-cardinality — exact

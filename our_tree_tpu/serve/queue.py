@@ -19,6 +19,12 @@ The queue is where overload policy lives, and the policy is explicit:
   router's backpressure propagation leans on (a shed answer travels
   back as retry-with-backoff on the replica ring, so the heavy tenant
   self-throttles instead of taking the host down).
+* **Two priority tiers.** Tenants named in ``low_priority_tenants``
+  (or requests submitted with ``priority=0``) shed FIRST once queue
+  depth crosses ``priority_depth_frac * max_depth``
+  (``serve_shed{reason=priority}``, degrade kind ``priority->shed``):
+  under pressure the low tier degrades before the normal tier feels
+  anything, instead of both tiers racing to the hard cap.
 * **Per-request deadline.** Every accepted request carries a
   ``resilience.policy.Budget``; a request whose budget is exhausted by
   the time the batcher drains it gets a ``"deadline"`` error instead of
@@ -91,6 +97,12 @@ class Response:
     detail: str = ""
     queued_s: float = 0.0              #: admission -> drain residency
     batch: str | None = None           #: label of the batch that served it
+    #: the per-request time-attribution ledger (docs/OBSERVABILITY.md,
+    #: the waterfall): stage name -> µs, disjoint contiguous stages
+    #: summing to ``total`` — built by the server for SAMPLED requests
+    #: and shipped over the wire so the router can prepend its own
+    #: stages. None on unsampled/refused requests.
+    ledger: dict | None = None
 
 
 @dataclass
@@ -107,8 +119,18 @@ class Request:
     t_submit: float = 0.0
     #: the admission-time head-sampling decision (OT_TRACE_SAMPLE):
     #: every span this request rides is emitted iff this bit is set
-    #: (or the outcome force-samples it)
+    #: (or the outcome force-samples it). When the request arrived over
+    #: the wire the ROUTER's admission decision rides in instead, so one
+    #: coin flip governs the whole cross-process chain.
     sampled: bool = True
+    #: the upstream (router) span id this request's spans chain under —
+    #: cross-process trace parentage, handed over the wire ("ps")
+    parent: str | None = None
+    #: admission -> drain residency, stamped by drain() (the ledger's
+    #: backend_queue stage), plus the drain timestamp itself (the next
+    #: stage's start — the ledger's stages are contiguous by clock)
+    queued_us: int = 0
+    t_drain: float = 0.0
     _span_cm: object | None = field(default=None, repr=False)
     _queue: object | None = field(default=None, repr=False)
 
@@ -145,10 +167,26 @@ class RequestQueue:
                  max_request_blocks: int = 4096,
                  default_deadline_s: float = 30.0,
                  tenant_depth_frac: float = 1.0,
+                 low_priority_tenants=(),
+                 priority_depth_frac: float = 0.5,
                  clock=time.monotonic):
         self.max_depth = int(max_depth)
         self.max_request_blocks = int(max_request_blocks)
         self.default_deadline_s = float(default_deadline_s)
+        #: Two-level tenant priority (ROADMAP carry-over): tenants named
+        #: here are LOW priority — under depth pressure (queue depth at
+        #: or past ``priority_depth_frac * max_depth``) their submits
+        #: shed FIRST (``serve_shed{reason=priority}``), reserving the
+        #: remaining headroom for normal-priority traffic. Everyone is
+        #: equal below the pressure line; the hard depth cap still sheds
+        #: everyone at the top. A per-request ``priority=0`` submit
+        #: argument opts a single request into the low tier regardless
+        #: of tenant (the wire's "pr" field).
+        self.low_priority_tenants = frozenset(low_priority_tenants)
+        self.priority_depth_frac = min(
+            max(float(priority_depth_frac), 0.0), 1.0)
+        self._priority_line = max(
+            int(self.priority_depth_frac * self.max_depth), 1)
         #: Per-tenant admission cap, as a fraction of ``max_depth``: one
         #: tenant may occupy at most ``max(1, int(frac * max_depth))``
         #: queued slots, so a heavy tenant sheds ITSELF (reason=tenant)
@@ -169,6 +207,7 @@ class RequestQueue:
         self.answered = 0
         self.shed = 0
         self.shed_tenant = 0
+        self.shed_priority = 0
         self.refused = 0
         self.expired = 0
         self.depth_peak = 0
@@ -178,10 +217,20 @@ class RequestQueue:
 
     # -- admission ---------------------------------------------------------
     def submit(self, tenant: str, key: bytes, nonce: bytes, payload,
-               deadline_s: float | None = None) -> asyncio.Future:
+               deadline_s: float | None = None,
+               sampled: bool | None = None, parent: str | None = None,
+               priority: int | None = None) -> asyncio.Future:
         """Admit one request; always returns a future (already resolved
         with a coded error Response when admission refuses it — callers
-        get one uniform await, not two failure channels)."""
+        get one uniform await, not two failure channels).
+
+        ``sampled``/``parent`` are the cross-process propagation hooks:
+        a request arriving over the wire carries the ROUTER's admission
+        sampling decision and span id, so its spans join the router's
+        trace instead of flipping a second coin (None = local admission:
+        draw ``trace.sample()`` here, no upstream parent). ``priority``
+        (0 = low) opts a single request into the low tier; None defers
+        to the ``low_priority_tenants`` set."""
         fut = asyncio.get_running_loop().create_future()
         data = np.asarray(payload, dtype=np.uint8).reshape(-1)
         code = None
@@ -214,6 +263,28 @@ class RequestQueue:
                 "accept->shed",
                 f"serve queue overloaded (depth {self.max_depth}); "
                 f"shedding new requests")
+        elif ((priority == 0 or (priority is None
+                                 and tenant in self.low_priority_tenants))
+              and self.priority_depth_frac < 1.0
+              and len(self._pending) >= self._priority_line):
+            # The priority tier: under depth pressure (at or past the
+            # priority line, below the hard cap) LOW-priority traffic
+            # sheds first, reserving the remaining headroom for the
+            # normal tier — graceful degradation by tier instead of a
+            # lottery at the cap.
+            code, why = ERR_SHED, (
+                f"low-priority shed under depth pressure "
+                f"({self._priority_line}/{self.max_depth} slots used)")
+            self.shed += 1
+            self.shed_priority += 1
+            metrics.counter("serve_shed", reason="priority")
+            trace.counter("serve_shed_priority")
+            degrade.degrade(
+                "priority->shed",
+                f"queue depth crossed the priority line "
+                f"({self._priority_line}/{self.max_depth}, "
+                f"priority_depth_frac={self.priority_depth_frac}); "
+                "shedding low-priority requests first")
         elif (self.tenant_depth_frac < 1.0
               and self._tenant_pending.get(tenant, 0) >= self._tenant_cap):
             # The per-tenant cap: THIS tenant is over its depth share
@@ -247,8 +318,10 @@ class RequestQueue:
             budget=Budget(deadline, clock=self._clock) if deadline > 0
             else None,
             t_submit=self._clock(), _queue=self,
-            sampled=trace.sample())
-        cm = trace.maybe_span(req.sampled, "request-queued", req=req.id,
+            sampled=trace.sample() if sampled is None else bool(sampled),
+            parent=parent)
+        cm = trace.maybe_span(req.sampled, "request-queued",
+                              parent=req.parent, req=req.id,
                               tenant=tenant, blocks=req.nblocks)
         cm.__enter__()
         req._span_cm = cm
@@ -306,8 +379,12 @@ class RequestQueue:
         live = []
         for req in taken:
             self._tenant_done(req)
-            queued_s = self._clock() - req.t_submit
+            req.t_drain = self._clock()
+            queued_s = req.t_drain - req.t_submit
+            req.queued_us = int(queued_s * 1e6)
             metrics.observe("serve_queued_us", queued_s * 1e6)
+            metrics.observe("serve_stage_us", req.queued_us,
+                            stage="backend_queue")
             if req.budget is not None and req.budget.exhausted():
                 self.expired += 1
                 metrics.counter("serve_deadline_expired")
@@ -339,6 +416,7 @@ class RequestQueue:
         return {"accepted": self.accepted, "answered": self.answered,
                 "lost": self.accepted - self.answered,
                 "shed": self.shed, "shed_tenant": self.shed_tenant,
+                "shed_priority": self.shed_priority,
                 "refused": self.refused,
                 "expired": self.expired, "depth": self.depth(),
                 "depth_peak": self.depth_peak}

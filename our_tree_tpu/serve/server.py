@@ -74,6 +74,7 @@ tables), ``lane-probe`` (canary), ``serve-warmup`` / ``lane-warmup``.
 from __future__ import annotations
 
 import asyncio
+import time
 from dataclasses import dataclass
 
 import jax
@@ -136,6 +137,12 @@ class ServerConfig:
     #: (``serve_shed{reason=tenant}``) while others keep being admitted;
     #: 1.0 = no per-tenant cap (global shed only)
     tenant_depth_frac: float = 1.0
+    #: the LOW-priority tenant set (serve/queue.py priority tiers):
+    #: their submits shed first once queue depth crosses
+    #: ``priority_depth_frac * max_depth`` (serve_shed{reason=priority})
+    low_priority_tenants: tuple = ()
+    #: the depth-pressure line low-priority shedding starts at
+    priority_depth_frac: float = 0.5
     #: per-request residency deadline (queue admission -> response)
     request_deadline_s: float = 30.0
     #: watchdog deadline around each lane's engine call; None = the
@@ -184,7 +191,9 @@ class Server:
         self.queue = RequestQueue(max_depth=c.max_depth,
                                   max_request_blocks=self.rungs[-1],
                                   default_deadline_s=c.request_deadline_s,
-                                  tenant_depth_frac=c.tenant_depth_frac)
+                                  tenant_depth_frac=c.tenant_depth_frac,
+                                  low_priority_tenants=c.low_priority_tenants,
+                                  priority_depth_frac=c.priority_depth_frac)
         self.keycache = KeyCache(per_tenant=c.keycache_per_tenant)
         self.engine: str | None = None   # resolved at start
         self.pool: lanes.LanePool | None = None  # built at start
@@ -389,10 +398,16 @@ class Server:
 
     # -- request side ------------------------------------------------------
     async def submit(self, tenant: str, key: bytes, nonce: bytes, payload,
-                     deadline_s: float | None = None):
-        """Admit one CTR crypt request and await its Response."""
+                     deadline_s: float | None = None,
+                     sampled: bool | None = None,
+                     parent: str | None = None,
+                     priority: int | None = None):
+        """Admit one CTR crypt request and await its Response.
+        ``sampled``/``parent``/``priority`` propagate a wire-fronted
+        request's router-side admission decisions (serve/queue.py)."""
         return await self.queue.submit(tenant, key, nonce, payload,
-                                       deadline_s)
+                                       deadline_s, sampled=sampled,
+                                       parent=parent, priority=priority)
 
     # -- the batcher loop --------------------------------------------------
     async def _loop(self) -> None:
@@ -493,12 +508,14 @@ class Server:
     async def _dispatch_batch(self, b: batcher.Batch, sched) -> None:
         from .queue import Response  # cycle-free: queue never imports us
 
+        t_d0 = time.monotonic()
+        timing: dict = {}
         try:
             out, _lane, _redispatched = await self.pool.dispatch(
                 b.words, b.ctr_words, sched, b.slot_index, b.label,
                 bucket=b.bucket, blocks=b.blocks,
                 requests=len(b.requests), runs=b.runs,
-                sampled=b.sampled)
+                sampled=b.sampled, timing=timing)
         except lanes.LanesExhausted as e:
             # Failover already ran: every lane was tried (and each
             # miss degraded its lane's health). Only now do the riders
@@ -539,9 +556,42 @@ class Server:
         self._dispatched_blocks += b.bucket
         self._slots_used += len(b.slots)
         self._slot_capacity += b.key_slots
+        # The batch's dispatch window, split for the ledger: executor
+        # wait + device compute from the lane seam, host overhead as the
+        # remainder — with pack (drain -> dispatch submit) before it and
+        # reply (dispatch end -> resolve) after, every rider's stages
+        # are contiguous by clock and sum to its measured residency.
+        t_d1 = time.monotonic()
+        dispatch_total = int((t_d1 - t_d0) * 1e6)
+        wait_us = int(timing.get("worker_wait_us", 0))
+        device_us = int(timing.get("device_us", 0))
+        host_us = max(dispatch_total - wait_us - device_us, 0)
+        if b.requests:
+            pack_b = max(int((t_d0 - b.requests[0].t_drain) * 1e6), 0)
+            metrics.observe("serve_stage_us", pack_b, stage="pack")
+            b.stages.update(pack_us=pack_b, worker_wait_us=wait_us,
+                            dispatch_us=host_us, device_us=device_us)
         try:
             for req, data in zip(b.requests, b.split_output(out)):
-                req.resolve(Response(ok=True, payload=data, batch=b.label))
+                ledger = None
+                t_now = time.monotonic()
+                reply_us = max(int((t_now - t_d1) * 1e6), 0)
+                if req.sampled:
+                    ledger = {
+                        "stages": {
+                            "backend_queue": req.queued_us,
+                            "pack": max(int((t_d0 - req.t_drain) * 1e6),
+                                        0),
+                            "worker_wait": wait_us,
+                            "dispatch": host_us,
+                            "device": device_us,
+                            "reply": reply_us,
+                        },
+                        "total_us": int((t_now - req.t_submit) * 1e6),
+                    }
+                req.resolve(Response(ok=True, payload=data, batch=b.label,
+                                     ledger=ledger))
+                metrics.observe("serve_stage_us", reply_us, stage="reply")
         except Exception as e:  # noqa: BLE001 - containment (docstring)
             # E.g. a wrongly-shaped engine result breaking split_output:
             # riders not yet resolved get errors (fail() no-ops on the

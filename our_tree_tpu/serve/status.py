@@ -74,6 +74,13 @@ class HttpStatusEndpoint:
         gauges at scrape time before rendering."""
         return metrics.render_prometheus()
 
+    async def metrics_text_async(self) -> str:
+        """Awaitable /metrics hook (defaults to the sync body): the
+        router's FEDERATED scrape overrides this — it must await its
+        backends' /metrics over the network, which a sync method on the
+        event loop cannot."""
+        return self.metrics_text()
+
     # -- the responder ------------------------------------------------------
     async def _handle(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:
@@ -88,7 +95,7 @@ class HttpStatusEndpoint:
                     break
             self.requests += 1
             if path.split("?")[0] == "/metrics":
-                body = self.metrics_text()
+                body = await self.metrics_text_async()
                 ctype = "text/plain; version=0.0.4; charset=utf-8"
                 code, reason = 200, "OK"
             elif path.split("?")[0] == "/healthz":

@@ -9,18 +9,34 @@ Both directions use the same shape:
 request::
 
     {"t": "<tenant>", "k": "<key hex>", "n": "<nonce hex>",
-     "len": <payload bytes>, "deadline_s": <float|null>}\\n
+     "len": <payload bytes>, "deadline_s": <float|null>,
+     "sm": <bool|absent>, "ps": "<parent span id|absent>",
+     "pr": <0|absent>, "lg": <true|absent>}\\n
     <len raw bytes>
 
 response::
 
-    {"ok": true, "len": <n>, "batch": "<label|null>"}\\n<n raw bytes>
+    {"ok": true, "len": <n>, "batch": "<label|null>", "tr": <epoch µs>,
+     "ts": <epoch µs>, "pid": <int>, "lg": {<ledger>|absent}}\\n<raw>
     {"ok": false, "len": 0, "error": "<code>", "detail": "..."}\\n
 
 The codes are ``serve.queue``'s closed ERR_* set — the router
 dispatches on them (a ``shed`` retries the replica ring with backoff, a
 ``shutdown`` marks the backend draining, everything else answers the
 rider as-is), so the wire adds NO new failure vocabulary.
+
+The observability fields are the CROSS-PROCESS propagation seam
+(docs/OBSERVABILITY.md, fleet tracing): ``sm`` carries the router's
+admission-time head-sampling decision (one coin flip governs the whole
+chain), ``ps`` the router's per-request span id (the backend's
+``request-queued`` span chains under it, joining the fleet trace),
+``pr`` a low-priority marker, and ``lg`` requests the per-request
+time-attribution ledger, which rides back in the response's ``lg``.
+Every response also stamps ``tr``/``ts`` (the backend's epoch-µs clock
+at frame receipt and at reply — the NTP-style pair the router's
+clock-skew estimate cancels processing time with) and ``pid`` — the
+wire handshake the Perfetto timeline alignment is built from. All
+optional: a bare header is a plain local request, exactly as before.
 
 Used by ``serve/worker.py`` (the backend process's TCP frontend — reads
 requests, feeds ``Server.submit``, writes responses) and by

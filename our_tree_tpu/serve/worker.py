@@ -135,6 +135,7 @@ class RequestFrontend:
                 pass
 
     async def _answer(self, writer, header: dict, payload: bytes) -> None:
+        t_rx = trace.now_us()  # frame receipt (the "tr" stamp)
         try:
             key = bytes.fromhex(str(header.get("k", "")))
             nonce = bytes.fromhex(str(header.get("n", "")))
@@ -152,9 +153,19 @@ class RequestFrontend:
                  "detail": "deadline_s is not a number"}))
             await writer.drain()
             return
+        # Cross-process observability propagation (serve/wire.py): the
+        # router's admission-time sampling decision ("sm") and span id
+        # ("ps") replace a local coin flip, so this request's backend
+        # spans join the router's trace; "pr" rides the priority tier.
+        sampled = header.get("sm")
+        sampled = bool(sampled) if sampled is not None else None
+        parent = header.get("ps")
+        parent = str(parent) if parent else None
+        priority = 0 if header.get("pr") == 0 else None
         resp = await self._server.submit(
             str(header.get("t", "")), key, nonce,
-            memoryview(payload), deadline_s=deadline)
+            memoryview(payload), deadline_s=deadline,
+            sampled=sampled, parent=parent, priority=priority)
         if resp.ok:
             out = {"ok": True, "batch": resp.batch}
             body = resp.payload.tobytes()
@@ -162,6 +173,17 @@ class RequestFrontend:
             out = {"ok": False, "error": resp.error, "detail": resp.detail,
                    "batch": resp.batch}
             body = b""
+        # The reply-side handshake: backend receive + reply clocks and
+        # pid on every frame. TWO timestamps on purpose — the NTP
+        # four-timestamp form ((tr - send) + (ts - recv)) / 2 cancels
+        # the server's processing time out of the router's clock-skew
+        # estimate, where a single reply stamp would bias it by half
+        # the service time. Plus the per-request ledger when asked for.
+        out["tr"] = t_rx
+        out["ts"] = trace.now_us()
+        out["pid"] = os.getpid()
+        if header.get("lg") and resp.ledger is not None:
+            out["lg"] = resp.ledger
         writer.write(wire.encode_frame(out, body))
         await writer.drain()
 
@@ -175,6 +197,8 @@ async def _amain(args) -> int:
         native_threads=args.native_threads,
         max_depth=args.queue_depth,
         tenant_depth_frac=args.tenant_depth_frac,
+        low_priority_tenants=tuple(args.low_priority_tenant or ()),
+        priority_depth_frac=args.priority_depth_frac,
         request_deadline_s=args.deadline,
         dispatch_deadline_s=args.dispatch_deadline,
         retries=args.retries,
@@ -250,6 +274,15 @@ def main(argv=None) -> int:
     ap.add_argument("--queue-depth", type=int, default=1024)
     ap.add_argument("--tenant-depth-frac", type=float, default=1.0,
                     metavar="FRAC")
+    ap.add_argument("--low-priority-tenant", action="append", default=None,
+                    metavar="TENANT",
+                    help="mark TENANT low priority (repeatable): its "
+                         "submits shed first under depth pressure "
+                         "(serve_shed{reason=priority}, serve/queue.py)")
+    ap.add_argument("--priority-depth-frac", type=float, default=0.5,
+                    metavar="FRAC",
+                    help="queue-depth fraction past which low-priority "
+                         "requests shed (1.0 disables the tier split)")
     ap.add_argument("--deadline", type=float, default=30.0)
     ap.add_argument("--dispatch-deadline", type=float,
                     default=watchdog.default_deadline_s() or 10.0)

@@ -1,0 +1,451 @@
+"""Fleet-causal observability (ISSUE 12): cross-process trace
+propagation, the per-request time-attribution waterfall, device-time
+accounting, clock-skew handshake, federated /metrics, and the router
+status endpoint's rendering/containment.
+
+In-process rehearsals on the same wire path the CI route drive flies
+with real spawned workers: several REAL serve Servers behind
+``serve.worker.RequestFrontend`` ports with a ``route.proxy.Router``
+over them. The process boundary itself is covered by the CI drive's
+``obs.report --min-join-frac`` gate (a backend span must chain under
+the router's span id ACROSS processes); here the same parentage is
+asserted on the span ids, which the wire carries identically either
+way.
+"""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from our_tree_tpu.obs import export, metrics, trace
+from our_tree_tpu.obs.report import fleet_join_stats
+from our_tree_tpu.resilience import degrade, faults
+from our_tree_tpu.route import health
+from our_tree_tpu.route.bench import WATERFALL_STAGES, waterfall_stats
+from our_tree_tpu.route.proxy import BackendSpec, Router, RouterConfig
+from our_tree_tpu.route.status import RouterStatus, relabel_prometheus
+from our_tree_tpu.serve.queue import ERR_SHED, RequestQueue
+from our_tree_tpu.serve.server import Server, ServerConfig
+from our_tree_tpu.serve.worker import RequestFrontend
+
+LADDER = dict(min_bucket_blocks=32, max_bucket_blocks=256, lanes=1)
+
+
+@pytest.fixture(autouse=True)
+def _clean_process_state(monkeypatch):
+    monkeypatch.delenv("OT_FAULTS", raising=False)
+    monkeypatch.delenv("OT_DISPATCH_DEADLINE", raising=False)
+    faults.reset()
+    degrade.clear()
+    metrics.reset_for_tests()
+    yield
+    faults.reset()
+    degrade.clear()
+    metrics.reset_for_tests()
+
+
+@pytest.fixture
+def traced(tmp_path, monkeypatch):
+    monkeypatch.setenv("OT_TRACE_DIR", str(tmp_path / "tr"))
+    monkeypatch.setenv("OT_TRACE_RUN", "t-fleet")
+    monkeypatch.delenv("OT_TRACE_PARENT", raising=False)
+    monkeypatch.delenv("OT_TRACE_SAMPLE", raising=False)
+    trace.reset_for_tests()
+    yield tmp_path / "tr" / "t-fleet"
+    trace.reset_for_tests()
+
+
+class Cluster:
+    """N in-process backends + a router (the test_route harness)."""
+
+    def __init__(self, n=2, router_cfg=None, server_kw=None):
+        self.n = n
+        self.router_cfg = router_cfg
+        self.server_kw = dict(LADDER, **(server_kw or {}))
+        self.servers, self.fronts, self.specs = [], [], []
+        self.router = None
+
+    async def __aenter__(self):
+        for i in range(self.n):
+            s = Server(ServerConfig(status_port=0, **self.server_kw))
+            await s.start()
+            f = RequestFrontend(s, 0)
+            await f.start()
+            self.servers.append(s)
+            self.fronts.append(f)
+            self.specs.append(BackendSpec(
+                f"b{i}", "127.0.0.1", f.port, s.status.port))
+        cfg = self.router_cfg or RouterConfig(
+            gossip_every_s=0.0, attempt_timeout_s=2.0)
+        self.router = Router(self.specs, cfg)
+        await self.router.start()
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.router.stop()
+        for f in self.fronts:
+            await f.stop()
+        for s in self.servers:
+            await s.stop()
+
+
+async def _get(port, raw: bytes):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(raw)
+    await writer.drain()
+    out = await reader.read(1 << 22)
+    writer.close()
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The tentpole: waterfall + propagation + device time + skew.
+# ---------------------------------------------------------------------------
+
+
+def test_cross_process_waterfall_complete_and_sums(traced):
+    ledgers = []
+
+    async def main():
+        async with Cluster(n=2) as c:
+            for t in range(12):
+                resp = await c.router.submit(
+                    f"t{t}", b"\x01" * 16, b"\x02" * 16,
+                    np.zeros(2048, np.uint8))
+                assert resp.ok
+                assert resp.ledger is not None
+                ledgers.append(resp.ledger)
+            # The skew handshake ran at canary pinning; on one host the
+            # NTP-style estimate must be well under the exchange RTT.
+            b0 = c.router.backends["b0"]
+            assert b0.skew_us is not None and abs(b0.skew_us) < 50_000
+            assert b0.pid is not None
+
+    asyncio.run(main())
+    # Every ledger is COMPLETE (backend half arrived over the wire) and
+    # its disjoint stages sum to the router-measured end-to-end latency.
+    wf = waterfall_stats(ledgers)
+    assert wf["sampled"] == wf["complete"] == 12
+    assert wf["complete_frac"] == 1.0
+    assert wf["sum_within_tol_frac"] == 1.0
+    for l in ledgers:
+        assert set(WATERFALL_STAGES) <= set(l["stages"])
+        assert l["total_us"] > 0
+    # The device stage is present and distinct from host dispatch time.
+    dev = wf["stages"]["device"]
+    assert dev["count"] == 12 and dev["p95_us"] > 0
+
+    run = export.load_run(str(traced))
+    assert not run.violations
+    # Cross-process parentage: every backend request-queued span chains
+    # under a route-request root via the wire-propagated span id.
+    roots = {s.id for s in run.spans.values()
+             if s.name == "route-request"}
+    queued = [s for s in run.spans.values()
+              if s.name == "request-queued"
+              and s.attrs.get("tenant") != "_canary"]
+    assert len(roots) == 12 and len(queued) == 12
+    assert all(s.parent in roots for s in queued)
+    # lane-dispatch spans carry the device/host split on their END event
+    # (trace.note -> export merge).
+    lanes = [s for s in run.spans.values() if s.name == "lane-dispatch"]
+    assert lanes and all("device_us" in s.attrs and "host_us" in s.attrs
+                         for s in lanes)
+    # The skew handshake left wire-skew points keyed by pid.
+    offs = run.clock_offsets()
+    assert offs and all(abs(v) < 50_000 for v in offs.values())
+
+
+def test_sampling_decision_propagates_over_wire(traced, monkeypatch):
+    """OT_TRACE_SAMPLE=0 at the ROUTER: the backend must not flip its
+    own coin — no request lifecycle spans anywhere, no ledgers."""
+    monkeypatch.setenv("OT_TRACE_SAMPLE", "0")
+
+    async def main():
+        async with Cluster(n=2) as c:
+            for t in range(6):
+                resp = await c.router.submit(
+                    f"t{t}", b"\x01" * 16, b"\x02" * 16,
+                    np.zeros(256, np.uint8))
+                assert resp.ok
+                assert resp.ledger is None  # unsampled: no ledger built
+
+    asyncio.run(main())
+    run = export.load_run(str(traced))
+    names = {s.name for s in run.spans.values()}
+    assert "route-request" not in names
+    assert "request-queued" not in names
+    assert not run.violations
+
+
+def test_fleet_join_stats_counts_cross_proc_children():
+    run = export.Run()
+
+    def span(sid, name, parent, proc):
+        rec = {"id": sid, "name": name, "parent": parent, "ts": 0}
+        sp = export.SpanRec(rec, pid=1 if proc == "a" else 2, proc=proc)
+        run.spans[sid] = sp
+
+    span("a.1", "route-request", None, "a")
+    span("b.1", "request-queued", "a.1", "b")   # joined cross-process
+    span("a.2", "route-request", None, "a")
+    span("a.3", "request-queued", "a.2", "a")   # linked, same process
+    span("a.4", "route-request", None, "a")     # no children at all
+    js = fleet_join_stats(run)
+    assert js == {"roots": 3, "linked": 2, "joined": 1,
+                  "frac": pytest.approx(1 / 3)}
+
+
+# ---------------------------------------------------------------------------
+# Federated /metrics.
+# ---------------------------------------------------------------------------
+
+
+def test_relabel_prometheus_injects_backend_label():
+    text = ("# TYPE serve_requests_total counter\n"
+            "serve_requests_total 5\n"
+            'serve_shed_total{reason="depth"} 2\n')
+    out = relabel_prometheus(text, backend="b1")
+    assert 'serve_requests_total{backend="b1"} 5' in out
+    assert 'serve_shed_total{reason="depth",backend="b1"} 2' in out
+    assert "# TYPE serve_requests_total counter" in out
+
+
+def test_federated_metrics_scrape_carries_every_backend():
+    async def main():
+        async with Cluster(n=2) as c:
+            status = RouterStatus(c.router, 0)
+            await status.start()
+            for t in range(4):
+                assert (await c.router.submit(
+                    f"t{t}", b"\x01" * 16, b"\x02" * 16,
+                    np.zeros(256, np.uint8))).ok
+            raw = await _get(status.port,
+                             b"GET /metrics HTTP/1.1\r\n\r\n")
+            head, _, body = raw.partition(b"\r\n\r\n")
+            assert head.startswith(b"HTTP/1.1 200")
+            text = body.decode()
+            # Router's own series, plus BOTH backends' serve series
+            # relabeled, plus the per-backend federation liveness line.
+            assert "route_affinity" in text
+            for name in ("b0", "b1"):
+                assert f'ot_route_federate_up{{backend="{name}"}} 1' \
+                    in text
+                assert f'backend="{name}"' in text
+            assert "serve_requests_total{backend=" in text
+            # --no-federate arm: the router's registry only.
+            status.federate = False
+            raw = await _get(status.port,
+                             b"GET /metrics HTTP/1.1\r\n\r\n")
+            assert b"ot_route_federate_up" not in raw
+            await status.stop()
+
+    asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# route/status.py rendering + containment (satellite).
+# ---------------------------------------------------------------------------
+
+
+def test_router_healthz_renders_quarantined_and_probation_states():
+    async def main():
+        async with Cluster(n=3) as c:
+            status = RouterStatus(c.router, 0)
+            await status.start()
+            c.router.backends["b1"].health._quarantine("test-evidence")
+            c.router.backends["b2"].health.canary_ok()  # -> probation
+            raw = await _get(status.port,
+                             b"GET /healthz HTTP/1.1\r\n\r\n")
+            doc = json.loads(raw.partition(b"\r\n\r\n")[2])
+            assert doc["backends"]["b1"]["state"] == health.QUARANTINED
+            assert doc["backends"]["b2"]["state"] == health.PROBATION
+            # One placeable backend (b0 healthy + b2 probation) keeps
+            # the readiness answer "ok".
+            assert doc["status"] == "ok"
+            assert doc["placeable"] == 2
+            # All quarantined -> degraded, still a clean 200 document.
+            c.router.backends["b0"].health._quarantine("test-evidence")
+            c.router.backends["b2"].health._quarantine("test-evidence")
+            raw = await _get(status.port,
+                             b"GET /healthz HTTP/1.1\r\n\r\n")
+            doc = json.loads(raw.partition(b"\r\n\r\n")[2])
+            assert doc["status"] == "degraded"
+            assert doc["placeable"] == 0
+            await status.stop()
+
+    asyncio.run(main())
+
+
+def test_router_status_ephemeral_port_and_malformed_requests():
+    async def main():
+        async with Cluster(n=1) as c:
+            status = RouterStatus(c.router, 0)
+            await status.start()
+            assert status.port and status.port > 0  # port=0 resolved
+            # Garbage bytes: contained per connection (an error answer
+            # or a close — never a crash), and the endpoint still
+            # serves the next clean scrape.
+            try:
+                await asyncio.wait_for(
+                    _get(status.port, b"\x00\xff garbage\r\n\r\n"),
+                    timeout=10.0)
+            except (ConnectionError, asyncio.TimeoutError):
+                pass
+            raw = await _get(status.port, b"GET /healthz HTTP/1.1\r\n\r\n")
+            assert raw.startswith(b"HTTP/1.1 200")
+            # Unknown path answers 404, not a hang.
+            raw = await _get(status.port, b"GET /nope HTTP/1.1\r\n\r\n")
+            assert raw.startswith(b"HTTP/1.1 404")
+            await status.stop()
+
+    asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# End-event attrs, clock alignment, bounded snapshot growth.
+# ---------------------------------------------------------------------------
+
+
+def test_span_end_attrs_merge_into_reconstruction(traced):
+    cm = trace.detached_span("lane-dispatch", lane=0)
+    cm.__enter__()
+    cm.note(device_us=123, host_us=45)
+    cm.__exit__(None, None, None)
+    # The deferred (unsampled) twin keeps the same surface once forced.
+    dcm = trace.maybe_span(False, "lane-dispatch", lane=1)
+    dcm.__enter__()
+    dcm.force()
+    dcm.note(device_us=7)
+    dcm.__exit__(None, None, None)
+    trace._close_state()
+    run = export.load_run(str(traced))
+    by_lane = {s.attrs.get("lane"): s for s in run.spans.values()}
+    assert by_lane[0].attrs["device_us"] == 123
+    assert by_lane[0].attrs["host_us"] == 45
+    assert by_lane[1].attrs["device_us"] == 7
+    assert not run.violations
+
+
+def test_chrome_trace_aligns_clocks_from_wire_skew(traced):
+    import os
+
+    with trace.span("work"):
+        pass
+    trace.point("wire-skew", backend=0, pid=os.getpid(), skew_us=1000,
+                rtt_us=50)
+    trace._close_state()
+    run = export.load_run(str(traced))
+    assert run.clock_offsets() == {os.getpid(): 1000}
+    plain = export.to_chrome_trace(run, align=False)
+    aligned = export.to_chrome_trace(run, align=True)
+    assert aligned["otClockOffsetsUs"] == {str(os.getpid()): 1000}
+    sp = [e for e in plain["traceEvents"] if e.get("name") == "work"][0]
+    sa = [e for e in aligned["traceEvents"] if e.get("name") == "work"][0]
+    assert sp["ts"] - sa["ts"] == 1000
+
+
+def test_metrics_snapshot_rotation_bounded_with_visible_eviction(
+        tmp_path, monkeypatch):
+    monkeypatch.setenv("OT_TRACE_DIR", str(tmp_path / "tr"))
+    monkeypatch.setenv("OT_TRACE_RUN", "t-rot")
+    monkeypatch.setenv("OT_TRACE_MAX_MB", "0.02")
+    trace.reset_for_tests()
+    metrics.reset_for_tests()
+    try:
+        for i in range(150):
+            metrics.counter(f"rot_metric_{i}", i)
+        for _ in range(12):
+            assert metrics.flush_now()
+        run_dir = tmp_path / "tr" / "t-rot"
+        segs = sorted(p.name for p in run_dir.glob("metrics-*.jsonl"))
+        # Rotation engaged AND the cap held (oldest segments evicted).
+        assert any("-s" in s for s in segs)
+        total = sum(p.stat().st_size
+                    for p in run_dir.glob("metrics-*.jsonl"))
+        assert total <= int(0.02 * (1 << 20)) * 2  # cap, with slack
+        assert metrics.evicted_bytes() > 0
+        # Truncation is visible: the NEXT snapshot line carries the
+        # dropped-bytes counter, and /metrics renders it.
+        assert metrics.flush_now()
+        last = json.loads(open(
+            sorted(run_dir.glob("metrics-*.jsonl"),
+                   key=lambda p: p.stat().st_mtime)[-1]
+        ).read().splitlines()[-1])
+        assert last.get("evicted_bytes", 0) > 0
+        assert "ot_metrics_evicted_bytes_total" in \
+            metrics.render_prometheus()
+        # Cumulative snapshots: the surviving tail still reconstructs
+        # the FINAL totals through export (eviction cost history only).
+        run = export.load_run(str(run_dir))
+        assert not run.violations
+        totals = run.metrics_totals()
+        assert totals["counters"]["rot_metric_149"] == 149
+    finally:
+        trace.reset_for_tests()
+        metrics.reset_for_tests()
+
+
+def test_trace_rotation_counts_evicted_bytes(tmp_path, monkeypatch):
+    monkeypatch.setenv("OT_TRACE_DIR", str(tmp_path / "tr"))
+    monkeypatch.setenv("OT_TRACE_RUN", "t-tr-rot")
+    monkeypatch.setenv("OT_TRACE_MAX_MB", "0.02")
+    trace.reset_for_tests()
+    try:
+        for i in range(2000):
+            trace.point("soak-tick", i=i)
+        snap = trace.metrics_snapshot()
+        assert snap.get("evicted_bytes", 0) > 0
+    finally:
+        trace.reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# Priority tiers at admission (satellite).
+# ---------------------------------------------------------------------------
+
+
+def test_low_priority_tenant_sheds_first_under_depth_pressure():
+    async def main():
+        q = RequestQueue(max_depth=8, low_priority_tenants=("lp",),
+                         priority_depth_frac=0.5)
+        nonce, key = b"\x02" * 16, b"\x01" * 16
+        pay = np.zeros(16, np.uint8)
+        # Below the priority line (4): both tiers admitted.
+        f = q.submit("lp", key, nonce, pay)
+        assert not f.done()
+        for i in range(3):
+            q.submit(f"t{i}", key, nonce, pay)
+        assert q.depth() == 4
+        # At the line: low priority sheds, normal still admitted.
+        shed = await q.submit("lp", key, nonce, pay)
+        assert shed.error == ERR_SHED and "low-priority" in shed.detail
+        ok = q.submit("t9", key, nonce, pay)
+        assert not ok.done()
+        # Per-request priority=0 opts ANY tenant into the low tier.
+        shed2 = await q.submit("t5", key, nonce, pay, priority=0)
+        assert shed2.error == ERR_SHED
+        assert q.stats()["shed_priority"] == 2
+        assert "priority->shed" in degrade.events()
+        assert metrics.counter_total("serve_shed") == 2
+        q.flush()
+
+    asyncio.run(main())
+
+
+def test_priority_tier_off_by_default():
+    async def main():
+        q = RequestQueue(max_depth=4)
+        for i in range(4):
+            q.submit(f"t{i}", b"\x01" * 16, b"\x02" * 16,
+                     np.zeros(16, np.uint8))
+        # The hard cap still sheds everyone, reason=depth not priority.
+        shed = await q.submit("t9", b"\x01" * 16, b"\x02" * 16,
+                              np.zeros(16, np.uint8))
+        assert shed.error == ERR_SHED
+        assert q.stats()["shed_priority"] == 0
+        q.flush()
+
+    asyncio.run(main())

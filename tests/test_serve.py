@@ -1496,7 +1496,8 @@ def test_concurrent_rescue_waits_for_inflight_probe(monkeypatch):
 
     out_ok = np.ones(4, np.uint32)
 
-    def fake_call(self, w, c, s, k, label, warmup=False, runs=None):
+    def fake_call(self, w, c, s, k, label, warmup=False, runs=None,
+                  timing=None):
         _time.sleep(0.1)  # on the worker thread: a slow-but-healthy lane
         return out_ok
 
