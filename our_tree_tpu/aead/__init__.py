@@ -1,0 +1,10 @@
+"""Authenticated modes (AES-GCM) as first-class served workloads.
+
+``ghash.py`` is the HOST half: numpy/int GHASH (the parity twin of the
+traced kernel), the host AES block oracle the keycache derives
+H = E_K(0^128) with, GCM's inc32 counter materialiser, and the J0 /
+length-block helpers the batcher and the models API share. ``gcm.py``
+is the TRACED half plus the public API: the Horner-form GHASH kernel,
+the scattered-CTR-fused-with-GHASH multikey dispatch (the serve seam),
+the constant-time tag compare, and ``gcm_seal``/``gcm_open``.
+"""

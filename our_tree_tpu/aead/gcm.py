@@ -1,0 +1,306 @@
+"""AES-GCM — the TRACED half and the public seal/open API.
+
+The traced pieces are built on one design decision (ops/gf.py module
+docstring): multiplication by the fixed per-key GHASH subkey H is a
+GF(2) LINEAR map, so the kernel carries a precomputed 128x128 bit
+matrix per key slot and GHASH becomes bit-extraction + integer matmul
++ mask — XOR/AND arithmetic only, zero memory indirection, the same
+constant-time construction discipline as the bitsliced AES circuit.
+The jaxpr auditor covers these entries (``ghash[horner]``,
+``aes-gcm-fused[*]``, ``gcm-tag-eq`` — analysis/jaxpr_audit.py): a
+secret-indexed lookup creeping in here is a REAL finding, baselined
+only with reason.
+
+``gcm_crypt_ghash_words`` is the serve dispatch seam: scattered CTR
+(the existing multi-key engine cores, ``models.aes.MULTIKEY_CTR``)
+FUSED with segmented Horner GHASH accumulation in ONE jitted call.
+Batch layout (serve/batcher.py materialises it; ``gcm_seal``/
+``gcm_open`` build the single-request K=1 form of the same):
+
+* each request occupies 1 + n rows: row 0 carries counter J0 with a
+  zero data word — its CTR output IS E_K(J0), the tag's final pad —
+  and rows 1..n carry the payload under inc32 counters;
+* ``seg_keep`` (N,) zeroes the Horner carry at each segment start (and
+  at the J0 rows, whose GHASH lane is discarded), so one fixed-shape
+  scan serves many requests — no per-request shapes, the bucket
+  ladder's zero-recompile contract holds for GCM exactly as for CTR;
+* ``inject_words`` XORs each request's host-computed AAD prefix state
+  Y_aad into its first ciphertext block (GHASH is Horner, so seeding
+  the chain's first step with Y_aad ^ C_1 continues the AAD chain
+  bit-exactly);
+* the kernel emits the running Y at EVERY row; the host finisher reads
+  each request's last full-block row and applies the (tiny,
+  per-request, variable-length) tail: optional partial-block multiply,
+  the length block, and the E_K(J0) pad — ``ops.gf.gf128_mul`` on
+  ints, one or two multiplies per request.
+
+``tag_eq_words`` is the traced constant-time tag compare (full XOR +
+OR fold, one terminal equality); ``ghash.np_tag_eq`` is its host twin.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import aes as _aes
+from ..ops import gf
+from ..ops.keyschedule import expand_key_enc
+from ..utils import packing
+from . import ghash as _gh
+
+#: Fused-kernel directions (static compile args): GHASH always runs
+#: over the CIPHERTEXT stream — the dispatch OUTPUT when sealing, the
+#: dispatch INPUT when opening.
+SEAL = "seal"
+OPEN = "open"
+
+
+class TagMismatchError(ValueError):
+    """``gcm_open``'s authentication failure: no plaintext is returned
+    (the serve path answers the same event as a per-request
+    ``auth-failed`` refusal, never an exception escaping a batch)."""
+
+
+# ---------------------------------------------------------------------------
+# Bit-plane packing (the word-bit basis of gf.gf128_mul_matrix_words).
+# ---------------------------------------------------------------------------
+
+
+def _bits_of(w2: jnp.ndarray) -> jnp.ndarray:
+    """(N, 4) u32 block words -> (N, 128) 0/1 u32 bit lanes, word-bit
+    order (bit k = bit k%32 of word k//32)."""
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    return ((w2[:, :, None] >> shifts[None, None, :])
+            & jnp.uint32(1)).reshape(w2.shape[0], 128)
+
+
+def _words_of(bits: jnp.ndarray) -> jnp.ndarray:
+    """(N, 128) 0/1 u32 bit lanes -> (N, 4) u32 block words."""
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    return jnp.sum(bits.reshape(bits.shape[0], 4, 32) << shifts,
+                   axis=-1, dtype=jnp.uint32)
+
+
+# ---------------------------------------------------------------------------
+# Traced GHASH (Horner) + the fused scattered-CTR/GHASH dispatch.
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def _ghash_words_jit(words, hmat, y0_words):
+    b2 = words.reshape(-1, 4)
+    bits = _bits_of(b2)
+    y0 = _bits_of(y0_words.reshape(1, 4))[0]
+
+    def step(y, xb):
+        y2 = jnp.matmul(hmat, y ^ xb) & jnp.uint32(1)
+        return y2, None
+
+    y, _ = jax.lax.scan(step, y0, bits, unroll=4)
+    return _words_of(y[None])[0]
+
+
+def ghash_words(words, hmat, y0_words=None):
+    """Horner-form GHASH over a batch of blocks: ``words`` (N, 4) u32
+    (or flat (4N,)), ``hmat`` the (128, 128) mul-by-H bit matrix,
+    ``y0_words`` an optional (4,) initial state. Returns the final Y as
+    (4,) u32 words. The standalone traced entry the auditor taints."""
+    if y0_words is None:
+        y0_words = jnp.zeros(4, jnp.uint32)
+    return _ghash_words_jit(words, hmat, y0_words)
+
+
+@functools.partial(jax.jit, static_argnums=(7, 8, 9, 10))
+def _gcm_fused_jit(words, ctr_le_words, rks, key_slots, hmats,
+                   inject_words, seg_keep, nr, engine, direction, knobs):
+    del knobs  # compile-cache key only (models/aes.py:_engine_knobs_key)
+    w2 = words.reshape(-1, 4)
+    c2 = ctr_le_words.reshape(-1, 4)
+    slots = key_slots.astype(jnp.uint32)
+    fn = _aes.MULTIKEY_CTR.get(engine, _aes._multikey_bitslice)
+    out = fn(w2, c2, rks, slots, nr)
+    # GHASH runs over the ciphertext: the CTR output when sealing, the
+    # input when opening. inject carries each segment's AAD prefix
+    # state into its first block (XOR before bit extraction — GF(2)
+    # addition commutes with the basis change).
+    gh2 = (out if direction == SEAL else w2) ^ inject_words.reshape(-1, 4)
+    bits = _bits_of(gh2)
+
+    def step(y, xs):
+        xb, keep, slot = xs
+        m = jax.lax.dynamic_index_in_dim(hmats, slot, axis=0,
+                                         keepdims=False)  # public index
+        y2 = jnp.matmul(m, (y * keep) ^ xb) & jnp.uint32(1)
+        return y2, y2
+
+    _, ys = jax.lax.scan(
+        step, jnp.zeros(128, jnp.uint32),
+        (bits, seg_keep.astype(jnp.uint32), slots), unroll=2)
+    return out.reshape(words.shape), _words_of(ys).reshape(words.shape)
+
+
+def gcm_crypt_ghash_words(words, ctr_le_words, rks, key_slots, hmats,
+                          inject_words, seg_keep, nr, engine="jnp",
+                          direction=SEAL):
+    """The fused GCM dispatch: scattered multi-key CTR + segmented
+    Horner GHASH in one jitted call (module docstring has the batch
+    layout). Returns ``(out_words, y_words)``, both in the caller's
+    flat/(N, 4) shape: ``out_words`` is the CTR result (E_K(J0) on the
+    J0 rows), ``y_words`` the running GHASH state after every row —
+    the host finisher reads each request's last full-block row. Every
+    array shape is closed over (N, K), so the bucket ladder's
+    zero-recompile contract holds for GCM batches unchanged."""
+    return _gcm_fused_jit(words, ctr_le_words, rks, key_slots, hmats,
+                          inject_words, seg_keep, nr, engine, direction,
+                          _aes._engine_knobs_key(engine))
+
+
+@jax.jit
+def _tag_eq_jit(a, b):
+    d = a.reshape(-1) ^ b.reshape(-1)
+    r = (d[0] | d[1]) | (d[2] | d[3])
+    return r == jnp.uint32(0)
+
+
+def tag_eq_words(a, b) -> jnp.ndarray:
+    """Constant-time 128-bit tag compare on (4,) u32 words: full XOR,
+    one OR fold, ONE terminal equality — no data-dependent early exit
+    (the audit's ``gcm-tag-eq`` entry pins exactly this shape)."""
+    return _tag_eq_jit(jnp.asarray(a, jnp.uint32),
+                       jnp.asarray(b, jnp.uint32))
+
+
+# ---------------------------------------------------------------------------
+# The public models-facing API.
+# ---------------------------------------------------------------------------
+
+#: key digest -> (nr, rk, h_int, hmat) — deriving the mul-by-H matrix
+#: is ~128 field multiplies of host int work; KATs/fuzz re-enter with
+#: the same few keys constantly. Bounded: fallback eviction at 64 keys.
+_KEY_CACHE: dict[bytes, tuple] = {}
+
+
+def _key_material(key: bytes):
+    key = bytes(key)
+    hit = _KEY_CACHE.get(key)
+    if hit is not None:
+        return hit
+    nr, rk = expand_key_enc(key)
+    rk = np.asarray(rk, dtype=np.uint32)
+    h = _gh.derive_h(nr, rk)
+    ent = (nr, rk, h, gf.gf128_mul_matrix_words(h))
+    if len(_KEY_CACHE) >= 64:
+        _KEY_CACHE.pop(next(iter(_KEY_CACHE)))
+    _KEY_CACHE[key] = ent
+    return ent
+
+
+def _finish_tag(y_int: int, h: int, tail_ct: bytes, aad_len: int,
+                ct_len: int, ek_j0: np.ndarray) -> bytes:
+    """The host per-request GHASH tail: optional zero-padded partial
+    block, the length block, then the E_K(J0) pad. One or two
+    ``gf128_mul`` calls — variable-length work the fixed-shape kernel
+    deliberately leaves to the host."""
+    if tail_ct:
+        y_int = gf.gf128_mul(
+            y_int ^ gf.block_to_int(_gh.pad16(tail_ct)), h)
+    y_int = gf.gf128_mul(
+        y_int ^ gf.block_to_int(_gh.length_block(aad_len, ct_len)), h)
+    return bytes(np.frombuffer(gf.int_to_block(y_int), np.uint8)
+                 ^ np.asarray(ek_j0, np.uint8))
+
+
+def _gcm_arrays(j0: bytes, data: bytes, y_aad: int):
+    """The single-request (K=1) fused-dispatch arrays for ``data``'s
+    full blocks: row 0 = J0, rows 1..n = payload — the same layout the
+    serve batcher materialises, so seal/open and the served path
+    exercise ONE kernel."""
+    nfull = len(data) // 16
+    n = 1 + nfull
+    words = np.zeros(4 * n, dtype=np.uint32)
+    if nfull:
+        words[4:] = packing.np_bytes_to_words(
+            np.frombuffer(data[:16 * nfull], np.uint8))
+    ctr = _gh.np_gcm_ctr_blocks(j0, np.arange(n, dtype=np.uint32))
+    inject = np.zeros((n, 4), dtype=np.uint32)
+    if nfull:
+        inject[1] = packing.np_bytes_to_words(
+            np.frombuffer(gf.int_to_block(y_aad), np.uint8))
+    keep = np.ones(n, dtype=np.uint32)
+    keep[0] = 0
+    if nfull:
+        keep[1] = 0
+    return words, ctr.reshape(-1), inject.reshape(-1), keep, nfull
+
+
+def _gcm_crypt(key: bytes, iv: bytes, aad: bytes, data: bytes,
+               engine: str, direction: str):
+    """Shared seal/open core: returns (crypt output bytes, tag)."""
+    nr, rk, h, hmat = _key_material(key)
+    j0 = _gh.j0_from_iv(h, iv)
+    y_aad = _gh.ghash_int(h, _gh.pad16(aad))
+    words, ctr, inject, keep, nfull = _gcm_arrays(j0, data, y_aad)
+    engine = _aes.resolve_engine(engine)
+    rks = np.asarray(rk, np.uint32)[None, :]
+    slots = np.zeros(1 + nfull, dtype=np.uint32)
+    hmats = hmat[None, :, :]
+    out, ys = gcm_crypt_ghash_words(words, ctr, rks, slots, hmats,
+                                    inject, keep, nr, engine, direction)
+    out = np.asarray(out).reshape(-1, 4)
+    ys = np.asarray(ys).reshape(-1, 4)
+    ek_j0 = packing.np_words_to_bytes(out[0:1]).reshape(-1)
+    full = packing.np_words_to_bytes(out[1:]).reshape(-1)[:16 * nfull]
+    tail_in = data[16 * nfull:]
+    if tail_in:
+        # The partial tail block: one more keystream block host-side
+        # (inc32^{nfull+1}(J0) through the host oracle — a reference-
+        # grade single block, not a dispatch), truncated XOR.
+        ks = _gh.np_aes_encrypt_block(
+            nr, rk, _gh.inc32(j0, 1 + nfull))
+        tail_out = bytes(np.frombuffer(tail_in, np.uint8)
+                         ^ ks[:len(tail_in)])
+    else:
+        tail_out = b""
+    out_bytes = bytes(full) + tail_out
+    ct = out_bytes if direction == SEAL else bytes(data)
+    y_int = (gf.block_to_int(
+        packing.np_words_to_bytes(ys[nfull:nfull + 1]).reshape(-1))
+        if nfull else y_aad)
+    tag = _finish_tag(y_int, h, ct[16 * nfull:], len(aad), len(ct),
+                      ek_j0)
+    return out_bytes, tag
+
+
+def gcm_seal(key, iv, aad=b"", plaintext=b"",
+             engine: str = "jnp") -> tuple[bytes, bytes]:
+    """AES-GCM authenticated encryption (SP 800-38D): returns
+    ``(ciphertext, tag16)``. Arbitrary plaintext/AAD lengths; 96-bit
+    IVs take the fast J0 path, any other length derives J0 by GHASH.
+    ``engine`` picks the CTR core tier exactly as every mode entry
+    does (``models.aes.resolve_engine``)."""
+    key, iv = bytes(bytearray(key)), bytes(bytearray(iv))
+    aad = bytes(bytearray(aad))
+    pt = bytes(bytearray(plaintext))
+    ct, tag = _gcm_crypt(key, iv, aad, pt, engine, SEAL)
+    return ct, tag
+
+
+def gcm_open(key, iv, aad, ciphertext, tag,
+             engine: str = "jnp") -> bytes:
+    """AES-GCM authenticated decryption: verifies the tag (traced
+    constant-time compare) BEFORE returning plaintext; raises
+    ``TagMismatchError`` on failure — never partial plaintext."""
+    key, iv = bytes(bytearray(key)), bytes(bytearray(iv))
+    aad = bytes(bytearray(aad))
+    ct = bytes(bytearray(ciphertext))
+    tag = bytes(bytearray(tag))
+    pt, want = _gcm_crypt(key, iv, aad, ct, engine, OPEN)
+    if len(tag) != 16 or not bool(tag_eq_words(
+            packing.np_bytes_to_words(np.frombuffer(want, np.uint8)),
+            packing.np_bytes_to_words(np.frombuffer(tag, np.uint8)))):
+        raise TagMismatchError("GCM tag mismatch")
+    return pt

@@ -293,6 +293,7 @@ def _entries(engines):
     the shape-specialization check can trace at two sizes."""
     import numpy as np
 
+    from ..aead import gcm as aead_gcm
     from ..models import aes, arc4, rc4
     from ..ops import bitslice
 
@@ -312,6 +313,15 @@ def _entries(engines):
 
     def slots(n):  # per-block key-slot indices — PUBLIC batch layout
         return np.zeros(n, np.uint32)
+
+    def hmat(_n):  # one mul-by-H GHASH matrix — KEY-DERIVED (secret)
+        return np.zeros((128, 128), np.uint32)
+
+    def hmat_stack(_n):  # the fused dispatch's (K, 128, 128) H stack
+        return np.zeros((4, 128, 128), np.uint32)
+
+    def keep(n):  # per-row segment-reset mask — PUBLIC batch layout
+        return np.ones(n, np.uint32)
 
     out = []
     for eng in engines:
@@ -351,6 +361,34 @@ def _entries(engines):
              lambda ww, vv, kk, e=eng: aes.cbc_decrypt_words(ww, vv, kk,
                                                              NR, e),
              (w, iv, rk), {0, 2}),
+            # The parallel CBC-decrypt serve seam (ot-aead): the
+            # scattered multikey decrypt core under the PREV-stream XOR.
+            # The prev stream (arg 1) is ciphertext-derived — secret;
+            # the slot vector stays public batch layout.
+            (f"aes-cbc-dec-scattered-multikey[{eng}]",
+             lambda ww, pp, ks, sl, e=eng:
+                 aes.cbc_decrypt_words_scattered_multikey(ww, pp, ks, sl,
+                                                          NR, e),
+             (w, w, rk_stack, slots), {0, 1, 2}),
+            # The fused GCM dispatch (aead/gcm.py): scattered CTR +
+            # segmented Horner GHASH in one program, both directions
+            # (distinct compiled programs — the static direction arg).
+            # Secret: payload words, the schedule stack, the mul-by-H
+            # matrices (key-derived), and the AAD-prefix inject states;
+            # public: counters, the slot vector, the seg_keep mask.
+            # GHASH is taint-SENSITIVE by construction here: the mul-by-H
+            # formulation is pure XOR/AND matvec, so a secret-indexed
+            # lookup in this entry is a REAL finding (docs/ANALYSIS.md).
+            (f"aes-gcm-fused-seal[{eng}]",
+             lambda ww, cc, ks, sl, hm, inj, kp, e=eng:
+                 aead_gcm.gcm_crypt_ghash_words(ww, cc, ks, sl, hm, inj,
+                                                kp, NR, e, aead_gcm.SEAL),
+             (w, w, rk_stack, slots, hmat_stack, w, keep), {0, 2, 4, 5}),
+            (f"aes-gcm-fused-open[{eng}]",
+             lambda ww, cc, ks, sl, hm, inj, kp, e=eng:
+                 aead_gcm.gcm_crypt_ghash_words(ww, cc, ks, sl, hm, inj,
+                                                kp, NR, e, aead_gcm.OPEN),
+             (w, w, rk_stack, slots, hmat_stack, w, keep), {0, 2, 4, 5}),
             (f"aes-cfb-dec[{eng}]",
              lambda ww, vv, kk, e=eng: aes.cfb128_decrypt_words(ww, vv, kk,
                                                                 NR, e),
@@ -390,6 +428,18 @@ def _entries(engines):
         ("bitslice-dec[kernel]",
          lambda ww, kk: bitslice.decrypt_words(ww, kk, NR),
          (w, rk), {0, 1}),
+        # The standalone GHASH kernel and the traced constant-time tag
+        # compare (aead/gcm.py) — taint-sensitive entries: the mul-by-H
+        # bit-matrix formulation exists precisely so these contain no
+        # memory indirection at all (the byte-table GHASH variant is
+        # host-only for the same reason, ops/gf.py module docstring);
+        # a secret-indexed lookup here is a REAL finding.
+        ("ghash[horner]",
+         lambda ww, hm: aead_gcm.ghash_words(ww, hm),
+         (w, hmat), {0, 1}),
+        ("gcm-tag-eq[kernel]",
+         lambda a, b: aead_gcm.tag_eq_words(a, b),
+         (iv, iv), {0, 1}),
     ]
     return out
 

@@ -1,6 +1,11 @@
-"""Cipher models: AES contexts/modes, phase-split ARC4, fused RC4."""
+"""Cipher models: AES contexts/modes, AES-GCM seal/open, phase-split
+ARC4, fused RC4."""
 
 from .aes import AES, AES_DECRYPT, AES_ENCRYPT  # noqa: F401
 from .base import DIR_BOTH, DIR_DECRYPT, DIR_ENCRYPT, AESCipher, BlockCipher  # noqa: F401
 from .arc4 import ARC4  # noqa: F401
 from .rc4 import RC4  # noqa: F401
+# The AEAD public API (aead/gcm.py) re-exported at the models layer —
+# imported LAST: aead.gcm reaches back into models.aes, which the lines
+# above have already bound on the package.
+from ..aead.gcm import TagMismatchError, gcm_open, gcm_seal  # noqa: F401,E402

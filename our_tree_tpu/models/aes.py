@@ -574,6 +574,66 @@ MULTIKEY_CTR["jnp"] = _multikey_jnp
 MULTIKEY_CTR["bitslice"] = _multikey_bitslice
 
 
+#: Multi-key CBC-DECRYPT cores: (cipher2, prev2, rks_dec, key_slots,
+#: nr) -> plain2, where prev2 is the shifted ciphertext stream (IV at
+#: each request's first block) the batcher materialises host-side —
+#: P_i = D(C_i) ^ C_{i-1} reads only ciphertext, so decryption is
+#: data-parallel even though encryption is a true recurrence (the
+#: reference does BOTH serially, aes.c:757-816). Same fixed-K stacked
+#: dispatch shape as MULTIKEY_CTR, with the DECRYPT (InvMixColumns-
+#: folded) schedule stack; engines without an entry fall back to the
+#: bitsliced circuit inside the jit.
+MULTIKEY_CBC: dict[str, object] = {}
+
+
+def _multikey_cbc_jnp(c2, prev2, rks_dec, key_slots, nr):
+    """T-table multi-key CBC decrypt: public schedule gather + vmapped
+    oracle decrypt core, shifted-XOR against the host-built prev
+    stream. Same documented jnp timing-channel tradeoff (baselined)."""
+    rkb = rks_dec[key_slots]  # (N, 4*(nr+1)) — public gather
+    return jax.vmap(lambda c, r: block.decrypt_words(c, r, nr))(
+        c2, rkb) ^ prev2
+
+
+def _multikey_cbc_bitslice(c2, prev2, rks_dec, key_slots, nr):
+    from ..ops import bitslice as _bs
+
+    return _bs.decrypt_words_multikey(c2, rks_dec[key_slots], nr) ^ prev2
+
+
+MULTIKEY_CBC["jnp"] = _multikey_cbc_jnp
+MULTIKEY_CBC["bitslice"] = _multikey_cbc_bitslice
+
+
+@functools.partial(jax.jit, static_argnums=(4, 5, 6))
+def _cbc_dec_scattered_multikey_jit(words, prev_words, rks_dec, key_slots,
+                                    nr, engine, knobs):
+    del knobs
+    w2 = _as_block_words(words)
+    p2 = _as_block_words(prev_words)
+    fn = MULTIKEY_CBC.get(engine, _multikey_cbc_bitslice)
+    return fn(w2, p2, rks_dec, key_slots.astype(jnp.uint32),
+              nr).reshape(words.shape)
+
+
+def cbc_decrypt_words_scattered_multikey(words, prev_words, rks_dec,
+                                         key_slots, nr, engine="jnp"):
+    """Parallel CBC decrypt across many requests and K keys in ONE
+    dispatch: ``words`` the concatenated ciphertext blocks, and
+    ``prev_words`` the per-block XOR stream — each request's IV at its
+    first block, then its own shifted ciphertext (serve/batcher.py
+    materialises it exactly like the scattered counters, so CBC rides
+    the rung-packer with the SAME closed shapes as CTR). ``rks_dec`` is
+    the (K, 4*(nr+1)) DECRYPT schedule stack (keycache builds it beside
+    the encrypt one), ``key_slots`` the public per-block slot vector.
+    CBC *encrypt* stays a per-stream recurrence and is deliberately not
+    servable — the reference ships both directions serial
+    (aes.c:757-816); only the decrypt direction parallelises."""
+    return _cbc_dec_scattered_multikey_jit(words, prev_words, rks_dec,
+                                           key_slots, nr, engine,
+                                           _engine_knobs_key(engine))
+
+
 @functools.partial(jax.jit, static_argnums=(4, 5, 6))
 def _ctr_scattered_multikey_jit(words, ctr_le_words, rks, key_slots, nr,
                                 engine, knobs):

@@ -608,6 +608,21 @@ def hist_merged(name: str) -> dict:
     return merge_buckets(parts)
 
 
+def counter_by_label(name: str, label_key: str) -> dict:
+    """label value -> summed total for one counter name, grouped by one
+    label key (e.g. ``serve_requests`` by ``mode`` — the per-workload
+    split the serve artifact and obs.report render)."""
+    out: dict[str, float] = {}
+    with _LOCK:
+        for (n, labels), v in _COUNTS.items():
+            if n != name:
+                continue
+            lv = dict(labels).get(label_key)
+            if lv is not None:
+                out[str(lv)] = out.get(str(lv), 0) + v
+    return dict(sorted(out.items()))
+
+
 def hist_by_label(name: str, label_key: str) -> dict:
     """label value -> merged buckets for one histogram name, grouped by
     one label key (e.g. ``serve_stage_us`` by ``stage``)."""

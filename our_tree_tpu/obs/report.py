@@ -314,6 +314,57 @@ def render(run: export.Run, top: int = 10, out=sys.stdout,
                ["lane", "dispatches", "probes", "device_s", "busy",
                 "killed"], out)
 
+    # -- per-mode dispatch (serve) -----------------------------------------
+    # The served-workload split (ot-aead): `mode` rides the request,
+    # batch-blocks, dispatch-latency, and auth-failure series
+    # (serve/queue.py MODES — ctr, gcm, gcm-open, cbc), so a mixed-mode
+    # run renders one row per mode: exact request/auth-failed totals
+    # from the counters, batches + payload blocks from the
+    # serve_batch_blocks histogram, dispatch-latency p50/p95 from the
+    # serve_dispatch_us buckets. Registry-fed, so the table stays exact
+    # at any OT_TRACE_SAMPLE rate.
+    if run.snapshots:
+        totals_m = run.metrics_totals()
+
+        def _by_mode(series: dict, name: str) -> dict:
+            got: dict[str, list] = {}
+            for key, v in series.items():
+                m = re.fullmatch(re.escape(name) + r"\{(.*)\}", key)
+                if not m:
+                    continue
+                labels = dict(p.split("=", 1)
+                              for p in m.group(1).split(",") if "=" in p)
+                mode = labels.get("mode")
+                if mode is not None:
+                    got.setdefault(mode, []).append(v)
+            return got
+
+        req_c = _by_mode(totals_m["counters"], "serve_requests")
+        auth_c = _by_mode(totals_m["counters"], "serve_auth_failed")
+        blocks_h = _by_mode(totals_m["hists"], "serve_batch_blocks")
+        disp_h = _by_mode(totals_m["hists"], "serve_dispatch_us")
+        mode_keys = sorted(set(req_c) | set(blocks_h) | set(disp_h))
+        if mode_keys:
+            rows = []
+            for mk in mode_keys:
+                batches = sum(h["count"] for h in blocks_h.get(mk, []))
+                blocks = sum(h["sum"] for h in blocks_h.get(mk, []))
+                disp = _metrics.merge_buckets(
+                    [h["buckets"] for h in disp_h.get(mk, [])])
+                rows.append([
+                    mk, f"{sum(req_c.get(mk, [0])):g}",
+                    str(batches), f"{blocks:g}",
+                    (f"{_metrics.percentile_from_buckets(disp, 50):.0f}"
+                     if disp else "-"),
+                    (f"{_metrics.percentile_from_buckets(disp, 95):.0f}"
+                     if disp else "-"),
+                    f"{sum(auth_c.get(mk, [0])):g}",
+                ])
+            out.write("\nper-mode dispatch (serve):\n")
+            _table(rows, ["mode", "requests", "batches", "blocks",
+                          "disp_p50_us", "disp_p95_us", "auth_failed"],
+                   out)
+
     # -- per-backend dispatch (route) --------------------------------------
     # The routing tier's fault-domain breakdown, mirroring the per-lane
     # table one level up: `route-dispatch` / `backend-probe` spans carry

@@ -1114,3 +1114,22 @@ def encrypt_words_multikey(words: jnp.ndarray, rk_blocks: jnp.ndarray,
     out = _crypt_planes(to_planes(padded), multikey_planes(rk_blocks, nr),
                         nr, encrypt_round)
     return from_planes(out)[:n]
+
+
+def decrypt_words_multikey(words: jnp.ndarray, rk_blocks: jnp.ndarray,
+                           nr: int) -> jnp.ndarray:
+    """Bitsliced batch decrypt where block i uses its OWN
+    InvMixColumns-folded schedule — the decrypt twin of
+    ``encrypt_words_multikey`` (the parallel CBC-decrypt serve seam:
+    models/aes.py:cbc_decrypt_words_scattered_multikey). The inverse
+    round circuit is key-oblivious exactly like the forward one, so K
+    keys again cost one ``to_planes`` pass over the gathered schedules."""
+    padded, n = _pad32(words)
+    pad = padded.shape[0] - rk_blocks.shape[0]
+    if pad:
+        rk_blocks = jnp.concatenate(
+            [rk_blocks,
+             jnp.zeros((pad, rk_blocks.shape[1]), rk_blocks.dtype)], axis=0)
+    out = _crypt_planes(to_planes(padded), multikey_planes(rk_blocks, nr),
+                        nr, decrypt_round)
+    return from_planes(out)[:n]

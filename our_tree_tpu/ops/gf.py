@@ -1,9 +1,34 @@
-"""GF(2^8) arithmetic for AES (Rijndael field, modulus x^8+x^4+x^3+x+1).
+"""GF(2^8) and GF(2^128) arithmetic for AES and GCM.
 
-Host-side numpy, used only at import time to generate lookup tables and
-key schedules. This replaces the reference's runtime table generator
-(`aes_gen_tables`, reference aes-modes/aes.c:361-435) with a from-scratch
-implementation derived directly from FIPS-197; nothing here is traced by JAX.
+Host-side numpy/int, used at import time (table and key-schedule
+generation) and at KEY time (GHASH mul-by-H matrix derivation). The
+GF(2^8) half replaces the reference's runtime table generator
+(`aes_gen_tables`, reference aes-modes/aes.c:361-435) with a
+from-scratch implementation derived directly from FIPS-197; nothing
+here is traced by JAX.
+
+The GF(2^128) half is the GCM field (SP 800-38D §6.3: modulus
+x^128 + x^7 + x^2 + x + 1, "reflected" bit order — the first byte's
+most significant bit is the coefficient of x^0). Three formulations of
+the same multiply, the per-primitive table-vs-dense tradeoff the engine
+tiers map one field down (docs/ENGINES.md):
+
+* ``gf128_mul`` — the bit-serial int reference (the parity twin every
+  other formulation is pinned against);
+* ``gf128_mul_table`` + ``gf128_tables`` — the byte-at-a-time
+  precomputed-table variant (Shoup's method). HOST-ONLY on purpose: a
+  traced version would index a key-derived table by secret GHASH state
+  bytes — exactly the T-table timing channel the jaxpr auditor exists
+  to flag (``constant-time`` on a secret-indexed gather);
+* ``gf128_mul_matrix_words`` — multiply-by-a-FIXED-H as a 128x128
+  GF(2) matrix: carry-less multiply is linear over GF(2) in one
+  operand, so the traced GHASH kernel (aead/gcm.py) becomes pure
+  XOR/AND matvec work on ``bitslice.py`` idioms — zero memory
+  indirection, constant-time by construction. The matrix basis is
+  WORD-BIT order (bit k = bit k%32 of LE-packed u32 word k//32 — i.e.
+  byte k//8, bit k%8 of the block's byte stream), matching how the
+  dispatch arrays already hold blocks, so the kernel never reshuffles
+  bytes.
 """
 
 from __future__ import annotations
@@ -55,3 +80,112 @@ def ginv(a: int) -> int:
 def gmul_table(c: int) -> np.ndarray:
     """(256,) uint32 table of gmul(c, x) for all x — used for table generation."""
     return np.array([gmul(c, x) for x in range(256)], dtype=np.uint32)
+
+
+# ---------------------------------------------------------------------------
+# GF(2^128): the GCM/GHASH field.
+#
+# Elements are 128-bit Python ints in the SPEC's bit-string order: the
+# block's bytes big-endian, so int bit (127 - j) is the coefficient of
+# x^j. ``R`` is the reduction constant 11100001 || 0^120 from SP
+# 800-38D §6.3.
+# ---------------------------------------------------------------------------
+
+#: The GCM reduction constant: x^128 = x^7 + x^2 + x + 1, reflected.
+GCM_R = 0xE1 << 120
+
+
+def gf128_mul(x: int, y: int) -> int:
+    """Bit-serial carry-less multiply in GF(2^128), reduced (SP 800-38D
+    algorithm 1 translated to the big-endian int representation). The
+    reference every table/matrix formulation is pinned against."""
+    z, v = 0, x
+    for i in range(128):
+        if (y >> (127 - i)) & 1:
+            z ^= v
+        v = (v >> 1) ^ (GCM_R if v & 1 else 0)
+    return z
+
+
+def block_to_int(b) -> int:
+    """16 block bytes -> the field element (big-endian bit string)."""
+    return int.from_bytes(bytes(bytearray(b)), "big")
+
+
+def int_to_block(z: int) -> bytes:
+    """Field element -> 16 block bytes."""
+    return z.to_bytes(16, "big")
+
+
+#: x^8 as a field element (int bit 119): the per-byte shift constant
+#: the table variant's Horner step multiplies by.
+_X8 = 1 << 119
+
+
+def gf128_tables(h: int) -> tuple[np.ndarray, np.ndarray]:
+    """The byte-table variant's two precomputed tables for a fixed H:
+    ``T0[b]`` = (b as the block's FIRST byte) * H, and ``R8[c]`` = the
+    reduction feed-in of multiplying an element whose LAST byte is c by
+    x^8. Both (256,) object arrays of ints (128-bit values)."""
+    t0 = np.array([gf128_mul(b << 120, h) for b in range(256)],
+                  dtype=object)
+    r8 = np.array([gf128_mul(c, _X8) for c in range(256)], dtype=object)
+    return t0, r8
+
+
+def gf128_mul_table(x: int, tables: tuple[np.ndarray, np.ndarray]) -> int:
+    """x * H byte-at-a-time via the precomputed tables (Shoup's method):
+    Horner over x's 16 bytes, one table hit + one shift-reduce per byte.
+    16 secret-indexed lookups per block — the formulation a traced
+    kernel must NOT use (module docstring); host twin only."""
+    t0, r8 = tables
+    z = 0
+    for i in range(15, -1, -1):
+        z = (z >> 8) ^ int(r8[z & 0xFF])          # z *= x^8, reduced
+        z ^= int(t0[(x >> (8 * (15 - i))) & 0xFF])
+    return z
+
+
+def wordbit_to_int(j: int) -> int:
+    """The field element whose only set WORD-BIT is j (word-bit k =
+    byte k//8, bit k%8 of the block's byte stream — the LE-u32-packed
+    dispatch layout)."""
+    byte_i, bit_t = j // 8, j % 8
+    b = bytearray(16)
+    b[byte_i] = 1 << bit_t
+    return block_to_int(b)
+
+
+def int_to_wordbits(z: int) -> np.ndarray:
+    """Field element -> (128,) 0/1 uint32 vector in word-bit order."""
+    b = int_to_block(z)
+    out = np.empty(128, dtype=np.uint32)
+    for i in range(16):
+        for t in range(8):
+            out[8 * i + t] = (b[i] >> t) & 1
+    return out
+
+
+def gf128_mul_matrix_words(h: int) -> np.ndarray:
+    """Multiply-by-H as a (128, 128) GF(2) uint32 matrix in the
+    WORD-BIT basis: column j = (word-bit j) * H. Carry-less multiply is
+    linear over GF(2) in x for fixed H, so ``(M @ bits(x)) & 1`` IS the
+    field multiply — the traced GHASH kernel's whole arithmetic
+    (aead/gcm.py), no lookups, no carries. Derived per key at the
+    keycache seam (H = E_K(0^128)); ~64 KiB per key."""
+    m = np.empty((128, 128), dtype=np.uint32)
+    for j in range(128):
+        m[:, j] = int_to_wordbits(gf128_mul(wordbit_to_int(j), h))
+    return m
+
+
+def gf128_matvec_words(m: np.ndarray, x: int) -> int:
+    """Host matvec twin of the traced kernel's step: x * H via the
+    word-bit matrix (tests pin it against ``gf128_mul``)."""
+    bits = int_to_wordbits(x)
+    out = (m @ bits) & 1
+    z = 0
+    for j in range(128):
+        if out[j]:
+            z |= wordbit_to_int(j)
+    return z

@@ -72,9 +72,12 @@ def expand_key_enc(key: bytes) -> tuple[int, np.ndarray]:
     return nr, np.array(w, dtype=np.uint32)
 
 
-def expand_key_dec(key: bytes) -> tuple[int, np.ndarray]:
-    """Expand an AES key for decryption (equivalent inverse cipher schedule)."""
-    nr, enc = expand_key_enc(key)
+def dec_schedule_from_enc(nr: int, enc: np.ndarray) -> np.ndarray:
+    """The decrypt schedule as a pure function of the ENCRYPT schedule:
+    reversed round order with InvMixColumns on the interior round keys
+    (`aes_setkey_dec`, aes.c:547-599). Split out so holders of an
+    expanded encrypt schedule (the serve keycache's stacked view) can
+    derive the decrypt twin without re-touching key bytes."""
     dec = np.zeros_like(enc)
     # Round 0 of decryption = last round key of encryption, untransformed.
     dec[0:4] = enc[4 * nr : 4 * nr + 4]
@@ -84,7 +87,13 @@ def expand_key_dec(key: bytes) -> tuple[int, np.ndarray]:
         dec[4 * r : 4 * r + 4] = inv_mix_columns_word(src)
     # Final: the original first round key.
     dec[4 * nr : 4 * nr + 4] = enc[0:4]
-    return nr, dec
+    return dec
+
+
+def expand_key_dec(key: bytes) -> tuple[int, np.ndarray]:
+    """Expand an AES key for decryption (equivalent inverse cipher schedule)."""
+    nr, enc = expand_key_enc(key)
+    return nr, dec_schedule_from_enc(nr, enc)
 
 
 # ---------------------------------------------------------------------------

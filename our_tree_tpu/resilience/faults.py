@@ -113,6 +113,17 @@ point              wired into
                    ``serve.bench --slo`` regression gate red in CI
                    (docs/OBSERVABILITY.md) — no error counters move,
                    only the latency/goodput SLOs.
+``tag_mismatch``   the serve GCM tag-verify seam
+                   (``serve/server.py:_gcm_finish``): the next
+                   ``gcm-open`` request's computed tag is treated as
+                   mismatched, so that ONE request is answered the
+                   per-request ``auth-failed`` refusal while its batch
+                   riders are untouched — the deterministic way CI
+                   drives the authentication-failure path (no
+                   exception, no failover, no lost request; the server
+                   must keep serving). Fires at the host finisher, not
+                   inside the fused kernel: a real mismatch is a DATA
+                   event, not a dispatch fault.
 =================  ========================================================
 
 Determinism contract: firings consume counts in call order within ONE
@@ -140,7 +151,7 @@ import time
 KNOWN_POINTS = ("init_hang", "dispatch_fail", "build_fail", "lock_busy",
                 "dispatch_hang", "unit_crash", "serve_dispatch",
                 "lane_fail", "lane_hang", "dispatch_slow",
-                "backend_fail", "backend_hang")
+                "backend_fail", "backend_hang", "tag_mismatch")
 
 #: Scope names the ``@<scope>=<i>`` qualifier accepts: ``lane`` (serve
 #: dispatch lanes) and ``backend`` (the router's backend index).

@@ -39,10 +39,12 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from ..aead import ghash as aead_ghash
 from ..obs import metrics
+from ..ops import gf
 from ..ops.keyschedule import ROUNDS
 from ..utils import packing
-from .queue import Request
+from .queue import GCM_MODES, Request
 
 #: Default ladder bounds, in 16-byte blocks. Floor 32: the bitsliced
 #: engines pack 32 blocks per lane group, so smaller rungs only add
@@ -113,16 +115,40 @@ class Slot:
 
 @dataclass
 class Batch:
-    """One formed dispatch: up to K key slots, padded to a ladder rung."""
+    """One formed dispatch: up to K key slots, padded to a ladder rung.
+
+    ``mode`` is uniform across the batch (the packer never mixes
+    modes: each mode compiles its own dispatch program — GHASH
+    direction and the CBC decrypt core are static arguments — and a
+    mixed batch would be two programs in one shape). Per-mode array
+    semantics (``materialise``):
+
+    * ``ctr`` — words/ctr_words/slot_index exactly as always;
+    * ``gcm``/``gcm-open`` — each request's rows are [J0, data...]:
+      ``ctr_words`` carries J0 then inc32 counters, ``seg_keep`` zeroes
+      the GHASH carry at each J0/first-data row, ``inject_words`` seeds
+      each request's first data row with its host-computed AAD prefix
+      state (needs ``sched`` — the per-slot H);
+    * ``cbc`` — ``ctr_words`` is repurposed as the PREV stream (IV at
+      each request's first block, then its shifted ciphertext): the
+      XOR side of P_i = D(C_i) ^ C_{i-1}.
+    """
 
     slots: list[Slot]
     bucket: int                  #: padded block count (the rung)
     blocks: int                  #: real (unpadded) payload block count
     nr: int                      #: round count (uniform across slots)
     key_slots: int               #: the fixed K dimension
+    mode: str = "ctr"            #: uniform served mode (queue.MODES)
     words: np.ndarray | None = field(default=None, repr=False)
     ctr_words: np.ndarray | None = field(default=None, repr=False)
     slot_index: np.ndarray | None = field(default=None, repr=False)
+    #: GCM only: the fused kernel's segment arrays (aead/gcm.py)
+    inject_words: np.ndarray | None = field(default=None, repr=False)
+    seg_keep: np.ndarray | None = field(default=None, repr=False)
+    #: per-request (data_start_block, nblocks) in ``requests`` order —
+    #: the split_output offsets (GCM rows skip each request's J0 row)
+    req_spans: list | None = field(default=None, repr=False)
     #: request layout [(slot, start_block, nblocks, nonce16)] — the
     #: native tier's per-request C CTR path consumes this instead of
     #: the materialised counter array (models.aes ``native_runs``)
@@ -135,7 +161,8 @@ class Batch:
     @property
     def label(self) -> str:
         first = self.slots[0].label if self.slots else "?"
-        return f"{first}+{len(self.slots) - 1}k:{self.bucket}"
+        suffix = "" if self.mode == "ctr" else f":{self.mode}"
+        return f"{first}+{len(self.slots) - 1}k:{self.bucket}{suffix}"
 
     @property
     def requests(self) -> list[Request]:
@@ -157,36 +184,51 @@ class Batch:
     def occupancy(self) -> float:
         return self.blocks / self.bucket
 
-    def materialise(self, counters: bool = True) -> None:
+    def materialise(self, counters: bool = True, sched=None) -> None:
         """Build the flat u32 dispatch arrays: payload words, per-block
         LE counter words, the per-block slot-index vector, and the
         request-layout ``runs``. Flat (4N,) words on purpose: the dense
         jit-boundary layout every models entry point shares
         (models/aes.py:_as_block_words). Padding blocks stay at slot 0 /
-        zero counters / zero payload — their keystream is discarded by
-        split_output's offsets.
+        zero counters / zero payload — their keystream (and, for GCM,
+        their GHASH lane) is discarded by ``req_spans``' offsets.
 
-        ``counters=False`` (the native-tier server) skips the counter
-        array and the slot vector entirely: the host tier consumes
-        ``runs`` — per-request (slot, start, nblocks, nonce) — and
-        generates counters inside C, so materialising an (N, 4) array
-        it would never read is a pure memory-bandwidth tax at exactly
-        the rungs where bandwidth is the budget.
+        ``counters=False`` (the native-tier server, ctr mode only)
+        skips the counter array and the slot vector entirely: the host
+        tier consumes ``runs`` — per-request (slot, start, nblocks,
+        nonce) — and generates counters inside C, so materialising an
+        (N, 4) array it would never read is a pure memory-bandwidth tax
+        at exactly the rungs where bandwidth is the budget.
+
+        ``sched`` (the keycache's StackedSchedules) is required for GCM
+        batches: each request's AAD prefix state Y_aad = GHASH(H, A) is
+        computed HOST-side here with its slot's H (``sched.h_ints``)
+        and injected into the fused kernel's first data row — the
+        variable-length AAD never enters the fixed dispatch shape.
 
         Assembly is allocation-lean — it sits between every payload
         byte and the engine: requests pack contiguously, so padding
         exists only as a TAIL and only the tail is zeroed (a full
         ``np.zeros`` re-touched every cache line before the copy
-        overwrote it); a single request exactly filling its rung skips
+        overwrote it); a ctr request exactly filling its rung skips
         the payload copy entirely (the request's own bytes viewed as
         words ARE the dispatch array — reads only downstream)."""
+        if self.mode in GCM_MODES:
+            self._materialise_gcm(sched)
+            return
+        if self.mode == "cbc":
+            self._materialise_cbc()
+            return
         runs = []
+        spans = []
         off = 0
         for si, slot in enumerate(self.slots):
             for req in slot.requests:
                 runs.append((si, off, req.nblocks, req.nonce))
+                spans.append((off, req.nblocks))
                 off += req.nblocks
         self.runs = runs
+        self.req_spans = spans
         reqs = self.requests
         if len(reqs) == 1 and reqs[0].nblocks == self.bucket:
             req = reqs[0]
@@ -224,9 +266,83 @@ class Batch:
             self.ctr_words = ctr.reshape(-1)
             self.slot_index = slot_index
 
+    def _materialise_gcm(self, sched) -> None:
+        """The GCM batch layout (aead/gcm.py module docstring): per
+        request, row 0 = J0 under a zero data word (its CTR output is
+        E_K(J0)), rows 1..n = payload under inc32 counters; ``seg_keep``
+        resets the Horner carry at each segment, ``inject_words`` seeds
+        each segment with its host-computed AAD prefix state."""
+        if sched is None or sched.h_ints is None:
+            raise ValueError("GCM materialise needs the stacked "
+                             "schedules' H (keycache.stacked mode=gcm)")
+        n_rows = self.bucket
+        words = np.zeros(4 * n_rows, dtype=np.uint32)
+        ctr = np.zeros((n_rows, 4), dtype=np.uint32)
+        slot_index = np.zeros(n_rows, dtype=np.uint32)
+        inject = np.zeros((n_rows, 4), dtype=np.uint32)
+        keep = np.ones(n_rows, dtype=np.uint32)
+        spans = []
+        off = 0
+        for si, slot in enumerate(self.slots):
+            h = sched.h_ints[si]
+            for req in slot.requests:
+                n = req.nblocks
+                j0 = bytes(req.iv) + b"\x00\x00\x00\x01"
+                aead_ghash.np_gcm_ctr_blocks(
+                    j0, _block_idx(n + 1), out=ctr[off:off + n + 1])
+                words[4 * (off + 1):4 * (off + 1 + n)] = (
+                    packing.np_bytes_to_words(req.payload))
+                slot_index[off:off + n + 1] = si
+                keep[off] = 0          # J0 row: GHASH lane discarded
+                keep[off + 1] = 0      # first data row: fresh Horner chain
+                y_aad = (aead_ghash.ghash_int(
+                    h, aead_ghash.pad16(bytes(req.aad))) if req.aad else 0)
+                if y_aad:
+                    inject[off + 1] = packing.np_bytes_to_words(
+                        np.frombuffer(gf.int_to_block(y_aad), np.uint8))
+                spans.append((off + 1, n))
+                off += n + 1
+        self.words = words
+        self.ctr_words = ctr.reshape(-1)
+        self.slot_index = slot_index
+        self.inject_words = inject.reshape(-1)
+        self.seg_keep = keep
+        self.req_spans = spans
+        self.runs = None
+
+    def _materialise_cbc(self) -> None:
+        """The CBC-decrypt batch layout: ``ctr_words`` carries the PREV
+        stream — each request's IV at its first block, then its own
+        ciphertext shifted one block (P_i = D(C_i) ^ C_{i-1} reads only
+        ciphertext, which is why decrypt batches at all)."""
+        words = np.zeros(4 * self.bucket, dtype=np.uint32)
+        prev = np.zeros(4 * self.bucket, dtype=np.uint32)
+        slot_index = np.zeros(self.bucket, dtype=np.uint32)
+        spans = []
+        off = 0
+        for si, slot in enumerate(self.slots):
+            for req in slot.requests:
+                n = req.nblocks
+                w = packing.np_bytes_to_words(req.payload)
+                words[4 * off:4 * (off + n)] = w
+                prev[4 * off:4 * off + 4] = packing.np_bytes_to_words(
+                    np.frombuffer(bytes(req.iv), np.uint8))
+                if n > 1:
+                    prev[4 * (off + 1):4 * (off + n)] = w[:4 * (n - 1)]
+                slot_index[off:off + n] = si
+                spans.append((off, n))
+                off += n
+        self.words = words
+        self.ctr_words = prev
+        self.slot_index = slot_index
+        self.req_spans = spans
+        self.runs = None
+
     def split_output(self, out_words: np.ndarray) -> list[np.ndarray]:
         """Per-request output bytes (slot order, then request order —
-        the ``requests`` property's order) from the batch's output.
+        the ``requests`` property's order) from the batch's output,
+        using the ``req_spans`` offsets materialise built (GCM spans
+        skip each request's J0 row).
 
         A request spanning the ENTIRE dispatch buffer (the big-payload
         fast path: one request exactly filling its rung) gets a
@@ -240,15 +356,20 @@ class Batch:
         as READ-ONLY where response payloads have always been
         caller-mutable."""
         flat = np.asarray(out_words, dtype=np.uint32).reshape(-1)
+        spans = self.req_spans
+        if spans is None:
+            # Pre-materialise callers (tests, tools): ctr's contiguous
+            # layout derives straight from the request order.
+            spans, off = [], 0
+            for req in self.requests:
+                spans.append((off, req.nblocks))
+                off += req.nblocks
         outs = []
-        off = 0
-        for req in self.requests:
-            n = req.nblocks
+        for off, n in spans:
             w = flat[4 * off:4 * (off + n)]
-            if 4 * n != flat.size or not flat.flags.writeable:
+            if 4 * n != flat.size or off != 0 or not flat.flags.writeable:
                 w = w.copy()
             outs.append(packing.np_words_to_bytes(w))
-            off += n
         return outs
 
 
@@ -256,23 +377,25 @@ def form_batches(requests: list[Request],
                  rungs: tuple[int, ...],
                  key_digest,
                  key_slots: int = DEFAULT_KEY_SLOTS) -> list[Batch]:
-    """The rung-packer: group by (tenant, key digest) in arrival order,
-    then pack up to ``key_slots`` groups per batch, filling to the
-    ladder ceiling and padding to the smallest rung that holds what was
-    packed. A batch is flushed when it runs out of block capacity, when
-    an unstarted group finds all K slots taken, or when the next group's
-    key length (round count) differs — ``nr`` is a static compile
-    argument and may not vary inside one dispatch. Array
-    materialisation is deferred to the caller (the server times it
-    under its ``batch-formed`` span).
+    """The rung-packer: group by (mode, tenant, key digest) in arrival
+    order, then pack up to ``key_slots`` groups per batch, filling to
+    the ladder ceiling and padding to the smallest rung that holds what
+    was packed. A batch is flushed when it runs out of block capacity,
+    when an unstarted group finds all K slots taken, or when the next
+    group's key length (round count) OR MODE differs — ``nr``, the
+    GHASH direction, and the CBC core are all static compile arguments,
+    so neither may vary inside one dispatch (batches never mix modes).
+    Capacity counts ``span_blocks`` (GCM requests carry their J0 row).
+    Array materialisation is deferred to the caller (the server times
+    it under its ``batch-formed`` span).
     """
     if key_slots < 1:
         raise ValueError("key_slots must be >= 1")
     ceiling = rungs[-1]
-    groups: dict[tuple[str, str], list[Request]] = {}
-    order: list[tuple[str, str]] = []
+    groups: dict[tuple, list[Request]] = {}
+    order: list[tuple] = []
     for req in requests:
-        k = (req.tenant, key_digest(req.key))
+        k = (req.mode, req.tenant, key_digest(req.key))
         if k not in groups:
             groups[k] = []
             order.append(k)
@@ -280,43 +403,51 @@ def form_batches(requests: list[Request],
 
     batches: list[Batch] = []
     cur_slots: list[Slot] = []
-    cur_blocks = 0
+    cur_blocks = 0     # payload blocks packed (the occupancy numerator)
+    cur_span = 0       # batch rows used (payload + GCM J0 rows)
     cur_nr = None
+    cur_mode = None
 
     def flush():
-        nonlocal cur_slots, cur_blocks, cur_nr
+        nonlocal cur_slots, cur_blocks, cur_span, cur_nr, cur_mode
         if cur_slots:
-            bucket = bucket_for(cur_blocks, rungs)
+            bucket = bucket_for(cur_span, rungs)
             batches.append(Batch(cur_slots, bucket,
-                                 cur_blocks, cur_nr, key_slots))
+                                 cur_blocks, cur_nr, key_slots,
+                                 mode=cur_mode))
             # The rung-packer's live distributions (obs/metrics.py):
             # payload blocks per formed batch, labeled by its rung (the
             # per-rung occupancy the SERVE artifact histograms post-hoc,
-            # now continuously on /metrics), and key slots packed per
-            # batch (the coalesce shape — fragmentation regressions show
-            # up as this histogram collapsing toward 1).
-            metrics.observe("serve_batch_blocks", cur_blocks, rung=bucket)
+            # now continuously on /metrics) and mode (the per-workload
+            # split), and key slots packed per batch (the coalesce
+            # shape — fragmentation regressions show up as this
+            # histogram collapsing toward 1).
+            metrics.observe("serve_batch_blocks", cur_blocks,
+                            rung=bucket, mode=cur_mode)
             metrics.observe("serve_batch_slots", len(cur_slots))
-        cur_slots, cur_blocks, cur_nr = [], 0, None
+        cur_slots, cur_blocks, cur_span = [], 0, 0
+        cur_nr = cur_mode = None
 
-    for tenant, digest in order:
-        pending = groups[(tenant, digest)]
+    for mode, tenant, digest in order:
+        pending = groups[(mode, tenant, digest)]
         nr = ROUNDS[len(pending[0].key) * 8]
-        if cur_nr is not None and nr != cur_nr:
+        if cur_nr is not None and (nr != cur_nr or mode != cur_mode):
             flush()
         if len(cur_slots) >= key_slots:
             flush()
         slot = None
         for req in pending:
-            if cur_slots and cur_blocks + req.nblocks > ceiling:
+            if cur_slots and cur_span + req.span_blocks > ceiling:
                 flush()
                 slot = None
             if slot is None:
                 slot = Slot(tenant, digest, req.key, [], 0)
                 cur_slots.append(slot)
                 cur_nr = nr
+                cur_mode = mode
             slot.requests.append(req)
             slot.blocks += req.nblocks
             cur_blocks += req.nblocks
+            cur_span += req.span_blocks
     flush()
     return batches

@@ -96,7 +96,8 @@ async def _drive(args, probes):
         probe_every=args.probe_every,
         journal=args.journal,
         max_inflight=args.max_inflight,
-        status_port=args.status_port)
+        status_port=args.status_port,
+        modes=args.mode_list)
     server = Server(cfg)
     await server.start()
     report = await loadgen.run(
@@ -104,7 +105,7 @@ async def _drive(args, probes):
         sizes=args.sizes, tenants=args.tenants,
         keys_per_tenant=args.keys_per_tenant, seed=args.seed,
         verify_every=args.verify_every, probes=probes,
-        arrival_rate=args.arrival_rate)
+        arrival_rate=args.arrival_rate, modes=args.mode_list)
     await server.stop()
     return server, report
 
@@ -152,6 +153,13 @@ def main(argv=None) -> int:
     ap.add_argument("--mixed-sizes", action="store_true",
                     help=f"request sizes drawn from {loadgen.MIXED_SIZES} "
                          "(the ladder-exercising menu)")
+    ap.add_argument("--sizes", default=None, metavar="B1,B2",
+                    help="explicit request-size menu in bytes (comma "
+                         "list; overrides --mixed-sizes/--size-bytes). "
+                         "The mixed-MODE drive wants the top size one "
+                         "rung under the ceiling: a GCM request carries "
+                         "its J0 row, so a payload exactly filling the "
+                         "ceiling refuses too-large in gcm modes")
     ap.add_argument("--size-bytes", type=int, default=4096,
                     help="fixed request size when --mixed-sizes is off")
     ap.add_argument("--tenants", type=int, default=4)
@@ -162,6 +170,14 @@ def main(argv=None) -> int:
                          f"{loadgen.TENANT_HEAVY_SIZES} — full rungs can "
                          "only come from multi-key packing (the "
                          "coalesce_efficiency rehearsal)")
+    ap.add_argument("--modes", default="ctr", metavar="M1,M2",
+                    help="served-mode MIX (comma list from ctr, gcm, "
+                         "gcm-open, cbc): the server enables and warms "
+                         "exactly these ladders, and the loadgen draws "
+                         "each request's mode uniformly from them — the "
+                         "mixed-workload drive (docs/SERVING.md AEAD "
+                         "section). gcm probes pin ciphertext AND tag "
+                         "bit-exactly against the pure-host reference")
     ap.add_argument("--engine", default="auto",
                     help="serve engine tier: auto (ranked jax ladder on "
                          "an accelerator, native AESNI host tier on "
@@ -263,11 +279,19 @@ def main(argv=None) -> int:
         args.sizes = loadgen.TENANT_HEAVY_SIZES
         args.tenants = max(args.tenants, 24)
         args.keys_per_tenant = 1
+    elif args.sizes:
+        args.sizes = tuple(int(s) for s in args.sizes.split(",") if s)
     else:
         args.sizes = (loadgen.MIXED_SIZES if args.mixed_sizes
                       else (args.size_bytes,))
     if args.key_slots is None:
         args.key_slots = batcher.DEFAULT_KEY_SLOTS
+    args.mode_list = tuple(m.strip() for m in args.modes.split(",")
+                           if m.strip()) or ("ctr",)
+    if "gcm-open" in args.mode_list and not args.verify_every:
+        ap.error("--modes gcm-open requires --verify-every > 0: open "
+                 "traffic replays the per-size sealed probe pairs "
+                 "(a made-up tag would answer auth-failed by design)")
 
     if args.unquarantine:
         if not args.journal:
@@ -285,10 +309,11 @@ def main(argv=None) -> int:
         return 0
 
     trace.ensure_run()
-    # Reference ciphertexts BEFORE the server's warmup marker: the
-    # byte-exact models path compiles per probe size, and those compiles
-    # belong to the harness, not to steady-state serving.
-    probes = (loadgen.make_probes(args.sizes, args.seed)
+    # Reference outputs BEFORE the server's warmup marker: the
+    # byte-exact models path compiles per probe size (the AEAD/CBC
+    # references are pure-host numpy — no compile either way), and
+    # those compiles belong to the harness, not to steady-state serving.
+    probes = (loadgen.make_probes(args.sizes, args.seed, args.mode_list)
               if args.verify_every else [])
     server, report = asyncio.run(_drive(args, probes))
     stats = server.stats()
@@ -377,6 +402,22 @@ def main(argv=None) -> int:
             f"{s}:p95={st['p95_us']:.0f}µs"
             for s, st in stages.items()))
 
+    # The per-workload split (mode rides serve_requests/serve_refused/
+    # serve_batch_blocks/serve_dispatch_us): the mixed-mode drive's
+    # evidence that every enabled mode actually carried traffic.
+    per_mode = {
+        "requests": metrics.counter_by_label("serve_requests", "mode"),
+        "auth_failed": metrics.counter_by_label("serve_auth_failed",
+                                                "mode"),
+    }
+    if len(args.mode_list) > 1 or args.mode_list != ("ctr",):
+        print("# modes: " + "  ".join(
+            f"{m}:{int(n)}" for m, n in per_mode["requests"].items())
+            + ("" if not per_mode["auth_failed"] else
+               "  auth_failed: " + "  ".join(
+                   f"{m}:{int(n)}"
+                   for m, n in per_mode["auth_failed"].items())))
+
     artifact = {
         "config": {
             "requests": args.requests, "concurrency": args.concurrency,
@@ -390,8 +431,10 @@ def main(argv=None) -> int:
             "lanes": lanes["count"], "probe_every": args.probe_every,
             "max_inflight": args.max_inflight,
             "arrival_rate": args.arrival_rate,
+            "modes": list(args.mode_list),
             "seed": args.seed,
         },
+        "modes": per_mode,
         "load": report.to_json(),
         "overlap": overlap,
         "coalesce": coal,
@@ -456,6 +499,9 @@ def main(argv=None) -> int:
             "quarantines": lanes["quarantine_events"],
             "recompiles": stats["compiles"]["steady"],
             "mismatches": report.mismatches}
+    if args.mode_list != ("ctr",):
+        line["modes"] = {m: int(n)
+                         for m, n in per_mode["requests"].items()}
     if args.slo:
         line["slo"] = "fail" if slo_rc else "pass"
     if degrade.events():

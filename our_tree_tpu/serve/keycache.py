@@ -56,8 +56,10 @@ from collections import OrderedDict
 
 import numpy as np
 
+from ..aead import ghash as aead_ghash
 from ..obs import metrics, trace
-from ..ops.keyschedule import expand_key_enc
+from ..ops import gf
+from ..ops.keyschedule import dec_schedule_from_enc, expand_key_enc
 
 
 def key_digest(key: bytes) -> str:
@@ -75,15 +77,27 @@ class StackedSchedules:
     (``runtime.native.aes_ctx_from_schedule``): lazy because jax-engine
     servers never need them, retained because the stack itself is
     memoized, so steady state pays zero key setup either way.
+
+    The AEAD extensions ride the same stack lazily, per MODE need
+    (``KeyCache.stacked``): ``rks_dec`` is the (K, 4*(nr+1)) DECRYPT
+    schedule stack (the parallel CBC-decrypt dispatch), ``hmats`` the
+    (K, 128, 128) mul-by-H bit matrices and ``h_ints`` the raw H field
+    elements (the GCM fused kernel + the host tag finisher). All pure
+    functions of the slot keys, attached once to the memoized stack —
+    a ctr-only server never pays for them.
     """
 
-    __slots__ = ("nr", "rks", "digests", "_native_ctxs")
+    __slots__ = ("nr", "rks", "digests", "_native_ctxs",
+                 "rks_dec", "hmats", "h_ints")
 
     def __init__(self, nr: int, rks: np.ndarray, digests: tuple):
         self.nr = int(nr)
         self.rks = rks
         self.digests = digests
         self._native_ctxs = None
+        self.rks_dec = None
+        self.hmats = None
+        self.h_ints = None
 
     def native_ctxs(self):
         if self._native_ctxs is None:
@@ -105,11 +119,21 @@ class KeyCache:
         self._tenants: dict[str, OrderedDict] = {}
         self._stacked: OrderedDict = OrderedDict()
         self.stacked_capacity = max(int(stacked_capacity), 1)
+        #: per-digest AEAD derivation memos — pure functions of the key
+        #: bytes (digest -> value), shared across every stack the digest
+        #: appears in so re-stacking a familiar key never re-derives.
+        #: ``_aead``: digest -> (H int, (128, 128) mul-by-H matrix);
+        #: ``_dec``: digest -> the decrypt-schedule row. Bounded like
+        #: the stack memo (FIFO past 4x stacked_capacity): the H-matrix
+        #: is ~64 KiB/key and must not grow with key churn.
+        self._aead: OrderedDict = OrderedDict()
+        self._dec: OrderedDict = OrderedDict()
         self.hits = 0
         self.misses = 0
         self.evictions = 0
         self.stacked_hits = 0
         self.stacked_misses = 0
+        self.aead_derives = 0
 
     def get(self, tenant: str, key: bytes):
         """(digest, nr, host round-key words) for ``key`` under
@@ -137,7 +161,8 @@ class KeyCache:
             trace.counter("keycache_evict", tenant=tenant)
         return (digest, *entry)
 
-    def stacked(self, slots: list, key_slots: int) -> StackedSchedules:
+    def stacked(self, slots: list, key_slots: int,
+                mode: str = "ctr") -> StackedSchedules:
         """The memoized (K, 4*(nr+1)) stack for ``slots`` (slot-ordered
         (tenant, key) pairs — ``Batch.keys``). Every slot still passes
         through ``get`` (LRU touch + hit accounting + expansion on a
@@ -146,7 +171,14 @@ class KeyCache:
         (digest tuple, K), so re-forming a familiar batch shape does no
         schedule work. Mixed key lengths are refused: ``nr`` is a static
         compile argument of the dispatch (the batcher never packs them
-        together; this is the seam's own guard)."""
+        together; this is the seam's own guard).
+
+        ``mode`` attaches that served mode's extra per-key material to
+        the (shared) stack on first need: ``gcm``/``gcm-open`` the
+        GHASH subkeys H = E_K(0^128) and their mul-by-H bit matrices,
+        ``cbc`` the decrypt-schedule stack. Derivations memo per DIGEST
+        (``_aead``/``_dec``), so one key sealing and opening — or
+        appearing in two different stacks — derives once."""
         if not slots or len(slots) > key_slots:
             raise ValueError(
                 f"{len(slots)} slot(s) for a {key_slots}-slot stack")
@@ -162,6 +194,7 @@ class KeyCache:
             self.stacked_hits += 1
             metrics.counter("keycache_stacked", outcome="hit")
             trace.counter("keycache_stacked_hit")
+            self._attach_mode(hit, entries, mode)
             return hit
         self.stacked_misses += 1
         metrics.counter("keycache_stacked", outcome="miss")
@@ -174,7 +207,49 @@ class KeyCache:
         self._stacked[memo_key] = sched
         if len(self._stacked) > self.stacked_capacity:
             self._stacked.popitem(last=False)
+        self._attach_mode(sched, entries, mode)
         return sched
+
+    def _memo_aead(self, digest: str, nr: int, rk) -> tuple:
+        """(H int, mul-by-H matrix) for one key, memoized per digest."""
+        hit = self._aead.get(digest)
+        if hit is None:
+            self.aead_derives += 1
+            metrics.counter("keycache", outcome="aead-derive")
+            h = aead_ghash.derive_h(nr, rk)
+            hit = (h, gf.gf128_mul_matrix_words(h))
+            self._aead[digest] = hit
+            if len(self._aead) > 4 * self.stacked_capacity:
+                self._aead.popitem(last=False)
+        return hit
+
+    def _attach_mode(self, sched: StackedSchedules, entries: list,
+                     mode: str) -> None:
+        """Attach ``mode``'s per-key material to the stack, once. Unused
+        slots stay zero — a GCM batch's padding rows index slot 0 (a
+        real slot) and their GHASH lanes are discarded by the request
+        spans, so zero rows are never read as key material."""
+        if mode in ("gcm", "gcm-open") and sched.hmats is None:
+            k = sched.rks.shape[0]
+            hmats = np.zeros((k, 128, 128), dtype=np.uint32)
+            h_ints = [0] * k
+            for i, (digest, nr, rk) in enumerate(entries):
+                h_ints[i], hmats[i] = self._memo_aead(digest, nr, rk)
+            sched.hmats = hmats
+            sched.h_ints = tuple(h_ints)
+        elif mode == "cbc" and sched.rks_dec is None:
+            rks_dec = np.zeros_like(sched.rks)
+            for i, (digest, nr, rk) in enumerate(entries):
+                row = self._dec.get(digest)
+                if row is None:
+                    # Derived from the already-expanded ENCRYPT schedule
+                    # (reverse + InvMixColumns) — no key bytes re-touched.
+                    row = dec_schedule_from_enc(nr, rk)
+                    self._dec[digest] = row
+                    if len(self._dec) > 4 * self.stacked_capacity:
+                        self._dec.popitem(last=False)
+                rks_dec[i] = row
+            sched.rks_dec = rks_dec
 
     def holds(self, tenant: str, key: bytes) -> bool:
         """Whether the entry is cached (no LRU touch — test/introspection
@@ -187,5 +262,6 @@ class KeyCache:
                 "stacked_hits": self.stacked_hits,
                 "stacked_misses": self.stacked_misses,
                 "stacked_entries": len(self._stacked),
+                "aead_derives": self.aead_derives,
                 "tenants": len(self._tenants),
                 "entries": sum(len(v) for v in self._tenants.values())}

@@ -71,6 +71,7 @@ import collections
 import time
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..models import aes
@@ -242,9 +243,10 @@ class Lane:
     # -- the ONE device-dispatch seam in serve/ ----------------------------
     def engine_call(self, words, ctr_words, sched, key_slots, label: str,
                     warmup: bool = False, runs=None,
-                    timing: dict | None = None):
-        """One MULTI-KEY scattered-CTR dispatch on THIS lane's device,
-        under this lane's watchdog deadline. ``sched`` is the keycache's
+                    timing: dict | None = None, mode: str = "ctr",
+                    inject_words=None, seg_keep=None):
+        """One MULTI-KEY dispatch on THIS lane's device, under this
+        lane's watchdog deadline. ``sched`` is the keycache's
         StackedSchedules view (K expanded schedules, zero rows in unused
         slots) and ``key_slots`` the per-block slot-index vector — the
         fixed-K dispatch shape that keeps the ladder's compile cache
@@ -258,7 +260,19 @@ class Lane:
         batch). Warmup runs under the global opt-in deadline (a
         first-contact compile legitimately dwarfs a steady-state
         dispatch) — EXCEPT on a quarantined lane, which already proved
-        it cannot be trusted with an unbounded wait."""
+        it cannot be trusted with an unbounded wait.
+
+        ``mode`` routes the batch to its kernel (serve/queue.py MODES):
+        ``ctr`` the scattered-CTR seam as always; ``gcm``/``gcm-open``
+        the fused CTR+GHASH dispatch (``aead.gcm``; ``inject_words`` /
+        ``seg_keep`` are its segment arrays, the result is the (2, 4N)
+        stack [crypt output, running GHASH states]); ``cbc`` the
+        parallel CBC-decrypt core (``ctr_words`` carries the PREV
+        stream). Every mode's dispatch is a pure function of its
+        arrays, so bit-exact failover replay holds for all of them. The
+        AEAD kernels are jax-only: on the native host tier they run the
+        jnp engine in-process (no C GHASH exists; documented in
+        docs/SERVING.md's tier table)."""
         deadline_s = (self.deadline_s
                       if (not warmup or self.state == QUARANTINED)
                       else watchdog.default_deadline_s())
@@ -279,7 +293,7 @@ class Lane:
                 # slower dispatch): the knob the SLO gate rehearsal
                 # (`serve.bench --slo`, docs/RESILIENCE.md) turns red.
                 faults.injected_slow("dispatch_slow", label)
-            if self.engine == aes.NATIVE_ENGINE:
+            if mode == "ctr" and self.engine == aes.NATIVE_ENGINE:
                 # ``runs`` (the batch's request layout) flips the host
                 # tier to the per-request C CTR fast path: counters are
                 # generated inside C, no (N, 4) array ever exists —
@@ -300,14 +314,35 @@ class Lane:
                         (self._clock() - t_eng) * 1e6))
                     timing["device_us"] = d_us
                 return out
+            # The jax path (all modes; AEAD/CBC on a native-tier server
+            # run the jnp engine here — the docstring's tier note).
+            engine = (aes.resolve_engine("jnp")
+                      if self.engine == aes.NATIVE_ENGINE else self.engine)
             w, c, r, s = words, ctr_words, sched.rks, key_slots
+            if mode in ("gcm", "gcm-open"):
+                r = (sched.rks, sched.hmats, inject_words, seg_keep)
+            elif mode == "cbc":
+                r = sched.rks_dec
             if self.device is not None:
                 w = jax.device_put(w, self.device)
                 c = jax.device_put(c, self.device)
-                r = jax.device_put(r, self.device)
                 s = jax.device_put(s, self.device)
-            out = aes.ctr_crypt_words_scattered_multikey(
-                w, c, r, s, sched.nr, self.engine)
+                r = jax.tree_util.tree_map(
+                    lambda a: jax.device_put(a, self.device), r)
+            if mode in ("gcm", "gcm-open"):
+                from ..aead import gcm as aead_gcm
+
+                rks, hmats, inject, keep = r
+                out_w, y_w = aead_gcm.gcm_crypt_ghash_words(
+                    w, c, rks, s, hmats, inject, keep, sched.nr, engine,
+                    aead_gcm.SEAL if mode == "gcm" else aead_gcm.OPEN)
+                out = jnp.stack([out_w.reshape(-1), y_w.reshape(-1)])
+            elif mode == "cbc":
+                out = aes.cbc_decrypt_words_scattered_multikey(
+                    w, c, r, s, sched.nr, engine)
+            else:
+                out = aes.ctr_crypt_words_scattered_multikey(
+                    w, c, r, s, sched.nr, engine)
             # Device-time accounting: jax dispatch is ASYNC — the call
             # above returns once the program is enqueued (host: cache
             # lookup + launch), and the block-until-ready fence below is
@@ -585,7 +620,8 @@ class LanePool:
     # -- dispatch with failover --------------------------------------------
     async def dispatch(self, words, ctr_words, sched, key_slots, label: str,
                        bucket: int, blocks: int, requests: int, runs=None,
-                       sampled: bool = True, timing: dict | None = None):
+                       sampled: bool = True, timing: dict | None = None,
+                       mode: str = "ctr", inject_words=None, seg_keep=None):
         """Place and run one batch, failing over across lanes until it
         succeeds or every lane has been tried. ``sched``/``key_slots``
         are the multi-key pair (keycache.StackedSchedules + per-block
@@ -665,11 +701,18 @@ class LanePool:
                 # worker_wait stage, per batch.
                 attempt_timing["worker_wait_us"] = int(
                     (lane._clock() - t0) * 1e6)
+                # Mode kwargs only off the ctr default: the ctr hot
+                # path's call shape is unchanged (and with it every
+                # engine_call stub/wrapper that predates modes).
+                extra = ({} if mode == "ctr"
+                         else {"mode": mode, "inject_words": inject_words,
+                               "seg_keep": seg_keep})
                 return lane.policy.run(
                     lambda att: lane.engine_call(words, ctr_words,
                                                  sched, key_slots,
                                                  label, runs=runs,
-                                                 timing=attempt_timing))
+                                                 timing=attempt_timing,
+                                                 **extra))
 
             try:
                 out = await lane.run_async(unit)
@@ -710,7 +753,7 @@ class LanePool:
                 # showed after the run ended.
                 metrics.observe("serve_dispatch_us", dt_us,
                                 lane=lane.idx, engine=self.engine,
-                                outcome=outcome)
+                                outcome=outcome, mode=mode)
                 metrics.counter("serve_lane_busy_us", dt_us,
                                 lane=lane.idx)
                 self._notify_change()

@@ -40,8 +40,10 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from ..aead import ghash as aead_ghash
 from ..models.aes import AES
 from ..obs import metrics as obs_metrics
+from ..ops.keyschedule import expand_key_enc
 
 #: The mixed-size menu (bytes): 1 block to the default bucket ceiling.
 #: Mixed sizes are the point — a single size would never exercise the
@@ -71,6 +73,13 @@ class Probe:
     nonce: bytes
     payload: np.ndarray
     expected: np.ndarray
+    #: served mode + its request fields (serve/queue.py); ctr leaves
+    #: them empty. ``expected_tag`` pins the gcm seal tag bit-exactly.
+    mode: str = "ctr"
+    iv: bytes = b""
+    aad: bytes = b""
+    tag: bytes = b""
+    expected_tag: bytes = b""
 
 
 @dataclass
@@ -111,24 +120,63 @@ class LoadReport:
         }
 
 
-def make_probes(sizes, seed: int) -> list[Probe]:
-    """One pinned request per size with its reference ciphertext.
+def _np_cbc_encrypt(key: bytes, iv16: bytes, pt: bytes) -> bytes:
+    """Host-reference CBC encrypt (the sequential direction serving
+    deliberately lacks): chains ``aead.ghash``'s single-block oracle —
+    the probe-generation twin of the served parallel CBC decrypt."""
+    nr, rk = expand_key_enc(key)
+    prev, ct = iv16, bytearray()
+    for i in range(0, len(pt), 16):
+        blk = bytes(a ^ b for a, b in zip(pt[i:i + 16], prev))
+        prev = aead_ghash.np_aes_encrypt_block(nr, rk, blk).tobytes()
+        ct += prev
+    return bytes(ct)
 
-    Runs the byte-exact models CTR path once per size — call BEFORE the
-    server's warmup/compile marker, so reference compiles never count
-    against steady-state serving."""
+
+def make_probes(sizes, seed: int, modes=("ctr",)) -> list[Probe]:
+    """One pinned request per (mode, size) with its reference output.
+
+    ctr references run the byte-exact models path; the AEAD/CBC
+    references are the pure-host numpy twins (``aead.ghash`` — no jax,
+    no compile). Call BEFORE the server's warmup/compile marker, so
+    reference compiles never count against steady-state serving. The
+    ``gcm-open`` probe replays the ``gcm`` probe's sealed pair — its
+    expected output is the original plaintext, and its (valid) tag is
+    what keeps verified open traffic from auth-failing."""
     rng = np.random.default_rng(seed ^ 0x9E3779B9)
     probes = []
     for size in sizes:
         key = rng.integers(0, 256, 16, dtype=np.uint8).tobytes()
         nonce = rng.integers(0, 256, 16, dtype=np.uint8).tobytes()
         payload = rng.integers(0, 256, size, dtype=np.uint8)
-        ref = AES(key, engine="jnp")
-        expected, _, _, _ = ref.crypt_ctr(
-            0, np.frombuffer(nonce, np.uint8),
-            np.zeros(16, np.uint8), payload)
-        probes.append(Probe("probe", key, nonce, payload,
-                            np.asarray(expected)))
+        if "ctr" in modes:
+            ref = AES(key, engine="jnp")
+            expected, _, _, _ = ref.crypt_ctr(
+                0, np.frombuffer(nonce, np.uint8),
+                np.zeros(16, np.uint8), payload)
+            probes.append(Probe("probe", key, nonce, payload,
+                                np.asarray(expected)))
+        gcm_wanted = [m for m in ("gcm", "gcm-open") if m in modes]
+        if gcm_wanted:
+            iv = rng.integers(0, 256, 12, dtype=np.uint8).tobytes()
+            aad = rng.integers(0, 256, 16, dtype=np.uint8).tobytes()
+            ct, tag = aead_ghash.np_gcm_seal(key, iv, aad,
+                                             payload.tobytes())
+            if "gcm" in gcm_wanted:
+                probes.append(Probe(
+                    "probe", key, b"", payload,
+                    np.frombuffer(ct, np.uint8), mode="gcm", iv=iv,
+                    aad=aad, expected_tag=tag))
+            if "gcm-open" in gcm_wanted:
+                probes.append(Probe(
+                    "probe", key, b"", np.frombuffer(ct, np.uint8),
+                    payload, mode="gcm-open", iv=iv, aad=aad, tag=tag))
+        if "cbc" in modes:
+            iv16 = rng.integers(0, 256, 16, dtype=np.uint8).tobytes()
+            cbc_ct = _np_cbc_encrypt(key, iv16, payload.tobytes())
+            probes.append(Probe(
+                "probe", key, b"", np.frombuffer(cbc_ct, np.uint8),
+                payload, mode="cbc", iv=iv16))
     return probes
 
 
@@ -138,6 +186,7 @@ async def run(server, n_requests: int, concurrency: int = 32,
               deadline_s: float | None = None,
               probes: list[Probe] | None = None,
               arrival_rate: float | None = None,
+              modes=("ctr",),
               clock=time.monotonic) -> LoadReport:
     """Drive ``server`` with ``n_requests`` total; returns the
     aggregated LoadReport.
@@ -146,11 +195,30 @@ async def run(server, n_requests: int, concurrency: int = 32,
     clients. ``arrival_rate=R``: open loop — one request submitted every
     ``1/R`` seconds with no outstanding-request bound (``concurrency``
     is ignored; the offered load, not the service rate, sets the pace).
+
+    ``modes`` is the served-mode MIX (serve/queue.py MODES): each
+    request draws its mode uniformly, so CTR, GCM seal/open, and CBC
+    decrypt interleave in one queue — the mixed-workload drive. Random
+    ``gcm-open`` traffic replays the per-size sealed probe pair (a
+    made-up tag would answer ``auth-failed`` by design; auth-failure
+    coverage is the tamper tests' job, not the load mix's).
     """
     sizes = tuple(sizes)
+    modes = tuple(modes) or ("ctr",)
     if probes is None:
-        probes = make_probes(sizes, seed)
-    by_size = {p.payload.size: p for p in probes}
+        probes = make_probes(sizes, seed, modes)
+    by_key = {(p.mode, p.payload.size): p for p in probes}
+    if "gcm-open" in modes:
+        missing = [s for s in sizes if ("gcm-open", s) not in by_key]
+        if missing:
+            # Fail FAST: without a sealed pair per size every random
+            # gcm-open request would either carry a made-up tag (100%
+            # auth-failed) or have to silently change mode — both turn
+            # the drive into noise. Verification supplies the pairs.
+            raise ValueError(
+                f"gcm-open in the mode mix needs a sealed probe pair "
+                f"per size (missing sizes {missing}): enable "
+                f"verify_every / pass probes covering every size")
     keys = {}
     key_rng = np.random.default_rng(seed)
     for t in range(tenants):
@@ -170,19 +238,36 @@ async def run(server, n_requests: int, concurrency: int = 32,
                 for s in sizes}
 
     def pick(i: int, rng):
-        """Request ``i``'s (tenant, key, nonce, payload, probe) — shared
-        by both loop models so a run's request mix depends only on the
-        seed and the request index order, not on the loop shape."""
+        """Request ``i``'s (tenant, key, nonce, payload, probe, mode,
+        iv, aad, tag) — shared by both loop models so a run's request
+        mix depends only on the seed and the request index order, not
+        on the loop shape."""
         size = int(rng.choice(sizes))
-        probe = by_size.get(size) if (verify_every
-                                      and i % verify_every == 0) else None
+        mode = modes[int(rng.integers(len(modes)))]
+        probe = (by_key.get((mode, size))
+                 if (verify_every and i % verify_every == 0) else None)
         if probe is not None:
-            return (probe.tenant, probe.key, probe.nonce,
-                    probe.payload, probe)
+            return (probe.tenant, probe.key, probe.nonce, probe.payload,
+                    probe, probe.mode, probe.iv, probe.aad, probe.tag)
+        if mode == "gcm-open":
+            # Unverified open traffic still needs a VALID tag: replay
+            # the sealed pair without counting it as a probe (its
+            # presence per size is checked at run() entry).
+            p = by_key[(mode, size)]
+            return (p.tenant, p.key, p.nonce, p.payload, None,
+                    p.mode, p.iv, p.aad, p.tag)
         tenant = f"t{int(rng.integers(tenants))}"
         key = keys[(int(tenant[1:]), int(rng.integers(keys_per_tenant)))]
-        nonce = rng.integers(0, 256, 16, dtype=np.uint8).tobytes()
-        return tenant, key, nonce, payloads[size], None
+        nonce = iv = aad = b""
+        if mode == "ctr":
+            nonce = rng.integers(0, 256, 16, dtype=np.uint8).tobytes()
+        elif mode == "gcm":
+            iv = rng.integers(0, 256, 12, dtype=np.uint8).tobytes()
+            aad = rng.integers(0, 256, 16, dtype=np.uint8).tobytes()
+        elif mode == "cbc":
+            iv = rng.integers(0, 256, 16, dtype=np.uint8).tobytes()
+        return (tenant, key, nonce, payloads[size], None, mode, iv, aad,
+                b"")
 
     def account(resp, payload, probe, dt_ms: float):
         report.requests += 1
@@ -206,8 +291,24 @@ async def run(server, n_requests: int, concurrency: int = 32,
                 if not np.array_equal(np.asarray(resp.payload),
                                       probe.expected):
                     report.mismatches += 1
+                elif (probe.expected_tag
+                        and getattr(resp, "tag", None)
+                        != probe.expected_tag):
+                    # The gcm seal probe pins the TAG bit-exactly too:
+                    # right ciphertext + wrong tag is still a broken
+                    # AEAD path.
+                    report.mismatches += 1
         else:
             report.errors[resp.error] = report.errors.get(resp.error, 0) + 1
+
+    async def submit_one(tenant, key, nonce, payload, mode, iv, aad, tag):
+        # Mode kwargs only off the ctr default: a ctr-only drive keeps
+        # the pre-AEAD submit() shape (and with it every router client
+        # that predates modes).
+        kw = ({} if mode == "ctr"
+              else {"mode": mode, "iv": iv, "aad": aad, "tag": tag})
+        return await server.submit(tenant, key, nonce, payload,
+                                   deadline_s=deadline_s, **kw)
 
     async def client(cid: int):
         rng = np.random.default_rng((seed << 8) ^ cid)
@@ -216,16 +317,18 @@ async def run(server, n_requests: int, concurrency: int = 32,
             if i >= n_requests:
                 return
             counter["next"] = i + 1
-            tenant, key, nonce, payload, probe = pick(i, rng)
+            (tenant, key, nonce, payload, probe,
+             mode, iv, aad, tag) = pick(i, rng)
             t0 = clock()
-            resp = await server.submit(tenant, key, nonce, payload,
-                                       deadline_s=deadline_s)
+            resp = await submit_one(tenant, key, nonce, payload, mode,
+                                    iv, aad, tag)
             account(resp, payload, probe, (clock() - t0) * 1e3)
 
     async def open_request(i: int, scheduled: float, rng):
-        tenant, key, nonce, payload, probe = pick(i, rng)
-        resp = await server.submit(tenant, key, nonce, payload,
-                                   deadline_s=deadline_s)
+        (tenant, key, nonce, payload, probe,
+         mode, iv, aad, tag) = pick(i, rng)
+        resp = await submit_one(tenant, key, nonce, payload, mode, iv,
+                                aad, tag)
         # Latency from the SCHEDULED arrival: a generator that fell
         # behind a saturated server charges the lag as queueing delay
         # (the open-loop, coordinated-omission-free accounting).

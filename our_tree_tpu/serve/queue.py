@@ -77,6 +77,22 @@ ERR_BAD_REQUEST = "bad-request"  #: malformed payload/nonce
 ERR_DEADLINE = "deadline"      #: budget exhausted (queued or dispatching)
 ERR_DISPATCH = "dispatch-failed"  #: the batch died after retries
 ERR_SHUTDOWN = "shutdown"      #: server stopped with the request queued
+ERR_AUTH = "auth-failed"       #: GCM open: tag mismatch (per-request
+#                                 refusal — the batch and its other
+#                                 riders are unaffected)
+
+#: The served mode vocabulary. ``ctr`` is the original scattered-CTR
+#: workload; ``gcm``/``gcm-open`` are AES-GCM seal/open (aead/gcm.py —
+#: distinct modes because the fused kernel's GHASH direction is a
+#: static compile argument, so the two may never share a dispatch);
+#: ``cbc`` is parallel CBC DECRYPT (the only CBC direction that
+#: parallelises — models/aes.py:cbc_decrypt_words_scattered_multikey).
+#: Batches never mix modes (serve/batcher.py).
+MODES = ("ctr", "gcm", "gcm-open", "cbc")
+
+#: Modes whose batch rows include the extra J0 block (the E_K(J0) tag
+#: pad rides the CTR dispatch as each request's row 0).
+GCM_MODES = ("gcm", "gcm-open")
 
 
 class ServeError(RuntimeError):
@@ -95,6 +111,8 @@ class Response:
     payload: np.ndarray | None = None  #: (len,) u8, encrypt/decrypt output
     error: str | None = None           #: one of the ERR_* codes
     detail: str = ""
+    #: GCM seal only: the 16-byte authentication tag (None elsewhere)
+    tag: bytes | None = None
     queued_s: float = 0.0              #: admission -> drain residency
     batch: str | None = None           #: label of the batch that served it
     #: the per-request time-attribution ledger (docs/OBSERVABILITY.md,
@@ -112,11 +130,16 @@ class Request:
     id: int
     tenant: str
     key: bytes
-    nonce: bytes                 #: 16 big-endian counter bytes
+    nonce: bytes                 #: 16 big-endian counter bytes (ctr mode)
     payload: np.ndarray          #: (16*nblocks,) u8
     future: asyncio.Future
     budget: Budget | None = None
     t_submit: float = 0.0
+    #: served mode (MODES); mode-specific fields below are empty for ctr
+    mode: str = "ctr"
+    iv: bytes = b""              #: 96-bit GCM IV / 128-bit CBC IV
+    aad: bytes = b""             #: GCM additional authenticated data
+    tag: bytes = b""             #: GCM open: the tag to verify
     #: the admission-time head-sampling decision (OT_TRACE_SAMPLE):
     #: every span this request rides is emitted iff this bit is set
     #: (or the outcome force-samples it). When the request arrived over
@@ -137,6 +160,13 @@ class Request:
     @property
     def nblocks(self) -> int:
         return self.payload.size // 16
+
+    @property
+    def span_blocks(self) -> int:
+        """Batch rows this request occupies: GCM requests carry one
+        extra row — counter J0 under a zero data word, whose CTR
+        output is E_K(J0), the tag's final pad (serve/batcher.py)."""
+        return self.nblocks + (1 if self.mode in GCM_MODES else 0)
 
     def resolve(self, resp: Response) -> None:
         if not self.future.done():
@@ -169,9 +199,15 @@ class RequestQueue:
                  tenant_depth_frac: float = 1.0,
                  low_priority_tenants=(),
                  priority_depth_frac: float = 0.5,
+                 modes=("ctr",),
                  clock=time.monotonic):
         self.max_depth = int(max_depth)
         self.max_request_blocks = int(max_request_blocks)
+        #: the ENABLED mode set: the server warms exactly these ladders,
+        #: so a mode outside it must refuse at admission — its first
+        #: dispatch would otherwise pay a steady-state compile, breaking
+        #: the zero-recompile contract mid-traffic.
+        self.modes = tuple(modes)
         self.default_deadline_s = float(default_deadline_s)
         #: Two-level tenant priority (ROADMAP carry-over): tenants named
         #: here are LOW priority — under depth pressure (queue depth at
@@ -219,7 +255,9 @@ class RequestQueue:
     def submit(self, tenant: str, key: bytes, nonce: bytes, payload,
                deadline_s: float | None = None,
                sampled: bool | None = None, parent: str | None = None,
-               priority: int | None = None) -> asyncio.Future:
+               priority: int | None = None, mode: str = "ctr",
+               iv: bytes = b"", aad: bytes = b"",
+               tag: bytes = b"") -> asyncio.Future:
         """Admit one request; always returns a future (already resolved
         with a coded error Response when admission refuses it — callers
         get one uniform await, not two failure channels).
@@ -230,14 +268,31 @@ class RequestQueue:
         trace instead of flipping a second coin (None = local admission:
         draw ``trace.sample()`` here, no upstream parent). ``priority``
         (0 = low) opts a single request into the low tier; None defers
-        to the ``low_priority_tenants`` set."""
+        to the ``low_priority_tenants`` set.
+
+        ``mode`` selects the served workload (MODES): ``ctr`` (nonce
+        required), ``gcm``/``gcm-open`` (96-bit ``iv``, optional
+        ``aad``; open carries the 16-byte ``tag``), ``cbc`` decrypt
+        (128-bit ``iv``). The serve path keeps CTR's block-granular
+        payload contract for every mode — arbitrary-length GCM lives at
+        the models API (``gcm_seal``/``gcm_open``)."""
         fut = asyncio.get_running_loop().create_future()
         data = np.asarray(payload, dtype=np.uint8).reshape(-1)
+        mode = str(mode or "ctr")
+        iv, aad, tag = bytes(iv), bytes(aad), bytes(tag)
+        span = data.size // 16 + (1 if mode in GCM_MODES else 0)
         code = None
         if self.closed:
             # Placement stopped (graceful drain in progress): refuse up
             # front so the drain set is frozen the moment stop() begins.
             code, why = ERR_SHUTDOWN, "server is draining"
+        elif mode not in MODES:
+            code, why = ERR_BAD_REQUEST, (
+                f"unknown mode {mode!r} (served modes: {MODES})")
+        elif mode not in self.modes:
+            code, why = ERR_BAD_REQUEST, (
+                f"mode {mode!r} not enabled on this server "
+                f"(enabled: {self.modes}; its ladder was never warmed)")
         elif data.size == 0 or data.size % 16:
             code, why = ERR_BAD_REQUEST, "payload must be a nonzero multiple of 16 bytes"
         elif len(bytes(key)) not in (16, 24, 32):
@@ -245,11 +300,24 @@ class RequestQueue:
             # batcher loop — admission owns malformed requests.
             code, why = ERR_BAD_REQUEST, (
                 f"key must be 16/24/32 bytes, got {len(bytes(key))}")
-        elif len(bytes(nonce)) != 16:
+        elif mode == "ctr" and len(bytes(nonce)) != 16:
             code, why = ERR_BAD_REQUEST, "nonce must be 16 bytes"
-        elif data.size // 16 > self.max_request_blocks:
+        elif mode in GCM_MODES and len(iv) != 12:
+            # The serve fast path pins the 96-bit J0 derivation; other
+            # IV lengths (a host GHASH over the IV) are a models-API
+            # affair, not a batched dispatch shape.
+            code, why = ERR_BAD_REQUEST, (
+                f"GCM iv must be 12 bytes (serve fast path), got "
+                f"{len(iv)}")
+        elif mode == "gcm-open" and len(tag) != 16:
+            code, why = ERR_BAD_REQUEST, (
+                f"gcm-open tag must be 16 bytes, got {len(tag)}")
+        elif mode == "cbc" and len(iv) != 16:
+            code, why = ERR_BAD_REQUEST, (
+                f"cbc iv must be 16 bytes, got {len(iv)}")
+        elif span > self.max_request_blocks:
             code, why = ERR_TOO_LARGE, (
-                f"{data.size // 16} blocks > bucket ceiling "
+                f"{span} blocks > bucket ceiling "
                 f"{self.max_request_blocks}")
         elif len(self._pending) >= self.max_depth:
             code, why = ERR_SHED, f"queue depth {self.max_depth} reached"
@@ -307,7 +375,13 @@ class RequestQueue:
         if code is not None:
             if code != ERR_SHED:
                 self.refused += 1
-                metrics.counter("serve_refused", code=code)
+                # The mode label comes off the WIRE: an unknown value is
+                # untrusted client input and must not mint metric series
+                # (labels live forever; _MAX_SERIES would fill with junk
+                # and drop legitimate series) — collapse it.
+                metrics.counter("serve_refused", code=code,
+                                mode=(mode if mode in MODES
+                                      else "invalid"))
             fut.set_result(Response(ok=False, error=code, detail=why))
             return fut
         deadline = (self.default_deadline_s if deadline_s is None
@@ -319,10 +393,11 @@ class RequestQueue:
             else None,
             t_submit=self._clock(), _queue=self,
             sampled=trace.sample() if sampled is None else bool(sampled),
-            parent=parent)
+            parent=parent, mode=mode, iv=iv, aad=aad, tag=tag)
         cm = trace.maybe_span(req.sampled, "request-queued",
                               parent=req.parent, req=req.id,
-                              tenant=tenant, blocks=req.nblocks)
+                              tenant=tenant, blocks=req.nblocks,
+                              mode=mode)
         cm.__enter__()
         req._span_cm = cm
         self._pending.append(req)
@@ -331,7 +406,9 @@ class RequestQueue:
         # Registry, not trace: the per-request counter is the hot path
         # the sampled trace can no longer count exactly — and queue
         # depth (+ its high-water) is the /metrics admission gauge.
-        metrics.counter("serve_requests")
+        # ``mode`` splits the request/dispatch/error series per served
+        # workload (the per-mode row in obs.report).
+        metrics.counter("serve_requests", mode=mode)
         metrics.counter("serve_payload_blocks", req.nblocks)
         depth = len(self._pending)
         if depth > self.depth_peak:

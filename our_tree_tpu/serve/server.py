@@ -80,14 +80,19 @@ from dataclasses import dataclass
 import jax
 import numpy as np
 
+from ..aead import gcm as aead_gcm
+from ..aead import ghash as aead_ghash
 from ..models import aes
 from ..obs import metrics, trace
+from ..ops import gf
+from ..resilience import faults
 from ..resilience import journal as journal_mod
 from ..resilience import watchdog
 from ..utils import packing
 from . import batcher, lanes
 from .keycache import KeyCache, key_digest
-from .queue import ERR_DEADLINE, ERR_DISPATCH, RequestQueue
+from .queue import (ERR_AUTH, ERR_DEADLINE, ERR_DISPATCH, GCM_MODES, MODES,
+                    RequestQueue)
 from .status import StatusServer
 
 #: The jax monitoring event that fires once per REAL backend compile and
@@ -156,6 +161,14 @@ class ServerConfig:
     #: key lengths (bits) warmed per rung — a key size outside this set
     #: still works, it just pays its first-contact compile online
     warmup_key_bits: tuple = (128,)
+    #: the ENABLED served-mode set (queue.MODES). Warmup walks every
+    #: enabled mode's ladder per lane — each mode is its own compiled
+    #: program (GHASH direction / CBC core are static args) — and
+    #: admission refuses modes outside it (an unwarmed mode's first
+    #: dispatch would pay a steady-state compile, breaking the
+    #: zero-recompile contract mid-traffic). Default ctr-only: AEAD
+    #: serving is an explicit opt-in (docs/SERVING.md, AEAD section).
+    modes: tuple = ("ctr",)
     #: dispatch lanes: None = one per visible device; an explicit count
     #: may exceed the device count (lanes share devices round-robin —
     #: the single-device rehearsal mode)
@@ -188,12 +201,17 @@ class Server:
         c = self.config
         self.rungs = batcher.bucket_ladder(c.min_bucket_blocks,
                                            c.max_bucket_blocks)
+        bad = [m for m in c.modes if m not in MODES]
+        if bad or not c.modes:
+            raise ValueError(f"unknown serve mode(s) {bad} "
+                             f"(known: {MODES})")
         self.queue = RequestQueue(max_depth=c.max_depth,
                                   max_request_blocks=self.rungs[-1],
                                   default_deadline_s=c.request_deadline_s,
                                   tenant_depth_frac=c.tenant_depth_frac,
                                   low_priority_tenants=c.low_priority_tenants,
-                                  priority_depth_frac=c.priority_depth_frac)
+                                  priority_depth_frac=c.priority_depth_frac,
+                                  modes=c.modes)
         self.keycache = KeyCache(per_tenant=c.keycache_per_tenant)
         self.engine: str | None = None   # resolved at start
         self.pool: lanes.LanePool | None = None  # built at start
@@ -342,6 +360,28 @@ class Server:
                                                      warmup=True)
                             if mismatch:
                                 break
+                            # Every enabled AEAD/CBC mode primes its OWN
+                            # ladder: the GHASH direction and the CBC
+                            # decrypt core are static compile arguments,
+                            # so each mode is a distinct program per
+                            # (lane, rung) — an unwarmed mode's first
+                            # batch would recompile mid-traffic.
+                            for m in c.modes:
+                                if m == "ctr":
+                                    continue
+                                sched_m = self.keycache.stacked(
+                                    [("_warmup", b"\x00" * (bits // 8))],
+                                    c.key_slots, mode=m)
+                                for rung in self.rungs:
+                                    words = np.zeros(4 * rung,
+                                                     dtype=np.uint32)
+                                    lane.engine_call(
+                                        words, words, sched_m,
+                                        slot_vecs[rung],
+                                        f"warmup:{rung}:{m}", warmup=True,
+                                        mode=m, inject_words=words,
+                                        seg_keep=np.ones(
+                                            rung, dtype=np.uint32))
                         if mismatch:
                             lane._quarantine("warmup-mismatch",
                                              self._journal)
@@ -401,13 +441,17 @@ class Server:
                      deadline_s: float | None = None,
                      sampled: bool | None = None,
                      parent: str | None = None,
-                     priority: int | None = None):
-        """Admit one CTR crypt request and await its Response.
+                     priority: int | None = None, mode: str = "ctr",
+                     iv: bytes = b"", aad: bytes = b"", tag: bytes = b""):
+        """Admit one crypt request and await its Response.
         ``sampled``/``parent``/``priority`` propagate a wire-fronted
-        request's router-side admission decisions (serve/queue.py)."""
+        request's router-side admission decisions; ``mode`` selects the
+        served workload with its ``iv``/``aad``/``tag`` fields
+        (serve/queue.py has the per-mode contract)."""
         return await self.queue.submit(tenant, key, nonce, payload,
                                        deadline_s, sampled=sampled,
-                                       parent=parent, priority=priority)
+                                       parent=parent, priority=priority,
+                                       mode=mode, iv=iv, aad=aad, tag=tag)
 
     # -- the batcher loop --------------------------------------------------
     async def _loop(self) -> None:
@@ -489,12 +533,17 @@ class Server:
                                   bucket=b.bucket, blocks=b.blocks,
                                   slots=len(b.slots),
                                   requests=len(b.requests)):
-                sched = self.keycache.stacked(b.keys, b.key_slots)
+                sched = self.keycache.stacked(b.keys, b.key_slots,
+                                              mode=b.mode)
                 # The native tier generates counters inside C per
                 # request (the batch's ``runs`` layout) — materialising
                 # the (N, 4) counter array it would never read is pure
-                # memory-bandwidth tax at the big rungs.
-                b.materialise(counters=self.engine != aes.NATIVE_ENGINE)
+                # memory-bandwidth tax at the big rungs. CTR only: the
+                # AEAD/CBC modes dispatch through the jax path and read
+                # their arrays regardless of tier.
+                b.materialise(counters=(b.mode != "ctr"
+                                        or self.engine != aes.NATIVE_ENGINE),
+                              sched=sched)
                 return sched
         except Exception as e:  # noqa: BLE001 - containment (docstring)
             self.batches_failed += 1
@@ -515,7 +564,8 @@ class Server:
                 b.words, b.ctr_words, sched, b.slot_index, b.label,
                 bucket=b.bucket, blocks=b.blocks,
                 requests=len(b.requests), runs=b.runs,
-                sampled=b.sampled, timing=timing)
+                sampled=b.sampled, timing=timing, mode=b.mode,
+                inject_words=b.inject_words, seg_keep=b.seg_keep)
         except lanes.LanesExhausted as e:
             # Failover already ran: every lane was tried (and each
             # miss degraded its lane's health). Only now do the riders
@@ -572,7 +622,24 @@ class Server:
             b.stages.update(pack_us=pack_b, worker_wait_us=wait_us,
                             dispatch_us=host_us, device_us=device_us)
         try:
-            for req, data in zip(b.requests, b.split_output(out)):
+            if b.mode in GCM_MODES:
+                res = np.asarray(out)
+                outs = b.split_output(res[0])
+                tags, auth_ok = self._gcm_finish(b, sched, res[0], res[1])
+            else:
+                outs = b.split_output(out)
+                tags = auth_ok = None
+            for i, (req, data) in enumerate(zip(b.requests, outs)):
+                if auth_ok is not None and not auth_ok[i]:
+                    # Tag mismatch: a PER-REQUEST refusal — the batch
+                    # and its other riders are untouched, and no
+                    # plaintext leaves the server for this request.
+                    metrics.counter("serve_auth_failed", mode=b.mode)
+                    trace.counter("serve_auth_failed", batch=b.label)
+                    req.fail(ERR_AUTH,
+                             "GCM tag mismatch (authentication failed)",
+                             batch=b.label)
+                    continue
                 ledger = None
                 t_now = time.monotonic()
                 reply_us = max(int((t_now - t_d1) * 1e6), 0)
@@ -590,7 +657,9 @@ class Server:
                         "total_us": int((t_now - req.t_submit) * 1e6),
                     }
                 req.resolve(Response(ok=True, payload=data, batch=b.label,
-                                     ledger=ledger))
+                                     ledger=ledger,
+                                     tag=(tags[i] if tags is not None
+                                          and b.mode == "gcm" else None)))
                 metrics.observe("serve_stage_us", reply_us, stage="reply")
         except Exception as e:  # noqa: BLE001 - containment (docstring)
             # E.g. a wrongly-shaped engine result breaking split_output:
@@ -602,6 +671,43 @@ class Server:
             for req in b.requests:
                 req.fail(ERR_DISPATCH, f"{type(e).__name__}: {e}",
                          batch=b.label)
+
+    def _gcm_finish(self, b: batcher.Batch, sched, crypt_flat,
+                    y_flat) -> tuple[list, list]:
+        """The host per-request GHASH tail for a served GCM batch:
+        each request's running Y comes off its LAST data row of the
+        fused kernel's state stream, the length block is folded in with
+        its slot's H (one ``gf128_mul`` per request — the variable-
+        length work the fixed-shape kernel leaves to the host), and the
+        E_K(J0) pad comes off the request's J0 row of the CTR output.
+        Returns (tags, auth_ok): ``tags`` the 16-byte computed tag per
+        request (in ``b.requests`` order); ``auth_ok`` per-request
+        verification for ``gcm-open`` (always True for seal). The
+        compare is the constant-time host twin (``ghash.np_tag_eq``);
+        the ``tag_mismatch`` fault point forces a mismatch here — the
+        deterministic way CI drives the auth-failure path."""
+        slot_of = [si for si, slot in enumerate(b.slots)
+                   for _ in slot.requests]
+        tags, auth_ok = [], []
+        for (off, n), si, req in zip(b.req_spans, slot_of, b.requests):
+            h = sched.h_ints[si]
+            ek_j0 = packing.np_words_to_bytes(
+                np.ascontiguousarray(crypt_flat[4 * (off - 1):4 * off]))
+            y_last = packing.np_words_to_bytes(
+                np.ascontiguousarray(
+                    y_flat[4 * (off + n - 1):4 * (off + n)]))
+            tag = aead_gcm._finish_tag(
+                gf.block_to_int(y_last.tobytes()), h, b"",
+                len(req.aad), 16 * n, ek_j0)
+            tags.append(tag)
+            if b.mode == "gcm-open":
+                ok = aead_ghash.np_tag_eq(tag, req.tag)
+                if faults.fire("tag_mismatch"):
+                    ok = False
+                auth_ok.append(ok)
+            else:
+                auth_ok.append(True)
+        return tags, auth_ok
 
     # -- introspection -----------------------------------------------------
     def occupancy_histogram(self) -> dict:

@@ -11,19 +11,32 @@ request::
     {"t": "<tenant>", "k": "<key hex>", "n": "<nonce hex>",
      "len": <payload bytes>, "deadline_s": <float|null>,
      "sm": <bool|absent>, "ps": "<parent span id|absent>",
-     "pr": <0|absent>, "lg": <true|absent>}\\n
+     "pr": <0|absent>, "lg": <true|absent>,
+     "m": "<mode|absent>", "iv": "<iv hex|absent>",
+     "a": "<aad hex|absent>", "tg": "<tag hex|absent>"}\\n
     <len raw bytes>
 
 response::
 
     {"ok": true, "len": <n>, "batch": "<label|null>", "tr": <epoch µs>,
-     "ts": <epoch µs>, "pid": <int>, "lg": {<ledger>|absent}}\\n<raw>
+     "ts": <epoch µs>, "pid": <int>, "lg": {<ledger>|absent},
+     "tg": "<tag hex|absent>"}\\n<raw>
     {"ok": false, "len": 0, "error": "<code>", "detail": "..."}\\n
 
 The codes are ``serve.queue``'s closed ERR_* set — the router
 dispatches on them (a ``shed`` retries the replica ring with backoff, a
 ``shutdown`` marks the backend draining, everything else answers the
-rider as-is), so the wire adds NO new failure vocabulary.
+rider as-is), so the wire adds NO new failure vocabulary; ``auth-failed``
+(a GCM tag mismatch) rides it as a plain per-request error.
+
+The AEAD fields are the served-mode seam (docs/SERVING.md, AEAD
+section): ``m`` selects the mode (``ctr`` when absent — every
+pre-AEAD frame is still a valid frame), ``iv`` carries the GCM 96-bit
+/ CBC 128-bit IV, ``a`` the GCM additional authenticated data, and
+``tg`` the tag — request-side the tag to VERIFY (``gcm-open``),
+response-side the tag ``gcm`` sealing produced. Hex for all three:
+they are small (12-16 bytes, AAD typically header-sized) next to the
+raw-riding payload.
 
 The observability fields are the CROSS-PROCESS propagation seam
 (docs/OBSERVABILITY.md, fleet tracing): ``sm`` carries the router's

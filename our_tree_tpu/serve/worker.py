@@ -139,8 +139,16 @@ class RequestFrontend:
         try:
             key = bytes.fromhex(str(header.get("k", "")))
             nonce = bytes.fromhex(str(header.get("n", "")))
+            # The AEAD fields (serve/wire.py): absent = empty, and a
+            # malformed hex field degrades to b"" — admission's
+            # per-mode validation answers the coded error.
+            iv = bytes.fromhex(str(header.get("iv", "")))
+            aad = bytes.fromhex(str(header.get("a", "")))
+            tag = bytes.fromhex(str(header.get("tg", "")))
         except ValueError:
             key, nonce = b"", b""
+            iv = aad = tag = b""
+        mode = str(header.get("m") or "ctr")
         try:
             deadline = header.get("deadline_s")
             deadline = float(deadline) if deadline is not None else None
@@ -165,9 +173,12 @@ class RequestFrontend:
         resp = await self._server.submit(
             str(header.get("t", "")), key, nonce,
             memoryview(payload), deadline_s=deadline,
-            sampled=sampled, parent=parent, priority=priority)
+            sampled=sampled, parent=parent, priority=priority,
+            mode=mode, iv=iv, aad=aad, tag=tag)
         if resp.ok:
             out = {"ok": True, "batch": resp.batch}
+            if resp.tag is not None:  # gcm seal: the tag rides back
+                out["tg"] = resp.tag.hex()
             body = resp.payload.tobytes()
         else:
             out = {"ok": False, "error": resp.error, "detail": resp.detail,
@@ -206,7 +217,8 @@ async def _amain(args) -> int:
         probe_every=args.probe_every,
         journal=args.journal,
         max_inflight=args.max_inflight,
-        status_port=args.status_port)
+        status_port=args.status_port,
+        modes=tuple((args.modes or "ctr").split(",")))
     server = Server(cfg)
     await server.start()
     frontend = RequestFrontend(server, args.port, host=args.host)
@@ -266,6 +278,11 @@ def main(argv=None) -> int:
                     help="/metrics + /healthz port (0 = ephemeral — the "
                          "router's gossip reads it from the READY line)")
     ap.add_argument("--engine", default="auto")
+    ap.add_argument("--modes", default="ctr", metavar="M1,M2",
+                    help="served modes to enable and warm (serve/queue.py "
+                         "MODES: ctr,gcm,gcm-open,cbc; default ctr — "
+                         "AEAD serving is an explicit opt-in, "
+                         "docs/SERVING.md)")
     ap.add_argument("--lanes", type=int, default=None, metavar="N")
     ap.add_argument("--bucket-min", type=int, default=32, metavar="BLOCKS")
     ap.add_argument("--bucket-max", type=int, default=4096, metavar="BLOCKS")

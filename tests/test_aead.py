@@ -1,0 +1,291 @@
+"""ot-aead (our_tree_tpu/aead + the GF(2^128) half of ops/gf): AES-GCM
+as a first-class workload.
+
+Covers the three GF(2^128) multiply formulations pinned against each
+other (bit-serial int reference, Shoup byte tables, the mul-by-H
+128x128 bit matrix the traced kernel uses), the traced Horner GHASH vs
+the int reference, the traced constant-time tag compare vs its host
+twin, the inc32 counter materialiser (including the 2^32 wrap), the
+NIST SP 800-38D KATs (tests/golden/gcm_kats.json) through the models
+API with per-byte tamper rejection, the fuzz-parity satellite
+(gcm_seal/gcm_open vs the pure-host numpy reference over random
+lengths/AAD splits, empty AAD, non-block-aligned tails, non-96-bit
+IVs), and the parallel CBC-decrypt seam (bitsliced multikey decrypt +
+the scattered dispatch vs the models single-key path).
+"""
+
+import json
+import pathlib
+
+import numpy as np
+import pytest
+
+from our_tree_tpu.aead import gcm, ghash
+from our_tree_tpu.models import TagMismatchError, aes, gcm_open, gcm_seal
+from our_tree_tpu.ops import bitslice, gf
+from our_tree_tpu.ops.keyschedule import (dec_schedule_from_enc,
+                                          expand_key_dec, expand_key_enc)
+from our_tree_tpu.utils import packing
+
+GOLDEN = pathlib.Path(__file__).resolve().parent / "golden" / "gcm_kats.json"
+
+
+def _kats():
+    return json.loads(GOLDEN.read_text())["kats"]
+
+
+# ---------------------------------------------------------------------------
+# GF(2^128): the three multiply formulations agree.
+# ---------------------------------------------------------------------------
+
+
+def _rand128(rng) -> int:
+    return int.from_bytes(rng.bytes(16), "big")
+
+
+def test_gf128_mul_field_axioms():
+    """Identity, commutativity, associativity, distributivity — on the
+    bit-serial reference everything else is pinned against."""
+    rng = np.random.default_rng(7)
+    one = 1 << 127  # the polynomial "1" in the reflected bit order
+    for _ in range(20):
+        a, b, c = (_rand128(rng) for _ in range(3))
+        assert gf.gf128_mul(a, one) == a
+        assert gf.gf128_mul(a, b) == gf.gf128_mul(b, a)
+        assert gf.gf128_mul(gf.gf128_mul(a, b), c) == \
+            gf.gf128_mul(a, gf.gf128_mul(b, c))
+        assert gf.gf128_mul(a ^ b, c) == \
+            gf.gf128_mul(a, c) ^ gf.gf128_mul(b, c)
+
+
+def test_gf128_table_and_matrix_match_reference():
+    rng = np.random.default_rng(8)
+    for _ in range(5):
+        h = _rand128(rng)
+        tables = gf.gf128_tables(h)
+        m = gf.gf128_mul_matrix_words(h)
+        for _ in range(10):
+            x = _rand128(rng)
+            want = gf.gf128_mul(x, h)
+            assert gf.gf128_mul_table(x, tables) == want
+            assert gf.gf128_matvec_words(m, x) == want
+
+
+def test_wordbit_basis_roundtrip():
+    """The word-bit basis change is its own inverse and maps exactly
+    one bit per index."""
+    for j in (0, 1, 7, 8, 31, 32, 63, 64, 100, 127):
+        z = gf.wordbit_to_int(j)
+        bits = gf.int_to_wordbits(z)
+        assert bits.sum() == 1 and bits[j] == 1
+    rng = np.random.default_rng(9)
+    z = _rand128(rng)
+    back = 0
+    for j, bit in enumerate(gf.int_to_wordbits(z)):
+        if bit:
+            back |= gf.wordbit_to_int(j)
+    assert back == z
+
+
+# ---------------------------------------------------------------------------
+# GHASH: traced Horner kernel vs the int reference; tag compare twins.
+# ---------------------------------------------------------------------------
+
+
+def _words_of_bytes(b: bytes) -> np.ndarray:
+    return packing.np_bytes_to_words(np.frombuffer(b, np.uint8))
+
+
+def test_ghash_words_matches_int_reference():
+    rng = np.random.default_rng(10)
+    h = _rand128(rng)
+    m = gf.gf128_mul_matrix_words(h)
+    for nblocks in (1, 2, 5, 32):
+        data = rng.bytes(16 * nblocks)
+        y = np.asarray(gcm.ghash_words(_words_of_bytes(data), m))
+        got = gf.block_to_int(packing.np_words_to_bytes(y).tobytes())
+        assert got == ghash.ghash_int(h, data)
+
+
+def test_ghash_words_y0_continuation():
+    """Seeding y0 continues the Horner chain bit-exactly — the property
+    the serve batcher's AAD-prefix injection relies on."""
+    rng = np.random.default_rng(11)
+    h = _rand128(rng)
+    m = gf.gf128_mul_matrix_words(h)
+    a, b = rng.bytes(32), rng.bytes(48)
+    y_a = ghash.ghash_int(h, a)
+    y0 = _words_of_bytes(gf.int_to_block(y_a))
+    y = np.asarray(gcm.ghash_words(_words_of_bytes(b), m, y0))
+    got = gf.block_to_int(packing.np_words_to_bytes(y).tobytes())
+    assert got == ghash.ghash_int(h, a + b)
+
+
+def test_tag_compare_twins_and_constant_shape():
+    rng = np.random.default_rng(12)
+    a = rng.bytes(16)
+    for b in (a, a[:15] + bytes([a[15] ^ 1]), rng.bytes(16)):
+        want = a == b
+        assert ghash.np_tag_eq(a, b) is want
+        got = bool(gcm.tag_eq_words(_words_of_bytes(a),
+                                    _words_of_bytes(b)))
+        assert got is want
+
+
+def test_inc32_counter_blocks_wrap():
+    """np_gcm_ctr_blocks implements inc32: ONLY the low 32 bits move,
+    mod 2^32 — pinned across the wrap against the byte-loop reference."""
+    j0 = bytes(range(12)) + b"\xff\xff\xff\xfe"  # low word near 2^32
+    idx = np.arange(5, dtype=np.uint32)
+    got = ghash.np_gcm_ctr_blocks(j0, idx)
+    for k in range(5):
+        want = _words_of_bytes(ghash.inc32(j0, k))
+        assert np.array_equal(got[k], want), k
+
+
+# ---------------------------------------------------------------------------
+# NIST SP 800-38D KATs through the models API.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("kat", _kats(), ids=lambda k: k["name"])
+def test_gcm_kat_models_api(kat):
+    key, iv = bytes.fromhex(kat["key"]), bytes.fromhex(kat["iv"])
+    aad, pt = bytes.fromhex(kat["aad"]), bytes.fromhex(kat["pt"])
+    ct, tag = gcm_seal(key, iv, aad, pt)
+    assert ct.hex() == kat["ct"]
+    assert tag.hex() == kat["tag"]
+    assert gcm_open(key, iv, aad, ct, tag) == pt
+
+
+@pytest.mark.parametrize("kat", [k for k in _kats() if k["ct"]],
+                         ids=lambda k: k["name"])
+def test_gcm_kat_tamper_rejected(kat):
+    """One flipped bit anywhere — ciphertext, tag, or AAD — must refuse
+    with TagMismatchError and never return partial plaintext."""
+    key, iv = bytes.fromhex(kat["key"]), bytes.fromhex(kat["iv"])
+    aad, ct = bytes.fromhex(kat["aad"]), bytes.fromhex(kat["ct"])
+    tag = bytes.fromhex(kat["tag"])
+    bad_ct = bytes([ct[0] ^ 1]) + ct[1:]
+    with pytest.raises(TagMismatchError):
+        gcm_open(key, iv, aad, bad_ct, tag)
+    bad_tag = tag[:-1] + bytes([tag[-1] ^ 0x80])
+    with pytest.raises(TagMismatchError):
+        gcm_open(key, iv, aad, ct, bad_tag)
+    if aad:
+        bad_aad = bytes([aad[0] ^ 1]) + aad[1:]
+        with pytest.raises(TagMismatchError):
+            gcm_open(key, iv, bad_aad, ct, tag)
+
+
+# ---------------------------------------------------------------------------
+# Fuzz parity: traced seal/open vs the pure-host reference.
+# ---------------------------------------------------------------------------
+
+
+def test_gcm_fuzz_parity_against_host_reference():
+    """The fuzz-parity satellite: random lengths (block-aligned, ragged
+    tails, empty), AAD splits (empty, short, multi-block, ragged),
+    96-bit and non-96-bit IVs, all three key sizes — traced gcm_seal/
+    gcm_open must agree with np_gcm_seal/np_gcm_open byte-for-byte."""
+    rng = np.random.default_rng(0xAEAD)
+    pt_lens = [0, 1, 15, 16, 17, 48, 65, 256, 1000]
+    aad_lens = [0, 1, 16, 20, 33]
+    cases = 0
+    for keylen in (16, 24, 32):
+        key = rng.bytes(keylen)
+        for ivlen in (12, 8, 16):
+            iv = rng.bytes(ivlen)
+            for _ in range(6):
+                pt = rng.bytes(int(rng.choice(pt_lens)))
+                aad = rng.bytes(int(rng.choice(aad_lens)))
+                ct, tag = gcm_seal(key, iv, aad, pt)
+                ct_ref, tag_ref = ghash.np_gcm_seal(key, iv, aad, pt)
+                assert ct == ct_ref and tag == tag_ref, \
+                    (keylen, ivlen, len(pt), len(aad))
+                assert gcm_open(key, iv, aad, ct, tag) == pt
+                assert ghash.np_gcm_open(key, iv, aad, ct, tag) == pt
+                cases += 1
+    assert cases == 54
+
+
+def test_gcm_open_refuses_what_host_refuses():
+    rng = np.random.default_rng(0xBEEF)
+    key, iv = rng.bytes(16), rng.bytes(12)
+    pt, aad = rng.bytes(100), rng.bytes(20)
+    ct, tag = gcm_seal(key, iv, aad, pt)
+    bad = bytes([ct[50] ^ 4]) + b"" if len(ct) < 51 else \
+        ct[:50] + bytes([ct[50] ^ 4]) + ct[51:]
+    assert ghash.np_gcm_open(key, iv, aad, bad, tag) is None
+    with pytest.raises(TagMismatchError):
+        gcm_open(key, iv, aad, bad, tag)
+
+
+# ---------------------------------------------------------------------------
+# Parallel CBC decrypt: the multikey seam vs the single-key models path.
+# ---------------------------------------------------------------------------
+
+
+def _np_cbc_encrypt(key: bytes, iv16: bytes, pt: bytes) -> bytes:
+    nr, rk = expand_key_enc(key)
+    prev, out = iv16, bytearray()
+    for i in range(0, len(pt), 16):
+        blk = bytes(a ^ b for a, b in zip(pt[i:i + 16], prev))
+        prev = ghash.np_aes_encrypt_block(nr, rk, blk).tobytes()
+        out += prev
+    return bytes(out)
+
+
+def test_dec_schedule_from_enc_matches_expand_key_dec():
+    rng = np.random.default_rng(13)
+    for keylen in (16, 24, 32):
+        key = rng.bytes(keylen)
+        nr, enc = expand_key_enc(key)
+        _nr, dec = expand_key_dec(key)
+        assert np.array_equal(dec_schedule_from_enc(nr, enc), dec)
+
+
+@pytest.mark.parametrize("engine", ["jnp", "bitslice"])
+def test_cbc_decrypt_scattered_multikey_parity(engine):
+    """Two requests under two keys, concatenated into ONE dispatch with
+    the host-built PREV stream — byte-identical to per-request CBC
+    decrypt, which is itself pinned to the encrypt chain's inverse."""
+    rng = np.random.default_rng(14)
+    reqs = []
+    for _ in range(2):
+        key = rng.bytes(16)
+        iv = rng.bytes(16)
+        pt = rng.bytes(16 * int(rng.integers(1, 6)))
+        reqs.append((key, iv, pt, _np_cbc_encrypt(key, iv, pt)))
+    nr = 10
+    rks_dec = np.zeros((4, 44), dtype=np.uint32)
+    words, prev, slots = [], [], []
+    for si, (key, iv, pt, ct) in enumerate(reqs):
+        rks_dec[si] = expand_key_dec(key)[1]
+        w = _words_of_bytes(ct)
+        words.append(w)
+        pv = _words_of_bytes(iv + ct[:-16])
+        prev.append(pv)
+        slots.append(np.full(len(ct) // 16, si, np.uint32))
+    out = np.asarray(aes.cbc_decrypt_words_scattered_multikey(
+        np.concatenate(words), np.concatenate(prev), rks_dec,
+        np.concatenate(slots), nr, engine))
+    got = packing.np_words_to_bytes(out).tobytes()
+    want = b"".join(pt for (_k, _iv, pt, _ct) in reqs)
+    assert got == want
+
+
+def test_bitslice_decrypt_words_multikey_matches_per_key():
+    rng = np.random.default_rng(15)
+    n = 8
+    words = rng.integers(0, 2**32, 4 * n, dtype=np.uint32)
+    keys = [rng.bytes(16) for _ in range(2)]
+    rk_rows = np.stack([expand_key_dec(k)[1] for k in keys])
+    slot = np.array([0, 1] * (n // 2), np.uint32)
+    w2 = words.reshape(n, 4)
+    got = np.asarray(bitslice.decrypt_words_multikey(
+        w2, rk_rows[slot], 10))
+    for i in range(n):
+        ref = np.asarray(bitslice.decrypt_words(
+            w2[i:i + 1], rk_rows[slot[i]], 10))
+        assert np.array_equal(got.reshape(n, 4)[i], ref.reshape(-1)), i

@@ -15,6 +15,7 @@ way.
 
 import asyncio
 import json
+import re
 
 import numpy as np
 import pytest
@@ -234,7 +235,10 @@ def test_federated_metrics_scrape_carries_every_backend():
                 assert f'ot_route_federate_up{{backend="{name}"}} 1' \
                     in text
                 assert f'backend="{name}"' in text
-            assert "serve_requests_total{backend=" in text
+            # serve_requests now carries its mode label (ot-aead), so
+            # the backend relabel lands after it: match any label set.
+            assert re.search(r'serve_requests_total\{[^}]*backend="b',
+                             text)
             # --no-federate arm: the router's registry only.
             status.federate = False
             raw = await _get(status.port,

@@ -269,7 +269,7 @@ def test_sampled_out_serve_run_keeps_counters_exact(traced, monkeypatch):
     assert not run.violations and not run.orphans()
     # The exactness contract: registry totals match the real traffic.
     totals = run.metrics_totals()
-    assert totals["counters"]["serve_requests"] == 6
+    assert totals["counters"]["serve_requests{mode=ctr}"] == 6
     assert totals["counters"]["serve_batches{outcome=ok}"] >= 1
     assert metrics.counter_total("serve_requests") == 6
 

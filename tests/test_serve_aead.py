@@ -1,0 +1,378 @@
+"""ot-aead through ot-serve: AES-GCM and parallel CBC-decrypt as SERVED
+modes (the second workload over the whole serving stack).
+
+Covers the queue's per-mode admission contract (mode vocabulary, the
+enabled-mode set, IV/tag length validation), the rung-packer's
+never-mix-modes invariant (including the GCM J0-row capacity
+accounting), the NIST SP 800-38D KATs end-to-end through a LIVE server
+— in-process and over the framed wire protocol — the tamper contract
+(one flipped ciphertext byte → exactly ONE per-request ``auth-failed``
+refusal, zero post-warmup recompiles, ``lost == 0``, and the server
+keeps serving), the ``tag_mismatch`` fault point driving the same path
+deterministically, and the mixed-mode loadgen drive (CTR + GCM seal/
+open + CBC interleaved, bit-exact probes, zero errors).
+"""
+
+import asyncio
+import json
+import pathlib
+
+import numpy as np
+import pytest
+
+from our_tree_tpu.aead import ghash
+from our_tree_tpu.ops.keyschedule import expand_key_enc
+from our_tree_tpu.resilience import degrade, faults
+from our_tree_tpu.serve import batcher, keycache, loadgen
+from our_tree_tpu.serve import queue as otq
+from our_tree_tpu.serve.server import Server, ServerConfig
+from our_tree_tpu.serve.worker import RequestFrontend
+from our_tree_tpu.serve import wire
+
+GOLDEN = pathlib.Path(__file__).resolve().parent / "golden" / "gcm_kats.json"
+
+#: Small ladder + one lane: fast warmup (each enabled mode walks the
+#: ladder per lane), no failover noise.
+AEAD_CFG = dict(engine="jnp", lanes=1, min_bucket_blocks=32,
+                max_bucket_blocks=64,
+                modes=("ctr", "gcm", "gcm-open", "cbc"))
+
+
+@pytest.fixture(autouse=True)
+def _clean_process_state(monkeypatch):
+    monkeypatch.delenv("OT_FAULTS", raising=False)
+    faults.reset()
+    degrade.clear()
+    yield
+    monkeypatch.delenv("OT_FAULTS", raising=False)
+    faults.reset()
+    degrade.clear()
+
+
+def _run_server(config, fn):
+    async def main():
+        server = Server(config)
+        await server.start()
+        try:
+            return server, await fn(server)
+        finally:
+            await server.stop()
+
+    return asyncio.run(main())
+
+
+def _served_kats():
+    """The golden KATs the block-granular serve path can carry: 96-bit
+    IV (the serve fast path) and a block-multiple payload. The ragged
+    and non-96-bit-IV vectors stay models-API coverage (test_aead)."""
+    kats = json.loads(GOLDEN.read_text())["kats"]
+    return [k for k in kats
+            if len(k["iv"]) == 24 and k["ct"] and len(k["ct"]) % 32 == 0]
+
+
+# ---------------------------------------------------------------------------
+# Admission: the per-mode request contract.
+# ---------------------------------------------------------------------------
+
+
+def test_queue_admission_validates_modes():
+    async def main():
+        q = otq.RequestQueue(max_depth=8, max_request_blocks=64,
+                             modes=("ctr", "gcm", "gcm-open", "cbc"))
+        key, pay = b"k" * 16, np.zeros(16, np.uint8)
+
+        async def code(**kw):
+            resp = await q.submit("t", key, b"", pay, **kw)
+            return resp.error
+
+        # Unknown mode / wrong field lengths: coded refusals, counted.
+        assert await code(mode="xts") == otq.ERR_BAD_REQUEST
+        assert await code(mode="gcm", iv=b"x" * 16) == otq.ERR_BAD_REQUEST
+        assert await code(mode="gcm-open", iv=b"x" * 12,
+                          tag=b"t" * 8) == otq.ERR_BAD_REQUEST
+        assert await code(mode="cbc", iv=b"x" * 12) == otq.ERR_BAD_REQUEST
+        # The GCM J0 row counts against the request's span.
+        big = np.zeros(16 * 64, np.uint8)
+        r = await q.submit("t", key, b"", big, mode="gcm", iv=b"x" * 12)
+        assert r.error == otq.ERR_TOO_LARGE
+        # Valid forms admit.
+        f1 = q.submit("t", key, b"", pay, mode="gcm", iv=b"i" * 12)
+        f2 = q.submit("t", key, b"", pay, mode="gcm-open", iv=b"i" * 12,
+                      tag=b"t" * 16)
+        f3 = q.submit("t", key, b"", pay, mode="cbc", iv=b"i" * 16)
+        assert len(q.drain()) == 3
+        for f in (f1, f2, f3):
+            f.cancel()
+
+    asyncio.run(main())
+
+
+def test_queue_refuses_unwarmed_mode():
+    """A mode outside the server's enabled set refuses at admission —
+    its ladder was never warmed, so serving it would recompile
+    mid-traffic."""
+    async def main():
+        q = otq.RequestQueue(max_depth=8, max_request_blocks=64,
+                             modes=("ctr",))
+        r = await q.submit("t", b"k" * 16, b"", np.zeros(16, np.uint8),
+                           mode="gcm", iv=b"i" * 12)
+        assert r.error == otq.ERR_BAD_REQUEST
+        assert "not enabled" in r.detail
+
+    asyncio.run(main())
+
+
+def test_server_start_rejects_unknown_mode():
+    with pytest.raises(ValueError):
+        Server(ServerConfig(modes=("ctr", "bogus")))
+
+
+# ---------------------------------------------------------------------------
+# The rung-packer: batches never mix modes; GCM spans carry the J0 row.
+# ---------------------------------------------------------------------------
+
+
+def _req(rid, mode, nblocks, key=b"a" * 16, tenant="t0"):
+    kw = {}
+    if mode == "ctr":
+        kw["nonce"] = b"\0" * 16
+    elif mode in otq.GCM_MODES:
+        kw.update(nonce=b"", iv=b"i" * 12, tag=b"t" * 16)
+    else:
+        kw.update(nonce=b"", iv=b"i" * 16)
+    return otq.Request(id=rid, tenant=tenant, key=key,
+                       payload=np.zeros(16 * nblocks, np.uint8),
+                       future=None, mode=mode, **kw)
+
+
+def test_form_batches_never_mixes_modes():
+    rungs = batcher.bucket_ladder(32, 128)
+    reqs = [_req(0, "ctr", 4), _req(1, "gcm", 4), _req(2, "ctr", 4),
+            _req(3, "cbc", 4), _req(4, "gcm-open", 4), _req(5, "gcm", 4)]
+    batches = batcher.form_batches(reqs, rungs, keycache.key_digest)
+    assert all(len({r.mode for r in b.requests}) == 1 for b in batches)
+    # Same (mode, tenant, key) groups coalesce: the two gcm requests
+    # share one batch even split by other modes in arrival order.
+    by_mode = {}
+    for b in batches:
+        by_mode.setdefault(b.mode, []).append(len(b.requests))
+    assert by_mode == {"ctr": [2], "gcm": [2], "cbc": [1],
+                       "gcm-open": [1]}
+    # Mode rides the batch label (the per-mode dispatch series).
+    assert any(b.label.endswith(":gcm") for b in batches)
+
+
+def test_gcm_span_blocks_counts_j0_row():
+    assert _req(0, "gcm", 4).span_blocks == 5
+    assert _req(0, "gcm-open", 4).span_blocks == 5
+    assert _req(0, "ctr", 4).span_blocks == 4
+    assert _req(0, "cbc", 4).span_blocks == 4
+    # Capacity packs by span: 8 gcm requests of 15 blocks are 128 rows
+    # (8 x 16), not 120 — they fill the 128 rung exactly.
+    rungs = batcher.bucket_ladder(32, 128)
+    reqs = [_req(i, "gcm", 15, key=b"a" * 16) for i in range(8)]
+    batches = batcher.form_batches(reqs, rungs, keycache.key_digest)
+    assert [b.bucket for b in batches] == [128]
+
+
+def test_gcm_materialise_layout():
+    """Row 0 = J0 under a zero data word, inc32 counters, seg_keep
+    resets, AAD prefix injected at the first data row."""
+    key = b"k" * 16
+    req = _req(0, "gcm", 2, key=key)
+    req.aad = b"hdr!"
+    rungs = batcher.bucket_ladder(32, 32)
+    b, = batcher.form_batches([req], rungs, keycache.key_digest)
+    kc = keycache.KeyCache()
+    sched = kc.stacked(b.keys, b.key_slots, mode="gcm")
+    b.materialise(sched=sched)
+    ctr = b.ctr_words.reshape(-1, 4)
+    j0 = b"i" * 12 + b"\x00\x00\x00\x01"
+    from our_tree_tpu.utils import packing
+    assert np.array_equal(
+        ctr[0], packing.np_bytes_to_words(np.frombuffer(j0, np.uint8)))
+    assert np.array_equal(
+        ctr[1], packing.np_bytes_to_words(
+            np.frombuffer(ghash.inc32(j0, 1), np.uint8)))
+    assert np.array_equal(b.words[:4], np.zeros(4, np.uint32))  # J0 row
+    assert list(b.seg_keep[:3]) == [0, 0, 1]
+    inj = b.inject_words.reshape(-1, 4)
+    assert inj[1].any() and not inj[0].any()  # Y_aad at first data row
+    assert b.req_spans == [(1, 2)]
+
+
+# ---------------------------------------------------------------------------
+# Live server: KATs, tamper, fault point, mixed-mode drive.
+# ---------------------------------------------------------------------------
+
+
+def test_serve_gcm_kats_live_server():
+    """The NIST KATs end-to-end through a live server: seal returns the
+    KAT ciphertext AND tag bit-exactly, open returns the plaintext —
+    with zero post-warmup recompiles."""
+    kats = _served_kats()
+    assert kats, "no block-aligned 96-bit-IV KATs in the golden file"
+
+    async def drive(server):
+        outs = []
+        for k in kats:
+            key, iv = bytes.fromhex(k["key"]), bytes.fromhex(k["iv"])
+            aad = bytes.fromhex(k["aad"])
+            pt = np.frombuffer(bytes.fromhex(k["pt"]), np.uint8)
+            ct = np.frombuffer(bytes.fromhex(k["ct"]), np.uint8)
+            tag = bytes.fromhex(k["tag"])
+            seal = await server.submit("t0", key, b"", pt, mode="gcm",
+                                       iv=iv, aad=aad)
+            opened = await server.submit("t0", key, b"", ct,
+                                         mode="gcm-open", iv=iv, aad=aad,
+                                         tag=tag)
+            outs.append((k, seal, opened))
+        return outs
+
+    # The golden set spans AES-128 AND AES-256: warm both key sizes so
+    # the zero-recompile assertion holds across nr values too.
+    server, outs = _run_server(
+        ServerConfig(warmup_key_bits=(128, 256), **AEAD_CFG), drive)
+    for k, seal, opened in outs:
+        assert seal.ok and opened.ok, (k["name"], seal.error, opened.error)
+        assert bytes(seal.payload).hex() == k["ct"], k["name"]
+        assert seal.tag.hex() == k["tag"], k["name"]
+        assert bytes(opened.payload).hex() == k["pt"], k["name"]
+    assert server.steady_compiles() == 0
+    assert server.stats()["queue"]["lost"] == 0
+
+
+def test_serve_tamper_one_byte_one_auth_failed():
+    """The acceptance tamper drive: N valid opens + ONE with a flipped
+    ciphertext byte → exactly one ``auth-failed``, every other request
+    answered with plaintext, zero recompiles, zero lost — and the
+    server still serves afterwards."""
+    rng = np.random.default_rng(21)
+    key, iv, aad = rng.bytes(16), rng.bytes(12), rng.bytes(12)
+    pt = rng.bytes(512)
+    ct, tag = ghash.np_gcm_seal(key, iv, aad, pt)
+    bad = bytearray(ct)
+    bad[17] ^= 0x20
+
+    async def drive(server):
+        good = [server.submit("t0", key, b"",
+                              np.frombuffer(ct, np.uint8),
+                              mode="gcm-open", iv=iv, aad=aad, tag=tag)
+                for _ in range(5)]
+        tampered = server.submit("t0", key, b"",
+                                 np.frombuffer(bytes(bad), np.uint8),
+                                 mode="gcm-open", iv=iv, aad=aad, tag=tag)
+        resps = await asyncio.gather(*good, tampered)
+        after = await server.submit(
+            "t0", key, b"", np.frombuffer(ct, np.uint8),
+            mode="gcm-open", iv=iv, aad=aad, tag=tag)
+        return resps, after
+
+    server, (resps, after) = _run_server(ServerConfig(**AEAD_CFG), drive)
+    codes = [r.error for r in resps]
+    assert codes.count(otq.ERR_AUTH) == 1
+    for r in resps:
+        if r.error is None:
+            assert bytes(r.payload) == pt
+        else:
+            assert r.payload is None  # never partial plaintext
+    assert after.ok and bytes(after.payload) == pt
+    assert server.steady_compiles() == 0
+    assert server.stats()["queue"]["lost"] == 0
+
+
+def test_tag_mismatch_fault_point(monkeypatch):
+    """OT_FAULTS=tag_mismatch:1 forces exactly ONE auth-failed on VALID
+    traffic — the deterministic CI rehearsal of the auth-failure path."""
+    monkeypatch.setenv("OT_FAULTS", "tag_mismatch:1")
+    faults.reset()
+    rng = np.random.default_rng(22)
+    key, iv = rng.bytes(16), rng.bytes(12)
+    pt = rng.bytes(256)
+    ct, tag = ghash.np_gcm_seal(key, iv, b"", pt)
+
+    async def drive(server):
+        return [await server.submit("t0", key, b"",
+                                    np.frombuffer(ct, np.uint8),
+                                    mode="gcm-open", iv=iv, tag=tag)
+                for _ in range(3)]
+
+    server, resps = _run_server(ServerConfig(**AEAD_CFG), drive)
+    codes = [r.error for r in resps]
+    assert codes.count(otq.ERR_AUTH) == 1
+    assert sum(1 for r in resps if r.ok) == 2
+    assert server.stats()["queue"]["lost"] == 0
+
+
+def test_serve_kats_over_the_wire():
+    """The KATs through the FRAMED WIRE protocol (worker frontend over
+    a real loopback socket): mode/iv/aad/tag ride the header, the seal
+    tag rides back, a tampered byte answers the coded auth-failed
+    frame — the router-facing shape of the AEAD contract."""
+    kat = _served_kats()[0]
+    key, iv = bytes.fromhex(kat["key"]), bytes.fromhex(kat["iv"])
+    aad, tag = bytes.fromhex(kat["aad"]), bytes.fromhex(kat["tag"])
+    pt, ct = bytes.fromhex(kat["pt"]), bytes.fromhex(kat["ct"])
+
+    async def main():
+        server = Server(ServerConfig(**AEAD_CFG))
+        await server.start()
+        frontend = RequestFrontend(server, 0)
+        await frontend.start()
+        try:
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", frontend.port)
+
+            async def ask(hdr, payload):
+                writer.write(wire.encode_frame(hdr, payload))
+                await writer.drain()
+                return await wire.read_frame(reader)
+
+            base = {"t": "t0", "k": key.hex()}
+            h, body = await ask({**base, "m": "gcm", "iv": iv.hex(),
+                                 "a": aad.hex()}, pt)
+            assert h["ok"] and body == ct and h["tg"] == tag.hex()
+            h, body = await ask({**base, "m": "gcm-open", "iv": iv.hex(),
+                                 "a": aad.hex(), "tg": tag.hex()}, ct)
+            assert h["ok"] and body == pt
+            bad = bytearray(ct)
+            bad[3] ^= 1
+            h, body = await ask({**base, "m": "gcm-open", "iv": iv.hex(),
+                                 "a": aad.hex(), "tg": tag.hex()},
+                                bytes(bad))
+            assert not h["ok"] and h["error"] == otq.ERR_AUTH
+            writer.close()
+        finally:
+            await frontend.stop()
+            await server.stop()
+        return server
+
+    server = asyncio.run(main())
+    assert server.steady_compiles() == 0
+    assert server.stats()["queue"]["lost"] == 0
+
+
+def test_mixed_mode_loadgen_drive():
+    """The mixed-workload drive: CTR + GCM seal/open + CBC interleaved
+    through one queue — zero errors, bit-exact probes (ciphertext AND
+    tag), zero recompiles, per-mode metrics populated."""
+    from our_tree_tpu.obs import metrics
+
+    modes = ("ctr", "gcm", "gcm-open", "cbc")
+    sizes = (64, 256, 512)
+    probes = loadgen.make_probes(sizes, seed=3, modes=modes)
+
+    async def drive(server):
+        return await loadgen.run(server, 60, concurrency=8, sizes=sizes,
+                                 seed=3, verify_every=4, probes=probes,
+                                 modes=modes)
+
+    server, report = _run_server(ServerConfig(**AEAD_CFG), drive)
+    assert report.ok == report.requests == 60
+    assert report.errors == {}
+    assert report.verified > 0 and report.mismatches == 0
+    assert server.steady_compiles() == 0
+    assert server.stats()["queue"]["lost"] == 0
+    per_mode = metrics.counter_by_label("serve_requests", "mode")
+    assert set(per_mode) == set(modes)
+    assert sum(per_mode.values()) >= 60
