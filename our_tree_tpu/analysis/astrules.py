@@ -48,7 +48,7 @@ try:
     from ..obs.metrics import ALLOWED_LABEL_KEYS
 except Exception:  # pragma: no cover - only on a broken tree
     ALLOWED_LABEL_KEYS = ("lane", "rung", "engine", "outcome", "bucket",
-                          "stage",
+                          "stage", "nr",
                           "code", "state", "slots", "point", "kind",
                           "mode", "backend", "reason")
 

@@ -31,7 +31,25 @@ per worker; this package gives every run the same per-phase attribution:
 * ``slo`` — SLO regression gates: compare a serve run against a
   committed ``SERVE_r*.json`` baseline with per-metric tolerances
   (count metrics tolerate nothing); ``serve.bench --slo`` runs it
-  in-process, CI gates against ``SERVE_r04_control.json``.
+  in-process, CI gates against ``SERVE_r04_control.json``. Gates the
+  per-stage waterfall budgets and the cost section's per-(engine x
+  rung) achieved-GB/s rows — a regression names WHICH stage or kernel
+  moved.
+* ``costmodel`` — static per-(engine, mode, rung) dispatch cost
+  records: analytic jit-boundary HBM traffic (hand-derived from the
+  dispatch signature, per-engine dataflow-aware) pinned within 10% of
+  XLA's ``cost_analysis()``/``memory_analysis()`` byte counts where
+  both exist. Computed once at serve warmup, stamped into
+  ``SERVE_r*.json`` (the ``cost`` section), the run dir
+  (``cost-<pid>-*.json`` — the report's roofline table + gap-explain
+  line), and incident bundles.
+* ``incident`` — the flight recorder: a bounded in-memory ring of
+  recent dispatch records; watchdog kills, quarantines, SLO breaches,
+  and auth-failure spikes dump self-contained evidence bundles
+  (ring + exact metrics snapshot + degrade ledger + cost records)
+  into the run layout, coalesced per incident. ``obs.report
+  --incidents [--check]`` renders/gates them; ``/incidentz`` lists
+  them live.
 * ``export`` — run-dir parsing (schema validation for spans AND metrics
   snapshots, begin/end span pairing, orphan detection — an orphaned
   span IS the evidence of a SIGKILLed child) and the Chrome/Perfetto

@@ -1,0 +1,392 @@
+"""Static dispatch cost records: modeled HBM traffic + op counts.
+
+The serve stack measures *time* exhaustively — per-stage waterfalls,
+device-window accounting, per-lane busy fractions — but until this
+module nothing said what a dispatch *should* cost the hardware: how
+many bytes one batch at (engine, mode, rung) moves across the HBM
+boundary and roughly how many vector ops it issues. Without that,
+"35.4 GB/s offline vs 1 GB/s served" is a gap with no decomposition:
+achieved GB/s against a roofline needs a numerator (bytes actually
+moved per dispatch), and the counter/keystream overhead of CTR means
+payload goodput UNDERSTATES traffic by an engine-dependent factor.
+
+Two sources, pinned against each other:
+
+* **Analytic** (always available, every engine): the jit-boundary
+  traffic derived by hand from the dispatch signature the serve seam
+  actually calls (``models/aes.py``, ``aead/gcm.py``). Per rung ``N``
+  (16-byte blocks), ``K`` key slots, ``nr`` rounds:
+
+  - ``ctr`` (jax engines): payload in + counter words in + the
+    (K, 4*(nr+1)) schedule stack + the (N,) slot vector; payload out.
+  - ``ctr`` (native host tier): payload in + schedules; payload out —
+    counters are generated in C registers per request (the ``runs``
+    fast path), so no counter array ever crosses memory. This is the
+    "per engine" half of the fallback: the traffic model follows the
+    engine's actual dataflow, not one formula.
+  - ``gcm``/``gcm-open``: the ctr arrays plus the (K, 128, 128)
+    mul-by-H bit matrices, the inject state, and the seg_keep vector;
+    out is the stacked (crypt, GHASH-state) pair — 2x payload.
+  - ``cbc``: payload + PREV stream + decrypt schedules in; payload out.
+
+  The op count is an order-of-magnitude AES budget (blocks x rounds x
+  ~32 word-ops, + ~256/block for the GHASH matvec) — use the XLA flops
+  when present; the *byte* model is the precise half.
+
+* **XLA** (where available): ``jit(...).lower(...).compile()`` of the
+  SAME entry points, reading ``cost_analysis()`` (flops, total "bytes
+  accessed" — note this counts every HLO op's operands, a fused-
+  intermediate measure far above boundary traffic) and
+  ``memory_analysis()`` (argument/output buffer bytes — the exact
+  jit-boundary quantity the analytic model predicts). The parity test
+  (tests/test_costmodel.py) pins analytic-vs-XLA byte counts within
+  10% on every engine where both exist: a dispatch-signature change
+  that stales the hand model fails the pin instead of silently skewing
+  every roofline number downstream.
+
+Computed once per process (records memoized) at serve warmup — the
+ladder is already being walked — and stamped three ways: the
+``SERVE_r*.json`` ``cost`` section (``cost_section``), a
+``cost-<pid>-*.json`` file in the OT_TRACE_DIR run layout so
+``obs.report`` can render the roofline table post-hoc with no server
+in sight, and the incident bundles (``obs/incident.py``).
+
+``OT_COST_XLA`` bounds the warmup compile bill: ``0``/``off`` skips
+the XLA half entirely, ``all`` compiles every (engine, mode, rung),
+default ``top`` compiles only each mode's largest rung (byte counts
+scale linearly in N below it; tests compile what they pin).
+
+Module-level imports are stdlib-only (obs discipline — ``obs.report``
+must stay importable in jax-free CI steps); numpy/jax load lazily
+inside the XLA half, and every XLA failure degrades to the analytic
+record, never an exception.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+
+KIND = "ot-cost"
+VERSION = 1
+
+#: Order-of-magnitude word-ops per block per AES round (T-table shape:
+#: 16 gathers + 12 combining XORs + 4 round-key XORs). The analytic op
+#: budget, not a promise — XLA flops supersede it when present.
+OPS_PER_BLOCK_ROUND = 32
+
+#: Extra word-ops per block for the GHASH mul-by-H bit-matrix matvec
+#: (128 AND+XOR steps over 4-word rows).
+OPS_PER_GHASH_BLOCK = 256
+
+#: (engine, mode, rung, nr, key_slots) -> record. Process-global on
+#: purpose: every Server.start() in one process shares the ladder's
+#: records (and the XLA half's compile bill is paid once).
+_CACHE: dict[tuple, dict] = {}
+
+
+def xla_policy() -> str:
+    """``OT_COST_XLA``: ``off`` / ``top`` (default) / ``all``."""
+    v = str(os.environ.get("OT_COST_XLA", "top") or "top").lower()
+    if v in ("0", "off", "none", "false"):
+        return "off"
+    return "all" if v == "all" else "top"
+
+
+def _exec_engine(engine: str, mode: str) -> str:
+    """The engine tier that actually executes (engine, mode): the
+    native host tier serves only ctr in C — AEAD/CBC batches on a
+    native-tier server run the jnp engine in-process (the lane seam's
+    documented tier detour)."""
+    return "jnp" if engine == "native" and mode != "ctr" else engine
+
+
+def analytic_cost(engine: str, mode: str, rung: int, nr: int,
+                  key_slots: int) -> dict:
+    """The hand-derived per-dispatch record (module docstring has the
+    per-mode formulas). Bytes are jit-boundary traffic: what one
+    dispatch reads and writes across the HBM seam."""
+    n = int(rung)
+    k = int(key_slots)
+    blk = 16 * n                       # payload bytes at this rung
+    sched = k * 4 * (int(nr) + 1) * 4  # the stacked schedules
+    exec_eng = _exec_engine(engine, mode)
+    ops = n * int(nr) * OPS_PER_BLOCK_ROUND
+    if mode in ("gcm", "gcm-open"):
+        hmats = k * 128 * 128 * 4
+        bytes_in = blk + blk + sched + 4 * n + hmats + blk + 4 * n
+        bytes_out = 2 * blk            # stacked (crypt, GHASH state)
+        ops += n * OPS_PER_GHASH_BLOCK
+    elif mode == "cbc":
+        bytes_in = blk + blk + sched + 4 * n
+        bytes_out = blk
+    elif exec_eng == "native":
+        # Counters are generated inside C per request (the runs fast
+        # path): no counter array, no slot vector crosses memory.
+        bytes_in = blk + sched
+        bytes_out = blk
+    else:
+        bytes_in = blk + blk + sched + 4 * n
+        bytes_out = blk
+    return {
+        "engine": engine, "exec_engine": exec_eng, "mode": mode,
+        "rung": n, "nr": int(nr), "key_slots": k,
+        "bytes_in": bytes_in, "bytes_out": bytes_out,
+        "hbm_bytes": bytes_in + bytes_out,
+        "ops": ops,
+    }
+
+
+def xla_cost(engine: str, mode: str, rung: int, nr: int,
+             key_slots: int) -> dict | None:
+    """The XLA half: lower + compile the REAL dispatch entry at this
+    shape and read ``cost_analysis()`` + ``memory_analysis()``. None
+    whenever anything is unavailable (native ctr has no XLA program;
+    an old jax may lack either API; a Pallas engine may not lower on
+    this host) — the analytic record stands alone then, and the parity
+    test skips, it does not fail."""
+    try:
+        import numpy as np
+
+        from ..models import aes
+
+        exec_eng = _exec_engine(engine, mode)
+        if exec_eng == aes.NATIVE_ENGINE:
+            return None
+        n, k = int(rung), int(key_slots)
+        w = np.zeros(4 * n, dtype=np.uint32)
+        c = np.zeros(4 * n, dtype=np.uint32)
+        rks = np.zeros((k, 4 * (int(nr) + 1)), dtype=np.uint32)
+        s = np.zeros(n, dtype=np.uint32)
+        knobs = aes._engine_knobs_key(exec_eng)
+        if mode in ("gcm", "gcm-open"):
+            from ..aead import gcm as aead_gcm
+
+            hm = np.zeros((k, 128, 128), dtype=np.uint32)
+            lowered = aead_gcm._gcm_fused_jit.lower(
+                w, c, rks, s, hm, w, s, int(nr), exec_eng,
+                aead_gcm.SEAL if mode == "gcm" else aead_gcm.OPEN, knobs)
+        elif mode == "cbc":
+            lowered = aes._cbc_dec_scattered_multikey_jit.lower(
+                w, c, rks, s, int(nr), exec_eng, knobs)
+        else:
+            lowered = aes._ctr_scattered_multikey_jit.lower(
+                w, c, rks, s, int(nr), exec_eng, knobs)
+        compiled = lowered.compile()
+        out: dict = {}
+        try:
+            ca = compiled.cost_analysis()
+            d = ca[0] if isinstance(ca, (list, tuple)) else ca
+            if isinstance(d, dict):
+                out["flops"] = float(d.get("flops", 0.0))
+                out["bytes_accessed"] = float(d.get("bytes accessed", 0.0))
+        except Exception:  # noqa: BLE001 - partial cost info is still info
+            pass
+        try:
+            ma = compiled.memory_analysis()
+            out["arg_bytes"] = int(ma.argument_size_in_bytes)
+            out["out_bytes"] = int(ma.output_size_in_bytes)
+            out["temp_bytes"] = int(ma.temp_size_in_bytes)
+        except Exception:  # noqa: BLE001 - same
+            pass
+        return out or None
+    except Exception:  # noqa: BLE001 - degrade to analytic, never raise
+        return None
+
+
+def cost_record(engine: str, mode: str, rung: int, nr: int,
+                key_slots: int, with_xla: bool = False) -> dict:
+    """One memoized record. ``with_xla`` requests the compile-backed
+    half (an already-cached analytic-only record is upgraded in
+    place, so the warmup policy and an eager test compose)."""
+    key = (engine, mode, int(rung), int(nr), int(key_slots))
+    rec = _CACHE.get(key)
+    if rec is None:
+        rec = analytic_cost(engine, mode, rung, nr, key_slots)
+        rec["xla"] = None
+        rec["source"] = "analytic"
+        _CACHE[key] = rec
+    if with_xla and rec["xla"] is None:
+        x = xla_cost(engine, mode, rung, nr, key_slots)
+        if x is not None:
+            rec["xla"] = x
+            rec["source"] = "analytic+xla"
+    return rec
+
+
+def ladder_costs(engine: str, modes, rungs, key_bits=(128,),
+                 key_slots: int = 8) -> list[dict]:
+    """Every (mode, rung, nr) record for one server's warmed ladder,
+    with the XLA half per ``OT_COST_XLA`` (default: each mode's top
+    rung only — the byte model is linear in N below it, and one
+    compile per mode bounds the warmup bill)."""
+    from ..ops.keyschedule import ROUNDS
+
+    policy = xla_policy()
+    rungs = tuple(int(r) for r in rungs)
+    records = []
+    for bits in key_bits:
+        nr = ROUNDS[int(bits)]
+        for mode in modes:
+            for rung in rungs:
+                want_xla = (policy == "all"
+                            or (policy == "top" and rung == max(rungs)))
+                records.append(cost_record(engine, mode, rung, nr,
+                                           key_slots, with_xla=want_xla))
+    return records
+
+
+# ---------------------------------------------------------------------------
+# The run-dir stamp (what obs.report joins post-hoc).
+# ---------------------------------------------------------------------------
+
+
+def write_run_records(records, engine: str,
+                      ceiling_gbps: float | None = None) -> str | None:
+    """Stamp the records into the OT_TRACE_DIR run layout as
+    ``cost-<pid>-<tok>.json`` (never raises; None when tracing is off
+    or the write fails — the in-memory records still serve the bench
+    artifact either way)."""
+    try:
+        from . import trace
+
+        if not trace.enabled():
+            return None
+        run = trace.ensure_run()
+        d = trace.run_dir()
+        if d is None:
+            return None
+        os.makedirs(d, exist_ok=True)
+        import uuid
+
+        path = os.path.join(
+            d, f"cost-{os.getpid()}-{uuid.uuid4().hex[:8]}.json")
+        doc = {"kind": KIND, "v": VERSION, "run": run,
+               "pid": os.getpid(), "engine": engine,
+               "ceiling_gbps": ceiling_gbps, "records": list(records)}
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(doc, fh, separators=(",", ":"), sort_keys=True)
+            fh.write("\n")
+        return path
+    except Exception:  # noqa: BLE001 - never-raises discipline
+        return None
+
+
+def load_run_records(run_dir: str) -> tuple[list[dict], float | None]:
+    """(deduped records, ceiling) from every ``cost-*.json`` in the run
+    dir (a fleet writes one per process; identical ladders dedupe on
+    (engine, mode, rung, nr)). Unparseable files are skipped."""
+    records: list[dict] = []
+    seen: set[tuple] = set()
+    ceiling = None
+    for path in sorted(glob.glob(os.path.join(run_dir, "cost-*.json"))):
+        try:
+            with open(path, encoding="utf-8") as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(doc, dict) or doc.get("kind") != KIND:
+            continue
+        if ceiling is None and doc.get("ceiling_gbps"):
+            ceiling = float(doc["ceiling_gbps"])
+        for rec in doc.get("records", []):
+            if not isinstance(rec, dict):
+                continue
+            key = (rec.get("engine"), rec.get("mode"), rec.get("rung"),
+                   rec.get("nr"))
+            if key in seen:
+                continue
+            seen.add(key)
+            records.append(rec)
+    return records, ceiling
+
+
+# ---------------------------------------------------------------------------
+# The roofline join: records x measured per-rung dispatch counters.
+# ---------------------------------------------------------------------------
+
+
+_FLAT_RE = re.compile(r"^([A-Za-z0-9_]+)\{(.*)\}$")
+
+
+def _series_by_key(counters: dict, name: str) -> dict[tuple, float]:
+    """{(engine, mode, rung, nr): total} for one flat-keyed counter
+    name (the ``obs/metrics.py`` ``name{k=v,...}`` convention both the
+    live snapshot and the run-dir totals share)."""
+    out: dict[tuple, float] = {}
+    for key, v in counters.items():
+        m = _FLAT_RE.match(key)
+        if not m or m.group(1) != name:
+            continue
+        labels = dict(p.split("=", 1)
+                      for p in m.group(2).split(",") if "=" in p)
+        try:
+            k = (labels.get("engine", "?"), labels.get("mode", "ctr"),
+                 int(labels.get("rung", 0)), int(labels.get("nr", 0)))
+        except ValueError:
+            continue
+        out[k] = out.get(k, 0.0) + float(v)
+    return out
+
+
+def cost_section(records, counters: dict,
+                 ceiling_gbps: float | None = None) -> dict:
+    """The artifact/report ``cost`` join: per (engine, mode, rung) with
+    traffic, modeled bytes moved x measured dispatches over the rung's
+    accumulated DEVICE time (``serve_rung_dispatches`` /
+    ``serve_rung_device_us``, serve/lanes.py) -> achieved GB/s moved
+    and utilization against the measured roofline. Rows exist only for
+    rungs that actually dispatched; ``per_engine`` aggregates them —
+    the SERVE_r* ``cost`` section and the SLO gate's per-row surface."""
+    disp = _series_by_key(counters, "serve_rung_dispatches")
+    dev = _series_by_key(counters, "serve_rung_device_us")
+    rows = []
+    seen: set[tuple] = set()
+    per_engine: dict[str, dict] = {}
+    for rec in records:
+        # nr is part of the join: a 128- and a 256-bit ladder at the
+        # same rung are DIFFERENT records (schedule traffic + rounds),
+        # and the lane seam labels its counters accordingly.
+        key = (rec.get("engine", "?"), rec.get("mode", "ctr"),
+               int(rec.get("rung", 0)), int(rec.get("nr", 0)))
+        if key in seen:
+            continue
+        seen.add(key)
+        d = disp.get(key, 0.0)
+        if d <= 0:
+            continue
+        dus = dev.get(key, 0.0)
+        moved = float(rec["hbm_bytes"]) * d
+        gbps = (moved / 1e9 / (dus / 1e6)) if dus > 0 else 0.0
+        rows.append({
+            "engine": key[0], "mode": key[1], "rung": key[2],
+            "nr": key[3],
+            "dispatches": int(d),
+            "modeled_dispatch_bytes": int(rec["hbm_bytes"]),
+            "modeled_bytes": int(moved),
+            "device_s": round(dus / 1e6, 6),
+            "achieved_gbps": round(gbps, 6),
+            "utilization": (round(gbps / ceiling_gbps, 6)
+                            if ceiling_gbps else None),
+        })
+        agg = per_engine.setdefault(key[0], {"modeled_bytes": 0,
+                                             "device_s": 0.0})
+        agg["modeled_bytes"] += int(moved)
+        agg["device_s"] += dus / 1e6
+    for eng, agg in per_engine.items():
+        gbps = (agg["modeled_bytes"] / 1e9 / agg["device_s"]
+                if agg["device_s"] > 0 else 0.0)
+        agg["device_s"] = round(agg["device_s"], 6)
+        agg["achieved_gbps"] = round(gbps, 6)
+        agg["utilization"] = (round(gbps / ceiling_gbps, 6)
+                              if ceiling_gbps else None)
+    rows.sort(key=lambda r: (r["engine"], r["mode"], r["rung"],
+                             r["nr"]))
+    return {"ceiling_gbps": ceiling_gbps, "records": list(records),
+            "rows": rows, "per_engine": per_engine}
+
+
+def reset_for_tests() -> None:
+    _CACHE.clear()

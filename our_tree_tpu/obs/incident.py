@@ -1,0 +1,306 @@
+"""The incident flight recorder: a bounded dispatch ring + evidence bundles.
+
+When a serve incident fires — a watchdog kill, a quarantine, an SLO
+breach, an auth-failure spike — the evidence an operator needs is
+scattered: the trace stream has the force-sampled spans (somewhere in a
+rotating JSONL), the registry has the exact counters (last snapshot),
+the cost model knows what the dispatches should have cost, and the
+dispatch history right before the event is nowhere at all once spans
+are sampled. This module is the black box:
+
+* **The ring** (``record``): a bounded in-memory deque of the most
+  recent TRAFFIC dispatch records — lane, rung, engine, mode, outcome,
+  device/wall µs, batch label, timestamp — appended by the lane seam
+  on every dispatch completion (serve/lanes.py), O(1), never-raises,
+  ``OT_INCIDENT_RING`` entries (default 256, 0 disables). Warmup and
+  canary dispatches are not traffic and stay out.
+* **Triggers** (``trigger``): the four incident classes dump a
+  self-contained bundle into the OT_TRACE_DIR run layout —
+  ``incident-<pid>-<tok>-<n>.json`` beside the trace/metrics/cost
+  files — holding the ring, the full metrics snapshot, the degrade
+  ledger, the process's cost records, and the trigger's own attrs.
+  The force-sampled spans the incident left live in the trace stream
+  beside it (the bundle stamps the run id that joins them).
+  Triggers COALESCE: one incident is usually several signals within
+  milliseconds (the watchdog kill quarantines its lane), so a trigger
+  inside ``OT_INCIDENT_COOLDOWN_S`` (default 30) of the last bundle is
+  counted as suppressed instead of dumping a near-identical bundle —
+  the CI lane-kill drive's "exactly one bundle" gate is this rule.
+  ``OT_INCIDENT_MAX`` (default 8) bounds bundles per process.
+* **Auth-failure spike** (``note_auth_failure``): single tag
+  mismatches are data events (a per-request refusal, by design); a
+  SPIKE — ``OT_INCIDENT_AUTH_SPIKE`` (default 3) failures within
+  ``OT_INCIDENT_AUTH_WINDOW_S`` (default 10) — is an incident
+  (key confusion, an attack, a broken client) and triggers.
+
+Reading: ``obs.report --incidents <run-dir>`` renders every bundle and
+``--check`` gates their schema (``validate_bundle``); the status
+endpoint's ``/incidentz`` lists them live (serve/status.py). Same
+constitution as trace/metrics: never raises, and with tracing OFF the
+ring still records in memory (for /incidentz) while bundles are
+skipped — the run layout is where bundles live.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import glob
+import os
+import time
+import uuid
+
+from . import metrics, trace
+
+KIND = "ot-incident"
+VERSION = 1
+
+#: Bundle schema: the keys every bundle must carry, and the fields
+#: every ring record must carry (``validate_bundle``).
+REQUIRED_KEYS = ("kind", "v", "run", "pid", "ts_us", "reason", "ring",
+                 "metrics")
+RING_REQUIRED = ("t_us", "outcome")
+
+#: The closed trigger vocabulary (a ``reason`` outside it is a schema
+#: violation — new incident classes are added here deliberately).
+REASONS = ("watchdog-kill", "quarantine", "slo-breach", "auth-spike")
+
+_RING: collections.deque | None = None
+_PROC = uuid.uuid4().hex[:8]
+_BUNDLES = 0
+_SUPPRESSED = 0
+_LAST_TRIGGER_US: int | None = None
+_AUTH_TS: collections.deque = collections.deque(maxlen=64)
+_COST_RECORDS: list = []
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default) or default)
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default) or default)
+    except ValueError:
+        return default
+
+
+def ring_capacity() -> int:
+    return max(_env_int("OT_INCIDENT_RING", 256), 0)
+
+
+def _now_us() -> int:
+    return time.time_ns() // 1000
+
+
+def _ring() -> collections.deque | None:
+    global _RING
+    cap = ring_capacity()
+    if cap <= 0:
+        return None
+    if _RING is None or _RING.maxlen != cap:
+        _RING = collections.deque(_RING or (), maxlen=cap)
+    return _RING
+
+
+def record(**fields) -> None:
+    """Append one dispatch record to the ring (O(1), no I/O, never
+    raises). The lane seam calls it per traffic dispatch with lane,
+    rung, engine, mode, outcome, device_us, wall_us, batch."""
+    try:
+        ring = _ring()
+        if ring is None:
+            return
+        rec = {"t_us": _now_us()}
+        rec.update(fields)
+        ring.append(rec)
+    except Exception:  # noqa: BLE001 - never-raises contract
+        pass
+
+
+def snapshot() -> list[dict]:
+    """The ring's current contents, oldest first."""
+    ring = _ring()
+    return [dict(r) for r in ring] if ring else []
+
+
+def set_cost_records(records) -> None:
+    """Attach the process's cost-model records (obs/costmodel.py) so
+    bundles are self-contained: the server stamps them at warmup."""
+    global _COST_RECORDS
+    try:
+        _COST_RECORDS = list(records or [])
+    except Exception:  # noqa: BLE001 - never-raises contract
+        _COST_RECORDS = []
+
+
+def counts() -> dict:
+    """{dumped, suppressed, ring} — the /incidentz live header."""
+    ring = _ring()
+    return {"dumped": _BUNDLES, "suppressed": _SUPPRESSED,
+            "ring": len(ring) if ring else 0}
+
+
+def trigger(reason: str, **attrs) -> str | None:
+    """Dump one incident bundle (returns its path), or None when
+    suppressed: tracing off (no run layout to dump into), within the
+    cooldown of the previous bundle (one incident = one bundle even
+    when it fires several signals), or past the per-process cap.
+    Never raises — an incident dump failing must not create a second
+    incident."""
+    global _BUNDLES, _SUPPRESSED, _LAST_TRIGGER_US
+    try:
+        now = _now_us()
+        if not trace.enabled():
+            return None
+        cooldown_us = int(
+            max(_env_float("OT_INCIDENT_COOLDOWN_S", 30.0), 0.0) * 1e6)
+        if (_LAST_TRIGGER_US is not None
+                and now - _LAST_TRIGGER_US < cooldown_us):
+            _SUPPRESSED += 1
+            metrics.counter("serve_incidents", reason="suppressed")
+            return None
+        if _BUNDLES >= max(_env_int("OT_INCIDENT_MAX", 8), 1):
+            _SUPPRESSED += 1
+            metrics.counter("serve_incidents", reason="suppressed")
+            return None
+        run = trace.ensure_run()
+        d = trace.run_dir()
+        if d is None:
+            return None
+        os.makedirs(d, exist_ok=True)
+        try:
+            from ..resilience import degrade
+            degraded = degrade.events()
+        except Exception:  # noqa: BLE001 - the ledger is optional evidence
+            degraded = []
+        doc = {
+            "kind": KIND, "v": VERSION, "run": run, "pid": os.getpid(),
+            "ts_us": now, "reason": str(reason), "attrs": dict(attrs),
+            "ring": snapshot(),
+            "metrics": metrics.snapshot(),
+            "cost": list(_COST_RECORDS),
+            "degraded": degraded,
+            "suppressed_before": _SUPPRESSED,
+        }
+        path = os.path.join(
+            d, f"incident-{os.getpid()}-{_PROC}-{_BUNDLES}.json")
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(doc, fh, separators=(",", ":"), sort_keys=True)
+            fh.write("\n")
+        _BUNDLES += 1
+        _LAST_TRIGGER_US = now
+        metrics.counter("serve_incidents", reason=str(reason))
+        trace.point("incident", reason=str(reason),
+                    bundle=os.path.basename(path))
+        return path
+    except Exception:  # noqa: BLE001 - never-raises contract
+        return None
+
+
+def note_auth_failure() -> str | None:
+    """One auth-failed refusal. A single mismatch is a data event; a
+    SPIKE within the window is an incident and triggers a bundle."""
+    try:
+        now = _now_us()
+        _AUTH_TS.append(now)
+        window_us = int(
+            max(_env_float("OT_INCIDENT_AUTH_WINDOW_S", 10.0), 0.0) * 1e6)
+        spike = max(_env_int("OT_INCIDENT_AUTH_SPIKE", 3), 1)
+        recent = sum(1 for t in _AUTH_TS if now - t <= window_us)
+        if recent >= spike:
+            return trigger("auth-spike", failures=recent,
+                           window_s=window_us / 1e6)
+        return None
+    except Exception:  # noqa: BLE001 - never-raises contract
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Reading bundles (report, /incidentz, CI gates).
+# ---------------------------------------------------------------------------
+
+
+def list_bundles(run_dir: str) -> list[str]:
+    """Bundle paths in one run dir, oldest first (the per-process
+    sequence number orders within a pid; mtime breaks ties across)."""
+    paths = glob.glob(os.path.join(run_dir, "incident-*.json"))
+
+    def _key(p):
+        try:
+            return (os.path.getmtime(p), p)
+        except OSError:
+            return (0.0, p)
+
+    return sorted(paths, key=_key)
+
+
+def load_bundle(path: str) -> dict | None:
+    try:
+        with open(path, encoding="utf-8") as fh:
+            doc = json.load(fh)
+        return doc if isinstance(doc, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+def validate_bundle(doc: dict | None) -> list[str]:
+    """Schema violations as human-readable strings (empty = valid) —
+    what ``obs.report --incidents --check`` gates."""
+    if not isinstance(doc, dict):
+        return ["bundle is not a JSON object"]
+    out = []
+    for k in REQUIRED_KEYS:
+        if k not in doc:
+            out.append(f"missing required key {k!r}")
+    if doc.get("kind") != KIND:
+        out.append(f"kind is {doc.get('kind')!r}, want {KIND!r}")
+    if not isinstance(doc.get("v"), int):
+        out.append("v is not an int")
+    if doc.get("reason") not in REASONS:
+        out.append(f"reason {doc.get('reason')!r} outside {REASONS}")
+    ring = doc.get("ring")
+    if not isinstance(ring, list):
+        out.append("ring is not a list")
+    else:
+        for i, rec in enumerate(ring):
+            if not isinstance(rec, dict):
+                out.append(f"ring[{i}] is not an object")
+                continue
+            for k in RING_REQUIRED:
+                if k not in rec:
+                    out.append(f"ring[{i}] missing {k!r}")
+    if not isinstance(doc.get("metrics"), dict):
+        out.append("metrics is not an object")
+    return out
+
+
+def bundle_index(run_dir: str) -> list[dict]:
+    """Light per-bundle summaries for /incidentz (no payload bytes):
+    file, reason, ts_us, ring length, valid flag."""
+    out = []
+    for path in list_bundles(run_dir):
+        doc = load_bundle(path)
+        out.append({
+            "file": os.path.basename(path),
+            "reason": (doc or {}).get("reason"),
+            "ts_us": (doc or {}).get("ts_us"),
+            "ring": len((doc or {}).get("ring", [])
+                        if isinstance((doc or {}).get("ring"), list)
+                        else []),
+            "valid": not validate_bundle(doc),
+        })
+    return out
+
+
+def reset_for_tests() -> None:
+    global _RING, _BUNDLES, _SUPPRESSED, _LAST_TRIGGER_US, _COST_RECORDS
+    _RING = None
+    _BUNDLES = 0
+    _SUPPRESSED = 0
+    _LAST_TRIGGER_US = None
+    _AUTH_TS.clear()
+    _COST_RECORDS = []

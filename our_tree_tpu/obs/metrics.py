@@ -67,7 +67,7 @@ VERSION = 1
 #: multiply series, and series live forever in a process-global dict.
 ALLOWED_LABEL_KEYS = ("lane", "rung", "engine", "outcome", "bucket",
                       "code", "state", "slots", "point", "kind", "mode",
-                      "backend", "reason", "stage")
+                      "backend", "reason", "stage", "nr")
 
 #: Runtime backstop for the same hazard the lint rule prevents
 #: statically: at most this many distinct label sets per metric name —
@@ -621,6 +621,16 @@ def counter_by_label(name: str, label_key: str) -> dict:
             if lv is not None:
                 out[str(lv)] = out.get(str(lv), 0) + v
     return dict(sorted(out.items()))
+
+
+def hist_items(name: str) -> list:
+    """[(labels dict, {"buckets", "count", "sum"})] for one histogram
+    name (e.g. the per-(engine, rung) warmup compile-cost table)."""
+    with _LOCK:
+        return [(dict(labels),
+                 {"buckets": dict(h.buckets), "count": h.count,
+                  "sum": h.sum})
+                for (n, labels), h in _HISTS.items() if n == name]
 
 
 def hist_by_label(name: str, label_key: str) -> dict:

@@ -56,7 +56,7 @@ import os
 import re
 import sys
 
-from . import export
+from . import costmodel, export, incident
 from . import metrics as _metrics
 
 #: Span names that count as device-seam time in the per-unit table
@@ -154,7 +154,8 @@ def _table(rows: list[list[str]], header: list[str], out) -> None:
 
 
 def render(run: export.Run, top: int = 10, out=sys.stdout,
-           expected_orphans: dict | None = None) -> None:
+           expected_orphans: dict | None = None,
+           run_dir: str | None = None) -> None:
     run_id = next((h.get("run", "?") for h in run.procs.values()), "?")
     run_end = run.t1 if run.t1 is not None else 0
     orphans = sorted(run.orphans(), key=lambda s: (s.ts, s.id))
@@ -474,9 +475,9 @@ def render(run: export.Run, top: int = 10, out=sys.stdout,
     # with percentiles interpolated from the log2 buckets. This is the
     # table the TPU-saturation gap decomposes on (docs/OBSERVABILITY.md
     # cookbook): a goodput miss names its stage, not just its total.
+    stage_hists: dict[str, dict] = {}
     if run.snapshots:
         totals_w = run.metrics_totals()
-        stage_hists: dict[str, dict] = {}
         for key, h in totals_w["hists"].items():
             m = re.fullmatch(r"(?:route|serve)_stage_us\{stage=(\w+)\}",
                              key)
@@ -506,6 +507,102 @@ def render(run: export.Run, top: int = 10, out=sys.stdout,
                 ])
             _table(rows, ["stage", "count", "p50", "p95", "p99", "mean"],
                    out)
+
+    # -- the roofline (cost model x measured device time) ------------------
+    # The run dir's cost-*.json records (obs/costmodel.py, stamped at
+    # serve warmup) joined with the registry's per-rung dispatch/device
+    # counters: modeled HBM bytes moved over measured device time, per
+    # engine x mode x rung, with utilization against the measured
+    # ceiling when one was recorded — the table that decomposes a serve
+    # number below the offline BENCH_r* figure into "which kernel, what
+    # utilization, which rung".
+    cost_recs: list = []
+    ceiling = None
+    if run_dir:
+        cost_recs, ceiling = costmodel.load_run_records(run_dir)
+    if cost_recs and run.snapshots:
+        counters_flat = run.metrics_totals()["counters"]
+        cs = costmodel.cost_section(cost_recs, counters_flat,
+                                    ceiling_gbps=ceiling)
+        if cs["rows"]:
+            out.write("\nroofline (modeled HBM traffic vs achieved "
+                      "device rate):\n")
+            _table([[r["engine"], r["mode"], str(r["rung"]),
+                     str(r.get("nr", 0)),
+                     str(r["dispatches"]),
+                     f"{r['modeled_dispatch_bytes'] / 1e6:.3f}",
+                     f"{r['device_s']:.3f}",
+                     f"{r['achieved_gbps']:.3f}",
+                     (f"{r['utilization']:.1%}"
+                      if r["utilization"] is not None else "-")]
+                    for r in cs["rows"]],
+                   ["engine", "mode", "rung", "nr", "disp", "MB/disp",
+                    "device_s", "GB/s moved", "util"], out)
+            # The one-line gap explain: payload vs modeled traffic over
+            # the device windows, utilization vs the roofline, and the
+            # dominant NON-device waterfall stage — the saturation-run
+            # decomposition (docs/OBSERVABILITY.md cookbook) in a
+            # sentence instead of four tables.
+            moved = sum(r["modeled_bytes"] for r in cs["rows"])
+            dev_s = sum(r["device_s"] for r in cs["rows"])
+            served = counters_flat.get("serve_served_bytes", 0.0)
+            parts = []
+            if dev_s > 0:
+                parts.append(f"device moved {moved / 1e9 / dev_s:.3f} "
+                             f"GB/s modeled"
+                             + (f" ({served / 1e9 / dev_s:.3f} GB/s "
+                                f"payload)" if served else ""))
+            if ceiling and dev_s > 0:
+                parts.append(f"{moved / 1e9 / dev_s / ceiling:.1%} of "
+                             f"the {ceiling:g} GB/s ceiling")
+            off_device = {s: h for s, h in stage_hists.items()
+                          if s != "device" and h["count"]}
+            if off_device:
+                worst = max(off_device.items(),
+                            key=lambda kv: kv[1]["sum"])
+                total_stage = sum(h["sum"] for h in stage_hists.values())
+                frac = (worst[1]["sum"] / total_stage
+                        if total_stage else 0.0)
+                parts.append(
+                    f"biggest off-device stage: {worst[0]} "
+                    f"(p95 {_metrics.percentile_from_buckets(worst[1]['buckets'], 95):.0f}µs, "
+                    f"{frac:.0%} of summed stage time)")
+            if parts:
+                out.write("gap explain: " + "; ".join(parts) + "\n")
+
+    # -- warmup compile cost ------------------------------------------------
+    # serve_compile_us{engine, rung}: the jax.monitoring compile events
+    # routed into the registry at warmup (serve/server.py) — exact at
+    # any sample rate, so the startup compile bill is attributable per
+    # rung even on a fully sampled-out run.
+    if run.snapshots:
+        comp_rows = []
+        for key, h in sorted(run.metrics_totals()["hists"].items()):
+            m = re.fullmatch(r"serve_compile_us\{engine=([^,}]*),"
+                             r"rung=(\d+)\}", key)
+            if not m:
+                continue
+            comp_rows.append([
+                m.group(1), m.group(2), str(h["count"]),
+                f"{h['sum'] / 1e6:.3f}",
+                f"{_metrics.percentile_from_buckets(h['buckets'], 95) / 1e6:.3f}",
+            ])
+        if comp_rows:
+            comp_rows.sort(key=lambda r: (r[0], int(r[1])))
+            out.write("\nwarmup compile cost (serve_compile_us):\n")
+            _table(comp_rows,
+                   ["engine", "rung", "compiles", "total_s", "p95_s"],
+                   out)
+
+    # -- incident bundles ---------------------------------------------------
+    if run_dir:
+        bundles = incident.bundle_index(run_dir)
+        if bundles:
+            reasons = ", ".join(str(b["reason"]) for b in bundles)
+            bad = sum(1 for b in bundles if not b["valid"])
+            out.write(f"\nincidents: {len(bundles)} bundle(s): {reasons}"
+                      + (f" ({bad} INVALID)" if bad else "")
+                      + "  [obs.report --incidents renders them]\n")
 
     # -- cross-process joins + clock skew (fleet tracing) ------------------
     join = fleet_join_stats(run)
@@ -577,6 +674,62 @@ def render(run: export.Run, top: int = 10, out=sys.stdout,
                       f"until end of run — closed by kill{tag}\n")
 
 
+def render_incidents(run_dir: str, check: bool = False,
+                     out=None, tail: int = 8) -> int:
+    """The ``--incidents`` mode: render every flight-recorder bundle in
+    the run dir (reason, trigger attrs, the ring's tail, snapshot
+    headline counters, cost-record count) and — with ``check`` — exit
+    2 unless every bundle validates against the schema
+    (``incident.validate_bundle``). A run with NO bundles is a clean
+    rc 0 either way: bundle COUNT expectations are the CI drive's own
+    asserts, presence is not an error."""
+    out = out if out is not None else sys.stdout  # bound at CALL time
+    paths = incident.list_bundles(run_dir)
+    if not paths:
+        out.write(f"no incident bundles under {run_dir}\n")
+        return 0
+    bad = 0
+    for path in paths:
+        doc = incident.load_bundle(path)
+        viols = incident.validate_bundle(doc)
+        d = doc or {}
+        out.write(f"incident {os.path.basename(path)}: "
+                  f"reason={d.get('reason')} pid={d.get('pid')} "
+                  f"ts_us={d.get('ts_us')} "
+                  f"ring={len(d.get('ring') or [])} "
+                  f"cost_records={len(d.get('cost') or [])}"
+                  + (" SCHEMA-INVALID" if viols else "") + "\n")
+        for a, v in sorted((d.get("attrs") or {}).items()):
+            out.write(f"  attr {a} = {v}\n")
+        ring = d.get("ring") or []
+        for rec in ring[-tail:]:
+            if not isinstance(rec, dict):
+                continue
+            out.write(
+                "  ring "
+                f"t={rec.get('t_us')} lane={rec.get('lane')} "
+                f"rung={rec.get('rung')} engine={rec.get('engine')} "
+                f"mode={rec.get('mode')} outcome={rec.get('outcome')} "
+                f"device_us={rec.get('device_us')} "
+                f"wall_us={rec.get('wall_us')}\n")
+        counters = (d.get("metrics") or {}).get("counters") or {}
+        for k in ("serve_served_bytes", "serve_redispatch",
+                  "serve_lane_timeout", "serve_auth_failed"):
+            hits = {kk: v for kk, v in counters.items()
+                    if kk == k or kk.startswith(k + "{")}
+            if hits:
+                out.write(f"  metric {k} = "
+                          f"{sum(hits.values()):g}\n")
+        for v in viols:
+            out.write(f"  ! {v}\n")
+            bad += 1
+    if check and bad:
+        print(f"CHECK FAILED: {bad} incident-bundle schema "
+              "violation(s)", file=sys.stderr)
+        return 2
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="reconstruct a traced run (our_tree_tpu.obs)")
@@ -592,6 +745,13 @@ def main(argv=None) -> int:
                          "ONE orphan (repeat a name to allow more); an "
                          "unlisted-name orphan or an extra orphan past a "
                          "name's budget still fails --check")
+    ap.add_argument("--incidents", action="store_true",
+                    help="INCIDENT mode: render the run dir's "
+                         "flight-recorder bundles (incident-*.json, "
+                         "obs/incident.py) instead of the trace "
+                         "report; with --check, exit 2 unless every "
+                         "bundle is schema-valid (orphan/violation "
+                         "gating stays with the plain report run)")
     ap.add_argument("--trace-json", default=None, metavar="PATH",
                     help="also write the Chrome/Perfetto trace.json "
                          "(clock-aligned across processes when wire-skew "
@@ -609,6 +769,8 @@ def main(argv=None) -> int:
 
     run_dir = _resolve_run_dir(args.run_dir,
                                say=lambda m: print(m, file=sys.stderr))
+    if args.incidents:
+        return render_incidents(run_dir, check=args.check)
     run = export.load_run(run_dir)
     if not run.procs:
         print(f"no trace-*.jsonl files under {run_dir}", file=sys.stderr)
@@ -618,7 +780,8 @@ def main(argv=None) -> int:
         tok = tok.strip()
         if tok:
             expected[tok] = expected.get(tok, 0) + 1
-    render(run, top=args.top, expected_orphans=expected)
+    render(run, top=args.top, expected_orphans=expected,
+           run_dir=run_dir)
     if args.trace_json:
         path = export.write_chrome_trace(run, args.trace_json)
         print(f"# perfetto export: {path} "

@@ -59,6 +59,13 @@ DEFAULT_TOLERANCES = {
     #: regression names WHICH stage moved instead of only that the
     #: total did (the fleet-observability ISSUE's point).
     "stage_p95_us": 1.0,
+    #: per-(engine x mode x rung) achieved-GB/s-moved budget (the cost
+    #: section's roofline rows, obs/costmodel.py): each row's modeled-
+    #: traffic-over-device-time may FALL by at most this fraction of
+    #: the baseline. Wide by default (device-time on a shared CPU host
+    #: is noisy); the point is the failure NAMES the engine x rung
+    #: whose utilization moved, same shape as the per-stage gates.
+    "cost_gbps": 0.5,
 }
 
 #: Lower-is-better vs higher-is-better among the ratio metrics.
@@ -99,6 +106,16 @@ def extract(doc: dict) -> dict:
             str(name): float(v.get("p95_us", 0.0)
                              if isinstance(v, dict) else v)
             for name, v in stages.items()}
+    # The cost-section roofline rows (artifact "cost": {"rows": [...]},
+    # obs/costmodel.py): achieved GB/s moved per engine x mode x rung —
+    # the utilization-regression gate's surface.
+    cost = doc.get("cost")
+    if isinstance(cost, dict) and isinstance(cost.get("rows"), list):
+        out["cost"] = {
+            f"{r.get('engine')}|{r.get('mode')}|r{r.get('rung')}"
+            f"|nr{r.get('nr', 0)}":
+                float(r.get("achieved_gbps", 0.0))
+            for r in cost["rows"] if isinstance(r, dict)}
     return out
 
 
@@ -130,8 +147,8 @@ def compare(baseline: dict, candidate: dict,
     tol.update(tolerances or {})
     failures: list[str] = []
     for name, t in sorted(tol.items()):
-        if name == "stage_p95_us":
-            continue  # the per-stage loop below consumes it
+        if name in ("stage_p95_us", "cost_gbps"):
+            continue  # the per-stage / per-row loops below consume them
         base = baseline.get(name, 0.0)
         cand = candidate.get(name, 0.0)
         if not isinstance(base, (int, float)) or base <= 0:
@@ -173,6 +190,25 @@ def compare(baseline: dict, candidate: dict,
                 f"stage:{name}: p95 {cand:g}µs > {ceil:g}µs "
                 f"(baseline {base:g}µs, tolerance +{st:.0%}) — "
                 "this stage moved")
+    # The utilization budgets: achieved GB/s moved per engine x rung
+    # (lower is worse — a drop past tolerance is a device-efficiency
+    # regression that NAMES its engine x rung). Rows only the candidate
+    # has are new coverage; rows only the baseline has saw no traffic
+    # this run — neither gates.
+    ct = tol.get("cost_gbps", 0.0)
+    base_cost = baseline.get("cost") or {}
+    cand_cost = candidate.get("cost") or {}
+    for name in sorted(base_cost):
+        base = base_cost.get(name, 0.0)
+        cand = cand_cost.get(name)
+        if base <= 0 or cand is None:
+            continue
+        floor = base * (1.0 - ct)
+        if cand < floor:
+            failures.append(
+                f"cost:{name}: achieved {cand:g} GB/s moved < {floor:g} "
+                f"(baseline {base:g}, tolerance -{ct:.0%}) — this "
+                "engine x rung's device utilization moved")
     return failures
 
 
@@ -181,7 +217,7 @@ def render(baseline: dict, candidate: dict, failures: list[str],
     """The per-metric gate table, pass or fail, repo-`#`-line style."""
     out = out if out is not None else sys.stdout  # bound at CALL time
     names = sorted((set(DEFAULT_TOLERANCES) | set(COUNT_METRICS))
-                   - {"stage_p95_us"})
+                   - {"stage_p95_us", "cost_gbps"})
     for name in names:
         base = baseline.get(name, 0.0)
         cand = candidate.get(name, 0.0)
@@ -195,6 +231,16 @@ def render(baseline: dict, candidate: dict, failures: list[str],
         out.write(f"{prefix}: stage:{name:<14} "
                   f"baseline={base_stages.get(name, 0.0):<10g} "
                   f"run={cand_stages.get(name, 0.0):<10g} "
+                  f"{'FAIL' if bad else 'ok'}\n")
+    base_cost = baseline.get("cost") or {}
+    cand_cost = candidate.get("cost") or {}
+    for name in sorted(base_cost):
+        if cand_cost.get(name) is None:
+            continue  # no traffic at this engine x rung this run
+        bad = any(f.startswith(f"cost:{name}:") for f in failures)
+        out.write(f"{prefix}: cost:{name:<18} "
+                  f"baseline={base_cost.get(name, 0.0):<10g} "
+                  f"run={cand_cost.get(name, 0.0):<10g} "
                   f"{'FAIL' if bad else 'ok'}\n")
     for f in failures:
         out.write(f"{prefix}: REGRESSION {f}\n")

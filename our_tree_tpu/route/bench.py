@@ -95,7 +95,8 @@ def _spawn_backends(args, tag: str):
                     "--bucket-max", str(args.bucket_max),
                     "--queue-depth", str(args.worker_queue_depth),
                     "--tenant-depth-frac", str(args.tenant_depth_frac),
-                    "--dispatch-deadline", str(args.dispatch_deadline)]
+                    "--dispatch-deadline", str(args.dispatch_deadline),
+                    "--modes", ",".join(args.mode_list)]
             if args.worker_lanes is not None:
                 argv += ["--lanes", str(args.worker_lanes)]
             h = isolate.spawn_service(argv, env=env,
@@ -252,7 +253,7 @@ async def _drive(args, specs, affinity: bool, probes):
         sizes=args.sizes, tenants=args.tenants,
         keys_per_tenant=args.keys_per_tenant, seed=args.seed,
         verify_every=args.verify_every, probes=probes,
-        arrival_rate=args.arrival_rate)
+        arrival_rate=args.arrival_rate, modes=args.mode_list)
     # One final gossip pass so the artifact's backend view is current.
     await router.gossip_once()
     healthz = {name: b.last_healthz
@@ -275,7 +276,21 @@ def main(argv=None) -> int:
                     metavar="REQ_PER_S",
                     help="open-loop mode (serve.bench semantics)")
     ap.add_argument("--mixed-sizes", action="store_true")
+    ap.add_argument("--sizes", default=None, metavar="B1,B2",
+                    help="explicit request-size menu in bytes (comma "
+                         "list; overrides --mixed-sizes/--size-bytes). "
+                         "A gcm mix wants the top size one rung under "
+                         "the bucket ceiling: the J0 row rides each "
+                         "request (serve.bench's sizing note)")
     ap.add_argument("--size-bytes", type=int, default=4096)
+    ap.add_argument("--modes", default="ctr", metavar="M1,M2",
+                    help="served-mode MIX routed through the fleet "
+                         "(serve/queue.py MODES): every worker enables "
+                         "and warms exactly these ladders, the loadgen "
+                         "draws each request's mode uniformly, and gcm "
+                         "probes pin ciphertext AND tag bit-exactly "
+                         "THROUGH the router (affinity + failover "
+                         "included — docs/SERVING.md, AEAD section)")
     ap.add_argument("--tenants", type=int, default=8)
     ap.add_argument("--keys-per-tenant", type=int, default=2)
     ap.add_argument("--engine", default="auto",
@@ -368,8 +383,21 @@ def main(argv=None) -> int:
         ap.error("--ab compares affinity AGAINST random routing; with "
                  "--no-affinity both arms would be random and the "
                  "affinity-gain gate could only report a false verdict")
-    args.sizes = (loadgen.MIXED_SIZES if args.mixed_sizes
-                  else (args.size_bytes,))
+    if args.sizes:
+        try:
+            args.sizes = tuple(int(s) for s in args.sizes.split(",") if s)
+        except ValueError:
+            ap.error(f"--sizes wants a comma list of byte counts, "
+                     f"got {args.sizes!r}")
+    else:
+        args.sizes = (loadgen.MIXED_SIZES if args.mixed_sizes
+                      else (args.size_bytes,))
+    args.mode_list = tuple(m.strip() for m in args.modes.split(",")
+                           if m.strip()) or ("ctr",)
+    if "gcm-open" in args.mode_list and not args.verify_every:
+        ap.error("--modes gcm-open requires --verify-every > 0: open "
+                 "traffic replays the per-size sealed probe pairs "
+                 "(serve.bench's contract, one tier up)")
 
     if args.unquarantine:
         if not args.journal:
@@ -386,7 +414,7 @@ def main(argv=None) -> int:
         return 0
 
     trace.ensure_run()
-    probes = (loadgen.make_probes(args.sizes, args.seed)
+    probes = (loadgen.make_probes(args.sizes, args.seed, args.mode_list)
               if args.verify_every else [])
 
     affinity = not args.no_affinity
@@ -477,6 +505,7 @@ def main(argv=None) -> int:
             "tenants": args.tenants,
             "keys_per_tenant": args.keys_per_tenant,
             "engine": args.engine, "vnodes": args.vnodes,
+            "modes": list(args.mode_list),
             "affinity": affinity, "ab": bool(args.ab),
             "attempt_timeout_s": args.attempt_timeout,
             "gossip_every_s": args.gossip_every,

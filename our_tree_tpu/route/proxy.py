@@ -555,10 +555,20 @@ class Router:
 
     # -- the request path --------------------------------------------------
     async def submit(self, tenant: str, key: bytes, nonce: bytes, payload,
-                     deadline_s: float | None = None) -> Response:
-        """Route one CTR request; always answers (payload or coded
-        error) — the loadgen-compatible submit surface, so the serve
-        load generator drives a router exactly as it drives a server."""
+                     deadline_s: float | None = None, mode: str = "ctr",
+                     iv: bytes = b"", aad: bytes = b"",
+                     tag: bytes = b"") -> Response:
+        """Route one request; always answers (payload or coded error)
+        — the loadgen-compatible submit surface, so the serve load
+        generator drives a router exactly as it drives a server.
+        ``mode``/``iv``/``aad``/``tag`` are the served-mode fields
+        (serve/queue.py MODES): they ride the wire's ``m``/``iv``/
+        ``a``/``tg`` fields verbatim, the backend's admission owns the
+        per-mode validation, and a ``gcm`` seal's tag rides back on
+        the response — AEAD traffic gets the SAME affinity placement
+        and bit-exact failover as ctr (every mode's dispatch is a pure
+        function of its arrays, so replay on the next ring node is
+        byte-identical)."""
         if self._draining:
             return Response(ok=False, error=ERR_SHUTDOWN,
                             detail="router is draining")
@@ -567,7 +577,8 @@ class Router:
         self._idle.clear()
         try:
             resp = await self._route(tenant, bytes(key), bytes(nonce),
-                                     payload, deadline_s)
+                                     payload, deadline_s, str(mode),
+                                     bytes(iv), bytes(aad), bytes(tag))
         except Exception as e:  # noqa: BLE001 - a router must always answer
             resp = Response(ok=False, error=ERR_DISPATCH,
                             detail=f"{type(e).__name__}: {e}")
@@ -579,7 +590,9 @@ class Router:
         return resp
 
     async def _route(self, tenant: str, key: bytes, nonce: bytes, payload,
-                     deadline_s: float | None) -> Response:
+                     deadline_s: float | None, mode: str = "ctr",
+                     iv: bytes = b"", aad: bytes = b"",
+                     tag: bytes = b"") -> Response:
         """The per-request wrapper: one head-sampling decision at ROUTER
         admission governs the whole cross-process chain, and the
         ``route-request`` span minted here is the chain's ROOT — its id
@@ -595,7 +608,8 @@ class Router:
         try:
             resp = await self._route_attempts(
                 tenant, key, nonce, data, deadline_s, sampled,
-                span.id if span is not None else None)
+                span.id if span is not None else None,
+                mode, iv, aad, tag)
         except BaseException as e:
             cm.__exit__(type(e), e, None)
             raise
@@ -607,7 +621,10 @@ class Router:
 
     async def _route_attempts(self, tenant: str, key: bytes, nonce: bytes,
                               data: bytes, deadline_s: float | None,
-                              sampled: bool, ps: str | None) -> Response:
+                              sampled: bool, ps: str | None,
+                              mode: str = "ctr", iv: bytes = b"",
+                              aad: bytes = b"",
+                              tag: bytes = b"") -> Response:
         c = self.config
         aff = ring_mod.affinity_key(tenant, key)
         self._track(aff)
@@ -615,6 +632,16 @@ class Router:
                         else float(deadline_s), clock=self._clock)
         header = {"t": tenant, "k": key.hex(), "n": nonce.hex(),
                   "deadline_s": round(budget.total_s, 3) or None}
+        if mode != "ctr":
+            # The AEAD wire fields (serve/wire.py): absent = ctr, so a
+            # ctr-only fleet's frames are byte-identical to pre-AEAD.
+            header["m"] = mode
+            if iv:
+                header["iv"] = iv.hex()
+            if aad:
+                header["a"] = aad.hex()
+            if tag:
+                header["tg"] = tag.hex()
         if sampled:
             # Propagate the admission decision + span parentage + the
             # ledger request over the wire (serve/wire.py): the
@@ -776,9 +803,16 @@ class Router:
                 else:
                     self.affinity_misses += 1
                     metrics.counter("route_affinity", outcome="miss")
+                tg = rh.get("tg")
+                try:
+                    resp_tag = (bytes.fromhex(str(tg))
+                                if isinstance(tg, str) and tg else None)
+                except ValueError:
+                    resp_tag = None
                 return Response(ok=True,
                                 payload=np.frombuffer(body, np.uint8),
-                                batch=rh.get("batch"), ledger=ledger)
+                                batch=rh.get("batch"), ledger=ledger,
+                                tag=resp_tag)
             return Response(ok=False, error=err,
                             detail=str(rh.get("detail", "")),
                             batch=rh.get("batch"), ledger=ledger)

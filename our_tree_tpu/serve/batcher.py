@@ -287,7 +287,12 @@ class Batch:
             h = sched.h_ints[si]
             for req in slot.requests:
                 n = req.nblocks
-                j0 = bytes(req.iv) + b"\x00\x00\x00\x01"
+                # Admission derived J0 (96-bit concat or the host
+                # GHASH path for other IV lengths); the 12-byte concat
+                # fallback keeps pre-admission callers (tests, tools)
+                # working.
+                j0 = (bytes(req.j0) if getattr(req, "j0", b"")
+                      else bytes(req.iv) + b"\x00\x00\x00\x01")
                 aead_ghash.np_gcm_ctr_blocks(
                     j0, _block_idx(n + 1), out=ctr[off:off + n + 1])
                 words[4 * (off + 1):4 * (off + 1 + n)] = (

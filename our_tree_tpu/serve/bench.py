@@ -56,7 +56,7 @@ import os
 import re
 import sys
 
-from ..obs import metrics, slo, trace
+from ..obs import costmodel, incident, metrics, slo, trace
 from ..resilience import degrade, watchdog
 from ..resilience import journal as journal_mod
 from . import batcher, loadgen
@@ -97,7 +97,8 @@ async def _drive(args, probes):
         journal=args.journal,
         max_inflight=args.max_inflight,
         status_port=args.status_port,
-        modes=args.mode_list)
+        modes=args.mode_list,
+        ceiling_gbps=args.ceiling_gbps)
     server = Server(cfg)
     await server.start()
     report = await loadgen.run(
@@ -280,7 +281,11 @@ def main(argv=None) -> int:
         args.tenants = max(args.tenants, 24)
         args.keys_per_tenant = 1
     elif args.sizes:
-        args.sizes = tuple(int(s) for s in args.sizes.split(",") if s)
+        try:
+            args.sizes = tuple(int(s) for s in args.sizes.split(",") if s)
+        except ValueError:
+            ap.error(f"--sizes wants a comma list of byte counts, "
+                     f"got {args.sizes!r}")
     else:
         args.sizes = (loadgen.MIXED_SIZES if args.mixed_sizes
                       else (args.size_bytes,))
@@ -402,6 +407,48 @@ def main(argv=None) -> int:
             f"{s}:p95={st['p95_us']:.0f}µs"
             for s, st in stages.items()))
 
+    # The cost/attribution plane (obs/costmodel.py): modeled HBM bytes
+    # per dispatch x measured per-rung dispatch counts over per-rung
+    # DEVICE time — achieved GB/s *moved* (traffic, not payload: CTR's
+    # counter+keystream overhead is the difference) and utilization
+    # against the measured roofline, per engine x mode x rung. This is
+    # the artifact section that decomposes a serve number below the
+    # offline BENCH_r* figure into "which kernel, what utilization".
+    cost = costmodel.cost_section(server.cost_records,
+                                  metrics.snapshot()["counters"],
+                                  ceiling_gbps=args.ceiling_gbps)
+    for row in cost["rows"]:
+        util = (f" util={row['utilization']:.1%}"
+                if row["utilization"] is not None else "")
+        print(f"# cost: {row['engine']}/{row['mode']} r{row['rung']}: "
+              f"{row['dispatches']} disp x "
+              f"{row['modeled_dispatch_bytes'] / 1e6:.3f} MB modeled, "
+              f"device {row['device_s']:.3f}s -> "
+              f"{row['achieved_gbps']:.3f} GB/s moved{util}")
+
+    # Warmup compile cost (the jax.monitoring listener routed into
+    # serve_compile_us{engine, rung}): per-rung compile counts and
+    # totals — the startup bill that dominates TPU warmup and was
+    # invisible behind the bare compile COUNT until now.
+    comp_items = metrics.hist_items("serve_compile_us")
+    compile_by_rung: dict = {}
+    for labels, h in comp_items:
+        key = str(labels.get("rung", 0))
+        agg = compile_by_rung.setdefault(key, {"count": 0, "us": 0.0})
+        agg["count"] += h["count"]
+        agg["us"] += h["sum"]
+    if compile_by_rung:
+        total_us = sum(a["us"] for a in compile_by_rung.values())
+        print(f"# compile: {sum(a['count'] for a in compile_by_rung.values())} "
+              f"compile(s), {total_us / 1e6:.2f}s total  "
+              + "  ".join(
+                  f"r{k}:{a['count']}x{a['us'] / 1e6:.2f}s"
+                  for k, a in sorted(compile_by_rung.items(),
+                                     key=lambda kv: int(kv[0]))))
+        compile_by_rung = {k: {"count": a["count"],
+                               "total_us": round(a["us"], 1)}
+                           for k, a in compile_by_rung.items()}
+
     # The per-workload split (mode rides serve_requests/serve_refused/
     # serve_batch_blocks/serve_dispatch_us): the mixed-mode drive's
     # evidence that every enabled mode actually carried traffic.
@@ -450,6 +497,12 @@ def main(argv=None) -> int:
         # saturation-run decomposition surface (docs/OBSERVABILITY.md).
         "stages": stages,
         "device": device,
+        # The roofline attribution: modeled HBM traffic per dispatch,
+        # achieved GB/s moved from device time, utilization vs the
+        # measured ceiling — per engine x mode x rung (obs/costmodel.py;
+        # obs/slo.py gates the rows' achieved_gbps per engine x rung).
+        "cost": cost,
+        "compiles_by_rung": compile_by_rung,
         "degraded": degrade.events(),
         # The full registry snapshot: exact counters/gauges + log2
         # histogram buckets per label set — present traced or not (the
@@ -481,6 +534,12 @@ def main(argv=None) -> int:
         except (OSError, ValueError, KeyError) as e:
             print(f"# slo: gate unusable: {e}", file=sys.stderr)
             slo_rc = 1
+        if slo_rc:
+            # An SLO breach is an incident: dump the flight-recorder
+            # bundle (ring + metrics + cost records) beside the trace
+            # so the regression's dispatch history survives triage.
+            incident.trigger("slo-breach",
+                             baseline=os.path.basename(args.slo))
 
     line = {"unit": "serve", "engine": stats["engine"],
             "requests": report.requests, "ok": report.ok,

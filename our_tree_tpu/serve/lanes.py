@@ -75,7 +75,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models import aes
-from ..obs import metrics, trace
+from ..obs import incident, metrics, trace
 from ..resilience import degrade, faults, watchdog
 from ..resilience.policy import RetryPolicy
 from .dispatch import LaneExecutor
@@ -196,6 +196,11 @@ class Lane:
                         f"lane {self.idx} ({self.device}): {why}")
         if journal is not None:
             journal.record_failure(lane_unit(self.idx), why)
+        # A quarantine is an incident: dump the flight-recorder bundle
+        # (obs/incident.py). Coalesced by the trigger cooldown — the
+        # common kill->quarantine pair is ONE incident, one bundle.
+        incident.trigger("quarantine", unit=lane_unit(self.idx),
+                         lane=self.idx, why=why)
 
     def adopt_journal_quarantine(self, fails: int) -> None:
         """Start quarantined from recorded journal failure rows (the
@@ -727,6 +732,19 @@ class LanePool:
                 outcome = "timeout"
                 metrics.counter("serve_lane_timeout", lane=lane.idx)
                 trace.counter("serve_lane_timeout", lane=lane.idx)
+                # Flight recorder: the killed dispatch enters the ring
+                # BEFORE the trigger dumps, so the bundle's ring always
+                # contains the record that caused it (the CI gate).
+                # The quarantine that note_timeout() fires a moment
+                # later is the SAME incident — its trigger coalesces
+                # into this bundle via the cooldown.
+                incident.record(lane=lane.idx, rung=bucket,
+                                engine=self.engine, mode=mode,
+                                outcome="timeout", device_us=0,
+                                wall_us=int((lane._clock() - t0) * 1e6),
+                                batch=label)
+                incident.trigger("watchdog-kill", lane=lane.idx,
+                                 rung=bucket, batch=label)
                 lane.note_timeout(e, self.journal)
                 causes.append((lane.idx, e))
                 tried.add(lane.idx)
@@ -736,6 +754,11 @@ class LanePool:
                 outcome = "failed"
                 metrics.counter("serve_lane_failed", lane=lane.idx)
                 trace.counter("serve_lane_failed", lane=lane.idx)
+                incident.record(lane=lane.idx, rung=bucket,
+                                engine=self.engine, mode=mode,
+                                outcome="failed", device_us=0,
+                                wall_us=int((lane._clock() - t0) * 1e6),
+                                batch=label)
                 lane.note_failure(e, self.journal)
                 causes.append((lane.idx, e))
                 tried.add(lane.idx)
@@ -769,6 +792,25 @@ class LanePool:
                     wait_us=wait_us)
             cm.__exit__(None, None, None)
             metrics.counter("serve_device_us", device_us, lane=lane.idx)
+            # The cost-model join (obs/costmodel.py): dispatches and
+            # device time accumulated PER (rung, engine, mode, nr), so
+            # the roofline table can put modeled bytes moved over
+            # measured device time per ladder rung — which engine x
+            # rung, what utilization, not just one goodput scalar. nr
+            # rides the label because the schedule-stack traffic (and
+            # the op budget) depend on the key size, and a mixed
+            # 128/256-bit run must not price AES-256 dispatches with
+            # the AES-128 record.
+            nr = int(getattr(sched, "nr", 0) or 0)  # 0: stubbed scheds
+            metrics.counter("serve_rung_dispatches", rung=bucket,
+                            engine=self.engine, mode=mode, nr=nr)
+            metrics.counter("serve_rung_device_us", device_us,
+                            rung=bucket, engine=self.engine, mode=mode,
+                            nr=nr)
+            incident.record(lane=lane.idx, rung=bucket,
+                            engine=self.engine, mode=mode, outcome="ok",
+                            device_us=device_us, wall_us=dt_us,
+                            batch=label)
             metrics.observe("serve_stage_us", wait_us,
                             stage="worker_wait")
             metrics.observe("serve_stage_us", host_us, stage="dispatch")

@@ -66,7 +66,9 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from ..aead import ghash as aead_ghash
 from ..obs import metrics, trace
+from ..ops.keyschedule import expand_key_enc
 from ..resilience import degrade
 from ..resilience.policy import Budget
 
@@ -137,9 +139,15 @@ class Request:
     t_submit: float = 0.0
     #: served mode (MODES); mode-specific fields below are empty for ctr
     mode: str = "ctr"
-    iv: bytes = b""              #: 96-bit GCM IV / 128-bit CBC IV
+    iv: bytes = b""              #: GCM IV (any nonzero length) / CBC IV
     aad: bytes = b""             #: GCM additional authenticated data
     tag: bytes = b""             #: GCM open: the tag to verify
+    #: GCM: the 16-byte pre-counter block, derived at ADMISSION — the
+    #: 96-bit fast path is IV || 0^31 || 1; any other IV length takes
+    #: the host GHASH path (J0 = GHASH_H(IV padded || lens), SP
+    #: 800-38D §7.1) so non-96-bit IVs ride the same fixed dispatch
+    #: shape as everyone else (the batcher consumes this verbatim)
+    j0: bytes = b""
     #: the admission-time head-sampling decision (OT_TRACE_SAMPLE):
     #: every span this request rides is emitted iff this bit is set
     #: (or the outcome force-samples it). When the request arrived over
@@ -302,13 +310,12 @@ class RequestQueue:
                 f"key must be 16/24/32 bytes, got {len(bytes(key))}")
         elif mode == "ctr" and len(bytes(nonce)) != 16:
             code, why = ERR_BAD_REQUEST, "nonce must be 16 bytes"
-        elif mode in GCM_MODES and len(iv) != 12:
-            # The serve fast path pins the 96-bit J0 derivation; other
-            # IV lengths (a host GHASH over the IV) are a models-API
-            # affair, not a batched dispatch shape.
-            code, why = ERR_BAD_REQUEST, (
-                f"GCM iv must be 12 bytes (serve fast path), got "
-                f"{len(iv)}")
+        elif mode in GCM_MODES and not iv:
+            # Any NONZERO IV length serves (SP 800-38D): 96-bit takes
+            # the counter-concat fast path, everything else derives J0
+            # through the host GHASH path below. An empty IV is the
+            # one shape the spec itself refuses.
+            code, why = ERR_BAD_REQUEST, "GCM iv must be non-empty"
         elif mode == "gcm-open" and len(tag) != 16:
             code, why = ERR_BAD_REQUEST, (
                 f"gcm-open tag must be 16 bytes, got {len(tag)}")
@@ -372,6 +379,27 @@ class RequestQueue:
                 f"({self._tenant_cap}/{self.max_depth} slots, "
                 f"tenant_depth_frac={self.tenant_depth_frac}); "
                 "shedding that tenant's requests only")
+        j0 = b""
+        if code is None and mode in GCM_MODES:
+            if len(iv) == 12:
+                j0 = iv + b"\x00\x00\x00\x01"
+            else:
+                # The non-96-bit path: J0 = GHASH_H(IV) needs H =
+                # E_K(0^128) — one host key expansion + one host AES
+                # block + a short GHASH, paid once at admission by the
+                # rare IV shape that needs it (the 96-bit fast path
+                # stays a concat). Host-side on purpose: admission may
+                # never touch a device, and the derived J0 rides the
+                # request into the SAME fixed dispatch shape (KAT
+                # vector 9 pins the math at the models layer; the
+                # serve twin is tests/test_serve_aead.py).
+                try:
+                    nr_j0, rk_j0 = expand_key_enc(bytes(key))
+                    j0 = aead_ghash.j0_from_iv(
+                        aead_ghash.derive_h(nr_j0, rk_j0), iv)
+                except Exception as e:  # noqa: BLE001 - refuse, not crash
+                    code, why = ERR_BAD_REQUEST, (
+                        f"J0 derivation failed: {e}")
         if code is not None:
             if code != ERR_SHED:
                 self.refused += 1
@@ -393,7 +421,7 @@ class RequestQueue:
             else None,
             t_submit=self._clock(), _queue=self,
             sampled=trace.sample() if sampled is None else bool(sampled),
-            parent=parent, mode=mode, iv=iv, aad=aad, tag=tag)
+            parent=parent, mode=mode, iv=iv, aad=aad, tag=tag, j0=j0)
         cm = trace.maybe_span(req.sampled, "request-queued",
                               parent=req.parent, req=req.id,
                               tenant=tenant, blocks=req.nblocks,

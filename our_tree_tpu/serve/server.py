@@ -83,7 +83,7 @@ import numpy as np
 from ..aead import gcm as aead_gcm
 from ..aead import ghash as aead_ghash
 from ..models import aes
-from ..obs import metrics, trace
+from ..obs import costmodel, incident, metrics, trace
 from ..ops import gf
 from ..resilience import faults
 from ..resilience import journal as journal_mod
@@ -104,11 +104,33 @@ _COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
 _COMPILES = 0
 _MONITOR_ON = False
 
+#: What the process is compiling FOR right now: the warmup walk stamps
+#: (engine, rung) here before each ladder call, so the jax.monitoring
+#: compile-duration events route into the registry as
+#: ``serve_compile_us{engine, rung}`` histograms — the compile-cost
+#: table that makes warmup startup time visible per rung (on TPU,
+#: warmup dominates startup; until now its cost was one opaque wall).
+#: rung=0 means "outside the ladder walk" (cost-model lowerings, a
+#: steady-state recompile — the latter is already a gated contract
+#: violation; here it additionally becomes a measured one).
+_COMPILE_CTX = {"engine": "?", "rung": 0}
+
+
+def compile_context(engine: str, rung: int) -> None:
+    """Label subsequent backend-compile events (warmup walk only; the
+    listener reads this when an XLA compile actually fires)."""
+    _COMPILE_CTX["engine"] = str(engine)
+    _COMPILE_CTX["rung"] = int(rung)
+
 
 def _on_event(name: str, *args, **kw) -> None:
     global _COMPILES
     if name == _COMPILE_EVENT:
         _COMPILES += 1
+        dur = args[0] if args and isinstance(args[0], (int, float)) else 0.0
+        metrics.observe("serve_compile_us", float(dur) * 1e6,
+                        engine=_COMPILE_CTX["engine"],
+                        rung=_COMPILE_CTX["rung"])
 
 
 def compile_count() -> int:
@@ -191,6 +213,10 @@ class ServerConfig:
     #: queue depth, in-flight, keycache — live JSON). None = off;
     #: 0 = an ephemeral port (tests read server.status.port)
     status_port: int | None = None
+    #: the measured device roofline (GB/s, scripts/vpu_ceiling.py /
+    #: BENCH_r* on a real TPU) the cost model reports utilization
+    #: against; None = record traffic without a utilization ratio
+    ceiling_gbps: float | None = None
 
 
 class Server:
@@ -244,6 +270,9 @@ class Server:
         self._slot_capacity = 0
         self.warmup_compiles = 0
         self._compiles_at_ready = 0
+        #: the warmed ladder's cost-model records (obs/costmodel.py),
+        #: filled at start(); the bench's ``cost`` section reads them
+        self.cost_records: list = []
 
     # -- lifecycle ---------------------------------------------------------
     async def start(self) -> None:
@@ -272,6 +301,19 @@ class Server:
             raise RuntimeError(
                 f"serve warmup failed on all {len(self.pool.lanes)} "
                 f"lane(s) — no lane can dispatch (engine {self.engine})")
+        # The cost/attribution plane (obs/costmodel.py): modeled
+        # per-(engine, mode, rung) dispatch traffic for the warmed
+        # ladder — analytic always, XLA-backed per OT_COST_XLA (the
+        # lowerings below may compile; they run BEFORE the ready marker
+        # so they count as warmup, never as a steady-state recompile).
+        # Stamped into the run dir so obs.report can roofline post-hoc,
+        # and onto the incident recorder so bundles are self-contained.
+        self.cost_records = costmodel.ladder_costs(
+            self.engine, c.modes, self.rungs,
+            key_bits=c.warmup_key_bits, key_slots=c.key_slots)
+        costmodel.write_run_records(self.cost_records, engine=self.engine,
+                                    ceiling_gbps=c.ceiling_gbps)
+        incident.set_cost_records(self.cost_records)
         self._compiles_at_ready = compile_count()
         self.warmup_compiles = self._compiles_at_ready - before
         trace.gauge("serve_warmup_compiles", self.warmup_compiles,
@@ -323,6 +365,7 @@ class Server:
         # canary-released against its own output.
         order = sorted(self.pool.lanes,
                        key=lambda l: (l.state == lanes.QUARANTINED, l.idx))
+        compile_context(self.engine, 0)
         with trace.span("serve-warmup", engine=self.engine,
                         rungs=len(self.rungs), lanes=len(self.pool.lanes)):
             for lane in order:
@@ -335,6 +378,7 @@ class Server:
                                 [("_warmup", b"\x00" * (bits // 8))],
                                 c.key_slots)
                             for rung in self.rungs:
+                                compile_context(self.engine, rung)
                                 if (rung == canary_rung
                                         and bits == c.warmup_key_bits[0]):
                                     out = lane.engine_call(
@@ -373,6 +417,7 @@ class Server:
                                     [("_warmup", b"\x00" * (bits // 8))],
                                     c.key_slots, mode=m)
                                 for rung in self.rungs:
+                                    compile_context(self.engine, rung)
                                     words = np.zeros(4 * rung,
                                                      dtype=np.uint32)
                                     lane.engine_call(
@@ -393,6 +438,9 @@ class Server:
                         lane._quarantine(
                             f"warmup-failed:{type(e).__name__}",
                             self._journal)
+        # Compiles past this point (cost-model lowerings, any
+        # steady-state recompile) land unattributed at rung 0.
+        compile_context(self.engine, 0)
 
     async def stop(self) -> None:
         """Graceful drain: stop placement (admission closes), let the
@@ -636,6 +684,9 @@ class Server:
                     # plaintext leaves the server for this request.
                     metrics.counter("serve_auth_failed", mode=b.mode)
                     trace.counter("serve_auth_failed", batch=b.label)
+                    # One mismatch is a data event; a SPIKE within the
+                    # incident window dumps a flight-recorder bundle.
+                    incident.note_auth_failure()
                     req.fail(ERR_AUTH,
                              "GCM tag mismatch (authentication failed)",
                              batch=b.label)

@@ -34,7 +34,7 @@ from __future__ import annotations
 import asyncio
 import json
 
-from ..obs import metrics
+from ..obs import incident, metrics, trace
 from ..resilience import degrade
 
 
@@ -81,6 +81,18 @@ class HttpStatusEndpoint:
         event loop cannot."""
         return self.metrics_text()
 
+    def incidentz(self) -> dict:
+        """The /incidentz body: this process's flight-recorder state
+        (obs/incident.py) — live ring length, dump/suppress counts,
+        and a light index of the run dir's bundles. Read-only, like
+        everything else on this port."""
+        d = trace.run_dir()
+        return {
+            **incident.counts(),
+            "run_dir": d,
+            "bundles": incident.bundle_index(d) if d else [],
+        }
+
     # -- the responder ------------------------------------------------------
     async def _handle(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:
@@ -103,8 +115,14 @@ class HttpStatusEndpoint:
                                   sort_keys=True) + "\n"
                 ctype = "application/json"
                 code, reason = 200, "OK"
+            elif path.split("?")[0] == "/incidentz":
+                body = json.dumps(self.incidentz(), indent=1,
+                                  sort_keys=True) + "\n"
+                ctype = "application/json"
+                code, reason = 200, "OK"
             else:
-                body = "not found: try /metrics or /healthz\n"
+                body = ("not found: try /metrics, /healthz or "
+                        "/incidentz\n")
                 ctype = "text/plain"
                 code, reason = 404, "Not Found"
         except Exception:  # noqa: BLE001 - a bad scrape must not matter

@@ -218,7 +218,8 @@ async def _amain(args) -> int:
         journal=args.journal,
         max_inflight=args.max_inflight,
         status_port=args.status_port,
-        modes=tuple((args.modes or "ctr").split(",")))
+        modes=tuple((args.modes or "ctr").split(",")),
+        ceiling_gbps=args.ceiling_gbps)
     server = Server(cfg)
     await server.start()
     frontend = RequestFrontend(server, args.port, host=args.host)
@@ -307,6 +308,13 @@ def main(argv=None) -> int:
     ap.add_argument("--probe-every", type=int, default=8, metavar="BATCHES")
     ap.add_argument("--max-inflight", type=int, default=None, metavar="N")
     ap.add_argument("--journal", default=None, metavar="PATH")
+    ap.add_argument("--ceiling-gbps", type=float, default=None,
+                    metavar="GBPS",
+                    help="the measured device roofline the cost model "
+                         "records utilization against (obs/costmodel.py;"
+                         " rides this worker's cost-*.json run-dir "
+                         "stamp, so the fleet report's roofline table "
+                         "has its denominator)")
     args = ap.parse_args(argv)
     if args.key_slots is None:
         args.key_slots = batcher.DEFAULT_KEY_SLOTS

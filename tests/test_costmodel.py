@@ -1,0 +1,318 @@
+"""The cost/attribution plane (our_tree_tpu/obs/costmodel.py): the
+analytic-vs-XLA byte-count parity pin (the hand model must track the
+real dispatch signature — a signature change that stales it fails
+here, not silently downstream), graceful degradation where
+cost_analysis()/memory_analysis() are unavailable, the per-process
+record cache, the run-dir stamp roundtrip, the cost_section join, and
+the SLO gate's per-(engine x rung) utilization budgets."""
+
+import json
+
+import pytest
+
+from our_tree_tpu.obs import costmodel, metrics, slo, trace
+
+NR128 = 10  # AES-128 rounds
+K = 8
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    monkeypatch.delenv("OT_COST_XLA", raising=False)
+    yield
+
+
+# ---------------------------------------------------------------------------
+# The analytic model.
+# ---------------------------------------------------------------------------
+
+
+def test_analytic_ctr_formula_exact():
+    rec = costmodel.analytic_cost("jnp", "ctr", 64, NR128, K)
+    blk = 16 * 64
+    sched = K * 4 * (NR128 + 1) * 4
+    assert rec["bytes_in"] == blk + blk + sched + 4 * 64
+    assert rec["bytes_out"] == blk
+    assert rec["hbm_bytes"] == rec["bytes_in"] + rec["bytes_out"]
+    assert rec["ops"] > 0
+
+
+def test_analytic_native_skips_counter_traffic():
+    """The native host tier generates counters inside C (the runs fast
+    path): its traffic model must NOT charge a counter array or slot
+    vector — the per-engine half of the analytic fallback."""
+    nat = costmodel.analytic_cost("native", "ctr", 64, NR128, K)
+    jnp_ = costmodel.analytic_cost("jnp", "ctr", 64, NR128, K)
+    blk = 16 * 64
+    assert jnp_["bytes_in"] - nat["bytes_in"] == blk + 4 * 64
+    assert nat["exec_engine"] == "native"
+
+
+def test_analytic_gcm_counts_hmats_and_state_output():
+    rec = costmodel.analytic_cost("jnp", "gcm", 64, NR128, K)
+    assert rec["bytes_out"] == 2 * 16 * 64  # stacked (crypt, GHASH)
+    assert rec["bytes_in"] >= K * 128 * 128 * 4  # the mul-by-H matrices
+
+
+def test_analytic_aead_on_native_tier_models_jnp():
+    """AEAD batches on a native-tier server run the jnp engine
+    in-process (the lane seam's tier detour): the record must model
+    THAT dataflow, not the C one."""
+    rec = costmodel.analytic_cost("native", "gcm", 64, NR128, K)
+    twin = costmodel.analytic_cost("jnp", "gcm", 64, NR128, K)
+    assert rec["exec_engine"] == "jnp"
+    assert rec["hbm_bytes"] == twin["hbm_bytes"]
+
+
+# ---------------------------------------------------------------------------
+# The XLA pin (the acceptance contract: byte counts within 10% on
+# every engine where both halves exist).
+# ---------------------------------------------------------------------------
+
+
+def _pin(engine, mode, rung=64):
+    rec = costmodel.analytic_cost(engine, mode, rung, NR128, K)
+    x = costmodel.xla_cost(engine, mode, rung, NR128, K)
+    if x is None or "arg_bytes" not in x:
+        pytest.skip(f"XLA cost analysis unavailable for {engine}/{mode}")
+    assert abs(x["arg_bytes"] - rec["bytes_in"]) \
+        <= 0.10 * max(x["arg_bytes"], 1), (rec, x)
+    assert abs(x["out_bytes"] - rec["bytes_out"]) \
+        <= 0.10 * max(x["out_bytes"], 1), (rec, x)
+
+
+def test_xla_parity_jnp_ctr():
+    _pin("jnp", "ctr")
+
+
+@pytest.mark.slow
+def test_xla_parity_jnp_gcm():
+    # slow: the fused GCM lower+compile costs seconds. Tier-1 keeps the
+    # fast ctr pin above; the CI obs job runs this suite UNFILTERED, so
+    # the full every-engine acceptance pin is still enforced per PR.
+    _pin("jnp", "gcm")
+
+
+@pytest.mark.slow
+def test_xla_parity_jnp_cbc():
+    _pin("jnp", "cbc")
+
+
+@pytest.mark.slow
+def test_xla_parity_bitslice_ctr():
+    _pin("bitslice", "ctr")
+
+
+def test_xla_half_absent_on_native_ctr():
+    assert costmodel.xla_cost("native", "ctr", 64, NR128, K) is None
+
+
+def test_xla_half_never_raises_on_junk_engine():
+    # An unknown engine name lowers through the jit's bitslice
+    # fallback on this jax, or degrades to None on one where it
+    # cannot — either way, NEVER an exception (the warmup path calls
+    # this inline).
+    out = costmodel.xla_cost("no-such-engine", "ctr", 64, NR128, K)
+    assert out is None or isinstance(out, dict)
+
+
+# ---------------------------------------------------------------------------
+# Record cache + the ladder policy.
+# ---------------------------------------------------------------------------
+
+
+def test_cost_record_cached_and_upgraded():
+    costmodel.reset_for_tests()
+    a = costmodel.cost_record("jnp", "ctr", 32, NR128, K)
+    assert a["source"] == "analytic" and a["xla"] is None
+    b = costmodel.cost_record("jnp", "ctr", 32, NR128, K)
+    assert b is a  # memoized
+    c = costmodel.cost_record("jnp", "ctr", 32, NR128, K, with_xla=True)
+    assert c is a
+    if c["xla"] is not None:  # upgraded in place where XLA exists
+        assert c["source"] == "analytic+xla"
+
+
+def test_ladder_policy_off_and_top(monkeypatch):
+    costmodel.reset_for_tests()
+    monkeypatch.setenv("OT_COST_XLA", "0")
+    recs = costmodel.ladder_costs("jnp", ("ctr",), (32, 64), (128,), K)
+    assert [r["rung"] for r in recs] == [32, 64]
+    assert all(r["xla"] is None for r in recs)
+    costmodel.reset_for_tests()
+    monkeypatch.setenv("OT_COST_XLA", "top")
+    recs = costmodel.ladder_costs("jnp", ("ctr",), (32, 64), (128,), K)
+    by_rung = {r["rung"]: r for r in recs}
+    assert by_rung[32]["xla"] is None  # below the top rung: analytic
+    # Top rung attempted (non-None wherever this jax supports it).
+
+
+# ---------------------------------------------------------------------------
+# Run-dir stamp + the cost_section join.
+# ---------------------------------------------------------------------------
+
+
+def test_write_and_load_run_records(tmp_path, monkeypatch):
+    monkeypatch.setenv("OT_TRACE_DIR", str(tmp_path / "tr"))
+    monkeypatch.setenv("OT_TRACE_RUN", "t-cost")
+    trace.reset_for_tests()
+    try:
+        recs = [costmodel.analytic_cost("jnp", "ctr", 32, NR128, K)]
+        path = costmodel.write_run_records(recs, engine="jnp",
+                                           ceiling_gbps=35.4)
+        assert path is not None
+        doc = json.loads(open(path).read())
+        assert doc["kind"] == costmodel.KIND
+        loaded, ceiling = costmodel.load_run_records(
+            str(tmp_path / "tr" / "t-cost"))
+        assert ceiling == 35.4
+        assert loaded[0]["hbm_bytes"] == recs[0]["hbm_bytes"]
+    finally:
+        trace.reset_for_tests()
+
+
+def test_write_run_records_disabled_without_trace(monkeypatch):
+    monkeypatch.delenv("OT_TRACE_DIR", raising=False)
+    trace.reset_for_tests()
+    assert costmodel.write_run_records([], engine="jnp") is None
+
+
+def test_cost_section_join_and_utilization():
+    rec = costmodel.analytic_cost("jnp", "ctr", 4096, NR128, K)
+    counters = {
+        "serve_rung_dispatches{engine=jnp,mode=ctr,nr=10,rung=4096}":
+            1000.0,
+        "serve_rung_device_us{engine=jnp,mode=ctr,nr=10,rung=4096}": 1e4,
+        # A rung that never dispatched must not produce a row.
+        "serve_rung_dispatches{engine=jnp,mode=ctr,nr=10,rung=64}": 0.0,
+    }
+    cs = costmodel.cost_section([rec], counters, ceiling_gbps=10.0)
+    assert len(cs["rows"]) == 1
+    row = cs["rows"][0]
+    assert row["dispatches"] == 1000
+    assert row["modeled_bytes"] == 1000 * rec["hbm_bytes"]
+    expect_gbps = 1000 * rec["hbm_bytes"] / 1e9 / 0.01
+    assert abs(row["achieved_gbps"] - expect_gbps) < 1e-3 * expect_gbps
+    assert abs(row["utilization"] - expect_gbps / 10.0) \
+        < 1e-3 * expect_gbps
+    eng = cs["per_engine"]["jnp"]
+    assert eng["modeled_bytes"] == row["modeled_bytes"]
+
+
+def test_cost_section_no_device_time_zero_rate():
+    rec = costmodel.analytic_cost("jnp", "ctr", 32, NR128, K)
+    counters = {
+        "serve_rung_dispatches{engine=jnp,mode=ctr,nr=10,rung=32}": 2.0}
+    cs = costmodel.cost_section([rec], counters)
+    assert cs["rows"][0]["achieved_gbps"] == 0.0
+    assert cs["rows"][0]["utilization"] is None
+
+
+def test_cost_section_splits_key_sizes_at_one_rung():
+    """A mixed 128/256-bit run prices each key size with ITS record:
+    nr is part of the join, so AES-256 traffic at a rung is never
+    priced with the AES-128 schedule-stack bytes."""
+    r128 = costmodel.analytic_cost("jnp", "ctr", 64, 10, K)
+    r256 = costmodel.analytic_cost("jnp", "ctr", 64, 14, K)
+    counters = {
+        "serve_rung_dispatches{engine=jnp,mode=ctr,nr=10,rung=64}": 3.0,
+        "serve_rung_dispatches{engine=jnp,mode=ctr,nr=14,rung=64}": 5.0,
+    }
+    cs = costmodel.cost_section([r128, r256], counters)
+    by_nr = {r["nr"]: r for r in cs["rows"]}
+    assert set(by_nr) == {10, 14}
+    assert by_nr[10]["modeled_bytes"] == 3 * r128["hbm_bytes"]
+    assert by_nr[14]["modeled_bytes"] == 5 * r256["hbm_bytes"]
+    assert r256["hbm_bytes"] > r128["hbm_bytes"]  # bigger stack
+
+
+# ---------------------------------------------------------------------------
+# The SLO gate's cost budgets.
+# ---------------------------------------------------------------------------
+
+
+def _doc(gbps, rung=4096):
+    return {"load": {"p50_ms": 1, "p95_ms": 1, "p99_ms": 1,
+                     "goodput_gbps": 1.0, "errors": {}, "requests": 10},
+            "queue": {"lost": 0}, "compiles": {"steady": 0},
+            "cost": {"rows": [{"engine": "native", "mode": "ctr",
+                               "rung": rung, "nr": 10,
+                               "achieved_gbps": gbps}]}}
+
+
+def test_slo_cost_regression_names_engine_and_rung():
+    base = slo.extract(_doc(10.0))
+    good = slo.extract(_doc(9.0))
+    bad = slo.extract(_doc(3.0))
+    assert slo.compare(base, good) == []  # within the 50% default band
+    fails = slo.compare(base, bad)
+    assert len(fails) == 1
+    assert fails[0].startswith("cost:native|ctr|r4096|nr10:")
+    # A rung the candidate never served gates nothing.
+    other = slo.extract(_doc(10.0, rung=64))
+    assert slo.compare(other, slo.extract(_doc(10.0))) == []
+
+
+def test_slo_cost_tolerance_override():
+    base = slo.extract(_doc(10.0))
+    cand = slo.extract(_doc(9.0))
+    tol = slo.parse_tolerances("cost_gbps=0.05")
+    fails = slo.compare(base, cand, tol)
+    assert any(f.startswith("cost:") for f in fails)
+
+
+def test_slo_render_includes_cost_rows():
+    import io
+
+    base = slo.extract(_doc(10.0))
+    cand = slo.extract(_doc(3.0))
+    fails = slo.compare(base, cand)
+    buf = io.StringIO()
+    slo.render(base, cand, fails, out=buf)
+    assert "cost:native|ctr|r4096|nr10" in buf.getvalue()
+    assert "REGRESSION" in buf.getvalue()
+
+
+# ---------------------------------------------------------------------------
+# Compile-time accounting: exact at any sample rate.
+# ---------------------------------------------------------------------------
+
+
+def test_compile_histogram_exact_under_sampling(tmp_path, monkeypatch):
+    """serve_compile_us is registry-fed by the jax.monitoring listener:
+    its total count must equal the server's measured warmup compile
+    count EXACTLY even when span tracing samples everything out
+    (OT_TRACE_SAMPLE=0) — compile cost is incident-grade evidence and
+    must never depend on the sampling coin."""
+    import asyncio
+
+    from our_tree_tpu.serve.server import Server, ServerConfig
+
+    monkeypatch.setenv("OT_TRACE_DIR", str(tmp_path / "tr"))
+    monkeypatch.setenv("OT_TRACE_RUN", "t-compile")
+    monkeypatch.setenv("OT_TRACE_SAMPLE", "0")
+    monkeypatch.setenv("OT_COST_XLA", "0")
+    trace.reset_for_tests()
+    metrics.reset_for_tests()
+    try:
+        async def main():
+            s = Server(ServerConfig(engine="jnp", lanes=1,
+                                    min_bucket_blocks=32,
+                                    max_bucket_blocks=64))
+            await s.start()
+            try:
+                return s.warmup_compiles
+            finally:
+                await s.stop()
+
+        warmup = asyncio.run(main())
+        items = metrics.hist_items("serve_compile_us")
+        total = sum(h["count"] for _, h in items)
+        assert total == warmup
+        # Every ladder compile is attributed to a real rung label.
+        rungs = {int(labels["rung"]) for labels, _ in items}
+        if warmup:
+            assert rungs <= {0, 32, 64}
+    finally:
+        trace.reset_for_tests()
+        metrics.reset_for_tests()

@@ -1,0 +1,258 @@
+"""The incident flight recorder (our_tree_tpu/obs/incident.py): ring
+bounds, the trigger matrix (watchdog kill / quarantine coalescing,
+cooldown, per-process cap, auth-failure spike threshold), bundle
+schema validation, ``obs.report --incidents [--check]``, the live
+``/incidentz`` status document, and the end-to-end serve contract —
+a hang drive dumps EXACTLY one schema-valid bundle whose ring contains
+the killed dispatch; a healthy drive dumps none."""
+
+import asyncio
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from our_tree_tpu.obs import incident, metrics, report, trace
+from our_tree_tpu.resilience import degrade, faults
+from our_tree_tpu.serve.server import Server, ServerConfig
+
+LADDER = dict(engine="jnp", lanes=1, min_bucket_blocks=32,
+              max_bucket_blocks=64)
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    for k in ("OT_FAULTS", "OT_INCIDENT_RING", "OT_INCIDENT_MAX",
+              "OT_INCIDENT_COOLDOWN_S", "OT_INCIDENT_AUTH_SPIKE",
+              "OT_INCIDENT_AUTH_WINDOW_S", "OT_TRACE_SAMPLE"):
+        monkeypatch.delenv(k, raising=False)
+    monkeypatch.setenv("OT_COST_XLA", "0")  # keep server starts cheap
+    faults.reset()
+    degrade.clear()
+    metrics.reset_for_tests()
+    incident.reset_for_tests()
+    yield
+    monkeypatch.delenv("OT_FAULTS", raising=False)
+    faults.reset()
+    degrade.clear()
+    metrics.reset_for_tests()
+    incident.reset_for_tests()
+
+
+@pytest.fixture
+def traced(tmp_path, monkeypatch):
+    monkeypatch.setenv("OT_TRACE_DIR", str(tmp_path / "tr"))
+    monkeypatch.setenv("OT_TRACE_RUN", "t-incident")
+    monkeypatch.delenv("OT_TRACE_PARENT", raising=False)
+    trace.reset_for_tests()
+    metrics.reset_for_tests()
+    yield tmp_path / "tr" / "t-incident"
+    trace.reset_for_tests()
+    metrics.reset_for_tests()
+
+
+def _run_server(config, fn):
+    async def main():
+        server = Server(config)
+        await server.start()
+        try:
+            return server, await fn(server)
+        finally:
+            await server.stop()
+
+    return asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# The ring.
+# ---------------------------------------------------------------------------
+
+
+def test_ring_bounded_oldest_dropped(monkeypatch):
+    monkeypatch.setenv("OT_INCIDENT_RING", "4")
+    incident.reset_for_tests()
+    for i in range(10):
+        incident.record(lane=0, outcome="ok", seq=i)
+    snap = incident.snapshot()
+    assert len(snap) == 4
+    assert [r["seq"] for r in snap] == [6, 7, 8, 9]
+    assert all("t_us" in r for r in snap)
+
+
+def test_ring_disabled_at_zero(monkeypatch):
+    monkeypatch.setenv("OT_INCIDENT_RING", "0")
+    incident.reset_for_tests()
+    incident.record(lane=0, outcome="ok")
+    assert incident.snapshot() == []
+
+
+# ---------------------------------------------------------------------------
+# Triggers: cooldown coalescing, cap, no-trace no-bundle.
+# ---------------------------------------------------------------------------
+
+
+def test_trigger_without_trace_dir_is_noop(monkeypatch):
+    monkeypatch.delenv("OT_TRACE_DIR", raising=False)
+    trace.reset_for_tests()
+    assert incident.trigger("watchdog-kill") is None
+
+
+def test_trigger_writes_valid_bundle_and_coalesces(traced):
+    incident.record(lane=3, rung=64, engine="jnp", mode="ctr",
+                    outcome="timeout", device_us=0, wall_us=123,
+                    batch="b1")
+    incident.set_cost_records([{"engine": "jnp", "mode": "ctr",
+                                "rung": 64, "hbm_bytes": 1}])
+    path = incident.trigger("watchdog-kill", lane=3)
+    assert path is not None
+    doc = incident.load_bundle(path)
+    assert incident.validate_bundle(doc) == []
+    assert doc["reason"] == "watchdog-kill"
+    assert doc["attrs"] == {"lane": 3}
+    assert [r["outcome"] for r in doc["ring"]] == ["timeout"]
+    assert doc["cost"][0]["rung"] == 64
+    assert isinstance(doc["metrics"], dict)
+    # The quarantine that follows a kill is the SAME incident: the
+    # cooldown suppresses its trigger instead of dumping a twin.
+    assert incident.trigger("quarantine", unit="lane:3") is None
+    assert incident.counts()["dumped"] == 1
+    assert incident.counts()["suppressed"] == 1
+    assert len(incident.list_bundles(str(traced))) == 1
+
+
+def test_trigger_cooldown_zero_allows_separate_bundles(
+        traced, monkeypatch):
+    monkeypatch.setenv("OT_INCIDENT_COOLDOWN_S", "0")
+    assert incident.trigger("watchdog-kill") is not None
+    assert incident.trigger("quarantine") is not None
+    assert len(incident.list_bundles(str(traced))) == 2
+
+
+def test_trigger_capped_per_process(traced, monkeypatch):
+    monkeypatch.setenv("OT_INCIDENT_COOLDOWN_S", "0")
+    monkeypatch.setenv("OT_INCIDENT_MAX", "2")
+    assert incident.trigger("watchdog-kill") is not None
+    assert incident.trigger("watchdog-kill") is not None
+    assert incident.trigger("watchdog-kill") is None  # cap
+    assert incident.counts() == {"dumped": 2, "suppressed": 1,
+                                 "ring": 0}
+
+
+def test_auth_spike_threshold(traced, monkeypatch):
+    monkeypatch.setenv("OT_INCIDENT_AUTH_SPIKE", "3")
+    assert incident.note_auth_failure() is None
+    assert incident.note_auth_failure() is None
+    path = incident.note_auth_failure()  # the third within the window
+    assert path is not None
+    doc = incident.load_bundle(path)
+    assert doc["reason"] == "auth-spike"
+    assert doc["attrs"]["failures"] == 3
+
+
+# ---------------------------------------------------------------------------
+# Schema validation + the report's incident mode.
+# ---------------------------------------------------------------------------
+
+
+def test_validate_bundle_rejects_bad_shapes():
+    assert incident.validate_bundle(None)
+    assert incident.validate_bundle({"kind": "nope"})
+    ok = {"kind": incident.KIND, "v": 1, "run": "r", "pid": 1,
+          "ts_us": 2, "reason": "watchdog-kill",
+          "ring": [{"t_us": 1, "outcome": "ok"}], "metrics": {}}
+    assert incident.validate_bundle(ok) == []
+    bad_reason = dict(ok, reason="cosmic-ray")
+    assert any("reason" in v for v in incident.validate_bundle(bad_reason))
+    bad_ring = dict(ok, ring=[{"t_us": 1}])
+    assert any("outcome" in v for v in incident.validate_bundle(bad_ring))
+
+
+def test_report_incidents_mode_renders_and_checks(traced, capsys):
+    incident.record(lane=1, rung=32, engine="jnp", mode="ctr",
+                    outcome="timeout", device_us=0, wall_us=9,
+                    batch="b")
+    incident.trigger("watchdog-kill", lane=1)
+    trace.point("anchor")  # the run dir needs a trace file to resolve
+    assert report.main([str(traced), "--incidents", "--check"]) == 0
+    out = capsys.readouterr().out
+    assert "reason=watchdog-kill" in out
+    assert "outcome=timeout" in out
+    # A hand-broken bundle fails --check but not the plain render.
+    bad = traced / "incident-9999-deadbeef-0.json"
+    bad.write_text(json.dumps({"kind": "junk"}))
+    assert report.main([str(traced), "--incidents"]) == 0
+    assert report.main([str(traced), "--incidents", "--check"]) == 2
+
+
+def test_report_incidents_mode_empty_run_ok(traced):
+    trace.point("anchor")
+    assert report.main([str(traced), "--incidents", "--check"]) == 0
+
+
+# ---------------------------------------------------------------------------
+# End-to-end through a live server.
+# ---------------------------------------------------------------------------
+
+
+def test_hang_drive_dumps_exactly_one_bundle_with_killed_dispatch(
+        traced, monkeypatch):
+    """The CI contract (tier1.yml serve job): a dispatch_hang drive
+    produces EXACTLY one bundle — the watchdog kill, with the
+    quarantine coalesced into it — whose ring contains the killed
+    dispatch, and the bundle passes the schema gate."""
+    monkeypatch.setenv("OT_FAULTS", "dispatch_hang:1")
+    monkeypatch.setenv("OT_HANG_S", "60")
+    faults.reset()
+
+    async def drive(server):
+        r1 = await server.submit("t", b"k" * 16, b"n" * 16,
+                                 np.zeros(64, np.uint8))
+        r2 = await server.submit("t", b"k" * 16, b"m" * 16,
+                                 np.zeros(64, np.uint8))
+        return r1, r2
+
+    server, (r1, r2) = _run_server(
+        ServerConfig(dispatch_deadline_s=2.0, retries=1, **LADDER),
+        drive)
+    assert not r1.ok and r1.error == "deadline"
+    assert r2.ok  # the lane self-healed via the rescue canary
+    bundles = incident.list_bundles(str(traced))
+    assert len(bundles) == 1
+    doc = incident.load_bundle(bundles[0])
+    assert incident.validate_bundle(doc) == []
+    assert doc["reason"] == "watchdog-kill"
+    assert any(r.get("outcome") == "timeout" for r in doc["ring"])
+    assert doc["cost"], "bundle must carry the process's cost records"
+    assert report.main([str(traced), "--incidents", "--check"]) == 0
+
+
+def test_healthy_drive_dumps_no_bundles(traced):
+    async def drive(server):
+        return await server.submit("t", b"k" * 16, b"n" * 16,
+                                   np.zeros(64, np.uint8))
+
+    _server, resp = _run_server(ServerConfig(**LADDER), drive)
+    assert resp.ok
+    assert incident.list_bundles(str(traced)) == []
+
+
+def test_incidentz_endpoint(traced):
+    async def drive(server):
+        server.pool.lanes[0]._quarantine("test-incident", None)
+        port = server.status.port
+        loop = asyncio.get_running_loop()
+
+        def fetch(path):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+                return r.read().decode()
+
+        return await loop.run_in_executor(None, fetch, "/incidentz")
+
+    _server, body = _run_server(
+        ServerConfig(status_port=0, **LADDER), drive)
+    doc = json.loads(body)
+    assert doc["dumped"] == 1
+    assert doc["bundles"][0]["reason"] == "quarantine"
+    assert doc["bundles"][0]["valid"] is True

@@ -183,6 +183,78 @@ def test_affinity_same_key_lands_one_backend_control_spreads():
     asyncio.run(main())
 
 
+def test_router_gcm_kat_seal_open_affinity_and_failover(monkeypatch):
+    """AEAD through the routing tier (the ot-aead follow-up): the NIST
+    GCM KATs seal AND open bit-exactly THROUGH the router — ciphertext
+    and tag ride the wire's mode fields both ways — the AEAD traffic
+    gets the same key-affinity placement as ctr, and a scoped
+    backend_fail mid-seal re-dispatches on the next ring node with
+    byte-identical ciphertext+tag (failover-before-error holds for
+    modes that carry a tag across the wire)."""
+    kats = [k for k in json.loads(
+                (ROOT / "tests" / "golden" / "gcm_kats.json")
+                .read_text())["kats"]
+            if len(k["iv"]) == 24 and k["ct"] and len(k["ct"]) % 32 == 0]
+    assert kats, "no block-aligned 96-bit-IV KATs in the golden file"
+    # One key size: the in-process cluster warms 128-bit ladders only.
+    kats = [k for k in kats if len(k["key"]) == 32]
+
+    async def main():
+        async with Cluster(
+                n=3,
+                server_kw=dict(modes=("ctr", "gcm", "gcm-open"))) as c:
+            for k in kats:
+                key, iv = bytes.fromhex(k["key"]), bytes.fromhex(k["iv"])
+                aad = bytes.fromhex(k["aad"])
+                pt = np.frombuffer(bytes.fromhex(k["pt"]), np.uint8)
+                ct = bytes.fromhex(k["ct"])
+                seal = await c.router.submit("t0", key, b"", pt,
+                                             mode="gcm", iv=iv, aad=aad)
+                assert seal.ok, (k["name"], seal.error, seal.detail)
+                assert bytes(np.asarray(seal.payload)).hex() == k["ct"]
+                assert seal.tag.hex() == k["tag"], k["name"]
+                opened = await c.router.submit(
+                    "t0", key, b"", np.frombuffer(ct, np.uint8),
+                    mode="gcm-open", iv=iv, aad=aad,
+                    tag=bytes.fromhex(k["tag"]))
+                assert opened.ok
+                assert bytes(np.asarray(opened.payload)).hex() == k["pt"]
+            # A tampered tag answers the per-request auth refusal
+            # through the wire, not an exception anywhere.
+            k = kats[0]
+            bad = await c.router.submit(
+                "t0", bytes.fromhex(k["key"]), b"",
+                np.frombuffer(bytes.fromhex(k["ct"]), np.uint8),
+                mode="gcm-open", iv=bytes.fromhex(k["iv"]),
+                aad=bytes.fromhex(k["aad"]),
+                tag=b"\x00" * 16)
+            assert not bad.ok and bad.error == "auth-failed"
+            # AEAD rides affinity like ctr: same (tenant, key) -> same
+            # home backend for every request above.
+            st = c.router.stats()
+            assert st["affinity"]["ratio"] == 1.0
+            # Failover: wedge the KAT key's home backend for ONE
+            # request; the seal must re-dispatch bit-exactly.
+            k = kats[-1]
+            key = bytes.fromhex(k["key"])
+            tenant = _tenant_for(c.router, "b1", key)
+            monkeypatch.setenv("OT_FAULTS", "backend_fail:1@backend=1")
+            faults.reset()
+            seal = await c.router.submit(
+                tenant, key, b"",
+                np.frombuffer(bytes.fromhex(k["pt"]), np.uint8),
+                mode="gcm", iv=bytes.fromhex(k["iv"]),
+                aad=bytes.fromhex(k["aad"]))
+            assert seal.ok
+            assert bytes(np.asarray(seal.payload)).hex() == k["ct"]
+            assert seal.tag.hex() == k["tag"]
+            st = c.router.stats()
+            assert st["redispatches"] == 1
+            assert st["lost"] == 0
+
+    asyncio.run(main())
+
+
 # ---------------------------------------------------------------------------
 # The fault matrix at the backend seam.
 # ---------------------------------------------------------------------------

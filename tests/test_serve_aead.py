@@ -87,7 +87,7 @@ def test_queue_admission_validates_modes():
 
         # Unknown mode / wrong field lengths: coded refusals, counted.
         assert await code(mode="xts") == otq.ERR_BAD_REQUEST
-        assert await code(mode="gcm", iv=b"x" * 16) == otq.ERR_BAD_REQUEST
+        assert await code(mode="gcm", iv=b"") == otq.ERR_BAD_REQUEST
         assert await code(mode="gcm-open", iv=b"x" * 12,
                           tag=b"t" * 8) == otq.ERR_BAD_REQUEST
         assert await code(mode="cbc", iv=b"x" * 12) == otq.ERR_BAD_REQUEST
@@ -95,13 +95,23 @@ def test_queue_admission_validates_modes():
         big = np.zeros(16 * 64, np.uint8)
         r = await q.submit("t", key, b"", big, mode="gcm", iv=b"x" * 12)
         assert r.error == otq.ERR_TOO_LARGE
-        # Valid forms admit.
+        # Valid forms admit — any NONZERO GCM IV length does (the
+        # non-96-bit shapes derive J0 through the host GHASH path at
+        # admission; 96-bit stays the concat fast path).
         f1 = q.submit("t", key, b"", pay, mode="gcm", iv=b"i" * 12)
         f2 = q.submit("t", key, b"", pay, mode="gcm-open", iv=b"i" * 12,
                       tag=b"t" * 16)
         f3 = q.submit("t", key, b"", pay, mode="cbc", iv=b"i" * 16)
-        assert len(q.drain()) == 3
-        for f in (f1, f2, f3):
+        f4 = q.submit("t", key, b"", pay, mode="gcm", iv=b"i" * 16)
+        reqs = q.drain()
+        assert len(reqs) == 4
+        # J0 derived at admission: 96-bit = IV || 0^31 || 1; the
+        # 16-byte IV took the GHASH path (different, 16 bytes, pinned
+        # bit-exactly by the live-server KAT test below).
+        assert reqs[0].j0 == b"i" * 12 + b"\x00\x00\x00\x01"
+        assert len(reqs[3].j0) == 16
+        assert reqs[3].j0 != b"i" * 16
+        for f in (f1, f2, f3, f4):
             f.cancel()
 
     asyncio.run(main())
@@ -238,6 +248,51 @@ def test_serve_gcm_kats_live_server():
         assert bytes(seal.payload).hex() == k["ct"], k["name"]
         assert seal.tag.hex() == k["tag"], k["name"]
         assert bytes(opened.payload).hex() == k["pt"], k["name"]
+    assert server.steady_compiles() == 0
+    assert server.stats()["queue"]["lost"] == 0
+
+
+def test_serve_non_96_bit_iv_live_server():
+    """Non-96-bit GCM IVs SERVE now: admission derives J0 through the
+    host GHASH path (J0 = GHASH_H(IV padded || lens), SP 800-38D §7.1
+    — KAT vector 9 pins that math at the models layer) and the request
+    rides the same fixed dispatch shape as the 96-bit fast path.
+    Pinned bit-exactly against the pure-host reference GCM for 8- and
+    16-byte IVs, seal AND open, zero post-warmup recompiles."""
+    rng = np.random.default_rng(77)
+    key = rng.bytes(16)
+    aad = rng.bytes(20)
+    pt = rng.bytes(64)
+    cases = []
+    for iv_len in (8, 16, 60):
+        iv = rng.bytes(iv_len)
+        ct, tag = ghash.np_gcm_seal(key, iv, aad, pt)
+        cases.append((iv, ct, tag))
+
+    async def drive(server):
+        outs = []
+        for iv, ct, tag in cases:
+            seal = await server.submit(
+                "t0", key, b"", np.frombuffer(pt, np.uint8),
+                mode="gcm", iv=iv, aad=aad)
+            opened = await server.submit(
+                "t0", key, b"", np.frombuffer(ct, np.uint8),
+                mode="gcm-open", iv=iv, aad=aad, tag=tag)
+            tampered = await server.submit(
+                "t0", key, b"", np.frombuffer(ct, np.uint8),
+                mode="gcm-open", iv=iv, aad=aad,
+                tag=bytes([tag[0] ^ 1]) + tag[1:])
+            outs.append((seal, opened, tampered))
+        return outs
+
+    server, outs = _run_server(ServerConfig(**AEAD_CFG), drive)
+    for (iv, ct, tag), (seal, opened, tampered) in zip(cases, outs):
+        assert seal.ok and bytes(seal.payload) == ct, len(iv)
+        assert seal.tag == tag, len(iv)
+        assert opened.ok and bytes(opened.payload) == pt, len(iv)
+        # A tampered tag still refuses per-request — the GHASH-path J0
+        # must not weaken the auth side.
+        assert not tampered.ok and tampered.error == otq.ERR_AUTH
     assert server.steady_compiles() == 0
     assert server.stats()["queue"]["lost"] == 0
 
