@@ -80,6 +80,15 @@ class Rule:
     severity: str
     doc: str
     check: Callable[[FileContext], Iterator[tuple[ast.AST, str]]]
+    #: Optional MECHANICAL rewriter (the ``otlint --fix`` seam): yields
+    #: (node, replacement source) pairs for violations whose fix is a
+    #: pure text substitution — the node's exact source span is
+    #: replaced and the fixed file must re-lint clean (the
+    #: fixture-pair tests pin that). Rules whose fix needs judgment
+    #: (which seam to route through, what deadline to pick) leave this
+    #: None: --fix is for rewrites a reviewer would rubber-stamp.
+    fixer: Callable[[FileContext],
+                    Iterator[tuple[ast.AST, str]]] | None = None
 
 
 def _dotted(node: ast.AST) -> str:
@@ -313,7 +322,27 @@ def _check_wallclock(ctx: FileContext):
                     f"`{name}()` reads the wall clock: timed regions and "
                     "budgets use time.monotonic()/perf_counter() (NTP "
                     "steps corrupt durations); epoch time belongs to "
-                    "obs.trace and to file-mtime comparisons")
+                    "obs.trace (trace.now_us) and to file-mtime "
+                    "comparisons")
+
+
+#: The wallclock rule's mechanical rewrite (`--fix`): the monotonic
+#: twin of each wall-clock read. Call sites that genuinely need EPOCH
+#: time (event timestamps) belong on ``trace.now_us()`` instead —
+#: that is a judgment rewrite, left to the reviewer the finding names.
+_WALLCLOCK_FIX = {"time.time": "time.monotonic()",
+                  "time.time_ns": "time.monotonic_ns()"}
+
+
+def _fix_wallclock(ctx: FileContext):
+    if ctx.in_dir("obs", "our_tree_tpu/obs"):
+        return
+    for node in ast.walk(ctx.tree):
+        if (isinstance(node, ast.Call) and not node.args
+                and not node.keywords):
+            new = _WALLCLOCK_FIX.get(_dotted(node.func))
+            if new:
+                yield node, new
 
 
 # ---------------------------------------------------------------------------
@@ -410,8 +439,11 @@ def _check_fault_points(ctx: FileContext):
 # ---------------------------------------------------------------------------
 
 _METRIC_METHODS = ("counter", "gauge", "gauge_max", "observe")
-#: Keyword args that are the metric's VALUE, not labels.
-_METRIC_VALUE_KWARGS = ("n", "value")
+#: Keyword args that are the metric's VALUE, not labels ("exemplar" is
+#: the bounded tail-exemplar payload, obs/metrics.py — identity-shaped
+#: by design, bounded by the per-series exemplar cap, never a series
+#: key).
+_METRIC_VALUE_KWARGS = ("n", "value", "exemplar")
 #: Identifier fragments that statically smell like unbounded
 #: cardinality: a label value built from any of these turns the
 #: process-global registry into a per-request/per-tenant memory leak
@@ -614,8 +646,9 @@ RULES: tuple[Rule, ...] = (
          _check_degrade),
     Rule("wallclock", "warning",
          "No time.time()/time_ns() outside obs/ — durations use monotonic "
-         "clocks; epoch time is the tracer's and mtime comparisons'.",
-         _check_wallclock),
+         "clocks; epoch time is the tracer's and mtime comparisons'. "
+         "--fix rewrites to the monotonic twin.",
+         _check_wallclock, fixer=_fix_wallclock),
     Rule("trace-attrs", "error",
          "span/detached_span/point/counter/gauge attrs must be statically "
          "JSON-serializable (no bytes/set/lambda/complex literals).",
@@ -667,9 +700,7 @@ def lint_file(path: str, relpath: str) -> list[Finding]:
     return out
 
 
-def lint_paths(paths: list[str], repo_root: str) -> list[Finding]:
-    """Lint every .py under ``paths`` (files or directories), findings
-    keyed by repo-root-relative path."""
+def _walk_py(paths: list[str]) -> list[str]:
     files: list[str] = []
     for p in paths:
         if os.path.isdir(p):
@@ -680,9 +711,91 @@ def lint_paths(paths: list[str], repo_root: str) -> list[Finding]:
                              for f in sorted(filenames) if f.endswith(".py"))
         elif p.endswith(".py"):
             files.append(p)
+    return sorted(set(files))
+
+
+def lint_paths(paths: list[str], repo_root: str) -> list[Finding]:
+    """Lint every .py under ``paths`` (files or directories), findings
+    keyed by repo-root-relative path."""
     out: list[Finding] = []
-    for f in sorted(set(files)):
+    for f in _walk_py(paths):
         rel = os.path.relpath(os.path.abspath(f),
                               os.path.abspath(repo_root)).replace(os.sep, "/")
         out.extend(lint_file(f, rel))
     return anchored(out)
+
+
+# ---------------------------------------------------------------------------
+# --fix: apply the rules' mechanical rewrites in place.
+# ---------------------------------------------------------------------------
+
+
+def fix_file(path: str, relpath: str,
+             baseline: dict | None = None) -> int:
+    """Apply every rule's fixer to one file IN PLACE; returns the
+    rewrite count. Replacements splice the flagged node's exact source
+    span (``end_lineno``/``end_col_offset``), applied bottom-up so
+    earlier edits never shift later spans. Unparseable files are left
+    alone (the parse finding stands).
+
+    ``baseline`` (fingerprint -> entry, analysis/baseline.json's
+    loaded form) EXEMPTS baselined violations from fixing: a reasoned
+    baseline entry is a site where the "violation" is deliberate —
+    devlock's epoch-vs-mtime staleness compare, the watchdog report's
+    epoch filename — and a mechanical monotonic rewrite there would be
+    semantically wrong, not clean. Exemption is per (rule, line): any
+    baselined finding of the fixing rule on a line protects that
+    line's candidates."""
+    with open(path, encoding="utf-8") as fh:
+        src = fh.read()
+    try:
+        tree = ast.parse(src, filename=relpath)
+    except SyntaxError:
+        return 0
+    ctx = FileContext(relpath, src, tree, src.splitlines())
+    protected: set[tuple[str, int]] = set()
+    if baseline:
+        for f in anchored(lint_file(path, relpath)):
+            if f.fingerprint in baseline:
+                protected.add((f.rule, f.line))
+    edits: list[tuple] = []
+    for rule in RULES:
+        if rule.fixer is None:
+            continue
+        for node, replacement in rule.fixer(ctx):
+            if getattr(node, "end_lineno", None) is None:
+                continue
+            if (rule.id, getattr(node, "lineno", 0)) in protected:
+                continue
+            edits.append((node.lineno, node.col_offset,
+                          node.end_lineno, node.end_col_offset,
+                          replacement))
+    if not edits:
+        return 0
+    lines = src.splitlines(keepends=True)
+    for l0, c0, l1, c1, new in sorted(edits, reverse=True):
+        if l0 == l1:
+            line = lines[l0 - 1]
+            lines[l0 - 1] = line[:c0] + new + line[c1:]
+        else:
+            lines[l0 - 1:l1] = [lines[l0 - 1][:c0] + new
+                                + lines[l1 - 1][c1:]]
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write("".join(lines))
+    return len(edits)
+
+
+def fix_paths(paths: list[str], repo_root: str,
+              baseline: dict | None = None) -> dict[str, int]:
+    """``otlint --fix`` over files/dirs: {repo-relative path: rewrites}
+    for every file actually changed, baselined violations exempted
+    (``fix_file``). The contract the fixture-pair tests pin: a fixed
+    file re-lints CLEAN for the fixing rule."""
+    out: dict[str, int] = {}
+    for f in _walk_py(paths):
+        rel = os.path.relpath(os.path.abspath(f),
+                              os.path.abspath(repo_root)).replace(os.sep, "/")
+        n = fix_file(f, rel, baseline=baseline)
+        if n:
+            out[rel] = n
+    return out

@@ -65,6 +65,13 @@ def main(argv=None) -> int:
                          "preserving reasons from --baseline; new entries "
                          "get a TODO reason the loader rejects until "
                          "justified")
+    ap.add_argument("--fix", action="store_true",
+                    help="apply the rules' MECHANICAL rewrites in "
+                         "place before linting (currently: the "
+                         "wallclock rule's time.time()/time_ns() -> "
+                         "monotonic twin), then report the post-fix "
+                         "state — a fixed file re-lints clean for the "
+                         "fixing rule")
     ap.add_argument("--no-ast", action="store_true",
                     help="skip layer 1 (the AST linter)")
     ap.add_argument("--no-jaxpr", action="store_true",
@@ -100,6 +107,33 @@ def main(argv=None) -> int:
 
     root = _repo_root()
     findings = []
+    if args.fix:
+        # Baselined violations are EXEMPT from fixing (a reasoned
+        # baseline entry marks a deliberate site — mechanically
+        # rewriting it would be semantically wrong, e.g. devlock's
+        # epoch-vs-mtime staleness compare), so the baseline loads
+        # before the rewrites run — and a bare `--fix` with no
+        # --baseline flag still protects the COMMITTED baseline's
+        # sites (the one place the reasons live; an unprotected
+        # default would rewrite exactly the sites the reasons exist
+        # for).
+        fix_baseline_path = args.baseline or os.path.join(
+            root, "analysis", "baseline.json")
+        fix_base: dict = {}
+        if os.path.exists(fix_baseline_path):
+            try:
+                fix_base = baseline_mod.load(fix_baseline_path)
+            except baseline_mod.BaselineError as e:
+                print(f"BASELINE ERROR: {e}", file=sys.stderr)
+                return 2
+        paths = ([os.path.abspath(p) for p in args.paths]
+                 if args.paths else _default_paths(root))
+        fixed = astrules.fix_paths(paths, root, baseline=fix_base)
+        for rel, n in sorted(fixed.items()):
+            print(f"# otlint --fix: {rel}: {n} rewrite(s)",
+                  file=sys.stderr)
+        print(f"# otlint --fix: {sum(fixed.values())} rewrite(s) in "
+              f"{len(fixed)} file(s)", file=sys.stderr)
     if not args.no_ast:
         paths = ([os.path.abspath(p) for p in args.paths]
                  if args.paths else _default_paths(root))

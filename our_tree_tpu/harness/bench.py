@@ -900,12 +900,16 @@ def main(argv=None) -> int:
 
     profiler_cm = None
     if args.profile and args.backend == "tpu":
-        import contextlib
+        # The ONE capture seam (obs/profiler.py — shared with serve's
+        # --profile-window / /profilez and the incident recorder): the
+        # jax trace lands in the operator's DIR as before, and when
+        # tracing is on the window ALSO leaves its summary in the run
+        # layout so `obs.report --profile` joins sweep captures the
+        # same way it joins serve ones.
+        from ..obs import profiler as profiler_mod
 
-        import jax
-
-        profiler_cm = contextlib.ExitStack()
-        profiler_cm.enter_context(jax.profiler.trace(args.profile))
+        profiler_cm = profiler_mod.sweep_capture(args.profile)
+        profiler_cm.__enter__()
     target = args.isolate_child
     try:
         for name, run_unit in units:
@@ -1023,7 +1027,7 @@ def main(argv=None) -> int:
             em.line("# degraded: " + ",".join(degrade_mod.events()))
     finally:
         if profiler_cm is not None:
-            profiler_cm.close()
+            profiler_cm.__exit__(None, None, None)
         if journal is not None:
             journal.close()
         em.close()

@@ -50,6 +50,18 @@ per worker; this package gives every run the same per-phase attribution:
   into the run layout, coalesced per incident. ``obs.report
   --incidents [--check]`` renders/gates them; ``/incidentz`` lists
   them live.
+* ``profiler`` — ot-scope's ONE capture seam: bounded device-profiling
+  windows (jax.profiler trace where available, host stack sampling on
+  the native tier, a per-window metrics-registry delta summary either
+  way) armable via ``serve.bench --profile-window``, live
+  ``GET /profilez?seconds=N`` (router-federated per backend; overlap
+  refused 409), or the incident recorder (``OT_PROFILE_ON_INCIDENT``,
+  one capture per cooldown). Summaries land in the run layout;
+  ``obs.report --profile`` joins them against the cost records.
+* ``history`` — the perf-history ledger: every committed ``*_r*.json``
+  parsed into classed trend series; ``--check`` gates each series'
+  head against BEST-EVER with per-metric tolerances (CI runs it — a
+  silently-regressing committed artifact names itself).
 * ``export`` — run-dir parsing (schema validation for spans AND metrics
   snapshots, begin/end span pairing, orphan detection — an orphaned
   span IS the evidence of a SIGKILLed child) and the Chrome/Perfetto

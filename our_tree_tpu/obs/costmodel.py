@@ -311,10 +311,11 @@ def load_run_records(run_dir: str) -> tuple[list[dict], float | None]:
 _FLAT_RE = re.compile(r"^([A-Za-z0-9_]+)\{(.*)\}$")
 
 
-def _series_by_key(counters: dict, name: str) -> dict[tuple, float]:
+def series_by_key(counters: dict, name: str) -> dict[tuple, float]:
     """{(engine, mode, rung, nr): total} for one flat-keyed counter
     name (the ``obs/metrics.py`` ``name{k=v,...}`` convention both the
-    live snapshot and the run-dir totals share)."""
+    live snapshot and the run-dir totals share). Public: the profiler's
+    window deltas (obs/profiler.py) parse the same series."""
     out: dict[tuple, float] = {}
     for key, v in counters.items():
         m = _FLAT_RE.match(key)
@@ -337,11 +338,16 @@ def cost_section(records, counters: dict,
     traffic, modeled bytes moved x measured dispatches over the rung's
     accumulated DEVICE time (``serve_rung_dispatches`` /
     ``serve_rung_device_us``, serve/lanes.py) -> achieved GB/s moved
-    and utilization against the measured roofline. Rows exist only for
-    rungs that actually dispatched; ``per_engine`` aggregates them —
-    the SERVE_r* ``cost`` section and the SLO gate's per-row surface."""
-    disp = _series_by_key(counters, "serve_rung_dispatches")
-    dev = _series_by_key(counters, "serve_rung_device_us")
+    and utilization against the measured roofline. Every warmed record
+    gets a row — a rung the traffic never reached shows
+    ``dispatches=0`` rather than vanishing (a silently omitted row
+    reads as "covered" in trend diffs; the explicit zero is the
+    evidence that it was warmed and idle). ``per_engine`` aggregates
+    the dispatched rows — the SERVE_r* ``cost`` section and the SLO
+    gate's per-row surface (zero rows gate nothing: the SLO compare
+    skips baselines <= 0)."""
+    disp = series_by_key(counters, "serve_rung_dispatches")
+    dev = series_by_key(counters, "serve_rung_device_us")
     rows = []
     seen: set[tuple] = set()
     per_engine: dict[str, dict] = {}
@@ -356,6 +362,13 @@ def cost_section(records, counters: dict,
         seen.add(key)
         d = disp.get(key, 0.0)
         if d <= 0:
+            rows.append({
+                "engine": key[0], "mode": key[1], "rung": key[2],
+                "nr": key[3], "dispatches": 0,
+                "modeled_dispatch_bytes": int(rec["hbm_bytes"]),
+                "modeled_bytes": 0, "device_s": 0.0,
+                "achieved_gbps": 0.0, "utilization": None,
+            })
             continue
         dus = dev.get(key, 0.0)
         moved = float(rec["hbm_bytes"]) * d

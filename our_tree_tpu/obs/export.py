@@ -191,6 +191,16 @@ class Run:
                     agg["buckets"][b] = agg["buckets"].get(b, 0) + c
                 agg["count"] += h.get("count", 0)
                 agg["sum"] += h.get("sum", 0.0)
+                # Tail exemplars (obs/metrics.py): per bucket, the max
+                # observation wins across processes — same retention
+                # rule the live registry applies within one.
+                for b, e in (h.get("exemplars") or {}).items():
+                    if not isinstance(e, dict) or "v" not in e:
+                        continue
+                    ex = agg.setdefault("exemplars", {})
+                    cur = ex.get(b)
+                    if cur is None or e["v"] >= cur.get("v", 0):
+                        ex[b] = dict(e)
         return {"counters": counters,
                 "gauges": {k: v for k, (_, v) in gauges.items()},
                 "hists": hists}

@@ -196,6 +196,17 @@ def trigger(reason: str, **attrs) -> str | None:
         metrics.counter("serve_incidents", reason=str(reason))
         trace.point("incident", reason=str(reason),
                     bundle=os.path.basename(path))
+        # OT_PROFILE_ON_INCIDENT: arm one capture window over the
+        # incident's aftermath (obs/profiler.py). AFTER the bundle
+        # write and only on the non-suppressed path, so the trigger
+        # cooldown above is also the capture cooldown — one capture
+        # per incident, never a capture storm.
+        try:
+            from . import profiler
+
+            profiler.on_incident(str(reason))
+        except Exception:  # noqa: BLE001 - never a second incident
+            pass
         return path
     except Exception:  # noqa: BLE001 - never-raises contract
         return None

@@ -74,6 +74,14 @@ ALLOWED_LABEL_KEYS = ("lane", "rung", "engine", "outcome", "bucket",
 #: updates beyond it are dropped (and counted), never stored.
 _MAX_SERIES = 64
 
+#: Tail-exemplar retention bound PER SERIES: one exemplar per log2
+#: bucket, highest buckets kept when the cap is hit — a p99 outlier's
+#: identity survives, the registry's footprint stays O(1). Exemplars
+#: are the one sanctioned place an identity-shaped value (a span id)
+#: rides the registry: bounded by THIS cap, not by label cardinality
+#: (they are not labels and create no series).
+_EXEMPLAR_MAX = 6
+
 #: The time-attribution waterfall's stage vocabulary, in request-path
 #: order (docs/OBSERVABILITY.md): the router's stages, then the
 #: backend's. The ONE definition — route.bench's completeness gate and
@@ -108,14 +116,19 @@ _ATEXIT_REGISTERED = False
 class _Hist:
     """One log2-bucket histogram series: bucket exponent -> count, plus
     exact count/sum so means and Prometheus ``_sum``/``_count`` stay
-    bucket-error-free."""
+    bucket-error-free. ``exemplars`` (lazy) maps bucket exponent -> the
+    MAX observation's exemplar dict for that bucket ({"v", "ts", plus
+    caller attrs like span/trace/lane/rung/engine/mode}), bounded by
+    ``_EXEMPLAR_MAX`` — the tail-latency breadcrumb that turns a p99
+    number into a resolvable span chain."""
 
-    __slots__ = ("buckets", "count", "sum")
+    __slots__ = ("buckets", "count", "sum", "exemplars")
 
     def __init__(self):
         self.buckets: dict[int, int] = {}
         self.count = 0
         self.sum = 0.0
+        self.exemplars: dict[int, dict] | None = None
 
 
 def _trace():
@@ -228,12 +241,43 @@ def bucket_of(value: float) -> int:
     return v.bit_length() if v >= 1 else 0
 
 
-def observe(name: str, value: float, **labels) -> None:
-    """Record one histogram observation in fixed log2 buckets."""
+#: (raw env string, parsed flag) — one parse per distinct value, the
+#: trace._SAMPLE_CACHE pattern: the flag is consulted per exemplar-
+#: carrying observation on the hot path.
+_EXEMPLAR_CACHE: tuple[str, bool] = ("\0unset", True)
+
+
+def exemplars_enabled() -> bool:
+    """Exemplar retention is on by default (bounded: ``_EXEMPLAR_MAX``
+    per series); ``OT_EXEMPLARS=0`` disables it."""
+    global _EXEMPLAR_CACHE
+    raw = os.environ.get("OT_EXEMPLARS", "1")
+    cached_raw, cached = _EXEMPLAR_CACHE
+    if raw == cached_raw:
+        return cached
+    on = str(raw).lower() not in ("0", "off", "false")
+    _EXEMPLAR_CACHE = (raw, on)
+    return on
+
+
+def observe(name: str, value: float, exemplar: dict | None = None,
+            **labels) -> None:
+    """Record one histogram observation in fixed log2 buckets.
+
+    ``exemplar`` (optional, a small dict — span id, trace/run id, the
+    closed lane/rung/engine/mode attrs) is retained iff this
+    observation is the MAX seen in its bucket: the hot path stays one
+    dict update, and the histogram's high buckets each remember the one
+    concrete request that defined them (rendered by ``obs.report``'s
+    slowest-exemplars table and emitted on ``/metrics`` in OpenMetrics
+    exemplar syntax)."""
     global _DROPPED
     try:
         b = bucket_of(value)
         key = _key(name, labels)
+        # Resolved OUTSIDE the lock (and cached): the flag gate must
+        # not put an environ read inside the registry's hot section.
+        keep_ex = exemplar is not None and exemplars_enabled()
         with _LOCK:
             if not _admit_locked(_HISTS, key):
                 _DROPPED += 1
@@ -244,6 +288,16 @@ def observe(name: str, value: float, **labels) -> None:
             h.buckets[b] = h.buckets.get(b, 0) + 1
             h.count += 1
             h.sum += float(value)
+            if keep_ex:
+                ex = h.exemplars
+                if ex is None:
+                    ex = h.exemplars = {}
+                cur = ex.get(b)
+                if cur is None or float(value) >= cur["v"]:
+                    ex[b] = {"v": float(value),
+                             "ts": time.time_ns() // 1000, **exemplar}
+                    while len(ex) > _EXEMPLAR_MAX:
+                        del ex[min(ex)]  # highest buckets win the cap
     except Exception:  # noqa: BLE001 - never-raises contract
         _DROPPED += 1
 
@@ -324,10 +378,8 @@ def snapshot() -> dict:
     with _LOCK:
         counts = {flat_name(n, l): v for (n, l), v in _COUNTS.items()}
         gauges = {flat_name(n, l): v for (n, l), v in _GAUGES.items()}
-        hists = {flat_name(n, l): {
-            "buckets": {str(b): c for b, c in sorted(h.buckets.items())},
-            "count": h.count, "sum": round(h.sum, 3)}
-            for (n, l), h in _HISTS.items()}
+        hists = {flat_name(n, l): _hist_doc(h)
+                 for (n, l), h in _HISTS.items()}
     out: dict = {"counters": dict(sorted(counts.items())),
                  "gauges": dict(sorted(gauges.items())),
                  "hists": dict(sorted(hists.items()))}
@@ -336,16 +388,26 @@ def snapshot() -> dict:
     return out
 
 
+def _hist_doc(h: "_Hist") -> dict:
+    """One histogram series as its JSON-clean snapshot value (buckets +
+    exact count/sum, plus the retained exemplars when any — the
+    run-dir half of the exemplar story: ``obs.report`` resolves them
+    against the trace stream post-hoc)."""
+    doc = {"buckets": {str(b): c for b, c in sorted(h.buckets.items())},
+           "count": h.count, "sum": round(h.sum, 3)}
+    if h.exemplars:
+        doc["exemplars"] = {str(b): dict(e)
+                            for b, e in sorted(h.exemplars.items())}
+    return doc
+
+
 def _snapshot_rec(ts_us: int) -> dict:
     """One structured snapshot line for the metrics JSONL (lists of
     [name, {labels}, value] — the schema ``obs.export`` validates)."""
     with _LOCK:
         counters = [[n, dict(l), v] for (n, l), v in sorted(_COUNTS.items())]
         gauges = [[n, dict(l), v] for (n, l), v in sorted(_GAUGES.items())]
-        hists = [[n, dict(l),
-                  {"buckets": {str(b): c
-                               for b, c in sorted(h.buckets.items())},
-                   "count": h.count, "sum": round(h.sum, 3)}]
+        hists = [[n, dict(l), _hist_doc(h)]
                  for (n, l), h in sorted(_HISTS.items())]
     rec = {"ts": ts_us, "counters": counters, "gauges": gauges,
            "hists": hists}
@@ -517,6 +579,10 @@ def _prom_name(name: str) -> str:
     return "".join(c if c.isalnum() or c == "_" else "_" for c in name)
 
 
+#: Exemplar attr keys -> their OpenMetrics label names.
+_EXEMPLAR_LABEL = {"span": "span_id", "trace": "trace_id"}
+
+
 def _prom_num(v: float) -> str:
     """Full-precision sample rendering. ``%g`` would quantize to 6
     significant digits — a byte counter in the hundreds of MB could
@@ -539,18 +605,26 @@ def _prom_labels(labels, extra: str = "") -> str:
     return "{" + ",".join(parts) + "}" if parts else ""
 
 
-def render_prometheus() -> str:
+def render_prometheus(exemplars: bool = False) -> str:
     """The registry in Prometheus exposition text format (v0.0.4).
 
     Counters render as ``<name>_total``, gauges raw, histograms as
     cumulative ``_bucket{le=...}`` series over the log2 bounds plus
-    ``_sum``/``_count`` — directly scrapeable, no client library."""
+    ``_sum``/``_count`` — directly scrapeable, no client library.
+
+    ``exemplars=True`` appends each bucket's retained tail exemplar in
+    OpenMetrics exemplar syntax — legal ONLY in the OpenMetrics
+    format, so the status endpoint sets it iff the scraper negotiated
+    ``application/openmetrics-text`` (a classic 0.0.4 parser rejects
+    the ``#`` tail, and a default scrape must never lose every serve
+    metric to a parse error)."""
     lines: list[str] = []
     with _LOCK:
         counts = sorted(_COUNTS.items())
         gauges = sorted(_GAUGES.items())
         hists = sorted((k, {"buckets": dict(h.buckets),
-                            "count": h.count, "sum": h.sum})
+                            "count": h.count, "sum": h.sum,
+                            "exemplars": dict(h.exemplars or {})})
                        for k, h in _HISTS.items())
     seen: set[str] = set()
     for (name, labels), v in counts:
@@ -574,7 +648,21 @@ def render_prometheus() -> str:
         for b, c in sorted(h["buckets"].items()):
             cum += c
             le = 'le="%d"' % (1 << b if b else 1)
-            lines.append(f"{pn}_bucket{_prom_labels(labels, le)} {cum}")
+            # The bucket's retained exemplar rides in OpenMetrics
+            # exemplar syntax (`# {labels} value timestamp-seconds`):
+            # the one concrete tail request behind this bucket,
+            # scrape-side resolvable to its span chain.
+            ex = h.get("exemplars", {}).get(b) if exemplars else None
+            tail = ""
+            if ex:
+                exl = ",".join(
+                    f'{_prom_name(_EXEMPLAR_LABEL.get(k, k))}="{v}"'
+                    for k, v in sorted(ex.items())
+                    if k not in ("v", "ts"))
+                tail = (f" # {{{exl}}} {_prom_num(ex['v'])} "
+                        f"{ex.get('ts', 0) / 1e6:.6f}")
+            lines.append(
+                f"{pn}_bucket{_prom_labels(labels, le)} {cum}{tail}")
         inf = _prom_labels(labels, 'le="+Inf"')
         lines.append(f"{pn}_bucket{inf} {h['count']}")
         sum_s = _prom_num(h['sum'])

@@ -1,0 +1,621 @@
+"""Windowed device profiling: the serve stack's ONE capture seam.
+
+PR 14's roofline can say *which* engine x rung underperforms; this
+module answers *why inside the window* — a bounded capture armable
+while the service runs, landing evidence in the same OT_TRACE_DIR run
+layout every other obs artifact uses. Three arming paths share this one
+implementation (the dedup satellite: ``harness.bench --profile`` and
+``scripts/profile_ctr.py --capture`` route here too, so there is no
+second capture stack to drift):
+
+* ``serve.bench --profile-window <start_s>:<dur_s>`` — the CLI window;
+* ``GET /profilez?seconds=N`` on the status endpoint (serve/status.py;
+  the router FEDERATES it per backend, route/status.py) — the live
+  operator window. Overlapping captures are refused with 409: two
+  interleaved ``jax.profiler`` sessions corrupt each other, and two
+  interleaved delta windows would misattribute each other's traffic;
+* the incident flight recorder (``OT_PROFILE_ON_INCIDENT=<seconds>``,
+  obs/incident.py) — an SLO breach / watchdog kill arms one capture per
+  incident cooldown, so the evidence window covers the aftermath
+  without a capture storm.
+
+Two capture tiers, resolved per window:
+
+* **jax** — ``jax.profiler.start_trace`` into a per-window directory
+  beside the summary (TensorBoard/Perfetto-loadable XLA + host trace:
+  the kernel-internal view the pipelined-AES paper's round-stage
+  analysis needs). Tried first unless ``OT_PROFILE_TIER=stack``.
+* **stack** — the native/CPU fallback: a sampler thread walks
+  ``watchdog.current_stacks()`` (the SAME all-thread frame machinery
+  the watchdog's expiry dump uses) at ``OT_PROFILE_HZ`` and aggregates
+  stack signatures, so a host-tier server profiles too.
+
+Whatever the tier, every window also snapshots the metrics registry at
+open and close and summarises the DELTA: per-(engine, mode, rung, nr)
+dispatches and device time (the per-rung kernel wall), per-stage
+count/time, and the busy-vs-device split (transfer+host vs compute).
+The summary lands as ``profile-<pid>-<tok>-<n>.json`` in the run dir;
+``obs.report --profile`` joins it against the run dir's ``cost-*.json``
+records (``crosscheck``) so modeled utilization gets a measured
+in-window cross-check, and ``serve.bench`` stamps the same join into
+the artifact's ``profile`` section.
+
+Constitution: never wedges the caller (capture start/stop failures
+degrade tiers or drop the window, counted), one window at a time
+(``CaptureBusy``), and a window open at drain/exit still closes cleanly
+(``finish``/atexit) so its summary is never lost.
+"""
+
+from __future__ import annotations
+
+import atexit
+import glob
+import json
+import os
+import threading
+import time
+import uuid
+
+from . import costmodel, metrics, trace
+
+KIND = "ot-profile"
+VERSION = 1
+
+#: Summary schema (``validate_summary`` — what ``obs.report --profile
+#: --check`` and the CI mid-drive curl gate).
+REQUIRED_KEYS = ("kind", "v", "run", "pid", "t0_us", "t1_us", "seconds",
+                 "tier", "armed_by", "rungs", "stages")
+TIERS = ("jax", "stack")
+#: The closed arming vocabulary (who opened the window).
+ARMED_BY = ("cli", "http", "incident", "sweep", "api")
+
+
+class CaptureBusy(RuntimeError):
+    """A capture window is already open (one at a time; /profilez
+    answers 409)."""
+
+
+class CaptureDisabled(RuntimeError):
+    """Tracing is off: there is no run layout for artifacts to land in
+    (/profilez answers 503)."""
+
+
+_LOCK = threading.Lock()
+_ACTIVE: dict | None = None
+#: Closes IN FLIGHT: _ACTIVE clears at the instant the window closes
+#: (so a new window can arm), but the close work — jax flush, summary
+#: write — may still be running; wait_idle()/finish() wait this out so
+#: a caller never reads last_summary()/the run dir mid-close.
+_CLOSING = 0
+_SEQ = 0
+_PROC = uuid.uuid4().hex[:8]
+_LAST: dict | None = None
+_DROPPED = 0
+_ATEXIT = False
+
+
+def sample_hz() -> float:
+    """Stack-tier sampling rate (``OT_PROFILE_HZ``, default 25)."""
+    try:
+        return min(max(float(os.environ.get("OT_PROFILE_HZ", 25) or 25),
+                       1.0), 200.0)
+    except ValueError:
+        return 25.0
+
+
+def tier_override() -> str | None:
+    v = str(os.environ.get("OT_PROFILE_TIER", "") or "").lower()
+    return v if v in TIERS else None
+
+
+def incident_seconds() -> float:
+    """``OT_PROFILE_ON_INCIDENT``: capture length armed by the incident
+    recorder (0/unset = off)."""
+    try:
+        return max(float(os.environ.get("OT_PROFILE_ON_INCIDENT", 0) or 0),
+                   0.0)
+    except ValueError:
+        return 0.0
+
+
+class _StackSampler(threading.Thread):
+    """The native-tier capture: periodic all-thread stack signatures,
+    aggregated in memory (bounded: at most ``_MAX_KEYS`` distinct
+    signatures; overflow folds into an ``"(other)"`` bucket)."""
+
+    _MAX_KEYS = 256
+
+    def __init__(self, hz: float):
+        super().__init__(daemon=True, name="ot-profile-sampler")
+        self._period = 1.0 / hz
+        # NOT named _stop: threading.Thread has a private _stop METHOD
+        # that join() calls — shadowing it with an Event breaks join.
+        self._halt = threading.Event()
+        self.samples = 0
+        self.counts: dict[str, int] = {}
+
+    def run(self) -> None:
+        from ..resilience import watchdog
+
+        me = threading.get_ident()
+        while not self._halt.is_set():
+            try:
+                for ident, (name, frames) in watchdog.current_stacks(
+                        depth=4).items():
+                    if ident == me:
+                        continue
+                    key = f"{name}: " + " < ".join(frames)
+                    if (key not in self.counts
+                            and len(self.counts) >= self._MAX_KEYS):
+                        key = "(other)"
+                    self.counts[key] = self.counts.get(key, 0) + 1
+                self.samples += 1
+            except Exception:  # noqa: BLE001 - sampling must never wedge
+                pass
+            self._halt.wait(self._period)
+
+    def stop(self) -> dict:
+        self._halt.set()
+        self.join(timeout=2.0)
+        return dict(self.counts)
+
+
+def _try_jax_start(capture_dir: str) -> bool:
+    """Start a jax.profiler trace; False on ANY failure (no jax, an
+    unsupported platform, a profiler already running elsewhere) — the
+    stack tier stands in."""
+    try:
+        import jax
+
+        os.makedirs(capture_dir, exist_ok=True)
+        jax.profiler.start_trace(capture_dir)
+        return True
+    except Exception:  # noqa: BLE001 - degrade to the stack tier
+        return False
+
+
+def _jax_stop() -> None:
+    try:
+        import jax
+
+        jax.profiler.stop_trace()
+    except Exception:  # noqa: BLE001 - a failed stop loses the capture,
+        pass           # never the summary
+
+
+def active() -> dict | None:
+    """The open window's public view (seq, tier, armed_by, t0_us), or
+    None — the /profilez 409 body."""
+    entry = _ACTIVE
+    if entry is None:
+        return None
+    return {"seq": entry["seq"], "tier": entry["tier"],
+            "armed_by": entry["armed_by"], "t0_us": entry["t0_us"],
+            "seconds": entry["seconds"]}
+
+
+def start_window(seconds: float | None = None, armed_by: str = "api",
+                 jax_dir: str | None = None) -> dict:
+    """Open ONE capture window.
+
+    ``seconds`` set: a closer thread ends the window after that long
+    (the bounded-window contract); None: the window stays open until
+    ``stop_window``/``finish`` (the sweep-capture shape). ``jax_dir``
+    overrides the jax tier's artifact directory (``harness.bench
+    --profile DIR`` keeps its operator-visible path) — and is the one
+    case allowed with tracing OFF: the jax artifact still lands in the
+    caller's dir, only the run-layout summary is skipped (there is no
+    run layout to put it in). Raises ``CaptureBusy`` when a window is
+    open and ``CaptureDisabled`` when tracing is off with no explicit
+    dir. Returns {seq, tier, path, jax_dir?}.
+    """
+    global _ACTIVE, _SEQ, _ATEXIT
+    if not trace.enabled() and jax_dir is None:
+        raise CaptureDisabled("profiling needs the run layout: set "
+                              "OT_TRACE_DIR")
+    with _LOCK:
+        if _ACTIVE is not None:
+            raise CaptureBusy(
+                f"capture {_ACTIVE['seq']} ({_ACTIVE['armed_by']}) is "
+                "already in progress")
+        d = None
+        if trace.enabled():
+            trace.ensure_run()
+            d = trace.run_dir()
+            os.makedirs(d, exist_ok=True)
+        _SEQ += 1
+        seq = _SEQ
+        stem = f"profile-{os.getpid()}-{_PROC}-{seq}"
+        entry = {
+            "seq": seq, "armed_by": str(armed_by),
+            "seconds": (float(seconds) if seconds else None),
+            "run": trace.run_id(), "dir": d,
+            "path": (os.path.join(d, stem + ".json") if d else None),
+            "sampler": None, "jax_dir": None,
+        }
+        capture_dir = jax_dir or os.path.join(d, stem + ".jaxtrace")
+        forced = tier_override()
+        if forced != "stack" and _try_jax_start(capture_dir):
+            entry["tier"] = "jax"
+            entry["jax_dir"] = capture_dir
+        else:
+            sampler = _StackSampler(sample_hz())
+            sampler.start()
+            entry["tier"] = "stack"
+            entry["sampler"] = sampler
+        # t0 and the opening snapshot are stamped AFTER the capture
+        # backend is live: jax.profiler's first start_trace pays a
+        # seconds-scale one-time init, and that setup is neither
+        # captured time nor captured traffic.
+        entry["t0_us"] = trace.now_us()
+        entry["t0_mono"] = time.monotonic()
+        entry["before"] = metrics.snapshot()
+        _ACTIVE = entry
+        if not _ATEXIT:
+            _ATEXIT = True
+            atexit.register(finish)
+    trace.point("profile-window", seq=seq, armed_by=str(armed_by),
+                tier=entry["tier"], seconds=entry["seconds"])
+    if seconds:
+        threading.Thread(target=_close_after, args=(seconds, seq),
+                         daemon=True, name="ot-profile-close").start()
+    out = {"seq": seq, "tier": entry["tier"], "path": entry["path"]}
+    if entry["jax_dir"]:
+        out["jax_dir"] = entry["jax_dir"]
+    return out
+
+
+def _close_after(seconds: float, seq: int) -> None:
+    time.sleep(max(seconds, 0.0))
+    stop_window(expected_seq=seq)
+
+
+def stop_window(expected_seq: int | None = None) -> str | None:
+    """Close the open window and write its summary; returns the summary
+    path (None when no window is open, or — with ``expected_seq`` — when
+    the open window is a DIFFERENT one: the closer thread of a window
+    already ended early by drain must not close its successor)."""
+    global _ACTIVE, _CLOSING, _LAST, _DROPPED
+    with _LOCK:
+        entry = _ACTIVE
+        if entry is None or (expected_seq is not None
+                             and entry["seq"] != expected_seq):
+            return None
+        _ACTIVE = None
+        _CLOSING += 1
+    try:
+        # The window CLOSES here: t1/seconds (and the closing metrics
+        # snapshot) are stamped before the capture backend is stopped —
+        # jax.profiler.stop_trace may spend seconds flushing its
+        # artifact, and that flush is neither captured time nor
+        # captured traffic.
+        entry["t1_us"] = trace.now_us()
+        entry["measured_s"] = round(
+            time.monotonic() - entry["t0_mono"], 3)
+        after = metrics.snapshot()
+        stacks: dict = {}
+        samples = 0
+        if entry["tier"] == "jax":
+            _jax_stop()
+        elif entry["sampler"] is not None:
+            stacks = entry["sampler"].stop()
+            samples = entry["sampler"].samples
+        if entry["path"] is None:
+            return None  # explicit-dir capture with tracing off: the
+            #              jax artifact is the whole product
+        try:
+            doc = _summarise(entry, after, stacks, samples)
+            with open(entry["path"], "w", encoding="utf-8") as fh:
+                json.dump(doc, fh, separators=(",", ":"),
+                          sort_keys=True)
+                fh.write("\n")
+            _LAST = doc
+            trace.point("profile-captured", seq=entry["seq"],
+                        tier=entry["tier"],
+                        file=os.path.basename(entry["path"]))
+            metrics.counter("profile_captures", kind=entry["tier"])
+            return entry["path"]
+        except Exception:  # noqa: BLE001 - a lost summary must not take
+            _DROPPED += 1  # the serve loop (or atexit) down with it
+            return None
+    finally:
+        with _LOCK:
+            _CLOSING -= 1
+
+
+def _hist_deltas(before: dict, after: dict, names: tuple) -> dict:
+    """stage -> {count, sum_us} deltas for the stage histograms."""
+    out: dict[str, dict] = {}
+    for name in names:
+        for key, h1 in after.get("hists", {}).items():
+            if not key.startswith(name + "{"):
+                continue
+            stage = None
+            for part in key[len(name) + 1:-1].split(","):
+                k, _, v = part.partition("=")
+                if k == "stage":
+                    stage = v
+            if stage is None:
+                continue
+            h0 = before.get("hists", {}).get(key, {})
+            dc = int(h1.get("count", 0)) - int(h0.get("count", 0))
+            ds = float(h1.get("sum", 0.0)) - float(h0.get("sum", 0.0))
+            if dc <= 0:
+                continue
+            agg = out.setdefault(stage, {"count": 0, "sum_us": 0.0})
+            agg["count"] += dc
+            agg["sum_us"] = round(agg["sum_us"] + ds, 1)
+    return out
+
+
+def _counter_delta(before: dict, after: dict, name: str) -> float:
+    tot = 0.0
+    for key, v in after.get("counters", {}).items():
+        if key == name or key.startswith(name + "{"):
+            tot += v - before.get("counters", {}).get(key, 0.0)
+    return tot
+
+
+def _summarise(entry: dict, after: dict, stacks: dict,
+               samples: int) -> dict:
+    before = entry["before"]
+    disp0 = costmodel.series_by_key(before.get("counters", {}),
+                                    "serve_rung_dispatches")
+    disp1 = costmodel.series_by_key(after.get("counters", {}),
+                                    "serve_rung_dispatches")
+    dev0 = costmodel.series_by_key(before.get("counters", {}),
+                                   "serve_rung_device_us")
+    dev1 = costmodel.series_by_key(after.get("counters", {}),
+                                   "serve_rung_device_us")
+    rungs = []
+    for key in sorted(disp1):
+        d = disp1[key] - disp0.get(key, 0.0)
+        if d <= 0:
+            continue
+        rungs.append({
+            "engine": key[0], "mode": key[1], "rung": key[2],
+            "nr": key[3], "dispatches": int(d),
+            "device_us": int(dev1.get(key, 0.0) - dev0.get(key, 0.0)),
+        })
+    busy_us = _counter_delta(before, after, "serve_lane_busy_us")
+    device_us = _counter_delta(before, after, "serve_device_us")
+    doc = {
+        "kind": KIND, "v": VERSION, "run": entry["run"],
+        "pid": os.getpid(), "proc": _PROC, "seq": entry["seq"],
+        "t0_us": entry["t0_us"],
+        "t1_us": entry.get("t1_us", trace.now_us()),
+        "seconds": entry.get("measured_s",
+                             round(time.monotonic() - entry["t0_mono"],
+                                   3)),
+        "armed_by": entry["armed_by"], "tier": entry["tier"],
+        "rungs": rungs,
+        "stages": _hist_deltas(before, after,
+                               ("serve_stage_us", "route_stage_us")),
+        # The transfer-vs-compute split over the window: lane busy wall
+        # vs the device/engine-compute share of it.
+        "busy_us": int(busy_us),
+        "device_us": int(device_us),
+        "host_us": int(max(busy_us - device_us, 0.0)),
+    }
+    if entry["jax_dir"]:
+        doc["jax_dir"] = os.path.basename(entry["jax_dir"])
+    if stacks:
+        top = sorted(stacks.items(), key=lambda kv: -kv[1])[:20]
+        doc["samples"] = samples
+        doc["stacks"] = [{"frames": k, "count": c} for k, c in top]
+    return doc
+
+
+def finish(timeout_s: float = 5.0) -> str | None:
+    """Close any open window NOW (drain/exit path) and wait for a
+    closer already mid-close. Returns the summary path when this call
+    did the closing."""
+    path = stop_window()
+    deadline = time.monotonic() + timeout_s
+    while ((_ACTIVE is not None or _CLOSING)
+           and time.monotonic() < deadline):
+        time.sleep(0.01)
+    return path
+
+
+def wait_idle(timeout_s: float = 10.0) -> bool:
+    """True once no window is open AND no close is in flight (the
+    bench's pre-artifact barrier: a CLI window still capturing at
+    drive end closes via its own closer; this waits out both the
+    window and its summary write)."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if _ACTIVE is None and not _CLOSING:
+            return True
+        time.sleep(0.02)
+    return _ACTIVE is None and not _CLOSING
+
+
+class _SweepCapture:
+    """Context manager for whole-run captures (``harness.bench
+    --profile``, ``scripts/profile_ctr.py --capture``): opens an
+    unbounded window on enter, closes it on exit. Start failures
+    (window busy, tracing off) degrade to a no-op — a profile flag must
+    never fail the sweep it observes."""
+
+    def __init__(self, jax_dir: str | None = None,
+                 armed_by: str = "sweep"):
+        self._jax_dir = jax_dir
+        self._armed_by = armed_by
+        self._seq: int | None = None
+
+    def __enter__(self):
+        try:
+            self._seq = start_window(None, armed_by=self._armed_by,
+                                     jax_dir=self._jax_dir)["seq"]
+        except (CaptureBusy, CaptureDisabled):
+            self._seq = None
+        return self
+
+    def __exit__(self, *exc):
+        if self._seq is not None:
+            stop_window(expected_seq=self._seq)
+        return False
+
+
+def sweep_capture(jax_dir: str | None = None,
+                  armed_by: str = "sweep") -> _SweepCapture:
+    return _SweepCapture(jax_dir, armed_by)
+
+
+def last_summary() -> dict | None:
+    return _LAST
+
+
+def profilez(seconds: float) -> tuple[int, dict]:
+    """The /profilez body: (HTTP status, JSON doc). 200 = armed, 409 =
+    a window is open, 503 = tracing off (no run layout)."""
+    try:
+        secs = min(max(float(seconds), 0.05), 120.0)
+    except (TypeError, ValueError):
+        secs = 1.0
+    try:
+        out = start_window(secs, armed_by="http")
+    except CaptureBusy as e:
+        return 409, {"error": str(e), "active": active()}
+    except CaptureDisabled as e:
+        return 503, {"error": str(e)}
+    return 200, {"armed": True, "seconds": secs, **out}
+
+
+def on_incident(reason: str) -> None:
+    """The incident recorder's arming hook (called AFTER a bundle
+    dumps, so the trigger cooldown — one bundle per incident — is also
+    the capture cooldown): arm one window of OT_PROFILE_ON_INCIDENT
+    seconds; a window already open or any failure is silently fine —
+    an incident capture must never create a second incident. Arming
+    happens on a short-lived daemon thread: trigger() fires from the
+    serve event loop's thread, and the capture backend's startup cost
+    (jax.profiler init) must not stall the loop mid-incident."""
+    secs = incident_seconds()
+    if not secs:
+        return
+
+    def _arm():
+        try:
+            start_window(secs, armed_by="incident")
+        except Exception:  # noqa: BLE001 - never-raises on this path
+            pass
+
+    threading.Thread(target=_arm, daemon=True,
+                     name="ot-profile-incident").start()
+
+
+# ---------------------------------------------------------------------------
+# Reading summaries (report --profile, the CI mid-drive gate).
+# ---------------------------------------------------------------------------
+
+
+def list_summaries(run_dir: str) -> list[str]:
+    """Summary paths in one run dir, capture order (pid-token-seq
+    naming orders within a process; mtime breaks ties across)."""
+    paths = [p for p in glob.glob(os.path.join(run_dir, "profile-*.json"))
+             if os.path.isfile(p)]
+
+    def _key(p):
+        try:
+            return (os.path.getmtime(p), p)
+        except OSError:
+            return (0.0, p)
+
+    return sorted(paths, key=_key)
+
+
+def load_summary(path: str) -> dict | None:
+    try:
+        with open(path, encoding="utf-8") as fh:
+            doc = json.load(fh)
+        return doc if isinstance(doc, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+def validate_summary(doc: dict | None) -> list[str]:
+    """Schema violations as human-readable strings (empty = valid)."""
+    if not isinstance(doc, dict):
+        return ["summary is not a JSON object"]
+    out = []
+    for k in REQUIRED_KEYS:
+        if k not in doc:
+            out.append(f"missing required key {k!r}")
+    if doc.get("kind") != KIND:
+        out.append(f"kind is {doc.get('kind')!r}, want {KIND!r}")
+    if doc.get("tier") not in TIERS:
+        out.append(f"tier {doc.get('tier')!r} outside {TIERS}")
+    if doc.get("armed_by") not in ARMED_BY:
+        out.append(f"armed_by {doc.get('armed_by')!r} outside {ARMED_BY}")
+    rungs = doc.get("rungs")
+    if not isinstance(rungs, list):
+        out.append("rungs is not a list")
+    else:
+        for i, r in enumerate(rungs):
+            if not isinstance(r, dict) or not {
+                    "engine", "mode", "rung", "dispatches",
+                    "device_us"} <= set(r):
+                out.append(f"rungs[{i}] malformed")
+    if not isinstance(doc.get("stages"), dict):
+        out.append("stages is not an object")
+    return out
+
+
+def crosscheck(doc: dict, records, ceiling_gbps: float | None) -> dict:
+    """The measured-vs-modeled join for one capture window: per rung,
+    modeled HBM bytes (obs/costmodel.py) x in-window dispatches over
+    in-window device time -> achieved GB/s moved inside the window,
+    with utilization against the ceiling — the cross-check that says
+    whether the roofline's modeled utilization holds when you actually
+    look."""
+    by_key = {}
+    for rec in records or ():
+        key = (rec.get("engine"), rec.get("mode"), int(rec.get("rung", 0)),
+               int(rec.get("nr", 0)))
+        by_key.setdefault(key, rec)
+    rows = []
+    for r in doc.get("rungs", []):
+        key = (r.get("engine"), r.get("mode"), int(r.get("rung", 0)),
+               int(r.get("nr", 0)))
+        rec = by_key.get(key)
+        dus = int(r.get("device_us", 0))
+        row = {"engine": key[0], "mode": key[1], "rung": key[2],
+               "nr": key[3], "dispatches": int(r.get("dispatches", 0)),
+               "device_s": round(dus / 1e6, 6),
+               "modeled_dispatch_bytes": (int(rec["hbm_bytes"])
+                                          if rec else None)}
+        if rec and dus > 0:
+            gbps = (float(rec["hbm_bytes"]) * row["dispatches"]
+                    / 1e9 / (dus / 1e6))
+            row["window_gbps"] = round(gbps, 6)
+            row["utilization"] = (round(gbps / ceiling_gbps, 6)
+                                  if ceiling_gbps else None)
+        else:
+            row["window_gbps"] = None
+            row["utilization"] = None
+        rows.append(row)
+    return {"ceiling_gbps": ceiling_gbps, "rows": rows}
+
+
+def dropped() -> int:
+    return _DROPPED
+
+
+def reset_for_tests() -> None:
+    """Close any open window and clear the last summary. ``_SEQ`` is
+    deliberately NOT reset: a bounded window abandoned here may still
+    have its closer thread sleeping, and a later window reusing its
+    seq would match that stale closer's ``expected_seq`` and be closed
+    mid-capture — monotonic seqs are what make stale closers inert."""
+    global _ACTIVE, _LAST, _DROPPED
+    entry = _ACTIVE
+    if entry is not None:
+        if entry["tier"] == "jax":
+            _jax_stop()
+        elif entry["sampler"] is not None:
+            entry["sampler"].stop()
+    _ACTIVE = None
+    _LAST = None
+    _DROPPED = 0

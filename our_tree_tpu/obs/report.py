@@ -56,7 +56,7 @@ import os
 import re
 import sys
 
-from . import costmodel, export, incident
+from . import costmodel, export, incident, profiler
 from . import metrics as _metrics
 
 #: Span names that count as device-seam time in the per-unit table
@@ -74,6 +74,44 @@ ATTEMPT_SPANS = ("unit-attempt", "unit")
 #: (obs/metrics.py; route/proxy.py _build_ledger and serve/server.py
 #: produce it, route.bench's completeness gate consumes the same tuple).
 WATERFALL_STAGES = _metrics.WATERFALL_STAGES
+
+
+def exemplar_rows(run: export.Run, top: int = 10) -> list[dict]:
+    """The slowest-exemplars rows: every tail exemplar the registry
+    retained (obs/metrics.py, riding the metrics snapshots), ranked by
+    value, each resolved against the trace stream — ``chain`` is the
+    exemplar span's ancestor path and ``complete`` whether it reaches a
+    root with no missing link. This is the exemplar -> trace
+    walk-through as data: a p99 bucket's number becomes one concrete
+    request's full span chain (the acceptance gate: rendered rows must
+    all resolve on a sampled run)."""
+    rows: list[dict] = []
+    if not run.snapshots:
+        return rows
+    for key, h in run.metrics_totals()["hists"].items():
+        for b, e in (h.get("exemplars") or {}).items():
+            if not isinstance(e, dict):
+                continue
+            rows.append({"hist": key, "bucket": int(b),
+                         "v": float(e.get("v", 0.0)),
+                         "span": e.get("span"), "attrs": e})
+    rows.sort(key=lambda r: (-r["v"], r["hist"]))
+    rows = rows[:top]
+    for r in rows:
+        chain: list[str] = []
+        complete = False
+        seen: set[str] = set()
+        sp = run.spans.get(r["span"]) if r["span"] else None
+        while sp is not None and sp.id not in seen:
+            seen.add(sp.id)
+            chain.append(sp.name)
+            if not sp.parent:
+                complete = True  # reached a root: the chain is whole
+                break
+            sp = run.spans.get(sp.parent)
+        r["chain"] = chain
+        r["complete"] = complete
+    return rows
 
 
 def fleet_join_stats(run: export.Run) -> dict:
@@ -508,6 +546,23 @@ def render(run: export.Run, top: int = 10, out=sys.stdout,
             _table(rows, ["stage", "count", "p50", "p95", "p99", "mean"],
                    out)
 
+    # -- slowest exemplars (histogram tails -> span chains) ----------------
+    # The registry's retained tail exemplars (obs/metrics.py), ranked
+    # by value and resolved against the trace: the table that turns "a
+    # p99 bucket exists" into "THIS request, THIS chain". A row whose
+    # chain breaks (span or an ancestor missing from the stream) says
+    # so — `--profile --check` gates that none do on a sampled run.
+    ex_rows = exemplar_rows(run, top=top)
+    if ex_rows:
+        out.write("\nslowest exemplars (histogram tails -> span "
+                  "chains):\n")
+        _table([[r["hist"], f"{r['v']:.0f}", str(r["span"] or "-"),
+                 (" < ".join(r["chain"]) if r["chain"] else "-"),
+                 ("complete" if r["complete"] else "BROKEN")]
+                for r in ex_rows],
+               ["histogram", "value_us", "span", "chain", "resolve"],
+               out)
+
     # -- the roofline (cost model x measured device time) ------------------
     # The run dir's cost-*.json records (obs/costmodel.py, stamped at
     # serve warmup) joined with the registry's per-rung dispatch/device
@@ -730,6 +785,64 @@ def render_incidents(run_dir: str, check: bool = False,
     return 0
 
 
+def render_profile(run_dir: str, check: bool = False, out=None) -> int:
+    """The ``--profile`` section: every capture summary in the run dir
+    (obs/profiler.py) — window span, tier, the per-rung kernel wall —
+    JOINED against the run dir's cost records (``profiler.crosscheck``)
+    so modeled utilization gets its measured in-window cross-check,
+    plus the stack-tier hot frames when that tier captured. With
+    ``check``: exit 2 on schema-invalid summaries or when NO capture
+    exists (the CI mid-drive curl gates that the armed window actually
+    landed its artifact)."""
+    out = out if out is not None else sys.stdout  # bound at CALL time
+    paths = profiler.list_summaries(run_dir)
+    if not paths:
+        out.write(f"no profile captures under {run_dir}\n")
+        if check:
+            print("CHECK FAILED: --profile expected at least one "
+                  "capture summary in the run dir", file=sys.stderr)
+            return 2
+        return 0
+    cost_recs, ceiling = costmodel.load_run_records(run_dir)
+    bad = 0
+    for path in paths:
+        doc = profiler.load_summary(path)
+        viols = profiler.validate_summary(doc)
+        d = doc or {}
+        out.write(
+            f"profile {os.path.basename(path)}: "
+            f"tier={d.get('tier')} armed_by={d.get('armed_by')} "
+            f"window={d.get('seconds')}s pid={d.get('pid')} "
+            f"device {d.get('device_us', 0) / 1e6:.3f}s / busy "
+            f"{d.get('busy_us', 0) / 1e6:.3f}s in-window"
+            + (" SCHEMA-INVALID" if viols else "") + "\n")
+        if d.get("jax_dir"):
+            out.write(f"  jax trace: {d['jax_dir']} (TensorBoard / "
+                      "ui.perfetto.dev loadable)\n")
+        cc = profiler.crosscheck(d, cost_recs, ceiling)
+        if cc["rows"]:
+            _table([[r["engine"], r["mode"], str(r["rung"]),
+                     str(r["dispatches"]), f"{r['device_s']:.3f}",
+                     (f"{r['window_gbps']:.3f}"
+                      if r["window_gbps"] is not None else "-"),
+                     (f"{r['utilization']:.1%}"
+                      if r["utilization"] is not None else "-")]
+                    for r in cc["rows"]],
+                   ["engine", "mode", "rung", "disp", "device_s",
+                    "GB/s moved", "util"], out)
+        for st in (d.get("stacks") or [])[:5]:
+            out.write(f"  stack x{st.get('count')}: "
+                      f"{st.get('frames')}\n")
+        for v in viols:
+            out.write(f"  ! {v}\n")
+            bad += 1
+    if check and bad:
+        print(f"CHECK FAILED: {bad} profile-summary schema "
+              "violation(s)", file=sys.stderr)
+        return 2
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="reconstruct a traced run (our_tree_tpu.obs)")
@@ -752,6 +865,16 @@ def main(argv=None) -> int:
                          "report; with --check, exit 2 unless every "
                          "bundle is schema-valid (orphan/violation "
                          "gating stays with the plain report run)")
+    ap.add_argument("--profile", action="store_true",
+                    help="PROFILE mode: render the run dir's capture "
+                         "summaries (profile-*.json, obs/profiler.py) "
+                         "joined against its cost records — per-rung "
+                         "in-window kernel wall vs modeled traffic — "
+                         "after the trace report; with --check, exit 2 "
+                         "unless at least one capture exists, every "
+                         "summary is schema-valid, AND every rendered "
+                         "slowest-exemplar row resolves to a complete "
+                         "span chain")
     ap.add_argument("--trace-json", default=None, metavar="PATH",
                     help="also write the Chrome/Perfetto trace.json "
                          "(clock-aligned across processes when wire-skew "
@@ -782,6 +905,19 @@ def main(argv=None) -> int:
             expected[tok] = expected.get(tok, 0) + 1
     render(run, top=args.top, expected_orphans=expected,
            run_dir=run_dir)
+    if args.profile:
+        rc = render_profile(run_dir, check=args.check)
+        if rc:
+            return rc
+        if args.check:
+            broken = [r for r in exemplar_rows(run, top=args.top)
+                      if not r["complete"]]
+            if broken:
+                print(f"CHECK FAILED: {len(broken)} slowest-exemplar "
+                      "row(s) do not resolve to a complete span chain: "
+                      + ", ".join(f"{r['hist']}->{r['span']}"
+                                  for r in broken), file=sys.stderr)
+                return 2
     if args.trace_json:
         path = export.write_chrome_trace(run, args.trace_json)
         print(f"# perfetto export: {path} "

@@ -108,14 +108,19 @@ def extract(doc: dict) -> dict:
             for name, v in stages.items()}
     # The cost-section roofline rows (artifact "cost": {"rows": [...]},
     # obs/costmodel.py): achieved GB/s moved per engine x mode x rung —
-    # the utilization-regression gate's surface.
+    # the utilization-regression gate's surface. Explicit dispatches=0
+    # rows (a warmed rung the traffic skipped — present since ot-scope
+    # so trend diffs never read omission as coverage) are NOT gate
+    # material: "no traffic at this rung this run" must gate nothing,
+    # exactly as the row's former absence did.
     cost = doc.get("cost")
     if isinstance(cost, dict) and isinstance(cost.get("rows"), list):
         out["cost"] = {
             f"{r.get('engine')}|{r.get('mode')}|r{r.get('rung')}"
             f"|nr{r.get('nr', 0)}":
                 float(r.get("achieved_gbps", 0.0))
-            for r in cost["rows"] if isinstance(r, dict)}
+            for r in cost["rows"]
+            if isinstance(r, dict) and float(r.get("dispatches", 1)) > 0}
     return out
 
 

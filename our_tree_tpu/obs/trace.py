@@ -456,6 +456,13 @@ class _SpanCM:
         need no branch."""
         return self._span
 
+    @property
+    def span_id(self) -> str | None:
+        """The live span's id (None before enter / after exit) — what a
+        call site hands the metrics registry as a tail exemplar, so a
+        histogram's max bucket can name the span that filled it."""
+        return self._span.id if self._span is not None else None
+
 
 class _DeferredSpanCM:
     """An UNSAMPLED detached span: begin is captured, not written.
@@ -530,6 +537,13 @@ class _DeferredSpanCM:
         if attrs:
             self._end_attrs = {**(self._end_attrs or {}), **attrs}
 
+    @property
+    def span_id(self) -> str | None:
+        """None until force-materialised: an unsampled span has no id
+        on disk, so it contributes no exemplar (exemplars must resolve
+        to real span chains)."""
+        return self._span.id if self._span is not None else None
+
     def __exit__(self, exc_type, exc, tb):
         if self._done:
             return False
@@ -561,6 +575,10 @@ class _NullCM:
         return None
 
     def note(self, **attrs):
+        return None
+
+    @property
+    def span_id(self):
         return None
 
 

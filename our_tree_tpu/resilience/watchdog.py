@@ -136,6 +136,27 @@ def default_deadline_s() -> float:
         return 0.0
 
 
+def current_stacks(depth: int | None = None) -> dict:
+    """{thread ident: (name, [compact frame strings, leaf first])} — the
+    all-thread frame walk behind the expiry dump, shared with the
+    profiler's stack-sampling tier (obs/profiler.py): one machinery for
+    "where is every thread right now", whether the question is a hang's
+    post-mortem or a capture window's sample."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out: dict = {}
+    for ident, frame in sys._current_frames().items():
+        frames = []
+        f = frame
+        while f is not None and (depth is None or len(frames) < depth):
+            co = f.f_code
+            frames.append(f"{co.co_name} "
+                          f"({os.path.basename(co.co_filename)}:"
+                          f"{f.f_lineno})")
+            f = f.f_back
+        out[ident] = (names.get(ident, "?"), frames)
+    return out
+
+
 def dump_stacks(what: str, seconds: float) -> str | None:
     """Write every thread's current stack to a crash-report file.
 

@@ -206,13 +206,47 @@ class Backend:
             return None
         return body.decode("utf-8", "replace") if body is not None else None
 
+    async def poll_profilez(self, seconds: float,
+                            timeout_s: float | None = None) -> dict | None:
+        """GET /profilez?seconds=N off the backend's status port — the
+        federated capture arm (route/status.py): the backend itself
+        enforces the one-window rule (409) and the tracing requirement
+        (503); the router just relays. Returns {"code", "doc"} or None
+        when the backend is unreachable / has no status port. The
+        default relay deadline covers the backend's documented
+        seconds-scale jax-tier arming cost (first start_trace init) —
+        a 5 s gossip-style timeout would misreport an arming backend
+        as unreachable while its window opened anyway."""
+        if not self.spec.status_port:
+            return None
+        if timeout_s is None:
+            timeout_s = float(seconds) + 60.0
+        try:
+            code, body = await asyncio.wait_for(
+                self._get_status_raw(f"/profilez?seconds={seconds:g}"),
+                timeout=max(timeout_s, 0.001))
+        except Exception:  # noqa: BLE001 - unreachable IS the data point
+            return None
+        try:
+            doc = json.loads(body) if body else {}
+        except ValueError:
+            doc = {}
+        return {"code": code, "doc": doc if isinstance(doc, dict) else {}}
+
     async def _get_status(self, path: str) -> bytes | None:
         """One HTTP GET against the backend's status port (the gossip
-        and federation scrapes share it); None on a non-200. The
-        response is read to EOF (the endpoint answers Connection:
-        close), NOT with one read() — a /metrics body past one TCP
-        segment would otherwise come back truncated mid-line — with a
-        hard size cap so a misbehaving peer cannot balloon the router."""
+        and federation scrapes share it); None on a non-200."""
+        code, body = await self._get_status_raw(path)
+        return body if code == 200 else None
+
+    async def _get_status_raw(self, path: str) -> tuple[int, bytes]:
+        """The raw (status code, body) GET behind ``_get_status`` and
+        the profilez relay (which must distinguish 409/503 from
+        unreachable). The response is read to EOF (the endpoint answers
+        Connection: close), NOT with one read() — a /metrics body past
+        one TCP segment would otherwise come back truncated mid-line —
+        with a hard size cap so a misbehaving peer cannot balloon the
+        router."""
         reader, writer = await asyncio.open_connection(
             self.spec.host, self.spec.status_port)
         try:
@@ -234,9 +268,11 @@ class Backend:
             except Exception:  # noqa: BLE001 - peer already gone
                 pass
         head, _, body = raw.partition(b"\r\n\r\n")
-        if not head.startswith(b"HTTP/1.1 200"):
-            return None
-        return body
+        try:
+            code = int(head.split(None, 2)[1])
+        except (IndexError, ValueError):
+            code = 0
+        return code, body
 
     def stats(self) -> dict:
         return {
@@ -713,7 +749,11 @@ class Router:
                 t_first = t0
                 metrics.observe("route_stage_us",
                                 (t_first - t_admit) * 1e6,
-                                stage="router_queue")
+                                stage="router_queue",
+                                exemplar=({"span": ps,
+                                           "trace": trace.run_id(),
+                                           "backend": b.idx}
+                                          if ps else None))
             outcome = "ok"
             try:
                 faults.check_backend("backend_fail", b.idx, label)
@@ -793,7 +833,7 @@ class Router:
                 trace.counter("route_redispatch", backend=b.idx,
                               after=len(tried))
             ledger = self._build_ledger(sampled, rh, b.idx, t_admit,
-                                        t_first, t0, t_att_end)
+                                        t_first, t0, t_att_end, ps=ps)
             if rh.get("ok"):
                 self.routed_ok += 1
                 b.bytes_out += len(body)
@@ -819,7 +859,8 @@ class Router:
 
     def _build_ledger(self, sampled: bool, rh: dict, backend: int,
                       t_admit: float, t_first: float,
-                      t0: float, t_att_end: float) -> dict | None:
+                      t0: float, t_att_end: float,
+                      ps: str | None = None) -> dict | None:
         """The request's cross-process time-attribution ledger (µs),
         assembled at answer time for SAMPLED requests: the router's own
         stages — ``router_queue`` (admission -> first attempt),
@@ -848,10 +889,18 @@ class Router:
                 stages[str(name)] = int(v)
         else:
             stages["wire"] = att_wall
-        metrics.observe("route_stage_us", stages["wire"], stage="wire")
+        # The wire/retry stages carry a tail exemplar pointing at this
+        # request's route-request root span: the slowest wire crossing
+        # in the histogram resolves to one concrete request's full
+        # cross-process chain (the exemplar -> trace walk-through,
+        # docs/OBSERVABILITY.md).
+        ex = ({"span": ps, "trace": trace.run_id(), "backend": backend}
+              if ps else None)
+        metrics.observe("route_stage_us", stages["wire"], stage="wire",
+                        exemplar=ex)
         if stages["retry"]:
             metrics.observe("route_stage_us", stages["retry"],
-                            stage="retry")
+                            stage="retry", exemplar=ex)
         # total closes at the exchange end — the boundary the stages
         # cover. The router's post-answer bookkeeping (span write,
         # counters) happens after every stage clock stopped; folding it

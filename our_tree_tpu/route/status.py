@@ -73,8 +73,13 @@ class RouterStatus(HttpStatusEndpoint):
         self._router = router
         self.federate = bool(federate)
 
-    async def metrics_text_async(self) -> str:
-        own = self.metrics_text()
+    async def metrics_text_async(self, exemplars: bool = False) -> str:
+        # The router's own registry honors the scraper's OpenMetrics
+        # negotiation; backend documents are relayed as scraped (plain
+        # 0.0.4 — the proxy's scrape does not negotiate), so the
+        # federated body never mixes exemplar tails into lines a
+        # classic parser will read.
+        own = self.metrics_text(exemplars=exemplars)
         if not self.federate:
             return own
         backends = [(name, b)
@@ -106,6 +111,38 @@ class RouterStatus(HttpStatusEndpoint):
         parts.append("# TYPE ot_route_federate_up gauge")
         parts.extend(up)
         return "\n".join(parts) + "\n"
+
+    async def profilez_async(self, seconds: float) -> tuple[int, dict]:
+        """The FEDERATED /profilez: relay the capture arm to every
+        backend with a status port, concurrently through the proxy seam
+        (``Backend.poll_profilez``) — one operator request profiles the
+        whole per-host fleet, each backend enforcing its own one-window
+        rule. The router itself captures nothing (the routing tier is
+        device-free; its latency story is the waterfall's wire/retry
+        stages). 200 when any backend armed; else 409 if any refused as
+        busy; else 503 (no backend could capture)."""
+        backends = [(name, b)
+                    for name, b in sorted(self._router.backends.items())
+                    if b.spec.status_port]
+        results = await asyncio.gather(
+            *(b.poll_profilez(seconds) for _, b in backends),
+            return_exceptions=True)
+        doc: dict = {"federated": {}}
+        codes: list[int] = []
+        for (name, _b), res in zip(backends, results):
+            if not isinstance(res, dict):
+                doc["federated"][name] = {"error": "unreachable"}
+                continue
+            codes.append(res["code"])
+            doc["federated"][name] = {"code": res["code"], **res["doc"]}
+        if 200 in codes:
+            code = 200
+        elif 409 in codes:
+            code = 409
+        else:
+            code = 503
+        doc["armed"] = sum(1 for c in codes if c == 200)
+        return code, doc
 
     def healthz(self) -> dict:
         r = self._router

@@ -56,7 +56,7 @@ import os
 import re
 import sys
 
-from ..obs import costmodel, incident, metrics, slo, trace
+from ..obs import costmodel, incident, metrics, profiler, slo, trace
 from ..resilience import degrade, watchdog
 from ..resilience import journal as journal_mod
 from . import batcher, loadgen
@@ -76,6 +76,20 @@ def _next_artifact(root: str) -> str:
         if m:
             taken.append(int(m.group(1)))
     return os.path.join(root, f"SERVE_r{max(taken) + 1:02d}.json")
+
+
+async def _arm_profile_window(start_s: float, dur_s: float) -> None:
+    """The --profile-window arm: wait out the offset, then open one
+    bounded capture (obs/profiler.py — the same window /profilez and
+    the incident recorder arm). Refusals are reported, never fatal: a
+    profile flag must not fail the drive it observes."""
+    await asyncio.sleep(start_s)
+    try:
+        out = profiler.start_window(dur_s, armed_by="cli")
+        print(f"# profile-window: armed {dur_s:g}s "
+              f"(tier={out['tier']})", file=sys.stderr)
+    except (profiler.CaptureBusy, profiler.CaptureDisabled) as e:
+        print(f"# profile-window: not armed: {e}", file=sys.stderr)
 
 
 async def _drive(args, probes):
@@ -101,13 +115,27 @@ async def _drive(args, probes):
         ceiling_gbps=args.ceiling_gbps)
     server = Server(cfg)
     await server.start()
+    arm_task = None
+    if args.profile_window is not None:
+        arm_task = asyncio.ensure_future(
+            _arm_profile_window(*args.profile_window))
     report = await loadgen.run(
         server, args.requests, concurrency=args.concurrency,
         sizes=args.sizes, tenants=args.tenants,
         keys_per_tenant=args.keys_per_tenant, seed=args.seed,
         verify_every=args.verify_every, probes=probes,
         arrival_rate=args.arrival_rate, modes=args.mode_list)
+    if arm_task is not None and not arm_task.done():
+        arm_task.cancel()  # the drive ended before the window's offset
+        try:
+            await arm_task
+        except asyncio.CancelledError:
+            pass
     await server.stop()
+    # A window still capturing at drain (a long --profile-window, a
+    # late /profilez) closes CLEANLY here — shortened, summarised,
+    # never lost — before the artifact is stamped.
+    profiler.finish()
     return server, report
 
 
@@ -235,6 +263,16 @@ def main(argv=None) -> int:
                          "dropping its failure rows from --journal "
                          "(repeatable), then exit — the same "
                          "clear_failures edit harness.bench uses")
+    ap.add_argument("--profile-window", default=None, metavar="START:DUR",
+                    help="arm ONE bounded device-profiling capture "
+                         "(obs/profiler.py) DUR seconds long, START "
+                         "seconds into the drive: jax.profiler trace "
+                         "where available (TensorBoard/Perfetto), host "
+                         "stack sampling on the native tier, plus the "
+                         "per-rung kernel-wall window summary either "
+                         "way — landing in the OT_TRACE_DIR run layout "
+                         "and stamped into the artifact's `profile` "
+                         "section (requires OT_TRACE_DIR)")
     ap.add_argument("--status-port", type=int, default=None, metavar="PORT",
                     help="serve the operator status endpoint on "
                          "127.0.0.1:PORT for the duration of the drive: "
@@ -293,6 +331,17 @@ def main(argv=None) -> int:
         args.key_slots = batcher.DEFAULT_KEY_SLOTS
     args.mode_list = tuple(m.strip() for m in args.modes.split(",")
                            if m.strip()) or ("ctr",)
+    if args.profile_window is not None:
+        try:
+            start_s, _, dur_s = args.profile_window.partition(":")
+            args.profile_window = (max(float(start_s), 0.0),
+                                   max(float(dur_s), 0.05))
+        except ValueError:
+            ap.error(f"--profile-window wants <start_s>:<dur_s>, got "
+                     f"{args.profile_window!r}")
+        if not trace.enabled():
+            ap.error("--profile-window needs OT_TRACE_DIR: the capture "
+                     "artifacts land in the trace run layout")
     if "gcm-open" in args.mode_list and not args.verify_every:
         ap.error("--modes gcm-open requires --verify-every > 0: open "
                  "traffic replays the per-size sealed probe pairs "
@@ -314,6 +363,9 @@ def main(argv=None) -> int:
         return 0
 
     trace.ensure_run()
+    # Captures from BEFORE this drive (an embedding test harness's
+    # earlier run in the same process) are not this artifact's story.
+    profile_before = profiler.last_summary()
     # Reference outputs BEFORE the server's warmup marker: the
     # byte-exact models path compiles per probe size (the AEAD/CBC
     # references are pure-host numpy — no compile either way), and
@@ -449,6 +501,37 @@ def main(argv=None) -> int:
                                "total_us": round(a["us"], 1)}
                            for k, a in compile_by_rung.items()}
 
+    # The profile section (obs/profiler.py): the armed window's capture
+    # summary — span, tier, per-rung kernel wall inside the window —
+    # joined against the cost model so modeled utilization gets a
+    # measured in-window cross-check. Present iff a window actually
+    # captured (--profile-window, a /profilez hit, or an incident arm).
+    profile_doc = profiler.last_summary()
+    if profile_doc is profile_before:
+        profile_doc = None  # nothing captured DURING this drive
+    profile_section = None
+    if profile_doc is not None:
+        profile_section = {
+            "capture": profile_doc,
+            "crosscheck": profiler.crosscheck(
+                profile_doc, server.cost_records,
+                ceiling_gbps=args.ceiling_gbps),
+        }
+        print(f"# profile: tier={profile_doc['tier']} "
+              f"window={profile_doc['seconds']:g}s "
+              f"({profile_doc['armed_by']}), "
+              f"{len(profile_doc['rungs'])} rung row(s), "
+              f"device {profile_doc['device_us'] / 1e6:.3f}s of "
+              f"{profile_doc['busy_us'] / 1e6:.3f}s busy in-window")
+        for row in profile_section["crosscheck"]["rows"]:
+            if row["window_gbps"] is None:
+                continue
+            util = (f" util={row['utilization']:.1%}"
+                    if row["utilization"] is not None else "")
+            print(f"# profile: {row['engine']}/{row['mode']} "
+                  f"r{row['rung']}: {row['dispatches']} disp in-window "
+                  f"-> {row['window_gbps']:.3f} GB/s moved{util}")
+
     # The per-workload split (mode rides serve_requests/serve_refused/
     # serve_batch_blocks/serve_dispatch_us): the mixed-mode drive's
     # evidence that every enabled mode actually carried traffic.
@@ -504,6 +587,9 @@ def main(argv=None) -> int:
         "cost": cost,
         "compiles_by_rung": compile_by_rung,
         "degraded": degrade.events(),
+        # The armed profile window's summary + costmodel cross-check
+        # (None when no window captured this run).
+        "profile": profile_section,
         # The full registry snapshot: exact counters/gauges + log2
         # histogram buckets per label set — present traced or not (the
         # registry always counts; only the JSONL flusher needs

@@ -354,8 +354,10 @@ class Lane:
             # where device compute is actually waited out. The fence
             # window is the ledger's "device" stage (an upper bound that
             # excludes host work by construction; transfer rides it on
-            # committed inputs). jax.profiler hooks can refine it on a
-            # real TPU, but the fence split is engine-independent.
+            # committed inputs). The kernel-internal refinement lives
+            # behind the profiler seam (obs/profiler.py — a /profilez
+            # window captures jax.profiler traces around these fences);
+            # the fence split itself is engine-independent.
             t_fence = self._clock()
             jax.block_until_ready(out)
             if timing is not None:
@@ -694,6 +696,15 @@ class LanePool:
                 blocks=blocks, requests=requests, engine=self.engine,
                 redispatch=bool(tried))
             cm.__enter__()
+            # The tail exemplar this dispatch contributes to the
+            # latency histograms: span id + the closed attrs, so a
+            # p99 bucket names the one span chain that defined it
+            # (sampled dispatches only — an unsampled span has no id
+            # on disk to resolve).
+            ex = ({"span": cm.span_id, "trace": trace.run_id(),
+                   "lane": lane.idx, "rung": bucket,
+                   "engine": self.engine, "mode": mode}
+                  if cm.span_id else None)
             lane.inflight += 1
             self._inflight(+1)
             t0 = lane._clock()
@@ -776,7 +787,8 @@ class LanePool:
                 # showed after the run ended.
                 metrics.observe("serve_dispatch_us", dt_us,
                                 lane=lane.idx, engine=self.engine,
-                                outcome=outcome, mode=mode)
+                                outcome=outcome, mode=mode,
+                                exemplar=ex)
                 metrics.counter("serve_lane_busy_us", dt_us,
                                 lane=lane.idx)
                 self._notify_change()
@@ -812,9 +824,11 @@ class LanePool:
                             device_us=device_us, wall_us=dt_us,
                             batch=label)
             metrics.observe("serve_stage_us", wait_us,
-                            stage="worker_wait")
-            metrics.observe("serve_stage_us", host_us, stage="dispatch")
-            metrics.observe("serve_stage_us", device_us, stage="device")
+                            stage="worker_wait", exemplar=ex)
+            metrics.observe("serve_stage_us", host_us, stage="dispatch",
+                            exemplar=ex)
+            metrics.observe("serve_stage_us", device_us, stage="device",
+                            exemplar=ex)
             if timing is not None:
                 timing["worker_wait_us"] = wait_us
                 timing["device_us"] = device_us

@@ -488,8 +488,17 @@ class RequestQueue:
             queued_s = req.t_drain - req.t_submit
             req.queued_us = int(queued_s * 1e6)
             metrics.observe("serve_queued_us", queued_s * 1e6)
+            # Tail exemplar: the request-queued span is still open here,
+            # so the backend_queue histogram's worst bucket names the
+            # one request (and, via its router parent, the whole
+            # cross-process chain) that sat there longest.
+            sid = (req._span_cm.span_id
+                   if req._span_cm is not None else None)
             metrics.observe("serve_stage_us", req.queued_us,
-                            stage="backend_queue")
+                            stage="backend_queue",
+                            exemplar=({"span": sid,
+                                       "trace": trace.run_id()}
+                                      if sid else None))
             if req.budget is not None and req.budget.exhausted():
                 self.expired += 1
                 metrics.counter("serve_deadline_expired")

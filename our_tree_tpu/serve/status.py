@@ -34,7 +34,7 @@ from __future__ import annotations
 import asyncio
 import json
 
-from ..obs import incident, metrics, trace
+from ..obs import incident, metrics, profiler, trace
 from ..resilience import degrade
 
 
@@ -69,17 +69,20 @@ class HttpStatusEndpoint:
         """The live health JSON (the /healthz body) — subclass duty."""
         raise NotImplementedError
 
-    def metrics_text(self) -> str:
+    def metrics_text(self, exemplars: bool = False) -> str:
         """The /metrics body; subclasses override to re-sample liveness
-        gauges at scrape time before rendering."""
-        return metrics.render_prometheus()
+        gauges at scrape time before rendering. ``exemplars`` rides the
+        scraper's content negotiation: OpenMetrics exemplar tails are
+        emitted only to a scraper that asked for OpenMetrics (a classic
+        0.0.4 parser would reject them)."""
+        return metrics.render_prometheus(exemplars=exemplars)
 
-    async def metrics_text_async(self) -> str:
+    async def metrics_text_async(self, exemplars: bool = False) -> str:
         """Awaitable /metrics hook (defaults to the sync body): the
         router's FEDERATED scrape overrides this — it must await its
         backends' /metrics over the network, which a sync method on the
         event loop cannot."""
-        return self.metrics_text()
+        return self.metrics_text(exemplars=exemplars)
 
     def incidentz(self) -> dict:
         """The /incidentz body: this process's flight-recorder state
@@ -93,6 +96,21 @@ class HttpStatusEndpoint:
             "bundles": incident.bundle_index(d) if d else [],
         }
 
+    async def profilez_async(self, seconds: float) -> tuple[int, dict]:
+        """The /profilez handler: arm one bounded capture window
+        (obs/profiler.py) on THIS process — 200 armed, 409 while a
+        window is already open (overlapping captures are refused, not
+        queued), 503 with tracing off. Armed OFF the event loop
+        (executor): jax.profiler's first start_trace pays a
+        seconds-scale init, and the observation tool must not stall
+        the in-flight requests it exists to observe. The ROUTER
+        overrides this to federate the request per backend
+        (route/status.py) — same pattern as the /metrics fleet
+        scrape."""
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(None, profiler.profilez,
+                                          seconds)
+
     # -- the responder ------------------------------------------------------
     async def _handle(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:
@@ -100,15 +118,27 @@ class HttpStatusEndpoint:
             line = await asyncio.wait_for(reader.readline(), timeout=5.0)
             parts = line.decode("latin-1", "replace").split()
             path = parts[1] if len(parts) >= 2 else "/"
-            # Drain (and ignore) the request headers.
+            # Drain the request headers, watching only for the Accept
+            # content negotiation (the OpenMetrics exemplar opt-in).
+            accept = ""
             while True:
                 h = await asyncio.wait_for(reader.readline(), timeout=5.0)
                 if not h or h in (b"\r\n", b"\n"):
                     break
+                hl = h.decode("latin-1", "replace")
+                if hl.lower().startswith("accept:"):
+                    accept = hl.partition(":")[2].strip().lower()
             self.requests += 1
             if path.split("?")[0] == "/metrics":
-                body = await self.metrics_text_async()
-                ctype = "text/plain; version=0.0.4; charset=utf-8"
+                om = "application/openmetrics-text" in accept
+                body = await self.metrics_text_async(exemplars=om)
+                if om:
+                    # OpenMetrics requires the explicit EOF marker.
+                    body += "# EOF\n"
+                    ctype = ("application/openmetrics-text; "
+                             "version=1.0.0; charset=utf-8")
+                else:
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
                 code, reason = 200, "OK"
             elif path.split("?")[0] == "/healthz":
                 body = json.dumps(self.healthz(), indent=1,
@@ -120,9 +150,22 @@ class HttpStatusEndpoint:
                                   sort_keys=True) + "\n"
                 ctype = "application/json"
                 code, reason = 200, "OK"
+            elif path.split("?")[0] == "/profilez":
+                query = path.partition("?")[2]
+                params = dict(p.split("=", 1)
+                              for p in query.split("&") if "=" in p)
+                try:
+                    secs = float(params.get("seconds", 1.0))
+                except ValueError:
+                    secs = 1.0
+                code, doc = await self.profilez_async(secs)
+                body = json.dumps(doc, indent=1, sort_keys=True) + "\n"
+                ctype = "application/json"
+                reason = {200: "OK", 409: "Conflict",
+                          503: "Service Unavailable"}.get(code, "OK")
             else:
-                body = ("not found: try /metrics, /healthz or "
-                        "/incidentz\n")
+                body = ("not found: try /metrics, /healthz, /incidentz "
+                        "or /profilez\n")
                 ctype = "text/plain"
                 code, reason = 404, "Not Found"
         except Exception:  # noqa: BLE001 - a bad scrape must not matter
@@ -188,7 +231,7 @@ class StatusServer(HttpStatusEndpoint):
             "degraded": degrade.events(),
         }
 
-    def metrics_text(self) -> str:
+    def metrics_text(self, exemplars: bool = False) -> str:
         """The /metrics body: the registry plus scrape-time liveness
         gauges (queue depth and in-flight are refreshed HERE so a
         scrape between requests still sees current pressure, not the
@@ -197,4 +240,4 @@ class StatusServer(HttpStatusEndpoint):
         metrics.gauge("serve_queue_depth", s.queue.depth())
         if s.pool is not None:
             metrics.gauge("serve_inflight", s.pool.inflight_now)
-        return metrics.render_prometheus()
+        return metrics.render_prometheus(exemplars=exemplars)

@@ -62,7 +62,23 @@ class _ChildTimeout(Exception):
 
 
 def child(component: str) -> int:
-    """Measure ONE component and print a JSON line."""
+    """Measure ONE component and print a JSON line. With
+    ``OT_PROF_CAPTURE`` set (the parent's ``--capture`` flag), the
+    measurement runs inside the repo's ONE capture seam
+    (our_tree_tpu/obs/profiler.py — the same window serve's
+    /profilez and harness.bench --profile arm): the jax trace + window
+    summary land in the OT_TRACE_DIR run layout, one capture per
+    component child, `obs.report --profile` joins them."""
+    if os.environ.get("OT_PROF_CAPTURE"):
+        sys.path.insert(0, REPO)
+        from our_tree_tpu.obs import profiler as profiler_mod
+
+        with profiler_mod.sweep_capture(armed_by="cli"):
+            return _child_measure(component)
+    return _child_measure(component)
+
+
+def _child_measure(component: str) -> int:
     import numpy as np
 
     import jax
@@ -211,7 +227,17 @@ def main() -> int:
                          "under recover_watch's 1800s outer kill so a "
                          "wedged tunnel yields partial data, not a "
                          "SIGKILLed step retried from scratch")
+    ap.add_argument("--capture", action="store_true",
+                    help="wrap each component child in the shared "
+                         "obs/profiler.py capture window (requires "
+                         "OT_TRACE_DIR): jax trace + per-window summary "
+                         "in the run layout, joined by "
+                         "`obs.report --profile`")
     args = ap.parse_args()
+    if args.capture:
+        # Children inherit the environment through the isolate spawn;
+        # the capture itself stays inside the one profiler seam.
+        os.environ["OT_PROF_CAPTURE"] = "1"
     if args.component:
         return child(args.component)
 

@@ -526,3 +526,103 @@ def test_cli_fails_on_new_findings(tmp_path):
     assert rc == 1
     # Without the gate flag the same run reports but exits 0.
     assert driver.main([str(bad), "--no-jaxpr"]) == 0
+
+
+# ---------------------------------------------------------------------------
+# otlint --fix: mechanical rewrites (the wallclock seed rule).
+# ---------------------------------------------------------------------------
+
+
+def test_fix_wallclock_fixture_pair_relints_clean(tmp_path):
+    """The fixture-pair contract: a file with wallclock violations,
+    fixed by `--fix`, re-lints CLEAN for the rule — and the rewrite is
+    exactly the monotonic twin, byte-for-byte predictable."""
+    before = textwrap.dedent("""\
+        import time
+
+
+        def took():
+            t0 = time.time()
+            work()
+            ns = time.time_ns()
+            return time.time() - t0, ns
+    """)
+    after = textwrap.dedent("""\
+        import time
+
+
+        def took():
+            t0 = time.monotonic()
+            work()
+            ns = time.monotonic_ns()
+            return time.monotonic() - t0, ns
+    """)
+    f = tmp_path / "wall.py"
+    f.write_text(before)
+    findings = astrules.lint_paths([str(f)], str(tmp_path))
+    assert sum(1 for x in findings if x.rule == "wallclock") == 3
+    fixed = astrules.fix_paths([str(f)], str(tmp_path))
+    assert fixed == {"wall.py": 3}
+    assert f.read_text() == after
+    refound = astrules.lint_paths([str(f)], str(tmp_path))
+    assert not [x for x in refound if x.rule == "wallclock"]
+    # Idempotent: a second --fix rewrites nothing.
+    assert astrules.fix_paths([str(f)], str(tmp_path)) == {}
+
+
+def test_fix_leaves_judgment_sites_alone(tmp_path):
+    """time.time(x...) shapes (args/kwargs) and unparseable files are
+    not --fix's business; the finding still stands for the reviewer."""
+    f = tmp_path / "odd.py"
+    f.write_text("import time\nt = time.time\nbad = time.time(*a)\n")
+    assert astrules.fix_paths([str(f)], str(tmp_path)) == {}
+    g = tmp_path / "broken.py"
+    g.write_text("def (:\n")
+    assert astrules.fix_paths([str(g)], str(tmp_path)) == {}
+
+
+def test_fix_cli_applies_then_reports_postfix_state(tmp_path, capsys):
+    f = tmp_path / "wall.py"
+    f.write_text("import time\nt0 = time.time()\n")
+    rc = driver.main([str(f), "--no-jaxpr", "--fix", "--fail-on-new"])
+    assert rc == 0  # the fix landed BEFORE the lint: nothing new left
+    assert "time.monotonic()" in f.read_text()
+    err = capsys.readouterr().err
+    assert "--fix" in err and "1 rewrite(s)" in err
+
+
+def test_fix_exempts_baselined_violations(tmp_path):
+    """A reasoned baseline entry marks a DELIBERATE wallclock site
+    (devlock's epoch-vs-mtime staleness compare): --fix must leave it
+    byte-identical while still fixing unbaselined sites in the same
+    file."""
+    f = tmp_path / "wall.py"
+    f.write_text("import time\n"
+                 "fresh = time.time() - mtime <= 60\n"
+                 "t0 = time.time()\n")
+    findings = astrules.lint_paths([str(f)], str(tmp_path))
+    wall = [x for x in findings if x.rule == "wallclock"]
+    assert len(wall) == 2
+    keep = [x for x in wall if "fresh" in x.anchor]
+    base = {keep[0].fingerprint: {"reason": "epoch vs mtime on purpose"}}
+    fixed = astrules.fix_paths([str(f)], str(tmp_path), baseline=base)
+    assert fixed == {"wall.py": 1}
+    src = f.read_text()
+    assert "fresh = time.time() - mtime <= 60" in src   # protected
+    assert "t0 = time.monotonic()" in src               # fixed
+    # And the REAL baseline protects the real tree: a --fix dry run
+    # over the repo's own default paths with the committed baseline
+    # must not touch the baselined devlock/watchdog sites (verified by
+    # fixing into a COPY, never the tree itself).
+    import pathlib
+    import shutil
+    repo = pathlib.Path(astrules.__file__).resolve().parents[2]
+    from our_tree_tpu.analysis import baseline as baseline_mod
+    committed = baseline_mod.load(str(repo / "analysis" / "baseline.json"))
+    for rel in ("our_tree_tpu/utils/devlock.py",
+                "our_tree_tpu/resilience/watchdog.py"):
+        dst = tmp_path / pathlib.Path(rel).name
+        shutil.copy(repo / rel, dst)
+        before = dst.read_text()
+        astrules.fix_file(str(dst), rel, baseline=committed)
+        assert dst.read_text() == before, f"--fix touched baselined {rel}"

@@ -316,3 +316,46 @@ def test_compile_histogram_exact_under_sampling(tmp_path, monkeypatch):
     finally:
         trace.reset_for_tests()
         metrics.reset_for_tests()
+
+
+def test_cost_section_emits_zero_dispatch_rows_for_warmed_records():
+    """A warmed (engine, rung) that saw no post-warmup traffic still
+    gets its row — dispatches=0, never silent omission (a missing row
+    reads as 'covered' in trend diffs); zero rows price nothing into
+    per_engine and gate nothing in the SLO compare (base <= 0 skips)."""
+    hot = costmodel.analytic_cost("jnp", "ctr", 4096, NR128, K)
+    cold = costmodel.analytic_cost("jnp", "ctr", 64, NR128, K)
+    counters = {
+        "serve_rung_dispatches{engine=jnp,mode=ctr,nr=10,rung=4096}": 4.0,
+        "serve_rung_device_us{engine=jnp,mode=ctr,nr=10,rung=4096}": 1e3,
+    }
+    cs = costmodel.cost_section([hot, cold], counters, ceiling_gbps=10.0)
+    by_rung = {r["rung"]: r for r in cs["rows"]}
+    assert set(by_rung) == {64, 4096}
+    zero = by_rung[64]
+    assert zero["dispatches"] == 0
+    assert zero["modeled_dispatch_bytes"] == cold["hbm_bytes"]
+    assert zero["modeled_bytes"] == 0 and zero["device_s"] == 0.0
+    assert zero["achieved_gbps"] == 0.0 and zero["utilization"] is None
+    # per_engine aggregates only the dispatched traffic.
+    assert cs["per_engine"]["jnp"]["modeled_bytes"] \
+        == by_rung[4096]["modeled_bytes"]
+    # The SLO surface: a zero row in a BASELINE gates nothing.
+    base = slo.extract({"load": {}, "cost": cs})
+    cand = slo.extract({"load": {}, "cost": {"rows": []}})
+    assert not [f for f in slo.compare(base, cand)
+                if f.startswith("cost:")]
+
+
+def test_slo_skips_zero_dispatch_candidate_rows():
+    """A candidate's explicit dispatches=0 row (warmed rung, no traffic
+    this run) gates NOTHING against a baseline that dispatched there —
+    exactly as the row's pre-ot-scope absence did; a spurious 0-GB/s
+    'regression' would also fire a bogus SLO-breach incident."""
+    base = slo.extract(_doc(10.0))
+    zero_row = {"engine": "native", "mode": "ctr", "rung": 4096,
+                "nr": 10, "dispatches": 0, "achieved_gbps": 0.0}
+    cand = slo.extract({"load": {}, "cost": {"rows": [zero_row]}})
+    assert "native|ctr|r4096|nr10" not in cand.get("cost", {})
+    assert not [f for f in slo.compare(base, cand)
+                if f.startswith("cost:")]

@@ -1,0 +1,130 @@
+"""The perf-history ledger (our_tree_tpu/obs/history.py): artifact
+parsing into classed trend series, best-ever gating (green and red),
+count-metric strictness, tolerance parsing, and the committed repo
+artifacts themselves staying green — the CI gate's own contract."""
+
+import json
+import os
+
+import pytest
+
+from our_tree_tpu.obs import history
+
+
+def _write(root, name, doc):
+    with open(os.path.join(root, name), "w") as fh:
+        json.dump(doc, fh)
+
+
+def _serve_doc(gbps, lost=0, steady=0, modes=("ctr",), sizes=(4096,),
+               engine="native", lanes=8):
+    return {
+        "config": {"modes": list(modes), "sizes": list(sizes),
+                   "engine": engine, "lanes": lanes},
+        "load": {"goodput_gbps": gbps, "p50_ms": 1.0, "p95_ms": 2.0,
+                 "p99_ms": 3.0, "errors": {}, "mismatches": 0},
+        "queue": {"lost": lost},
+        "compiles": {"steady": steady},
+    }
+
+
+def test_collect_classes_and_families(tmp_path):
+    root = str(tmp_path)
+    _write(root, "SERVE_r01.json", _serve_doc(1.0))
+    _write(root, "SERVE_r02.json", _serve_doc(1.1))
+    _write(root, "SERVE_r03.json", _serve_doc(0.1, modes=("ctr", "gcm")))
+    _write(root, "SERVE_r02_control.json", _serve_doc(0.5))
+    _write(root, "BENCH_r01.json",
+           {"rc": 0, "parsed": {"value": 35.4, "unit": "GB/s"}})
+    _write(root, "MULTICHIP_r01.json", {"n_devices": 8, "ok": True})
+    _write(root, "notes.json", {"x": 1})  # not an artifact: ignored
+    recs = history.collect(root)
+    assert len(recs) == 6
+    by_file = {r["file"]: r for r in recs}
+    # The ctr and mixed drives form DIFFERENT series; the control
+    # variant is its own lineage.
+    assert (by_file["SERVE_r01.json"]["series"]
+            == by_file["SERVE_r02.json"]["series"])
+    assert (by_file["SERVE_r03.json"]["series"]
+            != by_file["SERVE_r01.json"]["series"])
+    assert ":control" in by_file["SERVE_r02_control.json"]["series"]
+    assert by_file["BENCH_r01.json"]["metrics"]["gbps"] == 35.4
+    assert by_file["MULTICHIP_r01.json"]["metrics"] == {
+        "devices": 8.0, "ok": 1.0}
+
+
+def test_check_green_within_tolerance_red_past_it(tmp_path):
+    root = str(tmp_path)
+    _write(root, "SERVE_r01.json", _serve_doc(1.0))
+    _write(root, "SERVE_r02.json", _serve_doc(0.8))  # -20%: inside 35%
+    recs = history.collect(root)
+    assert history.check(recs) == []
+    _write(root, "SERVE_r03.json", _serve_doc(0.5))  # -50%: regression
+    recs = history.collect(root)
+    fails = history.check(recs)
+    assert len(fails) == 1
+    # The failure names the artifact, the metric, and the best-ever.
+    assert "SERVE_r03.json" in fails[0]
+    assert "goodput_gbps" in fails[0]
+    assert "SERVE_r01.json" in fails[0]
+
+
+def test_check_gates_head_against_best_ever_not_last(tmp_path):
+    """The whole point vs an SLO baseline: r03 regressing against r01's
+    best still fails even though r02 (the would-be last baseline) was
+    already lower."""
+    root = str(tmp_path)
+    _write(root, "SERVE_r01.json", _serve_doc(2.0))
+    _write(root, "SERVE_r02.json", _serve_doc(1.4))
+    _write(root, "SERVE_r03.json", _serve_doc(1.2))
+    fails = history.check(history.collect(root))
+    assert fails and "best-ever 2" in fails[0]
+
+
+def test_count_metrics_tolerate_nothing(tmp_path):
+    root = str(tmp_path)
+    _write(root, "SERVE_r01.json", _serve_doc(1.0, lost=0))
+    _write(root, "SERVE_r02.json", _serve_doc(1.0, lost=1))
+    fails = history.check(history.collect(root))
+    assert any("lost" in f and "no tolerance" in f for f in fails)
+    # And a recompile regression in the head names recompiles.
+    _write(root, "SERVE_r02.json", _serve_doc(1.0, steady=2))
+    fails = history.check(history.collect(root))
+    assert any("recompiles" in f for f in fails)
+
+
+def test_unreadable_artifact_is_a_violation(tmp_path):
+    root = str(tmp_path)
+    (tmp_path / "SERVE_r01.json").write_text("{not json")
+    recs = history.collect(root)
+    assert recs[0]["error"]
+    assert any("unreadable" in f for f in history.check(recs))
+
+
+def test_unknown_schema_lists_but_gates_nothing(tmp_path):
+    root = str(tmp_path)
+    _write(root, "SERVE_r01_weird.json", {"claim": "an A/B doc"})
+    recs = history.collect(root)
+    assert recs[0]["parsed"] is False
+    assert history.check(recs) == []
+
+
+def test_tolerance_spec_rejects_unknown_names():
+    tol = history.parse_tolerances("goodput_gbps=0.5")
+    assert tol["goodput_gbps"] == 0.5
+    with pytest.raises(ValueError):
+        history.parse_tolerances("nope=1")
+
+
+def test_committed_artifacts_are_green(capsys):
+    """The repo's own committed *_r*.json set must pass --check: this
+    is the same gate CI runs, pinned here so a regressing artifact
+    fails the suite before it fails the workflow."""
+    rc = history.main(["--check"])
+    err = capsys.readouterr().err
+    assert rc == 0, err
+    assert "check green" in err
+    records = history.collect(history.repo_root())
+    assert len(records) >= 20  # the committed set, all collected
+    families = {r["family"] for r in records}
+    assert {"BENCH", "SERVE", "ROUTE", "MULTICHIP"} <= families

@@ -474,3 +474,114 @@ def test_injected_slow_fires_and_sleeps(monkeypatch):
     assert time.monotonic() - t0 >= 0.05
     assert faults.injected_slow("dispatch_slow") is True
     assert faults.injected_slow("dispatch_slow") is False  # pool spent
+
+
+# ---------------------------------------------------------------------------
+# Tail exemplars (ot-scope): bounded retention, snapshot + OpenMetrics
+# emission, and the OT_EXEMPLARS off switch.
+# ---------------------------------------------------------------------------
+
+
+def test_exemplar_retains_max_per_bucket():
+    metrics.observe("h", 100, exemplar={"span": "a.1"})
+    metrics.observe("h", 120, exemplar={"span": "a.2"})  # same bucket, max
+    metrics.observe("h", 90, exemplar={"span": "a.3"})   # below: kept a.2
+    snap = metrics.snapshot()["hists"]["h"]
+    b = str(metrics.bucket_of(120))
+    assert snap["exemplars"][b]["span"] == "a.2"
+    assert snap["exemplars"][b]["v"] == 120.0
+    assert snap["exemplars"][b]["ts"] > 0
+
+
+def test_exemplar_retention_bounded_highest_buckets_win():
+    # One exemplar per bucket, far more buckets than the cap: only the
+    # HIGHEST buckets survive — the tail is what exemplars exist for.
+    for e in range(16):
+        metrics.observe("h", float(1 << e), exemplar={"span": f"s.{e}"})
+    snap = metrics.snapshot()["hists"]["h"]
+    ex = snap["exemplars"]
+    assert len(ex) == metrics._EXEMPLAR_MAX
+    kept = sorted(int(b) for b in ex)
+    assert kept == sorted(kept)[-metrics._EXEMPLAR_MAX:]
+    assert max(kept) == metrics.bucket_of(1 << 15)
+
+
+def test_exemplar_bounded_under_series_cardinality_cap():
+    # Past the per-name series cap the observation itself is dropped —
+    # exemplars cannot leak around the cardinality backstop.
+    for i in range(metrics._MAX_SERIES + 8):
+        metrics.observe("h", 100, exemplar={"span": "x"}, lane=i)
+    assert metrics.dropped() >= 8
+    hists = metrics.snapshot()["hists"]
+    assert len([k for k in hists if k.startswith("h{")]) \
+        == metrics._MAX_SERIES
+
+
+def test_exemplar_disabled_by_env(monkeypatch):
+    monkeypatch.setenv("OT_EXEMPLARS", "0")
+    metrics.observe("h", 100, exemplar={"span": "a.1"})
+    assert "exemplars" not in metrics.snapshot()["hists"]["h"]
+
+
+def test_exemplar_rides_prometheus_openmetrics_syntax():
+    metrics.observe("serve_dispatch_us", 5000,
+                    exemplar={"span": "ab.1", "trace": "run-1"},
+                    lane=0)
+    metrics.observe("serve_dispatch_us", 12, lane=0)  # no exemplar
+    # DEFAULT rendering is classic 0.0.4: NO exemplar tails (a classic
+    # Prometheus parser rejects them) — exemplars ride only the
+    # negotiated OpenMetrics rendering.
+    assert " # {" not in metrics.render_prometheus()
+    prom = metrics.render_prometheus(exemplars=True)
+    ex_lines = [ln for ln in prom.splitlines() if " # {" in ln]
+    assert len(ex_lines) == 1
+    ln = ex_lines[0]
+    assert ln.startswith("serve_dispatch_us_bucket")
+    assert 'span_id="ab.1"' in ln and 'trace_id="run-1"' in ln
+    # OpenMetrics exemplar tail: `# {labels} value timestamp`.
+    tail = ln.split(" # ")[1]
+    labels, value, ts = tail.split(" ")
+    assert value == "5000" and float(ts) > 0
+
+
+def test_status_endpoint_negotiates_openmetrics_exemplars(traced):
+    """Plain /metrics stays strict 0.0.4; an Accept for
+    application/openmetrics-text gets the exemplar tails, the
+    OpenMetrics content type, and the EOF marker. Traced: exemplars
+    carry span ids, which only exist with the trace stream on."""
+    async def drive(server):
+        await asyncio.gather(*_submit_n(server, 2))
+        port = server.status.port
+        loop = asyncio.get_running_loop()
+
+        def fetch(accept=None):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/metrics",
+                headers={"Accept": accept} if accept else {})
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return r.headers.get("Content-Type", ""), \
+                    r.read().decode()
+
+        plain = await loop.run_in_executor(None, fetch)
+        om = await loop.run_in_executor(
+            None, fetch, "application/openmetrics-text")
+        return plain, om
+
+    _, ((p_ctype, plain), (o_ctype, om)) = _run_server(
+        ServerConfig(lanes=1, status_port=0, **LADDER), drive)
+    assert p_ctype.startswith("text/plain") and " # {" not in plain
+    assert o_ctype.startswith("application/openmetrics-text")
+    assert 'span_id="' in om
+    assert om.rstrip().endswith("# EOF")
+
+
+def test_exemplar_survives_snapshot_roundtrip_and_merge(
+        traced, monkeypatch):
+    metrics.observe("h", 500, exemplar={"span": "p.9", "trace": "r"})
+    assert metrics.flush_now()
+    run = export.load_run(str(traced.parent / "t-metrics"))
+    h = run.metrics_totals()["hists"]["h"]
+    b = str(metrics.bucket_of(500))
+    assert h["exemplars"][b]["span"] == "p.9"
+    # And --check still passes: exemplars are schema-clean extras.
+    assert run.violations == []

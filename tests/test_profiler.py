@@ -1,0 +1,381 @@
+"""ot-scope's capture seam (our_tree_tpu/obs/profiler.py): window
+open/close + the one-at-a-time contract, the registry-delta summary and
+its costmodel cross-check, /profilez over the live status endpoint
+(200 armed / 409 overlapping / 503 untraced), incident arming under the
+trigger cooldown (no capture storm), clean close at drain, and the
+report --profile join."""
+
+import asyncio
+import io
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from our_tree_tpu.obs import (costmodel, export, incident, metrics,
+                              profiler, report, trace)
+from our_tree_tpu.resilience import degrade, faults
+from our_tree_tpu.serve.server import Server, ServerConfig
+
+LADDER = dict(min_bucket_blocks=32, max_bucket_blocks=256)
+
+
+@pytest.fixture(autouse=True)
+def _clean_process_state(monkeypatch):
+    monkeypatch.delenv("OT_FAULTS", raising=False)
+    monkeypatch.delenv("OT_PROFILE_ON_INCIDENT", raising=False)
+    # The stack tier by default: tests must not leave a process-global
+    # jax profiler session behind (one per process, and another suite's
+    # capture would collide with it).
+    monkeypatch.setenv("OT_PROFILE_TIER", "stack")
+    monkeypatch.setenv("OT_PROFILE_HZ", "100")
+    faults.reset()
+    degrade.clear()
+    metrics.reset_for_tests()
+    profiler.reset_for_tests()
+    incident.reset_for_tests()
+    yield
+    profiler.reset_for_tests()
+    incident.reset_for_tests()
+    metrics.reset_for_tests()
+    faults.reset()
+    degrade.clear()
+
+
+@pytest.fixture
+def traced(tmp_path, monkeypatch):
+    monkeypatch.setenv("OT_TRACE_DIR", str(tmp_path / "tr"))
+    monkeypatch.setenv("OT_TRACE_RUN", "t-prof")
+    monkeypatch.delenv("OT_TRACE_PARENT", raising=False)
+    trace.reset_for_tests()
+    metrics.reset_for_tests()
+    yield tmp_path / "tr" / "t-prof"
+    trace.reset_for_tests()
+    metrics.reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# The window contract.
+# ---------------------------------------------------------------------------
+
+
+def test_window_requires_tracing(monkeypatch):
+    monkeypatch.delenv("OT_TRACE_DIR", raising=False)
+    with pytest.raises(profiler.CaptureDisabled):
+        profiler.start_window(0.1)
+
+
+def test_window_refuses_overlap_and_summarises_deltas(traced):
+    metrics.counter("serve_rung_dispatches", 5, rung=64, engine="jnp",
+                    mode="ctr", nr=10)
+    out = profiler.start_window(0.2, armed_by="api")
+    assert out["tier"] == "stack"
+    with pytest.raises(profiler.CaptureBusy):
+        profiler.start_window(0.2)
+    assert profiler.active()["seq"] == out["seq"]
+    # Traffic INSIDE the window: only the delta lands in the summary.
+    metrics.counter("serve_rung_dispatches", 3, rung=64, engine="jnp",
+                    mode="ctr", nr=10)
+    metrics.counter("serve_rung_device_us", 4000, rung=64, engine="jnp",
+                    mode="ctr", nr=10)
+    metrics.counter("serve_lane_busy_us", 9000, lane=0)
+    metrics.counter("serve_device_us", 4000, lane=0)
+    metrics.observe("serve_stage_us", 777, stage="device")
+    assert profiler.wait_idle(10)
+    doc = profiler.last_summary()
+    assert profiler.validate_summary(doc) == []
+    assert doc["rungs"] == [{"engine": "jnp", "mode": "ctr", "rung": 64,
+                             "nr": 10, "dispatches": 3,
+                             "device_us": 4000}]
+    assert doc["stages"]["device"]["count"] == 1
+    assert doc["busy_us"] == 9000 and doc["device_us"] == 4000
+    assert doc["host_us"] == 5000
+    assert doc["samples"] >= 1 and doc["stacks"]
+    # The summary is on disk in the run layout, and a SECOND window may
+    # open once the first closed.
+    paths = profiler.list_summaries(str(traced))
+    assert len(paths) == 1
+    assert profiler.load_summary(paths[0])["seq"] == doc["seq"]
+    out2 = profiler.start_window(0.05)
+    assert out2["seq"] != out["seq"]
+    assert profiler.wait_idle(10)
+    assert len(profiler.list_summaries(str(traced))) == 2
+
+
+def test_drain_close_is_clean(traced):
+    """A window still open at drain closes EARLY and completely: the
+    closer thread that would have fired later must not close the NEXT
+    window (the expected_seq guard)."""
+    out = profiler.start_window(30.0, armed_by="http")
+    path = profiler.finish()
+    assert path is not None
+    doc = profiler.load_summary(path)
+    assert profiler.validate_summary(doc) == []
+    assert doc["seconds"] < 5.0  # closed at drain, not after 30 s
+    # A new window opened right away is NOT closed by the first
+    # window's (still sleeping) closer thread.
+    out2 = profiler.start_window(None, armed_by="api")
+    assert out2["seq"] == out["seq"] + 1
+    assert profiler.active() is not None
+    assert profiler.stop_window(expected_seq=out["seq"]) is None
+    assert profiler.active() is not None  # untouched
+    assert profiler.stop_window() is not None
+
+
+def test_crosscheck_joins_cost_records(traced):
+    rec = costmodel.analytic_cost("jnp", "ctr", 64, 10, 8)
+    doc = {"rungs": [{"engine": "jnp", "mode": "ctr", "rung": 64,
+                      "nr": 10, "dispatches": 10, "device_us": 1000},
+                     {"engine": "jnp", "mode": "gcm", "rung": 64,
+                      "nr": 10, "dispatches": 2, "device_us": 0}]}
+    cc = profiler.crosscheck(doc, [rec], ceiling_gbps=10.0)
+    row = cc["rows"][0]
+    want = rec["hbm_bytes"] * 10 / 1e9 / 1e-3
+    assert abs(row["window_gbps"] - want) < 1e-6 * want
+    assert abs(row["utilization"] - want / 10.0) < 1e-6
+    # No record / no device time -> present but unrated, never omitted.
+    assert cc["rows"][1]["window_gbps"] is None
+    assert cc["rows"][1]["modeled_dispatch_bytes"] is None
+
+
+def test_sweep_capture_noop_when_disabled(monkeypatch):
+    monkeypatch.delenv("OT_TRACE_DIR", raising=False)
+    with profiler.sweep_capture():
+        assert profiler.active() is None  # degraded to a no-op
+    assert profiler.last_summary() is None
+
+
+def test_validate_summary_flags_malformed():
+    assert profiler.validate_summary(None)
+    assert profiler.validate_summary({"kind": "nope"})
+    viols = profiler.validate_summary(
+        {"kind": profiler.KIND, "v": 1, "run": "r", "pid": 1,
+         "t0_us": 0, "t1_us": 1, "seconds": 1.0, "tier": "warp",
+         "armed_by": "cli", "rungs": [{}], "stages": {}})
+    assert any("tier" in v for v in viols)
+    assert any("rungs[0]" in v for v in viols)
+
+
+# ---------------------------------------------------------------------------
+# /profilez on the live endpoint.
+# ---------------------------------------------------------------------------
+
+
+def _run_server(config, fn):
+    async def main():
+        server = Server(config)
+        await server.start()
+        try:
+            return server, await fn(server)
+        finally:
+            await server.stop()
+
+    return asyncio.run(main())
+
+
+def _fetch(port, path):
+    req = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=10)
+    with req as r:
+        return r.status, json.loads(r.read().decode())
+
+
+def test_profilez_arms_refuses_overlap_and_lands_artifact(traced):
+    async def drive(server):
+        port = server.status.port
+        loop = asyncio.get_running_loop()
+        code, doc = await loop.run_in_executor(
+            None, _fetch, port, "/profilez?seconds=0.3")
+        assert code == 200 and doc["armed"] and doc["tier"] == "stack"
+        # Overlapping request: 409, naming the open capture.
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            await loop.run_in_executor(None, _fetch, port,
+                                       "/profilez?seconds=1")
+        assert ei.value.code == 409
+        body = json.loads(ei.value.read().decode())
+        assert "already in progress" in body["error"]
+        assert body["active"]["armed_by"] == "http"
+        return doc
+
+    _run_server(ServerConfig(lanes=1, status_port=0, **LADDER), drive)
+    # The drive drained with the window possibly still open: the close
+    # is clean and the artifact exists, loads, and validates.
+    assert profiler.wait_idle(10)
+    paths = profiler.list_summaries(str(traced))
+    assert len(paths) == 1
+    doc = profiler.load_summary(paths[0])
+    assert profiler.validate_summary(doc) == []
+    assert doc["armed_by"] == "http"
+
+
+def test_profilez_503_when_untraced(monkeypatch):
+    monkeypatch.delenv("OT_TRACE_DIR", raising=False)
+    trace.reset_for_tests()
+
+    async def drive(server):
+        port = server.status.port
+        loop = asyncio.get_running_loop()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            await loop.run_in_executor(None, _fetch, port,
+                                       "/profilez?seconds=1")
+        return ei.value.code
+
+    _, code = _run_server(ServerConfig(lanes=1, status_port=0, **LADDER),
+                          drive)
+    assert code == 503
+
+
+# ---------------------------------------------------------------------------
+# Incident arming (OT_PROFILE_ON_INCIDENT).
+# ---------------------------------------------------------------------------
+
+
+def test_incident_arms_one_capture_per_cooldown(traced, monkeypatch):
+    monkeypatch.setenv("OT_PROFILE_ON_INCIDENT", "0.1")
+    monkeypatch.setenv("OT_INCIDENT_COOLDOWN_S", "30")
+    # Two triggers within the cooldown: ONE bundle, ONE capture — the
+    # coalescing rule is also the capture-storm guard. Arming is
+    # ASYNC (a daemon thread, so trigger never stalls the serve
+    # loop): poll the run dir for the summary.
+    assert incident.trigger("watchdog-kill", lane=0) is not None
+    assert incident.trigger("quarantine", lane=0) is None  # suppressed
+    deadline = time.monotonic() + 10.0
+    while (not profiler.list_summaries(str(traced))
+           and time.monotonic() < deadline):
+        time.sleep(0.02)
+    assert profiler.wait_idle(10)
+    assert len(profiler.list_summaries(str(traced))) == 1
+    doc = profiler.load_summary(profiler.list_summaries(str(traced))[0])
+    assert doc["armed_by"] == "incident"
+    assert incident.counts()["dumped"] == 1
+
+
+def test_incident_capture_off_by_default(traced):
+    assert incident.trigger("slo-breach") is not None
+    assert profiler.active() is None
+    assert profiler.list_summaries(str(traced)) == []
+
+
+# ---------------------------------------------------------------------------
+# report --profile: the rendered join + gates.
+# ---------------------------------------------------------------------------
+
+
+def test_report_profile_renders_join_and_gates(traced, capsys):
+    rec = costmodel.analytic_cost("jnp", "ctr", 64, 10, 8)
+    costmodel.write_run_records([rec], engine="jnp", ceiling_gbps=5.0)
+    profiler.start_window(None, armed_by="api")
+    metrics.counter("serve_rung_dispatches", 4, rung=64, engine="jnp",
+                    mode="ctr", nr=10)
+    metrics.counter("serve_rung_device_us", 2000, rung=64, engine="jnp",
+                    mode="ctr", nr=10)
+    profiler.stop_window()
+    buf = io.StringIO()
+    rc = report.render_profile(str(traced), check=True, out=buf)
+    out = buf.getvalue()
+    assert rc == 0
+    assert "tier=stack" in out and "armed_by=api" in out
+    assert "GB/s moved" in out and "jnp" in out
+    # CLI surface: --profile --check over the same run dir is rc 0.
+    assert report.main([str(traced), "--profile", "--check"]) == 0
+    capsys.readouterr()
+
+
+def test_report_profile_check_fails_without_capture(traced):
+    trace.point("anything")  # materialise the run dir + trace file
+    buf = io.StringIO()
+    assert report.render_profile(str(traced), check=True, out=buf) == 2
+    assert report.render_profile(str(traced), check=False,
+                                 out=io.StringIO()) == 0
+
+
+def test_report_profile_check_fails_on_invalid_summary(traced):
+    trace.point("anything")
+    bad = traced / "profile-1-deadbeef-1.json"
+    bad.write_text(json.dumps({"kind": "nope"}))
+    buf = io.StringIO()
+    assert report.render_profile(str(traced), check=True, out=buf) == 2
+    assert "SCHEMA-INVALID" in buf.getvalue()
+
+
+def test_exemplar_rows_resolve_span_chains(traced):
+    with trace.span("outer", unit="u"):
+        cm = trace.detached_span("inner")
+        cm.__enter__()
+        sid = cm.span_id
+        metrics.observe("serve_dispatch_us", 5000,
+                        exemplar={"span": sid, "trace": trace.run_id()})
+        cm.__exit__(None, None, None)
+    # A second exemplar pointing NOWHERE: its chain must read broken.
+    metrics.observe("serve_stage_us", 9000, stage="device",
+                    exemplar={"span": "nope.1", "trace": trace.run_id()})
+    metrics.flush_now()
+    run = export.load_run(str(traced))
+    rows = report.exemplar_rows(run, top=10)
+    by_hist = {r["hist"]: r for r in rows}
+    good = by_hist["serve_dispatch_us"]
+    assert good["complete"] and good["chain"] == ["inner", "outer"]
+    bad = by_hist["serve_stage_us{stage=device}"]
+    assert not bad["complete"] and bad["chain"] == []
+    # With a valid capture on file, --profile renders rc 0 — and
+    # --check still fails, now naming the BROKEN exemplar row.
+    profiler.start_window(None, armed_by="api")
+    profiler.stop_window()
+    assert report.main([str(traced), "--profile"]) == 0
+    rc = report.main([str(traced), "--profile", "--check"])
+    assert rc == 2
+
+
+# ---------------------------------------------------------------------------
+# Router federation (route/status.py): one operator request, per-backend
+# relay through the proxy seam.
+# ---------------------------------------------------------------------------
+
+
+class _StubSpec:
+    def __init__(self, status_port):
+        self.status_port = status_port
+
+
+class _StubBackend:
+    def __init__(self, status_port, result):
+        self.spec = _StubSpec(status_port)
+        self._result = result
+        self.asked_seconds = None
+
+    async def poll_profilez(self, seconds, timeout_s=5.0):
+        self.asked_seconds = seconds
+        if isinstance(self._result, Exception):
+            raise self._result
+        return self._result
+
+
+class _StubRouter:
+    def __init__(self, backends):
+        self.backends = backends
+
+
+def test_router_profilez_federates_per_backend():
+    from our_tree_tpu.route.status import RouterStatus
+
+    b0 = _StubBackend(1234, {"code": 200, "doc": {"armed": True,
+                                                  "tier": "stack"}})
+    b1 = _StubBackend(1235, {"code": 409, "doc": {"error": "busy"}})
+    b2 = _StubBackend(1236, None)            # unreachable
+    b3 = _StubBackend(None, None)            # no status port: skipped
+    rs = RouterStatus(_StubRouter({"b0": b0, "b1": b1, "b2": b2,
+                                   "b3": b3}), port=0)
+    code, doc = asyncio.run(rs.profilez_async(2.0))
+    assert code == 200 and doc["armed"] == 1
+    assert doc["federated"]["b0"]["tier"] == "stack"
+    assert doc["federated"]["b1"]["code"] == 409
+    assert doc["federated"]["b2"] == {"error": "unreachable"}
+    assert "b3" not in doc["federated"]
+    assert b0.asked_seconds == 2.0
+    # Every backend busy -> 409; none reachable -> 503.
+    rs = RouterStatus(_StubRouter({"b1": b1}), port=0)
+    assert asyncio.run(rs.profilez_async(1.0))[0] == 409
+    rs = RouterStatus(_StubRouter({"b2": b2}), port=0)
+    assert asyncio.run(rs.profilez_async(1.0))[0] == 503
