@@ -581,10 +581,13 @@ def _check_serve_lane(ctx: FileContext):
 #: is exactly the failure mode the seam exists to contain.
 _ROUTE_CONTACT_TAILS = ("open_connection", "create_connection",
                         "read_frame", "encode_frame")
-#: The seam file plus the harness entry (route/bench.py drives workers
+#: The seam files plus the harness entry (route/bench.py drives workers
 #: and references engines the way serve/bench.py does — it is the
-#: operator tool, not the routing tier).
-_ROUTE_SEAM_FILES = ("route/proxy.py",)
+#: operator tool, not the routing tier). route/fleet.py is seam tier:
+#: the replica server + gossip exchange speak the framed wire directly
+#: (they ARE transport endpoints), and all per-request backend contact
+#: still flows through the proxy it wraps.
+_ROUTE_SEAM_FILES = ("route/proxy.py", "route/fleet.py")
 _ROUTE_HARNESS_FILES = ("route/bench.py",)
 
 

@@ -124,6 +124,35 @@ point              wired into
                    must keep serving). Fires at the host finisher, not
                    inside the fused kernel: a real mismatch is a DATA
                    event, not a dispatch fault.
+``pool_stale``     the router's pooled-transport acquire seam
+                   (``route/proxy.py:Backend._exchange``): the next
+                   exchange behaves as if its pooled connection was
+                   half-closed under the router — first use raises a
+                   reset. Usually backend-scoped
+                   (``pool_stale:1@backend=1``). The request must ride
+                   the ring-retry failover (one redispatch, no error)
+                   and the NEXT exchange to that backend re-dials
+                   through the pool's RetryPolicy reconnect path — the
+                   deterministic rehearsal CI's elasticity drive gates
+                   the pool on.
+``worker_slow_start`` the fleet supervisor's spawn seam
+                   (``route/fleet.py:FleetSupervisor._boot``): the
+                   newly-booted worker takes ``OT_SLOW_S`` (default
+                   0.05 s) longer to go READY — a slow cold start.
+                   Scoped by SPAWN ORDINAL
+                   (``worker_slow_start:1@backend=2`` = the third
+                   worker the supervisor ever boots). The scale event
+                   completes late; riders never see it (the fleet
+                   serves on the old set while the newcomer warms).
+``scale_stall``    the fleet supervisor's scale-event seam (spawn AND
+                   retire, ``route/fleet.py``): the decided scale
+                   event aborts before touching the fleet — a stalled
+                   provisioner. Scoped by spawn ordinal on the grow
+                   side and by the victim's backend index on the
+                   shrink side. The supervisor counts + traces a
+                   ``stall`` event and retries at the next tick past
+                   cooldown; membership, placement, and riders are
+                   untouched.
 =================  ========================================================
 
 Determinism contract: firings consume counts in call order within ONE
@@ -151,7 +180,8 @@ import time
 KNOWN_POINTS = ("init_hang", "dispatch_fail", "build_fail", "lock_busy",
                 "dispatch_hang", "unit_crash", "serve_dispatch",
                 "lane_fail", "lane_hang", "dispatch_slow",
-                "backend_fail", "backend_hang", "tag_mismatch")
+                "backend_fail", "backend_hang", "tag_mismatch",
+                "pool_stale", "worker_slow_start", "scale_stall")
 
 #: Scope names the ``@<scope>=<i>`` qualifier accepts: ``lane`` (serve
 #: dispatch lanes) and ``backend`` (the router's backend index).

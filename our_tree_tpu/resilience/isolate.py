@@ -20,6 +20,12 @@ scripts/e2e_decompose.py, and now the sweep itself):
   through the shared ``RetryPolicy`` — attempts, backoff, and
   exhaustion live in ONE place.
 
+* ``run_streamed`` — the same deadline/group-kill contract with merged
+  stdout+stderr streamed live into a caller-owned sink instead of
+  captured: the shape of a multi-hour plan step whose partial log tail
+  is the evidence of where a wedge hit (scripts/recover_watch.py, the
+  last pre-isolate supervisor, runs on it).
+
 * ``run_isolated_sweep`` — the ``--isolate`` mode's supervisor: each
   sweep unit runs in a child process (the child targets exactly one
   unit and appends it to the shared journal itself), hangs are
@@ -269,6 +275,20 @@ class ServiceChild:
                      rc=self.proc.returncode)
         return self.proc.returncode
 
+    def kill(self) -> int:
+        """SIGKILL the whole group NOW — no drain signal first (the
+        chaos-drive path: a process that vanishes mid-frame, not one
+        asked to leave; ``stop(0.0)`` still sends the SIGTERM courtesy
+        shot). Reaps and returns the rc (negative, POSIX convention)."""
+        if self.proc.poll() is None:
+            _kill_group(self.proc)
+            self.proc.wait()
+        tr = _trace()
+        if tr is not None:
+            tr.point("service-killed", label=self.name,
+                     rc=self.proc.returncode)
+        return self.proc.returncode
+
     def drain_output(self) -> tuple[str, str]:
         """Whatever stdout/stderr remain after exit (including any
         buffered ready-line tail) — call only once the child is dead."""
@@ -299,6 +319,49 @@ def spawn_service(argv, *, env=None, cwd=None, name: str = "") -> ServiceChild:
         tr.point("service-spawned",
                  label=name or os.path.basename(str(argv[0])), pid=proc.pid)
     return ServiceChild(name or os.path.basename(str(argv[0])), proc)
+
+
+def run_streamed(argv, timeout_s: float | None = None, *, env=None,
+                 cwd=None, sink=None, name: str = "") -> ChildResult:
+    """Run ``argv`` with a wall deadline, STREAMING merged stdout+stderr
+    into ``sink`` (an open writable file object) as the child produces
+    it — the fourth child shape next to ``run_child`` (capture, read
+    after exit), ``spawn_service`` (piped, read deliberately), and the
+    sweep supervisor: a run-to-completion step whose output is the
+    operator's live log, e.g. a multi-hour hardware plan step
+    (scripts/recover_watch.py) whose partial tail is the only evidence
+    of where a wedge hit. Same session/group-kill semantics as
+    ``run_child``: the child leads its own session and the whole group
+    is SIGKILLed at the deadline (plan steps parent jax subprocesses of
+    their own — killing only the step would orphan a grandchild that
+    keeps driving the device). ``out``/``err`` on the returned
+    ``ChildResult`` are always "" — the sink holds the output. With
+    ``sink=None`` the child inherits the caller's stdio (stream to the
+    terminal)."""
+    tr = _trace()
+    cenv = dict(env if env is not None else os.environ)
+    if tr is not None:
+        cenv = tr.child_env(cenv)
+    t0 = time.monotonic()
+    with (tr.span("child", label=name or os.path.basename(str(argv[0])),
+                  streamed=1)
+          if tr is not None else _null_cm()):
+        proc = subprocess.Popen(
+            argv, env=cenv, cwd=cwd, stdout=sink,
+            stderr=subprocess.STDOUT if sink is not None else None,
+            start_new_session=True)
+        try:
+            rc = proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            _kill_group(proc)
+            proc.wait()
+            if tr is not None:
+                tr.point("child-killed", label=name,
+                         wall_s=round(time.monotonic() - t0, 3))
+            return ChildResult("timeout", proc.returncode, "", "",
+                               time.monotonic() - t0)
+    return ChildResult("ok" if rc == 0 else "crash", rc, "", "",
+                       time.monotonic() - t0)
 
 
 def run_child(argv, timeout_s: float | None = None, *, env=None, cwd=None,

@@ -49,11 +49,15 @@ import json
 import os
 import re
 import sys
+import time
 
 from ..obs import metrics, slo, trace
-from ..resilience import degrade, isolate
+from ..resilience import degrade, faults, isolate
 from ..resilience import journal as journal_mod
 from ..serve import loadgen, wire
+from .fleet import (REPLICA_EXIT_KIND, REPLICA_KIND, FailoverClient,
+                    FleetConfig, FleetSupervisor, ProcessWorkerHandle,
+                    RouterServer, worker_argv)
 from .proxy import BackendSpec, Router, RouterConfig
 from .status import RouterStatus
 
@@ -264,6 +268,511 @@ async def _drive(args, specs, affinity: bool, probes):
     return router, report, healthz
 
 
+async def _drive_fleet(args, probes) -> dict:
+    """The ELASTICITY drive (``--autoscale``): the fleet supervisor owns
+    every worker's lifecycle over one live open-loop drive — scale up
+    against real pressure, roll one worker through the bit-exact canary
+    handoff, lose one router replica to SIGKILL, scale back down to the
+    floor once the load passes — while the zero-lost / bit-exact /
+    zero-recompile contracts hold throughout. Returns everything
+    ``_main_fleet`` folds into the artifact."""
+    env = {k: v for k, v in os.environ.items() if k != "OT_FAULTS"}
+    wargv = worker_argv(
+        engine=args.engine, bucket_min=args.bucket_min,
+        bucket_max=args.bucket_max, queue_depth=args.worker_queue_depth,
+        tenant_depth_frac=args.tenant_depth_frac,
+        dispatch_deadline=args.dispatch_deadline,
+        modes=",".join(args.mode_list), lanes=args.worker_lanes)
+
+    def factory(name: str) -> ProcessWorkerHandle:
+        return ProcessWorkerHandle(name, wargv, env=dict(env),
+                                   ready_deadline_s=READY_DEADLINE_S)
+
+    loop = asyncio.get_running_loop()
+    max_frame = max(args.bucket_max * 16 * 2, wire.MAX_PAYLOAD)
+
+    # -- the floor fleet (b0..), booted concurrently through the SAME
+    # handle/argv template the autoscaler will spawn with, then handed
+    # to the supervisor so retire/roll own the full lifecycle.
+    names = [f"b{i}" for i in range(args.backends)]
+    handles = [factory(n) for n in names]
+    replicas: list[dict] = []
+    sup = None
+
+    async def _abandon():
+        for r in replicas:
+            await loop.run_in_executor(None, r["handle"].kill)
+        fleet = (list(sup.workers.values()) if sup is not None
+                 else list(handles))
+        for h in fleet:
+            await h.kill()
+
+    try:
+        specs = []
+        for n, spec in zip(names,
+                           await asyncio.gather(*(h.start()
+                                                  for h in handles))):
+            if spec is None:
+                raise RuntimeError(
+                    f"fleet worker {n} never came ready within "
+                    f"{READY_DEADLINE_S:.0f}s")
+            specs.append(spec)
+            print(f"# worker {n}: port {spec.port} "
+                  f"status {spec.status_port} pid {spec.pid}",
+                  file=sys.stderr)
+
+        cfg = RouterConfig(
+            deadline_s=args.deadline,
+            attempt_timeout_s=args.attempt_timeout,
+            gossip_every_s=args.gossip_every,
+            probation_batches=args.probation_batches,
+            vnodes=args.vnodes, affinity=True, seed=args.seed,
+            journal=args.journal, max_frame_bytes=max_frame)
+        router = Router(specs, cfg)
+        await router.start()
+
+        sup = FleetSupervisor(router, factory, FleetConfig(
+            min_workers=args.backends, max_workers=args.fleet_max,
+            up_depth=args.up_depth, down_depth=args.down_depth,
+            up_busy=args.up_busy, settle_ticks=args.settle_ticks,
+            down_settle_ticks=args.down_settle_ticks,
+            cooldown_s=args.cooldown, poll_every_s=args.poll_every))
+        for n, h in zip(names, handles):
+            sup.adopt(n, h)
+
+        status = None
+        if args.status_port is not None:
+            status = RouterStatus(router, args.status_port,
+                                  federate=not args.no_federate,
+                                  fleet=sup)
+            await status.start()
+            print(f"# router status: 127.0.0.1:{status.port} "
+                  f"(/fleetz live)", file=sys.stderr)
+
+        # -- the replicated router tier: the owner exposes its Router +
+        # membership authority on the framed wire; each replica process
+        # gossips with it and serves the same fleet. The failover
+        # client leads with replica r0 (the one the chaos step kills)
+        # and falls back to the owner, then the remaining replicas.
+        owner_server = None
+        client = router
+        if args.routers > 0:
+            owner_server = RouterServer(
+                router, view_fn=lambda: (sup.epoch, sup.view()),
+                max_frame_bytes=max_frame)
+            await owner_server.start()
+            member_json = json.dumps([
+                {"name": s.name, "host": s.host, "port": s.port,
+                 "status_port": s.status_port} for s in specs])
+            for j in range(args.routers):
+                argv = [sys.executable, "-m", "our_tree_tpu.route.fleet",
+                        "--port", "0", "--backends", member_json,
+                        "--peer", f"127.0.0.1:{owner_server.port}",
+                        "--gossip-every",
+                        str(min(args.gossip_every, 0.25)),
+                        "--attempt-timeout", str(args.attempt_timeout),
+                        "--deadline", str(args.deadline),
+                        "--max-frame-bytes", str(max_frame)]
+                h = isolate.spawn_service(argv, env=dict(env),
+                                          name=f"route:r{j}")
+                line = await loop.run_in_executor(
+                    None, h.read_line, READY_DEADLINE_S)
+                doc = None
+                if line:
+                    try:
+                        doc = json.loads(line)
+                    except ValueError:
+                        doc = None
+                if not (isinstance(doc, dict)
+                        and doc.get("kind") == REPLICA_KIND):
+                    replicas.append({"name": f"r{j}", "handle": h,
+                                     "killed": False})
+                    raise RuntimeError(
+                        f"router replica r{j} (pid {h.pid}) never came "
+                        f"ready (got {line!r})")
+                replicas.append({"name": f"r{j}", "handle": h,
+                                 "port": int(doc["port"]),
+                                 "killed": False})
+                print(f"# router replica r{j}: pid {h.pid} "
+                      f"port {doc['port']}", file=sys.stderr)
+            peers = ([("127.0.0.1", replicas[0]["port"]),
+                      ("127.0.0.1", owner_server.port)]
+                     + [("127.0.0.1", r["port"]) for r in replicas[1:]])
+            client = FailoverClient(
+                peers, attempt_timeout_s=args.attempt_timeout,
+                deadline_s=args.deadline, max_frame_bytes=max_frame)
+
+        # -- the chaos timeline, next to the supervisor loop.
+        stop_ev = asyncio.Event()
+        sup_task = asyncio.ensure_future(sup.run(stop_ev))
+        t0 = time.monotonic()
+        chaos: list[asyncio.Task] = []
+
+        async def arm_faults_later():
+            # Armed AFTER the startup canaries (and optionally deep
+            # into the drive): the injected fault rehearses the
+            # steady-state seams — a stale pooled socket with a live
+            # fleet to redispatch into — not the join checks, and not
+            # a one-member ring with nowhere to go.
+            await asyncio.sleep(args.drive_faults_after)
+            os.environ["OT_FAULTS"] = args.drive_faults
+            faults.reset()
+            print(f"# faults armed at +{time.monotonic() - t0:.1f}s: "
+                  f"{args.drive_faults}", file=sys.stderr)
+
+        if args.drive_faults:
+            chaos.append(asyncio.ensure_future(arm_faults_later()))
+
+        async def roll_later():
+            await asyncio.sleep(args.roll_after)
+            ok = await sup.roll_one()
+            print(f"# roll at +{time.monotonic() - t0:.1f}s: "
+                  f"{'replaced' if ok else 'ABORTED'}", file=sys.stderr)
+
+        async def kill_router_later():
+            await asyncio.sleep(args.kill_router_after)
+            r = replicas[0]
+            r["killed"] = True
+            await loop.run_in_executor(None, r["handle"].kill)
+            trace.point("router-killed", replica=r["name"],
+                        pid=r["handle"].pid)
+            print(f"# router {r['name']} SIGKILLed at "
+                  f"+{time.monotonic() - t0:.1f}s", file=sys.stderr)
+
+        if args.roll_after is not None:
+            chaos.append(asyncio.ensure_future(roll_later()))
+        if args.kill_router_after is not None and replicas:
+            chaos.append(asyncio.ensure_future(kill_router_later()))
+
+        report = await loadgen.run(
+            client, args.requests, concurrency=args.concurrency,
+            sizes=args.sizes, tenants=args.tenants,
+            keys_per_tenant=args.keys_per_tenant, seed=args.seed,
+            verify_every=args.verify_every, probes=probes,
+            arrival_rate=args.arrival_rate, modes=args.mode_list)
+        for c in await asyncio.gather(*chaos, return_exceptions=True):
+            if isinstance(c, BaseException):
+                raise c
+
+        # -- the settle window: load has passed, the supervisor keeps
+        # ticking against an idle fleet until it has shrunk back to the
+        # floor (the deterministic scale-down) or the window closes.
+        # A held resize lock counts as "not settled": a queued scale
+        # event may still move the size after we read it.
+        t_end = time.monotonic() + args.settle_timeout
+        while (time.monotonic() < t_end
+               and (len(router.backends) > args.backends
+                    or sup.resizing)):
+            await asyncio.sleep(args.poll_every)
+        stop_ev.set()
+        await sup_task
+
+        await router.gossip_once()
+        healthz = {name: b.last_healthz
+                   for name, b in router.backends.items()}
+        rstats = router.stats()
+        releases = router.release_events()
+        fleet_doc = sup.fleetz()
+
+        router_docs = []
+        for r in replicas:
+            h = r["handle"]
+            rc = await loop.run_in_executor(None, h.stop, 30.0)
+            out, _err = h.drain_output()
+            doc = {}
+            for raw in reversed(out.splitlines()):
+                try:
+                    cand = json.loads(raw)
+                except ValueError:
+                    continue
+                if (isinstance(cand, dict)
+                        and cand.get("kind") == REPLICA_EXIT_KIND):
+                    doc = cand
+                    break
+            router_docs.append({"name": r["name"], "rc": rc,
+                                "killed": r["killed"], **doc})
+
+        if status is not None:
+            await status.stop()
+        if owner_server is not None:
+            await owner_server.stop()
+        await sup.close(drain=True)
+        await router.stop()
+    except BaseException:
+        await _abandon()
+        raise
+
+    client_stats = None
+    if isinstance(client, FailoverClient):
+        client_stats = {"submitted": client.submitted,
+                        "failovers": client.failovers,
+                        "backpressure_retries": client.backpressure_retries,
+                        "peers": len(client.peers)}
+    return {"report": report, "router": rstats, "healthz": healthz,
+            "releases": releases, "fleet": fleet_doc,
+            "events": list(sup.events), "workers": sup.exit_docs,
+            "routers": router_docs, "client": client_stats}
+
+
+def _main_fleet(args, probes) -> int:
+    """The ``--autoscale`` tail of ``main``: run the elasticity drive,
+    narrate it, write the artifact, apply the fleet gates."""
+    res = asyncio.run(_drive_fleet(args, probes))
+    report, rstats = res["report"], res["router"]
+    fleet, client = res["fleet"], res["client"]
+    exit_docs = res["workers"]
+
+    lost_workers = sum(int(d.get("lost") or 0) for d in exit_docs)
+    crashed = [d for d in exit_docs if d.get("rc")]
+    lost_replicas = sum(int(d.get("lost") or 0) for d in res["routers"]
+                        if not d["killed"])
+    replica_bad_rc = [d for d in res["routers"]
+                      if not d["killed"] and d.get("rc")]
+    lost_router = rstats["lost"]
+    recompiles = sum(int(d.get("recompiles") or 0) for d in exit_docs)
+    waterfall = waterfall_stats(report.ledgers)
+    wire_p50 = (waterfall["stages"].get("wire") or {}).get("p50_us")
+    pool = dict(rstats.get("pool_retired")
+                or {"hits": 0, "dials": 0, "stale": 0})
+    for b in rstats["backends"].values():
+        for k in pool:
+            pool[k] += int((b.get("pool") or {}).get(k, 0))
+    # The before/after the pool satellite promises: the committed
+    # pre-pool wire p50 (ROUTE_r02 pinned it) next to this run's.
+    prepool_wire_p50 = None
+    try:
+        with open(os.path.join(_repo_root(), "ROUTE_r02.json"),
+                  encoding="utf-8") as fh:
+            prepool_wire_p50 = json.load(
+                fh)["waterfall"]["stages"]["wire"]["p50_us"]
+    except (OSError, ValueError, KeyError):
+        pass
+
+    print(f"# fleet: floor={args.backends} max={args.fleet_max} "
+          f"up_depth={args.up_depth:g} down_depth={args.down_depth:g} "
+          f"cooldown={args.cooldown:g}s routers={args.routers}")
+    print(f"# requests={report.requests} ok={report.ok} "
+          f"errors={report.errors or '{}'} lost_router={lost_router} "
+          f"lost_replicas={lost_replicas} lost_workers={lost_workers} "
+          f"verified={report.verified} mismatches={report.mismatches}")
+    print(f"# latency ms: p50={report.p50_ms} p95={report.p95_ms} "
+          f"p99={report.p99_ms}  goodput={report.goodput_gbps:.4f} GB/s "
+          f"wall={report.wall_s:.3f}s")
+    print(f"# elasticity: ups={fleet['scale_ups']} "
+          f"downs={fleet['scale_downs']} rolled={fleet['rolled']} "
+          f"roll_aborts={fleet['roll_aborts']} stalls={fleet['stalls']} "
+          f"spawn_failures={fleet['spawn_failures']} "
+          f"drained_lost={fleet['drained_lost']}")
+    for ev in res["events"]:
+        print(f"#   event {ev['kind']:<12} worker={ev['worker'] or '-'} "
+              f"size={ev['size']} epoch={ev['epoch']}"
+              + (f" successor={ev['successor']}"
+                 if "successor" in ev else ""))
+    if client is not None:
+        print(f"# router tier: peers={client['peers']} "
+              f"client_failovers={client['failovers']} "
+              f"backpressure_retries={client['backpressure_retries']} "
+              + " ".join(f"{d['name']}:"
+                         f"{'KILLED' if d['killed'] else d.get('rc')}"
+                         f"/lost={d.get('lost')}"
+                         for d in res["routers"]))
+    print(f"# pool: hits={pool['hits']} dials={pool['dials']} "
+          f"stale={pool['stale']}  wire_p50={wire_p50}µs "
+          f"(pre-pool ROUTE_r02: {prepool_wire_p50}µs)  "
+          f"redispatches={rstats['redispatches']}")
+    if waterfall["sampled"]:
+        print(f"# waterfall: {waterfall['complete']}/"
+              f"{waterfall['sampled']} sampled requests complete "
+              f"({waterfall['complete_frac']:.1%}), stage sum within "
+              f"{waterfall['tolerance']:.0%} of e2e on "
+              f"{waterfall['sum_within_tol_frac']:.1%} of them")
+        for s in WATERFALL_STAGES:
+            st = waterfall["stages"].get(s)
+            if st and st["count"]:
+                print(f"#   stage {s:<13} p50={st['p50_us']:>8.0f}µs "
+                      f"p95={st['p95_us']:>8.0f}µs "
+                      f"p99={st['p99_us']:>8.0f}µs  (n={st['count']})")
+
+    artifact = {
+        "config": {
+            "backends": args.backends, "requests": args.requests,
+            "concurrency": args.concurrency, "sizes": list(args.sizes),
+            "tenants": args.tenants,
+            "keys_per_tenant": args.keys_per_tenant,
+            "engine": args.engine, "vnodes": args.vnodes,
+            "modes": list(args.mode_list),
+            "affinity": True, "ab": False, "autoscale": True,
+            "attempt_timeout_s": args.attempt_timeout,
+            "gossip_every_s": args.gossip_every,
+            "worker_lanes": args.worker_lanes,
+            "arrival_rate": args.arrival_rate,
+            "seed": args.seed,
+            "fleet": {"max_workers": args.fleet_max,
+                      "up_depth": args.up_depth,
+                      "down_depth": args.down_depth,
+                      "up_busy": args.up_busy,
+                      "settle_ticks": args.settle_ticks,
+                      "down_settle_ticks": args.down_settle_ticks,
+                      "cooldown_s": args.cooldown,
+                      "poll_every_s": args.poll_every,
+                      "roll_after_s": args.roll_after,
+                      "routers": args.routers,
+                      "kill_router_after_s": args.kill_router_after,
+                      "drive_faults": args.drive_faults,
+                      "drive_faults_after_s": args.drive_faults_after},
+        },
+        "load": report.to_json(),
+        "router": rstats,
+        "queue": {"lost": lost_router + lost_replicas + lost_workers,
+                  "lost_router": lost_router,
+                  "lost_replicas": lost_replicas,
+                  "lost_workers": lost_workers},
+        "compiles": {"steady": recompiles},
+        "workers": exit_docs,
+        "fleet": {**fleet, "events": res["events"]},
+        "routers": {"count": args.routers, "docs": res["routers"],
+                    "client": client},
+        "pool": {**pool, "wire_p50_us": wire_p50,
+                 "wire_p50_us_prepool_r02": prepool_wire_p50},
+        "waterfall": waterfall,
+        "stages": waterfall["stages"],
+        "healthz": res["healthz"],
+        "degraded": degrade.events(),
+        "metrics": metrics.snapshot(),
+    }
+    if trace.enabled():
+        artifact["obs"] = trace.metrics_snapshot()
+        artifact["trace_sample"] = trace.sample_rate()
+    path = args.artifact or _next_artifact(_repo_root())
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(artifact, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    print(f"# artifact: {path}", file=sys.stderr)
+
+    slo_rc = 0
+    if args.slo:
+        try:
+            slo_rc = slo.gate(args.slo, artifact, args.slo_tolerance)
+        except (OSError, ValueError, KeyError) as e:
+            print(f"# slo: gate unusable: {e}", file=sys.stderr)
+            slo_rc = 1
+
+    line = {"unit": "route-fleet", "backends": args.backends,
+            "requests": report.requests, "ok": report.ok,
+            "errors": dict(sorted(report.errors.items())),
+            "lost": lost_router + lost_replicas + lost_workers,
+            "p50_ms": report.p50_ms, "p95_ms": report.p95_ms,
+            "p99_ms": report.p99_ms,
+            "goodput_gbps": round(report.goodput_gbps, 4),
+            "scale_ups": fleet["scale_ups"],
+            "scale_downs": fleet["scale_downs"],
+            "rolled": fleet["rolled"],
+            "roll_aborts": fleet["roll_aborts"],
+            "client_failovers": (client or {}).get("failovers", 0),
+            "redispatches": rstats["redispatches"],
+            "recompiles": recompiles,
+            "mismatches": report.mismatches,
+            "pool_hits": pool["hits"], "wire_p50_us": wire_p50,
+            "waterfall_complete_frac": waterfall["complete_frac"],
+            "waterfall_sum_ok_frac": waterfall["sum_within_tol_frac"]}
+    if args.slo:
+        line["slo"] = "fail" if slo_rc else "pass"
+    if degrade.events():
+        line["degraded"] = degrade.events()
+    print(json.dumps(line))
+
+    rc = 0
+    if report.mismatches:
+        print(f"# FAIL: {report.mismatches} probe response(s) mismatched "
+              "the byte-exact reference THROUGH the elastic fleet",
+              file=sys.stderr)
+        rc = 1
+    if lost_router or lost_replicas or lost_workers:
+        print(f"# FAIL: lost requests (router={lost_router}, "
+              f"replicas={lost_replicas}, workers={lost_workers}) — the "
+              "drain/failover contract is broken", file=sys.stderr)
+        rc = 1
+    if crashed:
+        print(f"# FAIL: worker(s) exited nonzero: "
+              + ", ".join(f"{d['name']}:rc={d['rc']}" for d in crashed),
+              file=sys.stderr)
+        rc = 1
+    if replica_bad_rc:
+        print(f"# FAIL: surviving router replica(s) exited nonzero: "
+              + ", ".join(f"{d['name']}:rc={d['rc']}"
+                          for d in replica_bad_rc), file=sys.stderr)
+        rc = 1
+    if recompiles and not args.allow_recompiles:
+        print(f"# FAIL: {recompiles} post-warmup backend compile(s) "
+              "across the fleet (--allow-recompiles to waive)",
+              file=sys.stderr)
+        rc = 1
+    if args.require_zero_errors and report.errors:
+        print(f"# FAIL: request errors {report.errors} — failover did "
+              "not absorb the churn", file=sys.stderr)
+        rc = 1
+    if (args.min_scale_ups is not None
+            and fleet["scale_ups"] < args.min_scale_ups):
+        print(f"# FAIL: {fleet['scale_ups']} scale-up(s) < "
+              f"{args.min_scale_ups} — the autoscaler never grew the "
+              "fleet", file=sys.stderr)
+        rc = 1
+    if (args.min_scale_downs is not None
+            and fleet["scale_downs"] < args.min_scale_downs):
+        print(f"# FAIL: {fleet['scale_downs']} scale-down(s) < "
+              f"{args.min_scale_downs} — the fleet never shrank back",
+              file=sys.stderr)
+        rc = 1
+    if args.expect_rolls is not None:
+        if fleet["rolled"] != args.expect_rolls:
+            print(f"# FAIL: {fleet['rolled']} rolled worker(s), expected "
+                  f"exactly {args.expect_rolls}", file=sys.stderr)
+            rc = 1
+        if fleet["roll_aborts"]:
+            print(f"# FAIL: {fleet['roll_aborts']} roll abort(s) — the "
+                  "canary handoff rejected a successor", file=sys.stderr)
+            rc = 1
+    if (args.min_client_failovers is not None
+            and (client or {}).get("failovers", 0)
+            < args.min_client_failovers):
+        print(f"# FAIL: {(client or {}).get('failovers', 0)} client "
+              f"failover(s) < {args.min_client_failovers} — the router "
+              "kill never exercised the tier", file=sys.stderr)
+        rc = 1
+    if (args.min_redispatch is not None
+            and rstats["redispatches"] < args.min_redispatch):
+        print(f"# FAIL: redispatches {rstats['redispatches']} < "
+              f"{args.min_redispatch} — the injected pool fault never "
+              "rode the ring-retry failover", file=sys.stderr)
+        rc = 1
+    if args.max_wire_p50_us is not None:
+        if wire_p50 is None or wire_p50 > args.max_wire_p50_us:
+            print(f"# FAIL: wire stage p50 {wire_p50}µs not under "
+                  f"{args.max_wire_p50_us:g}µs — pooling bought nothing "
+                  f"(pre-pool ROUTE_r02: {prepool_wire_p50}µs)",
+                  file=sys.stderr)
+            rc = 1
+    if (args.min_waterfall_complete is not None
+            and waterfall["complete_frac"] < args.min_waterfall_complete):
+        print(f"# FAIL: only {waterfall['complete_frac']:.1%} of sampled "
+              f"requests reconstructed a complete cross-process "
+              f"waterfall (< {args.min_waterfall_complete:.1%})",
+              file=sys.stderr)
+        rc = 1
+    if (args.min_stage_sum_ok is not None
+            and waterfall["sum_within_tol_frac"] < args.min_stage_sum_ok):
+        print(f"# FAIL: stage sums match end-to-end latency on only "
+              f"{waterfall['sum_within_tol_frac']:.1%} of complete "
+              f"waterfalls (< {args.min_stage_sum_ok:.1%})",
+              file=sys.stderr)
+        rc = 1
+    if slo_rc:
+        print(f"# FAIL: SLO regression against {args.slo}",
+              file=sys.stderr)
+        rc = 1
+    return rc
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m our_tree_tpu.route.bench",
@@ -378,7 +887,103 @@ def main(argv=None) -> int:
     ap.add_argument("--min-redispatch", type=int, default=None, metavar="N",
                     help="fail unless redispatches >= N (the failover "
                          "actually happened)")
+    fl = ap.add_argument_group(
+        "fleet elasticity (--autoscale; docs/SERVING.md)")
+    fl.add_argument("--autoscale", action="store_true",
+                    help="hand the worker fleet to the FleetSupervisor: "
+                         "--backends is the floor, the drive scales up "
+                         "under pressure and drains back down once load "
+                         "passes (route/fleet.py)")
+    fl.add_argument("--fleet-max", type=int, default=4, metavar="N",
+                    help="autoscaler ceiling (default 4)")
+    fl.add_argument("--up-depth", type=float, default=8.0, metavar="D",
+                    help="mean queue depth per worker that triggers a "
+                         "scale-up (default 8)")
+    fl.add_argument("--down-depth", type=float, default=1.0, metavar="D",
+                    help="mean depth the fleet must idle UNDER before a "
+                         "scale-down (default 1)")
+    fl.add_argument("--up-busy", type=float, default=0.95, metavar="FRAC",
+                    help="lane-busy fraction that also triggers growth")
+    fl.add_argument("--settle-ticks", type=int, default=2, metavar="N",
+                    help="consecutive out-of-band polls before a scale "
+                         "event (hysteresis; default 2)")
+    fl.add_argument("--down-settle-ticks", type=int, default=None,
+                    metavar="N",
+                    help="separate (usually much larger) settle count "
+                         "for shrinking: pressure is bursty, idleness "
+                         "must be sustained (default: --settle-ticks)")
+    fl.add_argument("--cooldown", type=float, default=3.0, metavar="S",
+                    help="minimum seconds between fleet resizes")
+    fl.add_argument("--poll-every", type=float, default=0.25, metavar="S",
+                    help="supervisor poll period")
+    fl.add_argument("--roll-after", type=float, default=None, metavar="S",
+                    help="start a rolling upgrade of ONE worker this many "
+                         "seconds into the drive (bit-exact canary "
+                         "handoff — the successor must answer the join "
+                         "canaries byte-for-byte or the roll aborts)")
+    fl.add_argument("--routers", type=int, default=0, metavar="N",
+                    help="spawn N replicated router processes "
+                         "(route.fleet replicas) gossiping with the "
+                         "in-process owner; the loadgen drives the tier "
+                         "through the failover client")
+    fl.add_argument("--kill-router-after", type=float, default=None,
+                    metavar="S",
+                    help="SIGKILL replica r0 this many seconds in — the "
+                         "failover client must carry every in-flight and "
+                         "subsequent request to the surviving peers")
+    fl.add_argument("--drive-faults", default=None, metavar="SPEC",
+                    help="OT_FAULTS spec armed AFTER router start + "
+                         "startup canaries (so join checks never absorb "
+                         "the shots), e.g. pool_stale:1@backend=0")
+    fl.add_argument("--drive-faults-after", type=float, default=0.0,
+                    metavar="S",
+                    help="arm --drive-faults this many seconds into the "
+                         "drive (late enough that the fleet has already "
+                         "scaled up: a stale-socket redispatch needs a "
+                         "second member to land on)")
+    fl.add_argument("--settle-timeout", type=float, default=30.0,
+                    metavar="S",
+                    help="post-load window for the fleet to drain back "
+                         "to the floor before the drive stops waiting")
+    fl.add_argument("--min-scale-ups", type=int, default=None, metavar="N",
+                    help="fail unless the autoscaler grew the fleet at "
+                         "least N times")
+    fl.add_argument("--min-scale-downs", type=int, default=None,
+                    metavar="N",
+                    help="fail unless the fleet shrank at least N times")
+    fl.add_argument("--expect-rolls", type=int, default=None, metavar="N",
+                    help="fail unless exactly N workers rolled AND no "
+                         "roll aborted")
+    fl.add_argument("--min-client-failovers", type=int, default=None,
+                    metavar="N",
+                    help="fail unless the failover client rerouted at "
+                         "least N times (the router kill was felt)")
+    fl.add_argument("--max-wire-p50-us", type=float, default=None,
+                    metavar="US",
+                    help="fail unless the wire stage p50 lands under US "
+                         "microseconds (the pooled-connection gate; "
+                         "ROUTE_r02 pinned the pre-pool baseline)")
     args = ap.parse_args(argv)
+    if args.autoscale:
+        if args.ab:
+            ap.error("--autoscale owns the worker fleet for one live "
+                     "drive; --ab wants two disposable fleets — run the "
+                     "A/B without the supervisor")
+        if args.no_affinity:
+            ap.error("--autoscale drives the affinity ring (rendezvous "
+                     "handoff across resizes is the point)")
+        if args.fleet_max < args.backends:
+            ap.error(f"--fleet-max {args.fleet_max} < --backends "
+                     f"{args.backends} (the floor)")
+        if args.kill_router_after is not None and args.routers < 1:
+            ap.error("--kill-router-after needs --routers >= 1")
+    elif (args.roll_after is not None or args.routers
+          or args.kill_router_after is not None or args.drive_faults
+          or args.min_scale_ups is not None
+          or args.min_scale_downs is not None
+          or args.expect_rolls is not None
+          or args.min_client_failovers is not None):
+        ap.error("fleet-elasticity flags require --autoscale")
     if args.ab and args.no_affinity:
         ap.error("--ab compares affinity AGAINST random routing; with "
                  "--no-affinity both arms would be random and the "
@@ -416,6 +1021,9 @@ def main(argv=None) -> int:
     trace.ensure_run()
     probes = (loadgen.make_probes(args.sizes, args.seed, args.mode_list)
               if args.verify_every else [])
+
+    if args.autoscale:
+        return _main_fleet(args, probes)
 
     affinity = not args.no_affinity
     handles, specs = _spawn_backends(args, "route")

@@ -44,18 +44,26 @@ per-HOST one):
   journal failure rows under ``backend:<name>``, released by the same
   ``clear_failures`` edit as lanes and sweep units.
 
-This module is the ONLY backend contact in the package (otlint's
-``route-backend-seam``): every socket a backend ever sees from the
-router — framed requests, /healthz gossip polls, canaries — is opened
-here, inside the guarded seams with the fault points
-(``backend_fail``/``backend_hang``, ``@backend=<i>`` scoped) that let
-CI kill one fault domain and assert the rest kept serving.
+This module is the ONLY direct backend contact in the package besides
+the fleet tier (otlint's ``route-backend-seam``): every socket a
+backend ever sees from the router — framed requests, /healthz gossip
+polls, canaries — is opened here, inside the guarded seams with the
+fault points (``backend_fail``/``backend_hang``/``pool_stale``,
+``@backend=<i>`` scoped) that let CI kill one fault domain and assert
+the rest kept serving. The request transport is POOLED: each backend
+keeps a small stack of idle persistent connections, fresh dials run
+the shared ``RetryPolicy`` (reconnect-and-backoff, off-loop), and a
+request that lands on a stale half-closed pooled socket fails over
+through the existing ring-retry path — a dead socket costs one
+redispatch, never an error (the ROUTE_r02 -> r04 wire-stage delta
+records what pooling buys).
 """
 
 from __future__ import annotations
 
 import asyncio
 import json
+import socket
 import time
 from dataclasses import dataclass
 
@@ -64,7 +72,7 @@ import numpy as np
 from ..obs import metrics, trace
 from ..resilience import degrade, faults
 from ..resilience import journal as journal_mod
-from ..resilience.policy import Budget
+from ..resilience.policy import Budget, RetryPolicy
 from ..serve import wire
 from ..serve.queue import (ERR_DEADLINE, ERR_DISPATCH, ERR_SHED,
                            ERR_SHUTDOWN, Response)
@@ -120,10 +128,23 @@ class Backend:
     def __init__(self, idx: int, spec: BackendSpec,
                  probation_batches: int = 2, journal=None,
                  clock=time.monotonic,
-                 max_frame_bytes: int = wire.MAX_PAYLOAD):
+                 max_frame_bytes: int = wire.MAX_PAYLOAD,
+                 pool_size: int = 8, reconnect_attempts: int = 3,
+                 reconnect_base_s: float = 0.02,
+                 connect_timeout_s: float = 2.0):
         self.idx = idx
         self.spec = spec
         self.max_frame_bytes = int(max_frame_bytes)
+        #: idle pooled connections to this backend (LIFO: the warmest
+        #: socket serves next); 0 disables pooling — dial per exchange
+        self.pool_size = int(pool_size)
+        self.reconnect_attempts = int(reconnect_attempts)
+        self.reconnect_base_s = float(reconnect_base_s)
+        self.connect_timeout_s = float(connect_timeout_s)
+        self._pool: list = []
+        self.pool_hits = 0
+        self.pool_dials = 0
+        self.pool_stale = 0
         self.health = BackendHealth(idx, spec.name,
                                     probation_batches=probation_batches,
                                     journal=journal, clock=clock)
@@ -154,21 +175,101 @@ class Backend:
             self._exchange(header, payload), timeout=max(timeout_s, 0.001))
 
     async def _exchange(self, header: dict, payload: bytes):
-        reader, writer = await asyncio.open_connection(
-            self.spec.host, self.spec.port)
+        reader, writer = await self._acquire()
         try:
+            if faults.fire_backend("pool_stale", self.idx):
+                # The injected half-closed pooled socket: the acquire
+                # liveness check passed but first use fails — the rider
+                # must ride the ring-retry failover, never an error.
+                trace.point("fault-pool-stale", backend=self.idx)
+                raise ConnectionResetError(
+                    "injected stale pooled connection")
             writer.write(wire.encode_frame(header, payload))
             await writer.drain()
             frame = await wire.read_frame(reader, self.max_frame_bytes)
             if frame is None:
                 raise ConnectionError(
                     f"backend {self.spec.name} closed mid-exchange")
-            return frame
-        finally:
-            try:
-                writer.close()
-            except Exception:  # noqa: BLE001 - peer already gone
-                pass
+        except BaseException:
+            # Any failure mid-exchange — a stale socket's reset, a torn
+            # frame, or the attempt deadline's cancel — leaves the
+            # stream untrustworthy (a half-written request or half-read
+            # response may be in flight): close it, never pool it back.
+            # The raised error flows into the router's existing
+            # ring-retry failover, so a stale pooled socket costs one
+            # redispatch, not an error.
+            self._discard(writer)
+            raise
+        self._release(reader, writer)
+        return frame
+
+    # -- the connection pool -----------------------------------------------
+    async def _acquire(self):
+        """An idle pooled connection, or a fresh dial. Pooled sockets
+        are liveness-checked (EOF/half-close seen by the transport) —
+        visibly dead ones are dropped and counted; an INVISIBLY dead
+        one (peer vanished without FIN reaching us yet) fails at first
+        use, which ``_exchange`` converts into failover."""
+        while self._pool:
+            reader, writer = self._pool.pop()
+            if reader.at_eof() or writer.is_closing():
+                self.pool_stale += 1
+                metrics.counter("route_pool", backend=self.idx,
+                                outcome="stale")
+                self._discard(writer)
+                continue
+            self.pool_hits += 1
+            metrics.counter("route_pool", backend=self.idx, outcome="hit")
+            return reader, writer
+        return await self._dial()
+
+    async def _dial(self):
+        """One transport dial. With pooling on, the blocking connect
+        runs off-loop under the shared ``RetryPolicy`` (attempts +
+        exponential backoff — the reconnect-and-backoff seam): a
+        backend mid-restart costs a bounded retry in an executor
+        thread, never a stalled event loop; exhaustion raises into the
+        ring-retry failover like any other backend failure."""
+        self.pool_dials += 1
+        metrics.counter("route_pool", backend=self.idx, outcome="dial")
+        host, port = self.spec.host, self.spec.port
+        if self.pool_size <= 0:
+            # Pooling disabled: the pre-pool dial-per-exchange path.
+            return await asyncio.open_connection(host, port)
+        timeout = self.connect_timeout_s
+
+        def dial_blocking():
+            return RetryPolicy(
+                attempts=max(self.reconnect_attempts, 1),
+                base_delay_s=self.reconnect_base_s,
+                retry_on=(OSError,),
+                name=f"route-pool:{self.spec.name}",
+            ).run(lambda _a: socket.create_connection((host, port),
+                                                      timeout=timeout))
+
+        loop = asyncio.get_running_loop()
+        sock = await loop.run_in_executor(None, dial_blocking)
+        return await asyncio.open_connection(sock=sock)
+
+    def _release(self, reader, writer) -> None:
+        if (len(self._pool) < self.pool_size and not writer.is_closing()
+                and not reader.at_eof()):
+            self._pool.append((reader, writer))
+        else:
+            self._discard(writer)
+
+    def _discard(self, writer) -> None:
+        try:
+            writer.close()
+        except Exception:  # noqa: BLE001 - peer already gone
+            pass
+
+    def close_pool(self) -> None:
+        """Drop every idle pooled connection (teardown: the member left
+        the ring or the router is stopping)."""
+        while self._pool:
+            _reader, writer = self._pool.pop()
+            self._discard(writer)
 
     # -- the gossip seam ----------------------------------------------------
     async def poll_healthz(self, timeout_s: float = 2.0) -> dict | None:
@@ -283,6 +384,8 @@ class Backend:
             "redispatches_in": self.redispatches_in,
             "sheds_seen": self.sheds_seen, "canaries": self.canaries,
             "pid": self.pid, "skew_us": self.skew_us,
+            "pool": {"idle": len(self._pool), "hits": self.pool_hits,
+                     "dials": self.pool_dials, "stale": self.pool_stale},
             **self.health.stats(),
         }
 
@@ -321,6 +424,18 @@ class RouterConfig:
     #: --bucket-max); a legitimate response above it would read as a
     #: backend failure on every replica
     max_frame_bytes: int = wire.MAX_PAYLOAD
+    #: idle pooled connections kept per backend (0 restores the
+    #: dial-per-exchange transport): pooling drops the per-request
+    #: connect from the wire stage — the ROUTE_r02 -> r04 waterfall
+    #: delta records what it buys
+    pool_size: int = 8
+    #: dial retry policy at the pool's reconnect seam
+    #: (resilience.policy.RetryPolicy: attempts + exponential backoff)
+    pool_reconnect_attempts: int = 3
+    pool_reconnect_base_s: float = 0.02
+    #: blocking connect() timeout per dial attempt (the attempt wall
+    #: deadline still bounds the whole exchange above it)
+    pool_connect_timeout_s: float = 2.0
 
 
 class Router:
@@ -341,6 +456,10 @@ class Router:
         self.routed_ok = 0
         self.redispatches = 0
         self.shed_retries = 0
+        #: pool counters of members that already LEFT the ring (an
+        #: elastic fleet retires workers mid-drive; route.bench's pool
+        #: aggregate must count their reuse too)
+        self.pool_retired = {"hits": 0, "dials": 0, "stale": 0}
         self.router_sheds = 0
         self.affinity_hits = 0
         self.affinity_misses = 0
@@ -379,10 +498,15 @@ class Router:
     def _register(self, spec: BackendSpec) -> None:
         if spec.name in self.backends:
             raise ValueError(f"backend {spec.name!r} already registered")
+        c = self.config
         b = Backend(self._next_idx, spec,
-                    probation_batches=self.config.probation_batches,
+                    probation_batches=c.probation_batches,
                     journal=self._journal, clock=self._clock,
-                    max_frame_bytes=self.config.max_frame_bytes)
+                    max_frame_bytes=c.max_frame_bytes,
+                    pool_size=c.pool_size,
+                    reconnect_attempts=c.pool_reconnect_attempts,
+                    reconnect_base_s=c.pool_reconnect_base_s,
+                    connect_timeout_s=c.pool_connect_timeout_s)
         self._next_idx += 1
         self.backends[spec.name] = b
         self.ring.add(spec.name)
@@ -479,6 +603,8 @@ class Router:
                 pass
             self._gossip_task = None
         await self._idle.wait()
+        for b in self.backends.values():
+            b.close_pool()
         trace.point("route-drained", accepted=self.accepted,
                     answered=self.answered,
                     lost=self.accepted - self.answered)
@@ -493,9 +619,12 @@ class Router:
         evidence (~K/N for one member among N) on the live key sample,
         not a synthetic one."""
         keys = list(self._seen_keys)
-        before = self.ring.placement(keys) if keys else {}
+        # An empty ring has no placement (teardown removes the last
+        # member; the fleet supervisor's close() walks through here):
+        # every tracked key counts as moved then.
+        before = self.ring.placement(keys) if keys and len(self.ring) else {}
         fn()
-        after = self.ring.placement(keys) if keys else {}
+        after = self.ring.placement(keys) if keys and len(self.ring) else {}
         moved = ring_mod.moved_keys(before, after)
         self.ring_changes += 1
         metrics.counter("route_ring_changes")
@@ -527,12 +656,42 @@ class Router:
     def remove_backend(self, name: str) -> None:
         """Leave: drop the member; its arcs return to the clockwise
         successors (minimal motion), in-flight requests to it finish or
-        fail over like any other outcome."""
+        fail over like any other outcome. The departing member's pool
+        counters fold into ``pool_retired`` — an elastic fleet retires
+        members mid-drive, and the reuse evidence must outlive them."""
         if name not in self.backends:
             raise ValueError(f"backend {name!r} not registered")
         self._rebalance_motion("leave", name,
                                lambda: self.ring.remove(name))
+        b = self.backends[name]
+        self.pool_retired["hits"] += b.pool_hits
+        self.pool_retired["dials"] += b.pool_dials
+        self.pool_retired["stale"] += b.pool_stale
+        b.close_pool()
         del self.backends[name]
+
+    async def canary_check(self, spec: BackendSpec) -> tuple[bool, str]:
+        """Probe a PROSPECTIVE backend with the pinned startup canary
+        WITHOUT granting membership — the rolling upgrade's bit-exact
+        handoff gate (route/fleet.py): a successor must answer the
+        fleet's pinned bytes identically before the predecessor may
+        begin draining. Returns (ok, why) with why one of
+        ok/failed/mismatch/unpinned; the ring, health, and placement
+        are untouched either way."""
+        b = Backend(-1, spec, clock=self._clock,
+                    max_frame_bytes=self.config.max_frame_bytes,
+                    pool_size=0)
+        try:
+            out = await self._canary_once(b)
+        finally:
+            b.close_pool()
+        if self._canary_expected is None:
+            return False, "unpinned"
+        if out is None:
+            return False, "failed"
+        if out != self._canary_expected:
+            return False, "mismatch"
+        return True, "ok"
 
     # -- gossip ------------------------------------------------------------
     async def _gossip_loop(self) -> None:
@@ -966,5 +1125,6 @@ class Router:
             "redispatches": self.redispatches,
             "shed_retries": self.shed_retries,
             "router_sheds": self.router_sheds,
+            "pool_retired": dict(self.pool_retired),
             "quarantine_events": self.quarantine_events(),
         }

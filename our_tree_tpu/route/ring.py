@@ -137,6 +137,16 @@ class Ring:
         change to count moved keys)."""
         return {k: self.node_for(k) for k in keys}
 
+    def digest(self) -> str:
+        """A short stable fingerprint of this ring's VIEW — the member
+        set plus vnode count, order-independent (placement depends only
+        on the set). Two routers agreeing on the digest place every key
+        identically; the fleet gossip (route/fleet.py) carries it so a
+        replica can detect config skew loudly instead of diverging
+        silently."""
+        doc = ",".join(sorted(self._members)) + f"#v{self.vnodes}"
+        return hashlib.sha256(doc.encode("utf-8")).hexdigest()[:16]
+
 
 def moved_keys(before: dict[str, str], after: dict[str, str]) -> int:
     """How many keys changed owner between two ``placement`` maps over

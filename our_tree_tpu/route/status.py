@@ -68,10 +68,17 @@ class RouterStatus(HttpStatusEndpoint):
     line instead of silently vanishing."""
 
     def __init__(self, router, port: int, host: str = "127.0.0.1",
-                 federate: bool = True):
+                 federate: bool = True, fleet=None):
         super().__init__(port, host)
         self._router = router
         self.federate = bool(federate)
+        #: The fleet supervisor (route/fleet.py FleetSupervisor) when
+        #: this router autoscales — /fleetz then serves its elasticity
+        #: document; None keeps the shared endpoint's 404.
+        self._fleet = fleet
+
+    def fleetz(self) -> dict | None:
+        return self._fleet.fleetz() if self._fleet is not None else None
 
     async def metrics_text_async(self, exemplars: bool = False) -> str:
         # The router's own registry honors the scraper's OpenMetrics
@@ -169,6 +176,7 @@ class RouterStatus(HttpStatusEndpoint):
             "placeable": placeable,
             "ring": {
                 "members": list(r.ring.members()),
+                "digest": r.ring.digest(),
                 "vnodes": r.config.vnodes,
                 "changes": r.ring_changes,
                 "tracked_keys": len(keys),

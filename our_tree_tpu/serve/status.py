@@ -96,6 +96,15 @@ class HttpStatusEndpoint:
             "bundles": incident.bundle_index(d) if d else [],
         }
 
+    def fleetz(self) -> dict | None:
+        """The /fleetz body: the fleet supervisor's elasticity document
+        (size, thresholds, scale-event ledger — route/fleet.py
+        ``FleetSupervisor.fleetz``). None (the default) answers 404:
+        only a status endpoint that OWNS a fleet supervisor — the
+        router's, with ``--autoscale`` on — has an elasticity story to
+        tell; a worker's does not."""
+        return None
+
     async def profilez_async(self, seconds: float) -> tuple[int, dict]:
         """The /profilez handler: arm one bounded capture window
         (obs/profiler.py) on THIS process — 200 armed, 409 while a
@@ -163,9 +172,19 @@ class HttpStatusEndpoint:
                 ctype = "application/json"
                 reason = {200: "OK", 409: "Conflict",
                           503: "Service Unavailable"}.get(code, "OK")
+            elif path.split("?")[0] == "/fleetz":
+                doc = self.fleetz()
+                if doc is None:
+                    body = "no fleet supervisor on this endpoint\n"
+                    ctype = "text/plain"
+                    code, reason = 404, "Not Found"
+                else:
+                    body = json.dumps(doc, indent=1, sort_keys=True) + "\n"
+                    ctype = "application/json"
+                    code, reason = 200, "OK"
             else:
-                body = ("not found: try /metrics, /healthz, /incidentz "
-                        "or /profilez\n")
+                body = ("not found: try /metrics, /healthz, /incidentz, "
+                        "/profilez or /fleetz\n")
                 ctype = "text/plain"
                 code, reason = 404, "Not Found"
         except Exception:  # noqa: BLE001 - a bad scrape must not matter

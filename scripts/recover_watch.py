@@ -48,8 +48,6 @@ from __future__ import annotations
 import argparse
 import os
 import shutil
-import signal
-import subprocess
 import sys
 import time
 
@@ -59,6 +57,7 @@ from _devlock_loader import load_devlock, load_resilience  # noqa: E402
 
 repolicy = load_resilience("policy")
 rejournal = load_resilience("journal")
+reisolate = load_resilience("isolate")
 
 
 class _Busy(Exception):
@@ -110,17 +109,13 @@ _PROBE_SRC = (
 def probe(timeout_s: float) -> tuple[bool, float]:
     """(alive, wall_seconds). Latency is evidence either way: a healthy
     probe completes <30 s; 'wedged at timeout' vs 'failed fast' (e.g. an
-    import error) are different diagnoses and the ledger should tell."""
-    t0 = time.monotonic()
-    try:
-        subprocess.run(
-            [sys.executable, "-c", _PROBE_SRC],
-            timeout=timeout_s, check=True,
-            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
-        )
-        return True, time.monotonic() - t0
-    except (subprocess.TimeoutExpired, subprocess.CalledProcessError):
-        return False, time.monotonic() - t0
+    import error) are different diagnoses and the ledger should tell.
+    One deadline-guarded throwaway child through the shared runner —
+    the group kill matters here too (a wedged PJRT init can hold a
+    helper subprocess of its own); output is captured and dropped."""
+    r = reisolate.run_child([sys.executable, "-c", _PROBE_SRC],
+                            timeout_s, name="recover-probe")
+    return r.ok, r.wall_s
 
 
 #: The committed probe ledger (VERDICT r3 missing #2): every probe attempt,
@@ -277,31 +272,20 @@ def main() -> int:
             with open(log, "a") as fh:
                 fh.write(f"## attempt at {time.strftime('%F %T')}\n")
                 fh.flush()
-                # Own session so a timeout kills the whole process
-                # GROUP: several steps (smoke, tune, corpus) are
+                # The streaming runner owns the session/group-kill
+                # semantics: several steps (smoke, tune, corpus) are
                 # parents of their own jax subprocesses, and killing
                 # only the parent would orphan a grandchild that keeps
                 # driving the device while we probe — the documented
-                # two-process wedge trigger.
-                proc = subprocess.Popen(
+                # two-process wedge trigger. The log file is the sink,
+                # so a re-wedged step's partial tail is preserved.
+                r = reisolate.run_streamed(
                     argv,
+                    min(outer, max(deadline - time.monotonic(), 60)),
                     env=dict(os.environ,
                              OT_BENCH_BUSY_FILE=child_busy, **env),
-                    cwd=REPO,
-                    stdout=fh, stderr=subprocess.STDOUT,
-                    start_new_session=True,
-                )
-                try:
-                    rc = proc.wait(
-                        timeout=min(outer,
-                                    max(deadline - time.monotonic(), 60)))
-                except subprocess.TimeoutExpired:
-                    try:
-                        os.killpg(proc.pid, signal.SIGKILL)
-                    except OSError:
-                        pass
-                    proc.wait()
-                    rc = "timeout"
+                    cwd=REPO, sink=fh, name=name)
+                rc = "timeout" if r.kind == "timeout" else r.rc
             print(f"# {name}: rc={rc} in {time.monotonic() - t0:.0f}s",
                   flush=True)
             ledger("step", name=name, rc=rc,

@@ -1,0 +1,609 @@
+"""ot-fleet (route/fleet.py): elasticity under chaos.
+
+In-process rehearsals of the fleet-lifecycle control loop on the same
+seams the CI elasticity drive flies with real spawned processes: the
+autoscaler's hysteresis/cooldown decisions, drain-then-remove
+scale-down, the rolling upgrade's bit-exact canary handoff gate (and
+its abort path), the replicated router tier (RouterServer + gossip +
+FailoverClient) with a router killed mid-stream, and the proxy's pooled
+transport riding the ring-retry failover when a pooled socket goes
+stale. Worker handles here wrap a REAL serve ``Server`` behind a
+``RequestFrontend`` port — the full wire path minus the process
+boundary, which the CI drive's spawned ``serve.worker`` children cover.
+"""
+
+import asyncio
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from our_tree_tpu.obs import metrics, trace
+from our_tree_tpu.resilience import degrade, faults
+from our_tree_tpu.route import fleet as fleet_mod
+from our_tree_tpu.route.fleet import (FailoverClient, FleetConfig,
+                                      FleetSupervisor, RouterServer,
+                                      adopt_view, gossip_exchange,
+                                      worker_argv)
+from our_tree_tpu.route.proxy import BackendSpec, Router, RouterConfig
+from our_tree_tpu.route.ring import Ring
+from our_tree_tpu.route.status import RouterStatus
+from our_tree_tpu.serve import wire
+from our_tree_tpu.serve.server import Server, ServerConfig
+from our_tree_tpu.serve.worker import RequestFrontend
+
+LADDER = dict(min_bucket_blocks=32, max_bucket_blocks=256, lanes=1)
+
+NIST_KEY = bytes.fromhex("2b7e151628aed2a6abf7158809cf4f3c")
+NIST_CTR0 = bytes.fromhex("f0f1f2f3f4f5f6f7f8f9fafbfcfdfeff")
+NIST_PT = bytes.fromhex(
+    "6bc1bee22e409f96e93d7e117393172a"
+    "ae2d8a571e03ac9c9eb76fac45af8e51"
+    "30c81c46a35ce411e5fbc1191a0a52ef"
+    "f69f2445df4f9b17ad2b417be66c3710")
+NIST_CT = bytes.fromhex(
+    "874d6191b620e3261bef6864990db6ce"
+    "9806f66b7970fdff8617187bb9fffdff"
+    "5ae4df3edbd5d35e5b4f09020db03eab"
+    "1e031dda2fbe03d1792170a0f3009cee")
+
+
+@pytest.fixture(autouse=True)
+def _clean_process_state(monkeypatch):
+    monkeypatch.delenv("OT_FAULTS", raising=False)
+    monkeypatch.delenv("OT_DISPATCH_DEADLINE", raising=False)
+    faults.reset()
+    degrade.clear()
+    metrics.reset_for_tests()
+    yield
+    monkeypatch.delenv("OT_FAULTS", raising=False)
+    faults.reset()
+    degrade.clear()
+    metrics.reset_for_tests()
+
+
+class InProcWorkerHandle:
+    """The supervisor's handle contract over an in-process serve
+    Server + frontend — the test twin of ``ProcessWorkerHandle``.
+    ``die_on_start=True`` models a worker SIGKILLed before its READY
+    line (``start()`` answers None, the spawn-failed seam)."""
+
+    def __init__(self, name, die_on_start=False):
+        self.name = name
+        self.die_on_start = die_on_start
+        self.server = None
+        self.front = None
+        self._alive = False
+        self.killed = False
+        self.drained = False
+
+    async def start(self):
+        if self.die_on_start:
+            return None
+        self.server = Server(ServerConfig(status_port=0, **LADDER))
+        await self.server.start()
+        self.front = RequestFrontend(self.server, 0)
+        await self.front.start()
+        self._alive = True
+        return BackendSpec(self.name, "127.0.0.1", self.front.port,
+                           self.server.status.port)
+
+    async def drain(self):
+        if not self._alive:
+            return {"rc": None, "lost": None}
+        # The worker lifecycle's drain order (serve/worker.py _amain):
+        # close admission, stop the frontend gracefully, stop the server.
+        self.server.queue.close()
+        await self.front.stop()
+        await self.server.stop()
+        self._alive = False
+        self.drained = True
+        return {"rc": 0, "lost": self.server.queue.stats()["lost"]}
+
+    async def kill(self):
+        self.killed = True
+        if not self._alive:
+            return
+        self._alive = False
+        await self.front.stop(grace_s=0.0)
+        await self.server.stop()
+
+    def alive(self):
+        return self._alive
+
+
+class RiggedCanaryHandle:
+    """A successor whose canary answer is NOT bit-exact (a bad build):
+    a minimal wire responder that answers ok frames with zero bytes —
+    never the fleet's pinned CTR output."""
+
+    def __init__(self, name):
+        self.name = name
+        self._srv = None
+        self.killed = False
+
+    async def start(self):
+        async def serve(reader, writer):
+            try:
+                while True:
+                    frame = await wire.read_frame(reader)
+                    if frame is None:
+                        return
+                    _header, payload = frame
+                    writer.write(wire.encode_frame(
+                        {"ok": True, "pid": os.getpid(),
+                         "ts": trace.now_us()},
+                        bytes(len(payload) or 64)))
+                    await writer.drain()
+            finally:
+                try:
+                    writer.close()
+                except Exception:  # noqa: BLE001
+                    pass
+
+        self._srv = await asyncio.start_server(serve, "127.0.0.1", 0)
+        port = self._srv.sockets[0].getsockname()[1]
+        return BackendSpec(self.name, "127.0.0.1", port, None)
+
+    async def drain(self):
+        await self.kill()
+        return {"rc": 0, "lost": 0}
+
+    async def kill(self):
+        self.killed = True
+        if self._srv is not None:
+            self._srv.close()
+            await self._srv.wait_closed()
+            self._srv = None
+
+    def alive(self):
+        return self._srv is not None
+
+
+class Fleet:
+    """N in-process workers adopted by a FleetSupervisor over a
+    Router — the elasticity test harness."""
+
+    def __init__(self, n=1, fleet_cfg=None, factory=None, clock=None,
+                 router_cfg=None):
+        self.n = n
+        self.fleet_cfg = fleet_cfg
+        self.factory = factory or InProcWorkerHandle
+        self.clock = clock or time.monotonic
+        self.router_cfg = router_cfg
+
+    async def __aenter__(self):
+        self.handles = {}
+        specs = []
+        for i in range(self.n):
+            h = InProcWorkerHandle(f"w{i}")
+            specs.append(await h.start())
+            self.handles[h.name] = h
+        self.router = Router(specs, self.router_cfg or RouterConfig(
+            gossip_every_s=0.0, attempt_timeout_s=2.0))
+        await self.router.start()
+        self.sup = FleetSupervisor(self.router, self.factory,
+                                   self.fleet_cfg, clock=self.clock)
+        for name, h in self.handles.items():
+            self.sup.adopt(name, h)
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.router.stop()
+        await self.sup.close(drain=False)
+
+
+async def _nist_ok(target, tenant="t0"):
+    resp = await target.submit(tenant, NIST_KEY, NIST_CTR0,
+                               np.frombuffer(NIST_PT, np.uint8))
+    assert resp.ok, (resp.error, resp.detail)
+    assert bytes(np.asarray(resp.payload)) == NIST_CT
+    return resp
+
+
+def _pressure(router, depth, busy=0.0):
+    """Fabricate the gossip reconnaissance the signals() pass reads
+    (refresh_gossip=False keeps it in place across ticks)."""
+    for b in router.backends.values():
+        b.last_healthz = {"queue": {"depth": depth},
+                          "lanes": {"inflight": busy, "count": 1}}
+
+
+# ---------------------------------------------------------------------------
+# The autoscaler: hysteresis, settle ticks, cooldown, drain-then-remove.
+# ---------------------------------------------------------------------------
+
+
+def test_autoscale_up_and_down_with_hysteresis_and_cooldown():
+    clk = {"t": 0.0}
+    cfg = FleetConfig(min_workers=1, max_workers=3, up_depth=8.0,
+                      down_depth=1.0, settle_ticks=2, cooldown_s=5.0,
+                      refresh_gossip=False)
+
+    async def main():
+        async with Fleet(n=1, fleet_cfg=cfg,
+                         clock=lambda: clk["t"]) as f:
+            sup, router = f.sup, f.router
+            # In the dead band: steady, no settle progress.
+            _pressure(router, depth=4.0)
+            assert await sup.tick() == "steady"
+            # Above the grow threshold: one settle tick, then the event.
+            _pressure(router, depth=20.0)
+            assert await sup.tick() == "pressure"
+            assert await sup.tick() == "scaled-up"
+            assert len(router.backends) == 2
+            assert sup.scale_ups == 1 and sup.epoch == 2
+            assert "w1" in router.backends and "w1" in sup.workers
+            # The newcomer serves bit-exactly (canary-gated join).
+            await _nist_ok(router)
+            # Cooldown: continued pressure cannot flap the fleet.
+            _pressure(router, depth=20.0)
+            assert await sup.tick() == "cooldown"
+            clk["t"] += 10.0
+            # Idle below the shrink threshold: settle, then drain one.
+            _pressure(router, depth=0.0)
+            assert await sup.tick() == "idle"
+            assert await sup.tick() == "scaled-down"
+            assert len(router.backends) == 1
+            assert sup.scale_downs == 1 and sup.drained_lost == 0
+            # The victim was the NEWEST owned worker, drained not killed.
+            assert f.sup.workers.keys() == {"w0"}
+            # At the floor: idle ticks never shrink below min_workers.
+            clk["t"] += 10.0
+            _pressure(router, depth=0.0)
+            assert await sup.tick() == "idle"
+            assert await sup.tick() == "idle"
+            assert len(router.backends) == 1
+            ev_kinds = [e["kind"] for e in sup.events]
+            assert ev_kinds == ["up", "down"]
+            doc = sup.fleetz()
+            assert doc["size"] == 1 and doc["scale_ups"] == 1
+            assert doc["events"][-1]["kind"] == "down"
+
+    asyncio.run(main())
+
+
+def test_scale_up_aborts_on_worker_killed_mid_spawn():
+    """A worker SIGKILLed before READY: the scale event fails, the
+    serving fleet is untouched, and the next request is still
+    bit-exact."""
+    async def main():
+        async with Fleet(n=1, factory=lambda name: InProcWorkerHandle(
+                name, die_on_start=True)) as f:
+            assert await f.sup.scale_up() is None
+            assert f.sup.spawn_failures == 1
+            assert f.sup.events[-1]["kind"] == "spawn-failed"
+            assert set(f.router.backends) == {"w0"}
+            assert f.sup.epoch == 1  # membership never changed
+            await _nist_ok(f.router)
+
+    asyncio.run(main())
+
+
+def test_scale_stall_fault_point_aborts_the_event(monkeypatch):
+    async def main():
+        async with Fleet(n=1) as f:
+            monkeypatch.setenv("OT_FAULTS", "scale_stall:1")
+            faults.reset()
+            assert await f.sup.scale_up() is None
+            assert f.sup.stalls == 1
+            assert f.sup.events[-1] == {**f.sup.events[-1],
+                                        "kind": "stall", "seam": "spawn"}
+            assert set(f.router.backends) == {"w0"}
+            # The shot is spent: the retried event succeeds.
+            assert await f.sup.scale_up() == "w1"
+            await _nist_ok(f.router)
+
+    asyncio.run(main())
+
+
+def test_worker_slow_start_delays_join_without_rider_impact(monkeypatch):
+    """A slow cold start (the ``worker_slow_start`` seam) stretches the
+    scale event but never touches riders: the fleet serves bit-exactly
+    on the old membership while the newcomer warms, and the late join
+    is still canary-gated."""
+    async def main():
+        async with Fleet(n=1) as f:
+            monkeypatch.setenv("OT_FAULTS", "worker_slow_start:1")
+            monkeypatch.setenv("OT_SLOW_S", "0.08")
+            faults.reset()
+            t0 = time.monotonic()
+            task = asyncio.ensure_future(f.sup.scale_up())
+            # Mid-boot: the old fleet answers, bit-exactly.
+            await _nist_ok(f.router)
+            assert await task == "w1"
+            assert time.monotonic() - t0 >= 0.08
+            assert set(f.router.backends) == {"w0", "w1"}
+            assert f.sup.scale_ups == 1 and f.sup.stalls == 0
+            await _nist_ok(f.router)
+
+    asyncio.run(main())
+
+
+def test_scale_down_drain_loses_nothing_under_load():
+    async def main():
+        async with Fleet(n=2) as f:
+            router = f.sup.router
+
+            async def one(i):
+                # Spread tenants so both members carry traffic.
+                return await router.submit(
+                    f"t{i}", NIST_KEY, NIST_CTR0,
+                    np.frombuffer(NIST_PT, np.uint8))
+
+            tasks = [asyncio.ensure_future(one(i)) for i in range(24)]
+            await asyncio.sleep(0)  # let the stream take flight
+            t0 = time.monotonic()
+            assert await f.sup.scale_down()
+            # The drain must not wedge on the router's PARKED pool
+            # sockets: the supervisor releases them when the drain
+            # starts, so the worker frontend's grace window (5 s in
+            # this harness) only covers genuinely in-flight work.
+            assert time.monotonic() - t0 < 4.0
+            results = await asyncio.gather(*tasks)
+            for resp in results:
+                assert resp.ok, (resp.error, resp.detail)
+                assert bytes(np.asarray(resp.payload)) == NIST_CT
+            assert len(router.backends) == 1
+            assert f.sup.drained_lost == 0
+            assert f.handles["w1"].drained and not f.handles["w1"].killed
+            st = router.stats()
+            assert st["lost"] == 0 and st["routed_ok"] == st["answered"]
+
+    asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# Rolling upgrades: the bit-exact canary handoff gate.
+# ---------------------------------------------------------------------------
+
+
+def test_roll_one_replaces_exactly_one_worker_bit_exact():
+    async def main():
+        async with Fleet(n=2) as f:
+            assert await f.sup.roll_one()
+            assert f.sup.rolled == 1 and f.sup.roll_aborts == 0
+            # Exactly one replaced: w0 (the oldest) left, w2 joined.
+            assert set(f.router.backends) == {"w1", "w2"}
+            assert f.handles["w0"].drained
+            assert f.sup.drained_lost == 0
+            assert f.sup.events[-1]["kind"] == "roll"
+            assert f.sup.events[-1]["successor"] == "w2"
+            await _nist_ok(f.router)
+
+    asyncio.run(main())
+
+
+def test_roll_abort_on_canary_mismatch_keeps_old_worker_serving():
+    rigged = []
+
+    def factory(name):
+        h = RiggedCanaryHandle(name)
+        rigged.append(h)
+        return h
+
+    async def main():
+        async with Fleet(n=1, factory=factory) as f:
+            assert not await f.sup.roll_one()
+            assert f.sup.roll_aborts == 1 and f.sup.rolled == 0
+            ev = f.sup.events[-1]
+            assert ev["kind"] == "roll-abort" and ev["why"] == "mismatch"
+            # The old worker never stopped serving; the successor died.
+            assert set(f.router.backends) == {"w0"}
+            assert not f.handles["w0"].drained
+            assert rigged and rigged[0].killed
+            await _nist_ok(f.router)
+
+    asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# The replicated router tier: gossip + failover.
+# ---------------------------------------------------------------------------
+
+
+def test_gossip_view_adoption_converges_replica_ring():
+    async def main():
+        async with Fleet(n=2) as f:
+            server = RouterServer(
+                f.router, view_fn=lambda: (f.sup.epoch, f.sup.view()))
+            await server.start()
+            # A replica booted with HALF the membership gossips up to
+            # the owner's view; the join re-proves bit-exactness
+            # through the replica's own canary.
+            w0 = f.router.backends["w0"].spec
+            replica = Router([BackendSpec("w0", w0.host, w0.port,
+                                          w0.status_port)],
+                             RouterConfig(gossip_every_s=0.0,
+                                          attempt_timeout_s=2.0))
+            await replica.start()
+            doc = await gossip_exchange("127.0.0.1", server.port, 0)
+            assert doc is not None and doc["epoch"] == f.sup.epoch
+            assert {m["name"] for m in doc["members"]} == {"w0", "w1"}
+            res = await adopt_view(replica, doc)
+            assert res == {"joined": ["w1"], "left": []}
+            # Converged: identical ring view, identical placement.
+            assert replica.ring.digest() == f.router.ring.digest()
+            assert doc["ring"] == replica.ring.digest()
+            await _nist_ok(replica)
+            # A draining flag rides the next view non-punitively.
+            f.router.backends["w1"].health.note_gossip("draining")
+            doc2 = await gossip_exchange("127.0.0.1", server.port, 0)
+            await adopt_view(replica, doc2)
+            assert replica.backends["w1"].health.draining
+            assert not replica.backends["w1"].health.placeable()
+            await replica.stop()
+            await server.stop()
+            assert server.gossip_frames == 2
+
+    asyncio.run(main())
+
+
+def test_router_killed_mid_drive_fails_over_bit_exact_zero_lost():
+    async def main():
+        async with Fleet(n=2) as f:
+            specs = [b.spec for b in f.router.backends.values()]
+            # Two interchangeable front doors over the SAME fleet.
+            other = Router(
+                [BackendSpec(s.name, s.host, s.port, s.status_port)
+                 for s in specs],
+                RouterConfig(gossip_every_s=0.0, attempt_timeout_s=2.0))
+            await other.start()
+            srv_a = RouterServer(f.router)
+            srv_b = RouterServer(other)
+            await srv_a.start()
+            await srv_b.start()
+            client = FailoverClient([("127.0.0.1", srv_a.port),
+                                     ("127.0.0.1", srv_b.port)],
+                                    attempt_timeout_s=2.0)
+            for i in range(6):
+                await _nist_ok(client, tenant=f"t{i}")
+            # SIGKILL analog on the CURRENT router: listener closed,
+            # connections torn mid-frame.
+            srv_a.abort()
+            for i in range(6, 12):
+                await _nist_ok(client, tenant=f"t{i}")
+            assert client.failovers >= 1
+            assert client.submitted == 12
+            assert metrics.counter_total("route_client_failover") >= 1
+            # Zero lost across the surviving tier: every accepted
+            # request was answered.
+            for r in (f.router, other):
+                st = r.stats()
+                assert st["lost"] == 0 and st["routed_ok"] == st["answered"]
+            await srv_b.stop()
+            await other.stop()
+
+    asyncio.run(main())
+
+
+def test_failover_client_error_only_when_whole_tier_dead():
+    async def main():
+        client = FailoverClient([("127.0.0.1", 1), ("127.0.0.1", 1)],
+                                attempt_timeout_s=0.2, deadline_s=1.0)
+        resp = await client.submit("t0", NIST_KEY, NIST_CTR0,
+                                   np.frombuffer(NIST_PT, np.uint8))
+        assert not resp.ok
+        assert "no router peer answered" in resp.detail
+        assert client.failovers >= 2
+
+    asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# The pooled transport (satellite): reuse + stale-socket failover.
+# ---------------------------------------------------------------------------
+
+
+def test_pool_reuses_connections_and_stale_socket_rides_ring_retry(
+        monkeypatch):
+    async def main():
+        async with Fleet(n=2) as f:
+            router = f.router
+            for i in range(8):
+                await _nist_ok(router, tenant=f"t{i}")
+            hits = sum(b.pool_hits for b in router.backends.values())
+            dials = sum(b.pool_dials for b in router.backends.values())
+            assert hits >= 6  # persistent: requests reuse pooled sockets
+            assert dials <= 4
+            # A stale/half-closed pooled socket (injected at the
+            # acquire seam): the request fails over through the ring
+            # retry, never an error.
+            monkeypatch.setenv("OT_FAULTS", "pool_stale:1")
+            faults.reset()
+            before = router.redispatches
+            await _nist_ok(router, tenant="t0")
+            assert router.redispatches == before + 1
+            st = router.stats()
+            assert st["lost"] == 0 and st["routed_ok"] == st["answered"]
+            pool = router.backends["w0"].stats()["pool"]
+            assert set(pool) == {"idle", "hits", "dials", "stale"}
+
+    asyncio.run(main())
+
+
+def test_pool_survives_backend_restart_via_reconnect():
+    """A backend's sockets all die (frontend restart on the same port
+    is not guaranteed, so: stale pooled sockets + a fresh dial) — the
+    pool discards the dead sockets and the RetryPolicy-governed dial
+    path reconnects; requests keep answering bit-exactly."""
+    async def main():
+        async with Fleet(n=1) as f:
+            router = f.router
+            await _nist_ok(router)
+            b = router.backends["w0"]
+            # Kill every pooled socket under the router (half-closed
+            # peers): the next acquire must detect staleness or the
+            # exchange must fail over to a reconnect, never error out.
+            for _reader, writer in list(b._pool):
+                writer.transport.abort()
+            await asyncio.sleep(0.05)
+            for i in range(4):
+                await _nist_ok(router, tenant=f"t{i}")
+            st = router.stats()
+            assert st["lost"] == 0 and st["routed_ok"] == st["answered"]
+
+    asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# /fleetz + miscellany.
+# ---------------------------------------------------------------------------
+
+
+async def _http_get(port, path):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(f"GET {path} HTTP/1.1\r\n\r\n".encode())
+    await writer.drain()
+    out = await reader.read(1 << 20)
+    writer.close()
+    return out
+
+
+def test_fleetz_endpoint_serves_supervisor_doc():
+    async def main():
+        async with Fleet(n=1) as f:
+            status = RouterStatus(f.router, 0, fleet=f.sup)
+            await status.start()
+            raw = await _http_get(status.port, "/fleetz")
+            assert raw.startswith(b"HTTP/1.1 200")
+            doc = json.loads(raw.partition(b"\r\n\r\n")[2])
+            assert doc["size"] == 1 and doc["owned"] == ["w0"]
+            assert doc["min_workers"] == 1 and "events" in doc
+            # Without a supervisor the endpoint answers 404 (a worker's
+            # status port has no elasticity story).
+            bare = RouterStatus(f.router, 0)
+            await bare.start()
+            raw = await _http_get(bare.port, "/fleetz")
+            assert raw.startswith(b"HTTP/1.1 404")
+            await bare.stop()
+            await status.stop()
+
+    asyncio.run(main())
+
+
+def test_ring_digest_is_set_stable_and_config_sensitive():
+    a = Ring(["w0", "w1", "w2"])
+    b = Ring(["w2", "w0", "w1"])  # join order must not matter
+    assert a.digest() == b.digest()
+    assert a.digest() != Ring(["w0", "w1"]).digest()
+    assert a.digest() != Ring(["w0", "w1", "w2"], vnodes=32).digest()
+
+
+def test_worker_argv_is_one_template_per_fleet():
+    argv = worker_argv(engine="jnp", bucket_min=32, bucket_max=256,
+                       lanes=1)
+    assert argv[1:3] == ["-m", "our_tree_tpu.serve.worker"]
+    assert "--port" in argv and "0" == argv[argv.index("--port") + 1]
+    assert argv[argv.index("--engine") + 1] == "jnp"
+    assert argv[argv.index("--lanes") + 1] == "1"
+
+
+def test_replica_entry_module_shape():
+    # The replica process entry is importable with the worker lifecycle
+    # contract's kinds (READY/exit lines, route/bench.py parses them).
+    assert fleet_mod.REPLICA_KIND == "ot-route-replica"
+    assert fleet_mod.REPLICA_EXIT_KIND == "ot-route-replica-exit"
+    assert callable(fleet_mod.main)
