@@ -40,7 +40,8 @@ except Exception:  # pragma: no cover - only on a broken tree
     KNOWN_POINTS = ("init_hang", "dispatch_fail", "build_fail", "lock_busy",
                     "dispatch_hang", "unit_crash", "serve_dispatch",
                     "lane_fail", "lane_hang", "dispatch_slow",
-                    "backend_fail", "backend_hang")
+                    "backend_fail", "backend_hang",
+                    "chunk_lost", "reassembly_stall", "transfer_abort")
 
 # The live metrics label-key allowlist (obs/metrics.py, also
 # stdlib-only) — same live-registry-with-frozen-fallback pattern.
@@ -405,7 +406,8 @@ def _check_trace_attrs(ctx: FileContext):
 # ---------------------------------------------------------------------------
 
 _FAULT_METHODS = ("fire", "check", "check_lane", "check_backend",
-                  "fire_backend", "scoped", "scoped_backend", "consume",
+                  "fire_backend", "scoped", "scoped_backend",
+                  "scoped_chunk", "fire_chunk", "consume",
                   "remaining", "injected_hang", "injected_slow")
 
 

@@ -2,7 +2,8 @@
 
 The repo root carries 20+ measured artifacts — ``BENCH_r*`` (offline
 engine GB/s), ``SERVE_r*`` (the serving drives), ``ROUTE_r*`` (the
-routed fleet), ``MULTICHIP_r*`` (device health) — each one a point on a
+routed fleet), ``STREAM_r*`` (the chunked-transfer chaos drive),
+``MULTICHIP_r*`` (device health) — each one a point on a
 trajectory nothing machine-readable ever connected: the SLO gate
 compares one run against ONE chosen baseline, so a regression that
 lands together with a new baseline (or that only shows against the
@@ -125,7 +126,10 @@ def _extract(family: str, doc: dict) -> dict:
         if isinstance(doc.get("ok"), bool):
             out["ok"] = 1.0 if doc["ok"] else 0.0
         return out
-    if family in ("SERVE", "ROUTE"):
+    if family in ("SERVE", "ROUTE", "STREAM"):
+        # STREAM (route.bench --transfer-sizes: the chunked-transfer
+        # chaos drive) is servelike too — same load/queue/compiles
+        # contract, plus a transfers section the class key pins below.
         return _extract_servelike(doc)
     return {}
 
@@ -137,7 +141,7 @@ def _series_class(family: str, doc: dict) -> str:
     share a class; the mixed-AEAD and tenant-heavy drives each get
     their own) without making every artifact a singleton."""
     c = doc.get("config") or {}
-    if family in ("SERVE", "ROUTE"):
+    if family in ("SERVE", "ROUTE", "STREAM"):
         modes = ",".join(c.get("modes") or ["ctr"])
         sizes = c.get("sizes") or ([c["size_bytes"]]
                                    if c.get("size_bytes") else [])
@@ -148,6 +152,11 @@ def _series_class(family: str, doc: dict) -> str:
             parts.append(f"lanes={c.get('lanes')}")
         else:
             parts.append(f"backends={c.get('backends')}")
+        if family == "STREAM":
+            t = doc.get("transfers") or {}
+            tsizes = t.get("sizes") or []
+            parts.append(
+                f"transfers={','.join(str(s) for s in tsizes)}")
         return ";".join(parts)
     return ""
 

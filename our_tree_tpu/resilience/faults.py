@@ -153,6 +153,32 @@ point              wired into
                    ``stall`` event and retries at the next tick past
                    cooldown; membership, placement, and riders are
                    untouched.
+``chunk_lost``     the transfer engine's chunk-completion seam
+                   (``serve/transfer.py``): a chunk that the ladder
+                   already served bit-exactly is DISCARDED before the
+                   reassembly buffer sees it — the result frame lost in
+                   flight. Usually chunk-scoped
+                   (``chunk_lost:1@chunk=3`` loses transfer chunk 3 and
+                   no other); the manager re-dispatches exactly that
+                   chunk (one ``serve_transfer_chunks{outcome=
+                   redispatch}``) and the spliced output stays
+                   byte-identical.
+``reassembly_stall`` the transfer engine's in-order emit seam: the
+                   consumer of the next contiguous chunk stalls for
+                   ``OT_SLOW_S`` (an awaitable sleep — the manager is
+                   an asyncio loop, the dispatch path must keep
+                   draining under it). Completed chunks pile up in the
+                   bounded reassembly buffer; once the byte budget is
+                   crossed NEW transfers shed (``serve_transfer_shed
+                   {reason=reassembly}``) while admitted chunks keep
+                   flowing — backpressure, never a wedged loop.
+``transfer_abort`` the transfer engine's per-chunk admission seam: the
+                   whole transfer aborts with a typed
+                   ``transfer-abort`` error mid-flight, acked chunks
+                   preserved in the journal ledger. ``@<skip>`` places
+                   the abort (``transfer_abort:1@3`` aborts at the
+                   fourth chunk) — the deterministic interrupt the
+                   resume drill replays a reconnecting client against.
 =================  ========================================================
 
 Determinism contract: firings consume counts in call order within ONE
@@ -181,11 +207,13 @@ KNOWN_POINTS = ("init_hang", "dispatch_fail", "build_fail", "lock_busy",
                 "dispatch_hang", "unit_crash", "serve_dispatch",
                 "lane_fail", "lane_hang", "dispatch_slow",
                 "backend_fail", "backend_hang", "tag_mismatch",
-                "pool_stale", "worker_slow_start", "scale_stall")
+                "pool_stale", "worker_slow_start", "scale_stall",
+                "chunk_lost", "reassembly_stall", "transfer_abort")
 
 #: Scope names the ``@<scope>=<i>`` qualifier accepts: ``lane`` (serve
-#: dispatch lanes) and ``backend`` (the router's backend index).
-SCOPES = ("lane", "backend")
+#: dispatch lanes), ``backend`` (the router's backend index) and
+#: ``chunk`` (a transfer's chunk index, serve/transfer.py).
+SCOPES = ("lane", "backend", "chunk")
 
 #: Sentinel count for a bare (uncounted) token: armed forever.
 ALWAYS = -1
@@ -410,6 +438,23 @@ def fire_backend(point: str, backend) -> bool:
     ``backend_hang`` is an awaitable sleep, not a raise). Same
     short-circuit contract as ``check_backend``."""
     return fire(scoped_backend(point, backend)) or fire(point)
+
+
+def scoped_chunk(point: str, chunk) -> str:
+    """The transfer twin of ``scoped``: the registry key the
+    ``@chunk=<i>`` grammar arms and the transfer engine's per-chunk
+    seams ask ``fire`` for (serve/transfer.py) — so a chaos drive can
+    lose ONE chunk of a multi-chunk transfer and assert the rest
+    arrived exactly once."""
+    return f"{point}@chunk={int(chunk)}"
+
+
+def fire_chunk(point: str, chunk) -> bool:
+    """Consume the chunk-scoped OR plain shot of `point`, without
+    raising — the transfer seams' faults are flow decisions (discard a
+    result, stall an emit, abort an exchange), not exceptions. Same
+    short-circuit contract as ``fire_backend``."""
+    return fire(scoped_chunk(point, chunk)) or fire(point)
 
 
 def injected_slow(point: str, detail: str = "") -> bool:

@@ -9,7 +9,7 @@ works is the one the recovery watcher already uses for whole plans:
 run the risky work in a CHILD process, give it a deadline, and SIGKILL
 the whole process group when the deadline expires. This module makes
 that pattern a primitive instead of four hand-rolled copies
-(scripts/tune_tpu.py, scripts/bitslice_tpu_repro.py,
+(scripts/tune_tpu.py, scripts/bitslice_tpu_repro.py, the since-retired
 scripts/e2e_decompose.py, and now the sweep itself):
 
 * ``run_child`` — run an argv with a wall deadline in its own session,

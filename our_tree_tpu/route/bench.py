@@ -47,14 +47,18 @@ import asyncio
 import glob
 import json
 import os
+import random
 import re
 import sys
 import time
+
+import numpy as np
 
 from ..obs import metrics, slo, trace
 from ..resilience import degrade, faults, isolate
 from ..resilience import journal as journal_mod
 from ..serve import loadgen, wire
+from ..serve.queue import ERR_TRANSFER_ABORT
 from .fleet import (REPLICA_EXIT_KIND, REPLICA_KIND, FailoverClient,
                     FleetConfig, FleetSupervisor, ProcessWorkerHandle,
                     RouterServer, worker_argv)
@@ -71,14 +75,15 @@ def _repo_root() -> str:
     return os.path.dirname(pkg)
 
 
-def _next_artifact(root: str) -> str:
-    """The next free ``ROUTE_r<NN>.json`` at the repo root."""
+def _next_artifact(root: str, family: str = "ROUTE") -> str:
+    """The next free ``<FAMILY>_r<NN>.json`` at the repo root (ROUTE
+    for the plain drive, STREAM when the run mixes chunked transfers)."""
     taken = [0]
-    for p in glob.glob(os.path.join(root, "ROUTE_r*.json")):
-        m = re.match(r"ROUTE_r(\d+)\.json$", os.path.basename(p))
+    for p in glob.glob(os.path.join(root, f"{family}_r*.json")):
+        m = re.match(rf"{family}_r(\d+)\.json$", os.path.basename(p))
         if m:
             taken.append(int(m.group(1)))
-    return os.path.join(root, f"ROUTE_r{max(taken) + 1:02d}.json")
+    return os.path.join(root, f"{family}_r{max(taken) + 1:02d}.json")
 
 
 def _spawn_backends(args, tag: str):
@@ -89,9 +94,22 @@ def _spawn_backends(args, tag: str):
     # the same spec would double-fire it inside the serve seams.
     env.pop("OT_FAULTS", None)
     handles, specs = [], []
+    kill_last = getattr(args, "kill_backend_after", None) is not None
     try:
         for i in range(args.backends):
             name = f"b{i}"
+            wenv = dict(env)
+            if i == 0 and getattr(args, "worker_faults", None):
+                # The hung-lane half of the mid-transfer chaos drive
+                # lives in exactly ONE worker; the rest stay clean so
+                # the blast radius is attributable.
+                wenv["OT_FAULTS"] = args.worker_faults
+            if kill_last and i == args.backends - 1:
+                # The SIGKILL victim writes no trace files: a process
+                # that vanishes mid-frame leaves torn spans behind, and
+                # obs.report's orphan licensing is for EXPECTED shapes,
+                # not collateral.
+                wenv.pop("OT_TRACE_DIR", None)
             argv = [sys.executable, "-m", "our_tree_tpu.serve.worker",
                     "--port", "0", "--status-port", "0",
                     "--engine", args.engine,
@@ -103,7 +121,7 @@ def _spawn_backends(args, tag: str):
                     "--modes", ",".join(args.mode_list)]
             if args.worker_lanes is not None:
                 argv += ["--lanes", str(args.worker_lanes)]
-            h = isolate.spawn_service(argv, env=env,
+            h = isolate.spawn_service(argv, env=wenv,
                                       name=f"{tag}:{name}")
             handles.append(h)
         for i, h in enumerate(handles):
@@ -135,12 +153,16 @@ def _spawn_backends(args, tag: str):
     return handles, specs
 
 
-def _teardown(handles) -> tuple[list[dict], int]:
+def _teardown(handles, killed=frozenset()) -> tuple[list[dict], int]:
     """SIGTERM-drain every worker, collect their exit-line docs and the
     worst rc (a worker that lost work exits nonzero; one SIGKILLed past
-    the drain deadline reports a negative rc)."""
+    the drain deadline reports a negative rc). Indices in ``killed``
+    were SIGKILLed ON PURPOSE mid-drive (the chaos arm): their rc is
+    recorded in the doc but exempt from the drain verdict — the
+    contract they prove is the ROUTER absorbing their loss, not their
+    own drain."""
     docs, worst = [], 0
-    for h in handles:
+    for i, h in enumerate(handles):
         rc = h.stop(term_deadline_s=60.0)
         out, err = h.drain_output()
         doc = {}
@@ -153,13 +175,17 @@ def _teardown(handles) -> tuple[list[dict], int]:
                     and cand.get("kind") == "ot-serve-worker-exit"):
                 doc = cand
                 break
-        if rc != 0:
+        if rc != 0 and i not in killed:
             tail = err.strip().splitlines()[-3:]
             print(f"# worker {h.name}: rc={rc}"
                   + (": " + " | ".join(tail) if tail else ""),
                   file=sys.stderr)
-        docs.append({"rc": rc, **doc})
-        worst = worst if rc == 0 else (rc if worst == 0 else worst)
+        row = {"rc": rc, **doc}
+        if i in killed:
+            row["killed"] = True
+        docs.append(row)
+        if i not in killed:
+            worst = worst if rc == 0 else (rc if worst == 0 else worst)
     return docs, worst
 
 
@@ -229,7 +255,81 @@ def _keycache_ratio(exit_docs: list[dict]) -> float:
     return round(hits / (hits + misses), 4) if hits + misses else 0.0
 
 
-async def _drive(args, specs, affinity: bool, probes):
+async def _resume_drill(args, router) -> dict:
+    """Interrupt one oversized transfer mid-stream (a scoped
+    ``transfer_abort`` shot at the LAST chunk's admission, so earlier
+    chunks have already landed, been emitted in order, and been acked
+    into the ledger), then resume it with the same token: only the
+    unacked chunks may be re-sent and the spliced output must be
+    byte-identical to an uninterrupted run — the artifact's ``resume``
+    section (docs/SERVING.md, streaming transfers)."""
+    size = max(args.transfer_sizes)
+    step = router.transfers.chunk_blocks * 16
+    chunks = (size + step - 1) // step
+    rng = random.Random(args.seed ^ 0x51E4A11)
+    key = bytes(rng.getrandbits(8) for _ in range(16))
+    nonce = bytes(rng.getrandbits(8) for _ in range(16))
+    payload = np.frombuffer(rng.randbytes(size), dtype=np.uint8)
+
+    # The reference: the same bytes, uninterrupted, its own token.
+    ref = await router.submit_transfer(
+        "drill", key, nonce, payload, deadline_s=args.transfer_deadline)
+
+    out = np.zeros(size, dtype=np.uint8)
+
+    def collect(spec, resp):
+        piece = np.asarray(resp.payload, dtype=np.uint8)
+        out[spec.offset:spec.offset + spec.nbytes] = piece[:spec.nbytes]
+
+    token = f"drill-{args.seed}"
+    prev = os.environ.get("OT_FAULTS")
+    os.environ["OT_FAULTS"] = f"transfer_abort:1@chunk={chunks - 1}"
+    faults.reset()
+    try:
+        first = await router.submit_transfer(
+            "drill", key, nonce, payload,
+            deadline_s=args.transfer_deadline,
+            resume_token=token, on_chunk=collect)
+    finally:
+        if prev is None:
+            os.environ.pop("OT_FAULTS", None)
+        else:
+            os.environ["OT_FAULTS"] = prev
+        faults.reset()
+    second = await router.submit_transfer(
+        "drill", key, nonce, payload,
+        deadline_s=args.transfer_deadline,
+        resume_token=token, on_chunk=collect)
+
+    t2 = dict(second.transfer or {})
+    doc = {
+        "size": size,
+        "chunks": chunks,
+        "interrupted": bool(not first.ok
+                            and first.error == ERR_TRANSFER_ABORT),
+        "first": dict(first.transfer or {}),
+        "second": t2,
+        "completed": bool(second.ok),
+        "byte_identical": bool(
+            ref.ok and second.ok
+            and out.tobytes()
+            == np.asarray(ref.payload, dtype=np.uint8).tobytes()),
+        "resent_only_unacked": bool(
+            second.ok and t2.get("resumed")
+            and t2.get("skipped", 0) > 0
+            and t2.get("sent", chunks) < chunks),
+    }
+    print(f"# resume drill: size={size} chunks={chunks} "
+          f"interrupted={doc['interrupted']} "
+          f"acked_before_resume={t2.get('skipped')} "
+          f"resent={t2.get('sent')} "
+          f"byte_identical={doc['byte_identical']}", file=sys.stderr)
+    return doc
+
+
+async def _drive(args, specs, affinity: bool, probes,
+                 handles=None, drill: bool = False):
+    transfers_on = bool(getattr(args, "transfer_sizes", ()))
     cfg = RouterConfig(
         deadline_s=args.deadline,
         attempt_timeout_s=args.attempt_timeout,
@@ -241,7 +341,19 @@ async def _drive(args, specs, affinity: bool, probes):
         journal=args.journal if affinity else None,
         # Response frames carry up to one full top-rung payload; size
         # the router's read ceiling to THIS fleet's ladder.
-        max_frame_bytes=max(args.bucket_max * 16 * 2, wire.MAX_PAYLOAD))
+        max_frame_bytes=max(args.bucket_max * 16 * 2, wire.MAX_PAYLOAD),
+        # The chunk rung IS the fleet's top rung: every chunk is an
+        # ordinary ladder-shaped request to a backend.
+        transfer_chunk_blocks=(args.bucket_max if transfers_on else None),
+        transfer_deadline_s=(args.transfer_deadline if transfers_on
+                             else 300.0),
+        # Size the reassembly budget so the drive's own mix can never
+        # shed itself (backpressure is exercised by tests, not here).
+        transfer_budget_bytes=(max(64 << 20,
+                                   2 * max(args.transfer_sizes))
+                               if transfers_on else 64 << 20),
+        transfer_ledger=(args.transfer_ledger
+                         if transfers_on and affinity else None))
     router = Router(specs, cfg)
     await router.start()
     status = None
@@ -252,12 +364,35 @@ async def _drive(args, specs, affinity: bool, probes):
         print(f"# router status: 127.0.0.1:{status.port} "
               f"(federated /metrics: {not args.no_federate})",
               file=sys.stderr)
+    killer = None
+    if handles and getattr(args, "kill_backend_after", None) is not None:
+
+        async def _kill():
+            await asyncio.sleep(args.kill_backend_after)
+            h = handles[-1]
+            print(f"# chaos: SIGKILL backend {h.name} (pid {h.pid}) "
+                  f"at +{args.kill_backend_after:g}s", file=sys.stderr)
+            await asyncio.get_running_loop().run_in_executor(None, h.kill)
+
+        killer = asyncio.create_task(_kill())
     report = await loadgen.run(
         router, args.requests, concurrency=args.concurrency,
         sizes=args.sizes, tenants=args.tenants,
         keys_per_tenant=args.keys_per_tenant, seed=args.seed,
         verify_every=args.verify_every, probes=probes,
-        arrival_rate=args.arrival_rate, modes=args.mode_list)
+        arrival_rate=args.arrival_rate, modes=args.mode_list,
+        transfer_sizes=(args.transfer_sizes if transfers_on else ()),
+        transfer_every=(getattr(args, "transfer_every", 0)
+                        if transfers_on else 0))
+    if killer is not None:
+        killer.cancel()
+        try:
+            await killer
+        except asyncio.CancelledError:
+            pass
+    resume = None
+    if drill and router.transfers is not None:
+        resume = await _resume_drill(args, router)
     # One final gossip pass so the artifact's backend view is current.
     await router.gossip_once()
     healthz = {name: b.last_healthz
@@ -265,7 +400,7 @@ async def _drive(args, specs, affinity: bool, probes):
     if status is not None:
         await status.stop()
     await router.stop()
-    return router, report, healthz
+    return router, report, healthz, resume
 
 
 async def _drive_fleet(args, probes) -> dict:
@@ -887,6 +1022,50 @@ def main(argv=None) -> int:
     ap.add_argument("--min-redispatch", type=int, default=None, metavar="N",
                     help="fail unless redispatches >= N (the failover "
                          "actually happened)")
+    st = ap.add_argument_group(
+        "streaming transfers (ot-stream; docs/SERVING.md)")
+    st.add_argument("--transfer-sizes", default=None, metavar="B1,B2",
+                    help="oversized payload menu in bytes (comma list, "
+                         "each a multiple of 16 ABOVE the top "
+                         "--bucket-max rung): enables router-side "
+                         "chunked transfers sized to this fleet's "
+                         "ladder and mixes one ALWAYS-verified "
+                         "transfer into the load every "
+                         "--transfer-every requests. Names the "
+                         "artifact family STREAM_r*")
+    st.add_argument("--transfer-every", type=int, default=32,
+                    metavar="N",
+                    help="issue a transfer probe every N requests "
+                         "(default 32)")
+    st.add_argument("--transfer-deadline", type=float, default=300.0,
+                    metavar="S",
+                    help="per-TRANSFER end-to-end Budget, seconds "
+                         "(each chunk dispatch gets the remainder)")
+    st.add_argument("--transfer-ledger", default=None, metavar="PATH",
+                    help="durable acked-chunk ledger (the resume "
+                         "contract; docs/RESILIENCE.md)")
+    st.add_argument("--kill-backend-after", type=float, default=None,
+                    metavar="S",
+                    help="SIGKILL the LAST backend this many seconds "
+                         "in — mid-transfer chunks must fail over "
+                         "bit-exactly; the victim's rc is exempt from "
+                         "the drain gate")
+    st.add_argument("--worker-faults", default=None, metavar="SPEC",
+                    help="OT_FAULTS spec armed in worker b0 ONLY "
+                         "(e.g. lane_hang:1 — the hung-lane half of "
+                         "the mid-transfer chaos drive; the spawner "
+                         "still strips the ROUTER's spec from every "
+                         "worker)")
+    st.add_argument("--resume-drill", action="store_true",
+                    help="after the load: interrupt one transfer with "
+                         "a transfer_abort shot, resume it by token, "
+                         "and gate byte-identity + only-unacked-chunks"
+                         "-resent")
+    st.add_argument("--min-chunk-redispatch", type=int, default=None,
+                    metavar="N",
+                    help="fail unless the transfer engine re-sent at "
+                         "least N chunks (chunk_lost discards + shed "
+                         "retries)")
     fl = ap.add_argument_group(
         "fleet elasticity (--autoscale; docs/SERVING.md)")
     fl.add_argument("--autoscale", action="store_true",
@@ -984,6 +1163,12 @@ def main(argv=None) -> int:
           or args.expect_rolls is not None
           or args.min_client_failovers is not None):
         ap.error("fleet-elasticity flags require --autoscale")
+    if args.autoscale and (args.transfer_sizes or args.resume_drill
+                           or args.kill_backend_after is not None
+                           or args.worker_faults):
+        ap.error("streaming-transfer flags drive the plain (non-"
+                 "autoscale) path; --autoscale owns its own chaos "
+                 "schedule")
     if args.ab and args.no_affinity:
         ap.error("--ab compares affinity AGAINST random routing; with "
                  "--no-affinity both arms would be random and the "
@@ -997,6 +1182,27 @@ def main(argv=None) -> int:
     else:
         args.sizes = (loadgen.MIXED_SIZES if args.mixed_sizes
                       else (args.size_bytes,))
+    if args.transfer_sizes:
+        try:
+            args.transfer_sizes = tuple(
+                int(s) for s in args.transfer_sizes.split(",") if s)
+        except ValueError:
+            ap.error(f"--transfer-sizes wants a comma list of byte "
+                     f"counts, got {args.transfer_sizes!r}")
+        rung = args.bucket_max * 16
+        for b in args.transfer_sizes:
+            if b % 16 or b <= rung:
+                ap.error(f"--transfer-sizes entries must be multiples "
+                         f"of 16 ABOVE the top rung ({rung} bytes) — "
+                         f"anything under it is an ordinary request; "
+                         f"got {b}")
+        if args.transfer_every <= 0:
+            ap.error("--transfer-sizes needs --transfer-every > 0")
+    else:
+        args.transfer_sizes = ()
+        if args.resume_drill or args.min_chunk_redispatch is not None:
+            ap.error("--resume-drill/--min-chunk-redispatch need "
+                     "--transfer-sizes (nothing would chunk)")
     args.mode_list = tuple(m.strip() for m in args.modes.split(",")
                            if m.strip()) or ("ctr",)
     if "gcm-open" in args.mode_list and not args.verify_every:
@@ -1027,13 +1233,16 @@ def main(argv=None) -> int:
 
     affinity = not args.no_affinity
     handles, specs = _spawn_backends(args, "route")
+    killed = ({len(handles) - 1}
+              if args.kill_backend_after is not None else frozenset())
     try:
-        router, report, healthz = asyncio.run(
-            _drive(args, specs, affinity, probes))
+        router, report, healthz, resume = asyncio.run(
+            _drive(args, specs, affinity, probes,
+                   handles=handles, drill=args.resume_drill))
     except BaseException:
-        _teardown(handles)
+        _teardown(handles, killed=killed)
         raise
-    exit_docs, worker_rc = _teardown(handles)
+    exit_docs, worker_rc = _teardown(handles, killed=killed)
 
     control = None
     if args.ab:
@@ -1041,7 +1250,7 @@ def main(argv=None) -> int:
         # meaningless over warm ones), same seed, random routing.
         c_handles, c_specs = _spawn_backends(args, "route-ctl")
         try:
-            c_router, c_report, _ = asyncio.run(
+            c_router, c_report, _, _ = asyncio.run(
                 _drive(args, c_specs, False, probes))
         except BaseException:
             _teardown(c_handles)
@@ -1079,6 +1288,15 @@ def main(argv=None) -> int:
           f"quarantines={rstats['quarantine_events']} releases={releases} "
           f"shed_retries={rstats['shed_retries']} "
           f"router_sheds={rstats['router_sheds']}")
+    tstats = rstats.get("transfers")
+    if tstats:
+        print(f"# transfers: started={tstats['started']} "
+              f"completed={tstats['completed']} "
+              f"resumed={tstats['resumed']} "
+              f"aborted={tstats['aborted']} shed={tstats['shed']} "
+              f"chunks_sent={tstats['chunks_sent']} "
+              f"chunk_redispatches={tstats['chunk_redispatches']} "
+              f"held_peak={tstats['held_peak_bytes']}B")
     print(f"# affinity: ratio={rstats['affinity']['ratio']:.4f} "
           f"(hits={rstats['affinity']['hits']} "
           f"misses={rstats['affinity']['misses']}) "
@@ -1143,10 +1361,27 @@ def main(argv=None) -> int:
         "degraded": degrade.events(),
         "metrics": metrics.snapshot(),
     }
+    if tstats:
+        artifact["transfers"] = {
+            "chunk_blocks": args.bucket_max,
+            "sizes": list(args.transfer_sizes),
+            "every": args.transfer_every,
+            "router": tstats,
+            "load": dict(report.transfers),
+        }
+    if resume is not None:
+        artifact["resume"] = resume
+    if args.kill_backend_after is not None:
+        artifact["config"]["kill_backend_after_s"] = \
+            args.kill_backend_after
+        artifact["killed_backend"] = f"b{args.backends - 1}"
+    if args.worker_faults:
+        artifact["config"]["worker_faults"] = args.worker_faults
     if trace.enabled():
         artifact["obs"] = trace.metrics_snapshot()
         artifact["trace_sample"] = trace.sample_rate()
-    path = args.artifact or _next_artifact(_repo_root())
+    path = args.artifact or _next_artifact(
+        _repo_root(), "STREAM" if args.transfer_sizes else "ROUTE")
     with open(path, "w", encoding="utf-8") as fh:
         json.dump(artifact, fh, indent=2, sort_keys=True)
         fh.write("\n")
@@ -1179,6 +1414,14 @@ def main(argv=None) -> int:
             "waterfall_sum_ok_frac": waterfall["sum_within_tol_frac"]}
     if control:
         line["keycache_hit_ratio_random"] = control["keycache_hit_ratio"]
+    if tstats:
+        line["transfers"] = dict(report.transfers)
+        line["chunk_redispatches"] = tstats["chunk_redispatches"]
+    if resume is not None:
+        line["resume"] = ("pass" if resume["interrupted"]
+                          and resume["completed"]
+                          and resume["byte_identical"]
+                          and resume["resent_only_unacked"] else "fail")
     if args.slo:
         line["slo"] = "fail" if slo_rc else "pass"
     if degrade.events():
@@ -1226,6 +1469,28 @@ def main(argv=None) -> int:
               f"{args.min_redispatch} — the failover never happened",
               file=sys.stderr)
         rc = 1
+    if args.transfer_sizes:
+        t = report.transfers or {}
+        if not t.get("requests") or t.get("ok", 0) != t.get("requests"):
+            print(f"# FAIL: transfers {t or '{}'} — every oversized "
+                  "payload in the mix must complete bit-exact",
+                  file=sys.stderr)
+            rc = 1
+    if args.min_chunk_redispatch is not None:
+        got = (tstats or {}).get("chunk_redispatches", 0)
+        if got < args.min_chunk_redispatch:
+            print(f"# FAIL: chunk redispatches {got} < "
+                  f"{args.min_chunk_redispatch} — the per-chunk "
+                  "failover never happened", file=sys.stderr)
+            rc = 1
+    if args.resume_drill:
+        if not (resume and resume["interrupted"] and resume["completed"]
+                and resume["byte_identical"]
+                and resume["resent_only_unacked"]):
+            print(f"# FAIL: resume drill {resume} — interrupted-then-"
+                  "resumed output must be byte-identical with only the "
+                  "unacked chunks re-sent", file=sys.stderr)
+            rc = 1
     if control is not None:
         gain = kc_ratio - control["keycache_hit_ratio"]
         floor = args.min_affinity_gain if args.min_affinity_gain is not None else 0.0

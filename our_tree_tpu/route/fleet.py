@@ -71,7 +71,8 @@ import numpy as np
 from ..obs import metrics, trace
 from ..resilience import faults, isolate
 from ..serve import wire
-from ..serve.queue import ERR_DISPATCH, ERR_SHED, ERR_SHUTDOWN, Response
+from ..serve.queue import (ERR_BAD_REQUEST, ERR_DISPATCH, ERR_SHED,
+                           ERR_SHUTDOWN, ERR_TOO_LARGE, Response)
 from .health import QUARANTINED
 from .proxy import BackendSpec, Router
 
@@ -697,8 +698,37 @@ class RouterServer:
             while True:
                 try:
                     frame = await wire.read_frame(reader, self._max_len)
-                except wire.WireError:
+                except wire.FrameTooLarge as e:
+                    # The router frontend's half of the frame-bound
+                    # hardening (serve/worker.py has the backend's): the
+                    # declared length failed validation BEFORE any
+                    # allocation, the header parsed, so answer a TYPED
+                    # error frame — and when the declared payload is
+                    # modest enough to drain, keep the connection.
                     self.protocol_errors += 1
+                    try:
+                        writer.write(wire.encode_frame(
+                            {"ok": False, "error": ERR_TOO_LARGE,
+                             "detail": f"wire: {e}"}))
+                        await writer.drain()
+                    except Exception:  # noqa: BLE001 - peer already gone
+                        return
+                    if 0 <= e.declared <= 4 * self._max_len and \
+                            await wire.skip_payload(reader, e.declared):
+                        continue
+                    return
+                except wire.WireError as e:
+                    # A torn or unparseable frame leaves no boundary to
+                    # trust: answer the typed error (best effort), then
+                    # close — but never a silent reset.
+                    self.protocol_errors += 1
+                    try:
+                        writer.write(wire.encode_frame(
+                            {"ok": False, "error": ERR_BAD_REQUEST,
+                             "detail": f"wire: {e}"}))
+                        await writer.drain()
+                    except Exception:  # noqa: BLE001 - peer already gone
+                        pass
                     return
                 if frame is None:
                     return

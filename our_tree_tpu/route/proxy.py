@@ -73,6 +73,7 @@ from ..obs import metrics, trace
 from ..resilience import degrade, faults
 from ..resilience import journal as journal_mod
 from ..resilience.policy import Budget, RetryPolicy
+from ..serve import transfer as transfer_mod
 from ..serve import wire
 from ..serve.queue import (ERR_DEADLINE, ERR_DISPATCH, ERR_SHED,
                            ERR_SHUTDOWN, Response)
@@ -436,6 +437,25 @@ class RouterConfig:
     #: blocking connect() timeout per dial attempt (the attempt wall
     #: deadline still bounds the whole exchange above it)
     pool_connect_timeout_s: float = 2.0
+    #: chunked transfers (serve/transfer.py) at the ROUTER: payloads
+    #: above this many blocks decompose into rung-sized chunks that
+    #: spray across the affinity replica ring (each chunk fails over
+    #: bit-exactly like an ordinary request). The router cannot see the
+    #: backends' ladder, so the rung is explicit — size it to the
+    #: fleet's --bucket-max. None/0 disables (oversized requests flow
+    #: to a backend and take its typed refusal).
+    transfer_chunk_blocks: int | None = None
+    #: concurrent transfers admitted before new ones shed
+    max_transfers: int = 8
+    #: in-flight chunks per transfer (the pipelining window)
+    transfer_window: int = 8
+    #: reassembly-buffer byte budget (backpressure, never a wedge)
+    transfer_budget_bytes: int = 64 << 20
+    #: default per-transfer Budget, seconds
+    transfer_deadline_s: float = 300.0
+    #: durable acked-chunk ledger path (the resume contract); None =
+    #: in-memory
+    transfer_ledger: str | None = None
 
 
 class Router:
@@ -473,6 +493,22 @@ class Router:
         #: recently-seen affinity keys (insertion-ordered dict as LRU)
         #: — the rebalance-motion sample on membership changes
         self._seen_keys: dict[str, None] = {}
+        #: the chunked-transfer engine (serve/transfer.py) — the SAME
+        #: engine the server embeds, parameterized here by per-chunk
+        #: ring placement instead of queue admission. None when the
+        #: deployer set no chunk rung.
+        self.transfers: transfer_mod.TransferManager | None = None
+        if self.config.transfer_chunk_blocks:
+            self.transfers = transfer_mod.TransferManager(
+                self._transfer_chunk,
+                chunk_blocks=self.config.transfer_chunk_blocks,
+                max_transfers=self.config.max_transfers,
+                window=self.config.transfer_window,
+                reassembly_budget_bytes=self.config.transfer_budget_bytes,
+                deadline_s=self.config.transfer_deadline_s,
+                ledger=transfer_mod.TransferLedger(
+                    self.config.transfer_ledger),
+                clock=self._clock)
 
     # -- lifecycle ---------------------------------------------------------
     async def start(self) -> None:
@@ -608,6 +644,8 @@ class Router:
         trace.point("route-drained", accepted=self.accepted,
                     answered=self.answered,
                     lost=self.accepted - self.answered)
+        if self.transfers is not None:
+            self.transfers.ledger.close()
         if self._journal is not None:
             self._journal.close()
             self._journal = None
@@ -771,9 +809,22 @@ class Router:
         self._inflight += 1
         self._idle.clear()
         try:
-            resp = await self._route(tenant, bytes(key), bytes(nonce),
-                                     payload, deadline_s, str(mode),
-                                     bytes(iv), bytes(aad), bytes(tag))
+            data = (payload.tobytes() if hasattr(payload, "tobytes")
+                    else bytes(payload))
+            if (self.transfers is not None and data
+                    and len(data) % 16 == 0
+                    and len(data) // 16 > self.transfers.chunk_blocks):
+                # Oversized: ONE accepted/answered request whose chunks
+                # spray across the replica ring (serve/transfer.py) —
+                # gcm lands here too, for the engine's typed refusal.
+                resp = await self.transfers.run(
+                    tenant, bytes(key), bytes(nonce),
+                    np.frombuffer(data, np.uint8), mode=str(mode),
+                    iv=bytes(iv), deadline_s=deadline_s)
+            else:
+                resp = await self._route(tenant, bytes(key), bytes(nonce),
+                                         payload, deadline_s, str(mode),
+                                         bytes(iv), bytes(aad), bytes(tag))
         except Exception as e:  # noqa: BLE001 - a router must always answer
             resp = Response(ok=False, error=ERR_DISPATCH,
                             detail=f"{type(e).__name__}: {e}")
@@ -783,6 +834,59 @@ class Router:
             if self._inflight == 0:
                 self._idle.set()
         return resp
+
+    async def submit_transfer(self, tenant: str, key: bytes, nonce: bytes,
+                              payload, deadline_s: float | None = None,
+                              mode: str = "ctr", iv: bytes = b"",
+                              resume_token: str | None = None,
+                              tails: dict | None = None,
+                              on_chunk=None) -> Response:
+        """The explicit chunked-transfer entry (what ``submit`` takes
+        automatically for oversized payloads), with the resumable
+        streaming hooks exposed — the serve frontend's ``tx``
+        sub-protocol shape, one fault domain up."""
+        if self.transfers is None:
+            return Response(ok=False, error=ERR_DISPATCH,
+                            detail="transfers disabled on this router "
+                                   "(no transfer_chunk_blocks)")
+        if self._draining:
+            return Response(ok=False, error=ERR_SHUTDOWN,
+                            detail="router is draining")
+        self.accepted += 1
+        self._inflight += 1
+        self._idle.clear()
+        try:
+            resp = await self.transfers.run(
+                tenant, bytes(key), bytes(nonce), payload, mode=str(mode),
+                iv=bytes(iv), deadline_s=deadline_s,
+                resume_token=resume_token, tails=tails, on_chunk=on_chunk)
+        except Exception as e:  # noqa: BLE001 - a router must always answer
+            resp = Response(ok=False, error=ERR_DISPATCH,
+                            detail=f"{type(e).__name__}: {e}")
+        finally:
+            self.answered += 1
+            self._inflight -= 1
+            if self._inflight == 0:
+                self._idle.set()
+        return resp
+
+    async def _transfer_chunk(self, tenant: str, key: bytes,
+                              spec, piece, *, mode: str,
+                              deadline_s: float | None, sampled: bool,
+                              parent: str | None) -> Response:
+        """The transfer engine's submit seam at router level: one chunk
+        = one ordinary ring dispatch. ``rotate=spec.index`` starts each
+        chunk's attempt order one replica further around the key's ring
+        sequence — chunks keep the key's affinity (same replica SET)
+        while spraying across the backends, so a 16-chunk transfer is
+        never serialized behind one backend's queue and a single
+        backend's death costs only the chunks in flight there."""
+        data = (piece.tobytes() if hasattr(piece, "tobytes")
+                else bytes(piece))
+        return await self._route_attempts(
+            tenant, key, spec.nonce or b"", data, deadline_s,
+            bool(sampled), parent, mode, spec.iv, b"", b"",
+            rotate=spec.index)
 
     async def _route(self, tenant: str, key: bytes, nonce: bytes, payload,
                      deadline_s: float | None, mode: str = "ctr",
@@ -818,8 +922,8 @@ class Router:
                               data: bytes, deadline_s: float | None,
                               sampled: bool, ps: str | None,
                               mode: str = "ctr", iv: bytes = b"",
-                              aad: bytes = b"",
-                              tag: bytes = b"") -> Response:
+                              aad: bytes = b"", tag: bytes = b"",
+                              rotate: int = 0) -> Response:
         c = self.config
         aff = ring_mod.affinity_key(tenant, key)
         self._track(aff)
@@ -852,6 +956,13 @@ class Router:
         t_admit = self._clock()
         t_first: float | None = None
         order = self._order_for(aff)
+        if rotate and order:
+            # Chunk spray (serve/transfer.py riders): start this
+            # chunk's attempt order ``rotate`` replicas around the
+            # key's ring sequence — same affinity replica set, load
+            # spread across it; failover still walks every member.
+            r = rotate % len(order)
+            order = order[r:] + order[:r]
         primary = order[0] if order else None
         causes: list = []
         tried: set[str] = set()
@@ -1127,4 +1238,6 @@ class Router:
             "router_sheds": self.router_sheds,
             "pool_retired": dict(self.pool_retired),
             "quarantine_events": self.quarantine_events(),
+            "transfers": (self.transfers.stats()
+                          if self.transfers is not None else None),
         }

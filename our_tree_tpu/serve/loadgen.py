@@ -99,6 +99,10 @@ class LoadReport:
     #: responses (Response.ledger — the router attaches them): the
     #: waterfall population route.bench aggregates and gates on
     ledgers: list = field(default_factory=list, repr=False)
+    #: chunked-transfer tallies (requests whose Response carried a
+    #: ``transfer`` section — the oversized mix, serve/transfer.py);
+    #: empty when the drive sent none
+    transfers: dict = field(default_factory=dict)
 
     def finish(self, wall_s: float, ok_bytes: int) -> None:
         self.wall_s = wall_s
@@ -117,6 +121,8 @@ class LoadReport:
             "goodput_gbps": round(self.goodput_gbps, 4),
             "p50_ms": self.p50_ms, "p95_ms": self.p95_ms,
             "p99_ms": self.p99_ms,
+            **({"transfers": dict(self.transfers)}
+               if self.transfers else {}),
         }
 
 
@@ -180,6 +186,33 @@ def make_probes(sizes, seed: int, modes=("ctr",)) -> list[Probe]:
     return probes
 
 
+def make_transfer_probes(sizes, seed: int) -> list[Probe]:
+    """One pinned OVERSIZED ctr request per size — the chunked-transfer
+    mix's probes (serve/transfer.py). Every transfer request in the
+    drive is one of these, always verified: the whole point of the
+    oversized mix is proving the spliced output byte-identical to the
+    single-shot reference, so unverified random transfers would only
+    add bytes, not evidence. Same rule as ``make_probes``: call BEFORE
+    the warmup marker — the references compile on the models path, not
+    the server's."""
+    rng = np.random.default_rng(seed ^ 0x7F4A7C15)
+    probes = []
+    for size in sizes:
+        if size % 16:
+            raise ValueError(f"transfer size {size} is not a multiple "
+                             "of 16 bytes")
+        key = rng.integers(0, 256, 16, dtype=np.uint8).tobytes()
+        nonce = rng.integers(0, 256, 16, dtype=np.uint8).tobytes()
+        payload = rng.integers(0, 256, size, dtype=np.uint8)
+        ref = AES(key, engine="jnp")
+        expected, _, _, _ = ref.crypt_ctr(
+            0, np.frombuffer(nonce, np.uint8),
+            np.zeros(16, np.uint8), payload)
+        probes.append(Probe("transfer", key, nonce, payload,
+                            np.asarray(expected)))
+    return probes
+
+
 async def run(server, n_requests: int, concurrency: int = 32,
               sizes=MIXED_SIZES, tenants: int = 4, keys_per_tenant: int = 2,
               seed: int = 0, verify_every: int = 8,
@@ -187,6 +220,8 @@ async def run(server, n_requests: int, concurrency: int = 32,
               probes: list[Probe] | None = None,
               arrival_rate: float | None = None,
               modes=("ctr",),
+              transfer_sizes=(), transfer_every: int = 0,
+              transfer_probes: list[Probe] | None = None,
               clock=time.monotonic) -> LoadReport:
     """Drive ``server`` with ``n_requests`` total; returns the
     aggregated LoadReport.
@@ -202,11 +237,20 @@ async def run(server, n_requests: int, concurrency: int = 32,
     ``gcm-open`` traffic replays the per-size sealed probe pair (a
     made-up tag would answer ``auth-failed`` by design; auth-failure
     coverage is the tamper tests' job, not the load mix's).
+
+    ``transfer_sizes`` + ``transfer_every=N``: every Nth request is an
+    OVERSIZED pinned probe (round-robin over the sizes) that the target
+    serves as a chunked transfer (serve/transfer.py) — always verified
+    against its single-shot reference, tallied in
+    ``LoadReport.transfers``.
     """
     sizes = tuple(sizes)
     modes = tuple(modes) or ("ctr",)
     if probes is None:
         probes = make_probes(sizes, seed, modes)
+    tprobes = list(transfer_probes or ())
+    if not tprobes and transfer_sizes and transfer_every:
+        tprobes = make_transfer_probes(tuple(transfer_sizes), seed)
     by_key = {(p.mode, p.payload.size): p for p in probes}
     if "gcm-open" in modes:
         missing = [s for s in sizes if ("gcm-open", s) not in by_key]
@@ -242,6 +286,10 @@ async def run(server, n_requests: int, concurrency: int = 32,
         iv, aad, tag) — shared by both loop models so a run's request
         mix depends only on the seed and the request index order, not
         on the loop shape."""
+        if tprobes and transfer_every and i % transfer_every == 0:
+            p = tprobes[(i // transfer_every) % len(tprobes)]
+            return (p.tenant, p.key, p.nonce, p.payload, p,
+                    p.mode, p.iv, p.aad, p.tag)
         size = int(rng.choice(sizes))
         mode = modes[int(rng.integers(len(modes)))]
         probe = (by_key.get((mode, size))
@@ -274,6 +322,15 @@ async def run(server, n_requests: int, concurrency: int = 32,
         report.latencies_ms.append(dt_ms)
         if getattr(resp, "ledger", None) is not None:
             report.ledgers.append(resp.ledger)
+        tx = getattr(resp, "transfer", None)
+        if tx is not None:
+            t = report.transfers
+            t["requests"] = t.get("requests", 0) + 1
+            t["ok"] = t.get("ok", 0) + (1 if resp.ok else 0)
+            t["chunks_sent"] = (t.get("chunks_sent", 0)
+                                + int(tx.get("sent", 0)))
+            t["redispatched"] = (t.get("redispatched", 0)
+                                 + int(tx.get("redispatched", 0)))
         # Per-request client-side outcome + end-to-end latency into the
         # metrics registry: the error CODES are a closed set
         # (queue.ERR_*), so `outcome` stays low-cardinality — exact

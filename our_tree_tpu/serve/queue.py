@@ -82,6 +82,17 @@ ERR_SHUTDOWN = "shutdown"      #: server stopped with the request queued
 ERR_AUTH = "auth-failed"       #: GCM open: tag mismatch (per-request
 #                                 refusal — the batch and its other
 #                                 riders are unaffected)
+ERR_TRANSFER_ABORT = "transfer-abort"  #: a chunked transfer died
+#                                 mid-flight (fault/budget); the
+#                                 response's ``transfer`` dict carries
+#                                 the resume token and acked count, so
+#                                 the client can reconnect and finish
+ERR_TRANSFER_MODE = "transfer-unsupported"  #: oversized payload in a
+#                                 mode the chunk decomposition cannot
+#                                 serve bit-exactly (GCM needs GHASH
+#                                 continuation across chunk tags) —
+#                                 refused with the reason, never
+#                                 silently downgraded
 
 #: The served mode vocabulary. ``ctr`` is the original scattered-CTR
 #: workload; ``gcm``/``gcm-open`` are AES-GCM seal/open (aead/gcm.py —
@@ -123,6 +134,10 @@ class Response:
     #: and shipped over the wire so the router can prepend its own
     #: stages. None on unsampled/refused requests.
     ledger: dict | None = None
+    #: chunked-transfer bookkeeping (serve/transfer.py): the resume
+    #: token, chunk counts, and redispatch/skip tallies of the transfer
+    #: this response answers. None on ordinary (single-rung) requests.
+    transfer: dict | None = None
 
 
 @dataclass

@@ -89,10 +89,10 @@ from ..resilience import faults
 from ..resilience import journal as journal_mod
 from ..resilience import watchdog
 from ..utils import packing
-from . import batcher, lanes
+from . import batcher, lanes, transfer
 from .keycache import KeyCache, key_digest
-from .queue import (ERR_AUTH, ERR_DEADLINE, ERR_DISPATCH, GCM_MODES, MODES,
-                    RequestQueue)
+from .queue import (ERR_AUTH, ERR_DEADLINE, ERR_DISPATCH, ERR_TOO_LARGE,
+                    GCM_MODES, MODES, RequestQueue, Response)
 from .status import StatusServer
 
 #: The jax monitoring event that fires once per REAL backend compile and
@@ -217,6 +217,23 @@ class ServerConfig:
     #: BENCH_r* on a real TPU) the cost model reports utilization
     #: against; None = record traffic without a utilization ratio
     ceiling_gbps: float | None = None
+    #: chunked transfers (serve/transfer.py): payloads above the ladder
+    #: cap decompose into rung-sized chunks instead of refusing
+    #: ``too-large``. None = chunks of exactly the top rung; 0 disables
+    #: (the pre-stream refusal behaviour)
+    transfer_chunk_blocks: int | None = None
+    #: concurrent transfers admitted before new ones shed
+    max_transfers: int = 8
+    #: in-flight chunks per transfer (the pipelining window)
+    transfer_window: int = 8
+    #: reassembly-buffer byte budget: completed-but-unconsumed chunk
+    #: bytes past this shed NEW transfers (backpressure, never a wedge)
+    transfer_budget_bytes: int = 64 << 20
+    #: per-transfer wall deadline (the whole exchange's Budget)
+    transfer_deadline_s: float = 300.0
+    #: transfer ledger journal path (resume tokens survive the process);
+    #: None = in-memory ledger (transparent decomposition only)
+    transfer_ledger: str | None = None
 
 
 class Server:
@@ -273,6 +290,20 @@ class Server:
         #: the warmed ladder's cost-model records (obs/costmodel.py),
         #: filled at start(); the bench's ``cost`` section reads them
         self.cost_records: list = []
+        #: the chunked-transfer engine (serve/transfer.py): oversized
+        #: payloads decompose into ladder riders through the SAME queue
+        #: admission every ordinary request takes. None when disabled
+        #: (transfer_chunk_blocks=0).
+        self.transfers: transfer.TransferManager | None = None
+        if c.transfer_chunk_blocks != 0:
+            chunk_blocks = min(c.transfer_chunk_blocks or self.rungs[-1],
+                               self.rungs[-1])
+            self.transfers = transfer.TransferManager(
+                self._transfer_chunk, chunk_blocks=chunk_blocks,
+                max_transfers=c.max_transfers, window=c.transfer_window,
+                reassembly_budget_bytes=c.transfer_budget_bytes,
+                deadline_s=c.transfer_deadline_s,
+                ledger=transfer.TransferLedger(c.transfer_ledger))
 
     # -- lifecycle ---------------------------------------------------------
     async def start(self) -> None:
@@ -468,6 +499,8 @@ class Server:
             #                    already abandoned (stale generation)
         if self._journal is not None:
             self._journal.close()
+        if self.transfers is not None:
+            self.transfers.ledger.close()
         # Final exact totals on disk even if the process never reaches
         # atexit (e.g. an embedding test harness).
         metrics.flush_now()
@@ -495,11 +528,55 @@ class Server:
         ``sampled``/``parent``/``priority`` propagate a wire-fronted
         request's router-side admission decisions; ``mode`` selects the
         served workload with its ``iv``/``aad``/``tag`` fields
-        (serve/queue.py has the per-mode contract)."""
+        (serve/queue.py has the per-mode contract).
+
+        Payloads above the ladder cap no longer refuse ``too-large``:
+        they decompose into rung-sized chunks (serve/transfer.py) that
+        ride the same queue/batcher/lane machinery as everyone else,
+        and the spliced Response is byte-identical to what one giant
+        rung would have produced (chunk-boundary KATs pin it)."""
+        data = np.asarray(payload, dtype=np.uint8).reshape(-1)
+        span = data.size // 16 + (1 if mode in GCM_MODES else 0)
+        if self.transfers is not None and span > self.rungs[-1] \
+                and data.size and data.size % 16 == 0:
+            return await self.submit_transfer(
+                tenant, key, nonce, data, deadline_s=deadline_s,
+                sampled=sampled, parent=parent, mode=mode, iv=iv)
         return await self.queue.submit(tenant, key, nonce, payload,
                                        deadline_s, sampled=sampled,
                                        parent=parent, priority=priority,
                                        mode=mode, iv=iv, aad=aad, tag=tag)
+
+    async def submit_transfer(self, tenant: str, key: bytes, nonce: bytes,
+                              payload, deadline_s: float | None = None,
+                              sampled: bool | None = None,
+                              parent: str | None = None, mode: str = "ctr",
+                              iv: bytes = b"",
+                              resume_token: str | None = None,
+                              tails: dict | None = None,
+                              on_chunk=None):
+        """The explicit chunked-transfer entry (what ``submit`` takes
+        automatically for oversized payloads): ``resume_token`` /
+        ``tails`` / ``on_chunk`` are the wire frontend's resumable
+        streaming hooks (serve/worker.py's ``tx`` sub-protocol)."""
+        if self.transfers is None:
+            return Response(ok=False, error=ERR_TOO_LARGE,
+                            detail="transfers disabled on this server")
+        return await self.transfers.run(
+            tenant, key, nonce, payload, mode=mode, iv=iv,
+            deadline_s=deadline_s, sampled=sampled, parent=parent,
+            resume_token=resume_token, tails=tails, on_chunk=on_chunk)
+
+    async def _transfer_chunk(self, tenant: str, key: bytes,
+                              spec: transfer.ChunkSpec, piece, *,
+                              mode: str, deadline_s: float | None,
+                              sampled: bool, parent: str | None):
+        """The transfer engine's submit seam: one chunk = one ORDINARY
+        queue admission — it batches, coalesces, fails over, and is
+        deadline-policed exactly like a client-sized request."""
+        return await self.queue.submit(
+            tenant, key, spec.nonce or b"", piece, deadline_s,
+            sampled=sampled, parent=parent, mode=mode, iv=spec.iv)
 
     # -- the batcher loop --------------------------------------------------
     async def _loop(self) -> None:
@@ -805,4 +882,6 @@ class Server:
                       else {"count": 0}),
             "compiles": {"warmup": self.warmup_compiles,
                          "steady": self.steady_compiles()},
+            "transfers": (self.transfers.stats()
+                          if self.transfers is not None else None),
         }

@@ -1,0 +1,637 @@
+"""Resumable chunked transfers: oversized payloads as ladder riders.
+
+The serve ladder tops out at ``max_bucket_blocks`` (the 4096-block rung
+by default) and admission refuses anything larger (``"too-large"``) —
+a hard availability gap for the large-file/streaming scenario the
+ROADMAP names. The paper's own ``length/num_threads`` contiguous-chunk
+decomposition makes CTR embarrassingly parallel AND bit-exactly
+recomposable: block ``offset + j`` of the whole payload and block ``j``
+of a chunk whose counter starts at ``nonce + offset`` produce the same
+keystream byte-for-byte. This module turns that identity into an
+admission path:
+
+* **Decomposition** (``plan``): an oversized payload becomes
+  ladder-rung chunks. CTR chunks carry per-chunk counter offsets (the
+  full 128-bit big-endian add, matching
+  ``utils.packing.np_ctr_le_blocks`` — a counter wrap landing exactly
+  on a chunk boundary is a pinned KAT, tests/test_transfer.py). CBC
+  *decrypt* chunks chain IVs from the previous chunk's last ciphertext
+  block — known up front from the input, so chunks stay independently
+  dispatchable. GCM is refused with a typed reason
+  (``"transfer-unsupported"``): its tag is a GHASH over the WHOLE
+  message and this engine does not implement host-side GHASH
+  continuation across chunk tags — refusing loudly beats a tag that
+  only verifies by luck.
+* **Streaming**: chunks ride the existing queue/batcher/lane (or
+  router) machinery as ordinary riders — each inherits the bit-exact
+  lane/backend redispatch story, so a lane hang, worker SIGKILL, or
+  router failover mid-transfer costs exactly the in-flight chunks.
+* **Reassembly**: strictly in order under a bounded buffer.
+  Out-of-order completions are HELD (``held_bytes``); when the byte
+  budget is crossed, NEW transfers shed with a typed error
+  (``serve_transfer_shed{reason=reassembly}``) while admitted chunks
+  keep draining — a slow consumer backpressures admission, never the
+  dispatch loop.
+* **Resumability**: a journal-backed ledger (JSONL, fsync'd appends,
+  torn-tail tolerant — the ``resilience/journal.py`` durability idiom)
+  records each transfer's id, parameter fingerprint, and acked-chunk
+  bitmap. A reconnecting client presents its resume token: acked
+  chunks are never recomputed or re-emitted, only unacked chunks are
+  re-sent, and the spliced output is byte-identical to an
+  uninterrupted run (CTR/CBC chunk outputs depend only on key + chunk
+  params, never on which attempt computed them).
+
+Fault points (``resilience/faults.py``, ``@chunk=<i>`` scoped):
+``chunk_lost`` discards one completed chunk before reassembly (forcing
+a redispatch), ``reassembly_stall`` stalls the in-order emit seam (the
+slow consumer), ``transfer_abort`` kills the exchange mid-flight with
+the resume token in the typed error.
+
+Observability: a root ``transfer`` span chains every ``transfer-chunk``
+span (and, through ``parent=``, every chunk's queue/dispatch spans)
+under one id; ``serve_transfer_*`` counters and the
+``serve_stage_us{stage="reassembly"}`` histogram carry the exact
+counts; ``serve_reassembly_held_bytes`` gauges the buffer.
+
+asyncio + numpy + resilience/obs only — no jax and no engine imports:
+the module is testable without a backend, and the router (a
+device-free process) imports it as freely as the server.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import os
+import time
+import uuid
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..obs import metrics, trace
+from ..resilience import faults
+from ..resilience.policy import Budget
+from .queue import (ERR_BAD_REQUEST, ERR_DEADLINE, ERR_SHED,
+                    ERR_TRANSFER_ABORT, ERR_TRANSFER_MODE, Response)
+
+#: Modes the chunk decomposition serves bit-exactly. GCM (both
+#: directions) is NOT here: see the module docstring — oversized GCM is
+#: a typed refusal, never a silent downgrade.
+TRANSFER_MODES = ("ctr", "cbc")
+
+LEDGER_KIND = "ot-transfer-ledger"
+LEDGER_VERSION = 1
+
+
+def _slow_s() -> float:
+    """The injected stall cost (``OT_SLOW_S``, faults.injected_slow's
+    knob — one knob for every simulated-latency fault)."""
+    try:
+        return max(float(os.environ.get("OT_SLOW_S", 0.05)), 0.0)
+    except ValueError:
+        return 0.05
+
+
+def chunk_nonce(nonce: bytes, start_block: int) -> bytes:
+    """The CTR counter start of the chunk whose first block is
+    ``start_block`` of the whole payload: the full 128-bit big-endian
+    add (mod 2^128), the same ripple-carry semantics as
+    ``utils.packing.np_ctr_le_blocks`` — so chunked and whole-payload
+    keystreams agree even when the counter wraps mid-transfer."""
+    if len(nonce) != 16:
+        raise ValueError(f"nonce must be 16 bytes, got {len(nonce)}")
+    n = (int.from_bytes(nonce, "big") + int(start_block)) % (1 << 128)
+    return n.to_bytes(16, "big")
+
+
+@dataclass(frozen=True)
+class ChunkSpec:
+    """One planned chunk: where it lives in the transfer and the
+    derived per-chunk cipher parameters."""
+
+    index: int
+    offset: int    #: byte offset into the transfer payload
+    nbytes: int
+    nonce: bytes = b""   #: ctr: derived 16-byte counter start
+    iv: bytes = b""      #: cbc: derived 16-byte IV (previous ct block)
+
+
+def plan(mode: str, chunk_blocks: int, total_bytes: int,
+         nonce: bytes = b"", iv: bytes = b"", payload=None,
+         tails: dict | None = None) -> list[ChunkSpec]:
+    """Decompose a transfer into ladder-rung chunks.
+
+    ``payload`` (ctr: unused; cbc: the ciphertext, for IV chaining) may
+    be sparse on a RESUME — ``tails`` maps chunk index -> that chunk's
+    last 16 input bytes (the ledger remembers them at ack time), so a
+    chunk whose predecessor was acked in a previous connection still
+    plans its IV without the predecessor's bytes.
+    """
+    if total_bytes <= 0 or total_bytes % 16:
+        raise ValueError("payload must be a nonzero multiple of 16 bytes")
+    if chunk_blocks <= 0:
+        raise ValueError(f"chunk_blocks must be positive, got {chunk_blocks}")
+    step = int(chunk_blocks) * 16
+    specs = []
+    tails = tails or {}
+    for i, off in enumerate(range(0, total_bytes, step)):
+        n = min(step, total_bytes - off)
+        if mode == "ctr":
+            specs.append(ChunkSpec(i, off, n,
+                                   nonce=chunk_nonce(nonce, off // 16)))
+        elif mode == "cbc":
+            if off == 0:
+                civ = bytes(iv)
+            elif i - 1 in tails:
+                civ = bytes(tails[i - 1])
+            elif payload is not None:
+                civ = bytes(bytearray(
+                    np.asarray(payload, dtype=np.uint8)[off - 16:off]))
+            else:
+                raise ValueError(
+                    f"cbc chunk {i} needs the previous chunk's tail "
+                    "(payload slice or ledger tail)")
+            if len(civ) != 16:
+                raise ValueError(f"cbc chunk {i} derived a {len(civ)}-byte IV")
+            specs.append(ChunkSpec(i, off, n, iv=civ))
+        else:
+            raise ValueError(f"mode {mode!r} is not chunkable "
+                             f"(transfer modes: {TRANSFER_MODES})")
+    return specs
+
+
+def fingerprint(mode: str, key: bytes, nonce: bytes, iv: bytes,
+                total_bytes: int, chunk_blocks: int) -> str:
+    """The transfer-parameter fingerprint the ledger pins a resume token
+    to: same token + different params means the splice would NOT be
+    byte-identical, so the resume is refused (a fresh transfer starts).
+    The key rides as a digest — the ledger file never holds key bytes.
+    The payload itself is NOT fingerprinted: a resuming client presents
+    only the unacked chunks, and re-presenting its own data faithfully
+    is its job (the server cannot check bytes it never re-reads)."""
+    h = hashlib.sha256()
+    h.update(mode.encode())
+    h.update(hashlib.sha256(bytes(key)).digest())
+    h.update(bytes(nonce))
+    h.update(bytes(iv))
+    h.update(int(total_bytes).to_bytes(8, "big"))
+    h.update(int(chunk_blocks).to_bytes(8, "big"))
+    return h.hexdigest()[:32]
+
+
+class TransferLedger:
+    """The journal-backed acked-chunk ledger (transfer id -> fingerprint
+    + acked bitmap + CBC tails). Same durability idiom as
+    ``resilience/journal.py``: JSONL header + rows, every append flushed
+    AND fsync'd (an ack must survive the process's own SIGKILL — it is
+    the resume contract), torn tail truncated on load. ``path=None`` is
+    the in-memory variant (same API, no durability) for embedders that
+    only want transparent decomposition."""
+
+    def __init__(self, path: str | None = None, max_live: int = 4096):
+        self.path = path
+        self.max_live = int(max_live)
+        self._fh = None
+        #: tid -> {"fp", "chunks", "acked": set[int], "tails": {i: bytes}}
+        self._live: dict[str, dict] = {}
+        if path is not None:
+            self._load()
+            fresh = not os.path.exists(path) or os.path.getsize(path) == 0
+            self._fh = open(path, "a", encoding="utf-8")
+            if fresh:
+                self._append({"kind": LEDGER_KIND, "v": LEDGER_VERSION,
+                              "created_us": trace.now_us()})
+
+    # -- persistence -------------------------------------------------------
+    def _load(self) -> None:
+        if not os.path.exists(self.path):
+            return
+        good = []
+        torn = False
+        with open(self.path, encoding="utf-8") as fh:
+            for line in fh:
+                try:
+                    row = json.loads(line)
+                except ValueError:
+                    torn = True  # torn tail (or garbage): drop from here
+                    break
+                good.append(line)
+                self._replay(row)
+        if torn:
+            # Truncate the torn tail (the journal.py idiom): appending
+            # after a partial line would weld two rows into garbage.
+            tmp = self.path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as fh:
+                fh.writelines(good)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, self.path)
+
+    def _replay(self, row: dict) -> None:
+        op = row.get("op")
+        tid = row.get("tid")
+        if op == "begin":
+            st = self._live.get(tid)
+            if st is None or st["fp"] != row.get("fp"):
+                self._live[tid] = {"fp": row.get("fp"),
+                                   "chunks": int(row.get("chunks", 0)),
+                                   "acked": set(), "tails": {}}
+        elif op == "ack" and tid in self._live:
+            st = self._live[tid]
+            st["acked"].add(int(row["i"]))
+            tail = row.get("tail")
+            if tail:
+                st["tails"][int(row["i"])] = bytes.fromhex(tail)
+        elif op == "done":
+            self._live.pop(tid, None)
+
+    def _append(self, row: dict) -> None:
+        if self._fh is None:
+            return
+        self._fh.write(json.dumps(row, separators=(",", ":")) + "\n")
+        self._fh.flush()
+        os.fsync(self._fh.fileno())
+
+    # -- the transfer engine's API -----------------------------------------
+    def begin(self, tid: str, fp: str, chunks: int) -> set[int]:
+        """Open (or re-open) a transfer; returns the already-acked chunk
+        set — empty for a fresh transfer OR when the presented token's
+        fingerprint does not match (mismatched params restart from
+        scratch rather than splicing incompatible outputs)."""
+        st = self._live.get(tid)
+        if st is not None and st["fp"] == fp:
+            return set(st["acked"])
+        if len(self._live) >= self.max_live:
+            # Bounded: evict the oldest live transfer (dict order =
+            # insertion order) — an abandoned token from last week must
+            # not pin ledger memory forever.
+            self._live.pop(next(iter(self._live)))
+        self._live[tid] = {"fp": fp, "chunks": int(chunks),
+                           "acked": set(), "tails": {}}
+        self._append({"op": "begin", "tid": tid, "fp": fp,
+                      "chunks": int(chunks)})
+        return set()
+
+    def ack(self, tid: str, i: int, tail: bytes = b"") -> None:
+        st = self._live.get(tid)
+        if st is None:
+            return
+        st["acked"].add(int(i))
+        if tail:
+            st["tails"][int(i)] = bytes(tail)
+        row = {"op": "ack", "tid": tid, "i": int(i)}
+        if tail:
+            row["tail"] = bytes(tail).hex()
+        self._append(row)
+
+    def acked(self, tid: str) -> set[int]:
+        st = self._live.get(tid)
+        return set(st["acked"]) if st is not None else set()
+
+    def tails(self, tid: str) -> dict:
+        st = self._live.get(tid)
+        return dict(st["tails"]) if st is not None else {}
+
+    def done(self, tid: str, ok: bool = True) -> None:
+        if tid in self._live:
+            self._live.pop(tid, None)
+            self._append({"op": "done", "tid": tid, "ok": bool(ok)})
+
+    def live(self) -> int:
+        return len(self._live)
+
+    def close(self) -> None:
+        if self._fh is not None:
+            try:
+                self._fh.close()
+            finally:
+                self._fh = None
+
+
+class TransferManager:
+    """The transfer engine: plans, streams, reassembles, and resumes.
+
+    Parameterized by ``submit_chunk`` — ``async (tenant, key, spec,
+    payload_slice, *, mode, deadline_s, sampled, parent) -> Response`` —
+    so the SAME engine drives the server's queue admission
+    (serve/server.py wraps ``RequestQueue.submit``) and the router's
+    ring placement (route/proxy.py wraps ``_route``, spraying chunks
+    across backends). Everything chunk-agnostic about robustness lives
+    here once: the in-flight window, the per-transfer ``Budget``, the
+    bounded reassembly buffer, the fault seams, the ledger, the spans.
+    """
+
+    def __init__(self, submit_chunk, *, chunk_blocks: int,
+                 max_transfers: int = 8, window: int = 8,
+                 reassembly_budget_bytes: int = 64 << 20,
+                 deadline_s: float = 300.0, retry_backoff_s: float = 0.05,
+                 ledger: TransferLedger | None = None,
+                 clock=time.monotonic):
+        self._submit = submit_chunk
+        self.chunk_blocks = int(chunk_blocks)
+        self.max_transfers = int(max_transfers)
+        self.window = int(window)
+        self.reassembly_budget_bytes = int(reassembly_budget_bytes)
+        self.deadline_s = float(deadline_s)
+        self.retry_backoff_s = float(retry_backoff_s)
+        self.ledger = ledger if ledger is not None else TransferLedger()
+        self._clock = clock
+        self.active = 0
+        self.held_bytes = 0
+        self.held_peak = 0
+        # -- counters (mirrored as serve_transfer_* metrics) --
+        self.started = 0
+        self.completed = 0
+        self.resumed = 0
+        self.aborted = 0
+        self.shed = 0
+        self.refused = 0
+        self.chunks_sent = 0
+        self.chunks_skipped = 0
+        self.chunk_redispatches = 0
+        self.bytes_out = 0
+
+    # -- admission ----------------------------------------------------------
+    def _refuse(self, code: str, why: str, mode: str) -> Response:
+        self.refused += 1
+        metrics.counter("serve_transfer_refused", code=code)
+        return Response(ok=False, error=code, detail=why)
+
+    def _shed(self, reason: str, why: str) -> Response:
+        self.shed += 1
+        metrics.counter("serve_transfer_shed", reason=reason)
+        return Response(ok=False, error=ERR_SHED, detail=why)
+
+    async def run(self, tenant: str, key: bytes, nonce: bytes, payload,
+                  *, mode: str = "ctr", iv: bytes = b"",
+                  deadline_s: float | None = None,
+                  sampled: bool | None = None, parent: str | None = None,
+                  resume_token: str | None = None, tails: dict | None = None,
+                  on_chunk=None) -> Response:
+        """Serve one oversized payload as a chunked transfer.
+
+        ``on_chunk`` (optional, sync or async ``(spec, response)``) is
+        the streaming consumer: called strictly in chunk order as the
+        contiguous prefix completes — the wire frontend streams
+        out-frames from it. Without it the chunks splice into one
+        payload and the returned ``Response`` carries the whole output
+        (the transparent-admission path). With it, acked-on-resume
+        chunks are SKIPPED (never recomputed, never re-emitted) and
+        ``Response.payload`` is None — the consumer assembled the
+        bytes. Every response carries ``Response.transfer`` (token +
+        chunk tallies)."""
+        data = np.asarray(payload, dtype=np.uint8).reshape(-1)
+        mode = str(mode or "ctr")
+        if mode not in TRANSFER_MODES:
+            return self._refuse(ERR_TRANSFER_MODE, (
+                f"mode {mode!r} cannot be served as a chunked transfer "
+                f"(chunkable: {TRANSFER_MODES}); GCM's tag is a GHASH "
+                "over the whole message and host-side GHASH continuation "
+                "across chunk tags is not implemented — submit at or "
+                "below the ladder cap, or use ctr/cbc"), mode)
+        if data.size == 0 or data.size % 16:
+            return self._refuse(ERR_BAD_REQUEST, (
+                "payload must be a nonzero multiple of 16 bytes"), mode)
+        try:
+            specs = plan(mode, self.chunk_blocks, data.size,
+                         nonce=nonce, iv=iv, payload=data, tails=tails)
+        except ValueError as e:
+            return self._refuse(ERR_BAD_REQUEST, f"transfer plan: {e}", mode)
+        # Backpressure BEFORE any work: a slow consumer (held bytes over
+        # budget) or a full transfer table sheds NEW transfers with a
+        # typed error — admitted transfers' chunks keep flowing, the
+        # dispatch loop never wedges behind reassembly.
+        if self.active >= self.max_transfers:
+            return self._shed("transfers", (
+                f"{self.active} transfers in flight (max "
+                f"{self.max_transfers}); retry with backoff"))
+        if self.held_bytes > self.reassembly_budget_bytes:
+            return self._shed("reassembly", (
+                f"reassembly buffer over budget ({self.held_bytes} > "
+                f"{self.reassembly_budget_bytes} bytes held); the "
+                "consumer is slow — retry with backoff"))
+
+        tid = resume_token or uuid.uuid4().hex
+        fp = fingerprint(mode, key, nonce, iv, data.size, self.chunk_blocks)
+        acked = self.ledger.begin(tid, fp, len(specs))
+        # Resuming only makes sense on the streaming path: without a
+        # consumer the response must carry EVERY byte, so acked chunks
+        # would have to be recomputed anyway.
+        skip = acked if on_chunk is not None else set()
+        resumed = bool(resume_token) and bool(skip)
+        if sampled is None:
+            sampled = trace.sample()
+        if deadline_s is None:
+            deadline_s = self.deadline_s
+        budget = Budget(deadline_s, clock=self._clock)
+        self.started += 1
+        if resumed:
+            self.resumed += 1
+            metrics.counter("serve_transfer_resumed", mode=mode)
+        metrics.counter("serve_transfer_requests", mode=mode)
+        self.chunks_skipped += len(skip)
+        if skip:
+            metrics.counter("serve_transfer_chunks", len(skip),
+                            outcome="skipped", mode=mode)
+
+        cm = trace.maybe_span(sampled, "transfer", parent=parent,
+                              tenant=tenant, mode=mode, chunks=len(specs),
+                              blocks=data.size // 16, resumed=resumed)
+        cm.__enter__()
+        root = cm.span_id
+        self.active += 1
+        t0 = self._clock()
+        out = np.empty(data.size, dtype=np.uint8) if on_chunk is None else None
+        results: dict[int, Response] = {}
+        landed = asyncio.Event()
+        abort: list = []  # [code, detail] — first failure wins
+        sem = asyncio.Semaphore(max(self.window, 1))
+        sent = 0
+        redispatched = 0
+
+        def _fail(code: str, detail: str) -> None:
+            if not abort:
+                abort.extend((code, detail))
+            landed.set()
+
+        async def run_chunk(spec: ChunkSpec) -> None:
+            nonlocal sent, redispatched
+            async with sem:
+                while True:
+                    if abort:
+                        return
+                    if budget.exhausted():
+                        _fail(ERR_DEADLINE, (
+                            f"transfer budget spent "
+                            f"({budget.spent():.3f}s of {deadline_s}s) "
+                            f"before chunk {spec.index} dispatched"))
+                        return
+                    # The per-chunk admission seam: transfer_abort kills
+                    # the WHOLE exchange here (@<skip> places it so some
+                    # chunks are already acked — the resume drill).
+                    if faults.fire_chunk("transfer_abort", spec.index):
+                        _fail(ERR_TRANSFER_ABORT, (
+                            f"injected transfer_abort at chunk "
+                            f"{spec.index}; present the resume token to "
+                            "finish"))
+                        return
+                    piece = data[spec.offset:spec.offset + spec.nbytes]
+                    ccm = trace.maybe_span(sampled, "transfer-chunk",
+                                           parent=root, chunk=spec.index,
+                                           blocks=spec.nbytes // 16)
+                    ccm.__enter__()
+                    try:
+                        sent += 1
+                        remaining = budget.remaining()
+                        resp = await self._submit(
+                            tenant, key, spec, piece, mode=mode,
+                            deadline_s=(None if remaining == float("inf")
+                                        else max(remaining, 0.001)),
+                            sampled=sampled, parent=root)
+                    except Exception as e:  # noqa: BLE001 - typed answer
+                        ccm.__exit__(type(e), e, None)
+                        _fail(ERR_TRANSFER_ABORT,
+                              f"chunk {spec.index} dispatch raised: {e}")
+                        return
+                    if resp.ok and faults.fire_chunk("chunk_lost",
+                                                     spec.index):
+                        # The injected in-flight loss: the ladder served
+                        # the chunk, the result frame never arrived —
+                        # discard and redispatch exactly this chunk.
+                        ccm.__exit__(RuntimeError, None, None)
+                        redispatched += 1
+                        self.chunk_redispatches += 1
+                        metrics.counter("serve_transfer_chunks",
+                                        outcome="redispatch", mode=mode)
+                        continue
+                    if not resp.ok and resp.error == ERR_SHED \
+                            and not budget.exhausted():
+                        # A shed chunk is backpressure, not failure:
+                        # back off within the transfer budget and
+                        # redispatch (the router does the same dance on
+                        # the ring, one fault domain up).
+                        ccm.__exit__(RuntimeError, None, None)
+                        redispatched += 1
+                        self.chunk_redispatches += 1
+                        metrics.counter("serve_transfer_chunks",
+                                        outcome="redispatch", mode=mode)
+                        await asyncio.sleep(self.retry_backoff_s)
+                        continue
+                    if not resp.ok:
+                        ccm.__exit__(RuntimeError, None, None)
+                        _fail(resp.error or ERR_TRANSFER_ABORT,
+                              f"chunk {spec.index}: {resp.detail}")
+                        return
+                    ccm.__exit__(None, None, None)
+                    metrics.counter("serve_transfer_chunks",
+                                    outcome="ok", mode=mode)
+                    results[spec.index] = resp
+                    self.held_bytes += spec.nbytes
+                    if self.held_bytes > self.held_peak:
+                        self.held_peak = self.held_bytes
+                    metrics.gauge("serve_reassembly_held_bytes",
+                                  self.held_bytes)
+                    landed.set()
+                    return
+
+        tasks = [asyncio.ensure_future(run_chunk(s))
+                 for s in specs if s.index not in skip]
+        try:
+            # The in-order emit loop: the ONE consumer-facing seam.
+            for spec in specs:
+                if spec.index in skip:
+                    continue  # resume: acked in a previous connection
+                t_wait = self._clock()
+                while spec.index not in results and not abort:
+                    landed.clear()
+                    if spec.index in results or abort:
+                        break
+                    try:
+                        await asyncio.wait_for(landed.wait(), timeout=0.25)
+                    except asyncio.TimeoutError:
+                        if budget.exhausted():
+                            _fail(ERR_DEADLINE, (
+                                f"transfer budget spent waiting to "
+                                f"reassemble chunk {spec.index}"))
+                if abort:
+                    break
+                resp = results.pop(spec.index)
+                hold_us = (self._clock() - t_wait) * 1e6
+                metrics.observe("serve_stage_us", hold_us,
+                                stage="reassembly")
+                if faults.fire_chunk("reassembly_stall", spec.index):
+                    # The slow consumer, injected: an AWAITABLE stall
+                    # (the manager shares the dispatch loop's thread —
+                    # a blocking sleep would wedge what this fault
+                    # exists to prove never wedges).
+                    await asyncio.sleep(_slow_s())
+                if on_chunk is not None:
+                    r = on_chunk(spec, resp)
+                    if asyncio.iscoroutine(r):
+                        await r
+                else:
+                    out[spec.offset:spec.offset + spec.nbytes] = resp.payload
+                self.held_bytes -= spec.nbytes
+                metrics.gauge("serve_reassembly_held_bytes",
+                              self.held_bytes)
+                tail = b""
+                if mode == "cbc":
+                    # The ledger remembers each chunk's input tail: a
+                    # RESUMED cbc transfer plans chunk i+1's IV from it
+                    # without re-reading chunk i's bytes.
+                    end = spec.offset + spec.nbytes
+                    tail = bytes(bytearray(data[end - 16:end]))
+                self.ledger.ack(tid, spec.index, tail=tail)
+                self.bytes_out += spec.nbytes
+        finally:
+            if abort:
+                for t in tasks:
+                    t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+            # Landed-but-never-emitted chunks (an aborted exchange, or
+            # stragglers that completed between the abort and the
+            # cancel) release their reassembly hold: an abandoned
+            # transfer must not pin the buffer budget it no longer uses.
+            for spec in specs:
+                if results.pop(spec.index, None) is not None:
+                    self.held_bytes -= spec.nbytes
+            metrics.gauge("serve_reassembly_held_bytes", self.held_bytes)
+            self.active -= 1
+
+        self.chunks_sent += sent
+        tx = {"token": tid, "chunks": len(specs), "sent": sent,
+              "skipped": len(skip), "redispatched": redispatched,
+              "acked": len(self.ledger.acked(tid)), "resumed": resumed}
+        if abort:
+            self.aborted += 1
+            metrics.counter("serve_transfer_aborts", code=abort[0])
+            cm.__exit__(RuntimeError, None, None)  # force-sample failures
+            return Response(ok=False, error=abort[0], detail=abort[1],
+                            transfer=tx)
+        self.ledger.done(tid, ok=True)
+        self.completed += 1
+        metrics.counter("serve_transfer_completed", mode=mode)
+        metrics.counter("serve_transfer_bytes", data.size, mode=mode)
+        metrics.observe("serve_transfer_us", (self._clock() - t0) * 1e6)
+        cm.__exit__(None, None, None)
+        return Response(
+            ok=True,
+            payload=out if on_chunk is None else None,
+            queued_s=0.0, transfer=tx)
+
+    def stats(self) -> dict:
+        """The artifact/status ``transfers`` section."""
+        return {"chunk_blocks": self.chunk_blocks,
+                "started": self.started, "completed": self.completed,
+                "resumed": self.resumed, "aborted": self.aborted,
+                "shed": self.shed, "refused": self.refused,
+                "active": self.active,
+                "chunks_sent": self.chunks_sent,
+                "chunks_skipped": self.chunks_skipped,
+                "chunk_redispatches": self.chunk_redispatches,
+                "bytes_out": self.bytes_out,
+                "held_bytes": self.held_bytes,
+                "held_peak_bytes": self.held_peak,
+                "ledger_live": self.ledger.live()}

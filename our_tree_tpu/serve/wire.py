@@ -51,6 +51,14 @@ clock-skew estimate cancels processing time with) and ``pid`` — the
 wire handshake the Perfetto timeline alignment is built from. All
 optional: a bare header is a plain local request, exactly as before.
 
+Frames carrying a ``tx`` field belong to the resumable chunked-transfer
+sub-protocol (oversized payloads, serve/transfer.py): ``begin`` /
+``begin-ack`` / ``chunk`` / ``out`` / ``done`` exchanged on one
+connection, each an ordinary header+payload frame — the framing layer
+below is unchanged, and every per-frame bound (MAX_HEADER, ``max_len``)
+still applies because a transfer's chunks are at most one ladder rung
+each. ``serve/worker.py`` documents the exchange.
+
 Used by ``serve/worker.py`` (the backend process's TCP frontend — reads
 requests, feeds ``Server.submit``, writes responses) and by
 ``route/proxy.py`` (the router's backend client — the one
@@ -78,6 +86,40 @@ MAX_PAYLOAD = 1 << 22
 class WireError(RuntimeError):
     """A malformed or oversized frame (protocol violation, not a
     request-level error: the connection is not trustworthy past it)."""
+
+
+class FrameTooLarge(WireError):
+    """A frame whose PARSEABLE header declares a payload over the
+    configured max — refused before any allocation trusts the peer.
+
+    Split out from ``WireError`` because this shape is recoverable: the
+    header parsed, so the stream is still framed — the frontend can
+    answer a TYPED error frame (``"too-large"`` with the declared size
+    in the detail) instead of resetting the connection, and — when the
+    declared length is modest enough to drain (``skip_payload``) — even
+    keep serving later frames on the same connection. A torn or
+    unparseable header stays a plain ``WireError``: there is no frame
+    boundary left to trust."""
+
+    def __init__(self, header: dict, declared: int, max_len: int):
+        self.header = header
+        self.declared = int(declared)
+        self.max_len = int(max_len)
+        super().__init__(
+            f"frame payload {declared} bytes outside [0, {max_len}]")
+
+
+async def skip_payload(reader, n: int, chunk: int = 1 << 16) -> bool:
+    """Drain ``n`` declared payload bytes in bounded slices (never one
+    ``n``-sized allocation — ``n`` is the untrusted quantity). True when
+    the stream resynced at the next frame boundary; False on EOF."""
+    left = int(n)
+    while left > 0:
+        piece = await reader.read(min(left, chunk))
+        if not piece:
+            return False
+        left -= len(piece)
+    return True
 
 
 def encode_frame(header: dict, payload: bytes = b"") -> bytes:
@@ -116,7 +158,11 @@ async def read_frame(reader, max_len: int = MAX_PAYLOAD):
     except (TypeError, ValueError) as e:
         raise WireError("frame len is not an integer") from e
     if n < 0 or n > max_len:
-        raise WireError(f"frame payload {n} bytes outside [0, {max_len}]")
+        # Validated against the configured max BEFORE any allocation:
+        # the declared length is attacker-controlled input, and the
+        # typed subclass carries what a frontend needs to refuse it
+        # politely (serve/worker.py, route/fleet.py).
+        raise FrameTooLarge(header, n, max_len)
     payload = b""
     if n:
         try:

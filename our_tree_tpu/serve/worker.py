@@ -47,10 +47,12 @@ import os
 import signal
 import sys
 
+import numpy as np
+
 from ..obs import trace
 from ..resilience import watchdog
-from . import batcher, wire
-from .queue import ERR_BAD_REQUEST
+from . import batcher, transfer, wire
+from .queue import ERR_BAD_REQUEST, ERR_TOO_LARGE, ERR_TRANSFER_MODE
 from .server import Server, ServerConfig
 
 
@@ -113,6 +115,26 @@ class RequestFrontend:
             while True:
                 try:
                     frame = await wire.read_frame(reader, self._max_len)
+                except wire.FrameTooLarge as e:
+                    # The declared length was validated BEFORE any
+                    # allocation (wire.read_frame) and the header parsed,
+                    # so the stream is still framed: answer a TYPED
+                    # error frame, and when the declared payload is
+                    # modest enough to drain, keep the connection —
+                    # one mis-sized request must not reset a peer's
+                    # whole multiplexed session.
+                    self.protocol_errors += 1
+                    try:
+                        writer.write(wire.encode_frame(
+                            {"ok": False, "error": ERR_TOO_LARGE,
+                             "detail": f"wire: {e}"}))
+                        await writer.drain()
+                    except Exception:  # noqa: BLE001 - peer already gone
+                        return
+                    if 0 <= e.declared <= 4 * self._max_len and \
+                            await wire.skip_payload(reader, e.declared):
+                        continue
+                    return
                 except wire.WireError as e:
                     self.protocol_errors += 1
                     try:
@@ -127,6 +149,9 @@ class RequestFrontend:
                     return  # clean EOF between frames
                 header, payload = frame
                 self.frames += 1
+                if header.get("tx"):
+                    await self._serve_transfer(reader, writer, header)
+                    continue
                 await self._answer(writer, header, payload)
         finally:
             try:
@@ -198,6 +223,133 @@ class RequestFrontend:
         writer.write(wire.encode_frame(out, body))
         await writer.drain()
 
+    async def _serve_transfer(self, reader, writer, header: dict) -> None:
+        """The ``tx`` resumable-transfer sub-protocol, one exchange:
+
+        1. client: ``{"tx": "begin", "tid"?, t, k, n|iv, m, total}``
+        2. worker: ``{"tx": "begin-ack", tid, chunks, chunk_blocks,
+           acked: [...]}`` — the acked bitmap from the transfer ledger
+           is the RESUME contract (a fresh tid acks nothing).
+        3. client: one ``{"tx": "chunk", "i", "len"}`` + payload frame
+           per UNACKED chunk, any order.
+        4. worker: in-order ``{"tx": "out", "i", "len"}`` + payload
+           frames as the contiguous prefix completes (each one follows
+           a durable ledger ack), then a final ``{"tx": "done", ...}``
+           verdict with the transfer tallies.
+
+        A mid-exchange failure — worker SIGKILL, cut connection,
+        injected ``transfer_abort`` — leaves the fsync'd acks behind:
+        the client reconnects, re-presents its token at step 1, and
+        steps 3-4 cover only what never acked. The spliced client-side
+        output is byte-identical to an uninterrupted run."""
+        async def refuse(code: str, why: str) -> None:
+            writer.write(wire.encode_frame(
+                {"tx": "done", "ok": False, "error": code, "detail": why}))
+            await writer.drain()
+
+        if header.get("tx") != "begin":
+            await refuse(ERR_BAD_REQUEST, (
+                f"tx exchange must open with begin, got "
+                f"{header.get('tx')!r}"))
+            return
+        tm = self._server.transfers
+        if tm is None:
+            await refuse(ERR_TOO_LARGE, "transfers disabled on this server")
+            return
+        try:
+            key = bytes.fromhex(str(header.get("k", "")))
+            nonce = bytes.fromhex(str(header.get("n", "")))
+            iv = bytes.fromhex(str(header.get("iv", "")))
+        except ValueError:
+            key, nonce, iv = b"", b"", b""
+        mode = str(header.get("m") or "ctr")
+        try:
+            total = int(header.get("total", 0))
+            deadline = header.get("deadline_s")
+            deadline = float(deadline) if deadline is not None else None
+        except (TypeError, ValueError):
+            await refuse(ERR_BAD_REQUEST, "total/deadline_s malformed")
+            return
+        # Refuse unservable exchanges at BEGIN — before the client
+        # uploads a single chunk it would only have wasted.
+        if mode not in transfer.TRANSFER_MODES:
+            await refuse(ERR_TRANSFER_MODE, (
+                f"mode {mode!r} is not chunkable "
+                f"(transfer modes: {transfer.TRANSFER_MODES})"))
+            return
+        if total <= 0 or total % 16:
+            await refuse(ERR_BAD_REQUEST,
+                         "total must be a nonzero multiple of 16 bytes")
+            return
+        step = tm.chunk_blocks * 16
+        chunks = (total + step - 1) // step
+        tid = str(header.get("tid") or "") or os.urandom(16).hex()
+        fp = transfer.fingerprint(mode, key, nonce, iv, total,
+                                  tm.chunk_blocks)
+        acked = tm.ledger.begin(tid, fp, chunks)
+        writer.write(wire.encode_frame(
+            {"tx": "begin-ack", "tid": tid, "chunks": chunks,
+             "chunk_blocks": tm.chunk_blocks, "acked": sorted(acked)}))
+        await writer.drain()
+
+        # Exactly the unacked chunks land in a sparse buffer; acked
+        # regions stay zero and are never read (the engine SKIPS them —
+        # cbc IVs for their successors come from the ledger's tails).
+        buf = np.zeros(total, dtype=np.uint8)
+        needed = set(range(chunks)) - set(acked)
+        while needed:
+            try:
+                frame = await wire.read_frame(reader, self._max_len)
+            except wire.WireError as e:
+                self.protocol_errors += 1
+                await refuse(ERR_BAD_REQUEST, f"wire: {e}")
+                return
+            if frame is None:
+                return  # client gone mid-upload; the acks persist
+            h, body = frame
+            self.frames += 1
+            if h.get("tx") != "chunk":
+                await refuse(ERR_BAD_REQUEST, (
+                    f"expected a chunk frame, got {h.get('tx')!r}"))
+                return
+            try:
+                i = int(h.get("i"))
+            except (TypeError, ValueError):
+                await refuse(ERR_BAD_REQUEST, "chunk index malformed")
+                return
+            want = min(step, total - i * step) if 0 <= i < chunks else -1
+            if want != len(body):
+                await refuse(ERR_BAD_REQUEST, (
+                    f"chunk {i}: {len(body)} bytes, expected {want}"))
+                return
+            buf[i * step:i * step + want] = np.frombuffer(body, np.uint8)
+            needed.discard(i)
+
+        sampled = header.get("sm")
+        sampled = bool(sampled) if sampled is not None else None
+        parent = header.get("ps")
+        parent = str(parent) if parent else None
+
+        async def on_chunk(spec, resp) -> None:
+            body = np.asarray(resp.payload, dtype=np.uint8).tobytes()
+            writer.write(wire.encode_frame(
+                {"tx": "out", "i": spec.index}, body))
+            await writer.drain()
+
+        resp = await self._server.submit_transfer(
+            str(header.get("t", "")), key, nonce, buf,
+            deadline_s=deadline, sampled=sampled, parent=parent,
+            mode=mode, iv=iv, resume_token=tid,
+            tails=tm.ledger.tails(tid), on_chunk=on_chunk)
+        out = {"tx": "done", "ok": resp.ok, "tid": tid,
+               "transfer": resp.transfer,
+               "ts": trace.now_us(), "pid": os.getpid()}
+        if not resp.ok:
+            out["error"] = resp.error
+            out["detail"] = resp.detail
+        writer.write(wire.encode_frame(out))
+        await writer.drain()
+
 
 async def _amain(args) -> int:
     cfg = ServerConfig(
@@ -219,7 +371,13 @@ async def _amain(args) -> int:
         max_inflight=args.max_inflight,
         status_port=args.status_port,
         modes=tuple((args.modes or "ctr").split(",")),
-        ceiling_gbps=args.ceiling_gbps)
+        ceiling_gbps=args.ceiling_gbps,
+        transfer_chunk_blocks=args.transfer_chunk_blocks,
+        max_transfers=args.max_transfers,
+        transfer_window=args.transfer_window,
+        transfer_budget_bytes=args.transfer_budget_bytes,
+        transfer_deadline_s=args.transfer_deadline,
+        transfer_ledger=args.transfer_ledger)
     server = Server(cfg)
     await server.start()
     frontend = RequestFrontend(server, args.port, host=args.host)
@@ -258,7 +416,8 @@ async def _amain(args) -> int:
             "recompiles": stats["compiles"]["steady"],
             "keycache": stats["keycache"],
             "frames": frontend.frames,
-            "protocol_errors": frontend.protocol_errors}
+            "protocol_errors": frontend.protocol_errors,
+            "transfers": stats["transfers"]}
     print(json.dumps(line), flush=True)
     trace.point("worker-drained", lost=lost, frames=frontend.frames)
     return 1 if lost else 0
@@ -308,6 +467,27 @@ def main(argv=None) -> int:
     ap.add_argument("--probe-every", type=int, default=8, metavar="BATCHES")
     ap.add_argument("--max-inflight", type=int, default=None, metavar="N")
     ap.add_argument("--journal", default=None, metavar="PATH")
+    ap.add_argument("--transfer-chunk-blocks", type=int, default=None,
+                    metavar="BLOCKS",
+                    help="chunk rung for oversized payloads "
+                         "(serve/transfer.py; default: the top ladder "
+                         "rung; 0 refuses oversized payloads outright)")
+    ap.add_argument("--max-transfers", type=int, default=8, metavar="N",
+                    help="concurrent chunked transfers before new ones "
+                         "shed")
+    ap.add_argument("--transfer-window", type=int, default=8, metavar="N",
+                    help="in-flight chunks per transfer")
+    ap.add_argument("--transfer-budget-bytes", type=int, default=64 << 20,
+                    metavar="BYTES",
+                    help="reassembly-buffer byte budget: held "
+                         "out-of-order bytes past this shed NEW "
+                         "transfers (backpressure, never a wedge)")
+    ap.add_argument("--transfer-deadline", type=float, default=300.0,
+                    metavar="S", help="default per-transfer budget")
+    ap.add_argument("--transfer-ledger", default=None, metavar="PATH",
+                    help="durable acked-chunk ledger (JSONL, fsync'd): "
+                         "the resume contract survives this worker's "
+                         "own SIGKILL")
     ap.add_argument("--ceiling-gbps", type=float, default=None,
                     metavar="GBPS",
                     help="the measured device roofline the cost model "

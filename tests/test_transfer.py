@@ -1,0 +1,730 @@
+"""ot-stream: resumable chunked transfers (serve/transfer.py).
+
+Four layers, inside-out:
+
+* the pure decomposition math — ``chunk_nonce``'s 128-bit ripple add
+  (a counter wrap landing EXACTLY on a chunk boundary is a pinned
+  case), ``plan``'s geometry and CBC IV chaining, the NIST SP 800-38A
+  CTR KAT stretched across a chunk boundary on BOTH engines;
+* the journal-backed ``TransferLedger`` — acks survive reopen, a torn
+  tail truncates, a fingerprint mismatch restarts instead of splicing
+  incompatible outputs;
+* the ``TransferManager`` engine over a deterministic fake cipher —
+  windowed streaming, bounded-reassembly backpressure (shed, never
+  wedge), ``chunk_lost`` redispatch, ``transfer_abort`` + resume with
+  byte-identical splice and only-unacked-chunks-resent;
+* the serve integration — an in-process ``Server`` admitting an
+  oversized CTR payload bit-exactly, the GCM typed refusal, and the
+  worker frontend's ``tx`` wire sub-protocol including a resumed
+  exchange and the frame-bound hardening on both frontends
+  (serve/worker.py RequestFrontend + route/fleet.py RouterServer).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+
+import numpy as np
+import pytest
+
+from our_tree_tpu.models.aes import AES
+from our_tree_tpu.obs import metrics
+from our_tree_tpu.resilience import degrade, faults
+from our_tree_tpu.route.fleet import RouterServer
+from our_tree_tpu.route.proxy import BackendSpec, Router, RouterConfig
+from our_tree_tpu.serve import transfer, wire
+from our_tree_tpu.serve.queue import (ERR_BAD_REQUEST, ERR_SHED,
+                                      ERR_TOO_LARGE, ERR_TRANSFER_ABORT,
+                                      ERR_TRANSFER_MODE, Response)
+from our_tree_tpu.serve.server import Server, ServerConfig
+from our_tree_tpu.serve.worker import RequestFrontend
+
+LADDER = dict(min_bucket_blocks=32, max_bucket_blocks=256, lanes=1)
+
+# NIST SP 800-38A F.5.1 (CTR-AES128.Encrypt): 4 blocks.
+NIST_KEY = bytes.fromhex("2b7e151628aed2a6abf7158809cf4f3c")
+NIST_CTR0 = bytes.fromhex("f0f1f2f3f4f5f6f7f8f9fafbfcfdfeff")
+NIST_PT = bytes.fromhex(
+    "6bc1bee22e409f96e93d7e117393172a"
+    "ae2d8a571e03ac9c9eb76fac45af8e51"
+    "30c81c46a35ce411e5fbc1191a0a52ef"
+    "f69f2445df4f9b17ad2b417be66c3710")
+NIST_CT = bytes.fromhex(
+    "874d6191b620e3261bef6864990db6ce"
+    "9806f66b7970fdff8617187bb9fffdff"
+    "5ae4df3edbd5d35e5b4f09020db03eab"
+    "1e031dda2fbe03d1792170a0f3009cee")
+
+
+@pytest.fixture(autouse=True)
+def _clean_process_state(monkeypatch):
+    monkeypatch.delenv("OT_FAULTS", raising=False)
+    monkeypatch.delenv("OT_DISPATCH_DEADLINE", raising=False)
+    faults.reset()
+    degrade.clear()
+    metrics.reset_for_tests()
+    yield
+    monkeypatch.delenv("OT_FAULTS", raising=False)
+    faults.reset()
+    degrade.clear()
+    metrics.reset_for_tests()
+
+
+def _ctr(key: bytes, nonce: bytes, payload, engine: str = "jnp"):
+    data = np.asarray(payload, dtype=np.uint8)
+    if engine == "native":
+        from our_tree_tpu.runtime.native import NativeAES
+        out, _ = NativeAES(key).ctr(np.frombuffer(nonce, np.uint8), data)
+        return np.asarray(out)
+    out, _, _, _ = AES(key, engine=engine).crypt_ctr(
+        0, np.frombuffer(nonce, np.uint8), np.zeros(16, np.uint8), data)
+    return np.asarray(out)
+
+
+# ---------------------------------------------------------------------------
+# Decomposition math.
+# ---------------------------------------------------------------------------
+
+
+def test_chunk_nonce_is_128bit_big_endian_add():
+    assert transfer.chunk_nonce(b"\x00" * 16, 0) == b"\x00" * 16
+    assert transfer.chunk_nonce(b"\x00" * 16, 5) == \
+        (5).to_bytes(16, "big")
+    # Ripple carry across every byte.
+    assert transfer.chunk_nonce(b"\x00" * 15 + b"\xff", 1) == \
+        b"\x00" * 14 + b"\x01\x00"
+    # The full 2^128 wrap.
+    assert transfer.chunk_nonce(b"\xff" * 16, 1) == b"\x00" * 16
+    assert transfer.chunk_nonce(b"\xff" * 16, 3) == \
+        (2).to_bytes(16, "big")
+    with pytest.raises(ValueError):
+        transfer.chunk_nonce(b"\x00" * 12, 1)
+
+
+def test_plan_ctr_geometry_and_nonces():
+    nonce = (7).to_bytes(16, "big")
+    specs = transfer.plan("ctr", 4, 16 * 10, nonce=nonce)
+    assert [s.index for s in specs] == [0, 1, 2]
+    assert [s.offset for s in specs] == [0, 64, 128]
+    assert [s.nbytes for s in specs] == [64, 64, 32]  # ragged tail
+    assert [int.from_bytes(s.nonce, "big") for s in specs] == [7, 11, 15]
+    with pytest.raises(ValueError):
+        transfer.plan("ctr", 4, 40, nonce=nonce)   # not a block multiple
+    with pytest.raises(ValueError):
+        transfer.plan("ctr", 0, 64, nonce=nonce)
+    with pytest.raises(ValueError):
+        transfer.plan("gcm", 4, 64, nonce=nonce)   # not chunkable
+
+
+def test_plan_cbc_chains_ivs_from_payload_and_tails():
+    rng = np.random.default_rng(3)
+    ct = rng.integers(0, 256, 16 * 8, dtype=np.uint8)
+    iv = bytes(range(16))
+    specs = transfer.plan("cbc", 4, ct.size, iv=iv, payload=ct)
+    assert specs[0].iv == iv
+    assert specs[1].iv == ct[48:64].tobytes()
+    # A RESUME plans the same IVs from the ledger's tails, without the
+    # predecessor's bytes.
+    tails = {0: ct[48:64].tobytes()}
+    resumed = transfer.plan("cbc", 4, ct.size, iv=iv, payload=None,
+                            tails=tails)
+    assert resumed[1].iv == specs[1].iv
+    with pytest.raises(ValueError):
+        transfer.plan("cbc", 4, ct.size, iv=iv)  # no payload, no tails
+
+
+def test_fingerprint_pins_every_parameter():
+    base = transfer.fingerprint("ctr", b"k" * 16, b"n" * 16, b"", 320, 4)
+    assert base == transfer.fingerprint(
+        "ctr", b"k" * 16, b"n" * 16, b"", 320, 4)
+    for other in (
+            transfer.fingerprint("cbc", b"k" * 16, b"n" * 16, b"", 320, 4),
+            transfer.fingerprint("ctr", b"x" * 16, b"n" * 16, b"", 320, 4),
+            transfer.fingerprint("ctr", b"k" * 16, b"m" * 16, b"", 320, 4),
+            transfer.fingerprint("ctr", b"k" * 16, b"n" * 16, b"", 640, 4),
+            transfer.fingerprint("ctr", b"k" * 16, b"n" * 16, b"", 320, 8)):
+        assert other != base
+
+
+@pytest.mark.parametrize("engine", ["jnp", "native"])
+def test_nist_ctr_kat_across_chunk_boundary(engine):
+    """The SP 800-38A KAT stretched across a chunk boundary: chunks of
+    2 blocks over the 4-block vector, each computed INDEPENDENTLY from
+    its planned counter start, splice to the pinned ciphertext."""
+    specs = transfer.plan("ctr", 2, len(NIST_PT), nonce=NIST_CTR0)
+    assert len(specs) == 2
+    out = b"".join(
+        _ctr(NIST_KEY, s.nonce,
+             np.frombuffer(NIST_PT[s.offset:s.offset + s.nbytes],
+                           np.uint8), engine).tobytes()
+        for s in specs)
+    assert out == NIST_CT
+
+
+@pytest.mark.parametrize("engine", ["jnp", "native"])
+def test_ctr_counter_wrap_exactly_on_chunk_boundary(engine):
+    """Counter start 2^128 - 2, 4 blocks, chunks of 2: the second
+    chunk's counter is EXACTLY the wrap to zero — chunked and whole
+    keystreams must still agree byte for byte."""
+    base = ((1 << 128) - 2).to_bytes(16, "big")
+    rng = np.random.default_rng(9)
+    pt = rng.integers(0, 256, 64, dtype=np.uint8)
+    specs = transfer.plan("ctr", 2, pt.size, nonce=base)
+    assert specs[1].nonce == b"\x00" * 16  # the wrap, on the boundary
+    whole = _ctr(NIST_KEY, base, pt, engine)
+    spliced = np.concatenate([
+        _ctr(NIST_KEY, s.nonce, pt[s.offset:s.offset + s.nbytes], engine)
+        for s in specs])
+    assert np.array_equal(whole, spliced)
+
+
+# ---------------------------------------------------------------------------
+# The ledger.
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_acks_survive_reopen(tmp_path):
+    path = str(tmp_path / "tx.jsonl")
+    led = transfer.TransferLedger(path)
+    assert led.begin("t1", "fp1", 4) == set()
+    led.ack("t1", 0)
+    led.ack("t1", 2, tail=b"\xab" * 16)
+    led.close()
+
+    led2 = transfer.TransferLedger(path)
+    assert led2.begin("t1", "fp1", 4) == {0, 2}
+    assert led2.tails("t1") == {2: b"\xab" * 16}
+    led2.done("t1")
+    led2.close()
+
+    led3 = transfer.TransferLedger(path)
+    assert led3.begin("t1", "fp1", 4) == set()  # done cleared it
+    led3.close()
+
+
+def test_ledger_fingerprint_mismatch_restarts(tmp_path):
+    led = transfer.TransferLedger(str(tmp_path / "tx.jsonl"))
+    led.begin("t1", "fp1", 4)
+    led.ack("t1", 1)
+    # Same token, different params: the splice would not be
+    # byte-identical, so nothing is considered acked.
+    assert led.begin("t1", "fp2", 4) == set()
+    led.close()
+
+
+def test_ledger_truncates_torn_tail(tmp_path):
+    path = tmp_path / "tx.jsonl"
+    led = transfer.TransferLedger(str(path))
+    led.begin("t1", "fp1", 4)
+    led.ack("t1", 0)
+    led.close()
+    with open(path, "a", encoding="utf-8") as fh:
+        fh.write('{"op": "ack", "tid": "t1", "i"')  # the torn append
+    led2 = transfer.TransferLedger(str(path))
+    assert led2.acked("t1") == {0}
+    # The torn line was truncated away, not welded onto the next row.
+    led2.ack("t1", 3)
+    led2.close()
+    rows = [json.loads(l) for l in path.read_text().splitlines()]
+    assert rows[-1] == {"op": "ack", "tid": "t1", "i": 3}
+
+
+def test_ledger_bounds_live_transfers():
+    led = transfer.TransferLedger(max_live=2)
+    led.begin("t1", "f", 1)
+    led.begin("t2", "f", 1)
+    led.begin("t3", "f", 1)  # evicts the oldest (t1)
+    assert led.live() == 2
+    assert led.begin("t2", "f", 1) is not None
+    led.begin("t1", "f", 1)  # t1 restarted from scratch
+    assert led.acked("t1") == set()
+
+
+# ---------------------------------------------------------------------------
+# The TransferManager engine (deterministic fake cipher).
+# ---------------------------------------------------------------------------
+
+
+def _fake_chunk_bytes(key: bytes, spec, piece: np.ndarray) -> bytes:
+    """A deterministic stand-in cipher: output depends ONLY on
+    (key, chunk params, chunk bytes) — the property resume relies on."""
+    seed = hashlib.sha256(
+        bytes(key) + spec.nonce + spec.iv
+        + spec.index.to_bytes(4, "big")
+        + np.asarray(piece, np.uint8).tobytes()).digest()
+    reps = (len(piece) + len(seed) - 1) // len(seed)
+    return (seed * reps)[:len(piece)]
+
+
+def _fake_submit(calls=None):
+    async def submit(tenant, key, spec, piece, *, mode, deadline_s,
+                     sampled, parent):
+        if calls is not None:
+            calls.append(spec.index)
+        await asyncio.sleep(0)
+        return Response(ok=True, payload=np.frombuffer(
+            _fake_chunk_bytes(key, spec, piece), np.uint8))
+    return submit
+
+
+def _fake_whole(key: bytes, nonce: bytes, payload: np.ndarray,
+                chunk_blocks: int) -> bytes:
+    return b"".join(
+        _fake_chunk_bytes(key, s,
+                          payload[s.offset:s.offset + s.nbytes])
+        for s in transfer.plan("ctr", chunk_blocks, payload.size,
+                               nonce=nonce))
+
+
+def test_manager_streams_and_reassembles_in_order():
+    key, nonce = b"k" * 16, b"\x07" * 16
+    payload = np.arange(16 * 40, dtype=np.uint8) % 251
+    tm = transfer.TransferManager(_fake_submit(), chunk_blocks=4,
+                                  window=3)
+    resp = asyncio.run(tm.run("t", key, nonce, payload))
+    assert resp.ok
+    assert resp.payload.tobytes() == _fake_whole(key, nonce, payload, 4)
+    assert resp.transfer["chunks"] == 10
+    assert resp.transfer["sent"] == 10
+    assert resp.transfer["skipped"] == 0
+    assert tm.completed == 1 and tm.held_bytes == 0
+    assert tm.ledger.live() == 0  # done() cleared the token
+
+
+def test_manager_streaming_consumer_gets_chunks_in_order():
+    key, nonce = b"k" * 16, b"\x01" * 16
+    payload = np.arange(16 * 12, dtype=np.uint8) % 249
+    seen = []
+
+    def consume(spec, resp):
+        seen.append((spec.index, resp.payload.tobytes()))
+
+    tm = transfer.TransferManager(_fake_submit(), chunk_blocks=4,
+                                  window=8)
+    resp = asyncio.run(tm.run("t", key, nonce, payload,
+                              on_chunk=consume))
+    assert resp.ok and resp.payload is None
+    assert [i for i, _ in seen] == [0, 1, 2]
+    assert b"".join(b for _, b in seen) == \
+        _fake_whole(key, nonce, payload, 4)
+
+
+def test_manager_refuses_gcm_and_bad_sizes():
+    tm = transfer.TransferManager(_fake_submit(), chunk_blocks=4)
+    r = asyncio.run(tm.run("t", b"k" * 16, b"n" * 16,
+                           np.zeros(128, np.uint8), mode="gcm"))
+    assert not r.ok and r.error == ERR_TRANSFER_MODE
+    assert "GHASH" in r.detail
+    r = asyncio.run(tm.run("t", b"k" * 16, b"n" * 16,
+                           np.zeros(20, np.uint8)))
+    assert not r.ok and r.error == ERR_BAD_REQUEST
+    assert tm.refused == 2
+
+
+def test_manager_sheds_new_transfers_under_backpressure():
+    tm = transfer.TransferManager(_fake_submit(), chunk_blocks=4,
+                                  max_transfers=2,
+                                  reassembly_budget_bytes=1024)
+    payload = np.zeros(16 * 8, np.uint8)
+    tm.active = 2  # the transfer table is full
+    r = asyncio.run(tm.run("t", b"k" * 16, b"n" * 16, payload))
+    assert not r.ok and r.error == ERR_SHED and "transfers" in r.detail
+    tm.active = 0
+    tm.held_bytes = 2048  # the consumer is slow
+    r = asyncio.run(tm.run("t", b"k" * 16, b"n" * 16, payload))
+    assert not r.ok and r.error == ERR_SHED and "reassembly" in r.detail
+    assert tm.shed == 2
+    tm.held_bytes = 0
+    assert asyncio.run(tm.run("t", b"k" * 16, b"n" * 16, payload)).ok
+
+
+def test_manager_redispatches_lost_chunk_bit_exactly(monkeypatch):
+    monkeypatch.setenv("OT_FAULTS", "chunk_lost:1@chunk=2")
+    faults.reset()
+    key, nonce = b"k" * 16, b"\x05" * 16
+    payload = np.arange(16 * 24, dtype=np.uint8) % 247
+    tm = transfer.TransferManager(_fake_submit(), chunk_blocks=4)
+    resp = asyncio.run(tm.run("t", key, nonce, payload))
+    assert resp.ok
+    assert resp.transfer["redispatched"] == 1
+    assert resp.transfer["sent"] == 7  # 6 chunks + 1 re-send
+    assert tm.chunk_redispatches == 1
+    assert resp.payload.tobytes() == _fake_whole(key, nonce, payload, 4)
+
+
+def test_manager_retries_shed_chunks_within_budget():
+    sheds = [True]
+
+    async def submit(tenant, key, spec, piece, *, mode, deadline_s,
+                     sampled, parent):
+        if spec.index == 1 and sheds:
+            sheds.pop()
+            return Response(ok=False, error=ERR_SHED, detail="busy")
+        return Response(ok=True, payload=np.frombuffer(
+            _fake_chunk_bytes(key, spec, piece), np.uint8))
+
+    key, nonce = b"k" * 16, b"\x09" * 16
+    payload = np.arange(16 * 12, dtype=np.uint8) % 241
+    tm = transfer.TransferManager(submit, chunk_blocks=4,
+                                  retry_backoff_s=0.0)
+    resp = asyncio.run(tm.run("t", key, nonce, payload))
+    assert resp.ok and resp.transfer["redispatched"] == 1
+    assert resp.payload.tobytes() == _fake_whole(key, nonce, payload, 4)
+
+
+def test_manager_abort_then_resume_is_byte_identical(
+        tmp_path, monkeypatch):
+    """The headline contract: interrupt mid-stream, resume by token —
+    acked chunks are never re-sent, the splice is byte-identical, and
+    the aborted attempt releases its reassembly hold."""
+    key, nonce = b"k" * 16, b"\x0b" * 16
+    payload = np.arange(16 * 32, dtype=np.uint8) % 239  # 8 chunks
+    chunks = 8
+    whole = _fake_whole(key, nonce, payload, 4)
+    led = transfer.TransferLedger(str(tmp_path / "tx.jsonl"))
+    tm = transfer.TransferManager(_fake_submit(), chunk_blocks=4,
+                                  window=2, ledger=led)
+    out = np.zeros(payload.size, np.uint8)
+
+    def collect(spec, resp):
+        out[spec.offset:spec.offset + spec.nbytes] = resp.payload
+
+    monkeypatch.setenv("OT_FAULTS", f"transfer_abort:1@chunk={chunks - 1}")
+    faults.reset()
+    first = asyncio.run(tm.run("t", key, nonce, payload,
+                               resume_token="tok-1", on_chunk=collect))
+    assert not first.ok and first.error == ERR_TRANSFER_ABORT
+    assert first.transfer["token"] == "tok-1"
+    assert 0 < first.transfer["acked"] < chunks
+    assert tm.held_bytes == 0  # the abort released its hold
+
+    monkeypatch.delenv("OT_FAULTS")
+    faults.reset()
+    second = asyncio.run(tm.run("t", key, nonce, payload,
+                                resume_token="tok-1", on_chunk=collect))
+    assert second.ok and second.transfer["resumed"]
+    assert second.transfer["skipped"] == first.transfer["acked"]
+    assert second.transfer["sent"] == chunks - first.transfer["acked"]
+    assert out.tobytes() == whole
+    assert tm.resumed == 1 and tm.ledger.live() == 0
+
+
+def test_manager_reassembly_stall_backpressures_not_wedges(monkeypatch):
+    monkeypatch.setenv("OT_FAULTS", "reassembly_stall:1@chunk=0")
+    monkeypatch.setenv("OT_SLOW_S", "0.01")
+    faults.reset()
+    key, nonce = b"k" * 16, b"\x0d" * 16
+    payload = np.arange(16 * 12, dtype=np.uint8) % 233
+    tm = transfer.TransferManager(_fake_submit(), chunk_blocks=4)
+    resp = asyncio.run(tm.run("t", key, nonce, payload))
+    assert resp.ok  # stalled, drained, never wedged
+    assert resp.payload.tobytes() == _fake_whole(key, nonce, payload, 4)
+
+
+# ---------------------------------------------------------------------------
+# Serve integration: Server admission + the worker's tx wire protocol.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def served():
+    """One in-process Server + frontend for the integration tests
+    (module-scoped: the warmup compile is the expensive part)."""
+    # transfer_window=2 < the chunk counts used below, so an injected
+    # transfer_abort at the LAST chunk admits only after earlier chunks
+    # completed and were acked — the resume tests rely on acked > 0.
+    server = Server(ServerConfig(status_port=None, transfer_window=2,
+                                 **LADDER))
+    loop = asyncio.new_event_loop()
+    loop.run_until_complete(server.start())
+    front = RequestFrontend(server, 0)
+    loop.run_until_complete(front.start())
+    yield loop, server, front
+    loop.run_until_complete(front.stop())
+    loop.run_until_complete(server.stop())
+    loop.close()
+
+
+def test_server_admits_oversized_ctr_bit_exactly(served):
+    loop, server, _front = served
+    rng = np.random.default_rng(11)
+    key, nonce = b"K" * 16, bytes(range(16))
+    size = 256 * 16 * 3 + 256  # 3 full rungs + a ragged tail
+    payload = rng.integers(0, 256, size, dtype=np.uint8)
+    resp = loop.run_until_complete(
+        server.submit("tenant", key, nonce, payload))
+    assert resp.ok
+    assert resp.transfer is not None
+    assert resp.transfer["chunks"] == 4
+    assert resp.payload.tobytes() == _ctr(key, nonce, payload).tobytes()
+    assert server.transfers.completed >= 1
+
+
+def test_server_refuses_oversized_gcm_with_typed_reason(served):
+    loop, server, _front = served
+    payload = np.zeros(256 * 16 * 2, np.uint8)
+    resp = loop.run_until_complete(
+        server.submit("tenant", b"K" * 16, b"", payload, mode="gcm",
+                      iv=b"\x01" * 12))
+    assert not resp.ok and resp.error == ERR_TRANSFER_MODE
+
+
+def test_server_transfers_disabled_keeps_too_large_refusal():
+    server = Server(ServerConfig(status_port=None,
+                                 transfer_chunk_blocks=0, **LADDER))
+    assert server.transfers is None
+
+    async def go():
+        await server.start()
+        try:
+            return await server.submit(
+                "t", b"K" * 16, b"n" * 16,
+                np.zeros(256 * 16 * 2, np.uint8))
+        finally:
+            await server.stop()
+
+    resp = asyncio.run(go())
+    assert not resp.ok and resp.error == ERR_TOO_LARGE
+
+
+async def _tx_exchange(port: int, header: dict, payload: np.ndarray,
+                       chunk_blocks: int, send: set[int] | None = None):
+    """One client-side tx exchange; returns (begin_ack, outs, done)."""
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    try:
+        writer.write(wire.encode_frame(header))
+        await writer.drain()
+        ack, _ = await wire.read_frame(reader)
+        assert ack.get("tx") == "begin-ack"
+        if not ack.get("ok", True):
+            return ack, {}, ack
+        step = chunk_blocks * 16
+        total = payload.size
+        chunks = ack["chunks"]
+        todo = (set(range(chunks)) - set(ack["acked"])
+                if send is None else set(send))
+        for i in sorted(todo):
+            body = payload[i * step:min((i + 1) * step, total)].tobytes()
+            writer.write(wire.encode_frame({"tx": "chunk", "i": i}, body))
+            await writer.drain()
+        outs, done = {}, None
+        while True:
+            frame = await wire.read_frame(reader, max_len=step)
+            if frame is None:
+                break
+            h, body = frame
+            if h.get("tx") == "out":
+                outs[int(h["i"])] = body
+            elif h.get("tx") == "done":
+                done = h
+                break
+        return ack, outs, done
+    finally:
+        writer.close()
+
+
+def test_worker_tx_protocol_round_trip(served):
+    loop, server, front = served
+    rng = np.random.default_rng(13)
+    key, nonce = b"W" * 16, b"\x21" * 16
+    payload = rng.integers(0, 256, 256 * 16 * 2 + 512, dtype=np.uint8)
+    cb = server.transfers.chunk_blocks
+    ack, outs, done = loop.run_until_complete(_tx_exchange(
+        front.port,
+        {"tx": "begin", "t": "tenant", "k": key.hex(), "n": nonce.hex(),
+         "total": int(payload.size)},
+        payload, cb))
+    assert ack["chunks"] == 3 and ack["acked"] == []
+    assert done["ok"] and done["transfer"]["chunks"] == 3
+    spliced = b"".join(outs[i] for i in sorted(outs))
+    assert spliced == _ctr(key, nonce, payload).tobytes()
+
+
+def test_worker_tx_begin_refusals(served):
+    loop, server, front = served
+
+    async def begin(header):
+        reader, writer = await asyncio.open_connection(
+            "127.0.0.1", front.port)
+        try:
+            writer.write(wire.encode_frame(header))
+            await writer.drain()
+            h, _ = await wire.read_frame(reader)
+            return h
+        finally:
+            writer.close()
+
+    # GCM refused AT BEGIN — before any chunk upload is wasted.
+    h = loop.run_until_complete(begin(
+        {"tx": "begin", "t": "t", "k": "00" * 16, "n": "00" * 16,
+         "m": "gcm", "total": 256 * 16 * 2}))
+    assert h["tx"] == "done" and not h["ok"]
+    assert h["error"] == ERR_TRANSFER_MODE
+    # A non-block-multiple total is a typed bad-request.
+    h = loop.run_until_complete(begin(
+        {"tx": "begin", "t": "t", "k": "00" * 16, "n": "00" * 16,
+         "total": 100}))
+    assert not h["ok"] and h["error"] == ERR_BAD_REQUEST
+
+
+def test_worker_tx_resume_resends_only_unacked(served, monkeypatch):
+    """Interrupt the exchange with an injected transfer_abort, then
+    reconnect with the same token: the begin-ack lists the durable
+    acks, only the unacked chunks are re-sent, and the spliced output
+    is byte-identical to the uninterrupted reference."""
+    loop, server, front = served
+    rng = np.random.default_rng(17)
+    key, nonce = b"R" * 16, b"\x31" * 16
+    cb = server.transfers.chunk_blocks
+    chunks = 6
+    payload = rng.integers(0, 256, cb * 16 * chunks, dtype=np.uint8)
+    header = {"tx": "begin", "t": "tenant", "k": key.hex(),
+              "n": nonce.hex(), "tid": "resume-kat",
+              "total": int(payload.size)}
+
+    monkeypatch.setenv("OT_FAULTS", f"transfer_abort:1@chunk={chunks - 1}")
+    faults.reset()
+    ack1, outs1, done1 = loop.run_until_complete(
+        _tx_exchange(front.port, header, payload, cb))
+    assert not done1["ok"] and done1["error"] == ERR_TRANSFER_ABORT
+    assert done1["tid"] == "resume-kat"
+    acked = done1["transfer"]["acked"]
+    assert 0 < acked < chunks
+    assert sorted(outs1) == list(range(acked))
+
+    monkeypatch.delenv("OT_FAULTS")
+    faults.reset()
+    ack2, outs2, done2 = loop.run_until_complete(
+        _tx_exchange(front.port, header, payload, cb))
+    assert sorted(ack2["acked"]) == sorted(outs1)
+    assert done2["ok"] and done2["transfer"]["resumed"]
+    assert done2["transfer"]["skipped"] == acked
+    assert done2["transfer"]["sent"] == chunks - acked
+    assert set(outs1) | set(outs2) == set(range(chunks))
+    spliced = b"".join({**outs1, **outs2}[i] for i in range(chunks))
+    assert spliced == _ctr(key, nonce, payload).tobytes()
+
+
+# ---------------------------------------------------------------------------
+# Frame-bound hardening, BOTH frontends: a typed error frame, never a
+# silent reset — and an oversized-but-drainable frame keeps the
+# connection serving.
+# ---------------------------------------------------------------------------
+
+
+async def _send_raw(port: int, blob: bytes, then: bytes = b""):
+    """Write raw bytes, read one response frame; optionally write a
+    follow-up frame on the SAME connection and read its answer too."""
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    try:
+        writer.write(blob)
+        await writer.drain()
+        first = await wire.read_frame(reader, max_len=1 << 24)
+        second = None
+        if then:
+            writer.write(then)
+            await writer.drain()
+            second = await wire.read_frame(reader, max_len=1 << 24)
+        return first, second
+    finally:
+        writer.close()
+
+
+def test_worker_frontend_refuses_oversized_frame_and_keeps_conn(served):
+    loop, server, front = served
+    declared = front._max_len + 16  # over the cap, drainable
+    hdr = json.dumps({"t": "t", "len": declared}).encode() + b"\n"
+    follow = wire.encode_frame(
+        {"t": "t", "k": ("00" * 16), "n": ("00" * 16)}, b"\x00" * 16)
+    before = front.protocol_errors
+    (h1, _), second = loop.run_until_complete(
+        _send_raw(front.port, hdr + b"\x00" * declared, then=follow))
+    assert not h1["ok"] and h1["error"] == ERR_TOO_LARGE
+    assert "outside" in h1["detail"]
+    # The SAME connection still serves the next (valid) frame.
+    assert second is not None and second[0]["ok"]
+    assert front.protocol_errors == before + 1
+
+
+def test_worker_frontend_refuses_undrainable_frame_then_closes(served):
+    loop, server, front = served
+    declared = 8 * front._max_len  # too big to drain: answer, close
+    hdr = json.dumps({"t": "t", "len": declared}).encode() + b"\n"
+
+    async def go():
+        reader, writer = await asyncio.open_connection(
+            "127.0.0.1", front.port)
+        try:
+            writer.write(hdr)
+            await writer.drain()
+            h, _ = await wire.read_frame(reader, max_len=1 << 24)
+            assert not h["ok"] and h["error"] == ERR_TOO_LARGE
+            assert await reader.read(16) == b""  # closed, not reset
+        finally:
+            writer.close()
+
+    loop.run_until_complete(go())
+
+
+def test_worker_frontend_answers_typed_frame_on_garbage(served):
+    loop, server, front = served
+    (h, _), _ = loop.run_until_complete(
+        _send_raw(front.port, b"this is not a frame header\n"))
+    assert not h["ok"] and h["error"] == ERR_BAD_REQUEST
+    assert "wire" in h["detail"]
+
+
+def test_router_frontend_hardening_typed_errors():
+    """route/fleet.py RouterServer: the same two hardening shapes as
+    the worker frontend — validated before allocation, typed frames,
+    drain-and-continue when the declared length is modest."""
+    router = Router([BackendSpec("b0", "127.0.0.1", 1, None)],
+                    RouterConfig())
+    srv = RouterServer(router, max_frame_bytes=4096)
+
+    async def go():
+        await srv.start()
+        try:
+            declared = 4096 + 16
+            hdr = json.dumps({"t": "t", "len": declared}).encode() + b"\n"
+            gossip = wire.encode_frame({"g": 1})
+            (h1, _), second = await _send_raw(
+                srv.port, hdr + b"\x00" * declared, then=gossip)
+            assert not h1["ok"] and h1["error"] == ERR_TOO_LARGE
+            # Drained: the same connection still answers gossip.
+            assert second is not None and second[0].get("g") == 1
+
+            (h2, _), _ = await _send_raw(srv.port, b"garbage header\n")
+            assert not h2["ok"] and h2["error"] == ERR_BAD_REQUEST
+            assert srv.protocol_errors == 2
+        finally:
+            await srv.stop()
+
+    asyncio.run(go())
+
+
+# ---------------------------------------------------------------------------
+# Router-side chunk spray: key affinity kept, attempt order rotated.
+# ---------------------------------------------------------------------------
+
+
+def test_router_rotate_spreads_chunks_across_replica_set():
+    specs = [BackendSpec(f"b{i}", "127.0.0.1", i + 1, None)
+             for i in range(3)]
+    router = Router(specs, RouterConfig(vnodes=16, seed=3))
+    for s in specs:
+        router._register(s)
+    base = router._order_for("tenant/deadbeef")
+    assert sorted(base) == ["b0", "b1", "b2"]
+    # Chunk spray (rotate=spec.index in _route_attempts) starts each
+    # chunk one replica further around the SAME affinity sequence:
+    # placement kept, load spread, every head reached.
+    heads = set()
+    for i in range(len(base)):
+        r = i % len(base)
+        rotated = base[r:] + base[:r]
+        heads.add(rotated[0])
+        assert sorted(rotated) == sorted(base)
+    assert len(heads) == len(base)
