@@ -451,6 +451,8 @@ class RouterConfig:
     transfer_window: int = 8
     #: reassembly-buffer byte budget (backpressure, never a wedge)
     transfer_budget_bytes: int = 64 << 20
+    #: per-transfer payload ceiling (too-large past it, pre-allocation)
+    transfer_max_bytes: int = 1 << 30
     #: default per-transfer Budget, seconds
     transfer_deadline_s: float = 300.0
     #: durable acked-chunk ledger path (the resume contract); None =
@@ -505,6 +507,7 @@ class Router:
                 max_transfers=self.config.max_transfers,
                 window=self.config.transfer_window,
                 reassembly_budget_bytes=self.config.transfer_budget_bytes,
+                max_payload_bytes=self.config.transfer_max_bytes,
                 deadline_s=self.config.transfer_deadline_s,
                 ledger=transfer_mod.TransferLedger(
                     self.config.transfer_ledger),

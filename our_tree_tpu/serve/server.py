@@ -229,6 +229,10 @@ class ServerConfig:
     #: reassembly-buffer byte budget: completed-but-unconsumed chunk
     #: bytes past this shed NEW transfers (backpressure, never a wedge)
     transfer_budget_bytes: int = 64 << 20
+    #: per-transfer payload ceiling: a begin frame's client-declared
+    #: total above this refuses ``too-large`` BEFORE any buffer is
+    #: sized from it (serve/worker.py's validate-before-allocate)
+    transfer_max_bytes: int = 1 << 30
     #: per-transfer wall deadline (the whole exchange's Budget)
     transfer_deadline_s: float = 300.0
     #: transfer ledger journal path (resume tokens survive the process);
@@ -302,6 +306,7 @@ class Server:
                 self._transfer_chunk, chunk_blocks=chunk_blocks,
                 max_transfers=c.max_transfers, window=c.transfer_window,
                 reassembly_budget_bytes=c.transfer_budget_bytes,
+                max_payload_bytes=c.transfer_max_bytes,
                 deadline_s=c.transfer_deadline_s,
                 ledger=transfer.TransferLedger(c.transfer_ledger))
 

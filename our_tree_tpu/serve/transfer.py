@@ -74,7 +74,8 @@ from ..obs import metrics, trace
 from ..resilience import faults
 from ..resilience.policy import Budget
 from .queue import (ERR_BAD_REQUEST, ERR_DEADLINE, ERR_SHED,
-                    ERR_TRANSFER_ABORT, ERR_TRANSFER_MODE, Response)
+                    ERR_TOO_LARGE, ERR_TRANSFER_ABORT, ERR_TRANSFER_MODE,
+                    Response)
 
 #: Modes the chunk decomposition serves bit-exactly. GCM (both
 #: directions) is NOT here: see the module docstring — oversized GCM is
@@ -190,10 +191,16 @@ class TransferLedger:
     the in-memory variant (same API, no durability) for embedders that
     only want transparent decomposition."""
 
-    def __init__(self, path: str | None = None, max_live: int = 4096):
+    def __init__(self, path: str | None = None, max_live: int = 4096,
+                 compact_min_rows: int = 1024):
         self.path = path
         self.max_live = int(max_live)
+        self.compact_min_rows = int(compact_min_rows)
         self._fh = None
+        #: journal op rows on disk (begin/ack/done) — the compaction
+        #: trigger compares this against the rows the live set needs
+        self._rows = 0
+        self.compactions = 0
         #: tid -> {"fp", "chunks", "acked": set[int], "tails": {i: bytes}}
         self._live: dict[str, dict] = {}
         if path is not None:
@@ -218,6 +225,8 @@ class TransferLedger:
                     torn = True  # torn tail (or garbage): drop from here
                     break
                 good.append(line)
+                if "op" in row:
+                    self._rows += 1
                 self._replay(row)
         if torn:
             # Truncate the torn tail (the journal.py idiom): appending
@@ -238,6 +247,11 @@ class TransferLedger:
                 self._live[tid] = {"fp": row.get("fp"),
                                    "chunks": int(row.get("chunks", 0)),
                                    "acked": set(), "tails": {}}
+            # max_live holds across restarts too: a journal written
+            # under a larger bound (or missing eviction rows from an
+            # older version) must not replay past the configured cap.
+            while len(self._live) > self.max_live:
+                self._live.pop(next(iter(self._live)))
         elif op == "ack" and tid in self._live:
             st = self._live[tid]
             st["acked"].add(int(row["i"]))
@@ -253,6 +267,48 @@ class TransferLedger:
         self._fh.write(json.dumps(row, separators=(",", ":")) + "\n")
         self._fh.flush()
         os.fsync(self._fh.fileno())
+        if "op" in row:
+            self._rows += 1
+            self._maybe_compact()
+
+    def _state_rows(self) -> int:
+        """Rows a compacted journal would hold (one begin + one ack per
+        acked chunk, per live transfer)."""
+        return sum(1 + len(st["acked"]) for st in self._live.values())
+
+    def _maybe_compact(self) -> None:
+        """Rewrite the journal from the live set once dead rows (done'd
+        and evicted transfers, superseded begins) dominate: without
+        this, a long-lived ledger grows one row per ack FOREVER. The
+        floor keeps small journals append-only (compaction is an fsync'd
+        whole-file rewrite — not worth it under ~1k rows)."""
+        if self._fh is None:
+            return
+        if self._rows <= max(self.compact_min_rows,
+                             4 * (self._state_rows() + 1)):
+            return
+        rows = [{"kind": LEDGER_KIND, "v": LEDGER_VERSION,
+                 "created_us": trace.now_us()}]
+        for tid, st in self._live.items():
+            rows.append({"op": "begin", "tid": tid, "fp": st["fp"],
+                         "chunks": int(st["chunks"])})
+            for i in sorted(st["acked"]):
+                r = {"op": "ack", "tid": tid, "i": int(i)}
+                tail = st["tails"].get(i)
+                if tail:
+                    r["tail"] = bytes(tail).hex()
+                rows.append(r)
+        tmp = self.path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            for r in rows:
+                fh.write(json.dumps(r, separators=(",", ":")) + "\n")
+            fh.flush()
+            os.fsync(fh.fileno())
+        self._fh.close()
+        os.replace(tmp, self.path)
+        self._fh = open(self.path, "a", encoding="utf-8")
+        self._rows = len(rows) - 1  # header row doesn't count
+        self.compactions += 1
 
     # -- the transfer engine's API -----------------------------------------
     def begin(self, tid: str, fp: str, chunks: int) -> set[int]:
@@ -266,8 +322,13 @@ class TransferLedger:
         if len(self._live) >= self.max_live:
             # Bounded: evict the oldest live transfer (dict order =
             # insertion order) — an abandoned token from last week must
-            # not pin ledger memory forever.
-            self._live.pop(next(iter(self._live)))
+            # not pin ledger memory forever. The eviction is JOURNALED
+            # as a done row: a restart must not replay the evicted
+            # transfer back into the live set.
+            old = next(iter(self._live))
+            self._live.pop(old)
+            self._append({"op": "done", "tid": old, "ok": False,
+                          "evicted": True})
         self._live[tid] = {"fp": fp, "chunks": int(chunks),
                            "acked": set(), "tails": {}}
         self._append({"op": "begin", "tid": tid, "fp": fp,
@@ -326,6 +387,7 @@ class TransferManager:
     def __init__(self, submit_chunk, *, chunk_blocks: int,
                  max_transfers: int = 8, window: int = 8,
                  reassembly_budget_bytes: int = 64 << 20,
+                 max_payload_bytes: int = 1 << 30,
                  deadline_s: float = 300.0, retry_backoff_s: float = 0.05,
                  ledger: TransferLedger | None = None,
                  clock=time.monotonic):
@@ -334,6 +396,9 @@ class TransferManager:
         self.max_transfers = int(max_transfers)
         self.window = int(window)
         self.reassembly_budget_bytes = int(reassembly_budget_bytes)
+        #: the per-transfer size ceiling — the frontends check it
+        #: against a client-DECLARED total before allocating anything
+        self.max_payload_bytes = int(max_payload_bytes)
         self.deadline_s = float(deadline_s)
         self.retry_backoff_s = float(retry_backoff_s)
         self.ledger = ledger if ledger is not None else TransferLedger()
@@ -394,6 +459,10 @@ class TransferManager:
         if data.size == 0 or data.size % 16:
             return self._refuse(ERR_BAD_REQUEST, (
                 "payload must be a nonzero multiple of 16 bytes"), mode)
+        if data.size > self.max_payload_bytes:
+            return self._refuse(ERR_TOO_LARGE, (
+                f"payload {data.size} bytes exceeds the transfer cap "
+                f"({self.max_payload_bytes} bytes)"), mode)
         try:
             specs = plan(mode, self.chunk_blocks, data.size,
                          nonce=nonce, iv=iv, payload=data, tails=tails)
@@ -539,65 +608,100 @@ class TransferManager:
         tasks = [asyncio.ensure_future(run_chunk(s))
                  for s in specs if s.index not in skip]
         try:
-            # The in-order emit loop: the ONE consumer-facing seam.
-            for spec in specs:
-                if spec.index in skip:
-                    continue  # resume: acked in a previous connection
-                t_wait = self._clock()
-                while spec.index not in results and not abort:
-                    landed.clear()
-                    if spec.index in results or abort:
+            try:
+                # The in-order emit loop: the ONE consumer-facing seam.
+                for spec in specs:
+                    if spec.index in skip:
+                        continue  # resume: acked in a previous connection
+                    t_wait = self._clock()
+                    while spec.index not in results and not abort:
+                        landed.clear()
+                        if spec.index in results or abort:
+                            break
+                        try:
+                            await asyncio.wait_for(landed.wait(),
+                                                   timeout=0.25)
+                        except asyncio.TimeoutError:
+                            if budget.exhausted():
+                                _fail(ERR_DEADLINE, (
+                                    f"transfer budget spent waiting to "
+                                    f"reassemble chunk {spec.index}"))
+                    if abort:
                         break
+                    resp = results.pop(spec.index)
+                    hold_us = (self._clock() - t_wait) * 1e6
+                    metrics.observe("serve_stage_us", hold_us,
+                                    stage="reassembly")
                     try:
-                        await asyncio.wait_for(landed.wait(), timeout=0.25)
-                    except asyncio.TimeoutError:
-                        if budget.exhausted():
-                            _fail(ERR_DEADLINE, (
-                                f"transfer budget spent waiting to "
-                                f"reassemble chunk {spec.index}"))
-                if abort:
-                    break
-                resp = results.pop(spec.index)
-                hold_us = (self._clock() - t_wait) * 1e6
-                metrics.observe("serve_stage_us", hold_us,
-                                stage="reassembly")
-                if faults.fire_chunk("reassembly_stall", spec.index):
-                    # The slow consumer, injected: an AWAITABLE stall
-                    # (the manager shares the dispatch loop's thread —
-                    # a blocking sleep would wedge what this fault
-                    # exists to prove never wedges).
-                    await asyncio.sleep(_slow_s())
-                if on_chunk is not None:
-                    r = on_chunk(spec, resp)
-                    if asyncio.iscoroutine(r):
-                        await r
-                else:
-                    out[spec.offset:spec.offset + spec.nbytes] = resp.payload
-                self.held_bytes -= spec.nbytes
-                metrics.gauge("serve_reassembly_held_bytes",
-                              self.held_bytes)
-                tail = b""
-                if mode == "cbc":
-                    # The ledger remembers each chunk's input tail: a
-                    # RESUMED cbc transfer plans chunk i+1's IV from it
-                    # without re-reading chunk i's bytes.
-                    end = spec.offset + spec.nbytes
-                    tail = bytes(bytearray(data[end - 16:end]))
-                self.ledger.ack(tid, spec.index, tail=tail)
-                self.bytes_out += spec.nbytes
-        finally:
-            if abort:
+                        if faults.fire_chunk("reassembly_stall",
+                                             spec.index):
+                            # The slow consumer, injected: an AWAITABLE
+                            # stall (the manager shares the dispatch
+                            # loop's thread — a blocking sleep would
+                            # wedge what this fault exists to prove
+                            # never wedges).
+                            await asyncio.sleep(_slow_s())
+                        if on_chunk is not None:
+                            r = on_chunk(spec, resp)
+                            if asyncio.iscoroutine(r):
+                                await r
+                        else:
+                            out[spec.offset:spec.offset + spec.nbytes] = \
+                                resp.payload
+                    except Exception as e:  # noqa: BLE001 - typed abort
+                        # A raising consumer (the wire writer draining
+                        # into a dead socket — the very disconnect
+                        # resume exists for) aborts through the same
+                        # typed path as any chunk failure, so the
+                        # cancel/cleanup below runs and the resume
+                        # token stays presentable.
+                        _fail(ERR_TRANSFER_ABORT, (
+                            f"consumer failed emitting chunk "
+                            f"{spec.index}: {e}"))
+                        break
+                    finally:
+                        # The popped chunk's hold releases on EVERY
+                        # path: held_bytes is manager-wide admission
+                        # state — leaking it on a consumer failure
+                        # would ratchet every future transfer toward
+                        # shed.
+                        self.held_bytes -= spec.nbytes
+                        metrics.gauge("serve_reassembly_held_bytes",
+                                      self.held_bytes)
+                    tail = b""
+                    if mode == "cbc":
+                        # The ledger remembers each chunk's input tail:
+                        # a RESUMED cbc transfer plans chunk i+1's IV
+                        # from it without re-reading chunk i's bytes.
+                        end = spec.offset + spec.nbytes
+                        tail = bytes(bytearray(data[end - 16:end]))
+                    self.ledger.ack(tid, spec.index, tail=tail)
+                    self.bytes_out += spec.nbytes
+            finally:
+                # Cancel unconditionally: on a clean pass every task
+                # already returned (cancel is a no-op), on ANY abnormal
+                # exit — abort, consumer failure, an unexpected raise —
+                # in-flight chunks must not outlive the exchange.
                 for t in tasks:
                     t.cancel()
-            await asyncio.gather(*tasks, return_exceptions=True)
-            # Landed-but-never-emitted chunks (an aborted exchange, or
-            # stragglers that completed between the abort and the
-            # cancel) release their reassembly hold: an abandoned
-            # transfer must not pin the buffer budget it no longer uses.
-            for spec in specs:
-                if results.pop(spec.index, None) is not None:
-                    self.held_bytes -= spec.nbytes
-            metrics.gauge("serve_reassembly_held_bytes", self.held_bytes)
+                await asyncio.gather(*tasks, return_exceptions=True)
+                # Landed-but-never-emitted chunks (an aborted exchange,
+                # or stragglers that completed between the abort and
+                # the cancel) release their reassembly hold: an
+                # abandoned transfer must not pin the buffer budget it
+                # no longer uses.
+                for spec in specs:
+                    if results.pop(spec.index, None) is not None:
+                        self.held_bytes -= spec.nbytes
+                metrics.gauge("serve_reassembly_held_bytes",
+                              self.held_bytes)
+        except BaseException as e:
+            # An escape the typed paths didn't catch still closes the
+            # transfer span — obs must not leak an open root (and the
+            # caller sees the raise unchanged).
+            cm.__exit__(type(e), e, e.__traceback__)
+            raise
+        finally:
             self.active -= 1
 
         self.chunks_sent += sent

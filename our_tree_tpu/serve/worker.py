@@ -51,8 +51,10 @@ import numpy as np
 
 from ..obs import trace
 from ..resilience import watchdog
+from ..resilience.policy import Budget
 from . import batcher, transfer, wire
-from .queue import ERR_BAD_REQUEST, ERR_TOO_LARGE, ERR_TRANSFER_MODE
+from .queue import (ERR_BAD_REQUEST, ERR_DEADLINE, ERR_TOO_LARGE,
+                    ERR_TRANSFER_MODE)
 from .server import Server, ServerConfig
 
 
@@ -281,6 +283,16 @@ class RequestFrontend:
             await refuse(ERR_BAD_REQUEST,
                          "total must be a nonzero multiple of 16 bytes")
             return
+        if total > tm.max_payload_bytes:
+            # The declared total is CLIENT data: bound it before the
+            # sparse buffer (np.zeros(total)) or the needed set exist —
+            # a begin frame alone must not be able to size an
+            # allocation (the same validate-before-allocate contract
+            # wire.read_frame enforces for frame payloads).
+            await refuse(ERR_TOO_LARGE, (
+                f"total {total} bytes exceeds this server's transfer "
+                f"cap ({tm.max_payload_bytes} bytes)"))
+            return
         step = tm.chunk_blocks * 16
         chunks = (total + step - 1) // step
         tid = str(header.get("tid") or "") or os.urandom(16).hex()
@@ -297,9 +309,25 @@ class RequestFrontend:
         # cbc IVs for their successors come from the ledger's tails).
         buf = np.zeros(total, dtype=np.uint8)
         needed = set(range(chunks)) - set(acked)
+        # The upload loop runs under the SAME wall deadline the compute
+        # side will: a client that sends begin and then stalls must not
+        # pin this connection, the sparse buffer, and a live ledger
+        # entry forever (the acks survive the refusal — a later resume
+        # picks up where the stall left off).
+        upload = Budget(deadline if deadline is not None
+                        else tm.deadline_s)
         while needed:
             try:
-                frame = await wire.read_frame(reader, self._max_len)
+                left = upload.remaining()
+                frame = await asyncio.wait_for(
+                    wire.read_frame(reader, self._max_len),
+                    timeout=(None if left == float("inf")
+                             else max(left, 0.001)))
+            except asyncio.TimeoutError:
+                await refuse(ERR_DEADLINE, (
+                    f"upload stalled: {len(needed)} chunks still "
+                    f"unsent after {upload.spent():.3f}s"))
+                return
             except wire.WireError as e:
                 self.protocol_errors += 1
                 await refuse(ERR_BAD_REQUEST, f"wire: {e}")
@@ -376,6 +404,7 @@ async def _amain(args) -> int:
         max_transfers=args.max_transfers,
         transfer_window=args.transfer_window,
         transfer_budget_bytes=args.transfer_budget_bytes,
+        transfer_max_bytes=args.transfer_max_bytes,
         transfer_deadline_s=args.transfer_deadline,
         transfer_ledger=args.transfer_ledger)
     server = Server(cfg)
@@ -482,6 +511,11 @@ def main(argv=None) -> int:
                     help="reassembly-buffer byte budget: held "
                          "out-of-order bytes past this shed NEW "
                          "transfers (backpressure, never a wedge)")
+    ap.add_argument("--transfer-max-bytes", type=int, default=1 << 30,
+                    metavar="BYTES",
+                    help="per-transfer payload ceiling: a begin "
+                         "frame's declared total above this refuses "
+                         "too-large before any buffer is sized from it")
     ap.add_argument("--transfer-deadline", type=float, default=300.0,
                     metavar="S", help="default per-transfer budget")
     ap.add_argument("--transfer-ledger", default=None, metavar="PATH",

@@ -242,6 +242,56 @@ def test_ledger_bounds_live_transfers():
     assert led.acked("t1") == set()
 
 
+def test_ledger_eviction_is_journaled_and_bounded_on_replay(tmp_path):
+    """An at-capacity eviction appends a done row, so a restart does
+    NOT replay the evicted transfer back into the live set — and even
+    a journal written under a LARGER max_live replays bounded."""
+    path = tmp_path / "tx.jsonl"
+    led = transfer.TransferLedger(str(path), max_live=2)
+    led.begin("t1", "f", 1)
+    led.ack("t1", 0)
+    led.begin("t2", "f", 1)
+    led.begin("t3", "f", 1)  # evicts t1, journaled
+    led.close()
+    rows = [json.loads(l) for l in path.read_text().splitlines()]
+    assert {"op": "done", "tid": "t1", "ok": False,
+            "evicted": True} in rows
+
+    led2 = transfer.TransferLedger(str(path), max_live=2)
+    assert led2.live() == 2
+    assert led2.acked("t1") == set()  # evicted, not resurrected
+    led2.close()
+
+    # The same journal under a TIGHTER bound: replay itself enforces it.
+    led3 = transfer.TransferLedger(str(path), max_live=1)
+    assert led3.live() == 1
+    led3.close()
+
+
+def test_ledger_compacts_journal_from_live_set(tmp_path):
+    """Done'd transfers' rows are dead weight: once they dominate, the
+    journal rewrites from the live set — it must not grow one row per
+    ack forever — and the surviving state reloads intact."""
+    path = tmp_path / "tx.jsonl"
+    led = transfer.TransferLedger(str(path), compact_min_rows=8)
+    led.begin("keep", "fp-keep", 4)
+    led.ack("keep", 1, tail=b"\xcd" * 16)
+    for n in range(6):  # 18 dead rows >> 4 * (live 2 rows + 1)
+        tid = f"dead-{n}"
+        led.begin(tid, "f", 1)
+        led.ack(tid, 0)
+        led.done(tid)
+    assert led.compactions >= 1
+    rows = [json.loads(l) for l in path.read_text().splitlines()]
+    assert len(rows) <= 10  # header + live + post-compaction tail, not 21
+    led.close()
+
+    led2 = transfer.TransferLedger(str(path))
+    assert led2.begin("keep", "fp-keep", 4) == {1}
+    assert led2.tails("keep") == {1: b"\xcd" * 16}
+    led2.close()
+
+
 # ---------------------------------------------------------------------------
 # The TransferManager engine (deterministic fake cipher).
 # ---------------------------------------------------------------------------
@@ -321,6 +371,56 @@ def test_manager_refuses_gcm_and_bad_sizes():
                            np.zeros(20, np.uint8)))
     assert not r.ok and r.error == ERR_BAD_REQUEST
     assert tm.refused == 2
+
+
+def test_manager_refuses_payload_over_transfer_cap():
+    tm = transfer.TransferManager(_fake_submit(), chunk_blocks=4,
+                                  max_payload_bytes=256)
+    r = asyncio.run(tm.run("t", b"k" * 16, b"n" * 16,
+                           np.zeros(512, np.uint8)))
+    assert not r.ok and r.error == ERR_TOO_LARGE
+    assert "cap" in r.detail and tm.refused == 1
+
+
+def test_manager_consumer_failure_releases_hold_and_stays_resumable(
+        tmp_path):
+    """The disconnect-mid-stream shape: the consumer raises (the wire
+    writer draining into a dead socket). The exchange must abort
+    TYPED, release the popped chunk's manager-wide reassembly hold
+    (a leak here ratchets every future transfer toward shed), and
+    leave the token resumable for a byte-identical splice."""
+    key, nonce = b"k" * 16, b"\x11" * 16
+    payload = np.arange(16 * 24, dtype=np.uint8) % 237  # 6 chunks
+    whole = _fake_whole(key, nonce, payload, 4)
+    led = transfer.TransferLedger(str(tmp_path / "tx.jsonl"))
+    tm = transfer.TransferManager(_fake_submit(), chunk_blocks=4,
+                                  window=2, ledger=led)
+    out = np.zeros(payload.size, np.uint8)
+
+    def dies_at_2(spec, resp):
+        if spec.index == 2:
+            raise ConnectionResetError("client went away")
+        out[spec.offset:spec.offset + spec.nbytes] = resp.payload
+
+    first = asyncio.run(tm.run("t", key, nonce, payload,
+                               resume_token="tok-c", on_chunk=dies_at_2))
+    assert not first.ok and first.error == ERR_TRANSFER_ABORT
+    assert "consumer" in first.detail
+    assert tm.held_bytes == 0  # the popped chunk's hold released too
+    assert tm.active == 0
+    acked = first.transfer["acked"]
+    assert acked == 2  # chunks 0/1 emitted + acked; 2 died mid-emit
+
+    def collect(spec, resp):
+        out[spec.offset:spec.offset + spec.nbytes] = resp.payload
+
+    second = asyncio.run(tm.run("t", key, nonce, payload,
+                                resume_token="tok-c", on_chunk=collect))
+    assert second.ok and second.transfer["resumed"]
+    assert second.transfer["skipped"] == acked
+    assert out.tobytes() == whole
+    # A fresh transfer still admits: held_bytes did not ratchet.
+    assert asyncio.run(tm.run("t", key, nonce, payload)).ok
 
 
 def test_manager_sheds_new_transfers_under_backpressure():
@@ -567,6 +667,48 @@ def test_worker_tx_begin_refusals(served):
         {"tx": "begin", "t": "t", "k": "00" * 16, "n": "00" * 16,
          "total": 100}))
     assert not h["ok"] and h["error"] == ERR_BAD_REQUEST
+    # A client-declared total is CLIENT data: an absurd one must be
+    # refused BEFORE the sparse buffer or needed set are sized from it
+    # (a single begin frame with total=2^48 must not OOM the worker) —
+    # and before the ledger admits a row for it.
+    live_before = server.transfers.ledger.live()
+    h = loop.run_until_complete(begin(
+        {"tx": "begin", "t": "t", "k": "00" * 16, "n": "00" * 16,
+         "total": 1 << 48}))
+    assert not h["ok"] and h["error"] == ERR_TOO_LARGE
+    assert "cap" in h["detail"]
+    assert server.transfers.ledger.live() == live_before
+
+
+def test_worker_tx_upload_stall_refuses_with_deadline(served):
+    """A client that sends begin and then stalls must not pin the
+    connection, the sparse buffer, and a live ledger entry forever:
+    the upload loop runs under the transfer deadline and answers a
+    typed deadline refusal (the acks survive for a later resume)."""
+    loop, server, front = served
+    cb = server.transfers.chunk_blocks
+
+    async def go():
+        reader, writer = await asyncio.open_connection(
+            "127.0.0.1", front.port)
+        try:
+            writer.write(wire.encode_frame(
+                {"tx": "begin", "t": "t", "k": "00" * 16, "n": "00" * 16,
+                 "total": cb * 16 * 2, "deadline_s": 0.2}))
+            await writer.drain()
+            ack, _ = await wire.read_frame(reader)
+            assert ack["tx"] == "begin-ack" and ack["chunks"] == 2
+            # ... and send nothing: the stall.
+            done, _ = await asyncio.wait_for(wire.read_frame(reader),
+                                             timeout=5.0)
+            return done
+        finally:
+            writer.close()
+
+    done = loop.run_until_complete(go())
+    assert done["tx"] == "done" and not done["ok"]
+    assert done["error"] == "deadline"
+    assert "upload stalled" in done["detail"]
 
 
 def test_worker_tx_resume_resends_only_unacked(served, monkeypatch):
