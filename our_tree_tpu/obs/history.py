@@ -63,7 +63,8 @@ DEFAULT_TOLERANCES = {
 #: Zero-noise count metrics: the head may never exceed the class's
 #: best-ever (minimum) — a lineage that ever achieved 0 lost requests
 #: has promised 0 forever.
-COUNT_METRICS = ("lost", "recompiles", "mismatches", "errors_total")
+COUNT_METRICS = ("lost", "recompiles", "mismatches", "errors_total",
+                 "alerts_total")
 
 #: Latency percentiles are RENDERED but not gated by default: they are
 #: config-sensitive in exactly the way the class key cannot fully pin
@@ -105,6 +106,15 @@ def _extract_servelike(doc: dict) -> dict:
     v = _num(dev.get("utilization"))
     if v is not None:
         out["utilization"] = v
+    # Pulse alert counts (obs/pulse.py): only promised when the round
+    # actually ran an engine — an artifact without the section (older
+    # rounds, pulse disabled) promises nothing, same as any absent
+    # metric.
+    alerts = doc.get("alerts")
+    if isinstance(alerts, dict):
+        v = _num(alerts.get("total"))
+        if v is not None:
+            out["alerts_total"] = v
     return out
 
 

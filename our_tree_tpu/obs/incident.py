@@ -63,7 +63,8 @@ RING_REQUIRED = ("t_us", "outcome")
 
 #: The closed trigger vocabulary (a ``reason`` outside it is a schema
 #: violation — new incident classes are added here deliberately).
-REASONS = ("watchdog-kill", "quarantine", "slo-breach", "auth-spike")
+REASONS = ("watchdog-kill", "quarantine", "slo-breach", "auth-spike",
+           "pulse-alert")
 
 _RING: collections.deque | None = None
 _PROC = uuid.uuid4().hex[:8]

@@ -67,7 +67,8 @@ VERSION = 1
 #: multiply series, and series live forever in a process-global dict.
 ALLOWED_LABEL_KEYS = ("lane", "rung", "engine", "outcome", "bucket",
                       "code", "state", "slots", "point", "kind", "mode",
-                      "backend", "reason", "stage", "nr")
+                      "backend", "reason", "stage", "nr", "rule",
+                      "severity")
 
 #: Runtime backstop for the same hazard the lint rule prevents
 #: statically: at most this many distinct label sets per metric name —

@@ -67,7 +67,7 @@ REQUIRED_KEYS = ("kind", "v", "run", "pid", "t0_us", "t1_us", "seconds",
                  "tier", "armed_by", "rungs", "stages")
 TIERS = ("jax", "stack")
 #: The closed arming vocabulary (who opened the window).
-ARMED_BY = ("cli", "http", "incident", "sweep", "api")
+ARMED_BY = ("cli", "http", "incident", "sweep", "api", "alert")
 
 
 class CaptureBusy(RuntimeError):
@@ -113,6 +113,18 @@ def incident_seconds() -> float:
     recorder (0/unset = off)."""
     try:
         return max(float(os.environ.get("OT_PROFILE_ON_INCIDENT", 0) or 0),
+                   0.0)
+    except ValueError:
+        return 0.0
+
+
+def alert_seconds() -> float:
+    """``OT_PROFILE_ON_ALERT``: capture length armed by a pulse alert
+    (obs/pulse.py; 0/unset = off). A separate knob from the incident
+    one: warn-severity alerts never dump a bundle but may still want
+    an evidence window."""
+    try:
+        return max(float(os.environ.get("OT_PROFILE_ON_ALERT", 0) or 0),
                    0.0)
     except ValueError:
         return 0.0
@@ -504,6 +516,28 @@ def on_incident(reason: str) -> None:
 
     threading.Thread(target=_arm, daemon=True,
                      name="ot-profile-incident").start()
+
+
+def on_alert(rule: str) -> None:
+    """The pulse engine's arming hook (obs/pulse.py ``_fire``): arm one
+    window of OT_PROFILE_ON_ALERT seconds over the alert's aftermath.
+    Same contract as ``on_incident`` — a window already open or any
+    failure is silently fine, and arming happens off the caller's
+    thread (the pulse tick must not stall on jax.profiler init). The
+    pulse edge-trigger is the storm guard: a sustained condition fires
+    once, so at most one window arms per alert edge."""
+    secs = alert_seconds()
+    if not secs:
+        return
+
+    def _arm():
+        try:
+            start_window(secs, armed_by="alert")
+        except Exception:  # noqa: BLE001 - never-raises on this path
+            pass
+
+    threading.Thread(target=_arm, daemon=True,
+                     name="ot-profile-alert").start()
 
 
 # ---------------------------------------------------------------------------

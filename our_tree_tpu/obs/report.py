@@ -659,6 +659,19 @@ def render(run: export.Run, top: int = 10, out=sys.stdout,
                       + (f" ({bad} INVALID)" if bad else "")
                       + "  [obs.report --incidents renders them]\n")
 
+    # -- pulse alerts (obs/pulse.py trace points) --------------------------
+    alerts = run.points("pulse-alert")
+    if alerts:
+        by_rule: dict[tuple[str, str], int] = {}
+        for p in alerts:
+            a = p.get("attrs", {})
+            k = (str(a.get("rule", "?")), str(a.get("severity", "?")))
+            by_rule[k] = by_rule.get(k, 0) + 1
+        out.write(f"\npulse alerts: {len(alerts)}: "
+                  + ", ".join(f"{r} x{n} ({sev})"
+                              for (r, sev), n in sorted(by_rule.items()))
+                  + "  [obs.pulse <run-dir> replays the rule engine]\n")
+
     # -- cross-process joins + clock skew (fleet tracing) ------------------
     join = fleet_join_stats(run)
     if join["roots"]:

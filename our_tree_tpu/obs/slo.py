@@ -72,7 +72,8 @@ DEFAULT_TOLERANCES = {
 _HIGHER_IS_BETTER = ("goodput_gbps",)
 
 #: Zero-noise count metrics: candidate must not exceed baseline, ever.
-COUNT_METRICS = ("errors_total", "lost", "recompiles", "mismatches")
+COUNT_METRICS = ("errors_total", "lost", "recompiles", "mismatches",
+                 "alerts_total")
 
 
 def extract(doc: dict) -> dict:
@@ -97,6 +98,15 @@ def extract(doc: dict) -> dict:
         out["recompiles"] = float(doc["compiles"].get("steady", 0))
     else:
         out["recompiles"] = float(load.get("recompiles", 0))
+    # Pulse alert count (artifact "alerts" section, obs/pulse.py): set
+    # ONLY when the artifact carries the section — a baseline from
+    # before the pulse engine (or with pulse disabled) promised
+    # nothing, and ``compare`` skips count metrics the baseline never
+    # recorded.
+    alerts = doc.get("alerts")
+    if isinstance(alerts, dict) and isinstance(
+            alerts.get("total"), (int, float)):
+        out["alerts_total"] = float(alerts["total"])
     # The per-stage waterfall budgets (artifact "stages" section:
     # {stage: {p50_us, p95_us, p99_us, count}} — route.bench /
     # serve.bench schema): p95 per stage is the gated quantity.
@@ -171,6 +181,12 @@ def compare(baseline: dict, candidate: dict,
                     f"{name}: {cand:g} > {ceil:g} "
                     f"(baseline {base:g}, tolerance +{t:.0%})")
     for name in COUNT_METRICS:
+        if name not in baseline:
+            # Absent = the baseline never promised this count (e.g. a
+            # pre-pulse artifact has no alerts_total). The classic four
+            # are always present in extract()'s output, so this skip
+            # only ever applies to later-added counts.
+            continue
         base = baseline.get(name, 0.0)
         cand = candidate.get(name, 0.0)
         if cand > base:

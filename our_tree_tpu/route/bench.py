@@ -327,6 +327,42 @@ async def _resume_drill(args, router) -> dict:
     return doc
 
 
+def _pulse_section(pulse_t) -> dict | None:
+    """The artifact's ``alerts`` section from the router's live pulse
+    engine (same shape as serve/bench.py's): one final ``tick()`` so
+    the tail of the drive sits inside the last window, then the
+    engine's document. None when the engine never ran."""
+    if pulse_t is None:
+        return None
+    try:
+        pulse_t.tick()
+        adoc = pulse_t.engine.alerts_doc()
+    except Exception:
+        return None
+    return {"total": adoc["total"], "fired": adoc["fired"],
+            "rows": adoc["alerts"], "frames": adoc["frames"]}
+
+
+def _fleet_capacity(healthz) -> dict | None:
+    """The artifact's ``capacity`` section: each worker's pulse engine
+    publishes its live blocks/s estimate on /healthz, the router's
+    gossip cached the documents — sum them into the fleet view the
+    headroom autoscaler polices."""
+    rows = {}
+    total = 0.0
+    for name, doc in sorted((healthz or {}).items()):
+        cap = (doc or {}).get("capacity")
+        if isinstance(cap, dict):
+            rows[name] = cap
+            try:
+                total += float(cap.get("total_blocks_per_s") or 0.0)
+            except (TypeError, ValueError):
+                pass
+    if not rows:
+        return None
+    return {"backends": rows, "total_blocks_per_s": round(total, 3)}
+
+
 async def _drive(args, specs, affinity: bool, probes,
                  handles=None, drill: bool = False):
     transfers_on = bool(getattr(args, "transfer_sizes", ()))
@@ -471,7 +507,9 @@ async def _drive_fleet(args, probes) -> dict:
             up_depth=args.up_depth, down_depth=args.down_depth,
             up_busy=args.up_busy, settle_ticks=args.settle_ticks,
             down_settle_ticks=args.down_settle_ticks,
-            cooldown_s=args.cooldown, poll_every_s=args.poll_every))
+            cooldown_s=args.cooldown, poll_every_s=args.poll_every,
+            policy=args.fleet_policy,
+            headroom_frac=args.headroom_frac))
         for n, h in zip(names, handles):
             sup.adopt(n, h)
 
@@ -633,6 +671,10 @@ async def _drive_fleet(args, probes) -> dict:
             await owner_server.stop()
         await sup.close(drain=True)
         await router.stop()
+        # The engine object outlives its thread: fold the router-tier
+        # pulse verdict into the result before the router goes out of
+        # scope (the fleet drive returns a dict, not the router).
+        pulse_doc = _pulse_section(router.pulse)
     except BaseException:
         await _abandon()
         raise
@@ -646,7 +688,8 @@ async def _drive_fleet(args, probes) -> dict:
     return {"report": report, "router": rstats, "healthz": healthz,
             "releases": releases, "fleet": fleet_doc,
             "events": list(sup.events), "workers": sup.exit_docs,
-            "routers": router_docs, "client": client_stats}
+            "routers": router_docs, "client": client_stats,
+            "pulse": pulse_doc}
 
 
 def _main_fleet(args, probes) -> int:
@@ -684,6 +727,7 @@ def _main_fleet(args, probes) -> int:
         pass
 
     print(f"# fleet: floor={args.backends} max={args.fleet_max} "
+          f"policy={args.fleet_policy} "
           f"up_depth={args.up_depth:g} down_depth={args.down_depth:g} "
           f"cooldown={args.cooldown:g}s routers={args.routers}")
     print(f"# requests={report.requests} ok={report.ok} "
@@ -727,6 +771,18 @@ def _main_fleet(args, probes) -> int:
                 print(f"#   stage {s:<13} p50={st['p50_us']:>8.0f}µs "
                       f"p95={st['p95_us']:>8.0f}µs "
                       f"p99={st['p99_us']:>8.0f}µs  (n={st['count']})")
+    pulse_doc = res["pulse"]
+    capacity = _fleet_capacity(res["healthz"])
+    if pulse_doc is not None:
+        fired = (" ".join(f"{r}x{n}"
+                          for r, n in pulse_doc["fired"].items())
+                 or "none")
+        print(f"# pulse: {pulse_doc['total']} alert(s) over "
+              f"{pulse_doc['frames']} frame(s) (fired: {fired})")
+    if capacity is not None:
+        print(f"# capacity: fleet "
+              f"{capacity['total_blocks_per_s']:g} blocks/s across "
+              f"{len(capacity['backends'])} worker(s)")
 
     artifact = {
         "config": {
@@ -743,6 +799,8 @@ def _main_fleet(args, probes) -> int:
             "arrival_rate": args.arrival_rate,
             "seed": args.seed,
             "fleet": {"max_workers": args.fleet_max,
+                      "policy": args.fleet_policy,
+                      "headroom_frac": args.headroom_frac,
                       "up_depth": args.up_depth,
                       "down_depth": args.down_depth,
                       "up_busy": args.up_busy,
@@ -772,6 +830,8 @@ def _main_fleet(args, probes) -> int:
         "waterfall": waterfall,
         "stages": waterfall["stages"],
         "healthz": res["healthz"],
+        "alerts": pulse_doc,
+        "capacity": capacity,
         "degraded": degrade.events(),
         "metrics": metrics.snapshot(),
     }
@@ -814,6 +874,8 @@ def _main_fleet(args, probes) -> int:
         line["slo"] = "fail" if slo_rc else "pass"
     if degrade.events():
         line["degraded"] = degrade.events()
+    if pulse_doc is not None and pulse_doc["total"]:
+        line["alerts"] = pulse_doc["fired"]
     print(json.dumps(line))
 
     rc = 0
@@ -1075,6 +1137,18 @@ def main(argv=None) -> int:
                          "passes (route/fleet.py)")
     fl.add_argument("--fleet-max", type=int, default=4, metavar="N",
                     help="autoscaler ceiling (default 4)")
+    fl.add_argument("--fleet-policy", choices=("static", "headroom"),
+                    default="static",
+                    help="grow policy: 'static' keeps the depth/busy "
+                         "thresholds alone; 'headroom' ALSO grows when "
+                         "measured offered load reaches --headroom-frac "
+                         "of the fleet's live capacity estimate (the "
+                         "workers' pulse engines publish blocks/s on "
+                         "/healthz; route/fleet.py folds them)")
+    fl.add_argument("--headroom-frac", type=float, default=0.80,
+                    metavar="FRAC",
+                    help="offered/capacity ratio that triggers headroom "
+                         "growth (default 0.8)")
     fl.add_argument("--up-depth", type=float, default=8.0, metavar="D",
                     help="mean queue depth per worker that triggers a "
                          "scale-up (default 8)")
@@ -1158,6 +1232,7 @@ def main(argv=None) -> int:
             ap.error("--kill-router-after needs --routers >= 1")
     elif (args.roll_after is not None or args.routers
           or args.kill_router_after is not None or args.drive_faults
+          or args.fleet_policy != "static"
           or args.min_scale_ups is not None
           or args.min_scale_downs is not None
           or args.expect_rolls is not None
@@ -1272,6 +1347,8 @@ def main(argv=None) -> int:
     kc_ratio = _keycache_ratio(exit_docs)
     releases = router.release_events()
     waterfall = waterfall_stats(report.ledgers)
+    pulse_doc = _pulse_section(router.pulse)
+    capacity = _fleet_capacity(healthz)
 
     print(f"# route: backends={args.backends} affinity={affinity} "
           f"vnodes={args.vnodes} tenants={args.tenants} "
@@ -1323,6 +1400,16 @@ def main(argv=None) -> int:
                 print(f"#   stage {s:<13} p50={st['p50_us']:>8.0f}µs "
                       f"p95={st['p95_us']:>8.0f}µs "
                       f"p99={st['p99_us']:>8.0f}µs  (n={st['count']})")
+    if pulse_doc is not None:
+        fired = (" ".join(f"{r}x{n}"
+                          for r, n in pulse_doc["fired"].items())
+                 or "none")
+        print(f"# pulse: {pulse_doc['total']} alert(s) over "
+              f"{pulse_doc['frames']} frame(s) (fired: {fired})")
+    if capacity is not None:
+        print(f"# capacity: fleet "
+              f"{capacity['total_blocks_per_s']:g} blocks/s across "
+              f"{len(capacity['backends'])} worker(s)")
 
     artifact = {
         "config": {
@@ -1358,6 +1445,8 @@ def main(argv=None) -> int:
         "stages": waterfall["stages"],
         "control": control,
         "healthz": healthz,
+        "alerts": pulse_doc,
+        "capacity": capacity,
         "degraded": degrade.events(),
         "metrics": metrics.snapshot(),
     }
@@ -1426,6 +1515,8 @@ def main(argv=None) -> int:
         line["slo"] = "fail" if slo_rc else "pass"
     if degrade.events():
         line["degraded"] = degrade.events()
+    if pulse_doc is not None and pulse_doc["total"]:
+        line["alerts"] = pulse_doc["fired"]
     print(json.dumps(line))
 
     rc = 0

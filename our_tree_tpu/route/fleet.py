@@ -229,6 +229,17 @@ class FleetConfig:
     name_prefix: str = "w"
     #: retained fleet-event ledger entries (the /fleetz tail)
     max_events: int = 256
+    #: scaling policy: ``"static"`` (the depth/busy/shed thresholds
+    #: above — the default until a TPU-measured capacity baseline
+    #: exists) or ``"headroom"`` — grow when the measured offered load
+    #: reaches ``headroom_frac`` of the fleet's MEASURED capacity (the
+    #: per-worker blocks/s estimate each backend's pulse engine
+    #: publishes on /healthz, summed over placeable members). The
+    #: static triad stays active as the safety net in headroom mode:
+    #: a fleet whose capacity estimate is missing or stale still grows
+    #: on depth/busy/shed.
+    policy: str = "static"
+    headroom_frac: float = 0.80
 
 
 class FleetSupervisor:
@@ -266,6 +277,13 @@ class FleetSupervisor:
         self._down_ticks = 0
         self._last_event_t: float | None = None
         self._last_sheds = 0
+        #: offered-load watermarks: last signals() wall-clock and the
+        #: fleet-wide dispatched-bytes total at that instant — the
+        #: deltas are the measured offered blocks/s the headroom
+        #: policy compares against the capacity estimate.
+        self._last_signal_t: float | None = None
+        self._last_bytes_out = 0.0
+        self._last_signals: dict = {}
         self._task: asyncio.Task | None = None
         #: serializes scale EVENTS (up/down/roll): each one awaits a
         #: child boot or drain, and an interleaved tick() deciding off
@@ -332,6 +350,9 @@ class FleetSupervisor:
             "min_workers": c.min_workers, "max_workers": c.max_workers,
             "up_depth": c.up_depth, "down_depth": c.down_depth,
             "cooldown_s": c.cooldown_s,
+            "policy": c.policy,
+            "headroom_frac": c.headroom_frac,
+            "signals": dict(self._last_signals),
             "epoch": self.epoch,
             "scale_ups": self.scale_ups, "scale_downs": self.scale_downs,
             "rolled": self.rolled, "roll_aborts": self.roll_aborts,
@@ -349,6 +370,7 @@ class FleetSupervisor:
         the registry as gauges — the same numbers an operator's scrape
         sees are the numbers the loop acted on."""
         depths, inflight, lanes = [], 0.0, 0.0
+        capacity_bps = 0.0
         for b in self.router.backends.values():
             doc = b.last_healthz
             if not isinstance(doc, dict) or not b.health.placeable():
@@ -360,17 +382,51 @@ class FleetSupervisor:
             if isinstance(ln, dict):
                 inflight += float(ln.get("inflight", 0))
                 lanes += max(float(ln.get("count", 1)), 1.0)
+            # The per-worker MEASURED capacity estimate (obs/pulse.py
+            # via the worker's /healthz "capacity" section): summed
+            # over placeable members = the fleet's live ceiling.
+            cap = doc.get("capacity")
+            if isinstance(cap, dict):
+                try:
+                    capacity_bps += float(
+                        cap.get("total_blocks_per_s", 0) or 0)
+                except (TypeError, ValueError):
+                    pass
         sheds_now = self.router.shed_retries + self.router.router_sheds
         shed_delta = sheds_now - self._last_sheds
         self._last_sheds = sheds_now
+        # Offered load, measured router-side: dispatched payload bytes
+        # across ALL backends (16-byte blocks) over the tick interval.
+        # At saturation dispatch tracks capacity, so offered/capacity
+        # approaches 1.0 — exactly when headroom is gone.
+        now = self._clock()
+        bytes_now = sum(float(b.bytes_out)
+                        for b in self.router.backends.values())
+        dt = (now - self._last_signal_t
+              if self._last_signal_t is not None else 0.0)
+        offered_bps = (max(bytes_now - self._last_bytes_out, 0.0) / 16.0
+                       / dt if dt > 0 else 0.0)
+        shed_rate = (shed_delta / dt) if dt > 0 else 0.0
+        self._last_signal_t = now
+        self._last_bytes_out = bytes_now
         depth = sum(depths) / len(depths) if depths else 0.0
         busy = (inflight / lanes) if lanes else 0.0
+        headroom = (offered_bps / capacity_bps) if capacity_bps > 0 else 0.0
         metrics.gauge("route_fleet_depth", depth)
         metrics.gauge("route_fleet_busy", busy)
+        metrics.gauge("route_fleet_shed_rate", shed_rate)
+        metrics.gauge("route_fleet_capacity_blocks", capacity_bps)
+        metrics.gauge("route_fleet_offered_blocks", offered_bps)
         if shed_delta:
             metrics.counter("route_fleet_shed_seen", shed_delta)
-        return {"depth": depth, "busy": busy, "shed": shed_delta,
-                "polled": len(depths)}
+        sig = {"depth": depth, "busy": busy, "shed": shed_delta,
+               "shed_rate": round(shed_rate, 3),
+               "capacity_bps": round(capacity_bps, 3),
+               "offered_bps": round(offered_bps, 3),
+               "headroom_used": round(headroom, 4),
+               "polled": len(depths)}
+        self._last_signals = sig
+        return sig
 
     # -- the loop ----------------------------------------------------------
     async def tick(self) -> str:
@@ -389,6 +445,16 @@ class FleetSupervisor:
             return "cooldown"
         grow = (sig["depth"] >= c.up_depth or sig["busy"] >= c.up_busy
                 or sig["shed"] > 0)
+        if c.policy == "headroom":
+            # Measured-capacity policy (the ROADMAP payoff): grow when
+            # offered load eats into the headroom band of the fleet's
+            # MEASURED capacity. The static triad above stays live as
+            # the safety net — a missing/stale capacity estimate must
+            # never make the fleet blind to pressure. Shrink/floor
+            # behavior is deliberately unchanged.
+            grow = grow or (sig["capacity_bps"] > 0
+                            and sig["offered_bps"]
+                            >= c.headroom_frac * sig["capacity_bps"])
         shrink = (sig["depth"] <= c.down_depth and sig["busy"] < c.up_busy
                   and sig["shed"] == 0)
         if grow:

@@ -69,7 +69,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
-from ..obs import metrics, trace
+from ..obs import metrics, pulse, trace
 from ..resilience import degrade, faults
 from ..resilience import journal as journal_mod
 from ..resilience.policy import Budget, RetryPolicy
@@ -308,6 +308,26 @@ class Backend:
             return None
         return body.decode("utf-8", "replace") if body is not None else None
 
+    async def poll_alertz(self, timeout_s: float = 2.0) -> dict | None:
+        """GET /alertz off the backend's status port — the federated
+        alert view (route/status.py folds every backend's pulse rows
+        into one fleet document). None when the backend is unreachable,
+        runs no pulse engine (404), or answers junk."""
+        if not self.spec.status_port:
+            return None
+        try:
+            body = await asyncio.wait_for(self._get_status("/alertz"),
+                                          timeout=max(timeout_s, 0.001))
+        except Exception:  # noqa: BLE001 - unreachable IS the data point
+            return None
+        if body is None:
+            return None
+        try:
+            doc = json.loads(body)
+        except ValueError:
+            return None
+        return doc if isinstance(doc, dict) else None
+
     async def poll_profilez(self, seconds: float,
                             timeout_s: float | None = None) -> dict | None:
         """GET /profilez?seconds=N off the backend's status port — the
@@ -499,6 +519,9 @@ class Router:
         #: engine the server embeds, parameterized here by per-chunk
         #: ring placement instead of queue admission. None when the
         #: deployer set no chunk rung.
+        #: the router-tier pulse analytics thread (obs/pulse.py),
+        #: started at start(); None when OT_PULSE=0
+        self.pulse: pulse.PulseThread | None = None
         self.transfers: transfer_mod.TransferManager | None = None
         if self.config.transfer_chunk_blocks:
             self.transfers = transfer_mod.TransferManager(
@@ -533,6 +556,11 @@ class Router:
         await self._pin_canary()
         if c.gossip_every_s > 0:
             self._gossip_task = asyncio.ensure_future(self._gossip_loop())
+        # The router-tier pulse engine (obs/pulse.py): consumes THIS
+        # process's registry (route_* series — sheds, backend
+        # transitions), so the quarantine-flap and burn-rate rules
+        # watch the routing tier too. None when OT_PULSE=0.
+        self.pulse = pulse.start_live("route")
 
     def _register(self, spec: BackendSpec) -> None:
         if spec.name in self.backends:
@@ -649,6 +677,8 @@ class Router:
                     lost=self.accepted - self.answered)
         if self.transfers is not None:
             self.transfers.ledger.close()
+        if self.pulse is not None:
+            self.pulse.stop()
         if self._journal is not None:
             self._journal.close()
             self._journal = None

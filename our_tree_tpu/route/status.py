@@ -151,6 +151,39 @@ class RouterStatus(HttpStatusEndpoint):
         doc["armed"] = sum(1 for c in codes if c == 200)
         return code, doc
 
+    async def alertz_async(self) -> dict | None:
+        """The FEDERATED /alertz: the router's own pulse document plus
+        every backend's, fetched concurrently through the proxy seam
+        (``Backend.poll_alertz``) — one operator request reads the
+        whole per-host fleet's live alert state, same pattern as the
+        /metrics and /profilez federation. A backend without a pulse
+        engine (or unreachable) contributes an error marker instead of
+        silently vanishing."""
+        own = (self._router.pulse.engine.alerts_doc()
+               if self._router.pulse is not None else None)
+        backends = [(name, b)
+                    for name, b in sorted(self._router.backends.items())
+                    if b.spec.status_port]
+        results = await asyncio.gather(
+            *(b.poll_alertz() for _, b in backends),
+            return_exceptions=True)
+        doc: dict = {"router": own, "federated": {}}
+        fired: dict[str, int] = {}
+        total = 0
+        for rule, n in ((own or {}).get("fired") or {}).items():
+            fired[rule] = fired.get(rule, 0) + int(n)
+        for (name, _b), res in zip(backends, results):
+            if not isinstance(res, dict):
+                doc["federated"][name] = {"error": "unreachable"}
+                continue
+            doc["federated"][name] = res
+            for rule, n in (res.get("fired") or {}).items():
+                fired[rule] = fired.get(rule, 0) + int(n)
+        total = sum(fired.values())
+        doc["fired"] = dict(sorted(fired.items()))
+        doc["total"] = total
+        return doc
+
     def healthz(self) -> dict:
         r = self._router
         placeable = sum(1 for b in r.backends.values()

@@ -548,6 +548,28 @@ def main(argv=None) -> int:
                    f"{m}:{int(n)}"
                    for m, n in per_mode["auth_failed"].items())))
 
+    # The live analytics verdict (obs/pulse.py): one final tick over
+    # the end-of-run registry, then the alert ledger + the measured
+    # per-worker capacity estimate. A healthy drive commits zero
+    # alerts — obs.history gates the count at zero forever after.
+    pulse_section = None
+    capacity_section = None
+    if server.pulse is not None:
+        server.pulse.tick()
+        adoc = server.pulse.engine.alerts_doc()
+        pulse_section = {"total": adoc["total"], "fired": adoc["fired"],
+                         "rows": adoc["alerts"], "frames": adoc["frames"]}
+        capacity_section = server.pulse.engine.capacity()
+        fired_s = (" ".join(f"{r}:{n}"
+                            for r, n in adoc["fired"].items())
+                   or "none")
+        print(f"# pulse: {adoc['total']} alert(s) over "
+              f"{adoc['frames']} frame(s) ({fired_s})")
+        for row in capacity_section["rows"]:
+            print(f"# capacity: {row['engine']}/{row['mode']}: "
+                  f"{row['ewma_blocks_per_s']:.1f} blocks/s baseline "
+                  f"({row['blocks_per_s']:.1f} last window)")
+
     artifact = {
         "config": {
             "requests": args.requests, "concurrency": args.concurrency,
@@ -587,6 +609,11 @@ def main(argv=None) -> int:
         "cost": cost,
         "compiles_by_rung": compile_by_rung,
         "degraded": degrade.events(),
+        # The live pulse verdict: alert totals (zero on a healthy
+        # drive — the count series obs.history tolerates no growth on)
+        # and the measured per-worker capacity model (obs/pulse.py).
+        "alerts": pulse_section,
+        "capacity": capacity_section,
         # The armed profile window's summary + costmodel cross-check
         # (None when no window captured this run).
         "profile": profile_section,
@@ -647,6 +674,8 @@ def main(argv=None) -> int:
     if args.mode_list != ("ctr",):
         line["modes"] = {m: int(n)
                          for m, n in per_mode["requests"].items()}
+    if pulse_section is not None and pulse_section["total"]:
+        line["alerts"] = pulse_section["fired"]
     if args.slo:
         line["slo"] = "fail" if slo_rc else "pass"
     if degrade.events():

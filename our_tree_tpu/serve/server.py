@@ -83,7 +83,7 @@ import numpy as np
 from ..aead import gcm as aead_gcm
 from ..aead import ghash as aead_ghash
 from ..models import aes
-from ..obs import costmodel, incident, metrics, trace
+from ..obs import costmodel, incident, metrics, pulse, trace
 from ..ops import gf
 from ..resilience import faults
 from ..resilience import journal as journal_mod
@@ -269,6 +269,9 @@ class Server:
         self._task: asyncio.Task | None = None
         self._running = False
         self.status: StatusServer | None = None
+        #: the live pulse analytics thread (obs/pulse.py), started at
+        #: start() after warmup; None when OT_PULSE=0
+        self.pulse: pulse.PulseThread | None = None
         #: overlap state: the in-flight cap (resolved at start) and the
         #: live task set (dispatch + probe tasks; drain awaits it). The
         #: MEASURED concurrency lives in the pool (`max_inflight_seen`:
@@ -363,6 +366,13 @@ class Server:
         # registry still counts in memory for /metrics and the bench
         # artifact either way).
         metrics.ensure_flusher()
+        # The live analytics plane (obs/pulse.py): windowed rates, the
+        # per-worker capacity model (/healthz "capacity"), and the
+        # typed alert rules — started AFTER warmup so the compile ramp
+        # is behind every frame the engine ever sees. None when
+        # OT_PULSE=0.
+        self.pulse = pulse.start_live("serve",
+                                      cost_records=self.cost_records)
         if c.status_port is not None:
             self.status = StatusServer(self, c.status_port)
             await self.status.start()
@@ -499,6 +509,8 @@ class Server:
         if self.status is not None:
             await self.status.stop()
             self.status = None
+        if self.pulse is not None:
+            self.pulse.stop()
         if self.pool is not None:
             self.pool.close()  # idle workers dismissed; wedged ones are
             #                    already abandoned (stale generation)

@@ -96,6 +96,20 @@ class HttpStatusEndpoint:
             "bundles": incident.bundle_index(d) if d else [],
         }
 
+    def alertz(self) -> dict | None:
+        """The /alertz body: the live pulse engine's alert rows +
+        fired-rule counts (obs/pulse.py ``alerts_doc``). None (the
+        default) answers 404 — an endpoint whose process runs no pulse
+        engine (OT_PULSE=0, or a process without one) has no alert
+        story to tell. The router FEDERATES this per backend
+        (route/status.py), like /profilez."""
+        return None
+
+    async def alertz_async(self) -> dict | None:
+        """Awaitable /alertz hook (defaults to the sync body) — the
+        router's federated version must await its backends."""
+        return self.alertz()
+
     def fleetz(self) -> dict | None:
         """The /fleetz body: the fleet supervisor's elasticity document
         (size, thresholds, scale-event ledger — route/fleet.py
@@ -172,6 +186,16 @@ class HttpStatusEndpoint:
                 ctype = "application/json"
                 reason = {200: "OK", 409: "Conflict",
                           503: "Service Unavailable"}.get(code, "OK")
+            elif path.split("?")[0] == "/alertz":
+                doc = await self.alertz_async()
+                if doc is None:
+                    body = "no pulse engine on this endpoint\n"
+                    ctype = "text/plain"
+                    code, reason = 404, "Not Found"
+                else:
+                    body = json.dumps(doc, indent=1, sort_keys=True) + "\n"
+                    ctype = "application/json"
+                    code, reason = 200, "OK"
             elif path.split("?")[0] == "/fleetz":
                 doc = self.fleetz()
                 if doc is None:
@@ -184,7 +208,7 @@ class HttpStatusEndpoint:
                     code, reason = 200, "OK"
             else:
                 body = ("not found: try /metrics, /healthz, /incidentz, "
-                        "/profilez or /fleetz\n")
+                        "/profilez, /alertz or /fleetz\n")
                 ctype = "text/plain"
                 code, reason = 404, "Not Found"
         except Exception:  # noqa: BLE001 - a bad scrape must not matter
@@ -211,6 +235,11 @@ class StatusServer(HttpStatusEndpoint):
     def __init__(self, server, port: int, host: str = "127.0.0.1"):
         super().__init__(port, host)
         self._server = server
+        #: transfer-shed watermark from the previous /healthz poll —
+        #: "sustained" shed means sheds grew since the last poll AND
+        #: reassembly is still pinned at its budget: backpressure that
+        #: is happening NOW, not a count from an old burst.
+        self._transfer_sheds_seen = 0
 
     # -- the two documents -------------------------------------------------
     def healthz(self) -> dict:
@@ -230,13 +259,38 @@ class StatusServer(HttpStatusEndpoint):
                 "redispatches": pool.redispatches,
                 "quarantine_events": pool.quarantine_events(),
             }
+        # The transfer plane's live state (the /healthz blind spot fix):
+        # held reassembly bytes vs budget, live ledger rows, sheds.
+        transfers_doc = None
+        shedding = False
+        if s.transfers is not None:
+            t = s.transfers.stats()
+            budget = int(getattr(s.transfers, "reassembly_budget_bytes",
+                                 0) or 0)
+            sheds = int(t.get("shed", 0))
+            pinned = (budget > 0
+                      and int(t.get("held_bytes", 0)) >= budget * 0.9)
+            shedding = pinned and sheds > self._transfer_sheds_seen
+            self._transfer_sheds_seen = sheds
+            transfers_doc = {
+                "held_bytes": int(t.get("held_bytes", 0)),
+                "held_peak_bytes": int(t.get("held_peak_bytes", 0)),
+                "budget_bytes": budget,
+                "ledger_live": int(t.get("ledger_live", 0)),
+                "shed": sheds,
+                "refused": int(t.get("refused", 0)),
+                "shedding": shedding,
+            }
         if s.queue.closed:
             status = "draining"
-        elif placeable > 0:
+        elif placeable > 0 and not shedding:
             status = "ok"
         else:
+            # No placeable lane, OR the transfer plane is pinned at its
+            # reassembly budget and actively shedding new transfers —
+            # either way this worker should stop receiving load.
             status = "degraded"
-        return {
+        doc = {
             "status": status,
             "engine": s.engine,
             "lanes": lanes_doc,
@@ -249,6 +303,18 @@ class StatusServer(HttpStatusEndpoint):
                          "steady": s.steady_compiles()},
             "degraded": degrade.events(),
         }
+        if transfers_doc is not None:
+            doc["transfers"] = transfers_doc
+        pulse_t = getattr(s, "pulse", None)
+        if pulse_t is not None:
+            # The live capacity estimate (obs/pulse.py): what the fleet
+            # supervisor's headroom policy reads off the gossip scrape.
+            doc["capacity"] = pulse_t.engine.capacity()
+        return doc
+
+    def alertz(self) -> dict | None:
+        pulse_t = getattr(self._server, "pulse", None)
+        return pulse_t.engine.alerts_doc() if pulse_t is not None else None
 
     def metrics_text(self, exemplars: bool = False) -> str:
         """The /metrics body: the registry plus scrape-time liveness

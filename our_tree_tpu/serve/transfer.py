@@ -417,6 +417,12 @@ class TransferManager:
         self.chunks_skipped = 0
         self.chunk_redispatches = 0
         self.bytes_out = 0
+        # Published once as a gauge so any registry consumer — the
+        # pulse reassembly-pressure rule, live or replaying snapshots
+        # offline — can judge held_bytes against the budget without
+        # reaching into this object.
+        metrics.gauge("serve_transfer_budget_bytes",
+                      self.reassembly_budget_bytes)
 
     # -- admission ----------------------------------------------------------
     def _refuse(self, code: str, why: str, mode: str) -> Response:
@@ -738,4 +744,5 @@ class TransferManager:
                 "bytes_out": self.bytes_out,
                 "held_bytes": self.held_bytes,
                 "held_peak_bytes": self.held_peak,
+                "budget_bytes": self.reassembly_budget_bytes,
                 "ledger_live": self.ledger.live()}

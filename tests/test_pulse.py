@@ -1,0 +1,687 @@
+"""ot-pulse (our_tree_tpu/obs/pulse.py): the streaming alert/capacity
+engine. Deterministic synthetic-corpus replays — every rule fires
+EXACTLY once on its planted pattern (edge-trigger + re-arm), zero
+false fires on a healthy corpus — plus the offline CLI (--check
+against the live engine's ``pulse_alerts`` record, rotated-segment
+ordering), the live serve contract (a ``dispatch_slow`` drive under a
+tight dispatch SLO raises the burn-rate alert and dumps exactly one
+coalesced incident bundle), the ``/alertz`` endpoints, the ``/healthz``
+``transfers`` section + degraded fold, and the fleet supervisor's
+``headroom`` policy over the measured capacity estimate."""
+
+import asyncio
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from our_tree_tpu.obs import incident, metrics, pulse, trace
+from our_tree_tpu.resilience import degrade, faults
+from our_tree_tpu.route.fleet import FleetConfig, FleetSupervisor
+from our_tree_tpu.route.status import RouterStatus
+from our_tree_tpu.serve.server import Server, ServerConfig
+
+LADDER = dict(engine="jnp", lanes=1, min_bucket_blocks=32,
+              max_bucket_blocks=64)
+
+#: Small deterministic thresholds shared by the synthetic-corpus tests.
+CFG = dict(fast_window_s=1.0, slow_window_s=2.0, budget=0.05,
+           fast_burn=8.0, slow_burn=2.0, min_events=5,
+           collapse_frac=0.5, ewma_alpha=0.5, baseline_frames=2,
+           min_dispatches=4, flap_n=3, flap_window_s=2.0,
+           storm_n=3, storm_window_s=2.0, pressure_frac=0.9,
+           pressure_ticks=3)
+
+_PULSE_ENV = ("OT_PULSE", "OT_PULSE_EVERY_S", "OT_PULSE_FAST_S",
+              "OT_PULSE_SLOW_S", "OT_PULSE_BUDGET", "OT_PULSE_FAST_BURN",
+              "OT_PULSE_SLOW_BURN", "OT_PULSE_MIN_EVENTS",
+              "OT_PULSE_COLLAPSE_FRAC", "OT_PULSE_ALPHA",
+              "OT_PULSE_BASELINE_FRAMES", "OT_PULSE_MIN_DISPATCHES",
+              "OT_PULSE_FLAP_N", "OT_PULSE_FLAP_S", "OT_PULSE_STORM_N",
+              "OT_PULSE_STORM_S", "OT_PULSE_PRESSURE_FRAC",
+              "OT_PULSE_PRESSURE_TICKS", "OT_PROFILE_ON_ALERT")
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    for k in _PULSE_ENV + ("OT_FAULTS", "OT_SLOW_S",
+                           "OT_INCIDENT_COOLDOWN_S"):
+        monkeypatch.delenv(k, raising=False)
+    monkeypatch.setenv("OT_COST_XLA", "0")  # keep server starts cheap
+    faults.reset()
+    degrade.clear()
+    metrics.reset_for_tests()
+    incident.reset_for_tests()
+    yield
+    faults.reset()
+    degrade.clear()
+    metrics.reset_for_tests()
+    incident.reset_for_tests()
+
+
+def _engine(**overrides):
+    cfg = dict(CFG)
+    cfg.update(overrides)
+    return pulse.PulseEngine(pulse.PulseConfig(**cfg), proc="test",
+                             emit=False)
+
+
+def _frame(ts_s, counters=None, gauges=None, hcounts=None):
+    return {"ts_us": int(ts_s * 1e6), "counters": dict(counters or {}),
+            "gauges": dict(gauges or {}), "hcounts": dict(hcounts or {})}
+
+
+# ---------------------------------------------------------------------------
+# The rules: each planted pattern fires exactly once; healthy fires none.
+# ---------------------------------------------------------------------------
+
+
+def test_burn_rate_fires_once_then_rearms_after_recovery():
+    eng = _engine()
+    req, bad = 0, 0
+    t = 0.0
+    # Healthy ramp: traffic, no budget spend, full window coverage.
+    while t <= 5.0:
+        req += 10
+        eng.observe(_frame(t, {"serve_requests{mode=ctr}": req,
+                               "serve_batches{outcome=deadline}": bad}))
+        t += 0.5
+    assert eng.fired == {}
+    # The planted incident: half the offered requests start deadline
+    # failing — burn = (5/10)/0.05 = 10x the budget in the fast window.
+    while t <= 8.0:
+        req += 10
+        bad += 5
+        eng.observe(_frame(t, {"serve_requests{mode=ctr}": req,
+                               "serve_batches{outcome=deadline}": bad}))
+        t += 0.5
+    assert eng.fired == {"burn_rate": 1}  # sustained != repeated firing
+    assert eng.alerts[0]["severity"] == "page"
+    assert eng.alerts[0]["detail"]["burn_fast"] >= CFG["fast_burn"]
+    # Recovery clears both windows -> the rule re-arms...
+    while t <= 12.0:
+        req += 10
+        eng.observe(_frame(t, {"serve_requests{mode=ctr}": req,
+                               "serve_batches{outcome=deadline}": bad}))
+        t += 0.5
+    assert eng.fired == {"burn_rate": 1}
+    # ...and a second incident fires a second (one) alert.
+    while t <= 15.0:
+        req += 10
+        bad += 5
+        eng.observe(_frame(t, {"serve_requests{mode=ctr}": req,
+                               "serve_batches{outcome=deadline}": bad}))
+        t += 0.5
+    assert eng.fired == {"burn_rate": 2}
+
+
+def test_burn_rate_needs_min_events():
+    eng = _engine(min_events=1000)
+    req, bad = 0, 0
+    for i in range(30):
+        req += 10
+        bad += 5  # 10x the budget, but the sample is too small to judge
+        eng.observe(_frame(i * 0.5,
+                           {"serve_requests{mode=ctr}": req,
+                            "serve_batches{outcome=deadline}": bad}))
+    assert eng.fired == {}
+
+
+_DISP = "serve_rung_dispatches{engine=jnp,mode=ctr,nr=1,rung=64}"
+_DEV = "serve_rung_device_us{engine=jnp,mode=ctr,nr=1,rung=64}"
+
+
+def test_capacity_collapse_fires_under_demand_not_on_drain():
+    eng = _engine()
+    disp, dev = 0, 0
+    t = 0.0
+    # Healthy throughput with queued demand: the baseline settles.
+    while t <= 3.0:
+        disp += 8
+        dev += 1000
+        eng.observe(_frame(t, {_DISP: disp, _DEV: dev},
+                           gauges={"serve_queue_depth": 4}))
+        t += 0.5
+    base = eng._baseline[("jnp", "ctr")]
+    assert base["updates"] >= CFG["baseline_frames"]
+    assert base["ewma"] > 0
+    # Collapse: dispatches stop dead while the queue stays non-empty —
+    # the worker is sick, not idle.
+    while t <= 6.0:
+        eng.observe(_frame(t, {_DISP: disp, _DEV: dev},
+                           gauges={"serve_queue_depth": 4}))
+        t += 0.5
+    assert eng.fired == {"capacity_collapse": 1}
+    # Baseline freeze: once collapsed, the incident must not decay the
+    # reference into its own new normal.
+    frozen = eng._baseline[("jnp", "ctr")]["ewma"]
+    assert frozen > 0
+    while t <= 8.0:
+        eng.observe(_frame(t, {_DISP: disp, _DEV: dev},
+                           gauges={"serve_queue_depth": 4}))
+        t += 0.5
+    assert eng._baseline[("jnp", "ctr")]["ewma"] == frozen
+    # End-of-drive drain: zero throughput with an EMPTY queue is not a
+    # collapse (the demand guard) — and must not re-fire the rule.
+    while t <= 9.0:
+        eng.observe(_frame(t, {_DISP: disp, _DEV: dev},
+                           gauges={"serve_queue_depth": 0}))
+        t += 0.5
+    assert eng.fired == {"capacity_collapse": 1}
+
+
+def test_quarantine_flap_counts_both_tiers():
+    eng = _engine()
+    lane_q = "serve_lane_transitions{lane=0,state=quarantined}"
+    backend_q = "route_backend_transitions{backend=b1,state=quarantined}"
+    t, n = 0.0, 0
+    while t <= 3.0:  # quiet: transitions flat
+        eng.observe(_frame(t, {lane_q: 1, backend_q: 0}))
+        t += 0.5
+    assert eng.fired == {}
+    # The flap: 3 fresh quarantine transitions inside the window, split
+    # across the serve and route tiers (one engine sees one tier live;
+    # both series summed keeps the rule tier-agnostic).
+    for extra in (1, 2, 3):
+        eng.observe(_frame(t, {lane_q: 1 + extra, backend_q: 1}))
+        t += 0.5
+        n = extra
+    assert n == 3 and eng.fired == {"quarantine_flap": 1}
+    assert eng.alerts[0]["severity"] == "warn"
+
+
+_COMPILE = "serve_compile_us{engine=jnp,rung=64}"
+
+
+def test_compile_storm_ignores_warmup_ramp():
+    eng = _engine()
+    t = 0.0
+    # Warmup: the compile ramp happens BEFORE any traffic — every
+    # window that could see it starts at serve_batches == 0, so the
+    # traffic-at-window-start guard holds it off.
+    compiles = 0
+    while t <= 1.0:
+        compiles += 2
+        eng.observe(_frame(t, {"serve_batches{outcome=ok}": 0},
+                           hcounts={_COMPILE: compiles}))
+        t += 0.5
+    batches = 0
+    while t <= 4.0:  # steady traffic, no new compiles
+        batches += 10
+        eng.observe(_frame(t, {"serve_batches{outcome=ok}": batches},
+                           hcounts={_COMPILE: compiles}))
+        t += 0.5
+    assert eng.fired == {}
+    # The storm: steady-state recompiles with traffic already flowing.
+    while t <= 6.0:
+        batches += 10
+        compiles += 2
+        eng.observe(_frame(t, {"serve_batches{outcome=ok}": batches},
+                           hcounts={_COMPILE: compiles}))
+        t += 0.5
+    assert eng.fired == {"compile_storm": 1}
+
+
+def test_reassembly_pressure_needs_consecutive_pinned_frames():
+    eng = _engine()
+    g = {"serve_transfer_budget_bytes": 100.0}
+    eng.observe(_frame(0.5, gauges={**g,
+                                    "serve_reassembly_held_bytes": 95}))
+    eng.observe(_frame(1.0, gauges={**g,
+                                    "serve_reassembly_held_bytes": 10}))
+    eng.observe(_frame(1.5, gauges={**g,
+                                    "serve_reassembly_held_bytes": 95}))
+    eng.observe(_frame(2.0, gauges={**g,
+                                    "serve_reassembly_held_bytes": 95}))
+    assert eng.fired == {}  # pinned runs of 1 and 2: below the tick bar
+    eng.observe(_frame(2.5, gauges={**g,
+                                    "serve_reassembly_held_bytes": 95}))
+    assert eng.fired == {"reassembly_pressure": 1}
+    # Still pinned: edge-triggered, not once per frame.
+    eng.observe(_frame(3.0, gauges={**g,
+                                    "serve_reassembly_held_bytes": 99}))
+    assert eng.fired == {"reassembly_pressure": 1}
+
+
+def test_healthy_corpus_zero_false_fires():
+    """The zero-noise contract: a healthy drive — steady traffic, an
+    error rate under budget, stable throughput, a warmup compile ramp,
+    modest reassembly held bytes — fires NOTHING."""
+    eng = _engine()
+    req = bad = disp = batches = 0
+    compiles = 4  # the warmup ramp, flat thereafter
+    for i in range(40):
+        t = i * 0.5
+        req += 20
+        disp += 8
+        batches += 10
+        if i % 10 == 0:
+            bad += 1  # (1/200)/0.05 = 0.1x budget: noise, not burn
+        eng.observe(_frame(
+            t,
+            {"serve_requests{mode=ctr}": req,
+             "serve_batches{outcome=ok}": batches,
+             "serve_batches{outcome=deadline}": bad,
+             "serve_lane_transitions{lane=0,state=healthy}": 1,
+             _DISP: disp, _DEV: disp * 100},
+            gauges={"serve_queue_depth": 2,
+                    "serve_transfer_budget_bytes": 100.0,
+                    "serve_reassembly_held_bytes": 30.0},
+            hcounts={_COMPILE: compiles}))
+    assert eng.fired == {}
+    assert eng.errors == 0
+    cap = eng.capacity()
+    assert cap["measured"] and cap["total_blocks_per_s"] > 0
+    row = cap["rows"][0]
+    assert (row["engine"], row["mode"]) == ("jnp", "ctr")
+    assert row["ewma_blocks_per_s"] > 0
+
+
+def test_out_of_order_frames_dropped_and_never_raises():
+    eng = _engine()
+    eng.observe(_frame(1.0, {"serve_requests{mode=ctr}": 5}))
+    eng.observe(_frame(0.5, {"serve_requests{mode=ctr}": 3}))  # stale
+    eng.observe(None)
+    eng.observe({"not": "a frame"})
+    assert eng.frames_seen == 1
+    assert eng.errors == 0
+
+
+def test_frame_from_snapshot_excludes_own_series():
+    snap = {"counters": {"pulse_alerts{rule=burn_rate,severity=page}": 1,
+                         "serve_requests{mode=ctr}": 7},
+            "gauges": {"serve_queue_depth": 2},
+            "hists": {_COMPILE: {"count": 3, "sum": 9, "buckets": {}}}}
+    f = pulse.frame_from_snapshot(snap, 123)
+    assert list(f["counters"]) == ["serve_requests{mode=ctr}"]
+    assert f["hcounts"][_COMPILE] == 3 and f["ts_us"] == 123
+
+
+# ---------------------------------------------------------------------------
+# Offline replay: the CLI over metrics-*.jsonl, --check vs the live record.
+# ---------------------------------------------------------------------------
+
+
+def _snap_rec(ts_s, counters, gauges=(), hists=()):
+    return {"ts": int(ts_s * 1e6),
+            "counters": [[n, lab, v] for n, lab, v in counters],
+            "gauges": [[n, lab, v] for n, lab, v in gauges],
+            "hists": [[n, lab, {"count": c, "sum": 0, "buckets": {}}]
+                      for n, lab, c in hists]}
+
+
+def _write_burn_stream(path_base, tmp_path, live_rules=("burn_rate",),
+                       split_rotated=False):
+    """One process's snapshot stream carrying the planted burn pattern;
+    the FINAL snapshot records the live engine's ``pulse_alerts``
+    verdict for --check to compare against."""
+    recs = [{"kind": metrics.KIND, "v": 1, "interval_s": 0.5}]
+    req = bad = 0
+    t = 0.0
+    while t <= 5.0:
+        req += 10
+        recs.append(_snap_rec(t, [("serve_requests", {"mode": "ctr"},
+                                   req)]))
+        t += 0.5
+    while t <= 8.0:
+        req += 10
+        bad += 5
+        counters = [("serve_requests", {"mode": "ctr"}, req),
+                    ("serve_batches", {"outcome": "deadline"}, bad)]
+        recs.append(_snap_rec(t, counters))
+        t += 0.5
+    final = recs[-1]
+    final["counters"].extend(
+        [["pulse_alerts", {"rule": r, "severity": "page"}, 1]
+         for r in live_rules])
+    if split_rotated:
+        # Rotation contract: the -s0 segment holds the OLDER prefix,
+        # the base name stays the newest tail.
+        head, tail = recs[:8], recs[8:]
+        (tmp_path / f"{path_base}-s0.jsonl").write_text(
+            "".join(json.dumps(r) + "\n" for r in head))
+        (tmp_path / f"{path_base}.jsonl").write_text(
+            "".join(json.dumps(r) + "\n" for r in tail))
+    else:
+        (tmp_path / f"{path_base}.jsonl").write_text(
+            "".join(json.dumps(r) + "\n" for r in recs))
+
+
+def _pulse_env(monkeypatch):
+    monkeypatch.setenv("OT_PULSE_FAST_S", "1")
+    monkeypatch.setenv("OT_PULSE_SLOW_S", "2")
+    monkeypatch.setenv("OT_PULSE_MIN_EVENTS", "5")
+    monkeypatch.setenv("OT_PULSE_BUDGET", "0.05")
+    monkeypatch.setenv("OT_PULSE_FAST_BURN", "8")
+    monkeypatch.setenv("OT_PULSE_SLOW_BURN", "2")
+
+
+def test_replay_cli_check_ok_with_rotated_segments(tmp_path, monkeypatch,
+                                                   capsys):
+    _pulse_env(monkeypatch)
+    _write_burn_stream("metrics-1234-ab12cd", tmp_path,
+                       split_rotated=True)
+    rc = pulse.main([str(tmp_path), "--check"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    doc = json.loads(out.strip().splitlines()[-1])
+    assert doc["kind"] == "ot-pulse-replay"
+    assert doc["fired"] == {"burn_rate": 1}
+    assert doc["live_fired"] == {"burn_rate": 1}
+    assert doc["check"] == {"ran": True, "problems": []}
+    assert any(ln.startswith("# alert: burn_rate")
+               for ln in out.splitlines())
+
+
+def test_replay_check_fails_on_live_replay_mismatch(tmp_path,
+                                                    monkeypatch, capsys):
+    _pulse_env(monkeypatch)
+    # The live engine claims a rule the replayed stream cannot justify.
+    _write_burn_stream("metrics-1234-ab12cd", tmp_path,
+                       live_rules=("burn_rate", "quarantine_flap"))
+    rc = pulse.main([str(tmp_path), "--check"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    doc = json.loads(out.strip().splitlines()[-1])
+    assert doc["check"]["problems"] == [
+        "live engine fired 'quarantine_flap' but replay did not"]
+
+
+def test_replay_empty_run_dir_fails_check(tmp_path, capsys):
+    rc = pulse.main([str(tmp_path), "--check"])
+    capsys.readouterr()
+    assert rc == 1
+
+
+# ---------------------------------------------------------------------------
+# Live serve: dispatch_slow under a tight SLO -> burn-rate alert, one
+# coalesced bundle, /alertz serves it.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def traced(tmp_path, monkeypatch):
+    monkeypatch.setenv("OT_TRACE_DIR", str(tmp_path / "tr"))
+    monkeypatch.setenv("OT_TRACE_RUN", "t-pulse")
+    monkeypatch.delenv("OT_TRACE_PARENT", raising=False)
+    trace.reset_for_tests()
+    metrics.reset_for_tests()
+    yield tmp_path / "tr" / "t-pulse"
+    trace.reset_for_tests()
+    metrics.reset_for_tests()
+
+
+def _run_server(config, fn):
+    async def main():
+        server = Server(config)
+        await server.start()
+        try:
+            return server, await fn(server)
+        finally:
+            await server.stop()
+
+    return asyncio.run(main())
+
+
+def test_dispatch_slow_drive_fires_burn_rate_and_one_bundle(
+        traced, monkeypatch):
+    """The CI alert-drill contract in-process: every dispatch slowed
+    past a tight dispatch deadline burns the error budget in both
+    windows; the page-severity firing triggers the incident seam, whose
+    cooldown coalesces the alert with the watchdog's own bundle —
+    EXACTLY one bundle on disk."""
+    monkeypatch.setenv("OT_FAULTS", "dispatch_slow")
+    monkeypatch.setenv("OT_SLOW_S", "0.4")
+    monkeypatch.setenv("OT_PULSE_EVERY_S", "0.05")
+    monkeypatch.setenv("OT_PULSE_FAST_S", "1.0")
+    monkeypatch.setenv("OT_PULSE_SLOW_S", "2.0")
+    monkeypatch.setenv("OT_PULSE_MIN_EVENTS", "1")
+    faults.reset()
+
+    async def drive(server):
+        assert server.pulse is not None
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            await server.submit("t", b"k" * 16, b"n" * 16,
+                                np.zeros(64, np.uint8))
+            if "burn_rate" in server.pulse.engine.fired:
+                break
+            await asyncio.sleep(0.05)
+        # Let watchdog-abandoned dispatch threads finish their injected
+        # sleep before teardown (they hold no locks, just OT_SLOW_S).
+        await asyncio.sleep(0.6)
+        return dict(server.pulse.engine.fired)
+
+    _server, fired = _run_server(
+        ServerConfig(dispatch_deadline_s=0.2, retries=1, **LADDER),
+        drive)
+    assert "burn_rate" in fired
+    # Emission seams: the counter with the rule/severity labels...
+    counters = metrics.snapshot()["counters"]
+    assert counters.get(
+        "pulse_alerts{rule=burn_rate,severity=page}", 0) >= 1
+    # ...and exactly ONE coalesced bundle (watchdog kill + pulse page
+    # alert land inside one cooldown window).
+    bundles = incident.list_bundles(str(traced))
+    assert len(bundles) == 1
+    doc = incident.load_bundle(bundles[0])
+    assert incident.validate_bundle(doc) == []
+    assert doc["reason"] in ("watchdog-kill", "pulse-alert")
+
+
+def test_alertz_endpoint_serves_live_doc(monkeypatch):
+    monkeypatch.setenv("OT_PULSE_EVERY_S", "0.05")
+
+    async def drive(server):
+        server.pulse.tick()
+        port = server.status.port
+        loop = asyncio.get_running_loop()
+
+        def fetch():
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/alertz", timeout=10) as r:
+                return r.status, r.read().decode()
+
+        return await loop.run_in_executor(None, fetch)
+
+    _server, (code, body) = _run_server(
+        ServerConfig(status_port=0, **LADDER), drive)
+    doc = json.loads(body)
+    assert code == 200
+    assert doc["kind"] == pulse.KIND and doc["source"] == "serve"
+    assert doc["total"] == 0 and doc["alerts"] == []
+    assert doc["frames"] >= 1
+
+
+def test_alertz_404_when_pulse_disabled(monkeypatch):
+    monkeypatch.setenv("OT_PULSE", "0")
+
+    async def drive(server):
+        assert server.pulse is None
+        port = server.status.port
+        loop = asyncio.get_running_loop()
+
+        def fetch():
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/alertz", timeout=10)
+            except urllib.error.HTTPError as e:
+                return e.code
+            return 200
+
+        return await loop.run_in_executor(None, fetch)
+
+    _server, code = _run_server(
+        ServerConfig(status_port=0, **LADDER), drive)
+    assert code == 404
+
+
+def test_router_alertz_always_answers():
+    """The router's /alertz is the fleet view: it answers 200 with a
+    merged document even with no pulse engine and no backends (CI polls
+    it mid-drive; an empty fleet is an empty doc, not a 404)."""
+
+    class _Router:
+        pulse = None
+        backends: dict = {}
+
+    rs = RouterStatus(_Router(), 0)
+    doc = asyncio.run(rs.alertz_async())
+    assert doc == {"router": None, "federated": {}, "fired": {},
+                   "total": 0}
+
+
+# ---------------------------------------------------------------------------
+# /healthz: the transfers section and the sustained-shed degraded fold.
+# ---------------------------------------------------------------------------
+
+
+class _FakeTransfers:
+    def __init__(self, budget):
+        self.reassembly_budget_bytes = budget
+        self.held = 0
+        self.sheds = 0
+
+    def stats(self):
+        return {"held_bytes": self.held, "held_peak_bytes": self.held,
+                "ledger_live": 2, "shed": self.sheds, "refused": 0}
+
+
+def test_healthz_transfers_section_and_degraded_fold():
+    async def drive(server):
+        fake = _FakeTransfers(budget=100)
+        orig = server.transfers
+        server.transfers = fake
+        # Calm: section present, worker stays ok.
+        doc = server.status.healthz()
+        assert doc["status"] == "ok"
+        assert doc["transfers"] == {
+            "held_bytes": 0, "held_peak_bytes": 0, "budget_bytes": 100,
+            "ledger_live": 2, "shed": 0, "refused": 0,
+            "shedding": False}
+        # Pinned at budget AND actively shedding since the last poll:
+        # the worker tells the placement tier to stop sending load.
+        fake.held, fake.sheds = 95, 3
+        doc = server.status.healthz()
+        assert doc["transfers"]["shedding"] is True
+        assert doc["status"] == "degraded"
+        # Still pinned but no NEW sheds: an old burst is history, not a
+        # reason to pull the worker out of rotation.
+        doc = server.status.healthz()
+        assert doc["transfers"]["shedding"] is False
+        assert doc["status"] == "ok"
+        # The live capacity section rides the same document.
+        assert "capacity" in doc
+        server.transfers = orig
+        return True
+
+    _server, ok = _run_server(ServerConfig(status_port=0, **LADDER),
+                              drive)
+    assert ok
+
+
+# ---------------------------------------------------------------------------
+# FleetSupervisor: the headroom policy over the measured capacity.
+# ---------------------------------------------------------------------------
+
+
+class _FakeHealth:
+    state = "healthy"
+    draining = False
+
+    def placeable(self):
+        return True
+
+
+class _FakeBackend:
+    def __init__(self, cap_bps):
+        self.last_healthz = {
+            "queue": {"depth": 0.0},
+            "lanes": {"inflight": 0.0, "count": 1},
+            "capacity": {"total_blocks_per_s": cap_bps}}
+        self.health = _FakeHealth()
+        self.bytes_out = 0
+
+
+class _FakeRouter:
+    def __init__(self, caps):
+        self.backends = {f"w{i}": _FakeBackend(c)
+                         for i, c in enumerate(caps)}
+        self.shed_retries = 0
+        self.router_sheds = 0
+
+
+def _sup(policy, clk, caps=(100.0,)):
+    cfg = FleetConfig(min_workers=1, max_workers=2, settle_ticks=1,
+                      cooldown_s=0.0, refresh_gossip=False,
+                      policy=policy, headroom_frac=0.8)
+    router = _FakeRouter(caps)
+    sup = FleetSupervisor(router, lambda name: None, cfg,
+                          clock=lambda: clk["t"])
+    ups = []
+
+    async def fake_up():
+        ups.append(1)
+        return True
+
+    sup.scale_up = fake_up
+    return sup, router, ups
+
+
+def test_headroom_policy_grows_on_measured_capacity():
+    clk = {"t": 0.0}
+    sup, router, ups = _sup("headroom", clk)
+
+    async def main():
+        # First tick establishes the offered-load watermark (dt=0).
+        assert await sup.tick() == "idle"
+        # 90 blocks/s offered against a measured 100 blocks/s fleet:
+        # 0.9 >= the 0.8 headroom bar, with depth/busy/shed all calm —
+        # only the measured-capacity branch can see this pressure.
+        clk["t"] += 1.0
+        router.backends["w0"].bytes_out = 90 * 16
+        assert await sup.tick() == "scaled-up"
+        sig = sup.fleetz()["signals"]
+        assert sig["capacity_bps"] == 100.0
+        assert sig["offered_bps"] == pytest.approx(90.0)
+        assert sig["headroom_used"] == pytest.approx(0.9)
+
+    asyncio.run(main())
+    assert ups == [1]
+    doc = sup.fleetz()
+    assert doc["policy"] == "headroom"
+    assert doc["headroom_frac"] == 0.8
+
+
+def test_static_policy_ignores_headroom_signal():
+    """Same offered/capacity pressure, default policy: the static triad
+    sees a calm fleet and never grows — headroom is opt-in."""
+    clk = {"t": 0.0}
+    sup, router, ups = _sup("static", clk)
+
+    async def main():
+        assert await sup.tick() == "idle"
+        clk["t"] += 1.0
+        router.backends["w0"].bytes_out = 90 * 16
+        assert await sup.tick() == "idle"
+
+    asyncio.run(main())
+    assert ups == []
+    assert sup.fleetz()["policy"] == "static"
+
+
+def test_signals_publish_shed_rate_and_capacity_gauges():
+    clk = {"t": 0.0}
+    sup, router, _ups = _sup("static", clk)
+    sup.signals()
+    clk["t"] += 2.0
+    router.shed_retries = 6  # 6 sheds over 2 s -> 3/s
+    sig = sup.signals()
+    assert sig["shed_rate"] == pytest.approx(3.0)
+    g = metrics.snapshot()["gauges"]
+    assert g["route_fleet_shed_rate"] == pytest.approx(3.0)
+    assert g["route_fleet_capacity_blocks"] == pytest.approx(100.0)
+    assert "route_fleet_offered_blocks" in g
