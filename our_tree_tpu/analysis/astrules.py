@@ -90,6 +90,10 @@ class Rule:
     #: None: --fix is for rewrites a reviewer would rubber-stamp.
     fixer: Callable[[FileContext],
                     Iterator[tuple[ast.AST, str]]] | None = None
+    #: Semantic version, folded into every fingerprint this rule mints.
+    #: Bump when a semantics change should invalidate old baseline
+    #: entries (they surface as stale, not as silent suppressions).
+    version: int = 1
 
 
 def _dotted(node: ast.AST) -> str:
@@ -701,7 +705,8 @@ def lint_file(path: str, relpath: str) -> list[Finding]:
         for node, message in rule.check(ctx):
             out.append(Finding(
                 rule.id, rule.severity, message, relpath,
-                getattr(node, "lineno", 0), anchor=ctx.line_text(node)))
+                getattr(node, "lineno", 0), anchor=ctx.line_text(node),
+                version=rule.version))
     return out
 
 

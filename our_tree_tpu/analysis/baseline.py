@@ -77,11 +77,24 @@ def write(path: str, findings: list[Finding],
           old: dict[str, dict] | None = None) -> int:
     """Write the baseline for ``findings``, preserving reasons from
     ``old`` by fingerprint; new entries get a TODO reason the loader
-    will reject until a human justifies them. Returns the entry count."""
+    will reject until a human justifies them. Returns the entry count.
+
+    When a rule's semantic **version** bumps, every fingerprint it
+    minted changes, so a reason preserved only by fingerprint would be
+    lost on regeneration. The fallback match on (rule, location)
+    carries the human's justification across the migration — the entry
+    still names the same violation at the same place; only the hash
+    moved. A finding that genuinely moved or changed shape misses both
+    matches and surfaces as TODO, which the loader rejects: migration
+    cannot silently launder an unsound suppression."""
     old = old or {}
+    by_rule_loc = {(e.get("rule"), e.get("location")): e
+                   for e in old.values()}
     entries = []
     for f in sorted(findings, key=lambda f: (f.layer, f.rule, f.location)):
-        prev = old.get(f.fingerprint, {})
+        prev = old.get(f.fingerprint)
+        if prev is None:
+            prev = by_rule_loc.get((f.rule, f.location), {})
         entries.append({
             "fingerprint": f.fingerprint,
             "rule": f.rule,

@@ -77,6 +77,11 @@ def main(argv=None) -> int:
     ap.add_argument("--no-jaxpr", action="store_true",
                     help="skip layer 2 (the jaxpr auditor) — the AST layer "
                          "then runs without jax in sight")
+    ap.add_argument("--san", action="store_true",
+                    help="run the ot-san concurrency auditor (whole-"
+                         "program call graph + effect inference: "
+                         "loop-stall, lock-await, lock-order, "
+                         "thread-ownership — docs/ANALYSIS.md)")
     ap.add_argument("--engines", default=None,
                     help="comma list of engines for the jaxpr audit, or "
                          "'all' (the default): jnp,bitslice plus every "
@@ -91,6 +96,11 @@ def main(argv=None) -> int:
     if args.list_rules:
         for rule in astrules.RULES:
             print(f"{rule.id} ({rule.severity}): {rule.doc}")
+        from . import sanrules
+
+        for rule in sanrules.RULES:
+            print(f"{rule.id} ({rule.severity}): [san v{rule.version}] "
+                  f"{rule.doc}")
         from .jaxpr_audit import DEFAULT_ENGINES
 
         print("constant-time (error): [jaxpr] no gather/dynamic_slice/"
@@ -138,6 +148,12 @@ def main(argv=None) -> int:
         paths = ([os.path.abspath(p) for p in args.paths]
                  if args.paths else _default_paths(root))
         findings += astrules.lint_paths(paths, root)
+    if args.san:
+        from . import sanrules
+
+        paths = ([os.path.abspath(p) for p in args.paths]
+                 if args.paths else _default_paths(root))
+        findings += sanrules.analyze_paths(paths, root)
     if not args.no_jaxpr:
         from . import jaxpr_audit
 
@@ -145,6 +161,17 @@ def main(argv=None) -> int:
         if args.engines and args.engines != "all":
             engines = tuple(e for e in args.engines.split(",") if e)
         findings += jaxpr_audit.audit(engines)
+
+    # Staleness is judged only over the layers that actually RAN: a
+    # `--no-jaxpr` lint must not report the jaxpr entries as fixed,
+    # and a run without --san must not condemn the san entries.
+    active_layers = set()
+    if not args.no_ast:
+        active_layers.add("ast")
+    if args.san:
+        active_layers.add("san")
+    if not args.no_jaxpr:
+        active_layers.add("jaxpr")
 
     stale: list[str] = []
     base: dict[str, dict] = {}
@@ -154,7 +181,8 @@ def main(argv=None) -> int:
         except baseline_mod.BaselineError as e:
             print(f"BASELINE ERROR: {e}", file=sys.stderr)
             return 2
-        stale = baseline_mod.apply(findings, base)
+        stale = [fp for fp in baseline_mod.apply(findings, base)
+                 if fp.split(":", 1)[0] in active_layers]
 
     if args.write_baseline:
         n = baseline_mod.write(args.write_baseline, findings, base)

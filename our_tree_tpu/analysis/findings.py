@@ -4,8 +4,16 @@ A finding's identity is its **fingerprint** — a stable hash of the rule
 plus a location anchor that survives line-number drift: AST findings
 anchor on the normalized source *text* of the flagged line (plus an
 occurrence index for textually identical lines), jaxpr findings on the
-(entry point, primitive) pair. Line numbers ride along for humans and
-go stale harmlessly; the baseline matches by fingerprint only.
+(entry point, primitive) pair, san findings on call-site text or a
+canonical cycle/attribute string. Line numbers ride along for humans
+and go stale harmlessly; the baseline matches by fingerprint only.
+
+The fingerprint also folds in the emitting rule's **semantic version**:
+tightening a rule's semantics (catching more, anchoring differently)
+bumps its version, which invalidates every baseline entry minted under
+the old semantics — stale entries are *reported*, never silently
+honored. Bump the version whenever a rule change would make an old
+suppression unsound; leave it alone for message-only edits.
 
 Stdlib-only: layer 1 and the baseline machinery must load without jax.
 """
@@ -28,15 +36,16 @@ class Finding:
     line: int = 0      #: 1-based; 0 = no source location (jaxpr findings
                        #: put any recovered file:line in the message)
     anchor: str = ""   #: stable identity component (see module docstring)
-    layer: str = "ast"  #: "ast" | "jaxpr"
+    layer: str = "ast"  #: "ast" | "jaxpr" | "san"
+    version: int = 1   #: emitting rule's semantic version (fingerprinted)
     baselined: bool = field(default=False, compare=False)
     baseline_reason: str = field(default="", compare=False)
 
     @property
     def fingerprint(self) -> str:
         h = hashlib.sha1(
-            f"{self.layer}|{self.rule}|{self.path}|{self.anchor}"
-            .encode()).hexdigest()[:16]
+            f"{self.layer}|{self.rule}|v{self.version}|{self.path}|"
+            f"{self.anchor}".encode()).hexdigest()[:16]
         return f"{self.layer}:{self.rule}:{h}"
 
     @property

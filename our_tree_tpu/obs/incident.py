@@ -103,7 +103,7 @@ def _ring() -> collections.deque | None:
     if cap <= 0:
         return None
     if _RING is None or _RING.maxlen != cap:
-        _RING = collections.deque(_RING or (), maxlen=cap)
+        _RING = collections.deque(_RING or (), maxlen=cap)  # ot-san: owner=gil-ref-swap
     return _RING
 
 
@@ -145,6 +145,7 @@ def counts() -> dict:
             "ring": len(ring) if ring else 0}
 
 
+# ot-san: absorb=rate-capped-evidence-dump (cooldown + per-process cap)
 def trigger(reason: str, **attrs) -> str | None:
     """Dump one incident bundle (returns its path), or None when
     suppressed: tracing off (no run layout to dump into), within the

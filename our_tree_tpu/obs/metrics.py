@@ -461,6 +461,7 @@ def _open_segment(sink: dict) -> None:
     sink["fh"], sink["path"] = fh, path
 
 
+# ot-san: absorb=amortized-cap-rotation (segment-full cadence only)
 def _rotate_sink(sink: dict) -> None:
     """Open-next-then-retire (the trace rotation order: a failed open
     mid-ENOSPC keeps the live handle and retries later), then evict the
@@ -489,6 +490,7 @@ def _rotate_sink(sink: dict) -> None:
             break
 
 
+# ot-san: absorb=amortized-snapshot-sink (open once; flusher-cadence writes)
 def _sink() -> dict | None:
     """Open (or reopen after a run-id change) the per-process metrics
     snapshot file, header line included. None while disabled or

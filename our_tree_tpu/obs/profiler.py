@@ -321,14 +321,14 @@ def stop_window(expected_seq: int | None = None) -> str | None:
                 json.dump(doc, fh, separators=(",", ":"),
                           sort_keys=True)
                 fh.write("\n")
-            _LAST = doc
+            _LAST = doc  # ot-san: owner=gil-ref-swap
             trace.point("profile-captured", seq=entry["seq"],
                         tier=entry["tier"],
                         file=os.path.basename(entry["path"]))
             metrics.counter("profile_captures", kind=entry["tier"])
             return entry["path"]
         except Exception:  # noqa: BLE001 - a lost summary must not take
-            _DROPPED += 1  # the serve loop (or atexit) down with it
+            _DROPPED += 1  # ot-san: owner=gil-counter
             return None
     finally:
         with _LOCK:

@@ -298,11 +298,12 @@ def _rotate_locked(state: dict) -> None:
         try:
             size = os.path.getsize(victim)
             os.unlink(victim)
-            _EVICTED_BYTES += size
+            _EVICTED_BYTES += size  # ot-san: owner=lock:_LOCK
         except OSError:
             break
 
 
+# ot-san: absorb=amortized-run-dir-init (makedirs/open once per process)
 def _state() -> dict | None:
     """Open this process's event file (header included) on first use.
 
@@ -348,7 +349,7 @@ def _close_state_locked() -> None:
             _STATE["fh"].close()
         except OSError:
             pass
-        _STATE = None
+        _STATE = None  # ot-san: owner=lock:_LOCK
 
 
 def _close_state() -> None:
@@ -356,6 +357,7 @@ def _close_state() -> None:
         _close_state_locked()
 
 
+# ot-san: absorb=buffered-trace-write (flush, never fsync; O(us) append)
 def _write(rec: dict) -> None:
     """One JSONL line, flushed (flush reaches the OS, so it survives the
     process's own SIGKILL — only a machine crash could lose it; fsync
@@ -368,7 +370,7 @@ def _write(rec: dict) -> None:
     try:
         line = json.dumps(rec, separators=(",", ":"), default=repr)
     except (TypeError, ValueError):
-        _DROPPED += 1
+        _DROPPED += 1  # ot-san: owner=gil-counter
         return
     try:
         with _LOCK:
@@ -381,7 +383,7 @@ def _write(rec: dict) -> None:
         # ValueError covers a racing reopen/close ("I/O operation on
         # closed file"): the never-raises contract holds over losing
         # one event at a run-id switch.
-        _DROPPED += 1
+        _DROPPED += 1  # ot-san: owner=gil-counter
 
 
 class Span:

@@ -117,8 +117,12 @@ class ProcessWorkerHandle:
         self.ready: dict | None = None
 
     async def start(self) -> BackendSpec | None:
-        self.child = isolate.spawn_service(self.argv, env=self.env,
-                                           name=f"fleet:{self.name}")
+        # Popen (pipes, fork/exec) blocks; the supervisor shares the
+        # router's event loop, so the spawn runs in the executor like
+        # read_line/stop below.
+        self.child = await asyncio.to_thread(
+            isolate.spawn_service, self.argv, env=self.env,
+            name=f"fleet:{self.name}")
         loop = asyncio.get_running_loop()
         line = await loop.run_in_executor(
             None, self.child.read_line, self.ready_deadline_s)

@@ -169,7 +169,11 @@ class HttpStatusEndpoint:
                 ctype = "application/json"
                 code, reason = 200, "OK"
             elif path.split("?")[0] == "/incidentz":
-                body = json.dumps(self.incidentz(), indent=1,
+                # Off the loop: the bundle index re-reads every
+                # incident-*.json in the run dir, and the status
+                # surface must not stall the dispatches it observes.
+                doc = await asyncio.to_thread(self.incidentz)
+                body = json.dumps(doc, indent=1,
                                   sort_keys=True) + "\n"
                 ctype = "application/json"
                 code, reason = 200, "OK"

@@ -261,6 +261,11 @@ class TransferLedger:
         elif op == "done":
             self._live.pop(tid, None)
 
+    # The resumability contract: a begin/ack row must be on disk before
+    # the chunk is acknowledged to the client, so the fsync is
+    # deliberately inline on the transfer path (PR 17's chunk-level
+    # failover depends on never acking an undurable chunk).
+    # ot-san: absorb=journal-fsync-durability
     def _append(self, row: dict) -> None:
         if self._fh is None:
             return

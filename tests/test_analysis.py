@@ -22,7 +22,8 @@ import textwrap
 import numpy as np
 import pytest
 
-from our_tree_tpu.analysis import astrules, baseline, driver, jaxpr_audit
+from our_tree_tpu.analysis import (astrules, baseline, driver, jaxpr_audit,
+                                   sanrules)
 from our_tree_tpu.analysis.findings import Finding
 
 ROOT = pathlib.Path(__file__).resolve().parent.parent
@@ -626,3 +627,372 @@ def test_fix_exempts_baselined_violations(tmp_path):
         before = dst.read_text()
         astrules.fix_file(str(dst), rel, baseline=committed)
         assert dst.read_text() == before, f"--fix touched baselined {rel}"
+
+
+# ---------------------------------------------------------------------------
+# Layer 3 (ot-san): the whole-program concurrency auditor.
+# ---------------------------------------------------------------------------
+
+
+def _san(tmp_path, files):
+    """Write {relpath: src} fixtures under tmp_path and run the san
+    layer over them (same path contract as the driver)."""
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return sanrules.analyze_paths([str(tmp_path)], str(tmp_path))
+
+
+def _by_rule(findings, rule):
+    return [f for f in findings if f.rule == rule]
+
+
+def test_san_loop_stall_fixture_pair(tmp_path):
+    violating = """
+        import asyncio
+        import time
+
+
+        def slow():
+            time.sleep(1.0)
+
+
+        async def handler():
+            slow()
+    """
+    fs = _by_rule(_san(tmp_path, {"pkg/stall.py": violating}), "loop-stall")
+    assert len(fs) == 1
+    f = fs[0]
+    assert f.path == "pkg/stall.py" and f.severity == "error"
+    assert "time.sleep" in f.message and "handler" in f.message
+    # Compliant twin: the same call hopped through asyncio.to_thread.
+    compliant = """
+        import asyncio
+        import time
+
+
+        def slow():
+            time.sleep(1.0)
+
+
+        async def handler():
+            await asyncio.to_thread(slow)
+    """
+    assert not _by_rule(_san(tmp_path, {"pkg/stall.py": compliant}),
+                        "loop-stall")
+
+
+def test_san_executor_hop_is_not_a_false_positive(tmp_path):
+    """run_in_executor severs blocking propagation: the callee runs on
+    a worker thread, so the coroutine holding the future is fine —
+    and the hopped target becomes thread-affine, not loop-affine."""
+    src = """
+        import asyncio
+        import time
+
+
+        def slow():
+            time.sleep(1.0)
+
+
+        async def handler():
+            loop = asyncio.get_running_loop()
+            return await loop.run_in_executor(None, slow)
+    """
+    assert not _san(tmp_path, {"pkg/hop.py": src})
+
+
+def test_san_loop_stall_flags_only_the_top_loop_frame(tmp_path):
+    """One bug, one fix site, one finding: the async->sync boundary is
+    flagged; the sync frames inside the chain are not re-flagged."""
+    src = """
+        import asyncio
+
+
+        def leaf():
+            open("/tmp/x").read()
+
+
+        def mid():
+            leaf()
+
+
+        async def handler():
+            mid()
+    """
+    fs = _by_rule(_san(tmp_path, {"pkg/chain.py": src}), "loop-stall")
+    assert len(fs) == 1
+    assert "mid" in fs[0].message and "open" in fs[0].message
+
+
+def test_san_lock_await_fixture_pair(tmp_path):
+    violating = """
+        import asyncio
+        import threading
+
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            async def step(self):
+                with self._lock:
+                    await asyncio.sleep(0)
+    """
+    fs = _by_rule(_san(tmp_path, {"pkg/la.py": violating}), "lock-await")
+    assert len(fs) == 1 and fs[0].severity == "error"
+    # Compliant twin: asyncio.Lock held across await is the normal
+    # async critical-section pattern.
+    compliant = """
+        import asyncio
+
+
+        class S:
+            def __init__(self):
+                self._lock = asyncio.Lock()
+
+            async def step(self):
+                async with self._lock:
+                    await asyncio.sleep(0)
+    """
+    assert not _san(tmp_path, {"pkg/la.py": compliant})
+
+
+def test_san_sync_with_on_asyncio_lock_is_flagged(tmp_path):
+    src = """
+        import asyncio
+
+
+        class S:
+            def __init__(self):
+                self._lock = asyncio.Lock()
+
+            def step(self):
+                with self._lock:
+                    return 1
+    """
+    fs = _by_rule(_san(tmp_path, {"pkg/sw.py": src}), "lock-await")
+    assert len(fs) == 1
+
+
+def test_san_lock_order_cross_file_cycle(tmp_path):
+    """A two-lock cycle split across modules — each file is locally
+    consistent; only the whole-program acquisition graph sees it —
+    reports exactly ONE finding (per SCC, not per edge)."""
+    a = """
+        import threading
+
+        from . import b
+
+        LOCK_A = threading.Lock()
+
+
+        def fwd():
+            with LOCK_A:
+                b.take_b()
+
+
+        def take_a():
+            with LOCK_A:
+                pass
+    """
+    b = """
+        import threading
+
+        from . import a
+
+        LOCK_B = threading.Lock()
+
+
+        def rev():
+            with LOCK_B:
+                a.take_a()
+
+
+        def take_b():
+            with LOCK_B:
+                pass
+    """
+    fs = _by_rule(_san(tmp_path, {"pkg/a.py": a, "pkg/b.py": b}),
+                  "lock-order")
+    assert len(fs) == 1
+    assert "LOCK_A" in fs[0].message and "LOCK_B" in fs[0].message
+    # Compliant twin: both paths honor the same global order.
+    b_ordered = """
+        import threading
+
+        from . import a
+
+        LOCK_B = threading.Lock()
+
+
+        def rev():
+            with LOCK_B:
+                pass
+
+
+        def take_b():
+            with LOCK_B:
+                pass
+    """
+    assert not _san(tmp_path, {"pkg/a.py": a, "pkg/b.py": b_ordered})
+
+
+def test_san_thread_ownership_fixture_pair(tmp_path):
+    violating = """
+        import asyncio
+        import threading
+
+        COUNT = 0
+
+
+        def worker():
+            global COUNT
+            COUNT += 1
+
+
+        async def main():
+            global COUNT
+            threading.Thread(target=worker).start()
+            COUNT = 0
+    """
+    fs = _by_rule(_san(tmp_path, {"pkg/own.py": violating}),
+                  "thread-ownership")
+    assert len(fs) == 1
+    assert "COUNT" in fs[0].message
+    # Compliant twin A: one write carries the owner annotation.
+    annotated = violating.replace(
+        "COUNT += 1",
+        "COUNT += 1  # ot-san: owner=test-seam")
+    assert not _san(tmp_path, {"pkg/own.py": annotated})
+    # Compliant twin B: every write holds the same thread lock.
+    locked = """
+        import asyncio
+        import threading
+
+        COUNT = 0
+        LOCK = threading.Lock()
+
+
+        def worker():
+            global COUNT
+            with LOCK:
+                COUNT += 1
+
+
+        async def main():
+            global COUNT
+            threading.Thread(target=worker).start()
+            with LOCK:
+                COUNT = 0
+    """
+    assert not _san(tmp_path, {"pkg/own.py": locked})
+
+
+def test_san_malformed_annotation_is_itself_a_finding(tmp_path):
+    """A typo must not silently waive the rule: the bad comment is
+    flagged AND the ownership finding still stands."""
+    src = """
+        import asyncio
+        import threading
+
+        COUNT = 0
+
+
+        def worker():
+            global COUNT
+            COUNT += 1  # ot-san: onwer=test-seam
+
+
+        async def main():
+            global COUNT
+            threading.Thread(target=worker).start()
+            COUNT = 0
+    """
+    fs = _by_rule(_san(tmp_path, {"pkg/bad.py": src}), "thread-ownership")
+    assert any("malformed" in f.message for f in fs)
+    assert any("COUNT" in f.message and "malformed" not in f.message
+               for f in fs)
+
+
+def test_san_fingerprints_stable_across_line_shift(tmp_path):
+    """The acceptance criterion: each planted violation keeps its
+    fingerprint when the file shifts underneath it."""
+    stall = ("import asyncio\nimport time\n\n\n"
+             "def slow():\n    time.sleep(1.0)\n\n\n"
+             "async def handler():\n    slow()\n")
+    la = ("import asyncio\nimport threading\n\n\n"
+          "class S:\n"
+          "    def __init__(self):\n"
+          "        self._lock = threading.Lock()\n\n"
+          "    async def step(self):\n"
+          "        with self._lock:\n"
+          "            await asyncio.sleep(0)\n")
+    own = ("import asyncio\nimport threading\n\nCOUNT = 0\n\n\n"
+           "def worker():\n    global COUNT\n    COUNT += 1\n\n\n"
+           "async def main():\n    global COUNT\n"
+           "    threading.Thread(target=worker).start()\n    COUNT = 0\n")
+    files = {"pkg/stall.py": stall, "pkg/la.py": la, "pkg/own.py": own}
+    before = _san(tmp_path, files)
+    assert len(before) == 3
+    shifted = {rel: "# a comment\n# another\n\n" + src
+               for rel, src in files.items()}
+    after = _san(tmp_path, shifted)
+    assert {f.fingerprint for f in before} == {f.fingerprint for f in after}
+    for f in after:
+        assert f.fingerprint.startswith("san:")
+
+
+def test_san_rule_version_changes_the_fingerprint():
+    f1 = Finding("loop-stall", "error", "m", "a.py", 3,
+                 anchor="x()", layer="san", version=1)
+    f2 = Finding("loop-stall", "error", "m", "a.py", 3,
+                 anchor="x()", layer="san", version=2)
+    assert f1.fingerprint != f2.fingerprint
+    assert f1.fingerprint.startswith("san:loop-stall:")
+
+
+def test_baseline_migrates_reasons_across_version_bumps(tmp_path):
+    """A rule version bump changes every fingerprint; the rewrite must
+    carry the human-written reason over by (rule, location) so the
+    justification survives the migration."""
+    old = Finding("loop-stall", "error", "m", "a.py", 3,
+                  anchor="x()", layer="san", version=1)
+    path = tmp_path / "base.json"
+    baseline.write(str(path), [old])
+    data = json.loads(path.read_text())
+    data["findings"][0]["reason"] = "a migrated reason"
+    path.write_text(json.dumps(data))
+    loaded = baseline.load(str(path))
+    new = Finding("loop-stall", "error", "m", "a.py", 3,
+                  anchor="x()", layer="san", version=2)
+    assert new.fingerprint != old.fingerprint
+    baseline.write(str(path), [new], loaded)
+    reloaded = baseline.load(str(path))
+    assert reloaded[new.fingerprint]["reason"] == "a migrated reason"
+
+
+def test_san_cli_runs_clean_against_committed_baseline():
+    """The acceptance criterion: `--san --baseline analysis/baseline.json
+    --fail-on-new` exits 0 on this tree, with every baselined entry
+    carrying a reason (the loader enforces that part)."""
+    rc = driver.main(["--san", "--no-jaxpr",
+                      "--baseline", str(ROOT / "analysis" / "baseline.json"),
+                      "--fail-on-new"])
+    assert rc == 0
+
+
+def test_san_fixed_files_stay_loop_stall_free():
+    """Satellite regression: the serve/route status surfaces and the
+    fleet spawn path were FIXED in this change, not baselined — the
+    auditor must keep them clean."""
+    pkg = ROOT / "our_tree_tpu"
+    fs = sanrules.analyze_paths([str(pkg)], str(ROOT))
+    fixed = ("our_tree_tpu/serve/status.py", "our_tree_tpu/route/status.py")
+    stalls = [f for f in _by_rule(fs, "loop-stall") if f.path in fixed]
+    assert not stalls, [f.message for f in stalls]
+    fleet_stalls = [f for f in _by_rule(fs, "loop-stall")
+                    if f.path == "our_tree_tpu/route/fleet.py"
+                    and "ProcessWorkerHandle.start" in f.message]
+    assert not fleet_stalls, [f.message for f in fleet_stalls]

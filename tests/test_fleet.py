@@ -601,6 +601,34 @@ def test_worker_argv_is_one_template_per_fleet():
     assert argv[argv.index("--lanes") + 1] == "1"
 
 
+def test_process_handle_spawn_runs_off_the_event_loop(monkeypatch):
+    """Loop-stall regression (ot-san loop-stall, route/fleet.py):
+    ``spawn_service`` is a fork/exec + pipe setup — ``start()`` must
+    run it in the executor, never on the supervisor's loop thread."""
+    import threading
+
+    seen = {}
+
+    class FakeChild:
+        def read_line(self, deadline):
+            return ""
+
+    def fake_spawn(argv, env=None, name=""):
+        seen["thread"] = threading.current_thread()
+        return FakeChild()
+
+    monkeypatch.setattr(fleet_mod.isolate, "spawn_service", fake_spawn)
+    handle = fleet_mod.ProcessWorkerHandle("w0", ["prog"],
+                                           ready_deadline_s=1.0)
+
+    async def drive():
+        seen["loop_thread"] = threading.current_thread()
+        return await handle.start()
+
+    assert asyncio.run(drive()) is None  # the fake child never answers
+    assert seen["thread"] is not seen["loop_thread"]
+
+
 def test_replica_entry_module_shape():
     # The replica process entry is importable with the worker lifecycle
     # contract's kinds (READY/exit lines, route/bench.py parses them).

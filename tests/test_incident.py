@@ -256,3 +256,36 @@ def test_incidentz_endpoint(traced):
     assert doc["dumped"] == 1
     assert doc["bundles"][0]["reason"] == "quarantine"
     assert doc["bundles"][0]["valid"] is True
+
+
+def test_incidentz_body_builds_off_the_event_loop(traced, monkeypatch):
+    """Loop-stall regression (ot-san loop-stall, serve/status.py): the
+    /incidentz body re-reads every bundle file in the run dir, so the
+    handler must build it in the executor, never on the loop thread."""
+    import threading
+
+    seen = {}
+    real = incident.bundle_index
+
+    def spy(run_dir):
+        seen["thread"] = threading.current_thread()
+        return real(run_dir)
+
+    monkeypatch.setattr(incident, "bundle_index", spy)
+
+    async def drive(server):
+        seen["loop_thread"] = threading.current_thread()
+        server.pool.lanes[0]._quarantine("test-incident", None)
+        port = server.status.port
+        loop = asyncio.get_running_loop()
+
+        def fetch(path):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+                return r.read().decode()
+
+        return await loop.run_in_executor(None, fetch, "/incidentz")
+
+    _server, body = _run_server(ServerConfig(status_port=0, **LADDER), drive)
+    assert "bundles" in json.loads(body)
+    assert seen["thread"] is not seen["loop_thread"]
