@@ -41,7 +41,8 @@ except Exception:  # pragma: no cover - only on a broken tree
                     "dispatch_hang", "unit_crash", "serve_dispatch",
                     "lane_fail", "lane_hang", "dispatch_slow",
                     "backend_fail", "backend_hang",
-                    "chunk_lost", "reassembly_stall", "transfer_abort")
+                    "chunk_lost", "reassembly_stall", "transfer_abort",
+                    "session_stall", "keystream_miss", "session_evict")
 
 # The live metrics label-key allowlist (obs/metrics.py, also
 # stdlib-only) — same live-registry-with-frozen-fallback pattern.

@@ -420,6 +420,23 @@ def _entries(engines):
          (lambda n: (np.uint32(0), np.uint32(0),
                      np.zeros(256, np.uint32)),
           lambda n: np.zeros(16 * n, np.uint32)), {0, 1}),
+        # The SERVED RC4 seam (serve/session.py): the batched PRGA
+        # prefetch entry — n sessions' scans in one vmapped dispatch on
+        # the lane wire layout — carries the same secret-indexed swaps
+        # as rc4-prep (same baseline reason: the PRGA is state-indexed
+        # by definition, confined to the keystream phase), and the
+        # session XOR phase on the packed word layout MUST audit clean:
+        # key-obliviousness is what lets many sessions' chunks coalesce
+        # into one shared dispatch (the paper's phase-split story,
+        # restated at the serve boundary).
+        ("rc4-prep-batched[vmap]",
+         lambda mm, xy: arc4.prep_batch_words(mm, xy, 64),
+         (lambda n: np.zeros(256 * n, np.uint32),
+          lambda n: np.zeros(2 * n, np.uint32)), {0, 1}),
+        ("rc4-xor[words]",
+         arc4.xor_words,
+         (lambda n: np.zeros(4 * n, np.uint32),
+          lambda n: np.zeros(4 * n, np.uint32)), {0, 1}),
         # The bitsliced kernels audited directly (not only through the
         # mode dispatchers): the acceptance bar for the whole layer.
         ("bitslice-enc[kernel]",

@@ -99,6 +99,44 @@ def crypt(data: jnp.ndarray, keystream: jnp.ndarray) -> jnp.ndarray:
     return jnp.bitwise_xor(data, keystream)
 
 
+@jax.jit
+def xor_words(words: jnp.ndarray, ks_words: jnp.ndarray) -> jnp.ndarray:
+    """The served XOR phase on the serve stack's packed uint32 word
+    layout (serve/batcher.py): ciphertext words = payload words XOR
+    cached keystream words. Key-oblivious and constant-time — no
+    secret-indexed access at all — so many sessions' chunks coalesce
+    into one dispatch exactly like multikey CTR (the jaxpr audit pins
+    this CLEAN; the secret-indexed PRGA lives in prep, not here)."""
+    return jnp.bitwise_xor(words, ks_words)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def prep_batch_words(m_words: jnp.ndarray, xy_words: jnp.ndarray,
+                     length: int, unroll: int = 8) -> jnp.ndarray:
+    """The served batched-PRGA entry: many sessions' sequential scans in
+    one vmapped dispatch, on the flat uint32 array layout the lane seam
+    ships (serve/lanes.py ``mode="rc4-prep"``).
+
+    ``m_words`` is the (S*256,) flattened permutation stack, ``xy_words``
+    the (2*S,) x/y stack ([x0..xS-1, y0..yS-1]); ``length`` (bytes per
+    session, multiple of 4) is static so the serve prefetcher's fixed
+    (S, length) quantum is ONE compiled shape — zero-recompile holds.
+    Returns (S, 258 + length//4) uint32: per session ``[x', y', m'[256],
+    keystream packed little-endian 4 bytes/word]`` — carry and keystream
+    in one fenceable array, a pure function of the inputs, so bit-exact
+    failover replay on another lane is byte-identical by construction.
+    """
+    s = xy_words.shape[0] // 2
+    m = m_words.reshape(s, 256)
+    (x2, y2, m2), ks = keystream_scan_batch(
+        (xy_words[:s], xy_words[s:], m), length, unroll)
+    k = ks.reshape(s, length // 4, 4).astype(jnp.uint32)
+    ks_words = (k[..., 0] | (k[..., 1] << 8)
+                | (k[..., 2] << 16) | (k[..., 3] << 24))
+    return jnp.concatenate(
+        [x2[:, None], y2[:, None], m2, ks_words], axis=1)
+
+
 @dataclass
 class ARC4:
     """arc4_context equivalent: holds {x, y, m} across calls."""

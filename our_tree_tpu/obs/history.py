@@ -3,6 +3,7 @@
 The repo root carries 20+ measured artifacts — ``BENCH_r*`` (offline
 engine GB/s), ``SERVE_r*`` (the serving drives), ``ROUTE_r*`` (the
 routed fleet), ``STREAM_r*`` (the chunked-transfer chaos drive),
+``SESSION_r*`` (the stateful rc4 session drive),
 ``MULTICHIP_r*`` (device health) — each one a point on a
 trajectory nothing machine-readable ever connected: the SLO gate
 compares one run against ONE chosen baseline, so a regression that
@@ -58,6 +59,7 @@ DEFAULT_TOLERANCES = {
     "utilization": 0.50,   # device-time utilization (noisy on CPU)
     "devices": 0.0,        # multichip healthy-device count
     "ok": 0.0,             # multichip all-healthy flag (1/0)
+    "session_hit_rate": 0.05,  # keystream prefetch hit rate (SESSION)
 }
 
 #: Zero-noise count metrics: the head may never exceed the class's
@@ -136,11 +138,19 @@ def _extract(family: str, doc: dict) -> dict:
         if isinstance(doc.get("ok"), bool):
             out["ok"] = 1.0 if doc["ok"] else 0.0
         return out
-    if family in ("SERVE", "ROUTE", "STREAM"):
+    if family in ("SERVE", "ROUTE", "STREAM", "SESSION"):
         # STREAM (route.bench --transfer-sizes: the chunked-transfer
         # chaos drive) is servelike too — same load/queue/compiles
         # contract, plus a transfers section the class key pins below.
-        return _extract_servelike(doc)
+        # SESSION (serve.bench --sessions: the stateful rc4 drive) adds
+        # the keystream prefetch hit rate as a gated gauge.
+        out = _extract_servelike(doc)
+        if family == "SESSION":
+            sess = doc.get("sessions") or {}
+            v = _num((sess.get("prefetch") or {}).get("hit_rate"))
+            if v is not None:
+                out["session_hit_rate"] = v
+        return out
     return {}
 
 
@@ -151,14 +161,14 @@ def _series_class(family: str, doc: dict) -> str:
     share a class; the mixed-AEAD and tenant-heavy drives each get
     their own) without making every artifact a singleton."""
     c = doc.get("config") or {}
-    if family in ("SERVE", "ROUTE", "STREAM"):
+    if family in ("SERVE", "ROUTE", "STREAM", "SESSION"):
         modes = ",".join(c.get("modes") or ["ctr"])
         sizes = c.get("sizes") or ([c["size_bytes"]]
                                    if c.get("size_bytes") else [])
         parts = [f"modes={modes}",
                  f"sizes={','.join(str(s) for s in sizes)}",
                  f"engine={c.get('engine')}"]
-        if family == "SERVE":
+        if family in ("SERVE", "SESSION"):
             parts.append(f"lanes={c.get('lanes')}")
         else:
             parts.append(f"backends={c.get('backends')}")

@@ -179,6 +179,32 @@ point              wired into
                    the abort (``transfer_abort:1@3`` aborts at the
                    fourth chunk) — the deterministic interrupt the
                    resume drill replays a reconnecting client against.
+``session_stall``  the RC4 session engine's keystream-refill seam
+                   (``serve/session.py``): the batched PRGA prefetch
+                   stalls ``OT_SLOW_S`` (an awaitable sleep) before
+                   dispatching. The per-session window drains toward
+                   the consumed offset; data chunks wait on the refill
+                   (backpressure), and once the GLOBAL byte budget or
+                   window can't cover a chunk it sheds typed
+                   (``serve_session_shed``) — never a wedged loop.
+                   Usually session-scoped
+                   (``session_stall:1@session=3`` stalls session 3's
+                   refill and no other).
+``keystream_miss`` the session reserve seam: the session's cached
+                   keystream window is DISCARDED (a cold cache / page
+                   loss stand-in) — the engine regenerates from the
+                   last acked-checkpoint carry in fixed quanta, counts
+                   a ``serve_session_replays`` carry replay, and the
+                   chunk's bytes stay bit-exact (the PRGA carry is
+                   deterministic). Session-scoped like the rest.
+``session_evict``  the session store's open-admission seam: the
+                   tenant's least-recently-used IDLE session is
+                   force-evicted even below capacity — the
+                   deterministic eviction rehearsal
+                   (``serve_session_evictions``). Sessions with chunks
+                   in flight are never evicted: when every row is busy
+                   the open sheds typed instead (the
+                   eviction-mid-session refusal).
 =================  ========================================================
 
 Determinism contract: firings consume counts in call order within ONE
@@ -208,12 +234,14 @@ KNOWN_POINTS = ("init_hang", "dispatch_fail", "build_fail", "lock_busy",
                 "lane_fail", "lane_hang", "dispatch_slow",
                 "backend_fail", "backend_hang", "tag_mismatch",
                 "pool_stale", "worker_slow_start", "scale_stall",
-                "chunk_lost", "reassembly_stall", "transfer_abort")
+                "chunk_lost", "reassembly_stall", "transfer_abort",
+                "session_stall", "keystream_miss", "session_evict")
 
 #: Scope names the ``@<scope>=<i>`` qualifier accepts: ``lane`` (serve
-#: dispatch lanes), ``backend`` (the router's backend index) and
-#: ``chunk`` (a transfer's chunk index, serve/transfer.py).
-SCOPES = ("lane", "backend", "chunk")
+#: dispatch lanes), ``backend`` (the router's backend index), ``chunk``
+#: (a transfer's chunk index, serve/transfer.py) and ``session`` (an
+#: RC4 session id, serve/session.py).
+SCOPES = ("lane", "backend", "chunk", "session")
 
 #: Sentinel count for a bare (uncounted) token: armed forever.
 ALWAYS = -1
@@ -455,6 +483,23 @@ def fire_chunk(point: str, chunk) -> bool:
     result, stall an emit, abort an exchange), not exceptions. Same
     short-circuit contract as ``fire_backend``."""
     return fire(scoped_chunk(point, chunk)) or fire(point)
+
+
+def scoped_session(point: str, sid) -> str:
+    """The session twin of ``scoped``: the registry key the
+    ``@session=<i>`` grammar arms and the RC4 session engine's seams ask
+    ``fire`` for (serve/session.py) — so a chaos drive can stall ONE
+    session's prefetch or drop ONE session's keystream window and assert
+    every other session streamed on undisturbed."""
+    return f"{point}@session={int(sid)}"
+
+
+def fire_session(point: str, sid) -> bool:
+    """Consume the session-scoped OR plain shot of `point`, without
+    raising — the session seams' faults are flow decisions (stall a
+    refill, discard a cached window, evict a store row), not exceptions.
+    Same short-circuit contract as ``fire_chunk``."""
+    return fire(scoped_session(point, sid)) or fire(point)
 
 
 def injected_slow(point: str, detail: str = "") -> bool:

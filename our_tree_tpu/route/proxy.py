@@ -75,8 +75,8 @@ from ..resilience import journal as journal_mod
 from ..resilience.policy import Budget, RetryPolicy
 from ..serve import transfer as transfer_mod
 from ..serve import wire
-from ..serve.queue import (ERR_DEADLINE, ERR_DISPATCH, ERR_SHED,
-                           ERR_SHUTDOWN, Response)
+from ..serve.queue import (ERR_BAD_REQUEST, ERR_DEADLINE, ERR_DISPATCH,
+                           ERR_SHED, ERR_SHUTDOWN, Response)
 from . import ring as ring_mod
 from .health import QUARANTINED, RELEASED, BackendHealth, backend_unit
 
@@ -515,6 +515,16 @@ class Router:
         #: recently-seen affinity keys (insertion-ordered dict as LRU)
         #: — the rebalance-motion sample on membership changes
         self._seen_keys: dict[str, None] = {}
+        #: (tenant, sid) -> backend name: where each rc4 session's
+        #: server-side state LIVES (the backend whose open succeeded).
+        #: Session frames are pinned there — cross-backend failover
+        #: would find no state (the in-process lane pool owns the
+        #: bit-exact failover story; docs/SERVING.md, sessions section)
+        self._session_pins: dict[tuple, str] = {}
+        self.sessions_opened = 0
+        self.sessions_closed = 0
+        self.session_chunks = 0
+        self.session_pin_misses = 0
         #: the chunked-transfer engine (serve/transfer.py) — the SAME
         #: engine the server embeds, parameterized here by per-chunk
         #: ring placement instead of queue admission. None when the
@@ -823,7 +833,7 @@ class Router:
     async def submit(self, tenant: str, key: bytes, nonce: bytes, payload,
                      deadline_s: float | None = None, mode: str = "ctr",
                      iv: bytes = b"", aad: bytes = b"",
-                     tag: bytes = b"") -> Response:
+                     tag: bytes = b"", sid: int = -1) -> Response:
         """Route one request; always answers (payload or coded error)
         — the loadgen-compatible submit surface, so the serve load
         generator drives a router exactly as it drives a server.
@@ -835,6 +845,12 @@ class Router:
         and bit-exact failover as ctr (every mode's dispatch is a pure
         function of its arrays, so replay on the next ring node is
         byte-identical)."""
+        if mode == "rc4":
+            # Session data chunk (serve/session.py): pinned-backend
+            # routing with its own admission accounting — the loadgen-
+            # compatible surface, same as the server's submit.
+            return await self.submit_session(tenant, sid, payload,
+                                             deadline_s=deadline_s)
         if self._draining:
             return Response(ok=False, error=ERR_SHUTDOWN,
                             detail="router is draining")
@@ -920,6 +936,147 @@ class Router:
             tenant, key, spec.nonce or b"", data, deadline_s,
             bool(sampled), parent, mode, spec.iv, b"", b"",
             rotate=spec.index)
+
+    # -- stateful sessions -------------------------------------------------
+    def session_order(self, tenant: str, sid: int) -> list[str]:
+        """A session's replica sequence: the ring order for the
+        session's OWN affinity key (tenant + sid — sessions carry no
+        shared placement key, and one tenant's sessions should spread
+        across its replica set). UN-rotated, unlike transfer chunk
+        spray: session frames need the ONE backend holding the state,
+        not load spreading."""
+        return self._order_for(
+            ring_mod.affinity_key(tenant, f"ss:{int(sid)}".encode()))
+
+    async def _session_exchange(self, name: str, header: dict,
+                                payload: bytes,
+                                deadline_s: float | None) -> tuple:
+        """One ``ss`` frame exchange with one NAMED backend; returns
+        (response header, body) or raises like any backend contact."""
+        c = self.config
+        b = self.backends.get(name)
+        if b is None:
+            raise ConnectionError(f"backend {name!r} left the fleet")
+        attempt_s = min(c.attempt_timeout_s,
+                        float(deadline_s) if deadline_s else
+                        c.attempt_timeout_s)
+        return await b.exchange(header, payload, attempt_s)
+
+    async def open_session(self, tenant: str, sid: int, key: bytes,
+                           deadline_s: float | None = None) -> Response:
+        """Open an rc4 session on the session's affinity backend and
+        PIN it there: every later frame of the session goes to the
+        backend that ran the KSA and holds the carry state. A replica
+        that sheds or fails at open costs nothing (no state was made) —
+        the open walks the replica sequence like an ordinary request."""
+        if self._draining:
+            return Response(ok=False, error=ERR_SHUTDOWN,
+                            detail="router is draining")
+        header = {"ss": "open", "t": tenant, "sid": int(sid),
+                  "k": bytes(key).hex()}
+        causes = []
+        for name in self.session_order(tenant, sid):
+            b = self.backends[name]
+            if b.health.state == QUARANTINED:
+                continue
+            try:
+                rh, _body = await self._session_exchange(
+                    name, header, b"", deadline_s)
+            except Exception as e:  # noqa: BLE001 - walk the replicas
+                causes.append((name, e))
+                continue
+            if rh.get("ok"):
+                self._session_pins[(tenant, int(sid))] = name
+                self.sessions_opened += 1
+                metrics.counter("route_session", outcome="opened")
+                return Response(ok=True, detail=str(rh.get("detail", "")))
+            if rh.get("error") in (ERR_SHED, ERR_SHUTDOWN):
+                causes.append((name, RuntimeError(rh.get("error"))))
+                continue  # busy/draining replica: the next may admit
+            return Response(ok=False, error=rh.get("error"),
+                            detail=str(rh.get("detail", "")))
+        metrics.counter("route_session", outcome="open-failed")
+        return Response(ok=False, error=ERR_DISPATCH,
+                        detail=f"session open failed on every replica "
+                               f"({len(causes)} attempt(s))")
+
+    async def submit_session(self, tenant: str, sid: int, payload,
+                             deadline_s: float | None = None) -> Response:
+        """One session data chunk to the session's PINNED backend. No
+        cross-backend failover: the PRGA carry lives only where open
+        landed, so a dead pinned backend is a typed error and the
+        client's move is close + reopen (in-PROCESS lane failover on
+        that backend is where bit-exact keystream replay happens —
+        docs/SERVING.md). Counted in accepted/answered like every
+        routed request."""
+        pin = self._session_pins.get((tenant, int(sid)))
+        if pin is None:
+            return Response(ok=False, error=ERR_BAD_REQUEST,
+                            detail=f"session {sid} is not open via this "
+                                   f"router")
+        if self._draining:
+            return Response(ok=False, error=ERR_SHUTDOWN,
+                            detail="router is draining")
+        self.accepted += 1
+        self._inflight += 1
+        self._idle.clear()
+        try:
+            data = (payload.tobytes() if hasattr(payload, "tobytes")
+                    else bytes(payload))
+            header = {"ss": "data", "t": tenant, "sid": int(sid)}
+            if deadline_s is not None:
+                header["deadline_s"] = round(float(deadline_s), 3)
+            try:
+                rh, body = await self._session_exchange(
+                    pin, header, data, deadline_s)
+            except Exception as e:  # noqa: BLE001 - typed, no failover
+                self.session_pin_misses += 1
+                metrics.counter("route_session", outcome="pin-miss")
+                return Response(
+                    ok=False, error=ERR_DISPATCH,
+                    detail=f"session backend {pin!r} unreachable "
+                           f"({type(e).__name__}: {e}); close and "
+                           f"reopen the session")
+            if rh.get("ok"):
+                self.session_chunks += 1
+                metrics.counter("route_session", outcome="chunk")
+                return Response(ok=True,
+                                payload=np.frombuffer(body, np.uint8),
+                                batch=rh.get("batch"))
+            return Response(ok=False, error=rh.get("error"),
+                            detail=str(rh.get("detail", "")),
+                            batch=rh.get("batch"))
+        finally:
+            self.answered += 1
+            self._inflight -= 1
+            if self._inflight == 0:
+                self._idle.set()
+
+    async def close_session(self, tenant: str, sid: int,
+                            deadline_s: float | None = None) -> Response:
+        """Close an rc4 session on its pinned backend and drop the pin
+        (dropped EITHER way — a close that failed because the backend
+        died releases the router-side pin too; the backend's own drain
+        force-closes its rows)."""
+        pin = self._session_pins.pop((tenant, int(sid)), None)
+        if pin is None:
+            return Response(ok=False, error=ERR_BAD_REQUEST,
+                            detail=f"session {sid} is not open via this "
+                                   f"router")
+        header = {"ss": "close", "t": tenant, "sid": int(sid)}
+        try:
+            rh, _body = await self._session_exchange(
+                pin, header, b"", deadline_s)
+        except Exception as e:  # noqa: BLE001 - pin already dropped
+            metrics.counter("route_session", outcome="close-failed")
+            return Response(ok=False, error=ERR_DISPATCH,
+                            detail=f"{type(e).__name__}: {e}")
+        self.sessions_closed += 1
+        metrics.counter("route_session", outcome="closed")
+        if rh.get("ok"):
+            return Response(ok=True, detail=str(rh.get("detail", "")))
+        return Response(ok=False, error=rh.get("error"),
+                        detail=str(rh.get("detail", "")))
 
     async def _route(self, tenant: str, key: bytes, nonce: bytes, payload,
                      deadline_s: float | None, mode: str = "ctr",
@@ -1273,4 +1430,9 @@ class Router:
             "quarantine_events": self.quarantine_events(),
             "transfers": (self.transfers.stats()
                           if self.transfers is not None else None),
+            "sessions": {"opened": self.sessions_opened,
+                         "closed": self.sessions_closed,
+                         "chunks": self.session_chunks,
+                         "pinned": len(self._session_pins),
+                         "pin_misses": self.session_pin_misses},
         }

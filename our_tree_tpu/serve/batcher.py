@@ -131,7 +131,12 @@ class Batch:
       state (needs ``sched`` — the per-slot H);
     * ``cbc`` — ``ctr_words`` is repurposed as the PREV stream (IV at
       each request's first block, then its shifted ciphertext): the
-      XOR side of P_i = D(C_i) ^ C_{i-1}.
+      XOR side of P_i = D(C_i) ^ C_{i-1};
+    * ``rc4`` — ``ctr_words`` is repurposed as the cached KEYSTREAM
+      (each chunk's slice, reserved from its session's prefetched
+      window, serve/session.py): the dispatch is the key-oblivious
+      XOR phase, so chunks of different sessions coalesce exactly
+      like multikey CTR — one slot per session, no schedules at all.
     """
 
     slots: list[Slot]
@@ -218,6 +223,9 @@ class Batch:
             return
         if self.mode == "cbc":
             self._materialise_cbc()
+            return
+        if self.mode == "rc4":
+            self._materialise_rc4()
             return
         runs = []
         spans = []
@@ -343,6 +351,35 @@ class Batch:
         self.req_spans = spans
         self.runs = None
 
+    def _materialise_rc4(self) -> None:
+        """The RC4 batch layout: ``ctr_words`` carries each chunk's
+        cached keystream slice (reserved at admission from the
+        session's prefetched window, serve/session.py). The dispatch is
+        one key-oblivious XOR — no schedules, no counters, no per-slot
+        state — so the slot axis exists only for grouping/metrics and
+        padding keystream is simply zero (zero XOR zero is discarded by
+        ``req_spans`` like every other padding block)."""
+        words = np.zeros(4 * self.bucket, dtype=np.uint32)
+        ks = np.zeros(4 * self.bucket, dtype=np.uint32)
+        slot_index = np.zeros(self.bucket, dtype=np.uint32)
+        spans = []
+        off = 0
+        for si, slot in enumerate(self.slots):
+            for req in slot.requests:
+                n = req.nblocks
+                words[4 * off:4 * (off + n)] = packing.np_bytes_to_words(
+                    req.payload)
+                ks[4 * off:4 * (off + n)] = packing.np_bytes_to_words(
+                    np.ascontiguousarray(req.ks, dtype=np.uint8))
+                slot_index[off:off + n] = si
+                spans.append((off, n))
+                off += n
+        self.words = words
+        self.ctr_words = ks
+        self.slot_index = slot_index
+        self.req_spans = spans
+        self.runs = None
+
     def split_output(self, out_words: np.ndarray) -> list[np.ndarray]:
         """Per-request output bytes (slot order, then request order —
         the ``requests`` property's order) from the batch's output,
@@ -400,7 +437,13 @@ def form_batches(requests: list[Request],
     groups: dict[tuple, list[Request]] = {}
     order: list[tuple] = []
     for req in requests:
-        k = (req.mode, req.tenant, key_digest(req.key))
+        # rc4 chunks group by SESSION, not key: data chunks carry no
+        # key (the KSA ran at session open), and per-session slots are
+        # what lets the coalesce stats tell sessions apart — the XOR
+        # itself is key-oblivious, so any grouping is correct.
+        ident = (f"s{req.sid}" if req.mode == "rc4"
+                 else key_digest(req.key))
+        k = (req.mode, req.tenant, ident)
         if k not in groups:
             groups[k] = []
             order.append(k)
@@ -435,7 +478,10 @@ def form_batches(requests: list[Request],
 
     for mode, tenant, digest in order:
         pending = groups[(mode, tenant, digest)]
-        nr = ROUNDS[len(pending[0].key) * 8]
+        # rc4 has no AES round count; 0 is its uniform nr sentinel, so
+        # the nr-flush rule keeps rc4 and AES work in separate batches
+        # for free (they could never share a program anyway).
+        nr = 0 if mode == "rc4" else ROUNDS[len(pending[0].key) * 8]
         if cur_nr is not None and (nr != cur_nr or mode != cur_mode):
             flush()
         if len(cur_slots) >= key_slots:

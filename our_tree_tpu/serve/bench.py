@@ -112,7 +112,12 @@ async def _drive(args, probes):
         max_inflight=args.max_inflight,
         status_port=args.status_port,
         modes=args.mode_list,
-        ceiling_gbps=args.ceiling_gbps)
+        ceiling_gbps=args.ceiling_gbps,
+        session_window_bytes=args.session_window_bytes,
+        session_quantum_bytes=args.session_quantum_bytes,
+        session_prefetch_slots=args.session_prefetch_slots,
+        session_budget_bytes=args.session_budget_bytes,
+        session_per_tenant=args.session_per_tenant)
     server = Server(cfg)
     await server.start()
     arm_task = None
@@ -124,7 +129,10 @@ async def _drive(args, probes):
         sizes=args.sizes, tenants=args.tenants,
         keys_per_tenant=args.keys_per_tenant, seed=args.seed,
         verify_every=args.verify_every, probes=probes,
-        arrival_rate=args.arrival_rate, modes=args.mode_list)
+        arrival_rate=args.arrival_rate, modes=args.mix_modes,
+        sessions=args.sessions, session_chunks=args.session_chunks,
+        session_chunk_bytes=args.session_chunk_bytes,
+        session_scripts=args.session_scripts)
     if arm_task is not None and not arm_task.done():
         arm_task.cancel()  # the drive ended before the window's offset
         try:
@@ -313,6 +321,34 @@ def main(argv=None) -> int:
                          "included — ends below FRAC (the CI multi-key "
                          "drive gates 0.5: a rung-packer regression "
                          "re-fragmenting tenants shows up here first)")
+    ap.add_argument("--sessions", type=int, default=0, metavar="N",
+                    help="run N concurrent rc4 streaming sessions beside "
+                         "the ordinary traffic (requires rc4 in --modes); "
+                         "every data chunk is verified against the "
+                         "pinned host-PRGA script (serve/session.py)")
+    ap.add_argument("--session-chunks", type=int, default=8, metavar="M",
+                    help="data chunks per session (default 8)")
+    ap.add_argument("--session-chunk-bytes", default="256,1024,4096",
+                    metavar="B1,B2",
+                    help="chunk sizes the session scripts cycle through "
+                         "(16-byte multiples; default 256,1024,4096)")
+    ap.add_argument("--session-window-bytes", type=int, default=65536)
+    ap.add_argument("--session-quantum-bytes", type=int, default=4096)
+    ap.add_argument("--session-prefetch-slots", type=int, default=8)
+    ap.add_argument("--session-budget-bytes", type=int, default=8 << 20)
+    ap.add_argument("--session-per-tenant", type=int, default=16)
+    ap.add_argument("--min-session-hit-rate", type=float, default=None,
+                    metavar="FRAC",
+                    help="fail (exit 1) if the keystream prefetch hit "
+                         "rate ends below FRAC (the CI session drive "
+                         "gates 0.9: chunks stalling on demand refills "
+                         "mean the prefetcher stopped running ahead)")
+    ap.add_argument("--min-session-replays", type=int, default=None,
+                    metavar="N",
+                    help="fail (exit 1) unless at least N keystream "
+                         "refills replayed from a carry checkpoint on a "
+                         "healthy lane (the failover drive asserts the "
+                         "bit-exact replay path actually exercised)")
     args = ap.parse_args(argv)
     if args.tenant_heavy:
         args.sizes = loadgen.TENANT_HEAVY_SIZES
@@ -346,6 +382,28 @@ def main(argv=None) -> int:
         ap.error("--modes gcm-open requires --verify-every > 0: open "
                  "traffic replays the per-size sealed probe pairs "
                  "(a made-up tag would answer auth-failed by design)")
+    if args.sessions and "rc4" not in args.mode_list:
+        ap.error("--sessions requires rc4 in --modes: session traffic "
+                 "IS the rc4 mode (serve/session.py)")
+    try:
+        args.session_chunk_bytes = tuple(
+            int(s) for s in args.session_chunk_bytes.split(",") if s)
+    except ValueError:
+        ap.error(f"--session-chunk-bytes wants a comma list of byte "
+                 f"counts, got {args.session_chunk_bytes!r}")
+    if any(b <= 0 or b % 16 for b in args.session_chunk_bytes):
+        ap.error("--session-chunk-bytes must be positive 16-byte "
+                 "multiples (the queue refuses partial blocks)")
+    # rc4 never rides the uniform per-request mode draw — a session
+    # chunk without an open session is a refusal by design. The server
+    # still enables the mode (args.mode_list); only the random mix and
+    # the pinned probes exclude it.
+    args.mix_modes = (tuple(m for m in args.mode_list if m != "rc4")
+                      or ("ctr",))
+    if args.mode_list == ("rc4",) and args.requests:
+        ap.error("--modes rc4 alone serves only session traffic: pass "
+                 "--requests 0, or add a stateless mode for the "
+                 "ordinary mix (e.g. --modes ctr,rc4)")
 
     if args.unquarantine:
         if not args.journal:
@@ -372,6 +430,13 @@ def main(argv=None) -> int:
     # those compiles belong to the harness, not to steady-state serving.
     probes = (loadgen.make_probes(args.sizes, args.seed, args.mode_list)
               if args.verify_every else [])
+    # Session scripts too: the host-PRGA references are pure numpy (no
+    # compile either way), but pinning them here keeps the one rule —
+    # everything pre-computed, nothing reference-shaped after warmup.
+    args.session_scripts = (loadgen.make_session_probes(
+        args.sessions, args.session_chunks, args.seed,
+        chunk_sizes=args.session_chunk_bytes, tenants=args.tenants)
+        if args.sessions else None)
     server, report = asyncio.run(_drive(args, probes))
     stats = server.stats()
     lanes = _lane_summary(stats, report.wall_s)
@@ -548,6 +613,23 @@ def main(argv=None) -> int:
                    f"{m}:{int(n)}"
                    for m, n in per_mode["auth_failed"].items())))
 
+    # The stateful-session plane (serve/session.py): client-side script
+    # outcomes (report.sessions) next to the store's own view — opens,
+    # evictions, the keystream prefetch hit rate, and carry replays
+    # (the failover drive's ">= 1 replay" evidence lands here).
+    sess_stats = stats.get("sessions")
+    if args.sessions and sess_stats is not None:
+        pf = sess_stats["prefetch"]
+        hr = pf["hit_rate"]
+        print(f"# sessions: opened={sess_stats['opened']} "
+              f"closed={sess_stats['closed']} "
+              f"chunks={sess_stats['chunks']} "
+              f"evicted={sess_stats['evicted']} "
+              f"shed={sess_stats['shed']} "
+              f"prefetch: dispatches={pf['dispatches']} "
+              f"hit_rate={'n/a' if hr is None else f'{hr:.4f}'} "
+              f"stalls={pf['stalls']} replays={pf['replays']}")
+
     # The live analytics verdict (obs/pulse.py): one final tick over
     # the end-of-run registry, then the alert ledger + the measured
     # per-worker capacity estimate. A healthy drive commits zero
@@ -585,6 +667,12 @@ def main(argv=None) -> int:
             "arrival_rate": args.arrival_rate,
             "modes": list(args.mode_list),
             "seed": args.seed,
+            **({"sessions": args.sessions,
+                "session_chunks": args.session_chunks,
+                "session_chunk_bytes": list(args.session_chunk_bytes),
+                "session_quantum_bytes": args.session_quantum_bytes,
+                "session_prefetch_slots": args.session_prefetch_slots}
+               if args.sessions else {}),
         },
         "modes": per_mode,
         "load": report.to_json(),
@@ -597,6 +685,10 @@ def main(argv=None) -> int:
         "queue": stats["queue"],
         "keycache": stats["keycache"],
         "compiles": stats["compiles"],
+        # The session store's view (serve/session.py.stats(); the
+        # client-side script outcomes ride load.sessions). None when
+        # rc4 is not an enabled mode.
+        "sessions": stats.get("sessions"),
         # The time-attribution stages (serve_stage_us{stage=...}, exact
         # at any sample rate) and the device-time split — the
         # saturation-run decomposition surface (docs/OBSERVABILITY.md).
@@ -674,6 +766,21 @@ def main(argv=None) -> int:
     if args.mode_list != ("ctr",):
         line["modes"] = {m: int(n)
                          for m, n in per_mode["requests"].items()}
+    if args.sessions and sess_stats is not None:
+        pf = sess_stats["prefetch"]
+        line["sessions"] = {
+            "opened": sess_stats["opened"],
+            "closed": sess_stats["closed"],
+            "chunks": sess_stats["chunks"],
+            "evicted": sess_stats["evicted"],
+            "shed": sess_stats["shed"],
+            "hit_rate": pf["hit_rate"],
+            "stalls": pf["stalls"],
+            "replays": pf["replays"],
+            **{k: int(v) for k, v in report.sessions.items()
+               if k in ("open_failed", "chunk_failed", "mismatches")
+               and v},
+        }
     if pulse_section is not None and pulse_section["total"]:
         line["alerts"] = pulse_section["fired"]
     if args.slo:
@@ -718,6 +825,24 @@ def main(argv=None) -> int:
         print(f"# FAIL: SLO regression against {args.slo} "
               "(see the # slo table above)", file=sys.stderr)
         rc = 1
+    if args.min_session_hit_rate is not None:
+        hr = (sess_stats or {}).get("prefetch", {}).get("hit_rate")
+        if hr is None or hr < args.min_session_hit_rate:
+            print(f"# FAIL: keystream prefetch hit rate "
+                  f"{'n/a' if hr is None else f'{hr:.4f}'} < "
+                  f"{args.min_session_hit_rate} — chunks stalled on "
+                  "demand refills (the prefetcher stopped running "
+                  "ahead of consumption)", file=sys.stderr)
+            rc = 1
+    if args.min_session_replays is not None:
+        rp = (sess_stats or {}).get("prefetch", {}).get("replays", 0)
+        if rp < args.min_session_replays:
+            print(f"# FAIL: {rp} keystream carry replay(s) < "
+                  f"{args.min_session_replays} — the failover drive "
+                  "never exercised the bit-exact replay path "
+                  "(serve/session.py carry checkpoints)",
+                  file=sys.stderr)
+            rc = 1
     return rc
 
 

@@ -74,7 +74,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..models import aes
+from ..models import aes, arc4
 from ..obs import incident, metrics, trace
 from ..resilience import degrade, faults, watchdog
 from ..resilience.policy import RetryPolicy
@@ -249,7 +249,8 @@ class Lane:
     def engine_call(self, words, ctr_words, sched, key_slots, label: str,
                     warmup: bool = False, runs=None,
                     timing: dict | None = None, mode: str = "ctr",
-                    inject_words=None, seg_keep=None):
+                    inject_words=None, seg_keep=None,
+                    prep_len: int | None = None):
         """One MULTI-KEY dispatch on THIS lane's device, under this
         lane's watchdog deadline. ``sched`` is the keycache's
         StackedSchedules view (K expanded schedules, zero rows in unused
@@ -298,6 +299,30 @@ class Lane:
                 # slower dispatch): the knob the SLO gate rehearsal
                 # (`serve.bench --slo`, docs/RESILIENCE.md) turns red.
                 faults.injected_slow("dispatch_slow", label)
+            if mode in ("rc4", "rc4-prep"):
+                # The session-mode seams (serve/session.py), jax-only
+                # like the AEAD kernels and schedule-free — ``sched`` is
+                # ignored entirely. ``rc4`` XORs payload words against
+                # cached keystream words (key-oblivious — coalesced
+                # sessions ride one dispatch); ``rc4-prep`` runs the
+                # batched PRGA at the prefetcher's fixed (slots,
+                # prep_len) quantum, carries in ``words``/``ctr_words``
+                # (m stack / xy stack), carry + keystream out in one
+                # array. Both are pure functions of their arrays, so the
+                # pool's bit-exact failover replay holds unchanged.
+                w, c = words, ctr_words
+                if self.device is not None:
+                    w = jax.device_put(w, self.device)
+                    c = jax.device_put(c, self.device)
+                out = (arc4.xor_words(w, c) if mode == "rc4"
+                       else arc4.prep_batch_words(w, c, int(prep_len)))
+                t_fence = self._clock()
+                jax.block_until_ready(out)
+                if timing is not None:
+                    self.device_us += (d_us := int(
+                        (self._clock() - t_fence) * 1e6))
+                    timing["device_us"] = d_us
+                return np.asarray(out)
             if mode == "ctr" and self.engine == aes.NATIVE_ENGINE:
                 # ``runs`` (the batch's request layout) flips the host
                 # tier to the per-request C CTR fast path: counters are
@@ -628,7 +653,8 @@ class LanePool:
     async def dispatch(self, words, ctr_words, sched, key_slots, label: str,
                        bucket: int, blocks: int, requests: int, runs=None,
                        sampled: bool = True, timing: dict | None = None,
-                       mode: str = "ctr", inject_words=None, seg_keep=None):
+                       mode: str = "ctr", inject_words=None, seg_keep=None,
+                       prep_len: int | None = None):
         """Place and run one batch, failing over across lanes until it
         succeeds or every lane has been tried. ``sched``/``key_slots``
         are the multi-key pair (keycache.StackedSchedules + per-block
@@ -722,7 +748,8 @@ class LanePool:
                 # engine_call stub/wrapper that predates modes).
                 extra = ({} if mode == "ctr"
                          else {"mode": mode, "inject_words": inject_words,
-                               "seg_keep": seg_keep})
+                               "seg_keep": seg_keep,
+                               "prep_len": prep_len})
                 return lane.policy.run(
                     lambda att: lane.engine_call(words, ctr_words,
                                                  sched, key_slots,

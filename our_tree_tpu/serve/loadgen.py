@@ -103,6 +103,10 @@ class LoadReport:
     #: ``transfer`` section — the oversized mix, serve/transfer.py);
     #: empty when the drive sent none
     transfers: dict = field(default_factory=dict)
+    #: stateful-session tallies (the rc4 session mix, serve/session.py:
+    #: opened/closed/chunks/verified/mismatches/...); empty when the
+    #: drive ran no sessions
+    sessions: dict = field(default_factory=dict)
 
     def finish(self, wall_s: float, ok_bytes: int) -> None:
         self.wall_s = wall_s
@@ -123,6 +127,8 @@ class LoadReport:
             "p99_ms": self.p99_ms,
             **({"transfers": dict(self.transfers)}
                if self.transfers else {}),
+            **({"sessions": dict(self.sessions)}
+               if self.sessions else {}),
         }
 
 
@@ -213,6 +219,49 @@ def make_transfer_probes(sizes, seed: int) -> list[Probe]:
     return probes
 
 
+@dataclass
+class SessionScript:
+    """One pinned RC4 session drive: key, chunk payloads, and every
+    chunk's expected ciphertext — a per-session probe SEQUENCE (the
+    stream is stateful, so the unit of verification is the whole
+    ordered chunk script, not one request)."""
+    tenant: str
+    sid: int
+    key: bytes
+    payloads: list
+    expected: list
+
+
+def make_session_probes(sessions: int, chunks: int, seed: int,
+                        chunk_sizes=(256, 1024, 4096),
+                        tenants: int = 4) -> list[SessionScript]:
+    """Pinned session scripts with HOST-reference ciphertexts.
+
+    References come from ``models/arc4.keystream_np`` — the pure-numpy
+    PRGA oracle (no jax, no compile), so a fully-verified session drive
+    adds zero post-warmup compiles (the ``make_probes`` rule). Chunk
+    sizes cycle the menu per session with a per-session phase, so
+    concurrent sessions' chunks land on DIFFERENT rungs and the
+    coalescer has mixed shapes to pack. Every chunk is a multiple of 16
+    bytes (queue admission's block rule binds rc4 like every mode)."""
+    from ..models.arc4 import key_schedule, keystream_np
+    rng = np.random.default_rng(seed ^ 0x2545F491)
+    scripts = []
+    for s in range(int(sessions)):
+        key = rng.integers(0, 256, 16, dtype=np.uint8).tobytes()
+        state = (0, 0, key_schedule(key))
+        payloads, expected = [], []
+        for c in range(int(chunks)):
+            size = int(chunk_sizes[(s + c) % len(chunk_sizes)])
+            data = rng.integers(0, 256, size, dtype=np.uint8)
+            ks, state = keystream_np(state, size)
+            payloads.append(data)
+            expected.append(np.bitwise_xor(data, ks))
+        scripts.append(SessionScript(f"t{s % max(int(tenants), 1)}",
+                                     s, key, payloads, expected))
+    return scripts
+
+
 async def run(server, n_requests: int, concurrency: int = 32,
               sizes=MIXED_SIZES, tenants: int = 4, keys_per_tenant: int = 2,
               seed: int = 0, verify_every: int = 8,
@@ -222,6 +271,9 @@ async def run(server, n_requests: int, concurrency: int = 32,
               modes=("ctr",),
               transfer_sizes=(), transfer_every: int = 0,
               transfer_probes: list[Probe] | None = None,
+              sessions: int = 0, session_chunks: int = 0,
+              session_chunk_bytes=(256, 1024, 4096),
+              session_scripts: list[SessionScript] | None = None,
               clock=time.monotonic) -> LoadReport:
     """Drive ``server`` with ``n_requests`` total; returns the
     aggregated LoadReport.
@@ -243,6 +295,14 @@ async def run(server, n_requests: int, concurrency: int = 32,
     serves as a chunked transfer (serve/transfer.py) — always verified
     against its single-shot reference, tallied in
     ``LoadReport.transfers``.
+
+    ``sessions=N`` + ``session_chunks=M``: N rc4 session clients run
+    ALONGSIDE the ordinary drive — each opens its session, streams M
+    interleaved data chunks (every one verified against the pinned
+    host-keystream script, serve/session.py), and closes. The stream
+    is stateful, so a failed chunk ends ITS session's script (the
+    stream position cannot rewind); everything is tallied in
+    ``LoadReport.sessions`` and the chunks join the request totals.
     """
     sizes = tuple(sizes)
     modes = tuple(modes) or ("ctr",)
@@ -251,6 +311,11 @@ async def run(server, n_requests: int, concurrency: int = 32,
     tprobes = list(transfer_probes or ())
     if not tprobes and transfer_sizes and transfer_every:
         tprobes = make_transfer_probes(tuple(transfer_sizes), seed)
+    scripts = list(session_scripts or ())
+    if not scripts and sessions and session_chunks:
+        scripts = make_session_probes(sessions, session_chunks, seed,
+                                      chunk_sizes=tuple(session_chunk_bytes),
+                                      tenants=tenants)
     by_key = {(p.mode, p.payload.size): p for p in probes}
     if "gcm-open" in modes:
         missing = [s for s in sizes if ("gcm-open", s) not in by_key]
@@ -391,6 +456,59 @@ async def run(server, n_requests: int, concurrency: int = 32,
         # (the open-loop, coordinated-omission-free accounting).
         account(resp, payload, probe, (clock() - scheduled) * 1e3)
 
+    async def session_client(script: SessionScript):
+        """One session's whole lifecycle: open -> M data chunks (each
+        verified against the pinned host-keystream script) -> close.
+        Runs concurrently with every other session and the ordinary
+        clients — the interleaving is the workload."""
+        t = report.sessions
+        t["sessions"] = t.get("sessions", 0) + 1
+        r = await server.open_session(script.tenant, script.sid,
+                                      script.key)
+        if not getattr(r, "ok", False):
+            t["open_failed"] = t.get("open_failed", 0) + 1
+            err = getattr(r, "error", None) or "open-failed"
+            report.errors[err] = report.errors.get(err, 0) + 1
+            obs_metrics.counter("loadgen_sessions", outcome="open-failed")
+            return
+        t["opened"] = t.get("opened", 0) + 1
+        obs_metrics.counter("loadgen_sessions", outcome="opened")
+        for data, want in zip(script.payloads, script.expected):
+            t0 = clock()
+            resp = await server.submit(script.tenant, b"", b"", data,
+                                       deadline_s=deadline_s, mode="rc4",
+                                       sid=script.sid)
+            dt_ms = (clock() - t0) * 1e3
+            report.requests += 1
+            report.latencies_ms.append(dt_ms)
+            t["chunks"] = t.get("chunks", 0) + 1
+            obs_metrics.counter("loadgen_requests",
+                                outcome=(resp.error or "ok"))
+            obs_metrics.observe("loadgen_latency_us", dt_ms * 1e3,
+                                outcome=(resp.error or "ok"))
+            if not resp.ok:
+                # The stream is stateful: a failed chunk's keystream
+                # position is gone, so the rest of this session's
+                # script would mis-verify by construction — end it.
+                report.errors[resp.error] = (
+                    report.errors.get(resp.error, 0) + 1)
+                t["chunk_failed"] = t.get("chunk_failed", 0) + 1
+                break
+            report.ok += 1
+            counter["ok_bytes"] += int(data.size)
+            obs_metrics.counter("loadgen_ok_bytes", int(data.size))
+            report.verified += 1
+            t["verified"] = t.get("verified", 0) + 1
+            if not np.array_equal(
+                    np.asarray(resp.payload, np.uint8).reshape(-1),
+                    want):
+                report.mismatches += 1
+                t["mismatches"] = t.get("mismatches", 0) + 1
+            await asyncio.sleep(0)  # let the other sessions interleave
+        r = await server.close_session(script.tenant, script.sid)
+        if getattr(r, "ok", False):
+            t["closed"] = t.get("closed", 0) + 1
+
     async def open_loop(t_start: float):
         interval = 1.0 / arrival_rate
         rng = np.random.default_rng(seed << 8)
@@ -405,9 +523,11 @@ async def run(server, n_requests: int, concurrency: int = 32,
         await asyncio.gather(*pending)
 
     t_start = clock()
+    sess_tasks = [session_client(s) for s in scripts]
     if arrival_rate is not None and arrival_rate > 0:
-        await open_loop(t_start)
+        await asyncio.gather(open_loop(t_start), *sess_tasks)
     else:
-        await asyncio.gather(*(client(c) for c in range(concurrency)))
+        await asyncio.gather(*(client(c) for c in range(concurrency)),
+                             *sess_tasks)
     report.finish(clock() - t_start, counter["ok_bytes"])
     return report

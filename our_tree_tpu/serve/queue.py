@@ -99,9 +99,12 @@ ERR_TRANSFER_MODE = "transfer-unsupported"  #: oversized payload in a
 #: distinct modes because the fused kernel's GHASH direction is a
 #: static compile argument, so the two may never share a dispatch);
 #: ``cbc`` is parallel CBC DECRYPT (the only CBC direction that
-#: parallelises — models/aes.py:cbc_decrypt_words_scattered_multikey).
-#: Batches never mix modes (serve/batcher.py).
-MODES = ("ctr", "gcm", "gcm-open", "cbc")
+#: parallelises — models/aes.py:cbc_decrypt_words_scattered_multikey);
+#: ``rc4`` is the session-stateful stream mode (serve/session.py): data
+#: chunks of an OPEN session XOR against pregenerated keystream — the
+#: request carries its keystream slice, reserved by the SessionManager
+#: before admission. Batches never mix modes (serve/batcher.py).
+MODES = ("ctr", "gcm", "gcm-open", "cbc", "rc4")
 
 #: Modes whose batch rows include the extra J0 block (the E_K(J0) tag
 #: pad rides the CTR dispatch as each request's row 0).
@@ -163,6 +166,15 @@ class Request:
     #: 800-38D §7.1) so non-96-bit IVs ride the same fixed dispatch
     #: shape as everyone else (the batcher consumes this verbatim)
     j0: bytes = b""
+    #: rc4 only: the session id this chunk belongs to, and the
+    #: keystream slice the SessionManager reserved for it at
+    #: ``ks_offset`` of the session's stream (serve/session.py) — the
+    #: batcher packs ``ks`` as the dispatch's counter-array twin, and
+    #: the server acks ``ks_offset`` back to the session when the chunk
+    #: is answered (the failover checkpoint advance).
+    sid: int = -1
+    ks: np.ndarray | None = None
+    ks_offset: int = -1
     #: the admission-time head-sampling decision (OT_TRACE_SAMPLE):
     #: every span this request rides is emitted iff this bit is set
     #: (or the outcome force-samples it). When the request arrived over
@@ -280,7 +292,8 @@ class RequestQueue:
                sampled: bool | None = None, parent: str | None = None,
                priority: int | None = None, mode: str = "ctr",
                iv: bytes = b"", aad: bytes = b"",
-               tag: bytes = b"") -> asyncio.Future:
+               tag: bytes = b"", sid: int = -1, ks=None,
+               ks_offset: int = -1) -> asyncio.Future:
         """Admit one request; always returns a future (already resolved
         with a coded error Response when admission refuses it — callers
         get one uniform await, not two failure channels).
@@ -318,13 +331,26 @@ class RequestQueue:
                 f"(enabled: {self.modes}; its ladder was never warmed)")
         elif data.size == 0 or data.size % 16:
             code, why = ERR_BAD_REQUEST, "payload must be a nonzero multiple of 16 bytes"
-        elif len(bytes(key)) not in (16, 24, 32):
+        elif mode != "rc4" and len(bytes(key)) not in (16, 24, 32):
             # Refused HERE, not discovered at key expansion inside the
-            # batcher loop — admission owns malformed requests.
+            # batcher loop — admission owns malformed requests. rc4 is
+            # exempt: its (1..256-byte) key was consumed by the host KSA
+            # at session OPEN (serve/session.py); data chunks carry no
+            # key at all, only their session id + keystream slice.
             code, why = ERR_BAD_REQUEST, (
                 f"key must be 16/24/32 bytes, got {len(bytes(key))}")
         elif mode == "ctr" and len(bytes(nonce)) != 16:
             code, why = ERR_BAD_REQUEST, "nonce must be 16 bytes"
+        elif mode == "rc4" and int(sid) < 0:
+            code, why = ERR_BAD_REQUEST, (
+                "rc4 chunks must name an open session (sid >= 0)")
+        elif mode == "rc4" and (ks is None
+                                or getattr(ks, "size", 0) != data.size):
+            # The server reserves the slice BEFORE admission; a missing
+            # or short one is a broken session handoff, refused typed.
+            code, why = ERR_BAD_REQUEST, (
+                f"rc4 chunk needs a payload-sized keystream slice "
+                f"(got {getattr(ks, 'size', None)}, want {data.size})")
         elif mode in GCM_MODES and not iv:
             # Any NONZERO IV length serves (SP 800-38D): 96-bit takes
             # the counter-concat fast path, everything else derives J0
@@ -436,7 +462,8 @@ class RequestQueue:
             else None,
             t_submit=self._clock(), _queue=self,
             sampled=trace.sample() if sampled is None else bool(sampled),
-            parent=parent, mode=mode, iv=iv, aad=aad, tag=tag, j0=j0)
+            parent=parent, mode=mode, iv=iv, aad=aad, tag=tag, j0=j0,
+            sid=int(sid), ks=ks, ks_offset=int(ks_offset))
         cm = trace.maybe_span(req.sampled, "request-queued",
                               parent=req.parent, req=req.id,
                               tenant=tenant, blocks=req.nblocks,

@@ -89,10 +89,10 @@ from ..resilience import faults
 from ..resilience import journal as journal_mod
 from ..resilience import watchdog
 from ..utils import packing
-from . import batcher, lanes, transfer
+from . import batcher, lanes, session as session_mod, transfer
 from .keycache import KeyCache, key_digest
-from .queue import (ERR_AUTH, ERR_DEADLINE, ERR_DISPATCH, ERR_TOO_LARGE,
-                    GCM_MODES, MODES, RequestQueue, Response)
+from .queue import (ERR_AUTH, ERR_BAD_REQUEST, ERR_DEADLINE, ERR_DISPATCH,
+                    ERR_TOO_LARGE, GCM_MODES, MODES, RequestQueue, Response)
 from .status import StatusServer
 
 #: The jax monitoring event that fires once per REAL backend compile and
@@ -238,6 +238,22 @@ class ServerConfig:
     #: transfer ledger journal path (resume tokens survive the process);
     #: None = in-memory ledger (transparent decomposition only)
     transfer_ledger: str | None = None
+    #: served RC4 sessions (serve/session.py; active iff "rc4" is in
+    #: ``modes``): open sessions admitted per tenant before the store's
+    #: LRU considers evicting that tenant's IDLE rows
+    session_per_tenant: int = 16
+    #: pregenerated keystream kept ahead of each session's consumed
+    #: offset (bytes); the watermark refill tops sessions back up to it
+    session_window_bytes: int = 65536
+    #: PRGA scan length per refill dispatch (bytes, multiple of 4) —
+    #: the FIXED compiled quantum every prefetch dispatch shares
+    session_quantum_bytes: int = 4096
+    #: sessions coalesced per prefetch dispatch (the stacked S axis of
+    #: the vmapped scan — also a fixed compile shape)
+    session_prefetch_slots: int = 8
+    #: global keystream-bytes-held budget: at the cap, non-urgent
+    #: refills pause and new opens shed (backpressure, never a wedge)
+    session_budget_bytes: int = 8 << 20
 
 
 class Server:
@@ -312,6 +328,18 @@ class Server:
                 max_payload_bytes=c.transfer_max_bytes,
                 deadline_s=c.transfer_deadline_s,
                 ledger=transfer.TransferLedger(c.transfer_ledger))
+        #: the RC4 session engine (serve/session.py): per-session PRGA
+        #: carry state + the batched keystream prefetcher, dispatching
+        #: through the SAME lane pool (and its failover) as traffic.
+        #: Built only when the rc4 mode is enabled.
+        self.sessions: session_mod.SessionManager | None = None
+        if "rc4" in c.modes:
+            self.sessions = session_mod.SessionManager(
+                self._session_prep, per_tenant=c.session_per_tenant,
+                window_bytes=c.session_window_bytes,
+                quantum_bytes=c.session_quantum_bytes,
+                prefetch_slots=c.session_prefetch_slots,
+                budget_bytes=c.session_budget_bytes)
 
     # -- lifecycle ---------------------------------------------------------
     async def start(self) -> None:
@@ -347,8 +375,12 @@ class Server:
         # so they count as warmup, never as a steady-state recompile).
         # Stamped into the run dir so obs.report can roofline post-hoc,
         # and onto the incident recorder so bundles are self-contained.
+        # rc4 is excluded from the cost model: ladder_costs prices AES
+        # rounds per key size (ROUNDS is AES-only) and the rc4 XOR is
+        # key-oblivious — no (bits, nr) row exists for it.
+        cost_modes = tuple(m for m in c.modes if m != "rc4") or ("ctr",)
         self.cost_records = costmodel.ladder_costs(
-            self.engine, c.modes, self.rungs,
+            self.engine, cost_modes, self.rungs,
             key_bits=c.warmup_key_bits, key_slots=c.key_slots)
         costmodel.write_run_records(self.cost_records, engine=self.engine,
                                     ceiling_gbps=c.ceiling_gbps)
@@ -457,7 +489,12 @@ class Server:
                             # (lane, rung) — an unwarmed mode's first
                             # batch would recompile mid-traffic.
                             for m in c.modes:
-                                if m == "ctr":
+                                # rc4 is schedule-free: keycache.stacked
+                                # cannot expand it and the XOR/PRGA
+                                # programs are keyless — it primes its
+                                # OWN block below, outside the per-bits
+                                # loop.
+                                if m in ("ctr", "rc4"):
                                     continue
                                 sched_m = self.keycache.stacked(
                                     [("_warmup", b"\x00" * (bits // 8))],
@@ -473,6 +510,34 @@ class Server:
                                         mode=m, inject_words=words,
                                         seg_keep=np.ones(
                                             rung, dtype=np.uint32))
+                        if "rc4" in c.modes and not mismatch:
+                            # RC4 primes exactly two program families
+                            # per lane: the key-oblivious XOR at every
+                            # rung (the crypt-phase shape session
+                            # chunks batch into) and ONE batched PRGA
+                            # scan at the prefetcher's fixed
+                            # (slots x quantum) carry shape — with
+                            # both warm, session traffic never
+                            # compiles (the zero-recompile contract
+                            # extends to the session axis). ``sched``
+                            # is None: the rc4 lane branch ignores it.
+                            for rung in self.rungs:
+                                compile_context(self.engine, rung)
+                                words = np.zeros(4 * rung,
+                                                 dtype=np.uint32)
+                                lane.engine_call(
+                                    words, words, None, slot_vecs[rung],
+                                    f"warmup:{rung}:rc4", warmup=True,
+                                    mode="rc4")
+                            s = c.session_prefetch_slots
+                            q = c.session_quantum_bytes
+                            compile_context(self.engine, q // 16)
+                            lane.engine_call(
+                                np.zeros(s * 256, dtype=np.uint32),
+                                np.zeros(2 * s, dtype=np.uint32),
+                                None, slot_vecs[self.rungs[0]],
+                                "warmup:rc4-prep", warmup=True,
+                                mode="rc4-prep", prep_len=q)
                         if mismatch:
                             lane._quarantine("warmup-mismatch",
                                              self._journal)
@@ -516,6 +581,12 @@ class Server:
             #                    already abandoned (stale generation)
         if self._journal is not None:
             self._journal.close()
+        if self.sessions is not None:
+            # Force-close whatever is still open (counted: a drain with
+            # open sessions is visible, not silent) and stop the
+            # background refill — after the batcher drain above, no
+            # chunk can still be riding their keystream.
+            await self.sessions.drain()
         if self.transfers is not None:
             self.transfers.ledger.close()
         # Final exact totals on disk even if the process never reaches
@@ -540,7 +611,8 @@ class Server:
                      sampled: bool | None = None,
                      parent: str | None = None,
                      priority: int | None = None, mode: str = "ctr",
-                     iv: bytes = b"", aad: bytes = b"", tag: bytes = b""):
+                     iv: bytes = b"", aad: bytes = b"", tag: bytes = b"",
+                     sid: int = -1):
         """Admit one crypt request and await its Response.
         ``sampled``/``parent``/``priority`` propagate a wire-fronted
         request's router-side admission decisions; ``mode`` selects the
@@ -553,6 +625,24 @@ class Server:
         and the spliced Response is byte-identical to what one giant
         rung would have produced (chunk-boundary KATs pin it)."""
         data = np.asarray(payload, dtype=np.uint8).reshape(-1)
+        if mode == "rc4" and self.sessions is not None:
+            # Session data chunk: reserve the chunk's keystream slice
+            # from the session's prefetched window (hit = no device
+            # wait; miss = an awaited urgent refill), ride the queue as
+            # an ordinary coalescable request carrying that slice, and
+            # ACK on ANY final answer — a failed chunk's error is final
+            # too, and its bytes must not pin the window forever.
+            resv = await self.sessions.reserve(tenant, sid, data.size)
+            if isinstance(resv, Response):
+                return resv
+            ks, off = resv
+            try:
+                return await self.queue.submit(
+                    tenant, key, nonce, data, deadline_s, sampled=sampled,
+                    parent=parent, priority=priority, mode=mode,
+                    sid=sid, ks=ks, ks_offset=off)
+            finally:
+                self.sessions.ack(tenant, sid, off, data.size)
         span = data.size // 16 + (1 if mode in GCM_MODES else 0)
         if self.transfers is not None and span > self.rungs[-1] \
                 and data.size and data.size % 16 == 0:
@@ -562,7 +652,8 @@ class Server:
         return await self.queue.submit(tenant, key, nonce, payload,
                                        deadline_s, sampled=sampled,
                                        parent=parent, priority=priority,
-                                       mode=mode, iv=iv, aad=aad, tag=tag)
+                                       mode=mode, iv=iv, aad=aad, tag=tag,
+                                       sid=sid)
 
     async def submit_transfer(self, tenant: str, key: bytes, nonce: bytes,
                               payload, deadline_s: float | None = None,
@@ -594,6 +685,39 @@ class Server:
         return await self.queue.submit(
             tenant, key, spec.nonce or b"", piece, deadline_s,
             sampled=sampled, parent=parent, mode=mode, iv=spec.iv)
+
+    # -- session side ------------------------------------------------------
+    async def open_session(self, tenant: str, sid: int, key: bytes):
+        """Open (KSA + full-window keystream prefill) one RC4 session."""
+        if self.sessions is None:
+            return Response(ok=False, error=ERR_BAD_REQUEST,
+                            detail="rc4 mode not enabled on this server")
+        return await self.sessions.open(tenant, sid, key)
+
+    async def close_session(self, tenant: str, sid: int):
+        """Close one RC4 session, releasing its window and state."""
+        if self.sessions is None:
+            return Response(ok=False, error=ERR_BAD_REQUEST,
+                            detail="rc4 mode not enabled on this server")
+        return await self.sessions.close(tenant, sid)
+
+    async def _session_prep(self, m_words, xy_words, sampled: bool):
+        """The session prefetcher's lane seam: ONE batched PRGA scan
+        (mode ``rc4-prep``) through the same failover pool as traffic.
+        A lane that dies or hangs mid-scan redispatches the identical
+        carry arrays on a healthy lane — the scan is a pure function of
+        its carries, so the replayed keystream is bit-exact — and the
+        attempt count comes back as the session layer's
+        keystream-replay evidence (``serve_session_replays``)."""
+        q = self.config.session_quantum_bytes
+        s = int(xy_words.shape[0]) // 2
+        out, _lane, replays = await self.pool.dispatch(
+            np.ascontiguousarray(m_words, dtype=np.uint32),
+            np.ascontiguousarray(xy_words, dtype=np.uint32),
+            None, np.zeros(1, dtype=np.uint32), f"rc4-prep:{s}",
+            bucket=q // 16, blocks=s * (q // 16), requests=1,
+            sampled=sampled, mode="rc4-prep", prep_len=q)
+        return np.asarray(out), replays
 
     # -- the batcher loop --------------------------------------------------
     async def _loop(self) -> None:
@@ -657,16 +781,18 @@ class Server:
         riders, so anything unexpected resolves them with errors; the
         in-flight slot is returned in every outcome."""
         try:
-            sched = self._form_batch(b)
-            if sched is not None:
-                await self._dispatch_batch(b, sched)
+            formed = self._form_batch(b)
+            if formed is not None:
+                await self._dispatch_batch(b, formed[0])
         finally:
             self._sem.release()
 
     def _form_batch(self, b: batcher.Batch):
-        """Array materialisation + schedule stacking; returns the
-        stacked schedules, or None after answering the riders when
-        formation itself failed."""
+        """Array materialisation + schedule stacking; returns a
+        1-tuple ``(sched,)`` (sched is None for the schedule-free rc4
+        mode — the tuple keeps "formed, no schedule" distinct from
+        failure), or None after answering the riders when formation
+        itself failed."""
         try:
             # Emitted iff the batch carries a sampled rider; a formation
             # FAILURE still materialises the span (error end) whatever
@@ -675,8 +801,14 @@ class Server:
                                   bucket=b.bucket, blocks=b.blocks,
                                   slots=len(b.slots),
                                   requests=len(b.requests)):
-                sched = self.keycache.stacked(b.keys, b.key_slots,
-                                              mode=b.mode)
+                # rc4 batches are schedule-free (the XOR is
+                # key-oblivious; the per-session key was consumed by
+                # the host KSA at session open) — the keycache never
+                # sees them, so its tenant-isolation LRU is untouched
+                # by session traffic.
+                sched = (None if b.mode == "rc4"
+                         else self.keycache.stacked(b.keys, b.key_slots,
+                                                    mode=b.mode))
                 # The native tier generates counters inside C per
                 # request (the batch's ``runs`` layout) — materialising
                 # the (N, 4) counter array it would never read is pure
@@ -686,7 +818,7 @@ class Server:
                 b.materialise(counters=(b.mode != "ctr"
                                         or self.engine != aes.NATIVE_ENGINE),
                               sched=sched)
-                return sched
+                return (sched,)
         except Exception as e:  # noqa: BLE001 - containment (docstring)
             self.batches_failed += 1
             metrics.counter("serve_batches", outcome="form-failed")
@@ -901,4 +1033,6 @@ class Server:
                          "steady": self.steady_compiles()},
             "transfers": (self.transfers.stats()
                           if self.transfers is not None else None),
+            "sessions": (self.sessions.stats()
+                         if self.sessions is not None else None),
         }

@@ -244,6 +244,10 @@ class StatusServer(HttpStatusEndpoint):
         #: reassembly is still pinned at its budget: backpressure that
         #: is happening NOW, not a count from an old burst.
         self._transfer_sheds_seen = 0
+        #: same watermark idiom for the session plane: "shedding" only
+        #: when sheds grew since the last poll AND the keystream budget
+        #: is still pinned — live backpressure, not an old burst.
+        self._session_sheds_seen = 0
 
     # -- the two documents -------------------------------------------------
     def healthz(self) -> dict:
@@ -285,6 +289,31 @@ class StatusServer(HttpStatusEndpoint):
                 "refused": int(t.get("refused", 0)),
                 "shedding": shedding,
             }
+        # The session plane's live state (serve/session.py): open
+        # sessions, held keystream bytes vs budget, sheds — the same
+        # blind-spot rule as transfers: state a load balancer must see
+        # BEFORE routing more stateful opens here.
+        sessions_doc = None
+        if getattr(s, "sessions", None) is not None:
+            st = s.sessions.stats()
+            budget = int(st.get("budget_bytes", 0) or 0)
+            sheds = int(st.get("shed", 0))
+            pinned = (budget > 0
+                      and int(st.get("held_bytes", 0)) >= budget * 0.9)
+            sess_shedding = pinned and sheds > self._session_sheds_seen
+            self._session_sheds_seen = sheds
+            shedding = shedding or sess_shedding
+            sessions_doc = {
+                "open": int(st.get("open", 0)),
+                "held_bytes": int(st.get("held_bytes", 0)),
+                "budget_bytes": budget,
+                "shed": sheds,
+                "refused": int(st.get("refused", 0)),
+                "evicted": int(st.get("evicted", 0)),
+                "hit_rate": st["prefetch"]["hit_rate"],
+                "replays": int(st["prefetch"]["replays"]),
+                "shedding": sess_shedding,
+            }
         if s.queue.closed:
             status = "draining"
         elif placeable > 0 and not shedding:
@@ -309,6 +338,8 @@ class StatusServer(HttpStatusEndpoint):
         }
         if transfers_doc is not None:
             doc["transfers"] = transfers_doc
+        if sessions_doc is not None:
+            doc["sessions"] = sessions_doc
         pulse_t = getattr(s, "pulse", None)
         if pulse_t is not None:
             # The live capacity estimate (obs/pulse.py): what the fleet

@@ -59,6 +59,16 @@ below is unchanged, and every per-frame bound (MAX_HEADER, ``max_len``)
 still applies because a transfer's chunks are at most one ladder rung
 each. ``serve/worker.py`` documents the exchange.
 
+Frames carrying an ``ss`` field belong to the stateful-session
+sub-protocol (mode ``rc4``, serve/session.py): ``open`` / ``data`` /
+``close``, each its OWN one-frame request/response exchange — no
+multi-frame state rides the connection, so one connection interleaves
+many sessions' chunks with ordinary requests (the server coalesces
+concurrent sessions' chunks into shared dispatches). The session state
+itself lives server-side, keyed ``(tenant, sid)``; the router pins each
+session's frames to the backend that opened it (route/proxy.py).
+``serve/worker.py`` documents the frames.
+
 Used by ``serve/worker.py`` (the backend process's TCP frontend — reads
 requests, feeds ``Server.submit``, writes responses) and by
 ``route/proxy.py`` (the router's backend client — the one

@@ -154,6 +154,9 @@ class RequestFrontend:
                 if header.get("tx"):
                     await self._serve_transfer(reader, writer, header)
                     continue
+                if header.get("ss"):
+                    await self._serve_session(writer, header, payload)
+                    continue
                 await self._answer(writer, header, payload)
         finally:
             try:
@@ -220,6 +223,88 @@ class RequestFrontend:
         out["tr"] = t_rx
         out["ts"] = trace.now_us()
         out["pid"] = os.getpid()
+        if header.get("lg") and resp.ledger is not None:
+            out["lg"] = resp.ledger
+        writer.write(wire.encode_frame(out, body))
+        await writer.drain()
+
+    async def _serve_session(self, writer, header: dict,
+                             payload: bytes) -> None:
+        """The ``ss`` stateful-session sub-protocol (mode ``rc4``,
+        serve/session.py). UNLIKE ``tx``, every ``ss`` frame is its own
+        one-frame exchange, so a connection interleaves many sessions'
+        frames (and ordinary requests) freely — which is the point: the
+        batcher coalesces CONCURRENT sessions' data chunks into shared
+        XOR dispatches.
+
+        * ``{"ss": "open", t, sid, k}`` — host KSA + full-window
+          keystream prefill; answers ``ok`` or a typed shed/refusal.
+        * ``{"ss": "data", t, sid, len}`` + payload — XOR the chunk
+          against the session's next ``len`` cached keystream bytes;
+          the ciphertext rides back on the answer frame. Chunks are
+          STATEFUL: each consumes the stream where the last left off,
+          so a failed chunk's session should be closed and reopened
+          (the stream position does not rewind).
+        * ``{"ss": "close", t, sid}`` — release the session.
+        """
+        t_rx = trace.now_us()
+        op = str(header.get("ss") or "")
+        tenant = str(header.get("t", ""))
+        try:
+            sid = int(header.get("sid"))
+        except (TypeError, ValueError):
+            writer.write(wire.encode_frame(
+                {"ss": op, "ok": False, "error": ERR_BAD_REQUEST,
+                 "detail": "ss frames need an integer sid"}))
+            await writer.drain()
+            return
+        sampled = header.get("sm")
+        sampled = bool(sampled) if sampled is not None else None
+        parent = header.get("ps")
+        parent = str(parent) if parent else None
+        body = b""
+        if op == "open":
+            try:
+                key = bytes.fromhex(str(header.get("k", "")))
+            except ValueError:
+                key = b""
+            resp = await self._server.open_session(tenant, sid, key)
+        elif op == "data":
+            try:
+                deadline = header.get("deadline_s")
+                deadline = (float(deadline) if deadline is not None
+                            else None)
+            except (TypeError, ValueError):
+                writer.write(wire.encode_frame(
+                    {"ss": op, "ok": False, "error": ERR_BAD_REQUEST,
+                     "detail": "deadline_s is not a number"}))
+                await writer.drain()
+                return
+            resp = await self._server.submit(
+                tenant, b"", b"", memoryview(payload),
+                deadline_s=deadline, sampled=sampled, parent=parent,
+                mode="rc4", sid=sid)
+            if resp.ok:
+                body = resp.payload.tobytes()
+        elif op == "close":
+            resp = await self._server.close_session(tenant, sid)
+        else:
+            writer.write(wire.encode_frame(
+                {"ss": op, "ok": False, "error": ERR_BAD_REQUEST,
+                 "detail": f"unknown ss op {op!r} "
+                           f"(known: open, data, close)"}))
+            await writer.drain()
+            return
+        out = {"ss": op, "ok": resp.ok, "sid": sid,
+               "tr": t_rx, "ts": trace.now_us(), "pid": os.getpid()}
+        if resp.ok:
+            if resp.batch:
+                out["batch"] = resp.batch
+            if resp.detail:
+                out["detail"] = resp.detail
+        else:
+            out["error"] = resp.error
+            out["detail"] = resp.detail
         if header.get("lg") and resp.ledger is not None:
             out["lg"] = resp.ledger
         writer.write(wire.encode_frame(out, body))
@@ -406,7 +491,12 @@ async def _amain(args) -> int:
         transfer_budget_bytes=args.transfer_budget_bytes,
         transfer_max_bytes=args.transfer_max_bytes,
         transfer_deadline_s=args.transfer_deadline,
-        transfer_ledger=args.transfer_ledger)
+        transfer_ledger=args.transfer_ledger,
+        session_per_tenant=args.session_per_tenant,
+        session_window_bytes=args.session_window_bytes,
+        session_quantum_bytes=args.session_quantum_bytes,
+        session_prefetch_slots=args.session_prefetch_slots,
+        session_budget_bytes=args.session_budget_bytes)
     server = Server(cfg)
     await server.start()
     frontend = RequestFrontend(server, args.port, host=args.host)
@@ -446,7 +536,8 @@ async def _amain(args) -> int:
             "keycache": stats["keycache"],
             "frames": frontend.frames,
             "protocol_errors": frontend.protocol_errors,
-            "transfers": stats["transfers"]}
+            "transfers": stats["transfers"],
+            "sessions": stats["sessions"]}
     print(json.dumps(line), flush=True)
     trace.point("worker-drained", lost=lost, frames=frontend.frames)
     return 1 if lost else 0
@@ -469,9 +560,9 @@ def main(argv=None) -> int:
     ap.add_argument("--engine", default="auto")
     ap.add_argument("--modes", default="ctr", metavar="M1,M2",
                     help="served modes to enable and warm (serve/queue.py "
-                         "MODES: ctr,gcm,gcm-open,cbc; default ctr — "
-                         "AEAD serving is an explicit opt-in, "
-                         "docs/SERVING.md)")
+                         "MODES: ctr,gcm,gcm-open,cbc,rc4; default ctr — "
+                         "AEAD and stateful-session serving are explicit "
+                         "opt-ins, docs/SERVING.md)")
     ap.add_argument("--lanes", type=int, default=None, metavar="N")
     ap.add_argument("--bucket-min", type=int, default=32, metavar="BLOCKS")
     ap.add_argument("--bucket-max", type=int, default=4096, metavar="BLOCKS")
@@ -522,6 +613,27 @@ def main(argv=None) -> int:
                     help="durable acked-chunk ledger (JSONL, fsync'd): "
                          "the resume contract survives this worker's "
                          "own SIGKILL")
+    ap.add_argument("--session-per-tenant", type=int, default=16,
+                    metavar="N",
+                    help="open rc4 sessions per tenant before the "
+                         "session store's LRU evicts that tenant's IDLE "
+                         "rows (serve/session.py)")
+    ap.add_argument("--session-window-bytes", type=int, default=65536,
+                    metavar="BYTES",
+                    help="pregenerated keystream kept ahead of each "
+                         "session's consumed offset")
+    ap.add_argument("--session-quantum-bytes", type=int, default=4096,
+                    metavar="BYTES",
+                    help="PRGA scan length per refill dispatch (the "
+                         "fixed compiled quantum)")
+    ap.add_argument("--session-prefetch-slots", type=int, default=8,
+                    metavar="S",
+                    help="sessions coalesced per prefetch dispatch (the "
+                         "stacked scan's fixed S axis)")
+    ap.add_argument("--session-budget-bytes", type=int, default=8 << 20,
+                    metavar="BYTES",
+                    help="global keystream-held budget: at the cap, "
+                         "non-urgent refills pause and new opens shed")
     ap.add_argument("--ceiling-gbps", type=float, default=None,
                     metavar="GBPS",
                     help="the measured device roofline the cost model "

@@ -1,0 +1,479 @@
+"""ot-session: served RC4 streaming sessions (serve/session.py).
+
+Four layers, inside-out:
+
+* the batched-PRGA device entries (``models/arc4.py``) — the vmapped
+  ``prep_batch_words`` lane layout and the serve XOR against the
+  pure-numpy PRGA oracle (``keystream_np``);
+* the ``SessionManager`` engine over a host-oracle dispatcher — the
+  bounded LRU store (tenant isolation, idle eviction, the
+  eviction-mid-session REFUSAL), the keystream window/budget
+  backpressure (shed, never wedge), the ``keystream_miss`` /
+  ``session_stall`` / ``session_evict`` fault seams, and
+  drain-with-open-sessions;
+* the serve integration — an in-process ``Server`` with rc4 enabled:
+  interleaved multi-session chunks bit-exact against the host oracle
+  with ZERO post-warmup compiles, and the lane-kill drill (a hung lane
+  quarantined mid-refill, the carry replayed bit-exactly on the
+  healthy lane);
+* the wire + router seams — the worker frontend's ``ss`` sub-protocol
+  and the router's pin-required contract for session data.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from our_tree_tpu.models import arc4
+from our_tree_tpu.obs import metrics
+from our_tree_tpu.resilience import degrade, faults
+from our_tree_tpu.serve import session as session_mod
+from our_tree_tpu.serve import wire
+from our_tree_tpu.serve.queue import (ERR_BAD_REQUEST, ERR_SHED,
+                                      ERR_SHUTDOWN, Response)
+from our_tree_tpu.serve.server import Server, ServerConfig
+from our_tree_tpu.serve.worker import RequestFrontend
+
+LADDER = dict(min_bucket_blocks=32, max_bucket_blocks=256, lanes=1)
+
+
+@pytest.fixture(autouse=True)
+def _clean_process_state(monkeypatch):
+    monkeypatch.delenv("OT_FAULTS", raising=False)
+    monkeypatch.delenv("OT_DISPATCH_DEADLINE", raising=False)
+    faults.reset()
+    degrade.clear()
+    metrics.reset_for_tests()
+    yield
+    monkeypatch.delenv("OT_FAULTS", raising=False)
+    faults.reset()
+    degrade.clear()
+    metrics.reset_for_tests()
+
+
+def _oracle_rows(m_words, xy_words, length: int) -> np.ndarray:
+    """The host twin of ``arc4.prep_batch_words``: per-slot PRGA via the
+    pure-numpy oracle, packed into the same (S, 258 + L/4) row layout."""
+    S = int(xy_words.shape[0]) // 2
+    rows = np.zeros((S, 258 + length // 4), np.uint32)
+    for i in range(S):
+        state = (int(xy_words[i]), int(xy_words[S + i]),
+                 m_words[i * 256:(i + 1) * 256].astype(np.uint8))
+        ks, (x2, y2, m2) = arc4.keystream_np(state, length)
+        rows[i, 0], rows[i, 1] = x2, y2
+        rows[i, 2:258] = m2
+        rows[i, 258:] = np.frombuffer(np.asarray(ks, np.uint8).tobytes(),
+                                      "<u4")
+    return rows
+
+
+def _host_dispatch(quantum: int):
+    """A SessionManager dispatcher that runs the oracle on the host —
+    the manager's engine logic exercised without a jax dispatch."""
+    async def dispatch(m_words, xy_words, sampled):
+        return _oracle_rows(m_words, xy_words, quantum), 0
+    return dispatch
+
+
+def _manager(quantum=1024, window=2048, slots=4, per_tenant=4,
+             budget=1 << 20, dispatch=None):
+    return session_mod.SessionManager(
+        dispatch or _host_dispatch(quantum), per_tenant=per_tenant,
+        window_bytes=window, quantum_bytes=quantum, prefetch_slots=slots,
+        budget_bytes=budget)
+
+
+# ---------------------------------------------------------------------------
+# The device entries (models/arc4.py).
+# ---------------------------------------------------------------------------
+
+
+def test_prep_batch_words_matches_host_oracle():
+    rng = np.random.default_rng(3)
+    S, L = 3, 128
+    m_words = np.zeros(S * 256, np.uint32)
+    xy_words = np.zeros(2 * S, np.uint32)
+    for i in range(S):
+        key = rng.integers(0, 256, 16, dtype=np.uint8).tobytes()
+        m_words[i * 256:(i + 1) * 256] = arc4.key_schedule(key)
+    got = np.asarray(arc4.prep_batch_words(m_words, xy_words, L))
+    want = _oracle_rows(m_words, xy_words, L)
+    assert got.shape == want.shape
+    assert np.array_equal(got, want)
+
+
+def test_prep_batch_words_resumes_from_carry():
+    # Two L-byte quanta from carries == one 2L run: the bit-exact
+    # failover story's substrate (a carry is a pure resume point).
+    key = bytes(range(16))
+    m_words = arc4.key_schedule(key).astype(np.uint32)
+    r1 = np.asarray(arc4.prep_batch_words(m_words, np.zeros(2, np.uint32),
+                                          64))
+    r2 = np.asarray(arc4.prep_batch_words(r1[0, 2:258], r1[0, :2], 64))
+    ks = (r1[0, 258:].astype("<u4").tobytes()
+          + r2[0, 258:].astype("<u4").tobytes())
+    want, _ = arc4.keystream_np((0, 0, arc4.key_schedule(key)), 128)
+    assert ks == np.asarray(want, np.uint8).tobytes()
+
+
+def test_xor_words_is_the_crypt_phase():
+    rng = np.random.default_rng(4)
+    a = rng.integers(0, 2**32, 64, dtype=np.uint32)
+    b = rng.integers(0, 2**32, 64, dtype=np.uint32)
+    got = np.asarray(arc4.xor_words(a, b))
+    assert np.array_equal(got, np.bitwise_xor(a, b))
+
+
+# ---------------------------------------------------------------------------
+# SessionManager over the host-oracle dispatcher.
+# ---------------------------------------------------------------------------
+
+
+def test_reserve_streams_bit_exact_and_hit_dominated():
+    async def go():
+        sm = _manager(quantum=1024, window=4096)
+        key = b"\x01" * 16
+        assert (await sm.open("t", 0, key)).ok
+        ref = arc4.ARC4(key)
+        for n in (256, 1024, 512):
+            ks, off = await sm.reserve("t", 0, n)
+            assert bytes(ks) == ref.prep(n, backend="np").tobytes()
+            sm.ack("t", 0, off, n)
+        st = sm.stats()
+        # The open prefilled a whole window, so every chunk above was a
+        # cache hit — the steady state the artifact gate pins >= 0.9.
+        assert st["prefetch"]["hits"] == 3
+        assert st["prefetch"]["misses"] == 0
+        assert (await sm.close("t", 0)).ok
+        await sm.drain()
+    asyncio.run(go())
+
+
+def test_tenant_isolation_same_sid_different_state():
+    async def go():
+        sm = _manager()
+        ka, kb = b"\xaa" * 16, b"\xbb" * 16
+        assert (await sm.open("ta", 7, ka)).ok
+        assert (await sm.open("tb", 7, kb)).ok
+        ra, rb = arc4.ARC4(ka), arc4.ARC4(kb)
+        ks_a, off_a = await sm.reserve("ta", 7, 256)
+        ks_b, off_b = await sm.reserve("tb", 7, 256)
+        assert bytes(ks_a) == ra.prep(256, backend="np").tobytes()
+        assert bytes(ks_b) == rb.prep(256, backend="np").tobytes()
+        sm.ack("ta", 7, off_a, 256)
+        sm.ack("tb", 7, off_b, 256)
+        # Closing one tenant's sid 7 leaves the other's untouched.
+        assert (await sm.close("ta", 7)).ok
+        ks_b2, _ = await sm.reserve("tb", 7, 256)
+        assert bytes(ks_b2) == rb.prep(256, backend="np").tobytes()
+        await sm.drain()
+    asyncio.run(go())
+
+
+def test_store_lru_evicts_idle_and_refuses_busy():
+    async def go():
+        sm = _manager(per_tenant=2)
+        for sid in (0, 1):
+            assert (await sm.open("t", sid, bytes([sid]) * 16)).ok
+        # Touch sid 0 so sid 1 is the LRU row; both are idle.
+        _ks, off = await sm.reserve("t", 0, 256)
+        sm.ack("t", 0, off, 256)
+        assert (await sm.open("t", 2, b"\x02" * 16)).ok
+        assert sm.stats()["evicted"] == 1
+        r = await sm.reserve("t", 1, 16)  # the evicted LRU row
+        assert isinstance(r, Response) and r.error == ERR_BAD_REQUEST
+        # Now make every row busy (a reserved, unacked chunk) — the
+        # eviction-mid-session refusal: open sheds instead of yanking
+        # state from under in-flight chunks.
+        for sid in (0, 2):
+            await sm.reserve("t", sid, 256)
+        r = await sm.open("t", 3, b"\x03" * 16)
+        assert not r.ok and r.error == ERR_SHED
+        assert sm.stats()["shed"] == 1
+        await sm.drain()
+    asyncio.run(go())
+
+
+def test_keystream_budget_sheds_until_acks_release(monkeypatch):
+    async def go():
+        # One quantum of global budget: A's prefill pins it, B's open
+        # sheds typed; acking A's chunk releases the window and B opens.
+        sm = _manager(quantum=1024, window=1024, budget=1024)
+        assert (await sm.open("t", 0, b"\x0a" * 16)).ok
+        r = await sm.open("t", 1, b"\x0b" * 16)
+        assert not r.ok and r.error == ERR_SHED
+        assert sm.stats()["open"] == 1  # the shed open left no row
+        ks, off = await sm.reserve("t", 0, 1024)
+        assert len(ks) == 1024
+        sm.ack("t", 0, off, 1024)
+        assert sm.stats()["held_bytes"] == 0
+        assert (await sm.open("t", 1, b"\x0b" * 16)).ok
+        await sm.drain()
+    asyncio.run(go())
+
+
+def test_keystream_miss_regenerates_bit_exact(monkeypatch):
+    async def go():
+        sm = _manager(quantum=512, window=1024)
+        key = b"\x42" * 16
+        assert (await sm.open("t", 0, key)).ok
+        ref = arc4.ARC4(key)
+        ks, off = await sm.reserve("t", 0, 256)
+        assert bytes(ks) == ref.prep(256, backend="np").tobytes()
+        sm.ack("t", 0, off, 256)
+        monkeypatch.setenv("OT_FAULTS", "keystream_miss:1@session=0")
+        faults.reset()
+        # The cached window is discarded at reserve; the engine
+        # regenerates forward from the acked-checkpoint carry and the
+        # bytes MUST be identical — the deterministic-PRGA guarantee.
+        ks2, off2 = await sm.reserve("t", 0, 512)
+        assert bytes(ks2) == ref.prep(512, backend="np").tobytes()
+        sm.ack("t", 0, off2, 512)
+        st = sm.stats()["prefetch"]
+        assert st["injected_misses"] == 1 and st["replays"] >= 1
+        await sm.drain()
+    asyncio.run(go())
+
+
+def test_session_stall_is_backpressure_not_a_wedge(monkeypatch):
+    async def go():
+        monkeypatch.setenv("OT_FAULTS", "session_stall:1@session=0")
+        monkeypatch.setenv("OT_SLOW_S", "0.01")
+        faults.reset()
+        sm = _manager(quantum=512, window=512)
+        key = b"\x05" * 16
+        assert (await sm.open("t", 0, key)).ok  # the prefill stalls...
+        ks, off = await sm.reserve("t", 0, 512)  # ...then serves
+        assert bytes(ks) == arc4.ARC4(key).prep(
+            512, backend="np").tobytes()
+        sm.ack("t", 0, off, 512)
+        assert sm.stats()["prefetch"]["stalls"] == 1
+        await sm.drain()
+    asyncio.run(go())
+
+
+def test_session_evict_fault_forces_the_idle_path(monkeypatch):
+    async def go():
+        monkeypatch.setenv("OT_FAULTS", "session_evict:1@session=1")
+        faults.reset()
+        sm = _manager(per_tenant=8)
+        assert (await sm.open("t", 0, b"\x00" * 16)).ok
+        # The rehearsal: the next open force-evicts the LRU-idle row
+        # even though the store is nowhere near capacity.
+        assert (await sm.open("t", 1, b"\x01" * 16)).ok
+        assert sm.stats()["evicted"] == 1
+        r = await sm.reserve("t", 0, 16)
+        assert isinstance(r, Response) and r.error == ERR_BAD_REQUEST
+        await sm.drain()
+    asyncio.run(go())
+
+
+def test_drain_with_open_sessions_counts_and_refuses():
+    async def go():
+        sm = _manager()
+        for sid in (0, 1):
+            assert (await sm.open("t", sid, bytes([sid]) * 16)).ok
+        await sm.drain()
+        assert sm.stats()["drained_open"] == 2
+        r = await sm.open("t", 9, b"\x09" * 16)
+        assert not r.ok and r.error == ERR_SHUTDOWN
+        r = await sm.reserve("t", 0, 16)
+        assert isinstance(r, Response) and r.error == ERR_BAD_REQUEST
+    asyncio.run(go())
+
+
+def test_open_validates_sid_and_key():
+    async def go():
+        sm = _manager()
+        assert (await sm.open("t", "x", b"\x01" * 16)).error == \
+            ERR_BAD_REQUEST
+        assert (await sm.open("t", -1, b"\x01" * 16)).error == \
+            ERR_BAD_REQUEST
+        assert (await sm.open("t", 0, b"")).error == ERR_BAD_REQUEST
+        assert (await sm.open("t", 0, b"\x01" * 16)).ok
+        r = await sm.open("t", 0, b"\x01" * 16)  # double open
+        assert not r.ok and r.error == ERR_BAD_REQUEST
+        await sm.drain()
+    asyncio.run(go())
+
+
+# ---------------------------------------------------------------------------
+# Serve integration: rc4 sessions through an in-process Server.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def served_rc4():
+    """One in-process rc4-enabled Server + frontend (module-scoped: the
+    warmup — every rung's XOR program plus the one fixed-shape prep
+    program — is the expensive part)."""
+    server = Server(ServerConfig(status_port=None, modes=("ctr", "rc4"),
+                                 session_quantum_bytes=2048,
+                                 session_prefetch_slots=2,
+                                 session_window_bytes=4096, **LADDER))
+    loop = asyncio.new_event_loop()
+    loop.run_until_complete(server.start())
+    front = RequestFrontend(server, 0)
+    loop.run_until_complete(front.start())
+    yield loop, server, front
+    loop.run_until_complete(front.stop())
+    loop.run_until_complete(server.stop())
+    loop.close()
+
+
+def test_server_interleaved_sessions_bit_exact_no_recompiles(served_rc4):
+    loop, server, _front = served_rc4
+    base = server.steady_compiles()
+    rng = np.random.default_rng(17)
+    keys = {i: rng.integers(0, 256, 16, dtype=np.uint8).tobytes()
+            for i in range(3)}
+    refs = {i: arc4.ARC4(k) for i, k in keys.items()}
+
+    async def go():
+        for i in range(3):
+            r = await server.open_session(f"t{i % 2}", i, keys[i])
+            assert r.ok, (r.error, r.detail)
+        for rnd in range(3):
+            for i in range(3):
+                n = 16 * int(rng.integers(1, 64))
+                data = rng.integers(0, 256, n, dtype=np.uint8)
+                r = await server.submit(f"t{i % 2}", b"", b"", data,
+                                        mode="rc4", sid=i)
+                assert r.ok, (i, rnd, r.error, r.detail)
+                ks = refs[i].prep(n, backend="np")
+                assert np.asarray(r.payload, np.uint8).tobytes() == \
+                    np.bitwise_xor(data, ks).tobytes(), (i, rnd)
+        for i in range(3):
+            assert (await server.close_session(f"t{i % 2}", i)).ok
+    loop.run_until_complete(go())
+    st = server.stats()["sessions"]
+    assert st["chunks"] == 9 and st["closed"] >= 3
+    # The zero-recompile contract holds with session traffic riding:
+    # every XOR rung and the one prep shape were primed at warmup.
+    assert server.steady_compiles() - base == 0
+
+
+def test_server_rc4_without_session_is_refused(served_rc4):
+    loop, server, _front = served_rc4
+    data = np.zeros(64, np.uint8)
+    r = loop.run_until_complete(
+        server.submit("t0", b"", b"", data, mode="rc4", sid=999))
+    assert not r.ok and r.error == ERR_BAD_REQUEST
+    r = loop.run_until_complete(
+        server.submit("t0", b"", b"", data, mode="rc4"))  # sid missing
+    assert not r.ok and r.error == ERR_BAD_REQUEST
+
+
+def test_server_without_rc4_mode_has_no_session_store():
+    server = Server(ServerConfig(status_port=None, **LADDER))
+    assert server.sessions is None
+
+    async def go():
+        await server.start()
+        try:
+            return await server.open_session("t", 0, b"\x01" * 16)
+        finally:
+            await server.stop()
+    r = asyncio.run(go())
+    assert not r.ok and r.error == ERR_BAD_REQUEST
+
+
+def test_lane_hang_mid_refill_replays_carry_bit_exact(monkeypatch):
+    """The lane-kill drill at the session seam: a hung lane is
+    quarantined by the watchdog and the SAME carry re-dispatches on the
+    healthy lane — every chunk byte-identical to the host oracle."""
+    monkeypatch.setenv("OT_FAULTS", "lane_hang:1")
+    monkeypatch.setenv("OT_DISPATCH_DEADLINE", "2")
+    faults.reset()
+    server = Server(ServerConfig(status_port=None, modes=("ctr", "rc4"),
+                                 min_bucket_blocks=32,
+                                 max_bucket_blocks=256, lanes=2,
+                                 session_quantum_bytes=2048,
+                                 session_prefetch_slots=2,
+                                 session_window_bytes=4096))
+    key = bytes(range(16))
+    ref = arc4.ARC4(key)
+
+    async def go():
+        await server.start()
+        try:
+            assert (await server.open_session("t", 0, key)).ok
+            rng = np.random.default_rng(1)
+            for i in range(6):
+                data = rng.integers(0, 256, 16 * 128, dtype=np.uint8)
+                r = await server.submit("t", b"", b"", data,
+                                        mode="rc4", sid=0)
+                assert r.ok, (i, r.error, r.detail)
+                ks = ref.prep(data.size, backend="np")
+                assert np.asarray(r.payload, np.uint8).tobytes() == \
+                    np.bitwise_xor(data, ks).tobytes(), i
+            return server.stats(), server.pool.quarantine_events()
+        finally:
+            await server.stop()
+
+    stats, quarantines = asyncio.run(go())
+    assert quarantines == 1
+    assert stats["sessions"]["prefetch"]["replays"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# The ss wire sub-protocol + the router's pin contract.
+# ---------------------------------------------------------------------------
+
+
+async def _ss_exchange(port: int, frames: list[tuple[dict, bytes]]):
+    """Send each (header, payload) frame and collect one answer per."""
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    out = []
+    try:
+        for header, payload in frames:
+            writer.write(wire.encode_frame(header, payload))
+            await writer.drain()
+            h, body = await wire.read_frame(reader)
+            out.append((h, body))
+        return out
+    finally:
+        writer.close()
+
+
+def test_worker_ss_protocol_round_trip(served_rc4):
+    loop, _server, front = served_rc4
+    key = b"\x77" * 16
+    ref = arc4.ARC4(key)
+    rng = np.random.default_rng(23)
+    chunks = [rng.integers(0, 256, 16 * n, dtype=np.uint8)
+              for n in (4, 32)]
+    frames = [({"ss": "open", "t": "wt", "sid": 5, "k": key.hex()}, b"")]
+    frames += [({"ss": "data", "t": "wt", "sid": 5}, c.tobytes())
+               for c in chunks]
+    frames.append(({"ss": "close", "t": "wt", "sid": 5}, b""))
+    answers = loop.run_until_complete(_ss_exchange(front.port, frames))
+    assert answers[0][0]["ok"] and answers[0][0]["ss"] == "open"
+    for c, (h, body) in zip(chunks, answers[1:-1]):
+        assert h["ok"] and h["ss"] == "data"
+        ks = ref.prep(c.size, backend="np")
+        assert body == np.bitwise_xor(c, ks).tobytes()
+    assert answers[-1][0]["ok"] and answers[-1][0]["ss"] == "close"
+
+
+def test_worker_ss_frame_validation(served_rc4):
+    loop, _server, front = served_rc4
+    answers = loop.run_until_complete(_ss_exchange(front.port, [
+        ({"ss": "open", "t": "wt", "sid": "nope"}, b""),
+        ({"ss": "bogus-op", "t": "wt", "sid": 1}, b""),
+        ({"ss": "data", "t": "wt", "sid": 404}, b"\x00" * 16),
+    ]))
+    for h, _body in answers:
+        assert not h["ok"] and h["error"] == ERR_BAD_REQUEST
+
+
+def test_router_session_data_requires_a_pin():
+    from our_tree_tpu.route.proxy import (BackendSpec, Router,
+                                          RouterConfig)
+    router = Router([BackendSpec("b0", "127.0.0.1", 1)], RouterConfig())
+    r = asyncio.run(router.submit_session("t", 3, b"\x00" * 16))
+    assert not r.ok and r.error == ERR_BAD_REQUEST
+    assert "not open" in r.detail
